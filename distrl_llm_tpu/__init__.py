@@ -1,0 +1,15 @@
+"""distrl_llm_tpu — a TPU-native distributed RL framework for LLM fine-tuning.
+
+Built from scratch in JAX/XLA/Pallas/pjit with the capabilities of
+BY571/DistRL-LLM: data-parallel rollout workers sample many candidate
+completions per prompt through a jit-compiled generation engine, rule-based
+rewards score them on the host, and LoRA learners apply policy-gradient or
+GRPO updates with gradient averaging over ICI collectives. Roles
+(generator/learner) are partitions of one ``jax.sharding.Mesh`` rather than
+processes; weight sync is a device-to-device transfer rather than an
+adapter file on a shared filesystem.
+"""
+
+__version__ = "0.1.0"
+
+from distrl_llm_tpu.config import MeshConfig, SamplingConfig, TrainConfig  # noqa: F401

@@ -1,0 +1,108 @@
+"""Driver-side reward shaping: baselines, GRPO advantages, top-k filtering.
+
+Parity with the reference Trainer's inline numpy blocks
+(distributed_trainer.py:262–294). Shaping runs on the host between the rollout
+round and the learner step; arrays are small (batch·n scalars) so there is
+nothing to jit here.
+
+Contract recap (SURVEY §3.6.7): per-candidate rewards arrive as (n, 2) arrays —
+column 0 format, column 1 accuracy. Training consumes the row sum; metrics
+split the columns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, MutableMapping, Sequence
+
+import numpy as np
+
+
+@dataclass
+class ShapingStats:
+    """Per-round metric accumulators matching the reference's lists
+    (distributed_trainer.py:256–260)."""
+
+    mean_acc: list[float] = field(default_factory=list)
+    max_acc: list[float] = field(default_factory=list)
+    min_acc: list[float] = field(default_factory=list)
+    mean_format: list[float] = field(default_factory=list)
+    mean_token_length: list[float] = field(default_factory=list)
+
+
+def shape_rewards(
+    candidates: Sequence[MutableMapping[str, Any]],
+    learner_type: str,
+) -> ShapingStats:
+    """Shape each task group's (n, 2) rewards in place and collect metrics.
+
+    PG: ``rewards`` ← per-candidate summed reward, plus a ``baselines`` list of
+    group means (subtracted later in the learner — distributed_trainer.py:277–279).
+    GRPO: ``rewards`` ← (r − mean)/(std + 1e-8) group-normalized advantages
+    (:273, :275–276). Metrics mirror :266–272.
+    """
+    stats = ShapingStats()
+    for cand in candidates:
+        baselines, summed, advantages = [], [], []
+        for group_reward, group_tokens in zip(cand["rewards"], cand["token_lengths"]):
+            group_reward = np.asarray(group_reward)
+            total = group_reward.sum(axis=1)
+            mean = float(np.mean(total))
+            baselines.append(mean)
+            summed.append(total)
+            advantages.append((total - mean) / (np.std(total) + 1e-8))
+
+            stats.mean_acc.append(float(np.mean(group_reward[:, 1])))
+            stats.max_acc.append(float(np.max(group_reward[:, 1])))
+            stats.min_acc.append(float(np.min(group_reward[:, 1])))
+            stats.mean_format.append(float(np.mean(group_reward[:, 0])))
+            stats.mean_token_length.append(float(np.mean(group_tokens)))
+
+        if learner_type == "grpo":
+            cand["rewards"] = advantages
+        else:
+            cand["baselines"] = baselines
+            cand["rewards"] = summed
+    return stats
+
+
+def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> None:
+    """Keep the top-k candidates per task group by shaped reward, in place
+    (distributed_trainer.py:281–294). Answers and rewards are selected by
+    argsort; problems are truncated, not reordered — safe because every entry
+    in a group is the identical prompt (SURVEY §3.6.5)."""
+    for cand in candidates:
+        kept_answers, kept_rewards, kept_problems = [], [], []
+        for j, rewards in enumerate(cand["rewards"]):
+            idx = np.argsort(rewards)[-topk:]
+            kept_answers.append([cand["answers"][j][i] for i in idx])
+            kept_rewards.append(np.asarray(rewards)[idx])
+            kept_problems.append(cand["problem"][j][:topk])
+        cand["answers"] = kept_answers
+        cand["rewards"] = kept_rewards
+        cand["problem"] = kept_problems
+
+
+def flatten_for_update(
+    candidates: Sequence[MutableMapping[str, Any]], learner_type: str
+) -> tuple[list[str], list[str], np.ndarray]:
+    """Flatten shaped candidates into (problems, answers, scalar-coefficient)
+    lists for the learner. PG applies reward − baseline here
+    (distributed_actor.py:399–406); GRPO passes advantages through (:495–504)."""
+    problems: list[str] = []
+    answers: list[str] = []
+    coeffs: list[float] = []
+    for cand in candidates:
+        if learner_type == "grpo":
+            for a, p, r in zip(cand["answers"], cand["problem"], cand["rewards"]):
+                problems.extend(p)
+                answers.extend(a)
+                coeffs.extend(np.asarray(r).tolist())
+        else:
+            for a, p, r, b in zip(
+                cand["answers"], cand["problem"], cand["rewards"], cand["baselines"]
+            ):
+                problems.extend(p)
+                answers.extend(a)
+                coeffs.extend((np.asarray(r) - b).tolist())
+    return problems, answers, np.asarray(coeffs, dtype=np.float32)

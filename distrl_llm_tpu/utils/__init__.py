@@ -1,0 +1,6 @@
+from distrl_llm_tpu.utils.chunking import (  # noqa: F401
+    chunk_sizes,
+    even_chunks,
+    merge_candidates,
+    split_dict_lists,
+)

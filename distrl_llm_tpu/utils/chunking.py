@@ -1,0 +1,111 @@
+"""Batch-chunking math for dispatching prompts across rollout workers.
+
+Behavioral parity with the reference's Trainer statics
+(distributed_trainer.py:77–169): ``chunk_sizes`` returns per-worker batch
+sizes — actors first, then learners at a fixed ``learner_chunk_size`` — with
+the same degradation policy when the batch is smaller than the worker pool
+(actors are prioritized, learners shrink or drop; SURVEY §4 "unit" targets).
+``split_dict_lists`` slices a dict-of-lists into those chunks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping, Sequence
+
+log = logging.getLogger(__name__)
+
+
+def chunk_sizes(
+    batch_size: int,
+    num_actors: int,
+    num_learners: int = 1,
+    learner_chunk_size: int = 1,
+) -> list[int]:
+    """Per-worker chunk sizes: ``num_actors`` near-even actor chunks followed by
+    ``num_learners`` chunks of ``learner_chunk_size``.
+
+    Under-provisioned batches (batch < actors + learners·chunk) follow the
+    reference's policy (distributed_trainer.py:98–124): give every actor at
+    least one item if possible, then fit learners into the remainder with a
+    shrunken chunk size; if even the actors don't fit, the batch is spread over
+    the first ``batch_size`` actors and learners get nothing.
+    """
+    if batch_size <= 0 or num_learners <= 0 or num_actors < 0:
+        raise ValueError("Batch size, number of learners and number of actors must be positive")
+
+    learner_total = learner_chunk_size * num_learners
+
+    if batch_size < num_actors + learner_total:
+        log.warning(
+            "batch size (%d) is smaller than actors + learners need (%d)",
+            batch_size,
+            num_actors + learner_total,
+        )
+        if batch_size >= num_actors:
+            remaining = batch_size - num_actors
+            if remaining > 0 and num_learners > 0:
+                learner_chunk_size = max(1, remaining // num_learners)
+                num_learners = min(num_learners, remaining // learner_chunk_size)
+                learner_total = learner_chunk_size * num_learners
+            else:
+                num_learners, learner_total = 0, 0
+        else:
+            num_actors = batch_size
+            num_learners, learner_total = 0, 0
+
+    actor_total = batch_size - learner_total
+    sizes: list[int] = []
+    if num_actors > 0:
+        base, extra = divmod(actor_total, num_actors)
+        sizes = [base + (1 if i < extra else 0) for i in range(num_actors)]
+    sizes.extend([learner_chunk_size] * num_learners)
+    return sizes
+
+
+def split_dict_lists(
+    data: Mapping[str, Sequence[Any]], sizes: Sequence[int] | int
+) -> list[dict[str, list[Any]]]:
+    """Slice every list in ``data`` into consecutive chunks of ``sizes``
+    (distributed_trainer.py:142–169). All lists must share a length equal to
+    ``sum(sizes)``."""
+    if isinstance(sizes, int):
+        sizes = [sizes]
+
+    length = len(next(iter(data.values())))
+    if any(len(v) != length for v in data.values()):
+        raise ValueError("All lists in the dictionary must have the same length")
+    if sum(sizes) != length:
+        raise ValueError(
+            f"Sum of chunk sizes ({sum(sizes)}) must equal the length of lists ({length})"
+        )
+
+    chunks = []
+    start = 0
+    for size in sizes:
+        chunks.append({k: list(v[start : start + size]) for k, v in data.items()})
+        start += size
+    return chunks
+
+
+def merge_candidates(
+    candidates: Sequence[Mapping[str, Any]],
+) -> tuple[list[Any], list[Any], list[Any]]:
+    """Flatten per-worker candidate dicts into parallel (problems, answers,
+    rewards) lists (distributed_trainer.py:221–230)."""
+    problems: list[Any] = []
+    answers: list[Any] = []
+    rewards: list[Any] = []
+    for cand in candidates:
+        for a, p, r in zip(cand["answers"], cand["problem"], cand["rewards"]):
+            problems.extend(p)
+            answers.extend(a)
+            rewards.extend(r)
+    return problems, answers, rewards
+
+
+def even_chunks(total: int, parts: int) -> list[int]:
+    """Split ``total`` items into ``parts`` near-even chunk sizes, remainder
+    spread over the leading chunks (distributed_trainer.py:312–314)."""
+    base, extra = divmod(total, parts)
+    return [base + (1 if i < extra else 0) for i in range(parts)]

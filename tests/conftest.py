@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device CPU mesh before JAX initializes.
+
+Sharding/collective tests (DP/TP/FSDP/ring attention, psum gradient sync) run
+on virtual CPU devices so CI needs no TPU (SURVEY §4). These env vars must be
+set before the first `import jax` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,81 @@
+"""Config contract tests (reference CLI: train_distributed.py:10–35, :54–81)."""
+
+import pytest
+
+from distrl_llm_tpu.config import MeshConfig, SamplingConfig, TrainConfig
+
+
+class TestTrainConfig:
+    def test_reference_defaults(self):
+        c = TrainConfig()
+        assert c.lr == 2e-5
+        assert c.max_new_tokens == 1200
+        assert c.max_prompt_tokens == 350
+        assert c.temperature == 1.2
+        assert c.episodes == 15
+        assert c.num_candidates == 16
+        assert c.batch_size == 30
+        assert c.learner_chunk_size == 8
+        assert c.train_batch_size == 8
+        assert c.save_every == 100
+        assert c.eval_every == 10
+        assert c.number_of_actors == 2
+        assert c.number_of_learners == 1
+        assert c.learner == "pg"
+        assert c.max_lora_rank == 32
+        assert c.lora_alpha == 16
+        assert c.topk == 16
+
+    def test_max_seq_length(self):
+        assert TrainConfig().max_seq_length == 1550
+
+    def test_sampling_configs(self):
+        c = TrainConfig()
+        train = c.train_sampling()
+        assert (train.temperature, train.top_p, train.n) == (1.2, 0.95, 16)
+        ev = c.eval_sampling()
+        assert (ev.temperature, ev.top_p, ev.n) == (0.6, 0.95, 8)
+
+    def test_invalid_learner_raises(self):
+        with pytest.raises(ValueError):
+            TrainConfig(learner="ppo")
+
+    def test_mesh_roles_sync(self):
+        c = TrainConfig(number_of_actors=4, number_of_learners=2)
+        assert c.mesh.number_of_actors == 4
+        assert c.mesh.number_of_learners == 2
+        assert c.mesh.num_roles == 6
+
+    def test_conflicting_mesh_roles_raise(self):
+        with pytest.raises(ValueError, match="conflict"):
+            TrainConfig(
+                number_of_actors=2,
+                number_of_learners=1,
+                mesh=MeshConfig(number_of_actors=4, number_of_learners=2),
+            )
+
+    def test_matching_mesh_roles_allowed(self):
+        c = TrainConfig(
+            number_of_actors=4,
+            number_of_learners=2,
+            mesh=MeshConfig(number_of_actors=4, number_of_learners=2, tp=2),
+        )
+        assert c.mesh.tp == 2
+
+    def test_flat_dict_has_reference_keys(self):
+        flat = TrainConfig().to_flat_dict()
+        for key in (
+            "run_name", "project_name", "lora_save_path", "lr", "max_prompt_tokens",
+            "max_new_tokens", "episodes", "num_candidates", "batch_size",
+            "train_batch_size", "temperature", "save_every", "eval_every", "model",
+            "dataset", "number_of_actors", "number_of_learners", "learner",
+            "use_vllm", "max_lora_rank", "topk", "learner_chunk_size",
+            "actor_gpu_usage", "learner_gpu_usage", "lora_alpha", "lora_dropout",
+        ):
+            assert key in flat, key
+
+
+class TestSamplingConfig:
+    def test_replace(self):
+        s = SamplingConfig().replace(n=8, temperature=0.6)
+        assert s.n == 8 and s.temperature == 0.6 and s.top_p == 0.95

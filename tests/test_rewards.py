@@ -1,0 +1,133 @@
+"""Reward-function parity tests (reference: reward_functions.py).
+
+Golden completions exercise every branch of the format/accuracy shaping,
+including the parity quirks: anchored no-DOTALL soft-format match and the
+trailing-text length penalty.
+"""
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.rewards import (
+    RewardComputer,
+    correctness_reward,
+    extract_xml_answer,
+    reward_function,
+    soft_format_reward,
+    strict_format_reward,
+    xmlcount_reward,
+)
+
+# Canonical format with trailing newline: all four xml-count branches fire with
+# zero length penalty → format score exactly 0.2.
+GOOD = "<think>\nsome reasoning\n</think>\n<answer>\n42\n</answer>\n"
+# Without the trailing newline, "\n</answer>\n" never occurs so the third branch
+# penalises by the FULL text length (reference quirk), and the fourth branch adds
+# +0.001 (empty tail, len-1 == -1).
+GOOD_NO_NL = GOOD[:-1]
+ONELINE = "<think>reasoning</think> <answer>42</answer>"
+
+
+class TestExtractXmlAnswer:
+    def test_basic(self):
+        assert extract_xml_answer("<answer>42</answer>") == "42"
+
+    def test_strips_whitespace(self):
+        assert extract_xml_answer("<answer>\n 42 \n</answer>") == "42"
+
+    def test_last_answer_tag_wins(self):
+        text = "<answer>1</answer> then <answer>2</answer>"
+        assert extract_xml_answer(text) == "2"
+
+    def test_no_tags_returns_whole_text(self):
+        assert extract_xml_answer("just 42") == "just 42"
+
+    def test_unclosed_tag(self):
+        assert extract_xml_answer("<answer>42") == "42"
+
+
+class TestCorrectness:
+    def test_match_and_mismatch(self):
+        out = correctness_reward(
+            ["<answer>42</answer>", "<answer>41</answer>"], ["42", "42"]
+        )
+        np.testing.assert_array_equal(out, [1.0, 0.0])
+
+    def test_exact_string_not_numeric(self):
+        # "42.0" != "42" — the reference is an exact string compare
+        out = correctness_reward(["<answer>42.0</answer>"], ["42"])
+        np.testing.assert_array_equal(out, [0.0])
+
+
+class TestSoftFormat:
+    def test_oneline_matches(self):
+        np.testing.assert_array_equal(soft_format_reward([ONELINE]), [0.1])
+
+    def test_multiline_think_does_not_match(self):
+        # parity quirk: no DOTALL — newline inside <think> blocks the match
+        np.testing.assert_array_equal(soft_format_reward([GOOD]), [0.0])
+
+    def test_not_anchored_at_start_fails(self):
+        np.testing.assert_array_equal(soft_format_reward(["x" + ONELINE]), [0.0])
+
+
+class TestStrictFormat:
+    def test_exact_newline_format(self):
+        s = "<think>\nr\n</think>\n<answer>\n42\n</answer>\n"
+        np.testing.assert_array_equal(strict_format_reward([s]), [0.1])
+        np.testing.assert_array_equal(strict_format_reward([ONELINE]), [0.0])
+
+
+class TestXmlCount:
+    def test_well_formed_scores_02(self):
+        assert xmlcount_reward([GOOD])[0] == pytest.approx(0.2)
+
+    def test_missing_trailing_newline_penalty(self):
+        # third branch tail = whole text (53 chars) → −0.053; fourth branch
+        # tail = "" → −(0−1)·0.001 = +0.001
+        assert len(GOOD_NO_NL) == 53
+        assert xmlcount_reward([GOOD_NO_NL])[0] == pytest.approx(0.2 - 0.053 + 0.001)
+
+    def test_trailing_text_penalty(self):
+        trailing = GOOD + "\nextra stuff"
+        base = xmlcount_reward([GOOD])[0]
+        assert xmlcount_reward([trailing])[0] < base
+
+    def test_empty(self):
+        assert xmlcount_reward([""])[0] == 0.0
+
+
+class TestRewardFunction:
+    def test_shape_and_columns(self):
+        out = reward_function([GOOD, ONELINE], ["42", "41"])
+        assert out.shape == (2, 2)
+        # column 1 is accuracy
+        assert out[0, 1] == 1.0 and out[1, 1] == 0.0
+        # column 0 is format: ONELINE gets the 0.1 soft reward, GOOD gets xmlcount
+        assert out[1, 0] == pytest.approx(0.1)
+        assert out[0, 0] == pytest.approx(0.2)
+
+    def test_empty_batch(self):
+        out = reward_function([], [])
+        assert out.shape == (0, 2)
+
+
+class TestRewardComputer:
+    def test_serial_matches_reference_function(self):
+        rc = RewardComputer(num_workers=0)
+        groups = [([GOOD, ONELINE], ["42", "42"]), ([ONELINE], ["7"])]
+        outs = rc(groups)
+        assert len(outs) == 2
+        np.testing.assert_array_equal(outs[0], reward_function(*groups[0]))
+        np.testing.assert_array_equal(outs[1], reward_function(*groups[1]))
+
+    def test_parallel_matches_serial(self):
+        rc = RewardComputer(num_workers=2, parallel_threshold=1)
+        groups = [([GOOD] * 10, ["42"] * 10), ([ONELINE] * 10, ["42"] * 10)]
+        try:
+            par = rc(groups)
+        finally:
+            rc.close()
+        ser = [reward_function(c, s) for c, s in groups]
+        for p, s in zip(par, ser):
+            np.testing.assert_array_equal(p, s)

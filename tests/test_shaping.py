@@ -1,0 +1,104 @@
+"""Advantage/baseline shaping and top-k filtering parity tests
+(reference: distributed_trainer.py:262–294; learner flattening at
+distributed_actor.py:397–416, :495–514)."""
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.shaping import flatten_for_update, shape_rewards, topk_filter
+
+
+def make_candidate(groups):
+    """groups: list of (rewards_(n,2), token_lengths_n)."""
+    return {
+        "problem": [[f"p{i}"] * len(r) for i, (r, _) in enumerate(groups)],
+        "answers": [[f"a{i}_{j}" for j in range(len(r))] for i, (r, _) in enumerate(groups)],
+        "rewards": [np.asarray(r, dtype=np.float64) for r, _ in groups],
+        "token_lengths": [list(t) for _, t in groups],
+    }
+
+
+class TestShapeRewardsPG:
+    def test_summed_rewards_and_baselines(self):
+        r = [[0.1, 1.0], [0.2, 0.0]]  # sums: 1.1, 0.2 → baseline 0.65
+        cand = make_candidate([(r, [10, 20])])
+        stats = shape_rewards([cand], "pg")
+        np.testing.assert_allclose(cand["rewards"][0], [1.1, 0.2])
+        assert cand["baselines"] == [pytest.approx(0.65)]
+        assert stats.mean_acc == [pytest.approx(0.5)]
+        assert stats.max_acc == [1.0]
+        assert stats.min_acc == [0.0]
+        assert stats.mean_format == [pytest.approx(0.15)]
+        assert stats.mean_token_length == [15.0]
+
+
+class TestShapeRewardsGRPO:
+    def test_group_normalized_advantages(self):
+        r = [[0.0, 1.0], [0.0, 0.0], [0.0, 1.0], [0.0, 0.0]]
+        cand = make_candidate([(r, [1, 1, 1, 1])])
+        shape_rewards([cand], "grpo")
+        adv = cand["rewards"][0]
+        total = np.array([1.0, 0.0, 1.0, 0.0])
+        expected = (total - 0.5) / (0.5 + 1e-8)
+        np.testing.assert_allclose(adv, expected, rtol=1e-6)
+        assert "baselines" not in cand
+
+    def test_identical_rewards_give_zero_advantage(self):
+        r = [[0.1, 1.0]] * 4
+        cand = make_candidate([(r, [1] * 4)])
+        shape_rewards([cand], "grpo")
+        np.testing.assert_allclose(cand["rewards"][0], 0.0, atol=1e-6)
+
+
+class TestTopkFilter:
+    def test_keeps_best_k(self):
+        cand = {
+            "problem": [["p", "p", "p", "p"]],
+            "answers": [["w", "x", "y", "z"]],
+            "rewards": [np.array([0.1, 0.9, 0.5, 0.7])],
+        }
+        topk_filter([cand], topk=2)
+        # argsort ascending, last 2 → indices [3, 1] (0.7 then 0.9)
+        assert cand["answers"][0] == ["z", "x"]
+        np.testing.assert_allclose(cand["rewards"][0], [0.7, 0.9])
+        assert cand["problem"][0] == ["p", "p"]
+
+    def test_topk_equal_n_is_reorder_only(self):
+        cand = {
+            "problem": [["p", "p"]],
+            "answers": [["a", "b"]],
+            "rewards": [np.array([0.9, 0.1])],
+        }
+        topk_filter([cand], topk=2)
+        assert sorted(cand["answers"][0]) == ["a", "b"]
+        assert len(cand["rewards"][0]) == 2
+
+
+class TestFlattenForUpdate:
+    def test_pg_subtracts_baseline(self):
+        cand = {
+            "problem": [["p", "p"]],
+            "answers": [["a", "b"]],
+            "rewards": [np.array([1.0, 0.5])],
+            "baselines": [0.75],
+        }
+        problems, answers, coeffs = flatten_for_update([cand], "pg")
+        assert problems == ["p", "p"] and answers == ["a", "b"]
+        np.testing.assert_allclose(coeffs, [0.25, -0.25])
+
+    def test_grpo_passes_through(self):
+        cand = {
+            "problem": [["p"]],
+            "answers": [["a"]],
+            "rewards": [np.array([1.5])],
+        }
+        _, _, coeffs = flatten_for_update([cand], "grpo")
+        np.testing.assert_allclose(coeffs, [1.5])
+
+    def test_roundtrip_through_shaping(self):
+        r = [[0.0, 1.0], [0.0, 0.0]]
+        cand = make_candidate([(r, [1, 1])])
+        shape_rewards([cand], "pg")
+        _, _, coeffs = flatten_for_update([cand], "pg")
+        # summed − baseline: [1.0, 0.0] − 0.5
+        np.testing.assert_allclose(coeffs, [0.5, -0.5])
