@@ -1,0 +1,21 @@
+from distrl_llm_tpu.models.configs import (  # noqa: F401
+    LLAMA3_8B,
+    PRESETS,
+    QWEN2_0_5B,
+    QWEN2_7B,
+    QWEN2_72B,
+    TINY,
+    ModelConfig,
+    preset_for_model_name,
+)
+from distrl_llm_tpu.models.lora import (  # noqa: F401
+    DEFAULT_TARGETS,
+    init_lora_params,
+    lora_scale,
+    merge_lora,
+)
+from distrl_llm_tpu.models.transformer import (  # noqa: F401
+    forward,
+    init_kv_cache,
+    init_params,
+)

@@ -1,0 +1,123 @@
+"""Model architecture configs for the dense decoder family the reference
+trains (Qwen2.5 / Llama-3 — reference model flags at train_distributed.py:11
+and BASELINE.json configs).
+
+One ``ModelConfig`` covers the whole family: GQA attention with optional QKV
+bias (Qwen2 yes, Llama no), SwiGLU MLP, RMSNorm, RoPE, optional tied
+embeddings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    attention_bias: bool = False  # Qwen2: bias on q/k/v only
+    tie_word_embeddings: bool = False
+    max_position_embeddings: int = 32768
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @staticmethod
+    def from_hf_config(hf) -> "ModelConfig":
+        """Build from a transformers PretrainedConfig (Qwen2Config/LlamaConfig)."""
+        get = lambda k, d=None: getattr(hf, k, d)
+        num_heads = hf.num_attention_heads
+        return ModelConfig(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=num_heads,
+            num_kv_heads=get("num_key_value_heads", num_heads),
+            head_dim=get("head_dim", None) or hf.hidden_size // num_heads,
+            rope_theta=get("rope_theta", 10000.0),
+            rms_norm_eps=get("rms_norm_eps", 1e-6),
+            attention_bias=hf.model_type == "qwen2" or bool(get("attention_bias", False)),
+            tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+            max_position_embeddings=get("max_position_embeddings", 32768),
+        )
+
+
+# Tiny config for unit/golden tests — shapes chosen to exercise GQA (heads !=
+# kv_heads) while staying sub-millisecond on CPU.
+TINY = ModelConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    rope_theta=10000.0,
+    attention_bias=True,
+    tie_word_embeddings=False,
+)
+
+QWEN2_0_5B = ModelConfig(
+    vocab_size=151936, hidden_size=896, intermediate_size=4864, num_layers=24,
+    num_heads=14, num_kv_heads=2, head_dim=64, rope_theta=1000000.0,
+    attention_bias=True, tie_word_embeddings=True,
+)
+
+QWEN2_7B = ModelConfig(
+    vocab_size=152064, hidden_size=3584, intermediate_size=18944, num_layers=28,
+    num_heads=28, num_kv_heads=4, head_dim=128, rope_theta=1000000.0,
+    attention_bias=True, tie_word_embeddings=False,
+)
+
+QWEN2_72B = ModelConfig(
+    vocab_size=152064, hidden_size=8192, intermediate_size=29568, num_layers=80,
+    num_heads=64, num_kv_heads=8, head_dim=128, rope_theta=1000000.0,
+    attention_bias=True, tie_word_embeddings=False,
+)
+
+LLAMA3_8B = ModelConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+    rms_norm_eps=1e-5, attention_bias=False, tie_word_embeddings=False,
+)
+
+PRESETS: dict[str, ModelConfig] = {
+    "tiny": TINY,
+    "qwen2.5-0.5b": QWEN2_0_5B,
+    "qwen2.5-7b": QWEN2_7B,
+    "qwen2.5-72b": QWEN2_72B,
+    "llama-3-8b": LLAMA3_8B,
+}
+
+
+def preset_for_model_name(name: str) -> ModelConfig | None:
+    """Map an HF-style model id (e.g. 'Qwen/Qwen2.5-7B-Instruct') to a preset."""
+    low = name.lower()
+    if low == "tiny":  # exact only — "tiny" substrings occur in real model ids
+        return TINY
+    for key, cfg in PRESETS.items():
+        if key != "tiny" and key in low.replace("_", "-"):
+            return cfg
+    if "0.5b" in low and "qwen" in low:
+        return QWEN2_0_5B
+    if "7b" in low and "qwen" in low:
+        return QWEN2_7B
+    if "72b" in low and "qwen" in low:
+        return QWEN2_72B
+    if "8b" in low and "llama" in low:
+        return LLAMA3_8B
+    return None

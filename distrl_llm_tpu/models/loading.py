@@ -1,0 +1,117 @@
+"""HF checkpoint → param-pytree loading.
+
+Maps transformers-style state dicts (Qwen2/Llama safetensors) onto the stacked
+[L, ...] layout of models/transformer.py. Replaces the reference's
+FastLanguageModel.from_pretrained load path (distributed_actor.py:58–66) —
+here loading is a host-side numpy pass followed by an optional device_put with
+sharding, so multi-host loads stream straight to their shards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from distrl_llm_tpu.models.configs import ModelConfig
+
+Params = dict[str, Any]
+
+# our layer key → (HF projection name, transpose?)  — HF Linear stores [out, in]
+_HF_LAYER_MAP = {
+    "wq": "self_attn.q_proj.weight",
+    "wk": "self_attn.k_proj.weight",
+    "wv": "self_attn.v_proj.weight",
+    "wo": "self_attn.o_proj.weight",
+    "w_gate": "mlp.gate_proj.weight",
+    "w_up": "mlp.up_proj.weight",
+    "w_down": "mlp.down_proj.weight",
+    "bq": "self_attn.q_proj.bias",
+    "bk": "self_attn.k_proj.bias",
+    "bv": "self_attn.v_proj.bias",
+    "attn_norm": "input_layernorm.weight",
+    "mlp_norm": "post_attention_layernorm.weight",
+}
+
+
+def _get(sd: Mapping[str, np.ndarray], name: str) -> np.ndarray:
+    if name in sd:
+        return np.asarray(sd[name])
+    # some exports drop the "model." prefix
+    alt = name.removeprefix("model.")
+    if alt in sd:
+        return np.asarray(sd[alt])
+    raise KeyError(name)
+
+
+def params_from_state_dict(
+    sd: Mapping[str, np.ndarray], cfg: ModelConfig, dtype=np.float32
+) -> Params:
+    """Numpy state dict (HF names) → our stacked param pytree."""
+
+    def stack(key: str, hf_name: str) -> np.ndarray:
+        per_layer = [
+            _get(sd, f"model.layers.{i}.{hf_name}") for i in range(cfg.num_layers)
+        ]
+        out = np.stack(per_layer).astype(dtype)
+        if key.startswith("w"):  # weights: HF [out, in] → ours [in, out]
+            out = out.transpose(0, 2, 1)
+        return out
+
+    layers = {
+        key: stack(key, hf_name)
+        for key, hf_name in _HF_LAYER_MAP.items()
+        if cfg.attention_bias or not key.startswith("b")
+    }
+    params: Params = {
+        "embed": _get(sd, "model.embed_tokens.weight").astype(dtype),
+        "final_norm": _get(sd, "model.norm.weight").astype(dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _get(sd, "lm_head.weight").astype(dtype).T
+    return params
+
+
+def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
+    """All tensors from a checkpoint directory's .safetensors shards, on host.
+    Honors the index file when present."""
+    from safetensors.numpy import load_file
+
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+    else:
+        shards = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    sd: dict[str, np.ndarray] = {}
+    for shard in shards:
+        sd.update(load_file(os.path.join(path, shard)))
+    return sd
+
+
+def load_pretrained(
+    path: str,
+    cfg: ModelConfig | None = None,
+    dtype=np.float32,
+    shard_fn: Callable[[Params], Params] | None = None,
+) -> tuple[Params, ModelConfig]:
+    """Load an HF-format local checkpoint directory. ``shard_fn`` (e.g. a
+    device_put with NamedSharding) is applied to the host tree, letting each
+    process materialize only its shards."""
+    if cfg is None:
+        with open(os.path.join(path, "config.json")) as f:
+            hf_cfg = json.load(f)
+
+        class _NS:
+            def __init__(self, d):
+                self.__dict__.update(d)
+
+        cfg = ModelConfig.from_hf_config(_NS(hf_cfg))
+    sd = load_safetensors_dir(path)
+    params = params_from_state_dict(sd, cfg, dtype=dtype)
+    if shard_fn is not None:
+        params = shard_fn(params)
+    return params, cfg

@@ -1,0 +1,82 @@
+"""LoRA adapter pytrees over the frozen base decoder.
+
+Equivalent of the reference's unsloth PEFT wrap (helper.py:25–46): rank-r
+adapters on q/k/v/o/gate/up/down projections, alpha scaling (rsLoRA off),
+zero-init B so step 0 is the base model. Unlike the reference, the adapter is
+a plain pytree — weight sync to rollout workers is `jax.device_put` of these
+arrays, not a filesystem round-trip (SURVEY §2b N2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.models.configs import ModelConfig
+
+Params = dict[str, Any]
+
+# layer-param key → (in_dim_attr, out_dim_attr) resolved against ModelConfig
+_TARGET_DIMS = {
+    "wq": ("hidden_size", "q_dim"),
+    "wk": ("hidden_size", "kv_dim"),
+    "wv": ("hidden_size", "kv_dim"),
+    "wo": ("q_dim", "hidden_size"),
+    "w_gate": ("hidden_size", "intermediate_size"),
+    "w_up": ("hidden_size", "intermediate_size"),
+    "w_down": ("intermediate_size", "hidden_size"),
+}
+
+# reference target_modules (helper.py:29–37) in our key naming
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def lora_scale(rank: int, alpha: float) -> float:
+    return alpha / rank
+
+
+def init_lora_params(
+    rng: jax.Array,
+    cfg: ModelConfig,
+    rank: int,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    dtype=jnp.float32,
+) -> Params:
+    """A ~ N(0, 1/r) (std r^-1/2), B = 0 — output delta starts at 0 and the
+    initial A@B gradient scale is rank-independent."""
+    layers: Params = {}
+    keys = jax.random.split(rng, len(targets))
+    for key, target in zip(keys, targets):
+        d_in = getattr(cfg, _TARGET_DIMS[target][0])
+        d_out = getattr(cfg, _TARGET_DIMS[target][1])
+        a = jax.random.normal(key, (cfg.num_layers, d_in, rank)) * (rank**-0.5)
+        layers[target] = {
+            "a": a.astype(dtype),
+            "b": jnp.zeros((cfg.num_layers, rank, d_out), dtype),
+        }
+    return {"layers": layers}
+
+
+def merge_lora(base: Params, lora: Params, alpha: float) -> Params:
+    """Fold adapters into a copy of the base weights (W + A@B·alpha/r) — used
+    for checkpoint export, mirroring the reference's save_pretrained artifact
+    (distributed_actor.py:263–264). Rank is derived from the adapter shapes so
+    the scale can't silently mismatch."""
+    rank = next(iter(lora["layers"].values()))["a"].shape[-1]
+    scale = lora_scale(rank, alpha)
+    merged_layers = dict(base["layers"])
+    for target, ab in lora["layers"].items():
+        w = base["layers"][target]
+        if hasattr(w, "matmul"):
+            raise NotImplementedError("cannot merge LoRA into quantized base weights")
+        delta = jnp.einsum("lir,lro->lio", ab["a"].astype(w.dtype), ab["b"].astype(w.dtype))
+        merged_layers[target] = w + delta * scale
+    out = dict(base)
+    out["layers"] = merged_layers
+    return out
+
+
+def lora_param_count(lora: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(lora))

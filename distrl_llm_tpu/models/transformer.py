@@ -1,0 +1,223 @@
+"""Pure-JAX GQA decoder (Qwen2 / Llama-3 family) over param pytrees.
+
+TPU-first design choices, deliberately unlike the reference's torch modules:
+
+* **Stacked layers + lax.scan** — per-layer params are stacked on a leading
+  [L, ...] axis and the decoder scans one compiled layer body over them. XLA
+  compiles the layer once instead of L times, and the same scan carries the KV
+  cache through prefill/decode.
+* **Functional everywhere** — params are nested dicts; the forward is a pure
+  function of (params, lora, inputs, cache), so jit/pjit/grad/remat compose
+  trivially and weight sync is array movement, not module surgery.
+* **Fixed shapes** — callers pad to static prompt/answer lengths (the
+  reference already does this on the learner side: distributed_actor.py:217–229),
+  so every distinct shape compiles exactly once.
+
+LoRA (q/k/v/o/gate/up/down targets — helper.py:29–37) is a separate pytree of
+stacked (A, B) factors applied additively inside the layer body; the base tree
+is frozen and may hold quantized weight containers (ops/quant.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.models.configs import ModelConfig
+from distrl_llm_tpu.ops.attention import attention, causal_padding_mask
+from distrl_llm_tpu.ops.linear import linear, lora_delta
+
+Params = dict[str, Any]
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+    """positions [B, S] → (cos, sin) each [B, S, head_dim/2], f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [B, S, H, D] by per-position angles (HF rotate-half convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _proj(h, p, lora, key, bias_key, lora_scale):
+    """One projection with optional bias and optional LoRA delta."""
+    y = linear(h, p[key], p.get(bias_key))
+    if lora is not None and key in lora:
+        y = y + lora_delta(h, lora[key]["a"], lora[key]["b"], lora_scale)
+    return y
+
+
+def _layer(
+    x: jax.Array,  # [B, S, D]
+    p: Params,  # one layer's params (leading L axis already sliced off)
+    lora: Params | None,
+    cache_k: jax.Array | None,  # [B, Smax, K, hd]
+    cache_v: jax.Array | None,
+    *,
+    cfg: ModelConfig,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array | None,
+    cache_offset: jax.Array | int,
+    lora_scale: float,
+    attn_impl: str,
+):
+    b, s, _ = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = _proj(h, p, lora, "wq", "bq", lora_scale).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = _proj(h, p, lora, "wk", "bk", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(h, p, lora, "wv", "bv", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if cache_k is not None:
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, cache_offset, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, cache_offset, 0, 0))
+        k_att, v_att = cache_k, cache_v
+    else:
+        k_att, v_att = k, v
+
+    att = attention(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask, impl=attn_impl)
+    att = att.reshape(b, s, cfg.q_dim)
+    x = x + _proj(att, p, lora, "wo", "bo", lora_scale)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(_proj(h, p, lora, "w_gate", "b_gate", lora_scale))
+    up = _proj(h, p, lora, "w_up", "b_up", lora_scale)
+    x = x + _proj(gate * up, p, lora, "w_down", "b_down", lora_scale)
+    return x, cache_k, cache_v
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids: jax.Array,  # [B, S]
+    *,
+    attention_mask: jax.Array | None = None,  # [B, Sk]; 1 = attendable key
+    positions: jax.Array | None = None,  # [B, S] absolute positions
+    lora: Params | None = None,
+    lora_scale: float = 1.0,
+    kv_cache: Params | None = None,  # {"k","v": [L, B, Smax, K, hd]}
+    cache_offset: jax.Array | int = 0,
+    remat: bool = False,
+    attn_impl: str = "reference",
+) -> tuple[jax.Array, Params | None]:
+    """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
+
+    Without a cache this is the training/prefill path (causal over the input);
+    with a cache, queries attend to all cache keys marked valid by
+    ``attention_mask`` (length Smax) and new K/V are written at
+    ``cache_offset``. Contract: ``cache_offset + S <= Smax`` — the engine sizes
+    caches as prompt+max_tokens so this holds by construction; writes past
+    capacity would be silently clamped by dynamic_update_slice.
+    """
+    b, s = input_ids.shape
+    if kv_cache is not None and isinstance(cache_offset, int):
+        smax = kv_cache["k"].shape[2]
+        if cache_offset + s > smax:
+            raise ValueError(
+                f"KV cache overflow: offset {cache_offset} + seq {s} > capacity {smax}"
+            )
+    if positions is None:
+        positions = cache_offset + jnp.arange(s, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, s))
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], input_ids, axis=0)
+
+    sk = kv_cache["k"].shape[2] if kv_cache is not None else s
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, sk), dtype=jnp.int32)
+    mask = causal_padding_mask(attention_mask, q_len=s, q_offset=cache_offset)
+
+    layer_fn = partial(
+        _layer,
+        cfg=cfg,
+        cos=cos,
+        sin=sin,
+        mask=mask,
+        cache_offset=cache_offset,
+        lora_scale=lora_scale,
+        attn_impl=attn_impl,
+    )
+
+    def scan_body(carry, xs):
+        p, lora_p, ck, cv = xs
+        y, ck, cv = layer_fn(carry, p, lora_p, ck, cv)
+        return y, (ck, cv)
+
+    if remat:
+        scan_body = jax.checkpoint(
+            scan_body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    xs = (
+        params["layers"],
+        lora["layers"] if lora is not None else None,
+        kv_cache["k"] if kv_cache is not None else None,
+        kv_cache["v"] if kv_cache is not None else None,
+    )
+    x, (new_k, new_v) = jax.lax.scan(scan_body, x, xs)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    lm_head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = linear(x, lm_head).astype(jnp.float32)
+
+    new_cache = {"k": new_k, "v": new_v} if kv_cache is not None else None
+    return logits, new_cache
+
+
+def init_params(
+    rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32
+) -> Params:
+    """Random init with HF-comparable scales (normal 0.02 for projections)."""
+    keys = iter(jax.random.split(rng, 16))
+    init = lambda k, shape: (0.02 * jax.random.normal(k, shape)).astype(dtype)
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "mlp_norm": jnp.ones((L, D), dtype),
+        "wq": init(next(keys), (L, D, cfg.q_dim)),
+        "wk": init(next(keys), (L, D, cfg.kv_dim)),
+        "wv": init(next(keys), (L, D, cfg.kv_dim)),
+        "wo": init(next(keys), (L, cfg.q_dim, D)),
+        "w_gate": init(next(keys), (L, D, F)),
+        "w_up": init(next(keys), (L, D, F)),
+        "w_down": init(next(keys), (L, F, D)),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    params: Params = {
+        "embed": init(next(keys), (cfg.vocab_size, D)),
+        "final_norm": jnp.ones((D,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = init(next(keys), (D, cfg.vocab_size))
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16
+) -> Params:
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -1,0 +1,7 @@
+from distrl_llm_tpu.ops.attention import (  # noqa: F401
+    attention,
+    attention_reference,
+    causal_padding_mask,
+    repeat_kv,
+)
+from distrl_llm_tpu.ops.linear import linear, lora_delta  # noqa: F401

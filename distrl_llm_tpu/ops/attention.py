@@ -1,0 +1,89 @@
+"""Attention ops: masked GQA attention with a plain-XLA reference path.
+
+This is the N1/N3-equivalent compute core (SURVEY §2b): the reference gets its
+attention from vLLM's CUDA kernels (decode) and Triton (train); here the
+baseline is a jnp implementation XLA fuses well on the MXU, with Pallas flash
+attention layered on top (ops/flash_attention.py) for long sequences, selected
+by ``attention(..., impl=...)``.
+
+Shapes follow the TPU-friendly layout [batch, seq, heads, head_dim] — last two
+dims map onto (sublane, lane) tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative for masked logits; avoids NaNs from true -inf
+
+
+def repeat_kv(k: jax.Array, num_groups: int) -> jax.Array:
+    """[B, S, K, D] → [B, S, K*num_groups, D] by repeating each kv head for its
+    query group (GQA)."""
+    if num_groups == 1:
+        return k
+    b, s, kh, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, kh, num_groups, d)).reshape(
+        b, s, kh * num_groups, d
+    )
+
+
+def attention_reference(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, K, D]
+    v: jax.Array,  # [B, Sk, K, D]
+    mask: jax.Array | None,  # broadcastable to [B, H, Sq, Sk]; True = attend
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain-XLA masked attention. Softmax in f32 regardless of input dtype."""
+    num_groups = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, num_groups)
+    v = repeat_kv(v, num_groups)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_padding_mask(
+    attention_mask: jax.Array,  # [B, Sk] 1 = real token
+    q_len: int,
+    q_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """[B, 1, Sq, Sk] boolean mask combining causality with key padding.
+
+    ``q_offset`` is the absolute position of the first query row — 0 for a
+    training/prefill forward, the current decode length for single-token decode
+    steps against a KV cache.
+    """
+    sk = attention_mask.shape[-1]
+    q_pos = q_offset + jnp.arange(q_len)[:, None]  # [Sq, 1]
+    k_pos = jnp.arange(sk)[None, :]  # [1, Sk]
+    causal = k_pos <= q_pos  # [Sq, Sk]
+    pad = attention_mask[:, None, None, :].astype(bool)  # [B, 1, 1, Sk]
+    return causal[None, None, :, :] & pad
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None,
+    scale: float | None = None,
+    impl: str = "reference",
+) -> jax.Array:
+    """Dispatching front door. ``impl``: "reference" (XLA) or "flash" (Pallas,
+    TPU only; falls back to reference off-TPU)."""
+    if impl == "flash":
+        try:
+            from distrl_llm_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, mask, scale=scale)
+        except (ImportError, NotImplementedError):
+            pass
+    return attention_reference(q, k, v, mask, scale=scale)

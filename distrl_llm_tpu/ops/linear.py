@@ -1,0 +1,31 @@
+"""Linear projection with pluggable weight containers.
+
+All model matmuls route through ``linear`` so the frozen base can swap its
+weights for quantized containers (int8/int4 weight-only — the N4 equivalent of
+the reference's bitsandbytes NF4 base, distributed_actor.py:17) without
+touching model code. Quantized containers live in ops/quant.py and are
+registered pytrees, so they flow through jit/pjit/scan like arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
+    """y = x @ w (+ b). ``w`` is either a plain [in, out] array or a quantized
+    container exposing ``.matmul(x)``."""
+    if hasattr(w, "matmul"):
+        y = w.matmul(x)
+    else:
+        y = jnp.einsum("...i,io->...o", x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def lora_delta(x: jax.Array, a: jax.Array, b: jax.Array, scale) -> jax.Array:
+    """LoRA contribution (x @ A) @ B · scale, computed in the activation dtype.
+    A: [in, r], B: [r, out], scale = alpha / r (rsLoRA off — helper.py:44)."""
+    return (x @ a @ b) * jnp.asarray(scale, dtype=x.dtype)

@@ -1,0 +1,7 @@
+from distrl_llm_tpu.parallel.mesh import AXES, RoleMeshes, build_role_meshes  # noqa: F401
+from distrl_llm_tpu.parallel.partition import (  # noqa: F401
+    batch_spec,
+    param_specs,
+    replicated,
+    shard_tree,
+)

@@ -1,0 +1,97 @@
+"""Device-mesh topology: global mesh, parallelism axes, and role submeshes.
+
+The reference maps roles (rollout actors / learners) to whole GPUs via Ray
+placement groups (distributed_actor.py:517–585). Here roles are partitions of
+the device set: the rollout submesh and learner submesh each get their own
+``jax.sharding.Mesh`` with axes
+
+    ("dp", "fsdp", "sp", "tp")
+
+- dp:   data parallel — batch sharding, gradient psum (the N6 equivalent)
+- fsdp: parameter sharding of learner state (ZeRO-style)
+- sp:   sequence parallel — ring attention over long context
+- tp:   tensor parallel — heads/MLP sharding within a model replica
+
+With fewer devices than roles (e.g. the 1-chip dev box) the roles time-share
+one mesh, matching the reference's hybrid learner-generation in spirit
+(README.md:19).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distrl_llm_tpu.config import MeshConfig
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+def _make_mesh(devices: list, tp: int, sp: int, fsdp: int) -> Mesh:
+    n = len(devices)
+    denom = tp * sp * fsdp
+    if n % denom != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp*fsdp={denom}")
+    dp = n // denom
+    arr = np.asarray(devices).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, AXES)
+
+
+@dataclass
+class RoleMeshes:
+    """The carved-up device set. ``rollout`` serves generation; ``learner``
+    serves the train step. ``timeshared`` means both are the same mesh."""
+
+    rollout: Mesh
+    learner: Mesh
+    timeshared: bool
+
+    @property
+    def rollout_dp(self) -> int:
+        return self.rollout.shape["dp"]
+
+    @property
+    def learner_dp(self) -> int:
+        return self.learner.shape["dp"]
+
+
+def build_role_meshes(cfg: MeshConfig, devices: list | None = None) -> RoleMeshes:
+    """Carve devices into rollout/learner submeshes per the configured role
+    counts. Each role is one dp-group of ``tp·sp·fsdp`` chips: actors first,
+    learners after, mirroring the reference's first-N/next-M GPU assignment
+    (distributed_actor.py:535–537)."""
+    if devices is None:
+        devices = jax.devices()
+    per_role = cfg.tp * cfg.sp * cfg.fsdp
+    needed = cfg.num_roles * per_role
+    if len(devices) < needed:
+        if not cfg.allow_timeshare:
+            raise RuntimeError(
+                f"Not enough devices. Available: {len(devices)}, Required: {needed}"
+            )
+        usable = max(per_role, len(devices) - len(devices) % per_role)
+        if len(devices) < per_role:
+            raise RuntimeError(
+                f"Need at least tp*sp*fsdp={per_role} devices, have {len(devices)}"
+            )
+        mesh = _make_mesh(devices[:usable], cfg.tp, cfg.sp, cfg.fsdp)
+        return RoleMeshes(rollout=mesh, learner=mesh, timeshared=True)
+
+    if cfg.number_of_actors == 0:
+        # learners generate too (reference allows actors=0,
+        # train_distributed.py:27) — rollout aliases the learner mesh
+        learner = _make_mesh(
+            devices[: cfg.number_of_learners * per_role], cfg.tp, cfg.sp, cfg.fsdp
+        )
+        return RoleMeshes(rollout=learner, learner=learner, timeshared=True)
+
+    n_rollout = cfg.number_of_actors * per_role
+    rollout = _make_mesh(devices[:n_rollout], cfg.tp, cfg.sp, cfg.fsdp)
+    learner = _make_mesh(
+        devices[n_rollout : n_rollout + cfg.number_of_learners * per_role],
+        cfg.tp, cfg.sp, cfg.fsdp,
+    )
+    return RoleMeshes(rollout=rollout, learner=learner, timeshared=False)

@@ -1,0 +1,90 @@
+"""PartitionSpec rules for the decoder param/LoRA/cache pytrees.
+
+GSPMD does the heavy lifting: we annotate parameters and batch inputs, XLA
+inserts the collectives (SURVEY §2c — TP sharding replaces the reference's
+unused vLLM TP; fsdp shards learner state; dp shards the batch). Specs are
+assigned by param-tree path so they survive structural additions like
+quantized weight containers.
+
+Layout conventions (models/transformer.py):
+  layers/w*:   [L, in, out]  → out over "tp" for up-projections (qkv, gate,
+               up), in over "tp" for down-projections (o, down) — Megatron
+               style, so the pair needs no resharding between them.
+  embed:       [V, D] vocab over "tp" (logits psum'd by GSPMD), D over "fsdp".
+  lm_head:     [D, V] V over "tp".
+  lora a/b:    factor dims follow the base weight's sharded dim; the rank dim
+               is always replicated.
+  kv cache:    [L, B, S, K, hd] batch over "dp", kv heads over "tp".
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+# layer weights whose OUT dim is tp-sharded (column parallel)
+_COL = {"wq", "wk", "wv", "w_gate", "w_up"}
+# layer weights whose IN dim is tp-sharded (row parallel)
+_ROW = {"wo", "w_down"}
+
+
+def _spec_for_path(path: tuple[str, ...], ndim: int) -> P:
+    name = path[-1]
+    if name in ("a", "b"):  # LoRA factor: path is (..., "layers", target, "a"|"b")
+        target = path[-2]
+        if name == "a":  # [L, in, r]
+            return P(None, "tp" if target in _ROW else "fsdp", None)
+        return P(None, None, "tp" if target in _COL else "fsdp")  # [L, r, out]
+    if name == "embed":
+        return P("tp", "fsdp")
+    if name == "lm_head":
+        return P("fsdp", "tp")
+    if name in ("final_norm", "attn_norm", "mlp_norm"):
+        return P(*([None] * ndim))
+    if name in _COL:
+        return P(None, "fsdp", "tp")
+    if name in _ROW:
+        return P(None, "tp", "fsdp")
+    if name.startswith("b"):  # projection biases [L, out]
+        return P(None, "tp") if name in ("bq", "bk", "bv") else P(None, "fsdp")
+    if name in ("k", "v"):  # kv cache [L, B, S, K, hd]
+        return P(None, "dp", None, "tp", None)
+    return P(*([None] * ndim))
+
+
+def _tree_specs(tree: Params) -> Params:
+    def walk(path: tuple[str, ...], node):
+        if isinstance(node, dict):
+            return {k: walk(path + (k,), v) for k, v in node.items()}
+        if node is None:
+            return None
+        return _spec_for_path(path, getattr(node, "ndim", 0))
+
+    return walk((), tree)
+
+
+def param_specs(params: Params) -> Params:
+    """PartitionSpec tree matching ``params``' structure (base, LoRA, or cache)."""
+    return _tree_specs(params)
+
+
+def shard_tree(tree: Params, mesh: Mesh, specs: Params | None = None) -> Params:
+    """device_put the tree onto ``mesh`` with its specs (host→device scatter)."""
+    if specs is None:
+        specs = param_specs(tree)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def batch_spec() -> P:
+    """Activations/batch inputs: leading dim over dp."""
+    return P("dp")
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

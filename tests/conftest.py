@@ -1,16 +1,23 @@
-"""Test configuration: force an 8-device CPU mesh before JAX initializes.
+"""Test configuration: force an 8-device CPU mesh before JAX backends initialize.
 
 Sharding/collective tests (DP/TP/FSDP/ring attention, psum gradient sync) run
-on virtual CPU devices so CI needs no TPU (SURVEY §4). These env vars must be
-set before the first `import jax` anywhere in the test process.
+on virtual CPU devices so CI needs no TPU (SURVEY §4).
+
+Note: this environment's sitecustomize imports jax and registers the "axon"
+TPU plugin at interpreter startup, so env vars set here are too late — jax has
+already read JAX_PLATFORMS. `jax.config.update` still works because backends
+are not initialized until first use, which is after conftest import.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

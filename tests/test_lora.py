@@ -1,0 +1,59 @@
+"""LoRA adapter tests: zero-init identity, delta application, merge parity
+(reference PEFT wrap: helper.py:25–46)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.models import TINY, forward, init_lora_params, init_params, merge_lora
+from distrl_llm_tpu.models.lora import DEFAULT_TARGETS, lora_scale
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, TINY.vocab_size, size=(2, 9)))
+    return params, lora, ids
+
+
+class TestLora:
+    def test_targets_match_reference(self):
+        # q/k/v/o/gate/up/down — helper.py:29–37
+        assert set(DEFAULT_TARGETS) == {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+    def test_zero_init_is_identity(self, setup):
+        params, lora, ids = setup
+        base, _ = forward(params, TINY, ids)
+        with_lora, _ = forward(params, TINY, ids, lora=lora, lora_scale=0.5)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora), atol=1e-6)
+
+    def test_nonzero_b_changes_output(self, setup):
+        params, lora, ids = setup
+        lora = jax.tree_util.tree_map(lambda x: x, lora)
+        lora["layers"]["wq"]["b"] = (
+            jax.random.normal(jax.random.PRNGKey(2), lora["layers"]["wq"]["b"].shape) * 0.1
+        )
+        base, _ = forward(params, TINY, ids)
+        with_lora, _ = forward(params, TINY, ids, lora=lora, lora_scale=0.5)
+        assert np.abs(np.asarray(base) - np.asarray(with_lora)).max() > 1e-4
+
+    def test_merge_matches_runtime_application(self, setup):
+        params, lora, ids = setup
+        rank, alpha = 4, 16
+        lora = jax.tree_util.tree_map(
+            lambda x: jax.random.normal(jax.random.PRNGKey(3), x.shape) * 0.02, lora
+        )
+        scale = lora_scale(rank, alpha)
+        runtime, _ = forward(params, TINY, ids, lora=lora, lora_scale=scale)
+        merged = merge_lora(params, lora, alpha)
+        folded, _ = forward(merged, TINY, ids)
+        np.testing.assert_allclose(
+            np.asarray(runtime), np.asarray(folded), atol=2e-4, rtol=2e-4
+        )
+
+    def test_scale_semantics(self):
+        # reference: alpha=16, rank=32 → scale 0.5 (rsLoRA off, helper.py:44)
+        assert lora_scale(32, 16) == 0.5
