@@ -1,0 +1,12 @@
+from distrl_llm_tpu.learner.losses import (  # noqa: F401
+    answer_logprobs,
+    entropy_bonus,
+    grpo_loss,
+    pg_loss,
+)
+from distrl_llm_tpu.learner.optim import adam8bit, make_optimizer  # noqa: F401
+from distrl_llm_tpu.learner.train_step import (  # noqa: F401
+    UpdateBatch,
+    make_train_step,
+    prepare_update_batch,
+)

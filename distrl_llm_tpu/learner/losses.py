@@ -1,0 +1,110 @@
+"""Policy-gradient and GRPO losses over recomputed answer logprobs.
+
+Parity with the reference learner math (distributed_actor.py:215–260, :349–395,
+:440–493):
+
+* **Fixed-shape logprob recompute** — prompt left-padded to max_prompt_tokens,
+  answer right-padded to max_new_tokens, one forward over the concat, shift by
+  one, slice the answer region (:217–249). The reference chose fixed shapes to
+  bound GPU memory; here they also mean exactly one XLA compilation.
+* **PG loss** ``−(((logp·mask).Σ/mask.Σ)·coeff).mean()`` (:375) where coeff is
+  reward − baseline (applied upstream, :406).
+* **GRPO loss** uses the ratio trick ``exp(logp − stop_grad(logp))`` (≡1 at
+  compute time, gradient = ∇logp · adv) with group-normalized advantages
+  (:467–470). No KL, no clipping — the reference takes exactly one update per
+  rollout batch, so the clipped objective never binds (SURVEY §3.6.2).
+
+Instead of materializing the [B, T, V] log_softmax and gathering row-by-row in
+a Python loop (the reference's memory cap, :252–260), per-token logprobs are
+``gathered_logit − logsumexp`` — O(B·T) extra memory and XLA fuses the
+logsumexp into the projection epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.models.configs import ModelConfig
+from distrl_llm_tpu.models.transformer import forward
+
+
+def answer_logprobs(
+    params,
+    cfg: ModelConfig,
+    prompt_ids: jax.Array,  # [B, P] left-padded
+    prompt_mask: jax.Array,  # [B, P]
+    answer_ids: jax.Array,  # [B, T] right-padded
+    answer_mask: jax.Array,  # [B, T]
+    *,
+    lora=None,
+    lora_scale: float = 1.0,
+    remat: bool = True,
+    attn_impl: str = "reference",
+) -> jax.Array:
+    """Per-token logprobs of the answer under the current policy, [B, T] f32.
+
+    Equivalent to the reference's compute_current_policy_probs
+    (distributed_actor.py:215–260): token t's logprob comes from the logit at
+    position P−1+t of the concatenated sequence.
+    """
+    full_ids = jnp.concatenate([prompt_ids, answer_ids], axis=1)
+    full_mask = jnp.concatenate([prompt_mask, answer_mask], axis=1)
+    p = prompt_ids.shape[1]
+    t = answer_ids.shape[1]
+    # project only positions P-1 .. P-1+T-1 (the logits predicting answer
+    # tokens) — prompt logits would be discarded, so don't compute them
+    pred, _ = forward(
+        params, cfg, full_ids,
+        attention_mask=full_mask, lora=lora, lora_scale=lora_scale,
+        remat=remat, attn_impl=attn_impl, logits_slice=(p - 1, t),
+    )  # [B, T, V]
+    gathered = jnp.take_along_axis(pred, answer_ids[..., None], axis=-1)[..., 0]
+    return gathered - jax.nn.logsumexp(pred, axis=-1)
+
+
+def _masked_mean_seq(logp_like: jax.Array, mask: jax.Array) -> jax.Array:
+    """(x·mask).Σ/mask.Σ per row, guarding empty answers (all-pad rows would be
+    0/0 = NaN in the reference)."""
+    denom = jnp.maximum(mask.sum(-1), 1.0)
+    return (logp_like * mask).sum(-1) / denom
+
+
+def pg_loss(
+    logprobs: jax.Array,  # [B, T]
+    answer_mask: jax.Array,  # [B, T]
+    coeffs: jax.Array,  # [B] reward − baseline
+    sample_mask: jax.Array | None = None,  # [B] 1 = real row (padding rows 0)
+) -> jax.Array:
+    """Vanilla PG: mean over rows of −(mean answer logprob)·coeff
+    (distributed_actor.py:375)."""
+    per_row = _masked_mean_seq(logprobs, answer_mask) * coeffs
+    if sample_mask is None:
+        return -per_row.mean()
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    return -(per_row * sample_mask).sum() / denom
+
+
+def grpo_loss(
+    logprobs: jax.Array,
+    answer_mask: jax.Array,
+    advantages: jax.Array,
+    sample_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Single-update GRPO: ratio ≡ 1 at compute time, gradient flows through
+    exp(logp − stop_grad(logp)) (distributed_actor.py:467–470)."""
+    ratio = jnp.exp(logprobs - jax.lax.stop_gradient(logprobs))
+    per_row = _masked_mean_seq(ratio, answer_mask) * advantages
+    if sample_mask is None:
+        return -per_row.mean()
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    return -(per_row * sample_mask).sum() / denom
+
+
+def entropy_bonus(logprobs_full: jax.Array, alpha: float) -> jax.Array:
+    """Entropy regularizer over the vocab distribution — defined for API parity
+    with the reference's compute_entropy_bonus (distributed_actor.py:266–281),
+    which is never enabled there either (call sites commented out)."""
+    probs = jnp.exp(logprobs_full)
+    entropy = -(probs * logprobs_full).sum(-1)
+    return alpha * entropy.mean()

@@ -1,0 +1,184 @@
+"""The pjit'd learner update: grad-accumulated PG/GRPO step over the learner mesh.
+
+Replaces the reference's entire update machinery — the per-learner microbatch
+loop with loss/num_batches scaling (distributed_actor.py:352–389), the
+CPU-pickled gradient dicts, the driver-side mean, and the one-learner optimizer
+step (:283–333, distributed_trainer.py:308–342) — with ONE jitted function:
+
+* microbatches run as a ``lax.scan`` over fixed-shape slices, accumulating
+  gradients on device;
+* data parallelism is the mesh's ``dp`` axis — the batch is sharded over it and
+  GSPMD inserts the gradient ``psum`` (ICI), which also fixes the reference's
+  stale-learner bug by construction (SURVEY §3.4): every learner shard applies
+  the same merged update in the same step;
+* the zero-reward microbatch skip implements the reference's *intent* (skip
+  only when every reward in the microbatch is zero — the reference's
+  ``batch_rewards.all() == 0`` actually skips when ANY reward is zero,
+  SURVEY §3.6.3; set ``skip_semantics="any_zero"`` for bug-parity).
+
+Batch layout (host-prepared by ``prepare_update_batch``): all arrays lead with
+N = num_micro · micro_size; rows beyond the real sample count are padding with
+``sample_mask`` 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distrl_llm_tpu.learner.losses import answer_logprobs, grpo_loss, pg_loss
+from distrl_llm_tpu.models.configs import ModelConfig
+
+
+class UpdateBatch(NamedTuple):
+    """Fixed-shape flattened candidates for one policy update."""
+
+    prompt_ids: jax.Array  # [N, P] int32, left-padded
+    prompt_mask: jax.Array  # [N, P]
+    answer_ids: jax.Array  # [N, T] int32, right-padded
+    answer_mask: jax.Array  # [N, T]
+    coeffs: jax.Array  # [N] f32 — reward−baseline (PG) or advantage (GRPO)
+    sample_mask: jax.Array  # [N] f32 — 0 for padding rows
+
+
+def _microbatch_loss(
+    lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
+    learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
+    attn_impl: str,
+):
+    """Loss for one microbatch with the zero-reward skip folded in as a weight."""
+    logps = answer_logprobs(
+        base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
+        mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
+        attn_impl=attn_impl,
+    )
+    loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
+    loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
+
+    # The skip operates on COEFFS (baseline-subtracted rewards / advantages),
+    # exactly like the reference: Learner.train flattens `r - b` and GRPO
+    # flattens advantages BEFORE compute_loss tests `batch_rewards.all() == 0`
+    # (distributed_actor.py:406, :495–504, :367). A GRPO group with identical
+    # rewards therefore zeroes out and is skipped in both frameworks.
+    real = mb.sample_mask > 0
+    if skip_semantics == "any_zero":  # reference bug-parity (.all()==0)
+        skip = jnp.any(real & (mb.coeffs == 0.0))
+    else:  # "all_zero" — the documented intent
+        skip = ~jnp.any(real & (mb.coeffs != 0.0))
+    has_real = jnp.any(real)
+    weight = jnp.where(skip | ~has_real, 0.0, 1.0)
+    return loss * weight, (weight, has_real.astype(jnp.float32))
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    *,
+    learner_type: str = "pg",
+    optimizer: optax.GradientTransformation,
+    lora_scale: float,
+    micro_size: int,
+    skip_semantics: str = "all_zero",
+    remat: bool = True,
+    attn_impl: str = "reference",
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted train step.
+
+    Returns ``step(lora, opt_state, base_params, batch) -> (lora, opt_state,
+    loss_sum)`` where ``loss_sum`` matches the reference's returned metric: the
+    sum of unscaled microbatch losses (its ``total_loss`` accumulation at
+    distributed_actor.py:387–389 cancels the /num_batches scaling).
+    """
+
+    loss_fn = partial(
+        _microbatch_loss,
+        cfg=cfg,
+        learner_type=learner_type,
+        lora_scale=lora_scale,
+        skip_semantics=skip_semantics,
+        remat=remat,
+        attn_impl=attn_impl,
+    )
+
+    def step(lora, opt_state, base_params, batch: UpdateBatch):
+        n = batch.prompt_ids.shape[0]
+        assert n % micro_size == 0, f"batch {n} not divisible by micro {micro_size}"
+        num_micro = n // micro_size
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape((num_micro, micro_size) + x.shape[1:]), batch
+        )
+
+        grad_fn = jax.value_and_grad(
+            lambda lo, mb: loss_fn(lo, base_params, mb=mb), has_aux=True
+        )
+
+        def accumulate(carry, mb):
+            grads_acc, loss_acc, nb_acc = carry
+            (loss, (weight, has_real)), grads = grad_fn(lora, mb)
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            return (grads_acc, loss_acc + loss, nb_acc + has_real), None
+
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, lora)
+        (grads, loss_sum, num_real_micro), _ = jax.lax.scan(
+            accumulate, (zero_grads, jnp.zeros([]), jnp.zeros([])), micro
+        )
+        # reference scaling: each microbatch contributes grad/num_batches
+        # (distributed_actor.py:382); num_batches counts microbatches with real
+        # rows, skipped-or-not — padding-only microbatches are excluded.
+        denom = jnp.maximum(num_real_micro, 1.0)
+        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss_sum
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def prepare_update_batch(
+    tokenizer,
+    problems: list[str],
+    answers: list[str],
+    coeffs: np.ndarray,
+    *,
+    max_prompt_tokens: int,
+    max_new_tokens: int,
+    micro_size: int,
+) -> UpdateBatch:
+    """Host-side tokenize+pad to the fixed learner shapes.
+
+    Mirrors the reference's encode calls (distributed_actor.py:217–229):
+    prompts left-padded/truncated to max_prompt_tokens, answers right-padded/
+    truncated to max_new_tokens. N is padded up to a multiple of micro_size
+    with sample_mask-0 rows so the scan shape is static.
+    """
+    from distrl_llm_tpu.tokenizer import encode_fixed
+
+    n_real = len(problems)
+    prompt_ids, prompt_mask = encode_fixed(
+        tokenizer, problems, max_prompt_tokens, side="left"
+    )
+    answer_ids, answer_mask = encode_fixed(
+        tokenizer, answers, max_new_tokens, side="right"
+    )
+    n = -(-max(n_real, 1) // micro_size) * micro_size
+    pad = n - n_real
+
+    def pad_rows(x):
+        return np.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+
+    sample_mask = np.zeros(n, np.float32)
+    sample_mask[:n_real] = 1.0
+    return UpdateBatch(
+        prompt_ids=jnp.asarray(pad_rows(prompt_ids)),
+        prompt_mask=jnp.asarray(pad_rows(prompt_mask)),
+        answer_ids=jnp.asarray(pad_rows(answer_ids)),
+        answer_mask=jnp.asarray(pad_rows(answer_mask)),
+        coeffs=jnp.asarray(pad_rows(np.asarray(coeffs, np.float32))),
+        sample_mask=jnp.asarray(sample_mask),
+    )

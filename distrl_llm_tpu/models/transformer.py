@@ -118,6 +118,7 @@ def forward(
     cache_offset: jax.Array | int = 0,
     remat: bool = False,
     attn_impl: str = "reference",
+    logits_slice: tuple[int, int] | None = None,  # (start, length) along seq
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
@@ -177,6 +178,11 @@ def forward(
     x, (new_k, new_v) = jax.lax.scan(scan_body, x, xs)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if logits_slice is not None:
+        # project only the needed positions — the learner's logprob recompute
+        # discards all prompt logits, so slicing the hidden states first skips
+        # ~P/(P+T) of the lm_head FLOPs and the [B, P, V] buffer
+        x = jax.lax.dynamic_slice_in_dim(x, logits_slice[0], logits_slice[1], axis=1)
     lm_head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = linear(x, lm_head).astype(jnp.float32)
 

@@ -69,6 +69,9 @@ def causal_padding_mask(
     return causal[None, None, :, :] & pad
 
 
+_flash_fallback_warned = False
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -78,12 +81,20 @@ def attention(
     impl: str = "reference",
 ) -> jax.Array:
     """Dispatching front door. ``impl``: "reference" (XLA) or "flash" (Pallas,
-    TPU only; falls back to reference off-TPU)."""
+    TPU only; warns once and falls back to reference where unsupported)."""
     if impl == "flash":
         try:
             from distrl_llm_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, mask, scale=scale)
-        except (ImportError, NotImplementedError):
-            pass
+        except (ImportError, NotImplementedError) as e:
+            global _flash_fallback_warned
+            if not _flash_fallback_warned:
+                _flash_fallback_warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "flash attention unavailable (%s); falling back to the XLA "
+                    "reference path — O(Sq*Sk) memory", e,
+                )
     return attention_reference(q, k, v, mask, scale=scale)

@@ -1,0 +1,75 @@
+"""Tokenizer adapter: fixed-shape encoding and batch decode.
+
+Wraps any HF-style tokenizer (the N7 Rust component in the reference —
+SURVEY §2b) behind the two operations the framework needs: fixed-length
+encode with explicit pad side (the learner contract, distributed_actor.py:
+217–229) and id→text decode for rollouts. A C++ BPE tokenizer with the same
+surface plugs in via distrl_llm_tpu.native (built when parity with the
+reference's native tokenizer path matters more than the HF dependency).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def encode_fixed(
+    tokenizer,
+    texts: Sequence[str],
+    max_length: int,
+    side: str = "left",
+    add_special_tokens: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Encode to exactly [N, max_length] (ids, mask), truncating and padding on
+    ``side``. Works with HF fast/slow tokenizers and test doubles exposing
+    ``encode(text) -> list[int]``."""
+    pad_id = getattr(tokenizer, "pad_token_id", None)
+    if pad_id is None:
+        pad_id = getattr(tokenizer, "eos_token_id", 0) or 0
+
+    takes_special = _accepts_kwarg(tokenizer.encode, "add_special_tokens")
+    ids = np.full((len(texts), max_length), pad_id, dtype=np.int32)
+    mask = np.zeros((len(texts), max_length), dtype=np.int32)
+    for i, text in enumerate(texts):
+        toks = tokenizer.encode(text, add_special_tokens=add_special_tokens) \
+            if takes_special else tokenizer.encode(text)
+        # HF default truncation_side="right": keep the leading tokens, as the
+        # reference's truncation=True encode does regardless of pad side
+        toks = toks[:max_length]
+        if side == "left":
+            ids[i, max_length - len(toks):] = toks
+            mask[i, max_length - len(toks):] = 1
+        else:
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = 1
+    return ids, mask
+
+
+def _accepts_kwarg(method, name: str) -> bool:
+    import inspect
+
+    try:
+        return name in inspect.signature(method).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def decode_batch(tokenizer, ids: np.ndarray, lengths: np.ndarray) -> list[str]:
+    """Decode each row's first ``lengths[i]`` tokens (rollout answers)."""
+    takes_skip = _accepts_kwarg(tokenizer.decode, "skip_special_tokens")
+    return [
+        tokenizer.decode(row[:n].tolist(), skip_special_tokens=True)
+        if takes_skip
+        else tokenizer.decode(row[:n].tolist())
+        for row, n in zip(ids, lengths)
+    ]
+
+
+def load_tokenizer(model_name_or_path: str):
+    """HF AutoTokenizer load (the reference's load_correct_tokenizer,
+    train_distributed.py:46)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_name_or_path)
