@@ -1,0 +1,1 @@
+from distrl_llm_tpu.engine.engine import GenerationEngine, GenerationResult  # noqa: F401

@@ -1,0 +1,192 @@
+"""The jit-compiled generation engine: shared-prefill + on-device decode loop.
+
+TPU-native replacement for the reference's in-process vLLM engine
+(``policy.fast_generate`` with n-candidate SamplingParams,
+distributed_actor.py:147–172 — SURVEY §2b N1/N2). Design:
+
+* **Prefill once per prompt, decode n candidates.** Prompts are left-padded to
+  a fixed length and prefilled at batch B; the KV cache is then repeated to
+  B·n rows so the n sampled candidates per prompt (``num_candidates``, 16 by
+  default) share one prompt forward — a 16× prefill saving the reference
+  delegates to vLLM's prefix caching.
+* **Whole decode loop on device.** One ``lax.while_loop`` carries (cache,
+  mask, output buffer, done flags); there are zero host round-trips between
+  tokens, and the loop exits early once every row has hit EOS — the fixed-shape
+  equivalent of continuous batching's tail behavior. Temperature/top-p are
+  traced scalars, so train and eval sampling share the compiled loop.
+* **LoRA rides the forward** as a pytree argument — "hot-swapping the adapter"
+  is passing the latest arrays (SURVEY §2b N2: device-to-device weight sync
+  replaces the reference's adapter-file bus, distributed_actor.py:150).
+
+The engine is mesh-agnostic: pass sharded params/batches and GSPMD runs it
+TP/DP-sharded; pass host arrays and it runs single-chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.models.configs import ModelConfig
+from distrl_llm_tpu.models.transformer import forward, init_kv_cache
+from distrl_llm_tpu.ops.sampling import sample
+
+Params = dict[str, Any]
+
+
+class GenerationResult(NamedTuple):
+    tokens: np.ndarray  # [B, n, T] int32, pad-filled after EOS
+    lengths: np.ndarray  # [B, n] generated token counts (incl. EOS)
+
+
+class _DecodeState(NamedTuple):
+    step: jax.Array
+    out: jax.Array  # [Bn, T]
+    lengths: jax.Array  # [Bn]
+    done: jax.Array  # [Bn] bool
+    key_mask: jax.Array  # [Bn, Smax]
+    logits: jax.Array  # [Bn, V] logits for the next token
+    cache: Params
+
+
+def _prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
+             max_total: int, lora_scale: float, cache_dtype, attn_impl: str):
+    b, p = prompt_ids.shape
+    cache = init_kv_cache(cfg, b, max_total, dtype=cache_dtype)
+    key_mask = jnp.pad(prompt_mask, ((0, 0), (0, max_total - p)))
+    last_logits, cache = forward(
+        params, cfg, prompt_ids,
+        attention_mask=key_mask, lora=lora, lora_scale=lora_scale,
+        kv_cache=cache, cache_offset=0, attn_impl=attn_impl,
+        logits_slice=(p - 1, 1),
+    )
+    return cache, key_mask, last_logits[:, 0]
+
+
+def _decode(params, lora, cache, key_mask, first_logits, rng,
+            *, cfg: ModelConfig, n: int, prompt_len: int, max_steps: int,
+            eos_ids, pad_id: int, temperature, top_p, lora_scale: float,
+            attn_impl: str):
+    # expand to candidate rows: row b*n + j is candidate j of prompt b
+    cache = {k: jnp.repeat(v, n, axis=1) for k, v in cache.items()}
+    key_mask = jnp.repeat(key_mask, n, axis=0)
+    logits = jnp.repeat(first_logits, n, axis=0)
+    bn = logits.shape[0]
+
+    state = _DecodeState(
+        step=jnp.zeros((), jnp.int32),
+        out=jnp.full((bn, max_steps), pad_id, jnp.int32),
+        lengths=jnp.zeros((bn,), jnp.int32),
+        done=jnp.zeros((bn,), bool),
+        key_mask=key_mask,
+        logits=logits,
+        cache=cache,
+    )
+
+    def cond(s: _DecodeState):
+        return (s.step < max_steps) & ~jnp.all(s.done)
+
+    def body(s: _DecodeState) -> _DecodeState:
+        tok = sample(jax.random.fold_in(rng, s.step), s.logits, temperature, top_p)
+        tok = jnp.where(s.done, pad_id, tok)
+        out = jax.lax.dynamic_update_slice(s.out, tok[:, None], (0, s.step))
+        lengths = s.lengths + (~s.done).astype(jnp.int32)
+        hit_eos = jnp.isin(tok, eos_ids)
+        # the just-sampled token occupies position prompt_len + step for rows
+        # that were still alive; they attend to it on the next forward
+        key_mask = jax.lax.dynamic_update_slice(
+            s.key_mask, (~s.done).astype(s.key_mask.dtype)[:, None],
+            (0, prompt_len + s.step),
+        )
+        done = s.done | hit_eos
+        next_logits, cache = forward(
+            params, cfg, tok[:, None],
+            attention_mask=key_mask, lora=lora, lora_scale=lora_scale,
+            kv_cache=s.cache, cache_offset=prompt_len + s.step,
+            attn_impl=attn_impl,
+        )
+        return _DecodeState(
+            step=s.step + 1, out=out, lengths=lengths, done=done,
+            key_mask=key_mask, logits=next_logits[:, 0], cache=cache,
+        )
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.out, final.lengths
+
+
+class GenerationEngine:
+    """Compiled rollout engine bound to (model config, shapes, eos/pad ids).
+
+    ``generate`` is the ``vllm_generate`` equivalent: prompts in, per-candidate
+    token arrays + lengths out (decode to text happens host-side).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        max_prompt_tokens: int,
+        max_new_tokens: int,
+        eos_token_ids: Sequence[int],
+        pad_token_id: int,
+        lora_scale: float = 1.0,
+        cache_dtype=jnp.bfloat16,
+        attn_impl: str = "reference",
+    ):
+        self.cfg = cfg
+        self.max_prompt_tokens = max_prompt_tokens
+        self.max_new_tokens = max_new_tokens
+        self.max_total = max_prompt_tokens + max_new_tokens
+        self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
+        self.pad_id = int(pad_token_id)
+        self.lora_scale = lora_scale
+
+        self._prefill = jax.jit(
+            partial(
+                _prefill, cfg=cfg, max_total=self.max_total,
+                lora_scale=lora_scale, cache_dtype=cache_dtype,
+                attn_impl=attn_impl,
+            )
+        )
+        # n and max_steps are static (shape-determining); temperature/top_p traced
+        self._decode = jax.jit(
+            partial(
+                _decode, cfg=cfg, prompt_len=max_prompt_tokens,
+                pad_id=self.pad_id, lora_scale=lora_scale, attn_impl=attn_impl,
+            ),
+            static_argnames=("n", "max_steps"),
+            # no cache donation: the candidate fan-out (jnp.repeat to B·n rows)
+            # allocates fresh loop-carried buffers, so the prefill cache can
+            # never alias them
+        )
+
+    def generate(
+        self,
+        params: Params,
+        lora: Params | None,
+        prompt_ids: np.ndarray,  # [B, P] left-padded to max_prompt_tokens
+        prompt_mask: np.ndarray,
+        sampling: SamplingConfig,
+        rng: jax.Array,
+    ) -> GenerationResult:
+        b, p = prompt_ids.shape
+        if p != self.max_prompt_tokens:
+            raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
+        max_steps = min(sampling.max_tokens, self.max_new_tokens)
+        cache, key_mask, last_logits = self._prefill(
+            params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
+        )
+        out, lengths = self._decode(
+            params, lora, cache, key_mask, last_logits, rng,
+            n=sampling.n, max_steps=max_steps, eos_ids=self.eos_ids,
+            temperature=jnp.asarray(sampling.temperature, jnp.float32),
+            top_p=jnp.asarray(sampling.top_p, jnp.float32),
+        )
+        out = np.asarray(out).reshape(b, sampling.n, max_steps)
+        lengths = np.asarray(lengths).reshape(b, sampling.n)
+        return GenerationResult(tokens=out, lengths=lengths)

@@ -1,0 +1,55 @@
+"""Batched token sampling under jit: temperature, top-p (nucleus), greedy.
+
+Replaces the vLLM sampler the reference drives through SamplingParams
+(distributed_actor.py:43–48 — temperature, top_p=0.95, n candidates). All ops
+are fixed-shape and branch-free so the whole decode loop stays on device; the
+top-p filter is the exact sort-based formulation (keep the minimal prefix of
+the sorted distribution whose mass reaches top_p).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.ops.attention import NEG_INF
+
+
+def top_p_filter(logits: jax.Array, top_p: jax.Array | float) -> jax.Array:
+    """Mask logits outside the nucleus: sort descending, keep tokens until the
+    cumulative probability first reaches ``top_p`` (the token that crosses the
+    threshold is kept, matching vLLM/HF semantics). [B, V] → [B, V].
+
+    Membership is mapped back by RANK, not by logit threshold, so ties at the
+    cutoff don't expand the nucleus beyond top_p (stable argsort breaks ties
+    deterministically by vocab index)."""
+    order = jnp.argsort(-logits, axis=-1)  # descending, stable
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens whose prefix mass EXCLUDING them has not yet reached top_p
+    keep_sorted = (cum - sorted_probs) < top_p
+    ranks = jnp.argsort(order, axis=-1)  # rank of each vocab position
+    keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jax.Array,  # [B, V]
+    temperature: jax.Array | float,
+    top_p: jax.Array | float = 1.0,
+) -> jax.Array:
+    """Sample token ids [B]. temperature == 0 → greedy (vLLM convention).
+
+    Temperature and top_p may be traced scalars so train/eval sampling params
+    (1.2/0.95 vs 0.6/0.95 — distributed_trainer.py:53–58) share one compiled
+    decode loop.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    scaled = logits.astype(jnp.float32) / t
+    filtered = top_p_filter(scaled, top_p)
+    sampled = jax.random.categorical(rng, filtered, axis=-1)
+    is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
+    return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)

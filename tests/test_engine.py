@@ -1,0 +1,140 @@
+"""Generation engine tests: greedy-vs-naive equivalence, EOS early stop,
+candidate fan-out, padding discipline (the FakeEngine-free core of SURVEY §4's
+integration strategy — the engine itself runs on tiny models in CI)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine import GenerationEngine
+from distrl_llm_tpu.models import TINY, forward, init_params
+
+
+P_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(7), TINY)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, TINY.vocab_size, size=(2, P_LEN)).astype(np.int32)
+    mask = np.ones((2, P_LEN), np.int32)
+    mask[0, :3] = 0  # left padding on row 0
+    ids[0, :3] = 0
+    return params, ids, mask
+
+
+def make_engine(max_new=6, eos=(), pad=0):
+    return GenerationEngine(
+        TINY, max_prompt_tokens=P_LEN, max_new_tokens=max_new,
+        eos_token_ids=eos or [TINY.vocab_size - 1], pad_token_id=pad,
+        cache_dtype=jnp.float32,
+    )
+
+
+def naive_greedy(params, ids, mask, steps):
+    """Reference decode: full forward (no cache) re-run per token."""
+    ids = jnp.asarray(ids)
+    mask = jnp.asarray(mask)
+    out = []
+    for _ in range(steps):
+        logits, _ = forward(params, TINY, ids, attention_mask=mask)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out.append(np.asarray(tok))
+        ids = jnp.concatenate([ids, tok[:, None]], axis=1)
+        mask = jnp.concatenate([mask, jnp.ones((ids.shape[0], 1), jnp.int32)], axis=1)
+    return np.stack(out, axis=1)  # [B, steps]
+
+
+class TestGreedyEquivalence:
+    def test_engine_matches_naive_full_forward(self, setup):
+        params, ids, mask = setup
+        engine = make_engine(max_new=6)
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        expected = naive_greedy(params, ids, mask, 6)
+        np.testing.assert_array_equal(res.tokens[:, 0, :], expected)
+        np.testing.assert_array_equal(res.lengths[:, 0], [6, 6])
+
+
+class TestEosStop:
+    def test_row_stops_at_eos_and_pads(self, setup):
+        params, ids, mask = setup
+        expected = naive_greedy(params, ids, mask, 6)
+        # make the token row 0 greedily emits at step 2 the EOS
+        eos = int(expected[0, 2])
+        engine = make_engine(max_new=6, eos=[eos], pad=0)
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        assert res.lengths[0, 0] == 3  # tokens at steps 0,1,2 incl. EOS
+        np.testing.assert_array_equal(res.tokens[0, 0, :3], expected[0, :3])
+        np.testing.assert_array_equal(res.tokens[0, 0, 3:], 0)  # pad after EOS
+        # row 1 unaffected unless it also hits eos
+        if eos not in expected[1]:
+            assert res.lengths[1, 0] == 6
+
+    def test_all_rows_done_exits_early(self, setup):
+        params, ids, mask = setup
+        expected = naive_greedy(params, ids, mask, 1)
+        engine = make_engine(max_new=50, eos=[int(expected[0, 0]), int(expected[1, 0])])
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=50, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        np.testing.assert_array_equal(res.lengths[:, 0], [1, 1])
+
+
+class TestCandidates:
+    def test_fanout_shapes_and_grouping(self, setup):
+        params, ids, mask = setup
+        engine = make_engine(max_new=4)
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=4, temperature=1.5, n=5),
+            jax.random.PRNGKey(3),
+        )
+        assert res.tokens.shape == (2, 5, 4)
+        assert res.lengths.shape == (2, 5)
+
+    def test_candidates_differ_under_sampling(self, setup):
+        params, ids, mask = setup
+        engine = make_engine(max_new=8)
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=8, temperature=2.0, n=8),
+            jax.random.PRNGKey(4),
+        )
+        unique = {tuple(res.tokens[0, j]) for j in range(8)}
+        assert len(unique) > 1
+
+    def test_greedy_candidates_identical(self, setup):
+        params, ids, mask = setup
+        engine = make_engine(max_new=4)
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=4, temperature=0.0, n=3),
+            jax.random.PRNGKey(5),
+        )
+        for j in range(1, 3):
+            np.testing.assert_array_equal(res.tokens[:, j], res.tokens[:, 0])
+
+
+class TestValidation:
+    def test_wrong_prompt_pad_raises(self, setup):
+        params, ids, mask = setup
+        engine = make_engine()
+        with pytest.raises(ValueError, match="padded"):
+            engine.generate(
+                params, None, ids[:, :4], mask[:, :4],
+                SamplingConfig(max_tokens=4, n=1), jax.random.PRNGKey(0),
+            )

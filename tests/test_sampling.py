@@ -1,0 +1,78 @@
+"""Sampler unit tests: top-p nucleus semantics, greedy, temperature."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.ops.sampling import NEG_INF, sample, top_p_filter
+
+
+def logits_for_probs(probs):
+    return jnp.log(jnp.asarray([probs], jnp.float32))
+
+
+class TestTopPFilter:
+    def test_keeps_minimal_prefix_crossing_threshold(self):
+        lg = logits_for_probs([0.5, 0.3, 0.15, 0.05])
+        out = np.asarray(top_p_filter(lg, 0.7))
+        # cum-excluding: 0, 0.5, 0.8, 0.95 → keep tokens 0,1 (0.8 ≥ 0.7 drops #2)
+        assert out[0, 0] > NEG_INF and out[0, 1] > NEG_INF
+        assert out[0, 2] == NEG_INF and out[0, 3] == NEG_INF
+
+    def test_top_p_1_keeps_everything(self):
+        lg = logits_for_probs([0.4, 0.3, 0.2, 0.1])
+        out = np.asarray(top_p_filter(lg, 1.0))
+        assert (out > NEG_INF).all()
+
+    def test_always_keeps_top_token(self):
+        lg = logits_for_probs([0.99, 0.005, 0.005])
+        out = np.asarray(top_p_filter(lg, 0.01))
+        assert out[0, 0] > NEG_INF
+        assert (out[0, 1:] == NEG_INF).all()
+
+    def test_unsorted_input(self):
+        lg = logits_for_probs([0.05, 0.5, 0.15, 0.3])
+        out = np.asarray(top_p_filter(lg, 0.7))
+        assert out[0, 1] > NEG_INF and out[0, 3] > NEG_INF  # 0.5 and 0.3 kept
+        assert out[0, 0] == NEG_INF and out[0, 2] == NEG_INF
+
+
+class TestSample:
+    def test_temperature_zero_is_greedy(self):
+        lg = jnp.asarray([[1.0, 5.0, 2.0], [9.0, 0.0, 1.0]])
+        tok = sample(jax.random.PRNGKey(0), lg, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(tok), [1, 0])
+
+    def test_sampling_respects_top_p_support(self):
+        lg = logits_for_probs([0.6, 0.3, 0.05, 0.05])
+        toks = [
+            int(sample(jax.random.PRNGKey(i), lg, 1.0, 0.8)[0]) for i in range(64)
+        ]
+        assert set(toks) <= {0, 1}
+
+    def test_high_temperature_flattens(self):
+        lg = jnp.asarray([[4.0, 0.0, 0.0, 0.0]])
+        toks = [int(sample(jax.random.PRNGKey(i), lg, 50.0, 1.0)[0]) for i in range(200)]
+        # at T=50 the distribution is near-uniform: non-argmax tokens dominate
+        assert sum(t != 0 for t in toks) > 100
+
+    def test_traced_params_one_compile(self):
+        calls = []
+
+        @jax.jit
+        def f(rng, lg, t, p):
+            calls.append(1)
+            return sample(rng, lg, t, p)
+
+        lg = jnp.zeros((2, 8))
+        f(jax.random.PRNGKey(0), lg, jnp.float32(1.2), jnp.float32(0.95))
+        f(jax.random.PRNGKey(1), lg, jnp.float32(0.6), jnp.float32(0.95))
+        assert len(calls) == 1  # no retrace for different sampling params
+
+    def test_ties_at_cutoff_do_not_expand_nucleus(self):
+        # uniform 4-way tie, top_p=0.5 → exactly 2 kept (rank-based membership)
+        lg = logits_for_probs([0.25, 0.25, 0.25, 0.25])
+        out = np.asarray(top_p_filter(lg, 0.5))
+        assert (out > NEG_INF).sum() == 2
