@@ -1,0 +1,143 @@
+"""Checkpoint / resume: Orbax state snapshots + the adapter artifact.
+
+The reference is save-only (SURVEY §5 "checkpoint"): a LoRA adapter file every
+step (save_lora, distributed_actor.py:84–86 — doubling as the weight-sync bus)
+and HF save_pretrained snapshots every ``save_every`` steps (:263–264). There
+is no load path and optimizer state is never saved. The TPU build fixes that:
+
+* :class:`CheckpointManager` — Orbax snapshots of {lora params, optimizer
+  state, step, episode, rng} with true resume and retention;
+* :func:`save_adapter_file` — an optional peft-style adapter artifact
+  (safetensors) for compatibility with the reference's per-step adapter file.
+  Weight *sync* does NOT go through this file — learner→rollout weights move
+  as device arrays (trainer.py) — it is an export artifact only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+Params = dict[str, Any]
+
+
+class CheckpointManager:
+    """Orbax-backed save/restore of the full learner state.
+
+    State tree: ``{"lora": ..., "opt_state": ..., "step": ..., "episode": ...,
+    "rng": ...}``. Restore requires a template with matching structure (build
+    it from a fresh init) — shapes/dtypes are validated by Orbax.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: dict) -> None:
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: dict, step: int | None = None) -> dict | None:
+        """Restore into ``template``'s structure; None if no checkpoint."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(
+            self._ocp.utils.to_shape_dtype_struct, template
+        )
+        return self._mgr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+# HF/peft adapter tensor-name mapping for the export artifact. Our stacked
+# [L, in, out] LoRA layout unstacks to per-layer peft names so the artifact is
+# loadable by peft-compatible tooling (the reference's adapter artifact is a
+# peft save_lora output, distributed_actor.py:84–86).
+_PEFT_NAMES = {
+    "wq": "self_attn.q_proj",
+    "wk": "self_attn.k_proj",
+    "wv": "self_attn.v_proj",
+    "wo": "self_attn.o_proj",
+    "w_gate": "mlp.gate_proj",
+    "w_up": "mlp.up_proj",
+    "w_down": "mlp.down_proj",
+}
+
+
+def save_adapter_file(
+    lora: Params, path: str, *, rank: int, alpha: float, model_name: str = ""
+) -> None:
+    """Write a peft-style adapter directory: adapter_model.safetensors +
+    adapter_config.json. LoRA pytree layout: ``lora[key]["a"]`` [L, in, r],
+    ``lora[key]["b"]`` [L, r, out] (models/lora.py)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+    for key, mats in lora.get("layers", lora).items():
+        peft = _PEFT_NAMES.get(key, key)
+        a, b = np.asarray(mats["a"]), np.asarray(mats["b"])
+        for layer in range(a.shape[0]):
+            base = f"base_model.model.model.layers.{layer}.{peft}"
+            # peft stores lora_A [r, in] and lora_B [out, r]
+            tensors[f"{base}.lora_A.weight"] = np.ascontiguousarray(a[layer].T)
+            tensors[f"{base}.lora_B.weight"] = np.ascontiguousarray(b[layer].T)
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+    config = {
+        "peft_type": "LORA",
+        "r": rank,
+        "lora_alpha": alpha,
+        "base_model_name_or_path": model_name,
+        "target_modules": sorted(
+            {v.rsplit(".", 1)[-1] for v in _PEFT_NAMES.values()}
+        ),
+    }
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(config, f, indent=2)
+
+
+def load_adapter_file(path: str, template: Params) -> Params:
+    """Read an adapter directory back into our stacked layout (shape/dtype from
+    ``template``) — the round-trip half the reference never had."""
+    from safetensors.numpy import load_file
+
+    tensors = load_file(os.path.join(path, "adapter_model.safetensors"))
+    nested = "layers" in template and isinstance(template.get("layers"), dict)
+    layer_template = template["layers"] if nested else template
+    out: Params = {}
+    for key, mats in layer_template.items():
+        peft = _PEFT_NAMES.get(key, key)
+        a_t, b_t = np.asarray(mats["a"]), np.asarray(mats["b"])
+        a = np.stack(
+            [
+                tensors[f"base_model.model.model.layers.{l}.{peft}.lora_A.weight"].T
+                for l in range(a_t.shape[0])
+            ]
+        ).astype(a_t.dtype)
+        b = np.stack(
+            [
+                tensors[f"base_model.model.model.layers.{l}.{peft}.lora_B.weight"].T
+                for l in range(b_t.shape[0])
+            ]
+        ).astype(b_t.dtype)
+        out[key] = {"a": a, "b": b}
+    return {"layers": out} if nested else out

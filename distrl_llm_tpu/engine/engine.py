@@ -68,7 +68,7 @@ def _prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
     return cache, key_mask, last_logits[:, 0]
 
 
-def _decode(params, lora, cache, key_mask, first_logits, rng,
+def _decode(params, lora, cache, key_mask, first_logits, row_alive, rng,
             *, cfg: ModelConfig, n: int, prompt_len: int, max_steps: int,
             eos_ids, pad_id: int, temperature, top_p, lora_scale: float,
             attn_impl: str):
@@ -82,7 +82,9 @@ def _decode(params, lora, cache, key_mask, first_logits, rng,
         step=jnp.zeros((), jnp.int32),
         out=jnp.full((bn, max_steps), pad_id, jnp.int32),
         lengths=jnp.zeros((bn,), jnp.int32),
-        done=jnp.zeros((bn,), bool),
+        # rows with an empty prompt are batch padding — born done, so they
+        # never gate the early-exit or sample from their NaN logits
+        done=jnp.repeat(~row_alive, n, axis=0),
         key_mask=key_mask,
         logits=logits,
         cache=cache,
@@ -181,8 +183,9 @@ class GenerationEngine:
         cache, key_mask, last_logits = self._prefill(
             params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
         )
+        row_alive = jnp.asarray(prompt_mask).sum(axis=-1) > 0
         out, lengths = self._decode(
-            params, lora, cache, key_mask, last_logits, rng,
+            params, lora, cache, key_mask, last_logits, row_alive, rng,
             n=sampling.n, max_steps=max_steps, eos_ids=self.eos_ids,
             temperature=jnp.asarray(sampling.temperature, jnp.float32),
             top_p=jnp.asarray(sampling.top_p, jnp.float32),

@@ -1,0 +1,58 @@
+"""FakeEngine: a scripted stand-in for the generation engine.
+
+The reference has no way to exercise its trainer loop without GPUs (SURVEY §4)
+— this is the fake backend our integration tests use instead. It honors the
+engine protocol (``generate(params, lora, prompt_ids, prompt_mask, sampling,
+rng) -> GenerationResult``) but produces completions from a host-side script
+function, tokenized to the same fixed shapes the real engine emits.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine.engine import GenerationResult
+
+# script(prompt, candidate_index) -> completion text
+ScriptFn = Callable[[str, int], str]
+
+
+class FakeEngine:
+    """Deterministic engine double. ``calls`` records (batch_size, n,
+    weight_version-at-call) so tests can assert rollout/sync behavior."""
+
+    def __init__(self, tokenizer, script: ScriptFn, *, max_new_tokens: int = 64):
+        self.tokenizer = tokenizer
+        self.script = script
+        self.max_new_tokens = max_new_tokens
+        self.calls: list[dict] = []
+
+    def generate(
+        self,
+        params,
+        lora,
+        prompt_ids: np.ndarray,
+        prompt_mask: np.ndarray,
+        sampling: SamplingConfig,
+        rng,
+    ) -> GenerationResult:
+        b = prompt_ids.shape[0]
+        n = sampling.n
+        max_steps = min(sampling.max_tokens, self.max_new_tokens)
+        self.calls.append({"batch": b, "n": n, "lora": lora})
+
+        pad_id = getattr(self.tokenizer, "pad_token_id", 0) or 0
+        tokens = np.full((b, n, max_steps), pad_id, np.int32)
+        lengths = np.zeros((b, n), np.int32)
+        for i in range(b):
+            # recover the prompt text to feed the script
+            real = prompt_ids[i][prompt_mask[i].astype(bool)]
+            prompt = self.tokenizer.decode(real.tolist())
+            for j in range(n):
+                toks = self.tokenizer.encode(self.script(prompt, j))[:max_steps]
+                tokens[i, j, : len(toks)] = toks
+                lengths[i, j] = len(toks)
+        return GenerationResult(tokens=tokens, lengths=lengths)

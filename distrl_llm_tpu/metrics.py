@@ -1,0 +1,147 @@
+"""Metrics sinks: the reference's wandb contract behind a pluggable interface.
+
+The reference hardcodes wandb (distributed_trainer.py:237–239, :348–366,
+:412–415). We keep the exact metric names and step semantics — parity lets
+reward curves overlay against the reference's published runs (media/*.png) —
+but make the sink pluggable: wandb when importable/configured, a JSONL file
+sink for offline TPU hosts, a null sink for tests.
+
+Metric-name contract (SURVEY §5 "metrics"):
+  train (per batch step, distributed_trainer.py:348–366):
+    loss, mean_accuracy_reward, min_accuracy_reward, max_accuracy_reward,
+    mean_format_reward, mean_token_length, episode, total_batch_steps,
+    total_samples_processed, timing/update_duration, timing/reward_duration,
+    timing/generation_duration
+  eval (distributed_trainer.py:412–415):
+    eval/pass@1(mean{n}), eval/BoN({n}), eval/mean_token_length,
+    timing/eval_duration
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Mapping, Protocol
+
+
+class MetricsSink(Protocol):
+    def log(self, metrics: Mapping[str, Any], step: int) -> None: ...
+    def finish(self) -> None: ...
+
+
+class NullSink:
+    """Discard everything (tests, dry runs)."""
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class MemorySink:
+    """Keep everything in a list (assertions in tests)."""
+
+    def __init__(self):
+        self.records: list[tuple[int, dict[str, Any]]] = []
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        self.records.append((step, dict(metrics)))
+
+    def finish(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """Append one JSON object per log call — the offline-host default."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        rec = {"_step": step, "_ts": time.time()}
+        rec.update({k: _jsonable(v) for k, v in metrics.items()})
+        self._f.write(json.dumps(rec) + "\n")
+
+    def finish(self) -> None:
+        self._f.close()
+
+
+class WandbSink:
+    """The reference sink: wandb.init(name, config, project) →
+    run.log(metrics, step) → finish (distributed_trainer.py:237–239)."""
+
+    def __init__(self, run_name: str | None, project: str, config: Mapping[str, Any]):
+        import wandb
+
+        self._run = wandb.init(name=run_name, config=dict(config), project=project)
+
+    def log(self, metrics: Mapping[str, Any], step: int) -> None:
+        self._run.log(dict(metrics), step=step)
+
+    def finish(self) -> None:
+        self._run.finish()
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return float(v) if hasattr(v, "__float__") else str(v)
+
+
+def make_sink(
+    backend: str,
+    *,
+    run_name: str | None,
+    project: str,
+    config: Mapping[str, Any],
+    run_dir: str = ".",
+) -> MetricsSink:
+    """``auto`` → wandb if importable and logged in, else jsonl."""
+    if backend == "null":
+        return NullSink()
+    if backend == "jsonl":
+        return JsonlSink(os.path.join(run_dir, "metrics.jsonl"))
+    if backend in ("wandb", "auto"):
+        try:
+            return WandbSink(run_name, project, config)
+        except Exception:
+            if backend == "wandb":
+                raise
+            return JsonlSink(os.path.join(run_dir, "metrics.jsonl"))
+    raise ValueError(f"unknown metrics backend {backend!r}")
+
+
+class PhaseTimer:
+    """Wall-clock phase timing matching the reference's inline time.time()
+    pairs (distributed_trainer.py:180/:202, :206/:217, :303/:343, :385/:411).
+    Usage: ``with timer("generation"): ...`` then ``timer.metrics()`` yields
+    ``timing/generation_duration`` etc."""
+
+    def __init__(self):
+        self._durations: dict[str, float] = {}
+        self._active: str | None = None
+        self._t0 = 0.0
+
+    def __call__(self, phase: str) -> "PhaseTimer":
+        self._active = phase
+        return self
+
+    def __enter__(self) -> "PhaseTimer":
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._active is not None
+        self._durations[self._active] = time.time() - self._t0
+        self._active = None
+
+    def metrics(self) -> dict[str, float]:
+        return {f"timing/{k}_duration": v for k, v in self._durations.items()}
+
+    def get(self, phase: str) -> float:
+        return self._durations.get(phase, 0.0)

@@ -73,3 +73,34 @@ def load_tokenizer(model_name_or_path: str):
     from transformers import AutoTokenizer
 
     return AutoTokenizer.from_pretrained(model_name_or_path)
+
+
+class CharTokenizer:
+    """Byte-level tokenizer with the surface the framework touches (encode/
+    decode/pad/eos + chat template via data.py's fallback). Used by the smoke
+    path and tests where no HF tokenizer is downloadable (no-egress hosts)."""
+
+    pad_token_id = 0
+    eos_token_id = 3
+    chat_template = None
+
+    def __init__(self, vocab_size: int = 256):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        return [min(b, self.vocab_size - 1) for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        specials = {self.pad_token_id, self.eos_token_id}
+        kept = [i for i in ids if not (skip_special_tokens and i in specials)]
+        return bytes(kept).decode("utf-8", errors="ignore")
+
+    def apply_chat_template(
+        self, messages, add_generation_prompt=False, tokenize=False, chat_template=None
+    ) -> str:
+        out = "".join(
+            f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n" for m in messages
+        )
+        if add_generation_prompt:
+            out += "<|im_start|>assistant\n"
+        return out

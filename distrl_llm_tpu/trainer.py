@@ -1,0 +1,400 @@
+"""The driver: synchronous on-policy RL loop re-hosted on a TPU mesh.
+
+TPU-native replacement for the reference Trainer (distributed_trainer.py:13–416
+— SURVEY §3.2). The reference's mechanisms map as follows:
+
+* **Rollout fan-out** (Ray actors + chunk dispatch, :178–200) → ONE sharded
+  ``engine.generate`` call: the batch is laid out over the rollout mesh's dp
+  axis and GSPMD parallelizes it. ``chunk_sizes`` is still computed for its
+  validation/warning semantics (and exercised by the multi-process control
+  plane), but on a single host no per-worker RPC exists.
+* **Weight sync** (adapter file save/load every step, :346 / distributed_
+  actor.py:150) → the learner's LoRA pytree is PASSED to the engine each
+  round — device arrays, no filesystem. ``weight_version`` counts updates and
+  the engine round records which version it sampled with (the race detector
+  the reference lacks, SURVEY §5). ``write_adapter_file=True`` still exports
+  the per-step artifact for compatibility.
+* **Gradient merge** (CPU dicts through Ray, :308–342) → inside the pjit'd
+  train step (learner/train_step.py); nothing to orchestrate here.
+* **Metrics / timing**: exact reference names (:348–366, :412–415) through a
+  pluggable sink (metrics.py).
+* **Checkpointing**: Orbax {lora, opt_state, step, episode} with true resume
+  (the reference is save-only, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distrl_llm_tpu.checkpoint import CheckpointManager, save_adapter_file
+from distrl_llm_tpu.config import SamplingConfig, TrainConfig
+from distrl_llm_tpu.data import DictDataset
+from distrl_llm_tpu.learner.optim import make_optimizer
+from distrl_llm_tpu.learner.train_step import make_train_step, prepare_update_batch
+from distrl_llm_tpu.metrics import MetricsSink, PhaseTimer, make_sink
+from distrl_llm_tpu.models.lora import init_lora_params, lora_scale
+from distrl_llm_tpu.parallel.mesh import RoleMeshes, build_role_meshes
+from distrl_llm_tpu.rewards import RewardComputer
+from distrl_llm_tpu.shaping import flatten_for_update, shape_rewards, topk_filter
+from distrl_llm_tpu.tokenizer import decode_batch, encode_fixed
+from distrl_llm_tpu.utils.chunking import chunk_sizes
+
+log = logging.getLogger(__name__)
+
+RewardFn = Callable[[Sequence[str], Sequence[str]], np.ndarray]
+
+
+class Trainer:
+    """Owns the episode/batch loop. Heavy pieces (tokenizer, base params,
+    engine, meshes) are injectable so the loop tests with fakes (SURVEY §4
+    "FakeEngine") and assembles itself for real runs via ``from_pretrained``.
+    """
+
+    def __init__(
+        self,
+        train_dataset,
+        test_dataset,
+        reward_function: RewardFn,
+        config: TrainConfig,
+        *,
+        tokenizer,
+        engine,
+        base_params,
+        model_cfg,
+        meshes: RoleMeshes | None = None,
+        sink: MetricsSink | None = None,
+        reward_computer: RewardComputer | None = None,
+    ):
+        self.config = config
+        self.train_dataset = DictDataset.wrap(train_dataset)
+        self.test_dataset = DictDataset.wrap(test_dataset)
+        self.reward_function = reward_function
+        self.tokenizer = tokenizer
+        self.engine = engine
+        self.base_params = base_params
+        self.model_cfg = model_cfg
+        self.meshes = meshes
+        self.sink = sink
+        self.rewards = reward_computer or RewardComputer()
+
+        # chunk-composition validation parity (distributed_trainer.py:34–36)
+        assert config.number_of_learners > 0, "Need at least one learner"
+        chunk_sizes(
+            config.batch_size,
+            config.number_of_actors,
+            config.number_of_learners,
+            config.learner_chunk_size,
+        )
+
+        self.scale = lora_scale(config.max_lora_rank, config.lora_alpha)
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._rng, lora_key = jax.random.split(self._rng)
+        self.lora = init_lora_params(
+            lora_key, model_cfg, config.max_lora_rank,
+            dtype=jnp.float32,  # adapters train in f32; base stays bf16
+        )
+        self.optimizer = make_optimizer(config.lr, use_8bit=config.optimizer_8bit)
+        self.opt_state = self.optimizer.init(self.lora)
+        self.train_step = make_train_step(
+            model_cfg,
+            learner_type=config.learner,
+            optimizer=self.optimizer,
+            lora_scale=self.scale,
+            micro_size=config.train_batch_size,
+            skip_semantics=(
+                "all_zero" if config.skip_all_zero_reward_batches else "any_zero"
+            ),
+        )
+
+        self.total_batch_steps = 0
+        self.total_samples_processed = 0
+        self.episode = 0
+        self.weight_version = 0  # incremented per optimizer step
+        self._rollout_weight_version = -1  # last version the engine sampled with
+
+        self.ckpt: CheckpointManager | None = None
+        if config.checkpoint_dir:
+            self.ckpt = CheckpointManager(config.checkpoint_dir)
+            if config.resume:
+                self._try_resume()
+
+    # ------------------------------------------------------------------ setup
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        train_dataset,
+        test_dataset,
+        reward_function: RewardFn,
+        config: TrainConfig,
+        *,
+        checkpoint_path: str | None = None,
+        tokenizer=None,
+        sink: MetricsSink | None = None,
+    ) -> "Trainer":
+        """Assemble the real thing: tokenizer + HF weights + sharded engine.
+
+        ``checkpoint_path`` is a local HF checkpoint directory; when None the
+        model id must resolve to a local path. (The reference's from_pretrained
+        pulls from the hub — distributed_actor.py:58; this environment has no
+        egress, so weights must be on disk.) Pass ``tokenizer`` if the caller
+        already loaded it (the CLI does, for dataset templating).
+        """
+        from distrl_llm_tpu.engine.engine import GenerationEngine
+        from distrl_llm_tpu.models.loading import load_pretrained
+        from distrl_llm_tpu.parallel.partition import param_specs, shard_tree
+        from distrl_llm_tpu.tokenizer import load_tokenizer
+
+        path = checkpoint_path or config.model
+        if tokenizer is None:
+            tokenizer = load_tokenizer(path)
+        meshes = build_role_meshes(config.mesh)
+        params, model_cfg = load_pretrained(path, dtype=np.dtype(config.dtype))
+        params = shard_tree(params, meshes.rollout, param_specs(params))
+        eos = [tokenizer.eos_token_id]
+        extra_eos = getattr(tokenizer, "eos_token_ids", None)
+        if extra_eos:
+            eos = sorted(set(eos) | set(extra_eos))
+        engine = GenerationEngine(
+            model_cfg,
+            max_prompt_tokens=config.max_prompt_tokens,
+            max_new_tokens=config.max_new_tokens,
+            eos_token_ids=eos,
+            pad_token_id=tokenizer.pad_token_id or tokenizer.eos_token_id,
+            lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+        )
+        return cls(
+            train_dataset, test_dataset, reward_function, config,
+            tokenizer=tokenizer, engine=engine, base_params=params,
+            model_cfg=model_cfg, meshes=meshes, sink=sink,
+        )
+
+    # ------------------------------------------------------------- checkpoint
+
+    def _state_tree(self) -> dict:
+        return {
+            "lora": self.lora,
+            "opt_state": self.opt_state,
+            "step": jnp.asarray(self.total_batch_steps),
+            "episode": jnp.asarray(self.episode),
+            "samples": jnp.asarray(self.total_samples_processed),
+            "rng": self._rng,
+        }
+
+    def _try_resume(self) -> None:
+        assert self.ckpt is not None
+        restored = self.ckpt.restore(self._state_tree())
+        if restored is None:
+            return
+        self.lora = restored["lora"]
+        self.opt_state = restored["opt_state"]
+        self.total_batch_steps = int(restored["step"])
+        self.episode = int(restored["episode"])
+        self.total_samples_processed = int(restored["samples"])
+        self._rng = restored["rng"]
+        self.weight_version = self.total_batch_steps
+        log.info(
+            "resumed from step %d (episode %d)", self.total_batch_steps, self.episode
+        )
+
+    def save_checkpoint(self) -> None:
+        if self.ckpt is not None:
+            self.ckpt.save(self.total_batch_steps, self._state_tree())
+
+    def save_adapter(self) -> None:
+        """The reference's per-step adapter artifact (distributed_trainer.py:346
+        → save_lora). Export-only here — weight sync is in-memory."""
+        save_adapter_file(
+            self.lora, self.config.lora_save_path,
+            rank=self.config.max_lora_rank, alpha=self.config.lora_alpha,
+            model_name=self.config.model,
+        )
+
+    # ---------------------------------------------------------------- rollout
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def _generate_round(
+        self, batch: Mapping[str, Sequence[str]], sampling: SamplingConfig
+    ) -> list[dict[str, Any]]:
+        """One rollout round → candidate dicts shaped like the reference's
+        ``vllm_generate`` output (distributed_actor.py:147–172): per task group,
+        n candidate strings, the prompt/solution tiled ×n, token lengths.
+
+        The whole round is one fixed-shape engine call: prompts padded to
+        ``batch_size`` rows (masked rows discarded after) so jit compiles once;
+        the batch shards over the rollout mesh's dp axis.
+        """
+        problems = list(batch["problem"])
+        solutions = list(batch["solution"])
+        b_real = len(problems)
+        b_pad = self.config.batch_size
+        prompt_ids, prompt_mask = encode_fixed(
+            self.tokenizer, problems + [""] * (b_pad - b_real),
+            self.config.max_prompt_tokens, side="left",
+        )
+        result = self.engine.generate(
+            self.base_params,
+            self.lora,
+            prompt_ids,
+            prompt_mask,
+            sampling,
+            self._next_rng(),
+        )
+        self._rollout_weight_version = self.weight_version
+
+        n = sampling.n
+        answers, token_lengths = [], []
+        for i in range(b_real):
+            answers.append(decode_batch(self.tokenizer, result.tokens[i], result.lengths[i]))
+            token_lengths.append([int(x) for x in result.lengths[i]])
+        return [
+            {
+                "answers": answers,
+                "problem": [[p] * n for p in problems],
+                "solution": [[s] * n for s in solutions],
+                "token_lengths": token_lengths,
+            }
+        ]
+
+    def _compute_round_rewards(self, candidates: list[dict[str, Any]]) -> None:
+        """Per-task-group (n, 2) rewards (distributed_trainer.py:205–219),
+        host-parallel via RewardComputer."""
+        for cand in candidates:
+            groups = [
+                (cand["answers"][j], cand["solution"][j])
+                for j in range(len(cand["answers"]))
+            ]
+            cand["rewards"] = self.rewards(groups)
+
+    def _generate_all_candidates(
+        self, batch: Mapping[str, Sequence[str]], sampling: SamplingConfig | None = None
+    ) -> list[dict[str, Any]]:
+        sampling = sampling or self.config.train_sampling()
+        candidates = self._generate_round(batch, sampling)
+        self._compute_round_rewards(candidates)
+        return candidates
+
+    # ------------------------------------------------------------------ train
+
+    def train(self) -> None:
+        cfg = self.config
+        if self.sink is None:
+            self.sink = make_sink(
+                cfg.metrics_backend,
+                run_name=cfg.run_name,
+                project=cfg.project_name,
+                config=cfg.to_flat_dict(),
+                run_dir=cfg.run_directory if cfg.run_name else ".",
+            )
+        if cfg.run_name:
+            os.makedirs(cfg.run_directory, exist_ok=True)
+
+        try:
+            # initial eval (distributed_trainer.py:241–242)
+            self.evaluate()
+
+            # self.episode is the next episode to START (end-of-episode saves
+            # store episode+1, so a finished run resumes as a no-op; mid-episode
+            # saves re-run their episode from the top — batch-level resume
+            # would need iterator state).
+            start_episode = self.episode
+            for episode in range(start_episode, cfg.episodes):
+                self.episode = episode
+                dataset = self.train_dataset.shuffle()
+                for batch in dataset.iter(cfg.batch_size):
+                    self._train_batch(batch, episode)
+                    if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
+                        self.evaluate()
+                    if cfg.save_every and self.total_batch_steps % cfg.save_every == 0:
+                        self.save_checkpoint()
+                self.episode = episode + 1
+                self.save_checkpoint()
+        finally:
+            self.sink.finish()
+            self.rewards.close()
+
+    def _train_batch(self, batch: Mapping[str, Sequence[str]], episode: int) -> None:
+        cfg = self.config
+        timer = PhaseTimer()
+
+        with timer("generation"):
+            candidates = self._generate_round(batch, cfg.train_sampling())
+        with timer("reward"):
+            self._compute_round_rewards(candidates)
+
+        # shaping: baselines / GRPO group-norm advantages + metric collection
+        # (distributed_trainer.py:262–279), then top-k (:281–294)
+        stats = shape_rewards(candidates, cfg.learner)
+        if cfg.topk < cfg.num_candidates:
+            topk_filter(candidates, cfg.topk)
+
+        with timer("update"):
+            problems, answers, coeffs = flatten_for_update(candidates, cfg.learner)
+            update = prepare_update_batch(
+                self.tokenizer, problems, answers, coeffs,
+                max_prompt_tokens=cfg.max_prompt_tokens,
+                max_new_tokens=cfg.max_new_tokens,
+                micro_size=cfg.train_batch_size,
+            )
+            self.lora, self.opt_state, loss = self.train_step(
+                self.lora, self.opt_state, self.base_params, update
+            )
+            loss = float(loss)
+        self.weight_version += 1
+
+        if cfg.write_adapter_file:
+            self.save_adapter()
+
+        self.total_batch_steps += 1
+        self.total_samples_processed += len(batch["problem"])
+        metrics = {
+            "loss": loss,
+            "mean_accuracy_reward": float(np.mean(stats.mean_acc)),
+            "min_accuracy_reward": float(np.mean(stats.min_acc)),
+            "max_accuracy_reward": float(np.mean(stats.max_acc)),
+            "mean_format_reward": float(np.mean(stats.mean_format)),
+            "mean_token_length": float(np.mean(stats.mean_token_length)),
+            "episode": episode,
+            "total_batch_steps": self.total_batch_steps,
+            "total_samples_processed": self.total_samples_processed,
+        }
+        metrics.update(timer.metrics())
+        self.sink.log(metrics, step=self.total_batch_steps)
+
+    # ------------------------------------------------------------------- eval
+
+    def evaluate(self) -> dict[str, float]:
+        """Best-of-n eval (distributed_trainer.py:384–416): pass@1 = mean
+        accuracy over candidates, BoN = max; same rollout path with eval
+        sampling params."""
+        cfg = self.config
+        timer = PhaseTimer()
+        accs, bons, tok_lens = [], [], []
+        with timer("eval"):
+            for batch in self.test_dataset.iter(cfg.batch_size):
+                candidates = self._generate_all_candidates(batch, cfg.eval_sampling())
+                for cand in candidates:
+                    for rewards, lengths in zip(cand["rewards"], cand["token_lengths"]):
+                        acc = np.asarray(rewards)[:, 1]
+                        accs.append(float(np.mean(acc)))
+                        bons.append(float(np.max(acc)))
+                        tok_lens.append(float(np.mean(lengths)))
+        n = cfg.eval_n
+        metrics = {
+            f"eval/pass@1(mean{n})": float(np.mean(accs)),
+            f"eval/BoN({n})": float(np.mean(bons)),
+            "eval/mean_token_length": float(np.mean(tok_lens)),
+            **timer.metrics(),
+        }
+        if self.sink is not None:
+            self.sink.log(metrics, step=self.total_batch_steps)
+        return metrics

@@ -1,0 +1,57 @@
+"""CLI flag-parity tests: every reference flag exists with the reference
+default (train_distributed.py:10–35 — the README.md:48–61 CLI contract)."""
+
+import pytest
+
+from train_distributed import build_parser, config_from_args
+
+REFERENCE_DEFAULTS = {
+    "model": "Qwen/Qwen2.5-7B-Instruct",
+    "dataset": "HuggingFaceH4/MATH-500",
+    "project_name": "math-reasoning",
+    "lora_save_path": "lora_request_math",
+    "lr": 2e-5,
+    "max_new_tokens": 1200,
+    "max_prompt_tokens": 350,
+    "temperature": 1.2,
+    "episodes": 15,
+    "num_candidates": 16,
+    "batch_size": 30,
+    "learner_chunk_size": 8,
+    "train_batch_size": 8,
+    "save_every": 100,
+    "eval_every": 10,
+    "number_of_actors": 2,
+    "number_of_learners": 1,
+    "learner": "pg",
+    "max_lora_rank": 32,
+    "lora_alpha": 16,
+    "lora_dropout": 0.0,
+    "topk": 16,
+    "actor_gpu_usage": 0.91,
+    "learner_gpu_usage": 0.35,
+}
+
+
+def test_reference_flags_and_defaults():
+    args = build_parser().parse_args([])
+    for flag, default in REFERENCE_DEFAULTS.items():
+        assert getattr(args, flag) == default, flag
+
+
+def test_config_roundtrip():
+    args = build_parser().parse_args(
+        ["--learner", "grpo", "--number_of_actors", "4", "--tp", "2",
+         "--batch_size", "16"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.learner == "grpo"
+    assert cfg.batch_size == 16
+    assert cfg.mesh.number_of_actors == 4
+    assert cfg.mesh.tp == 2
+    assert cfg.max_seq_length == 1550  # 350 + 1200 (distributed_actor.py:25)
+
+
+def test_invalid_learner_rejected():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--learner", "ppo"])
