@@ -68,17 +68,15 @@ def _prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
     return cache, key_mask, last_logits[:, 0]
 
 
-def _decode(params, lora, cache, key_mask, first_logits, row_alive, rng,
-            *, cfg: ModelConfig, n: int, prompt_len: int, max_steps: int,
-            eos_ids, pad_id: int, temperature, top_p, lora_scale: float,
-            attn_impl: str):
-    # expand to candidate rows: row b*n + j is candidate j of prompt b
-    cache = {k: jnp.repeat(v, n, axis=1) for k, v in cache.items()}
+def _decode_init(cache, key_mask, first_logits, row_alive,
+                 *, n: int, max_steps: int, pad_id: int):
+    """Expand prefill state to candidate rows: row b*n + j is candidate j of
+    prompt b."""
+    cache = jax.tree_util.tree_map(lambda c: jnp.repeat(c, n, axis=0), cache)
     key_mask = jnp.repeat(key_mask, n, axis=0)
     logits = jnp.repeat(first_logits, n, axis=0)
     bn = logits.shape[0]
-
-    state = _DecodeState(
+    return _DecodeState(
         step=jnp.zeros((), jnp.int32),
         out=jnp.full((bn, max_steps), pad_id, jnp.int32),
         lengths=jnp.zeros((bn,), jnp.int32),
@@ -90,8 +88,20 @@ def _decode(params, lora, cache, key_mask, first_logits, row_alive, rng,
         cache=cache,
     )
 
+
+def _decode_chunk(params, lora, state: _DecodeState, rng, step_end,
+                  *, cfg: ModelConfig, prompt_len: int, eos_ids, pad_id: int,
+                  temperature, top_p, lora_scale: float, attn_impl: str):
+    """Advance the decode loop up to ``step_end`` (traced) steps.
+
+    The full decode is dispatched as several donated chunks rather than one
+    device program: a 1200-step loop is minutes of uninterruptible device
+    time, and the host-side gap between chunks is where early exit happens —
+    once every row has hit EOS the remaining chunks are never dispatched (the
+    fixed-shape analogue of continuous batching draining its tail)."""
+
     def cond(s: _DecodeState):
-        return (s.step < max_steps) & ~jnp.all(s.done)
+        return (s.step < step_end) & ~jnp.all(s.done)
 
     def body(s: _DecodeState) -> _DecodeState:
         tok = sample(jax.random.fold_in(rng, s.step), s.logits, temperature, top_p)
@@ -117,8 +127,7 @@ def _decode(params, lora, cache, key_mask, first_logits, row_alive, rng,
             key_mask=key_mask, logits=next_logits[:, 0], cache=cache,
         )
 
-    final = jax.lax.while_loop(cond, body, state)
-    return final.out, final.lengths
+    return jax.lax.while_loop(cond, body, state)
 
 
 class GenerationEngine:
@@ -139,6 +148,7 @@ class GenerationEngine:
         lora_scale: float = 1.0,
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "reference",
+        decode_chunk: int = 128,
     ):
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
@@ -147,6 +157,7 @@ class GenerationEngine:
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale
+        self.decode_chunk = decode_chunk
 
         self._prefill = jax.jit(
             partial(
@@ -155,16 +166,20 @@ class GenerationEngine:
                 attn_impl=attn_impl,
             )
         )
-        # n and max_steps are static (shape-determining); temperature/top_p traced
-        self._decode = jax.jit(
+        # n and max_steps are static (shape-determining)
+        self._decode_init = jax.jit(
+            partial(_decode_init, pad_id=self.pad_id),
+            static_argnames=("n", "max_steps"),
+            # no cache donation: the candidate fan-out (jnp.repeat to B·n
+            # rows) allocates fresh buffers the prefill cache can't alias
+        )
+        # state is donated: each chunk updates the multi-GB cache in place
+        self._decode_chunk = jax.jit(
             partial(
-                _decode, cfg=cfg, prompt_len=max_prompt_tokens,
+                _decode_chunk, cfg=cfg, prompt_len=max_prompt_tokens,
                 pad_id=self.pad_id, lora_scale=lora_scale, attn_impl=attn_impl,
             ),
-            static_argnames=("n", "max_steps"),
-            # no cache donation: the candidate fan-out (jnp.repeat to B·n rows)
-            # allocates fresh loop-carried buffers, so the prefill cache can
-            # never alias them
+            donate_argnames=("state",),
         )
 
     def generate(
@@ -184,12 +199,21 @@ class GenerationEngine:
             params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
         )
         row_alive = jnp.asarray(prompt_mask).sum(axis=-1) > 0
-        out, lengths = self._decode(
-            params, lora, cache, key_mask, last_logits, row_alive, rng,
-            n=sampling.n, max_steps=max_steps, eos_ids=self.eos_ids,
-            temperature=jnp.asarray(sampling.temperature, jnp.float32),
-            top_p=jnp.asarray(sampling.top_p, jnp.float32),
+        state = self._decode_init(
+            cache, key_mask, last_logits, row_alive,
+            n=sampling.n, max_steps=max_steps,
         )
-        out = np.asarray(out).reshape(b, sampling.n, max_steps)
-        lengths = np.asarray(lengths).reshape(b, sampling.n)
+        temperature = jnp.asarray(sampling.temperature, jnp.float32)
+        top_p = jnp.asarray(sampling.top_p, jnp.float32)
+        steps_done = 0
+        while steps_done < max_steps:
+            steps_done = min(steps_done + self.decode_chunk, max_steps)
+            state = self._decode_chunk(
+                params, lora, state, rng, jnp.asarray(steps_done, jnp.int32),
+                eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
+            )
+            if bool(np.asarray(state.done).all()):
+                break
+        out = np.asarray(state.out).reshape(b, sampling.n, max_steps)
+        lengths = np.asarray(state.lengths).reshape(b, sampling.n)
         return GenerationResult(tokens=out, lengths=lengths)

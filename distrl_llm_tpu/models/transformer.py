@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from distrl_llm_tpu.models.configs import ModelConfig
-from distrl_llm_tpu.ops.attention import attention, causal_padding_mask
+from distrl_llm_tpu.ops.attention import attention, attention_cached, causal_padding_mask
 from distrl_llm_tpu.ops.linear import linear, lora_delta
 
 Params = dict[str, Any]
@@ -68,7 +68,7 @@ def _layer(
     x: jax.Array,  # [B, S, D]
     p: Params,  # one layer's params (leading L axis already sliced off)
     lora: Params | None,
-    cache_k: jax.Array | None,  # [B, Smax, K, hd]
+    cache_k: jax.Array | None,  # [B, K, hd, Smax] — S minormost (attention_cached)
     cache_v: jax.Array | None,
     *,
     cfg: ModelConfig,
@@ -88,13 +88,13 @@ def _layer(
     k = apply_rope(k, cos, sin)
 
     if cache_k is not None:
-        cache_k = jax.lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, cache_offset, 0, 0))
-        cache_v = jax.lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, cache_offset, 0, 0))
-        k_att, v_att = cache_k, cache_v
+        k_t = k.astype(cache_k.dtype).transpose(0, 2, 3, 1)  # [B, K, hd, S]
+        v_t = v.astype(cache_v.dtype).transpose(0, 2, 3, 1)
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k_t, (0, 0, 0, cache_offset))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v_t, (0, 0, 0, cache_offset))
+        att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
     else:
-        k_att, v_att = k, v
-
-    att = attention(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask, impl=attn_impl)
+        att = attention(q, k, v, mask, impl=attn_impl)
     att = att.reshape(b, s, cfg.q_dim)
     x = x + _proj(att, p, lora, "wo", "bo", lora_scale)
 
@@ -114,7 +114,7 @@ def forward(
     positions: jax.Array | None = None,  # [B, S] absolute positions
     lora: Params | None = None,
     lora_scale: float = 1.0,
-    kv_cache: Params | None = None,  # {"k","v": [L, B, Smax, K, hd]}
+    kv_cache: Params | None = None,  # {"k","v": L-tuples of [B, K, hd, Smax]}
     cache_offset: jax.Array | int = 0,
     remat: bool = False,
     attn_impl: str = "reference",
@@ -123,7 +123,9 @@ def forward(
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
     Without a cache this is the training/prefill path (causal over the input);
-    with a cache, queries attend to all cache keys marked valid by
+    with a cache (per-layer tuples from init_kv_cache — NOT a stacked array;
+    the cached path also always uses attention_cached, ignoring ``attn_impl``),
+    queries attend to all cache keys marked valid by
     ``attention_mask`` (length Smax) and new K/V are written at
     ``cache_offset``. Contract: ``cache_offset + S <= Smax`` — the engine sizes
     caches as prompt+max_tokens so this holds by construction; writes past
@@ -131,7 +133,7 @@ def forward(
     """
     b, s = input_ids.shape
     if kv_cache is not None and isinstance(cache_offset, int):
-        smax = kv_cache["k"].shape[2]
+        smax = kv_cache["k"][0].shape[-1]
         if cache_offset + s > smax:
             raise ValueError(
                 f"KV cache overflow: offset {cache_offset} + seq {s} > capacity {smax}"
@@ -143,7 +145,7 @@ def forward(
 
     x = jnp.take(params["embed"], input_ids, axis=0)
 
-    sk = kv_cache["k"].shape[2] if kv_cache is not None else s
+    sk = kv_cache["k"][0].shape[-1] if kv_cache is not None else s
     if attention_mask is None:
         attention_mask = jnp.ones((b, sk), dtype=jnp.int32)
     mask = causal_padding_mask(attention_mask, q_len=s, q_offset=cache_offset)
@@ -159,23 +161,39 @@ def forward(
         attn_impl=attn_impl,
     )
 
-    def scan_body(carry, xs):
-        p, lora_p, ck, cv = xs
-        y, ck, cv = layer_fn(carry, p, lora_p, ck, cv)
-        return y, (ck, cv)
+    xs = (params["layers"], lora["layers"] if lora is not None else None)
 
-    if remat:
-        scan_body = jax.checkpoint(
-            scan_body, policy=jax.checkpoint_policies.nothing_saveable
-        )
+    if kv_cache is None:
+        def scan_body(x, xs):
+            p, lora_p = xs
+            y, _, _ = layer_fn(x, p, lora_p, None, None)
+            return y, None
 
-    xs = (
-        params["layers"],
-        lora["layers"] if lora is not None else None,
-        kv_cache["k"] if kv_cache is not None else None,
-        kv_cache["v"] if kv_cache is not None else None,
-    )
-    x, (new_k, new_v) = jax.lax.scan(scan_body, x, xs)
+        if remat:
+            scan_body = jax.checkpoint(
+                scan_body, policy=jax.checkpoint_policies.nothing_saveable
+            )
+        x, _ = jax.lax.scan(scan_body, x, xs)
+        new_k = new_v = None
+    else:
+        # UNROLLED layer loop over PER-LAYER cache buffers. Carrying a stacked
+        # [L, ...] cache through a lax.scan (as slice/update on the scan carry)
+        # defeats XLA's in-place buffer aliasing: the while-loop ping-pongs the
+        # whole cache, costing a full cache-sized HBM temp (~9 GB at the
+        # reference rollout volume, measured via compile memory_analysis).
+        # Separate per-layer carry leaves alias to zero temp bytes. Weight
+        # slices params["layers"][w][i] are static and fuse into their matmuls.
+        new_k, new_v = [], []
+        for i in range(cfg.num_layers):
+            p_i = jax.tree_util.tree_map(lambda w: w[i], params["layers"])
+            lora_i = (
+                jax.tree_util.tree_map(lambda w: w[i], lora["layers"])
+                if lora is not None else None
+            )
+            x, ck, cv = layer_fn(x, p_i, lora_i, kv_cache["k"][i], kv_cache["v"][i])
+            new_k.append(ck)
+            new_v.append(cv)
+        new_k, new_v = tuple(new_k), tuple(new_v)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if logits_slice is not None:
@@ -225,5 +243,16 @@ def init_params(
 def init_kv_cache(
     cfg: ModelConfig, batch: int, max_seq: int, dtype=jnp.bfloat16
 ) -> Params:
-    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    """Per-layer tuples of [B, K, hd, Smax], S minormost.
+
+    Two deliberate choices, both required for the decode loop to update the
+    cache in place (zero HBM temps, verified with compile memory_analysis):
+    separate per-layer buffers (a stacked [L, ...] array carried through a
+    scan gets ping-pong-buffered by XLA), and S as the minormost dim (the
+    layout XLA assigns the loop carry; any other logical order inserts
+    cache-sized layout-conversion copies)."""
+    shape = (batch, cfg.num_kv_heads, cfg.head_dim, max_seq)
+    return {
+        "k": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+        "v": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+    }

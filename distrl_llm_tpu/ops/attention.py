@@ -33,21 +33,15 @@ def attention_reference(
     q: jax.Array,  # [B, Sq, H, D]
     k: jax.Array,  # [B, Sk, K, D]
     v: jax.Array,  # [B, Sk, K, D]
-    mask: jax.Array | None,  # broadcastable to [B, H, Sq, Sk]; True = attend
+    mask: jax.Array | None,  # [B, 1|H, Sq, Sk]; True = attend
     scale: float | None = None,
 ) -> jax.Array:
-    """Plain-XLA masked attention. Softmax in f32 regardless of input dtype."""
-    num_groups = q.shape[2] // k.shape[2]
-    k = repeat_kv(k, num_groups)
-    v = repeat_kv(v, num_groups)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    logits = logits * scale
-    if mask is not None:
-        logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    """Plain-XLA masked attention. Softmax in f32 regardless of input dtype.
+
+    GQA contracts the grouped query heads [B, Sq, K, G, D] directly against the
+    K kv heads — never materializing ``repeat_kv``, which would multiply KV
+    HBM traffic by G (7× for Qwen2.5-0.5B) in the decode hot loop."""
+    return _gqa_attention(q, k, v, mask, scale, kv_subscript="bskd", kv_heads_axis=2)
 
 
 def causal_padding_mask(
@@ -67,6 +61,46 @@ def causal_padding_mask(
     causal = k_pos <= q_pos  # [Sq, Sk]
     pad = attention_mask[:, None, None, :].astype(bool)  # [B, 1, 1, Sk]
     return causal[None, None, :, :] & pad
+
+
+def attention_cached(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, K, D, Sk] — decode-cache layout, S minormost
+    v: jax.Array,  # [B, K, D, Sk]
+    mask: jax.Array | None,  # [B, 1|H, Sq, Sk]; True = attend
+    scale: float | None = None,
+) -> jax.Array:
+    """Masked GQA attention against a [B, K, D, S] KV cache.
+
+    The cache keeps S as its minormost dim — the layout XLA's layout
+    assignment picks for the decode while-loop. Storing the cache any other
+    way makes XLA insert full-cache conversion copies inside the loop (two
+    extra cache-sized HBM temps that break donation aliasing)."""
+    return _gqa_attention(q, k, v, mask, scale, kv_subscript="bkds", kv_heads_axis=1)
+
+
+def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str, kv_heads_axis: int):
+    """Shared GQA attention body; only the kv einsum layout differs between
+    the training ([B,S,K,D]) and decode-cache ([B,K,D,S]) paths."""
+    b, sq, h, d = q.shape
+    kh = k.shape[kv_heads_axis]
+    g = h // kh
+    if scale is None:
+        scale = d**-0.5
+    qg = q.reshape(b, sq, kh, g, d)
+    logits = jnp.einsum(
+        f"bqkgd,{kv_subscript}->bkgqs", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if mask is not None:
+        if mask.shape[1] == 1:  # head-agnostic mask
+            m = mask[:, :, None]  # [B, 1, 1, Sq, Sk]
+        else:
+            m = mask.reshape(b, kh, g, *mask.shape[2:])
+        logits = jnp.where(m, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    out = jnp.einsum(f"bkgqs,{kv_subscript}->bqkgd", probs, v)
+    return out.reshape(b, sq, h, d)
 
 
 _flash_fallback_warned = False

@@ -27,5 +27,9 @@ def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
 
 def lora_delta(x: jax.Array, a: jax.Array, b: jax.Array, scale) -> jax.Array:
     """LoRA contribution (x @ A) @ B · scale, computed in the activation dtype.
-    A: [in, r], B: [r, out], scale = alpha / r (rsLoRA off — helper.py:44)."""
+    A: [in, r], B: [r, out], scale = alpha / r (rsLoRA off — helper.py:44).
+    Factors stored at higher precision (f32 LoRA over a bf16 base) are cast to
+    the activation dtype so the delta never widens the residual stream."""
+    a = a.astype(x.dtype)
+    b = b.astype(x.dtype)
     return (x @ a @ b) * jnp.asarray(scale, dtype=x.dtype)

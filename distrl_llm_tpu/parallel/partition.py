@@ -14,7 +14,8 @@ Layout conventions (models/transformer.py):
   lm_head:     [D, V] V over "tp".
   lora a/b:    factor dims follow the base weight's sharded dim; the rank dim
                is always replicated.
-  kv cache:    [L, B, S, K, hd] batch over "dp", kv heads over "tp".
+  kv cache:    per-layer tuples of [B, K, hd, S]; batch over "dp", kv heads
+               over "tp" (build it with models.init_kv_cache).
 """
 
 from __future__ import annotations
@@ -51,8 +52,8 @@ def _spec_for_path(path: tuple[str, ...], ndim: int) -> P:
         return P(None, "tp", "fsdp")
     if name.startswith("b"):  # projection biases [L, out]
         return P(None, "tp") if name in ("bq", "bk", "bv") else P(None, "fsdp")
-    if name in ("k", "v"):  # kv cache [L, B, S, K, hd]
-        return P(None, "dp", None, "tp", None)
+    if name in ("k", "v"):  # kv cache: per-layer [B, K, hd, S] (S minormost)
+        return P("dp", "tp", None, None)
     return P(*([None] * ndim))
 
 
@@ -60,6 +61,8 @@ def _tree_specs(tree: Params) -> Params:
     def walk(path: tuple[str, ...], node):
         if isinstance(node, dict):
             return {k: walk(path + (k,), v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):  # per-layer cache tuples
+            return type(node)(walk(path, v) for v in node)
         if node is None:
             return None
         return _spec_for_path(path, getattr(node, "ndim", 0))

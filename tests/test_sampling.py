@@ -76,3 +76,49 @@ class TestSample:
         lg = logits_for_probs([0.25, 0.25, 0.25, 0.25])
         out = np.asarray(top_p_filter(lg, 0.5))
         assert (out > NEG_INF).sum() == 2
+
+
+class TestTopPBisect:
+    """The sort-free filter must agree with the exact sort-based filter away
+    from exact probability ties at the nucleus boundary."""
+
+    def test_superset_of_sort_filter_with_negligible_extra_mass(self):
+        # Guaranteed contract: bisect never drops a token the exact filter
+        # keeps (its kept mass is always >= top_p and both sets are prob-rank
+        # prefixes); extra tokens sit within the bisection window of the
+        # boundary, so their total mass is tiny.
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import top_p_filter, top_p_filter_bisect
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(8, 512)) * 3.0, jnp.float32)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        for p in (0.1, 0.5, 0.95, 0.999):
+            exact = np.asarray(top_p_filter(logits, p)) > -1e29
+            bisect = np.asarray(top_p_filter_bisect(logits, p)) > -1e29
+            assert (bisect | exact == bisect).all(), "dropped an exact-kept token"
+            extra_mass = (probs * (bisect & ~exact)).sum(-1)
+            assert (extra_mass < 5e-3).all()
+
+    def test_kept_mass_at_least_top_p(self):
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import top_p_filter_bisect
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(16, 1024)), jnp.float32)
+        p = 0.9
+        kept = np.asarray(top_p_filter_bisect(logits, p)) > -1e29
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        mass = (probs * kept).sum(-1)
+        assert (mass >= p - 1e-6).all()
+
+    def test_top_p_1_keeps_everything(self):
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import top_p_filter_bisect
+
+        logits = jnp.asarray([[0.0, 1.0, -2.0, 3.0]], jnp.float32)
+        kept = np.asarray(top_p_filter_bisect(logits, 1.0)) > -1e29
+        assert kept.all()
