@@ -116,6 +116,10 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}
+    # attention implementation for learner/prefill forwards:
+    # "reference" (XLA softmax) or "flash" (Pallas blockwise kernel, TPU only;
+    # falls back with a warning elsewhere) — ops/flash_attention.py
+    attn_impl: str = "reference"
     write_adapter_file: bool = False  # artifact-parity adapter writer
     profile_dir: str | None = None  # jax.profiler trace destination
 

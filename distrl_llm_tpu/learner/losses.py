@@ -41,6 +41,7 @@ def answer_logprobs(
     lora_scale: float = 1.0,
     remat: bool = True,
     attn_impl: str = "reference",
+    attn_mesh=None,
 ) -> jax.Array:
     """Per-token logprobs of the answer under the current policy, [B, T] f32.
 
@@ -57,7 +58,8 @@ def answer_logprobs(
     pred, _ = forward(
         params, cfg, full_ids,
         attention_mask=full_mask, lora=lora, lora_scale=lora_scale,
-        remat=remat, attn_impl=attn_impl, logits_slice=(p - 1, t),
+        remat=remat, attn_impl=attn_impl, attn_mesh=attn_mesh,
+        logits_slice=(p - 1, t),
     )  # [B, T, V]
     gathered = jnp.take_along_axis(pred, answer_ids[..., None], axis=-1)[..., 0]
     return gathered - jax.nn.logsumexp(pred, axis=-1)

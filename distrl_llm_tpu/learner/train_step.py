@@ -49,13 +49,13 @@ class UpdateBatch(NamedTuple):
 def _microbatch_loss(
     lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
-    attn_impl: str,
+    attn_impl: str, attn_mesh=None,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight."""
     logps = answer_logprobs(
         base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
         mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, attn_mesh=attn_mesh,
     )
     loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
     loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
@@ -85,6 +85,7 @@ def make_train_step(
     skip_semantics: str = "all_zero",
     remat: bool = True,
     attn_impl: str = "reference",
+    attn_mesh=None,
     donate: bool = True,
 ) -> Callable:
     """Build the jitted train step.
@@ -103,6 +104,7 @@ def make_train_step(
         skip_semantics=skip_semantics,
         remat=remat,
         attn_impl=attn_impl,
+        attn_mesh=attn_mesh,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch):

@@ -69,7 +69,9 @@ def merge_lora(base: Params, lora: Params, alpha: float) -> Params:
     merged_layers = dict(base["layers"])
     for target, ab in lora["layers"].items():
         w = base["layers"][target]
-        if hasattr(w, "matmul"):
+        from distrl_llm_tpu.ops.quant import is_quantized
+
+        if is_quantized(w):
             raise NotImplementedError("cannot merge LoRA into quantized base weights")
         delta = jnp.einsum("lir,lro->lio", ab["a"].astype(w.dtype), ab["b"].astype(w.dtype))
         merged_layers[target] = w + delta * scale

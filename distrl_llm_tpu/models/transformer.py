@@ -78,6 +78,8 @@ def _layer(
     cache_offset: jax.Array | int,
     lora_scale: float,
     attn_impl: str,
+    attn_mesh=None,
+    key_valid: jax.Array | None = None,  # [B, S] for the ring path
 ):
     b, s, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -92,7 +94,19 @@ def _layer(
         v_t = v.astype(cache_v.dtype).transpose(0, 2, 3, 1)
         cache_k = jax.lax.dynamic_update_slice(cache_k, k_t, (0, 0, 0, cache_offset))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v_t, (0, 0, 0, cache_offset))
-        att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
+        if attn_impl == "flash" and isinstance(cache_offset, int) and cache_offset == 0 and s > 1:
+            # prefill: the cache holds nothing beyond the prompt being
+            # written, so attention is plain self-attention over the input —
+            # run the flash kernel on the fresh k/v and only WRITE the cache
+            att = attention(q, k, v, mask[..., :s], impl="flash")
+        else:
+            att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
+    elif attn_impl == "ring" and attn_mesh is not None:
+        # sequence-parallel training path: causal+padding semantics come from
+        # global positions inside the ring, not from the materialized mask
+        from distrl_llm_tpu.ops.ring_attention import ring_attention
+
+        att = ring_attention(q, k, v, key_valid, mesh=attn_mesh)
     else:
         att = attention(q, k, v, mask, impl=attn_impl)
     att = att.reshape(b, s, cfg.q_dim)
@@ -118,6 +132,7 @@ def forward(
     cache_offset: jax.Array | int = 0,
     remat: bool = False,
     attn_impl: str = "reference",
+    attn_mesh=None,  # jax Mesh with an "sp" axis; required for attn_impl="ring"
     logits_slice: tuple[int, int] | None = None,  # (start, length) along seq
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
@@ -159,6 +174,8 @@ def forward(
         cache_offset=cache_offset,
         lora_scale=lora_scale,
         attn_impl=attn_impl,
+        attn_mesh=attn_mesh,
+        key_valid=attention_mask,
     )
 
     xs = (params["layers"], lora["layers"] if lora is not None else None)

@@ -33,7 +33,8 @@ _COL = {"wq", "wk", "wv", "w_gate", "w_up"}
 _ROW = {"wo", "w_down"}
 
 
-def _spec_for_path(path: tuple[str, ...], ndim: int) -> P:
+def _spec_for_path(path: tuple[str, ...], shape: tuple[int, ...]) -> P:
+    ndim = len(shape)
     name = path[-1]
     if name in ("a", "b"):  # LoRA factor: path is (..., "layers", target, "a"|"b")
         target = path[-2]
@@ -54,6 +55,20 @@ def _spec_for_path(path: tuple[str, ...], ndim: int) -> P:
         return P(None, "tp") if name in ("bq", "bk", "bv") else P(None, "fsdp")
     if name in ("k", "v"):  # kv cache: per-layer [B, K, hd, S] (S minormost)
         return P("dp", "tp", None, None)
+    if name in ("q", "scale") and len(path) >= 2 and path[-2] in (_COL | _ROW):
+        # quantized weight container (ops/quant.py): q [L, G, g, out],
+        # scale [L, G, 1, out]. The base weight's input-dim sharding goes on
+        # G when there are multiple groups (blockwise int4 — contiguous groups
+        # per shard, so the dequant reshape [G, g] → [G·g] stays local); with
+        # a single group (per-column int8, G=1) it goes on g for q and is
+        # dropped for scale (whose g dim is 1).
+        target = path[-2]
+        in_ax, out_ax = ("fsdp", "tp") if target in _COL else ("tp", "fsdp")
+        if shape[1] > 1:  # [L, G>1, ...]: shard the group axis
+            return P(None, in_ax, None, out_ax)
+        if name == "q":
+            return P(None, None, in_ax, out_ax)
+        return P(None, None, None, out_ax)  # scale [L, 1, 1, out]
     return P(*([None] * ndim))
 
 
@@ -65,7 +80,7 @@ def _tree_specs(tree: Params) -> Params:
             return type(node)(walk(path, v) for v in node)
         if node is None:
             return None
-        return _spec_for_path(path, getattr(node, "ndim", 0))
+        return _spec_for_path(path, tuple(getattr(node, "shape", ())))
 
     return walk((), tree)
 

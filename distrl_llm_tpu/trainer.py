@@ -39,6 +39,7 @@ from distrl_llm_tpu.learner.optim import make_optimizer
 from distrl_llm_tpu.learner.train_step import make_train_step, prepare_update_batch
 from distrl_llm_tpu.metrics import MetricsSink, PhaseTimer, make_sink
 from distrl_llm_tpu.models.lora import init_lora_params, lora_scale
+from distrl_llm_tpu.ops.quant import default_group_size, quant_bits_for, quantize_params
 from distrl_llm_tpu.parallel.mesh import RoleMeshes, build_role_meshes
 from distrl_llm_tpu.rewards import RewardComputer
 from distrl_llm_tpu.shaping import flatten_for_update, shape_rewards, topk_filter
@@ -110,6 +111,10 @@ class Trainer:
             skip_semantics=(
                 "all_zero" if config.skip_all_zero_reward_batches else "any_zero"
             ),
+            attn_impl=config.attn_impl,
+            attn_mesh=meshes.learner if (
+                config.attn_impl == "ring" and meshes is not None
+            ) else None,
         )
 
         self.total_batch_steps = 0
@@ -156,6 +161,14 @@ class Trainer:
             tokenizer = load_tokenizer(path)
         meshes = build_role_meshes(config.mesh)
         params, model_cfg = load_pretrained(path, dtype=np.dtype(config.dtype))
+        bits = quant_bits_for(config.base_quant)
+        if bits is not None:
+            # N4 equivalent of the reference's 4-bit base (LOAD_IN_4BIT,
+            # distributed_actor.py:17): quantize the frozen projections before
+            # sharding so shards ship at int width
+            params = quantize_params(
+                params, bits=bits, group_size=default_group_size(bits)
+            )
         params = shard_tree(params, meshes.rollout, param_specs(params))
         eos = [tokenizer.eos_token_id]
         extra_eos = getattr(tokenizer, "eos_token_ids", None)
@@ -168,6 +181,7 @@ class Trainer:
             eos_token_ids=eos,
             pad_token_id=tokenizer.pad_token_id or tokenizer.eos_token_id,
             lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+            attn_impl=config.attn_impl,
         )
         return cls(
             train_dataset, test_dataset, reward_function, config,

@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel (ring attention) chips")
     p.add_argument("--fsdp", type=int, default=1, help="learner parameter sharding")
     p.add_argument("--base_quant", type=str, default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--attn_impl", type=str, default="reference",
+                   choices=["reference", "flash", "ring"])
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--seed", type=int, default=3407)
     p.add_argument("--checkpoint_dir", type=str, default=None)
@@ -105,7 +107,10 @@ def run_smoke(config: TrainConfig) -> None:
         number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
         eval_every=0, save_every=0, metrics_backend="null",
         max_lora_rank=4, lora_alpha=8, lr=1e-3,
-        mesh=MeshConfig(number_of_actors=1, number_of_learners=1),
+        mesh=MeshConfig(
+            number_of_actors=1, number_of_learners=1,
+            tp=config.mesh.tp, sp=config.mesh.sp, fsdp=config.mesh.fsdp,
+        ),
     )
     tokenizer = CharTokenizer(TINY.vocab_size)
     problems = [f"What is {i}+{i}?" for i in range(8)]
@@ -116,6 +121,13 @@ def run_smoke(config: TrainConfig) -> None:
     )
     test = {k: v[:4] for k, v in train.items()}
     base = init_params(jax.random.PRNGKey(0), TINY)
+    if config.base_quant != "none":
+        from distrl_llm_tpu.ops.quant import (
+            default_group_size, quant_bits_for, quantize_params,
+        )
+
+        bits = quant_bits_for(config.base_quant)
+        base = quantize_params(base, bits=bits, group_size=16)
     engine = GenerationEngine(
         TINY,
         max_prompt_tokens=config.max_prompt_tokens,
@@ -124,10 +136,12 @@ def run_smoke(config: TrainConfig) -> None:
         pad_token_id=tokenizer.pad_token_id,
     )
     sink = MemorySink()
+    from distrl_llm_tpu.parallel.mesh import build_role_meshes
+
     trainer = Trainer(
         train, test, reward_function, config,
         tokenizer=tokenizer, engine=engine, base_params=base, model_cfg=TINY,
-        sink=sink,
+        meshes=build_role_meshes(config.mesh), sink=sink,
     )
     trainer.train()
     train_recs = [m for _, m in sink.records if "loss" in m]
