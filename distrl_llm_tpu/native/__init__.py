@@ -1,0 +1,12 @@
+"""Native (C++) host components.
+
+The TPU compute path is JAX/XLA/Pallas; the host-side runtime pieces that the
+reference delegates to native dependencies are C++ here:
+
+* ``native.tokenizer`` — byte-level BPE encode/decode (the Rust HF-tokenizers
+  equivalent, SURVEY §2b N7).
+* ``native.build`` — tiny build cache: compiles each .cc to a shared library
+  with g++ on first use and memoizes by source hash.
+"""
+
+from distrl_llm_tpu.native.build import build_library, native_available  # noqa: F401

@@ -1,0 +1,56 @@
+"""Compile-on-first-use build cache for the C++ host components.
+
+pybind11 is not available in this environment, so native code exposes a plain
+C ABI and Python binds it with ctypes (environment constraint — see repo
+docs). Libraries are compiled with g++ into a per-source-hash cache dir, so
+editing a .cc transparently rebuilds and stale binaries are never loaded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+
+
+def native_available() -> bool:
+    return shutil.which("g++") is not None
+
+
+def _cache_dir() -> str:
+    d = os.environ.get(
+        "DISTRL_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "distrl_llm_tpu_native"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library(source_name: str) -> str:
+    """Compile csrc/<source_name> to a shared library; return its path.
+
+    Memoized by source content hash — a changed source compiles to a new
+    path, an unchanged one is reused across processes.
+    """
+    src = os.path.join(_CSRC, source_name)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    stem = os.path.splitext(source_name)[0]
+    out = os.path.join(_cache_dir(), f"{stem}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    if not native_available():
+        raise RuntimeError("g++ not found; native components unavailable")
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    os.replace(tmp, out)  # atomic vs concurrent builders
+    return out

@@ -1,0 +1,319 @@
+// Byte-level BPE tokenizer — C++ core with a plain C ABI (ctypes-bound).
+//
+// TPU-native parity component for the reference's Rust HF tokenizer
+// (SURVEY §2b N7: `load_correct_tokenizer` at train_distributed.py:46,
+// `batch_encode_plus` at distributed_actor.py:217/:222). The host-side
+// tokenize/decode of every rollout runs here instead of through Python
+// string code. Rust is not available in this environment, so the native
+// component is C++ (SURVEY §2b note).
+//
+// Model format: the Python wrapper (distrl_llm_tpu/native/tokenizer.py)
+// converts an HF tokenizer.json (unicode-remapped byte-level tokens) into a
+// raw-bytes serialization:
+//
+//   line 0:            V M S            (vocab size, merge count, special count)
+//   next V lines:      <hex-bytes>      (token id = line index)
+//   next M lines:      <hexL> <hexR>    (merge rank = line index)
+//   next S lines:      <id>             (special token ids; matched verbatim
+//                                        before pretokenization)
+//
+// Algorithm parity with the byte-level BPE the Rust crate implements:
+//   1. split text on special tokens (longest match first);
+//   2. GPT-2-style pretokenization (contractions / letter runs / digit runs /
+//      punctuation runs, with a leading-space convention). "Letter" follows
+//      ASCII classes plus any byte >= 0x80 (UTF-8 continuation), an
+//      approximation of the \p{L} unicode classes that is exact for ASCII
+//      and groups multibyte scripts into runs;
+//   3. per pretoken, greedy lowest-rank pair merging over the merge table
+//      (with a pretoken result cache, as the Rust implementation keeps).
+//
+// Decode is id -> byte-sequence concatenation (skipping specials on request).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+#include <mutex>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<uint32_t, uint32_t>& p) const {
+    return (static_cast<size_t>(p.first) << 32) ^ p.second;
+  }
+};
+
+struct Tokenizer {
+  std::vector<std::string> id_to_tok;                       // id -> raw bytes
+  std::unordered_map<std::string, uint32_t> tok_to_id;      // raw bytes -> id
+  std::unordered_map<std::pair<uint32_t, uint32_t>, uint32_t, PairHash>
+      merge_rank;                                           // (idL,idR) -> rank
+  std::unordered_map<std::pair<uint32_t, uint32_t>, uint32_t, PairHash>
+      merge_result;                                         // (idL,idR) -> id
+  std::vector<std::string> specials;                        // raw special strings
+  std::vector<uint32_t> special_ids;
+  std::unordered_map<std::string, std::vector<uint32_t>> cache;  // pretoken memo
+  std::mutex cache_mu;
+};
+
+bool is_ascii_letter(uint8_t b) {
+  return (b >= 'a' && b <= 'z') || (b >= 'A' && b <= 'Z');
+}
+bool is_letterish(uint8_t b) { return is_ascii_letter(b) || b >= 0x80; }
+bool is_digit(uint8_t b) { return b >= '0' && b <= '9'; }
+bool is_space(uint8_t b) { return b == ' ' || b == '\t' || b == '\n' || b == '\r'; }
+
+// GPT-2 pattern: 's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+
+std::vector<std::string> pretokenize(const std::string& text) {
+  std::vector<std::string> out;
+  size_t i = 0, n = text.size();
+  while (i < n) {
+    // contractions
+    if (text[i] == '\'' && i + 1 < n) {
+      size_t len = 0;
+      const char* two[] = {"'s", "'t", "'m", "'d"};
+      const char* three[] = {"'re", "'ve", "'ll"};
+      for (const char* c : three)
+        if (i + 3 <= n && text.compare(i, 3, c) == 0) len = 3;
+      if (!len)
+        for (const char* c : two)
+          if (i + 2 <= n && text.compare(i, 2, c) == 0) len = 2;
+      if (len) { out.emplace_back(text.substr(i, len)); i += len; continue; }
+    }
+    size_t start = i;
+    bool leading_space = false;
+    if (text[i] == ' ' && i + 1 < n &&
+        (is_letterish(text[i + 1]) || is_digit(text[i + 1]) ||
+         (!is_space(text[i + 1]) && text[i + 1] != ' '))) {
+      leading_space = true;
+      i++;
+    }
+    if (i < n && is_letterish(text[i])) {
+      while (i < n && is_letterish(text[i])) i++;
+      out.emplace_back(text.substr(start, i - start));
+      continue;
+    }
+    if (i < n && is_digit(text[i])) {
+      while (i < n && is_digit(text[i])) i++;
+      out.emplace_back(text.substr(start, i - start));
+      continue;
+    }
+    if (i < n && !is_space(text[i])) {  // punctuation run (apostrophes that
+      // did not start a contraction are ordinary punctuation, as in the
+      // greedy [^\s\p{L}\p{N}]+ alternative)
+      while (i < n && !is_space(text[i]) && !is_letterish(text[i]) &&
+             !is_digit(text[i]))
+        i++;
+      out.emplace_back(text.substr(start, i - start));
+      continue;
+    }
+    if (leading_space) { i = start; }  // space not followed by token content
+    // whitespace runs: \s+(?!\S) keeps trailing ws together; emit maximal run
+    // minus one if a non-space follows (that space prefixes the next token)
+    size_t ws_start = i;
+    while (i < n && is_space(text[i])) i++;
+    if (i < n && i - ws_start > 1 && text[i - 1] == ' ') {
+      out.emplace_back(text.substr(ws_start, i - ws_start - 1));
+      i--;  // final space joins the next pretoken
+    } else if (i > ws_start) {
+      out.emplace_back(text.substr(ws_start, i - ws_start));
+    }
+  }
+  return out;
+}
+
+std::vector<uint32_t> bpe_merge(Tokenizer* t, const std::string& piece) {
+  {
+    std::lock_guard<std::mutex> g(t->cache_mu);
+    auto it = t->cache.find(piece);
+    if (it != t->cache.end()) return it->second;
+  }
+  // initial symbols: single bytes (every byte has a vocab entry in byte-level BPE)
+  std::vector<uint32_t> ids;
+  ids.reserve(piece.size());
+  for (unsigned char b : piece) {
+    auto it = t->tok_to_id.find(std::string(1, b));
+    if (it == t->tok_to_id.end()) return {};  // malformed vocab: no byte fallback
+    ids.push_back(it->second);
+  }
+  while (ids.size() > 1) {
+    uint32_t best_rank = UINT32_MAX;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < ids.size(); i++) {
+      auto it = t->merge_rank.find({ids[i], ids[i + 1]});
+      if (it != t->merge_rank.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_i = i;
+      }
+    }
+    if (best_rank == UINT32_MAX) break;
+    uint32_t merged = t->merge_result[{ids[best_i], ids[best_i + 1]}];
+    ids[best_i] = merged;
+    ids.erase(ids.begin() + best_i + 1);
+  }
+  {
+    std::lock_guard<std::mutex> g(t->cache_mu);
+    if (t->cache.size() < (1u << 20)) t->cache.emplace(piece, ids);
+  }
+  return ids;
+}
+
+void encode_ordinary(Tokenizer* t, const std::string& text,
+                     std::vector<uint32_t>* out) {
+  for (const auto& piece : pretokenize(text)) {
+    auto whole = t->tok_to_id.find(piece);
+    if (whole != t->tok_to_id.end()) {
+      out->push_back(whole->second);
+      continue;
+    }
+    auto ids = bpe_merge(t, piece);
+    out->insert(out->end(), ids.begin(), ids.end());
+  }
+}
+
+int hexval(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+bool unhex(const std::string& h, std::string* out) {
+  if (h.size() % 2) return false;
+  out->clear();
+  out->reserve(h.size() / 2);
+  for (size_t i = 0; i < h.size(); i += 2) {
+    int a = hexval(h[i]), b = hexval(h[i + 1]);
+    if (a < 0 || b < 0) return false;
+    out->push_back(static_cast<char>((a << 4) | b));
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the serialized model (format in the header comment). Returns an
+// opaque handle or null on malformed input.
+void* bpe_create(const char* data, int64_t len) {
+  std::string s(data, static_cast<size_t>(len));
+  auto* t = new Tokenizer();
+  size_t pos = 0;
+  auto next_line = [&](std::string* line) -> bool {
+    if (pos >= s.size()) return false;
+    size_t e = s.find('\n', pos);
+    if (e == std::string::npos) e = s.size();
+    line->assign(s, pos, e - pos);
+    pos = e + 1;
+    return true;
+  };
+  std::string line;
+  if (!next_line(&line)) { delete t; return nullptr; }
+  long v = 0, m = 0, sp = 0;
+  if (sscanf(line.c_str(), "%ld %ld %ld", &v, &m, &sp) != 3 || v <= 0) {
+    delete t; return nullptr;
+  }
+  t->id_to_tok.resize(v);
+  for (long i = 0; i < v; i++) {
+    if (!next_line(&line)) { delete t; return nullptr; }
+    std::string raw;
+    if (!unhex(line, &raw)) { delete t; return nullptr; }
+    t->id_to_tok[i] = raw;
+    t->tok_to_id.emplace(raw, static_cast<uint32_t>(i));
+  }
+  for (long i = 0; i < m; i++) {
+    if (!next_line(&line)) { delete t; return nullptr; }
+    size_t sep = line.find(' ');
+    if (sep == std::string::npos) { delete t; return nullptr; }
+    std::string l, r;
+    if (!unhex(line.substr(0, sep), &l) || !unhex(line.substr(sep + 1), &r)) {
+      delete t; return nullptr;
+    }
+    auto li = t->tok_to_id.find(l), ri = t->tok_to_id.find(r),
+         mi = t->tok_to_id.find(l + r);
+    if (li == t->tok_to_id.end() || ri == t->tok_to_id.end() ||
+        mi == t->tok_to_id.end())
+      continue;  // merge over tokens pruned from the vocab
+    std::pair<uint32_t, uint32_t> key{li->second, ri->second};
+    if (!t->merge_rank.count(key)) {
+      t->merge_rank.emplace(key, static_cast<uint32_t>(i));
+      t->merge_result.emplace(key, mi->second);
+    }
+  }
+  for (long i = 0; i < sp; i++) {
+    if (!next_line(&line)) { delete t; return nullptr; }
+    long id = strtol(line.c_str(), nullptr, 10);
+    if (id < 0 || id >= v) { delete t; return nullptr; }
+    t->special_ids.push_back(static_cast<uint32_t>(id));
+    t->specials.push_back(t->id_to_tok[id]);
+  }
+  return t;
+}
+
+void bpe_free(void* h) { delete static_cast<Tokenizer*>(h); }
+
+// Encode UTF-8 text. Special tokens in the text are matched verbatim.
+// Returns the number of ids produced (may exceed max_out; only max_out are
+// written), or -1 on error.
+int64_t bpe_encode(void* h, const char* text, int64_t len, int32_t* out,
+                   int64_t max_out) {
+  auto* t = static_cast<Tokenizer*>(h);
+  if (!t) return -1;
+  std::string s(text, static_cast<size_t>(len));
+  std::vector<uint32_t> ids;
+  size_t start = 0;
+  while (start < s.size()) {
+    // find earliest special-token occurrence (ties: longest special wins)
+    size_t best_pos = std::string::npos, best_len = 0;
+    uint32_t best_id = 0;
+    for (size_t k = 0; k < t->specials.size(); k++) {
+      size_t p = s.find(t->specials[k], start);
+      if (p == std::string::npos) continue;
+      if (p < best_pos ||
+          (p == best_pos && t->specials[k].size() > best_len)) {
+        best_pos = p;
+        best_len = t->specials[k].size();
+        best_id = t->special_ids[k];
+      }
+    }
+    if (best_pos == std::string::npos) {
+      encode_ordinary(t, s.substr(start), &ids);
+      break;
+    }
+    if (best_pos > start)
+      encode_ordinary(t, s.substr(start, best_pos - start), &ids);
+    ids.push_back(best_id);
+    start = best_pos + best_len;
+  }
+  int64_t n = static_cast<int64_t>(ids.size());
+  for (int64_t i = 0; i < n && i < max_out; i++)
+    out[i] = static_cast<int32_t>(ids[i]);
+  return n;
+}
+
+// Decode ids to UTF-8 bytes. skip_special drops special ids. Returns byte
+// count (may exceed max_out; only max_out bytes are written), or -1.
+int64_t bpe_decode(void* h, const int32_t* ids, int64_t n, int skip_special,
+                   char* out, int64_t max_out) {
+  auto* t = static_cast<Tokenizer*>(h);
+  if (!t) return -1;
+  std::string s;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t id = static_cast<uint32_t>(ids[i]);
+    if (id >= t->id_to_tok.size()) continue;
+    if (skip_special) {
+      bool is_sp = false;
+      for (uint32_t sid : t->special_ids)
+        if (sid == id) { is_sp = true; break; }
+      if (is_sp) continue;
+    }
+    s += t->id_to_tok[id];
+  }
+  int64_t bytes = static_cast<int64_t>(s.size());
+  if (bytes > 0) memcpy(out, s.data(), static_cast<size_t>(std::min(bytes, max_out)));
+  return bytes;
+}
+
+}  // extern "C"
