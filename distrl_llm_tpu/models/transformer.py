@@ -98,7 +98,10 @@ def _layer(
             # prefill: the cache holds nothing beyond the prompt being
             # written, so attention is plain self-attention over the input —
             # run the flash kernel on the fresh k/v and only WRITE the cache
-            att = attention(q, k, v, mask[..., :s], impl="flash")
+            att = attention(
+                q, k, v, mask[..., :s], impl="flash",
+                key_valid=key_valid[:, :s] if key_valid is not None else None,
+            )
         else:
             att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
     elif attn_impl == "ring" and attn_mesh is not None:
@@ -108,7 +111,7 @@ def _layer(
 
         att = ring_attention(q, k, v, key_valid, mesh=attn_mesh)
     else:
-        att = attention(q, k, v, mask, impl=attn_impl)
+        att = attention(q, k, v, mask, impl=attn_impl, key_valid=key_valid)
     att = att.reshape(b, s, cfg.q_dim)
     x = x + _proj(att, p, lora, "wo", "bo", lora_scale)
 
@@ -163,7 +166,15 @@ def forward(
     sk = kv_cache["k"][0].shape[-1] if kv_cache is not None else s
     if attention_mask is None:
         attention_mask = jnp.ones((b, sk), dtype=jnp.int32)
-    mask = causal_padding_mask(attention_mask, q_len=s, q_offset=cache_offset)
+    # ring and (uncached) flash consume the [B, S] validity vector directly —
+    # building the [B, 1, S, S] mask for them would cost O(S²) memory on
+    # exactly the long-context paths those kernels exist to avoid (it is also
+    # DCE'd under jit, but eager/non-jit callers would pay it)
+    needs_dense_mask = kv_cache is not None or attn_impl not in ("ring", "flash")
+    mask = (
+        causal_padding_mask(attention_mask, q_len=s, q_offset=cache_offset)
+        if needs_dense_mask else None
+    )
 
     layer_fn = partial(
         _layer,

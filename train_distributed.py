@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write_adapter_file", action="store_true",
                    help="export the reference's per-step adapter artifact")
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--top_p_exact", action="store_true",
+                   help="exact sort-based nucleus filter (reference vLLM "
+                        "semantics) instead of the fast bisection filter")
+    p.add_argument("--generation_timeout_s", type=float, default=0.0,
+                   help="hang detector on generation rounds (0 = off; "
+                        "reference parity value: 240)")
     p.add_argument("--checkpoint_path", type=str, default=None,
                    help="local HF checkpoint dir (defaults to --model as a path)")
     p.add_argument("--smoke", action="store_true",
@@ -155,6 +161,17 @@ def run_smoke(config: TrainConfig) -> None:
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
+
+    # Honor JAX_PLATFORMS even where a sitecustomize-registered TPU plugin
+    # stomps the env var (this environment's axon plugin does, and hangs when
+    # no chip is reachable — tests/conftest.py documents the same workaround).
+    import os
+
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
 
     if args.smoke:
         run_smoke(config)
