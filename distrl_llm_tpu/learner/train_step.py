@@ -151,6 +151,7 @@ def prepare_update_batch(
     max_prompt_tokens: int,
     max_new_tokens: int,
     micro_size: int,
+    mesh=None,
 ) -> UpdateBatch:
     """Host-side tokenize+pad to the fixed learner shapes.
 
@@ -158,6 +159,10 @@ def prepare_update_batch(
     prompts left-padded/truncated to max_prompt_tokens, answers right-padded/
     truncated to max_new_tokens. N is padded up to a multiple of micro_size
     with sample_mask-0 rows so the scan shape is static.
+
+    When ``mesh`` is given, every array is placed on it with the row dim over
+    "dp" — the learner-mesh equivalent of the reference dispatching chunks to
+    learner processes (distributed_trainer.py:312–327).
     """
     from distrl_llm_tpu.tokenizer import encode_fixed
 
@@ -176,7 +181,7 @@ def prepare_update_batch(
 
     sample_mask = np.zeros(n, np.float32)
     sample_mask[:n_real] = 1.0
-    return UpdateBatch(
+    batch = UpdateBatch(
         prompt_ids=jnp.asarray(pad_rows(prompt_ids)),
         prompt_mask=jnp.asarray(pad_rows(prompt_mask)),
         answer_ids=jnp.asarray(pad_rows(answer_ids)),
@@ -184,3 +189,15 @@ def prepare_update_batch(
         coeffs=jnp.asarray(pad_rows(np.asarray(coeffs, np.float32))),
         sample_mask=jnp.asarray(sample_mask),
     )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # rows shard over dp only if the count divides evenly; otherwise the
+        # batch stays replicated (tiny smoke runs) rather than failing
+        def place(x):
+            dp = mesh.shape["dp"]
+            spec = P("dp", *([None] * (x.ndim - 1))) if x.shape[0] % dp == 0 else P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        batch = jax.tree_util.tree_map(place, batch)
+    return batch

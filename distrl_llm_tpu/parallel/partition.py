@@ -99,6 +99,39 @@ def shard_tree(tree: Params, mesh: Mesh, specs: Params | None = None) -> Params:
     )
 
 
+def opt_state_specs(opt_state: Any) -> Any:
+    """Specs for an optimizer-state tree. Optax moment trees mirror the param
+    tree's dict structure (mu/nu hold the same nested dicts), so each state
+    leaf's DictKey path suffix IS a param path — route it through the same
+    ``_spec_for_path`` rules. Leaves whose shape no longer matches the rule
+    (step counts, blockwise-quantized flat payloads) are replicated.
+
+    Needed because ``jit(optimizer.init)`` does NOT propagate input shardings:
+    init only uses input *shapes*, so the compiled program has no array inputs
+    and its outputs land on the default device."""
+    from jax.tree_util import DictKey
+
+    def spec_for(path, leaf):
+        ndim = len(getattr(leaf, "shape", ()))
+        names = tuple(k.key for k in path if isinstance(k, DictKey))
+        if names:
+            s = _spec_for_path(names, tuple(leaf.shape))
+            if len(s) == ndim:
+                return s
+        return P(*([None] * ndim))
+
+    return jax.tree_util.tree_map_with_path(spec_for, opt_state)
+
+
+def shard_opt_state(opt_state: Any, mesh: Mesh) -> Any:
+    """Place optimizer state on ``mesh``, moments sharded like their params
+    (explicit FSDP sharding of learner state — SURVEY §2c)."""
+    specs = opt_state_specs(opt_state)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), opt_state, specs
+    )
+
+
 def batch_spec() -> P:
     """Activations/batch inputs: leading dim over dp."""
     return P("dp")

@@ -51,6 +51,19 @@ log = logging.getLogger(__name__)
 RewardFn = Callable[[Sequence[str], Sequence[str]], np.ndarray]
 
 
+class StaleWeightsError(RuntimeError):
+    """The rollout mesh holds an adapter older than the learner's — the race
+    the reference structurally prevents with its synchronous barrier and we
+    detect with asserted weight-version counters (SURVEY §5 race detection)."""
+
+
+class EngineHangError(RuntimeError):
+    """A generation round exceeded ``generation_timeout_s`` — the hang
+    detector matching the reference's ``ray.get(timeout=240)``
+    (distributed_trainer.py:200). The trainer checkpoints before raising;
+    restart with ``resume=True`` to continue from the last completed step."""
+
+
 class Trainer:
     """Owns the episode/batch loop. Heavy pieces (tokenizer, base params,
     engine, meshes) are injectable so the loop tests with fakes (SURVEY §4
@@ -69,6 +82,7 @@ class Trainer:
         base_params,
         model_cfg,
         meshes: RoleMeshes | None = None,
+        base_params_learner=None,
         sink: MetricsSink | None = None,
         reward_computer: RewardComputer | None = None,
     ):
@@ -79,6 +93,13 @@ class Trainer:
         self.tokenizer = tokenizer
         self.engine = engine
         self.base_params = base_params
+        # the learner's copy of the frozen base: resident on the learner
+        # submesh so the train step never touches rollout devices (the
+        # reference's per-worker model load, distributed_actor.py:58); with
+        # timeshared roles both names alias one tree.
+        self.base_params_learner = (
+            base_params_learner if base_params_learner is not None else base_params
+        )
         self.model_cfg = model_cfg
         self.meshes = meshes
         self.sink = sink
@@ -102,6 +123,13 @@ class Trainer:
         )
         self.optimizer = make_optimizer(config.lr, use_8bit=config.optimizer_8bit)
         self.opt_state = self.optimizer.init(self.lora)
+        if meshes is not None:
+            # adapter + optimizer state are LEARNER-mesh residents with
+            # explicit shardings (FSDP sharding of learner state, SURVEY §2c)
+            from distrl_llm_tpu.parallel.partition import shard_opt_state, shard_tree
+
+            self.lora = shard_tree(self.lora, meshes.learner)
+            self.opt_state = shard_opt_state(self.opt_state, meshes.learner)
         self.train_step = make_train_step(
             model_cfg,
             learner_type=config.learner,
@@ -120,14 +148,26 @@ class Trainer:
         self.total_batch_steps = 0
         self.total_samples_processed = 0
         self.episode = 0
+        self.batch_in_episode = 0  # mid-episode resume cursor (SURVEY §5)
         self.weight_version = 0  # incremented per optimizer step
-        self._rollout_weight_version = -1  # last version the engine sampled with
+        self._rollout_weight_version = -1  # version resident on the rollout mesh
+
+        self.profiler = None
+        if config.profile_dir:
+            from distrl_llm_tpu.metrics import TraceProfiler
+
+            self.profiler = TraceProfiler(
+                config.profile_dir,
+                start_step=config.profile_start_step,
+                num_steps=config.profile_num_steps,
+            )
 
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
             self.ckpt = CheckpointManager(config.checkpoint_dir)
             if config.resume:
                 self._try_resume()
+        self._push_weights()
 
     # ------------------------------------------------------------------ setup
 
@@ -169,7 +209,15 @@ class Trainer:
             params = quantize_params(
                 params, bits=bits, group_size=default_group_size(bits)
             )
-        params = shard_tree(params, meshes.rollout, param_specs(params))
+        specs = param_specs(params)
+        params_rollout = shard_tree(params, meshes.rollout, specs)
+        # non-timeshared roles each hold the frozen base (the reference loads
+        # the model once per worker, distributed_actor.py:58); timeshared
+        # roles alias one copy
+        params_learner = (
+            params_rollout if meshes.timeshared
+            else shard_tree(params, meshes.learner, specs)
+        )
         eos = [tokenizer.eos_token_id]
         extra_eos = getattr(tokenizer, "eos_token_ids", None)
         if extra_eos:
@@ -185,7 +233,8 @@ class Trainer:
         )
         return cls(
             train_dataset, test_dataset, reward_function, config,
-            tokenizer=tokenizer, engine=engine, base_params=params,
+            tokenizer=tokenizer, engine=engine, base_params=params_rollout,
+            base_params_learner=params_learner,
             model_cfg=model_cfg, meshes=meshes, sink=sink,
         )
 
@@ -197,6 +246,7 @@ class Trainer:
             "opt_state": self.opt_state,
             "step": jnp.asarray(self.total_batch_steps),
             "episode": jnp.asarray(self.episode),
+            "batch_in_episode": jnp.asarray(self.batch_in_episode),
             "samples": jnp.asarray(self.total_samples_processed),
             "rng": self._rng,
         }
@@ -208,13 +258,20 @@ class Trainer:
             return
         self.lora = restored["lora"]
         self.opt_state = restored["opt_state"]
+        if self.meshes is not None:
+            from distrl_llm_tpu.parallel.partition import shard_opt_state, shard_tree
+
+            self.lora = shard_tree(self.lora, self.meshes.learner)
+            self.opt_state = shard_opt_state(self.opt_state, self.meshes.learner)
         self.total_batch_steps = int(restored["step"])
         self.episode = int(restored["episode"])
+        self.batch_in_episode = int(restored.get("batch_in_episode", 0))
         self.total_samples_processed = int(restored["samples"])
         self._rng = restored["rng"]
         self.weight_version = self.total_batch_steps
         log.info(
-            "resumed from step %d (episode %d)", self.total_batch_steps, self.episode
+            "resumed from step %d (episode %d, batch %d)",
+            self.total_batch_steps, self.episode, self.batch_in_episode,
         )
 
     def save_checkpoint(self) -> None:
@@ -230,11 +287,60 @@ class Trainer:
             model_name=self.config.model,
         )
 
+    # ------------------------------------------------------------ weight sync
+
+    def _push_weights(self) -> None:
+        """Learner→rollout weight sync: a device-to-device transfer of the LoRA
+        pytree onto the rollout submesh, replacing the reference's adapter-file
+        bus (save_lora distributed_actor.py:85 / load_lora :150). Records the
+        version now resident on the rollout mesh; ``_generate_round`` asserts
+        it before sampling."""
+        if self.meshes is not None and not self.meshes.timeshared:
+            from distrl_llm_tpu.parallel.partition import shard_tree
+
+            self._lora_rollout = shard_tree(self.lora, self.meshes.rollout)
+        else:
+            self._lora_rollout = self.lora
+        self._rollout_weight_version = self.weight_version
+
     # ---------------------------------------------------------------- rollout
 
     def _next_rng(self) -> jax.Array:
         self._rng, key = jax.random.split(self._rng)
         return key
+
+    def _call_engine(self, *args):
+        """Engine call with the configured hang detector: the generation runs
+        in a watchdog thread and exceeding ``generation_timeout_s`` raises
+        ``EngineHangError`` (the reference's ray.get(timeout=240) equivalent,
+        distributed_trainer.py:200). The hung device computation itself cannot
+        be interrupted — like the reference, the recovery unit is the process
+        (checkpoint + restart with resume=True)."""
+        timeout = self.config.generation_timeout_s
+        if timeout <= 0:
+            return self.engine.generate(*args)
+
+        import threading
+
+        result: dict[str, Any] = {}
+
+        def run() -> None:
+            try:
+                result["value"] = self.engine.generate(*args)
+            except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise EngineHangError(
+                f"generation round exceeded {timeout:.0f}s "
+                f"(step {self.total_batch_steps}, weights v{self.weight_version})"
+            )
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
 
     def _generate_round(
         self, batch: Mapping[str, Sequence[str]], sampling: SamplingConfig
@@ -255,15 +361,23 @@ class Trainer:
             self.tokenizer, problems + [""] * (b_pad - b_real),
             self.config.max_prompt_tokens, side="left",
         )
-        result = self.engine.generate(
+        # race detector (SURVEY §5): the engine must only ever sample with the
+        # adapter version the learner last published — the check the
+        # reference's filesystem bus never had
+        if self._rollout_weight_version != self.weight_version:
+            raise StaleWeightsError(
+                f"rollout mesh holds adapter v{self._rollout_weight_version} "
+                f"but learner is at v{self.weight_version}; _push_weights() "
+                "was not called after the last optimizer step"
+            )
+        result = self._call_engine(
             self.base_params,
-            self.lora,
+            self._lora_rollout,
             prompt_ids,
             prompt_mask,
             sampling,
             self._next_rng(),
         )
-        self._rollout_weight_version = self.weight_version
 
         n = sampling.n
         answers, token_lengths = [], []
@@ -317,22 +431,39 @@ class Trainer:
             self.evaluate()
 
             # self.episode is the next episode to START (end-of-episode saves
-            # store episode+1, so a finished run resumes as a no-op; mid-episode
-            # saves re-run their episode from the top — batch-level resume
-            # would need iterator state).
+            # store episode+1, so a finished run resumes as a no-op).
+            # ``batch_in_episode`` is the mid-episode cursor: the episode
+            # shuffle is seeded by (config.seed, episode), so a resumed run
+            # re-derives the same batch order and skips the batches already
+            # trained instead of re-sampling them (SURVEY §5 checkpoint).
             start_episode = self.episode
             for episode in range(start_episode, cfg.episodes):
                 self.episode = episode
-                dataset = self.train_dataset.shuffle()
-                for batch in dataset.iter(cfg.batch_size):
+                dataset = self.train_dataset.shuffle(seed=cfg.seed + 1000 * episode)
+                skip = self.batch_in_episode if episode == start_episode else 0
+                for bi, batch in enumerate(dataset.iter(cfg.batch_size)):
+                    if bi < skip:
+                        continue
+                    if self.profiler is not None:
+                        self.profiler.step_begin(self.total_batch_steps + 1)
                     self._train_batch(batch, episode)
+                    self.batch_in_episode = bi + 1
                     if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
                         self.evaluate()
                     if cfg.save_every and self.total_batch_steps % cfg.save_every == 0:
                         self.save_checkpoint()
                 self.episode = episode + 1
+                self.batch_in_episode = 0
                 self.save_checkpoint()
+        except EngineHangError:
+            # last-gasp state capture so the documented restart path
+            # (resume=True) continues from the final completed step
+            log.exception("generation round hung; checkpointing before exit")
+            self.save_checkpoint()
+            raise
         finally:
+            if self.profiler is not None:
+                self.profiler.finish()
             self.sink.finish()
             self.rewards.close()
 
@@ -358,12 +489,14 @@ class Trainer:
                 max_prompt_tokens=cfg.max_prompt_tokens,
                 max_new_tokens=cfg.max_new_tokens,
                 micro_size=cfg.train_batch_size,
+                mesh=self.meshes.learner if self.meshes is not None else None,
             )
             self.lora, self.opt_state, loss = self.train_step(
-                self.lora, self.opt_state, self.base_params, update
+                self.lora, self.opt_state, self.base_params_learner, update
             )
             loss = float(loss)
         self.weight_version += 1
+        self._push_weights()
 
         if cfg.write_adapter_file:
             self.save_adapter()

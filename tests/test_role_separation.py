@@ -1,0 +1,217 @@
+"""True rollout/learner role separation on disjoint device sets.
+
+The reference runs generation on actor GPUs while a distinct learner process
+trains, shipping LoRA weights learner→actor each step through an adapter file
+(distributed_actor.py:84–86, :150; distributed_trainer.py:346). Here the roles
+are disjoint submeshes of one CPU mesh: the engine runs on the rollout mesh,
+the train step on the learner mesh, and ``Trainer._push_weights`` moves the
+adapter across as a device-to-device transfer, with weight-version counters
+asserted at engine entry (SURVEY §5 race detection).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.config import MeshConfig, TrainConfig
+from distrl_llm_tpu.engine.engine import GenerationEngine
+from distrl_llm_tpu.metrics import MemorySink
+from distrl_llm_tpu.models import TINY, init_params
+from distrl_llm_tpu.models.lora import lora_scale
+from distrl_llm_tpu.parallel.mesh import build_role_meshes
+from distrl_llm_tpu.parallel.partition import param_specs, shard_tree
+from distrl_llm_tpu.rewards import reward_function
+from distrl_llm_tpu.tokenizer import CharTokenizer
+from distrl_llm_tpu.trainer import StaleWeightsError, Trainer
+
+BATCH = {
+    "problem": ["q a", "q b", "q c", "q d"],
+    "solution": ["A", "B", "C", "D"],
+}
+
+
+def make_config(**kw) -> TrainConfig:
+    defaults = dict(
+        model="tiny",
+        episodes=1,
+        batch_size=4,
+        num_candidates=2,
+        topk=2,
+        train_batch_size=4,
+        max_prompt_tokens=16,
+        max_new_tokens=8,
+        number_of_actors=1,
+        number_of_learners=1,
+        learner_chunk_size=1,
+        eval_every=0,
+        save_every=0,
+        metrics_backend="null",
+        lr=1e-3,
+        max_lora_rank=4,
+        lora_alpha=8,
+        mesh=MeshConfig(tp=2, fsdp=2),  # 4 chips per role → 8-device CPU mesh
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def tree_devices(tree) -> set:
+    out: set = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "devices"):
+            out |= set(leaf.devices())
+    return out
+
+
+@pytest.fixture(scope="module")
+def trainer():
+    cfg = make_config()
+    meshes = build_role_meshes(cfg.mesh)
+    assert not meshes.timeshared
+    tok = CharTokenizer()
+    base = init_params(jax.random.PRNGKey(0), TINY)
+    specs = param_specs(base)
+    base_rollout = shard_tree(base, meshes.rollout, specs)
+    base_learner = shard_tree(base, meshes.learner, specs)
+    engine = GenerationEngine(
+        TINY,
+        max_prompt_tokens=cfg.max_prompt_tokens,
+        max_new_tokens=cfg.max_new_tokens,
+        eos_token_ids=[tok.eos_token_id],
+        pad_token_id=tok.pad_token_id,
+        lora_scale=lora_scale(cfg.max_lora_rank, cfg.lora_alpha),
+    )
+    train = {"problem": BATCH["problem"], "solution": BATCH["solution"]}
+    return Trainer(
+        train, train, reward_function, cfg,
+        tokenizer=tok, engine=engine,
+        base_params=base_rollout, base_params_learner=base_learner,
+        model_cfg=TINY, meshes=meshes, sink=MemorySink(),
+    )
+
+
+class TestDisjointRoles:
+    def test_meshes_are_disjoint(self, trainer):
+        rollout = set(trainer.meshes.rollout.devices.flat)
+        learner = set(trainer.meshes.learner.devices.flat)
+        assert rollout and learner and not (rollout & learner)
+
+    def test_full_round_on_split_meshes(self, trainer):
+        """One rollout + update round where generation runs on the rollout
+        submesh and the train step on the learner submesh."""
+        trainer._train_batch(BATCH, episode=0)
+        recs = [m for _, m in trainer.sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+
+        learner_devs = set(trainer.meshes.learner.devices.flat)
+        rollout_devs = set(trainer.meshes.rollout.devices.flat)
+        # learner state lives exclusively on learner chips
+        assert tree_devices(trainer.lora) <= learner_devs
+        assert tree_devices(trainer.opt_state) <= learner_devs
+        # the engine's adapter copy lives exclusively on rollout chips
+        assert tree_devices(trainer._lora_rollout) <= rollout_devs
+        # and it IS the post-update adapter (weight sync happened)
+        np.testing.assert_array_equal(
+            np.asarray(trainer._lora_rollout["layers"]["wq"]["b"]),
+            np.asarray(trainer.lora["layers"]["wq"]["b"]),
+        )
+        assert trainer.weight_version == 1
+        assert trainer._rollout_weight_version == 1
+
+    def test_base_params_resident_per_role(self, trainer):
+        assert tree_devices(trainer.base_params) <= set(
+            trainer.meshes.rollout.devices.flat
+        )
+        assert tree_devices(trainer.base_params_learner) <= set(
+            trainer.meshes.learner.devices.flat
+        )
+
+    def test_stale_weights_detected(self, trainer):
+        """The write-only counter of round 1 is now a race detector: a missed
+        push between optimizer step and rollout raises."""
+        trainer.weight_version += 1  # simulate an un-pushed optimizer step
+        try:
+            with pytest.raises(StaleWeightsError):
+                trainer._generate_round(BATCH, trainer.config.train_sampling())
+        finally:
+            trainer.weight_version -= 1
+
+    def test_lora_is_sharded_not_replicated(self, trainer):
+        """The adapter itself must actually shard over the learner mesh's
+        fsdp/tp axes — a replicated adapter would make `--fsdp` a lie."""
+        total = 0
+        local = 0
+        for leaf in jax.tree_util.tree_leaves(trainer.lora):
+            total += leaf.nbytes
+            local += leaf.addressable_shards[0].data.nbytes
+        assert local < total  # at least some leaves are partitioned
+
+
+def _per_device_bytes(tree) -> int:
+    return sum(
+        leaf.addressable_shards[0].data.nbytes
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "addressable_shards")
+    )
+
+
+class TestFsdpOptState:
+    def test_opt_state_bytes_shrink_with_fsdp(self):
+        """FSDP substantiation (SURVEY §2c): optimizer moments inherit the
+        adapter's fsdp sharding through the jitted init, so per-device
+        optimizer-state bytes shrink as fsdp grows."""
+        from distrl_llm_tpu.learner.optim import make_optimizer
+        from distrl_llm_tpu.models import init_lora_params
+        from distrl_llm_tpu.parallel.mesh import _make_mesh
+        from distrl_llm_tpu.parallel.partition import shard_opt_state
+
+        devices = jax.devices()[:4]
+        lora = init_lora_params(jax.random.PRNGKey(0), TINY, rank=8)
+        optimizer = make_optimizer(1e-3, use_8bit=False)
+
+        sizes = {}
+        for fsdp in (1, 4):
+            mesh = _make_mesh(devices, tp=1, sp=1, fsdp=fsdp)
+            sharded = shard_tree(lora, mesh)
+            opt = shard_opt_state(optimizer.init(sharded), mesh)
+            sizes[fsdp] = _per_device_bytes(opt)
+        assert sizes[4] < sizes[1]
+
+    def test_train_step_preserves_opt_sharding(self):
+        """One train step keeps the fsdp-sharded moments sharded (no silent
+        re-replication through the jitted update)."""
+        from distrl_llm_tpu.learner.optim import make_optimizer
+        from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
+        from distrl_llm_tpu.models import init_lora_params, init_params
+        from distrl_llm_tpu.models.lora import lora_scale
+        from distrl_llm_tpu.parallel.mesh import _make_mesh
+        from distrl_llm_tpu.parallel.partition import shard_opt_state
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        devices = jax.devices()[:4]
+        mesh = _make_mesh(devices, tp=1, sp=1, fsdp=4)
+        base = shard_tree(init_params(jax.random.PRNGKey(0), TINY), mesh)
+        lora = shard_tree(init_lora_params(jax.random.PRNGKey(1), TINY, rank=8), mesh)
+        optimizer = make_optimizer(1e-3, use_8bit=False)
+        opt_state = shard_opt_state(optimizer.init(lora), mesh)
+        before = _per_device_bytes(opt_state)
+
+        rng = np.random.default_rng(0)
+        n, p_len, t_len = 4, 8, 8
+        batch = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, p_len)), jnp.int32),
+            prompt_mask=jnp.ones((n, p_len), jnp.int32),
+            answer_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, t_len)), jnp.int32),
+            answer_mask=jnp.ones((n, t_len), jnp.int32),
+            coeffs=jnp.asarray(rng.normal(size=n), jnp.float32),
+            sample_mask=jnp.ones((n,), jnp.float32),
+        )
+        step = make_train_step(
+            TINY, learner_type="pg", optimizer=optimizer,
+            lora_scale=lora_scale(8, 16.0), micro_size=4, donate=False,
+        )
+        _, new_opt, loss = step(lora, opt_state, base, batch)
+        assert np.isfinite(float(loss))
+        assert _per_device_bytes(new_opt) <= before * 1.5
