@@ -31,6 +31,11 @@ class SamplingConfig:
     temperature: float = 1.2
     top_p: float = 0.95
     n: int = 16  # candidates per prompt
+    # top-p filter implementation: False = sort-free bisection (fast path;
+    # kept set is a superset of the exact nucleus by at most the boundary
+    # tie mass), True = exact rank-based sort filter matching the reference's
+    # vLLM semantics — for eval/reproducibility runs (ADVICE r1).
+    top_p_exact: bool = False
 
     def replace(self, **kw) -> "SamplingConfig":
         return dataclasses.replace(self, **kw)
@@ -113,6 +118,9 @@ class TrainConfig:
     eval_temperature: float = 0.6
     eval_top_p: float = 0.95
     eval_n: int = 8
+    # use the exact sort-based nucleus filter (reference vLLM semantics)
+    # instead of the fast bisection filter, for reproducibility runs
+    top_p_exact: bool = False
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}
@@ -121,7 +129,20 @@ class TrainConfig:
     # falls back with a warning elsewhere) — ops/flash_attention.py
     attn_impl: str = "reference"
     write_adapter_file: bool = False  # artifact-parity adapter writer
-    profile_dir: str | None = None  # jax.profiler trace destination
+    # jax.profiler trace capture (SURVEY §5 tracing): traces the step window
+    # [profile_start_step, profile_start_step + profile_num_steps) into
+    # profile_dir (TensorBoard format). Step 1 is skipped by default — it is
+    # dominated by compilation.
+    profile_dir: str | None = None
+    profile_start_step: int = 2
+    profile_num_steps: int = 3
+    # Hang detector on generation rounds — parity with the reference's
+    # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
+    # default: a first rollout legitimately spends minutes in XLA compilation;
+    # production configs should set it once compile times are known). On
+    # timeout the trainer checkpoints and raises EngineHangError — restart
+    # with resume=True to continue from the last completed step.
+    generation_timeout_s: float = 0.0
 
     def __post_init__(self):
         if self.learner not in ("pg", "grpo"):
@@ -166,6 +187,7 @@ class TrainConfig:
             temperature=self.temperature,
             top_p=0.95,  # reference hardcodes top_p=0.95 (distributed_actor.py:47)
             n=self.num_candidates,
+            top_p_exact=self.top_p_exact,
         )
 
     def eval_sampling(self) -> SamplingConfig:
@@ -175,6 +197,7 @@ class TrainConfig:
             temperature=self.eval_temperature,
             top_p=self.eval_top_p,
             n=self.eval_n,
+            top_p_exact=self.top_p_exact,
         )
 
     def to_flat_dict(self) -> dict[str, Any]:

@@ -102,8 +102,12 @@ class DictDataset:
     def __getitem__(self, key: str) -> list[Any]:
         return self.data[key]
 
-    def shuffle(self) -> "DictDataset":
-        perm = self._rng.permutation(len(self))
+    def shuffle(self, seed: int | None = None) -> "DictDataset":
+        """Seedable like HF ``Dataset.shuffle(seed=...)`` — the trainer seeds
+        each episode's shuffle deterministically so a mid-episode resume can
+        re-derive the same batch order and skip what was already trained."""
+        rng = np.random.default_rng(seed) if seed is not None else self._rng
+        perm = rng.permutation(len(self))
         shuffled = {k: [v[i] for i in perm] for k, v in self.data.items()}
         out = DictDataset(shuffled)
         out._rng = self._rng

@@ -116,6 +116,39 @@ def make_sink(
     raise ValueError(f"unknown metrics backend {backend!r}")
 
 
+class TraceProfiler:
+    """jax.profiler trace capture around a configurable step window.
+
+    The reference has wall-clock phase timers only (SURVEY §5 tracing); this
+    adds real device traces: call ``step_begin(step)`` before each train step
+    and ``finish()`` at shutdown. Traces land in ``profile_dir`` in
+    TensorBoard format (``tensorboard --logdir <profile_dir>``)."""
+
+    def __init__(self, profile_dir: str, start_step: int = 2, num_steps: int = 3):
+        self.profile_dir = profile_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def step_begin(self, step: int) -> None:
+        import jax
+
+        if not self._active and self.start_step <= step < self.stop_step:
+            os.makedirs(self.profile_dir, exist_ok=True)
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+        elif self._active and step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def finish(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
 class PhaseTimer:
     """Wall-clock phase timing matching the reference's inline time.time()
     pairs (distributed_trainer.py:180/:202, :206/:217, :303/:343, :385/:411).
