@@ -37,8 +37,14 @@ def build_library(source_name: str) -> str:
     path, an unchanged one is reused across processes.
     """
     src = os.path.join(_CSRC, source_name)
+    h = hashlib.sha256()
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    for name in sorted(os.listdir(_CSRC)):  # local headers feed the digest too
+        if name.endswith(".h"):
+            with open(os.path.join(_CSRC, name), "rb") as f:
+                h.update(f.read())
+    digest = h.hexdigest()[:16]
     stem = os.path.splitext(source_name)[0]
     out = os.path.join(_cache_dir(), f"{stem}-{digest}.so")
     if os.path.exists(out):

@@ -11,7 +11,10 @@
 // converts an HF tokenizer.json (unicode-remapped byte-level tokens) into a
 // raw-bytes serialization:
 //
-//   line 0:            V M S            (vocab size, merge count, special count)
+//   line 0:            V M S [P]        (vocab size, merge count, special
+//                                        count, pretokenizer kind: 0 = GPT-2
+//                                        pattern, 1 = Qwen2/cl100k pattern;
+//                                        default 1)
 //   next V lines:      <hex-bytes>      (token id = line index)
 //   next M lines:      <hexL> <hexR>    (merge rank = line index)
 //   next S lines:      <id>             (special token ids; matched verbatim
@@ -19,11 +22,19 @@
 //
 // Algorithm parity with the byte-level BPE the Rust crate implements:
 //   1. split text on special tokens (longest match first);
-//   2. GPT-2-style pretokenization (contractions / letter runs / digit runs /
-//      punctuation runs, with a leading-space convention). "Letter" follows
-//      ASCII classes plus any byte >= 0x80 (UTF-8 continuation), an
-//      approximation of the \p{L} unicode classes that is exact for ASCII
-//      and groups multibyte scripts into runs;
+//   2. pretokenization with the checkpoint's actual regex, evaluated over
+//      decoded UTF-8 codepoints with real \p{L}/\p{N} class tables
+//      (unicode_tables.h, generated from unicodedata):
+//        P=1 (Qwen2/Llama-3 family, the models this framework trains):
+//          (?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}
+//          | ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+
+//        P=0 (GPT-2):
+//          's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+
+//          |\s+(?!\S)|\s+
+//      Alternatives are ordered (leftmost alternation wins), each greedy —
+//      matching onig's behavior for these patterns; the \s+(?!\S) lookahead
+//      is the standard "maximal run minus trailing space" rule. Differential
+//      tests against the Rust implementation: tests/test_native_tokenizer.py;
 //   3. per pretoken, greedy lowest-rank pair merging over the merge table
 //      (with a pretoken result cache, as the Rust implementation keeps).
 //
@@ -35,6 +46,8 @@
 #include <unordered_map>
 #include <vector>
 #include <mutex>
+
+#include "unicode_tables.h"
 
 namespace {
 
@@ -53,74 +66,269 @@ struct Tokenizer {
       merge_result;                                         // (idL,idR) -> id
   std::vector<std::string> specials;                        // raw special strings
   std::vector<uint32_t> special_ids;
+  int pretok_kind = 1;                                      // 0 gpt2, 1 qwen2
   std::unordered_map<std::string, std::vector<uint32_t>> cache;  // pretoken memo
   std::mutex cache_mu;
 };
 
-bool is_ascii_letter(uint8_t b) {
-  return (b >= 'a' && b <= 'z') || (b >= 'A' && b <= 'Z');
-}
-bool is_letterish(uint8_t b) { return is_ascii_letter(b) || b >= 0x80; }
-bool is_digit(uint8_t b) { return b >= '0' && b <= '9'; }
-bool is_space(uint8_t b) { return b == ' ' || b == '\t' || b == '\n' || b == '\r'; }
+// ---------------------------------------------------------------- unicode ---
 
-// GPT-2 pattern: 's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+
-std::vector<std::string> pretokenize(const std::string& text) {
+bool in_ranges(uint32_t cp, const uint32_t (*ranges)[2], size_t n) {
+  size_t lo = 0, hi = n;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (cp < ranges[mid][0]) hi = mid;
+    else if (cp > ranges[mid][1]) lo = mid + 1;
+    else return true;
+  }
+  return false;
+}
+
+bool is_letter(uint32_t cp) { return in_ranges(cp, kUnicodeL, kUnicodeL_len); }
+bool is_number(uint32_t cp) { return in_ranges(cp, kUnicodeN, kUnicodeN_len); }
+
+// onig's \s in Unicode mode (the class the HF pretokenizer regex uses)
+bool is_space_cp(uint32_t cp) {
+  switch (cp) {
+    case 0x09: case 0x0A: case 0x0B: case 0x0C: case 0x0D: case 0x20:
+    case 0x85: case 0xA0: case 0x1680: case 0x2028: case 0x2029:
+    case 0x202F: case 0x205F: case 0x3000:
+      return true;
+    default:
+      return cp >= 0x2000 && cp <= 0x200A;
+  }
+}
+
+// Decode one UTF-8 codepoint at byte offset i; returns codepoint and writes
+// its byte length. Invalid bytes decode as single-byte codepoints (byte-level
+// BPE always has a byte fallback, so this only affects class membership).
+uint32_t utf8_next(const std::string& s, size_t i, size_t* len) {
+  uint8_t b0 = static_cast<uint8_t>(s[i]);
+  size_t n = s.size();
+  if (b0 < 0x80) { *len = 1; return b0; }
+  auto cont = [&](size_t k) {
+    return i + k < n && (static_cast<uint8_t>(s[i + k]) & 0xC0) == 0x80;
+  };
+  if ((b0 & 0xE0) == 0xC0 && cont(1)) {
+    *len = 2;
+    return ((b0 & 0x1Fu) << 6) | (static_cast<uint8_t>(s[i + 1]) & 0x3Fu);
+  }
+  if ((b0 & 0xF0) == 0xE0 && cont(1) && cont(2)) {
+    *len = 3;
+    return ((b0 & 0x0Fu) << 12) | ((static_cast<uint8_t>(s[i + 1]) & 0x3Fu) << 6) |
+           (static_cast<uint8_t>(s[i + 2]) & 0x3Fu);
+  }
+  if ((b0 & 0xF8) == 0xF0 && cont(1) && cont(2) && cont(3)) {
+    *len = 4;
+    return ((b0 & 0x07u) << 18) | ((static_cast<uint8_t>(s[i + 1]) & 0x3Fu) << 12) |
+           ((static_cast<uint8_t>(s[i + 2]) & 0x3Fu) << 6) |
+           (static_cast<uint8_t>(s[i + 3]) & 0x3Fu);
+  }
+  *len = 1;
+  return b0;
+}
+
+// ----------------------------------------------------------- pretokenizer ---
+
+// Case-insensitive contraction match at byte offset i ('s 't 're 've 'm 'll
+// 'd). GPT-2's pattern is case-SENSITIVE; Qwen2's has the (?i:) group.
+size_t match_contraction(const std::string& s, size_t i, bool case_insensitive) {
+  size_t n = s.size();
+  if (s[i] != '\'' || i + 1 >= n) return 0;
+  auto low = [&](size_t k) {
+    char c = s[i + k];
+    if (!case_insensitive) return c;
+    return (c >= 'A' && c <= 'Z') ? static_cast<char>(c - 'A' + 'a') : c;
+  };
+  char c1 = low(1);
+  if (i + 2 < n) {
+    char c2 = low(2);
+    if ((c1 == 'r' && c2 == 'e') || (c1 == 'v' && c2 == 'e') ||
+        (c1 == 'l' && c2 == 'l'))
+      return 3;
+  }
+  if (c1 == 's' || c1 == 't' || c1 == 'm' || c1 == 'd') return 2;
+  return 0;
+}
+
+// The Qwen2 / cl100k-style pattern (tokenizer.json pre_tokenizer regex):
+//   (?i:'s|'t|'re|'ve|'m|'ll|'d)          contractions, any case
+//   [^\r\n\p{L}\p{N}]?\p{L}+              optional joiner char + letter run
+//   \p{N}{1,3}                            digits chunked 1-3 at a time
+//   ' ?[^\s\p{L}\p{N}]+[\r\n]*'           symbol run absorbing newlines
+//   \s*[\r\n]+                            whitespace ending in newlines
+//   \s+(?!\S)                             trailing whitespace
+//   \s+
+std::vector<std::string> pretokenize_qwen2(const std::string& text) {
   std::vector<std::string> out;
   size_t i = 0, n = text.size();
   while (i < n) {
-    // contractions
-    if (text[i] == '\'' && i + 1 < n) {
-      size_t len = 0;
-      const char* two[] = {"'s", "'t", "'m", "'d"};
-      const char* three[] = {"'re", "'ve", "'ll"};
-      for (const char* c : three)
-        if (i + 3 <= n && text.compare(i, 3, c) == 0) len = 3;
-      if (!len)
-        for (const char* c : two)
-          if (i + 2 <= n && text.compare(i, 2, c) == 0) len = 2;
-      if (len) { out.emplace_back(text.substr(i, len)); i += len; continue; }
+    size_t clen = match_contraction(text, i, /*case_insensitive=*/true);
+    if (clen) { out.emplace_back(text.substr(i, clen)); i += clen; continue; }
+
+    size_t len0;
+    uint32_t cp0 = utf8_next(text, i, &len0);
+
+    // [^\r\n\p{L}\p{N}]?\p{L}+
+    {
+      size_t j = i, jl = len0;
+      uint32_t c = cp0;
+      bool joiner = false;
+      if (c != '\r' && c != '\n' && !is_letter(c) && !is_number(c)) {
+        joiner = true;
+        j += jl;
+        if (j < n) c = utf8_next(text, j, &jl);
+      }
+      if (j < n && is_letter(c)) {
+        size_t end = j;
+        while (end < n) {
+          size_t l;
+          uint32_t cc = utf8_next(text, end, &l);
+          if (!is_letter(cc)) break;
+          end += l;
+        }
+        size_t start = joiner ? i : j;
+        out.emplace_back(text.substr(start, end - start));
+        i = end;
+        continue;
+      }
     }
-    size_t start = i;
-    bool leading_space = false;
-    if (text[i] == ' ' && i + 1 < n &&
-        (is_letterish(text[i + 1]) || is_digit(text[i + 1]) ||
-         (!is_space(text[i + 1]) && text[i + 1] != ' '))) {
-      leading_space = true;
-      i++;
-    }
-    if (i < n && is_letterish(text[i])) {
-      while (i < n && is_letterish(text[i])) i++;
-      out.emplace_back(text.substr(start, i - start));
+
+    // \p{N}{1,3}
+    if (is_number(cp0)) {
+      size_t end = i, count = 0;
+      while (end < n && count < 3) {
+        size_t l;
+        uint32_t cc = utf8_next(text, end, &l);
+        if (!is_number(cc)) break;
+        end += l;
+        count++;
+      }
+      out.emplace_back(text.substr(i, end - i));
+      i = end;
       continue;
     }
-    if (i < n && is_digit(text[i])) {
-      while (i < n && is_digit(text[i])) i++;
-      out.emplace_back(text.substr(start, i - start));
+
+    // ' ?[^\s\p{L}\p{N}]+[\r\n]*'
+    {
+      size_t j = i;
+      if (text[j] == ' ') j++;
+      if (j < n) {
+        size_t l;
+        uint32_t cc = utf8_next(text, j, &l);
+        if (!is_space_cp(cc) && !is_letter(cc) && !is_number(cc)) {
+          size_t end = j;
+          while (end < n) {
+            uint32_t c2 = utf8_next(text, end, &l);
+            if (is_space_cp(c2) || is_letter(c2) || is_number(c2)) break;
+            end += l;
+          }
+          while (end < n && (text[end] == '\r' || text[end] == '\n')) end++;
+          out.emplace_back(text.substr(i, end - i));
+          i = end;
+          continue;
+        }
+      }
+    }
+
+    // \s*[\r\n]+  — greedy: maximal whitespace run truncated at its LAST
+    // newline (the [\r\n]+ suffix); fails if the run contains no newline
+    if (is_space_cp(cp0)) {
+      size_t end = i, l, last_nl_end = 0, last_len = 0;
+      while (end < n) {
+        uint32_t cc = utf8_next(text, end, &l);
+        if (!is_space_cp(cc)) break;
+        end += l;
+        last_len = l;
+        if (cc == '\r' || cc == '\n') last_nl_end = end;
+      }
+      if (last_nl_end > i) {
+        out.emplace_back(text.substr(i, last_nl_end - i));
+        i = last_nl_end;
+        continue;
+      }
+      // \s+(?!\S) then \s+ : maximal run; drop the last space if a non-space
+      // follows (it joins the next pretoken via the joiner/space alternatives)
+      if (end < n && end - i > last_len) {
+        out.emplace_back(text.substr(i, end - i - last_len));
+        i = end - last_len;
+      } else {
+        out.emplace_back(text.substr(i, end - i));
+        i = end;
+      }
       continue;
     }
-    if (i < n && !is_space(text[i])) {  // punctuation run (apostrophes that
-      // did not start a contraction are ordinary punctuation, as in the
-      // greedy [^\s\p{L}\p{N}]+ alternative)
-      while (i < n && !is_space(text[i]) && !is_letterish(text[i]) &&
-             !is_digit(text[i]))
-        i++;
-      out.emplace_back(text.substr(start, i - start));
-      continue;
+
+    // unreachable fallback: emit the codepoint as its own pretoken
+    out.emplace_back(text.substr(i, len0));
+    i += len0;
+  }
+  return out;
+}
+
+// GPT-2 pattern: 's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+
+std::vector<std::string> pretokenize_gpt2(const std::string& text) {
+  std::vector<std::string> out;
+  size_t i = 0, n = text.size();
+  while (i < n) {
+    size_t clen = match_contraction(text, i, /*case_insensitive=*/false);
+    if (clen) { out.emplace_back(text.substr(i, clen)); i += clen; continue; }
+
+    // ' ?' prefix shared by the letter/number/symbol alternatives
+    size_t j = i;
+    if (text[j] == ' ' && j + 1 < n) j++;
+    if (j < n) {
+      size_t l;
+      uint32_t c = utf8_next(text, j, &l);
+      auto run = [&](bool (*cls)(uint32_t)) {
+        size_t end = j;
+        while (end < n) {
+          size_t ll;
+          uint32_t cc = utf8_next(text, end, &ll);
+          if (!cls(cc)) break;
+          end += ll;
+        }
+        out.emplace_back(text.substr(i, end - i));
+        i = end;
+      };
+      if (is_letter(c)) { run(is_letter); continue; }
+      if (is_number(c)) { run(is_number); continue; }
+      if (!is_space_cp(c)) {
+        size_t end = j;
+        while (end < n) {
+          size_t ll;
+          uint32_t cc = utf8_next(text, end, &ll);
+          if (is_space_cp(cc) || is_letter(cc) || is_number(cc)) break;
+          end += ll;
+        }
+        out.emplace_back(text.substr(i, end - i));
+        i = end;
+        continue;
+      }
     }
-    if (leading_space) { i = start; }  // space not followed by token content
-    // whitespace runs: \s+(?!\S) keeps trailing ws together; emit maximal run
-    // minus one if a non-space follows (that space prefixes the next token)
-    size_t ws_start = i;
-    while (i < n && is_space(text[i])) i++;
-    if (i < n && i - ws_start > 1 && text[i - 1] == ' ') {
-      out.emplace_back(text.substr(ws_start, i - ws_start - 1));
-      i--;  // final space joins the next pretoken
-    } else if (i > ws_start) {
-      out.emplace_back(text.substr(ws_start, i - ws_start));
+
+    // whitespace: \s+(?!\S) | \s+
+    size_t end = i, last_len = 0;
+    while (end < n) {
+      size_t l;
+      uint32_t cc = utf8_next(text, end, &l);
+      if (!is_space_cp(cc)) break;
+      end += l;
+      last_len = l;
+    }
+    if (end < n && end - i > last_len) {
+      out.emplace_back(text.substr(i, end - i - last_len));
+      i = end - last_len;
+    } else {
+      out.emplace_back(text.substr(i, end - i));
+      i = end;
     }
   }
   return out;
+}
+
+std::vector<std::string> pretokenize(const Tokenizer* t, const std::string& text) {
+  return t->pretok_kind == 0 ? pretokenize_gpt2(text) : pretokenize_qwen2(text);
 }
 
 std::vector<uint32_t> bpe_merge(Tokenizer* t, const std::string& piece) {
@@ -161,7 +369,7 @@ std::vector<uint32_t> bpe_merge(Tokenizer* t, const std::string& piece) {
 
 void encode_ordinary(Tokenizer* t, const std::string& text,
                      std::vector<uint32_t>* out) {
-  for (const auto& piece : pretokenize(text)) {
+  for (const auto& piece : pretokenize(t, text)) {
     auto whole = t->tok_to_id.find(piece);
     if (whole != t->tok_to_id.end()) {
       out->push_back(whole->second);
@@ -211,10 +419,12 @@ void* bpe_create(const char* data, int64_t len) {
   };
   std::string line;
   if (!next_line(&line)) { delete t; return nullptr; }
-  long v = 0, m = 0, sp = 0;
-  if (sscanf(line.c_str(), "%ld %ld %ld", &v, &m, &sp) != 3 || v <= 0) {
+  long v = 0, m = 0, sp = 0, pk = 1;
+  int fields = sscanf(line.c_str(), "%ld %ld %ld %ld", &v, &m, &sp, &pk);
+  if (fields < 3 || v <= 0 || pk < 0 || pk > 1) {
     delete t; return nullptr;
   }
+  t->pretok_kind = static_cast<int>(pk);
   t->id_to_tok.resize(v);
   for (long i = 0; i < v; i++) {
     if (!next_line(&line)) { delete t; return nullptr; }

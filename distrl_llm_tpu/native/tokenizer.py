@@ -51,6 +51,52 @@ def token_to_bytes(token: str) -> bytes:
         return token.encode("utf-8")
 
 
+def _detect_pretok_kind(tokenizer_json: dict[str, Any]) -> int:
+    """0 = GPT-2 pattern, 1 = Qwen2/cl100k pattern (the default for the model
+    families this framework trains). Detection keys off the digit-chunking
+    alternative ``\\p{N}{1,3}`` that distinguishes the cl100k-style regex."""
+    pt = tokenizer_json.get("pre_tokenizer") or {}
+    frags: list[str] = []
+
+    def collect(node):
+        if isinstance(node, dict):
+            pat = node.get("pattern")
+            if isinstance(pat, dict) and "Regex" in pat:
+                frags.append(pat["Regex"])
+            for v in node.values():
+                collect(v)
+        elif isinstance(node, list):
+            for v in node:
+                collect(v)
+
+    collect(pt)
+    pattern = " ".join(frags)
+    if pattern:
+        return 1 if "{1,3}" in pattern else 0
+
+    # No explicit Regex. A ByteLevel pre_tokenizer with use_regex (the
+    # tokenizers default is true) splits with its BUILT-IN GPT-2 pattern;
+    # only regex-less configs (use_regex false everywhere, as Qwen2-style
+    # Sequence[Split, ByteLevel(use_regex=false)] files always pair with an
+    # explicit Split) default to the modern cl100k rules.
+    uses_builtin_gpt2 = []
+
+    def check_bytelevel(node):
+        if isinstance(node, dict):
+            if node.get("type") == "ByteLevel":
+                uses_builtin_gpt2.append(node.get("use_regex", True))
+            for v in node.values():
+                check_bytelevel(v)
+        elif isinstance(node, list):
+            for v in node:
+                check_bytelevel(v)
+
+    check_bytelevel(pt)
+    if any(uses_builtin_gpt2):
+        return 0
+    return 1
+
+
 def serialize_hf_tokenizer(tokenizer_json: dict[str, Any]) -> bytes:
     """HF tokenizer.json dict → the C core's model format (see .cc header)."""
     model = tokenizer_json["model"]
@@ -70,7 +116,8 @@ def serialize_hf_tokenizer(tokenizer_json: dict[str, Any]) -> bytes:
         if tok.get("special", True):
             special_ids.append(tok["id"])
 
-    lines = [f"{size} {len(merges)} {len(special_ids)}"]
+    kind = _detect_pretok_kind(tokenizer_json)
+    lines = [f"{size} {len(merges)} {len(special_ids)} {kind}"]
     lines += [t.hex() for t in id_to_bytes]
     for m in merges:
         l, r = m if isinstance(m, (list, tuple)) else m.split(" ", 1)
@@ -115,6 +162,7 @@ class NativeBPETokenizer:
         eos_token_id: int,
         pad_token_id: int | None = None,
         chat_template: str | None = None,
+        nfc_normalize: bool = True,
     ):
         self._lib = _Lib.get()
         self._h = self._lib.bpe_create(serialized_model, len(serialized_model))
@@ -123,21 +171,32 @@ class NativeBPETokenizer:
         self.eos_token_id = eos_token_id
         self.pad_token_id = pad_token_id if pad_token_id is not None else eos_token_id
         self.chat_template = chat_template
+        # Qwen2-family tokenizer.json carries an NFC normalizer; GPT-2's has
+        # none. Normalization runs host-side in Python (unicodedata) — the C
+        # core sees NFC bytes.
+        self._nfc = nfc_normalize
 
     @classmethod
     def from_hf_file(cls, path: str, **kw) -> "NativeBPETokenizer":
         with open(path, encoding="utf-8") as f:
             tj = json.load(f)
         data = serialize_hf_tokenizer(tj)
+        if "nfc_normalize" not in kw:
+            kw["nfc_normalize"] = "NFC" in json.dumps(tj.get("normalizer") or {})
         if "eos_token_id" not in kw:
-            # best effort: conventional names, else the last special token
+            # conventional names only; a silently-wrong eos breaks generation
+            # termination (rollouts would always run to max_tokens), so an
+            # unrecognized vocabulary must fail loudly (ADVICE r1)
             specials = {t["content"]: t["id"] for t in tj.get("added_tokens", [])}
             for name in ("<|im_end|>", "</s>", "<|eot_id|>", "<|endoftext|>"):
                 if name in specials:
                     kw["eos_token_id"] = specials[name]
                     break
             else:
-                kw["eos_token_id"] = max(specials.values(), default=0)
+                raise ValueError(
+                    "no conventional EOS token found among special tokens "
+                    f"{sorted(specials)}; pass eos_token_id explicitly"
+                )
         return cls(data, **kw)
 
     def __del__(self):
@@ -147,6 +206,10 @@ class NativeBPETokenizer:
             self._h = None
 
     def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        if self._nfc:
+            import unicodedata
+
+            text = unicodedata.normalize("NFC", text)
         raw = text.encode("utf-8")
         cap = max(16, len(raw) + 16)
         buf = (ctypes.c_int32 * cap)()
@@ -174,10 +237,36 @@ class NativeBPETokenizer:
         raise RuntimeError("decode buffer negotiation failed")
 
     def apply_chat_template(
-        self, messages, tokenize: bool = False, add_generation_prompt: bool = True
+        self, messages, tokenize: bool = False, add_generation_prompt: bool = True,
+        chat_template: str | None = None,
     ):
-        """ChatML rendering (the Qwen2 template the reference's models use —
-        helper.py:15–19 relies on the HF template; this is its explicit form)."""
+        """Chat rendering (helper.py:15–19 relies on the HF template). A
+        Jinja template (from tokenizer_config.json or the caller) renders via
+        jinja2 when available; otherwise explicit ChatML — the Qwen2 format
+        the reference's models use."""
+        template = chat_template or self.chat_template
+        if template:
+            try:
+                import jinja2
+
+                env = jinja2.Environment(keep_trailing_newline=True)
+                env.globals["raise_exception"] = lambda msg: (_ for _ in ()).throw(
+                    ValueError(msg)
+                )
+                text = env.from_string(template).render(
+                    messages=messages,
+                    add_generation_prompt=add_generation_prompt,
+                    eos_token="",
+                    bos_token="",
+                )
+                return self.encode(text) if tokenize else text
+            except Exception as e:  # noqa: BLE001 — template quirks → ChatML fallback
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "chat template failed to render (%s: %s); falling back to "
+                    "ChatML — WRONG for non-ChatML checkpoints", type(e).__name__, e,
+                )
         parts = []
         for m in messages:
             parts.append(f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n")
