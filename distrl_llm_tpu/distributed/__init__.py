@@ -1,0 +1,15 @@
+"""Multi-host runtime: jax.distributed entry + the C++ control plane (N5)."""
+
+from distrl_llm_tpu.distributed.control_plane import (
+    DriverClient,
+    WorkerDeadError,
+    WorkerServer,
+)
+from distrl_llm_tpu.distributed.launch import initialize_distributed
+
+__all__ = [
+    "DriverClient",
+    "WorkerDeadError",
+    "WorkerServer",
+    "initialize_distributed",
+]

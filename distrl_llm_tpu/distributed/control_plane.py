@@ -1,0 +1,308 @@
+"""Driver↔worker RPC on the C++ TCP transport (native/csrc/control_plane.cc).
+
+The N5 equivalent of the reference's Ray usage (SURVEY §2b): the reference
+dispatches rollout shards to actor processes and collects results through
+Ray's object store with ray.get timeouts as its only failure detector
+(distributed_trainer.py:190–200, :325–337; ray.get(timeout=240) at :200).
+This module provides those semantics natively:
+
+* ``WorkerServer`` — the worker-side serve loop: receives DISPATCH frames,
+  runs a handler, replies RESULT (or ERROR with the traceback); answers PING
+  with PONG (the health check the reference lacks, SURVEY §5).
+* ``DriverClient`` — the driver side: round-robin shard dispatch with
+  deadlines, health-checked workers, and **shard resubmission**: a shard whose
+  worker times out or dies is re-dispatched to a healthy worker instead of
+  killing the run (the reference's worker death kills the run — SURVEY §5
+  failure detection).
+
+Payloads are opaque bytes; callers pickle (the reference moves pickled Python
+objects through the object store, distributed_actor.py:289–293).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pickle
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+from distrl_llm_tpu.native.build import build_library
+
+log = logging.getLogger(__name__)
+
+MSG_DISPATCH = 1
+MSG_RESULT = 2
+MSG_PING = 3
+MSG_PONG = 4
+MSG_SHUTDOWN = 5
+MSG_ERROR = 6
+
+
+class WorkerDeadError(RuntimeError):
+    """A worker missed its deadline or its connection broke."""
+
+
+class _Lib:
+    _inst = None
+
+    @classmethod
+    def get(cls):
+        if cls._inst is None:
+            lib = ctypes.CDLL(build_library("control_plane.cc"))
+            lib.cp_listen.restype = ctypes.c_int64
+            lib.cp_listen.argtypes = [ctypes.c_int]
+            lib.cp_bound_port.restype = ctypes.c_int
+            lib.cp_bound_port.argtypes = [ctypes.c_int64]
+            lib.cp_accept.restype = ctypes.c_int64
+            lib.cp_accept.argtypes = [ctypes.c_int64, ctypes.c_int]
+            lib.cp_connect.restype = ctypes.c_int64
+            lib.cp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+            lib.cp_send.restype = ctypes.c_int
+            lib.cp_send.argtypes = [
+                ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.cp_recv_header.restype = ctypes.c_int
+            lib.cp_recv_header.argtypes = [
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int,
+            ]
+            lib.cp_recv_payload.restype = ctypes.c_int
+            lib.cp_recv_payload.argtypes = [
+                ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.cp_close.argtypes = [ctypes.c_int64]
+            cls._inst = lib
+        return cls._inst
+
+
+class Connection:
+    """One framed TCP connection."""
+
+    def __init__(self, fd: int):
+        self._lib = _Lib.get()
+        self.fd = fd
+        self._send_mu = threading.Lock()
+
+    def send(self, msg_type: int, req_id: int, payload: bytes = b"",
+             timeout_ms: int = 30_000) -> None:
+        with self._send_mu:
+            rc = self._lib.cp_send(
+                self.fd, msg_type, req_id, payload, len(payload), timeout_ms
+            )
+        if rc != 0:
+            raise WorkerDeadError("send failed (peer gone or deadline hit)")
+
+    def recv(self, timeout_ms: int) -> tuple[int, int, bytes] | None:
+        """One frame, or None on timeout. Raises WorkerDeadError on close."""
+        t = ctypes.c_int()
+        rid = ctypes.c_uint64()
+        ln = ctypes.c_int64()
+        rc = self._lib.cp_recv_header(
+            self.fd, ctypes.byref(t), ctypes.byref(rid), ctypes.byref(ln),
+            timeout_ms,
+        )
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise WorkerDeadError("connection closed")
+        buf = ctypes.create_string_buffer(ln.value) if ln.value else None
+        if ln.value:
+            if self._lib.cp_recv_payload(self.fd, buf, ln.value, timeout_ms) != 0:
+                raise WorkerDeadError("payload truncated")
+        return t.value, rid.value, buf.raw if buf else b""
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            self._lib.cp_close(self.fd)
+            self.fd = -1
+
+
+class WorkerServer:
+    """Worker-side serve loop. ``handler(payload: bytes) -> bytes`` runs per
+    DISPATCH; exceptions travel back as ERROR frames with the traceback."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _Lib.get()
+        self._server_fd = self._lib.cp_listen(port)
+        if self._server_fd < 0:
+            raise OSError(f"cannot listen on port {port}")
+        self.port = self._lib.cp_bound_port(self._server_fd)
+
+    def serve_forever(self, handler: Callable[[bytes], bytes],
+                      accept_timeout_ms: int = 1000) -> None:
+        """Accept one driver connection at a time and serve until SHUTDOWN."""
+        try:
+            while True:
+                fd = self._lib.cp_accept(self._server_fd, accept_timeout_ms)
+                if fd == -1:
+                    continue  # accept timeout; keep listening
+                if fd < 0:
+                    raise OSError("accept failed")
+                conn = Connection(fd)
+                try:
+                    if self._serve_conn(conn, handler):
+                        return  # clean shutdown
+                except WorkerDeadError:
+                    log.info("driver connection dropped; re-listening")
+                finally:
+                    conn.close()
+        finally:
+            self._lib.cp_close(self._server_fd)
+
+    def _serve_conn(self, conn: Connection, handler) -> bool:
+        while True:
+            frame = conn.recv(timeout_ms=1000)
+            if frame is None:
+                continue
+            msg_type, req_id, payload = frame
+            if msg_type == MSG_PING:
+                conn.send(MSG_PONG, req_id)
+            elif msg_type == MSG_SHUTDOWN:
+                conn.send(MSG_PONG, req_id)
+                return True
+            elif msg_type == MSG_DISPATCH:
+                try:
+                    result = handler(payload)
+                    conn.send(MSG_RESULT, req_id, result)
+                except Exception:  # noqa: BLE001 — shipped to the driver
+                    conn.send(
+                        MSG_ERROR, req_id, traceback.format_exc().encode()
+                    )
+            else:
+                log.warning("unexpected frame type %d", msg_type)
+
+
+@dataclass
+class _Worker:
+    address: tuple[str, int]
+    conn: Connection | None
+    healthy: bool = True
+
+
+class DriverClient:
+    """Driver-side dispatch/collect over N workers with failure handling."""
+
+    def __init__(self, addresses: Sequence[tuple[str, int]],
+                 connect_timeout_ms: int = 10_000):
+        self._lib = _Lib.get()
+        self._workers: list[_Worker] = []
+        self._req_id = 0
+        for host, port in addresses:
+            fd = self._lib.cp_connect(host.encode(), port, connect_timeout_ms)
+            if fd < 0:
+                raise OSError(f"cannot connect to worker {host}:{port}")
+            self._workers.append(_Worker((host, port), Connection(fd)))
+
+    @property
+    def num_healthy(self) -> int:
+        return sum(w.healthy for w in self._workers)
+
+    def _next_id(self) -> int:
+        self._req_id += 1
+        return self._req_id
+
+    def ping_all(self, timeout_ms: int = 5000) -> list[bool]:
+        """Health check every worker (SURVEY §5: health-checked workers).
+
+        A missed or mismatched PONG closes the connection: the unanswered
+        PING would otherwise desync the request/response framing (a late
+        PONG surfacing as some future call's reply)."""
+        out = []
+        for w in self._workers:
+            ok = False
+            if w.conn is not None:
+                rid = self._next_id()
+                try:
+                    w.conn.send(MSG_PING, rid)
+                    frame = w.conn.recv(timeout_ms)
+                    ok = (
+                        frame is not None
+                        and frame[0] == MSG_PONG
+                        and frame[1] == rid
+                    )
+                except WorkerDeadError:
+                    ok = False
+                if not ok:
+                    w.conn.close()
+                    w.conn = None
+            w.healthy = ok
+            out.append(ok)
+        return out
+
+    def _call(self, w: _Worker, payload: bytes, timeout_ms: int) -> bytes:
+        rid = self._next_id()
+        w.conn.send(MSG_DISPATCH, rid, payload)
+        frame = w.conn.recv(timeout_ms)
+        if frame is None:
+            raise WorkerDeadError(
+                f"worker {w.address} missed the {timeout_ms}ms deadline"
+            )
+        msg_type, got_rid, body = frame
+        if got_rid != rid or msg_type not in (MSG_RESULT, MSG_ERROR):
+            raise WorkerDeadError(f"worker {w.address} protocol violation")
+        if msg_type == MSG_ERROR:
+            raise RuntimeError(
+                f"worker {w.address} raised:\n{body.decode(errors='replace')}"
+            )
+        return body
+
+    def dispatch_round(self, shards: Sequence[bytes],
+                       timeout_ms: int = 240_000) -> list[bytes]:
+        """Dispatch shard i to worker (i mod N); collect all results.
+
+        The reference's equivalent is actor.generate.remote per chunk +
+        ray.get(timeout=240) (distributed_trainer.py:190–200) — except a
+        timeout there kills the run. Here a dead worker is marked unhealthy
+        and its shard is RESUBMITTED to the next healthy worker; the round
+        only fails when no healthy workers remain."""
+        results: list[bytes | None] = [None] * len(shards)
+        pending = list(range(len(shards)))
+        while pending:
+            healthy = [w for w in self._workers if w.healthy and w.conn]
+            if not healthy:
+                raise WorkerDeadError("no healthy workers remain")
+            failed: list[int] = []
+            # assign round-robin over currently-healthy workers; collect
+            # synchronously per worker (one in-flight shard per worker,
+            # matching the reference's per-actor chunk)
+            assignment = [(i, healthy[k % len(healthy)])
+                          for k, i in enumerate(pending)]
+            for i, w in assignment:
+                if not w.healthy:
+                    failed.append(i)
+                    continue
+                try:
+                    results[i] = self._call(w, shards[i], timeout_ms)
+                except WorkerDeadError as e:
+                    log.warning("resubmitting shard %d: %s", i, e)
+                    w.healthy = False
+                    if w.conn:
+                        w.conn.close()
+                        w.conn = None
+                    failed.append(i)
+            pending = failed
+        return [r for r in results if r is not None]
+
+    def dispatch_objects(self, shards: Sequence[Any],
+                         timeout_ms: int = 240_000) -> list[Any]:
+        """pickle-in / pickle-out convenience over ``dispatch_round``."""
+        raw = self.dispatch_round(
+            [pickle.dumps(s) for s in shards], timeout_ms
+        )
+        return [pickle.loads(r) for r in raw]
+
+    def shutdown(self, timeout_ms: int = 5000) -> None:
+        for w in self._workers:
+            if w.conn is not None:
+                try:
+                    w.conn.send(MSG_SHUTDOWN, self._next_id())
+                    w.conn.recv(timeout_ms)
+                except WorkerDeadError:
+                    pass
+                w.conn.close()
+                w.conn = None

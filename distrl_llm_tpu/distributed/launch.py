@@ -1,0 +1,72 @@
+"""Multi-process JAX entry: jax.distributed.initialize + role assignment.
+
+SURVEY §2b N5 / §7 stage 8: the reference creates its process topology with
+ray.init + a STRICT_PACK placement group (distributed_actor.py:517–585). The
+TPU-native equivalent is multi-controller JAX — one process per TPU host,
+``jax.distributed.initialize`` wiring them into one global device set — plus
+the control plane (control_plane.py) for the driver loop's dispatch/collect
+RPC. Roles then come from ``build_role_meshes`` over the GLOBAL device list:
+mesh partitions, not process types.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ProcessInfo:
+    process_id: int
+    num_processes: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_driver(self) -> bool:
+        # process 0 owns the trainer loop (the reference's single driver
+        # process, SURVEY §1 "single driver process owns the control loop")
+        return self.process_id == 0
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ProcessInfo:
+    """Initialize multi-process JAX and report the process topology.
+
+    With no arguments (or num_processes == 1) this is single-process and a
+    no-op beyond reading device counts — the 1-host path needs no RPC at all
+    (SURVEY §2b N5). Environment fallbacks: DISTRL_COORDINATOR,
+    DISTRL_NUM_PROCESSES, DISTRL_PROCESS_ID (useful under mpirun-style
+    launchers); on Cloud TPU pods jax.distributed.initialize() can also
+    auto-detect with all arguments None.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("DISTRL_COORDINATOR")
+    if num_processes is None and "DISTRL_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DISTRL_NUM_PROCESSES"])
+    if process_id is None and "DISTRL_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DISTRL_PROCESS_ID"])
+
+    if coordinator_address and (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        log.info(
+            "jax.distributed initialized: process %d/%d via %s",
+            jax.process_index(), jax.process_count(), coordinator_address,
+        )
+    return ProcessInfo(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

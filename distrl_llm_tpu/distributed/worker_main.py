@@ -1,0 +1,58 @@
+"""Worker process entrypoint for the control plane.
+
+``python -m distrl_llm_tpu.distributed.worker_main --port 0`` starts a worker
+that prints ``PORT <n>`` on stdout and serves control-plane requests — the
+native counterpart of a Ray actor process (distributed_actor.py:183–193).
+
+Request payloads are pickled ``(op, arg)`` tuples:
+
+* ``("echo", x)`` → x  (liveness / plumbing tests)
+* ``("rollout_rewards", chunk)`` — chunk is a candidate dict shaped like the
+  reference's generate output ({"answers": [...groups...], "solution":
+  [...]}, distributed_actor.py:152–171); returns the per-group (n, 2) reward
+  arrays computed with the parity reward function (reward_functions.py:44–49).
+  This is the driver-side hot loop #2 moved ONTO workers — host-parallel
+  reward computation across processes (SURVEY §3.6.10).
+* ``("sleep", seconds)`` → "slept" (hang-injection tests)
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+import time
+
+
+def handler(payload: bytes) -> bytes:
+    from distrl_llm_tpu.rewards import reward_function
+
+    op, arg = pickle.loads(payload)
+    if op == "echo":
+        return pickle.dumps(arg)
+    if op == "sleep":
+        time.sleep(float(arg))
+        return pickle.dumps("slept")
+    if op == "rollout_rewards":
+        rewards = [
+            reward_function(answers, solutions)
+            for answers, solutions in zip(arg["answers"], arg["solution"])
+        ]
+        return pickle.dumps(rewards)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from distrl_llm_tpu.distributed.control_plane import WorkerServer
+
+    server = WorkerServer(port=args.port)
+    print(f"PORT {server.port}", flush=True)
+    server.serve_forever(handler)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Multi-process control-plane tests (N5): real worker subprocesses on CPU.
+
+The VERDICT r1 minimum bar: a 2-process test that dispatches a rollout shard
+and collects rewards over the control plane — plus health checks and the
+shard-resubmission failure path the reference lacks (its worker death kills
+the run, SURVEY §5).
+"""
+
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.distributed.control_plane import DriverClient, WorkerDeadError
+from distrl_llm_tpu.native.build import native_available
+from distrl_llm_tpu.utils.chunking import chunk_sizes, split_dict_lists
+
+pytestmark = [
+    pytest.mark.distributed,
+    pytest.mark.skipif(not native_available(), reason="g++ not available"),
+]
+
+
+def spawn_worker():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distrl_llm_tpu.distributed.worker_main", "--port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
+@pytest.fixture
+def two_workers():
+    procs, addrs = [], []
+    for _ in range(2):
+        p, port = spawn_worker()
+        procs.append(p)
+        addrs.append(("127.0.0.1", port))
+    yield procs, addrs
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+
+
+class TestDispatchCollect:
+    def test_rollout_shard_rewards_roundtrip(self, two_workers):
+        """Driver splits a candidate batch with the reference chunking math,
+        ships each shard to a worker process, and collects (n, 2) reward
+        arrays — the reference's _generate_round/_compute_round_rewards RPC
+        pattern (distributed_trainer.py:190–215) over our plane."""
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+
+        # two task groups of 2 candidates each, chunked like the reference
+        batch = {
+            "answers": [
+                ["<answer>4</answer>", "wrong"],
+                ["<think>t</think>\n<answer>9</answer>", "<answer>8</answer>"],
+            ],
+            "solution": [["4", "4"], ["9", "9"]],
+        }
+        sizes = chunk_sizes(2, num_actors=2, num_learners=1, learner_chunk_size=0)
+        assert sum(sizes) == 2
+        shards = split_dict_lists(batch, sizes[:2])
+        payloads = [("rollout_rewards", s) for s in shards]
+        results = driver.dispatch_objects(payloads, timeout_ms=30_000)
+
+        assert len(results) == 2
+        r0 = results[0][0]  # first shard, first group: (2, 2) rewards
+        assert r0.shape == (2, 2)
+        assert r0[0, 1] == 1.0 and r0[1, 1] == 0.0  # accuracy column
+        r1 = results[1][0]
+        assert r1[0, 1] == 1.0 and r1[1, 1] == 0.0
+        driver.shutdown()
+        for p in procs:
+            assert p.wait(timeout=10) == 0
+
+    def test_health_check(self, two_workers):
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        assert driver.ping_all() == [True, True]
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        assert driver.ping_all() == [False, True]
+        driver.shutdown()
+
+    def test_shard_resubmission_on_worker_death(self, two_workers):
+        """A dead worker's shard is re-dispatched to the survivor instead of
+        killing the round (SURVEY §5 failure: resubmission on timeout)."""
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+
+        payloads = [("echo", i) for i in range(4)]
+        results = driver.dispatch_objects(payloads, timeout_ms=10_000)
+        assert sorted(results) == [0, 1, 2, 3]
+        assert driver.num_healthy == 1
+        driver.shutdown()
+
+    def test_worker_exception_propagates(self, two_workers):
+        _, addrs = two_workers
+        driver = DriverClient(addrs[:1])
+        with pytest.raises(RuntimeError, match="unknown op"):
+            driver.dispatch_objects([("nope", None)], timeout_ms=10_000)
+        driver.shutdown()
+
+    def test_all_workers_dead_raises(self, two_workers):
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+        with pytest.raises(WorkerDeadError, match="no healthy workers"):
+            driver.dispatch_objects([("echo", 1)], timeout_ms=2000)
+
+
+class TestJaxDistributed:
+    def test_two_process_initialize(self, tmp_path):
+        """jax.distributed.initialize across 2 CPU processes: both see the
+        global process topology (the multi-controller entry path, SURVEY §7
+        stage 8)."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        script = (
+            "import os, sys\n"
+            "sys.path.insert(0, os.getcwd())\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from distrl_llm_tpu.distributed import initialize_distributed\n"
+            f"info = initialize_distributed('127.0.0.1:{port}', 2, int(sys.argv[1]))\n"
+            "assert info.num_processes == 2, info\n"
+            "assert info.global_device_count == 2 * info.local_device_count\n"
+            "print('OK', info.process_id)\n"
+        )
+        import os
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env.pop("XLA_FLAGS", None)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, str(pid)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            for pid in range(2)
+        ]
+        outs = [p.communicate(timeout=120) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"stdout={out}\nstderr={err}"
+            assert "OK" in out
