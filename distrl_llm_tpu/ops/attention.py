@@ -113,14 +113,19 @@ def attention(
     mask: jax.Array | None,
     scale: float | None = None,
     impl: str = "reference",
+    key_valid: jax.Array | None = None,
 ) -> jax.Array:
     """Dispatching front door. ``impl``: "reference" (XLA) or "flash" (Pallas,
-    TPU only; warns once and falls back to reference where unsupported)."""
+    TPU only; warns once and falls back to reference where unsupported).
+
+    ``key_valid`` is the [B, Sk] validity vector; the flash path consumes it
+    directly (no [B, 1, Sq, Sk] mask needs to exist). When only ``key_valid``
+    is given and the fallback runs, the dense causal mask is built here."""
     if impl == "flash":
         try:
             from distrl_llm_tpu.ops.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, mask, scale=scale)
+            return flash_attention(q, k, v, mask, scale=scale, key_valid=key_valid)
         except (ImportError, NotImplementedError) as e:
             global _flash_fallback_warned
             if not _flash_fallback_warned:
@@ -131,4 +136,6 @@ def attention(
                     "flash attention unavailable (%s); falling back to the XLA "
                     "reference path — O(Sq*Sk) memory", e,
                 )
+    if mask is None and key_valid is not None:
+        mask = causal_padding_mask(key_valid, q_len=q.shape[1])
     return attention_reference(q, k, v, mask, scale=scale)

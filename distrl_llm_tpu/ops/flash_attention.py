@@ -44,6 +44,7 @@ def flash_attention(
     v: jax.Array,  # [B, Sk, K, D]
     mask: jax.Array | None,  # [B, 1, Sq, Sk] from causal_padding_mask
     scale: float | None = None,
+    key_valid: jax.Array | None = None,  # [B, Sk]; preferred over mask
 ) -> jax.Array:
     if jax.default_backend() != "tpu":
         raise NotImplementedError("flash attention requires the TPU backend")
@@ -64,9 +65,12 @@ def flash_attention(
         k = jnp.repeat(k, h // kh, axis=2)
         v = jnp.repeat(v, h // kh, axis=2)
 
-    # key validity from the mask's last query row: with causal ∧ padding and
-    # q_offset=0, row S-1 attends exactly the valid keys
-    if mask is not None:
+    if key_valid is not None:
+        # direct [B, Sk] contract — no dense mask was ever materialized
+        valid = key_valid.astype(jnp.int32)
+    elif mask is not None:
+        # legacy contract: key validity from the mask's last query row (with
+        # causal ∧ padding and q_offset=0, row S-1 attends exactly the valid keys)
         valid = mask[:, 0, -1, :].astype(jnp.int32)  # [B, Sk]
     else:
         valid = jnp.ones((b, sk), jnp.int32)

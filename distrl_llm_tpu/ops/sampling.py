@@ -67,17 +67,23 @@ def sample(
     logits: jax.Array,  # [B, V]
     temperature: jax.Array | float,
     top_p: jax.Array | float = 1.0,
+    top_p_impl: str = "bisect",
 ) -> jax.Array:
     """Sample token ids [B]. temperature == 0 → greedy (vLLM convention).
 
     Temperature and top_p may be traced scalars so train/eval sampling params
     (1.2/0.95 vs 0.6/0.95 — distributed_trainer.py:53–58) share one compiled
     decode loop.
+
+    ``top_p_impl`` (static): "bisect" (default, sort-free — the fast path) or
+    "exact" (rank-based sort filter, byte-identical to the reference's vLLM
+    nucleus semantics) for reproducibility runs — SamplingConfig.top_p_exact.
     """
     greedy = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     scaled = logits.astype(jnp.float32) / t
-    filtered = top_p_filter_bisect(scaled, top_p)
+    filter_fn = top_p_filter if top_p_impl == "exact" else top_p_filter_bisect
+    filtered = filter_fn(scaled, top_p)
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
     return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)
