@@ -141,16 +141,25 @@ def main() -> int:
     dtype = jnp.bfloat16 if devices[0].platform == "tpu" else jnp.float32
     params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank, dtype=dtype)
+    from distrl_llm_tpu.config import parse_buckets
+
+    buckets = parse_buckets(os.environ.get("BENCH_PROMPT_BUCKETS"))
+    # Fraction of the batch left-padded to half length. Default 1/3 models a
+    # ragged batch; to MEASURE bucketing, set BENCH_SHORT_FRACTION=1 and a
+    # bucket ≥ max_prompt/2 (bucket choice follows the batch's LONGEST real
+    # prompt, so any full-length row pins the full bucket).
+    short_fraction = float(os.environ.get("BENCH_SHORT_FRACTION", str(1 / 3)))
     engine = GenerationEngine(
         cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
         eos_token_ids=[151645 % cfg.vocab_size], pad_token_id=151643 % cfg.vocab_size,
+        prompt_buckets=buckets or None,
     )
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, min(cfg.vocab_size, 50000), size=(n_prompts, max_prompt)).astype(np.int32)
     pmask = np.ones_like(prompts)
-    # ragged prompts: left-pad a third of the batch to half length
-    pmask[: n_prompts // 3, : max_prompt // 2] = 0
-    prompts[: n_prompts // 3, : max_prompt // 2] = engine.pad_id
+    n_short = int(round(n_prompts * min(max(short_fraction, 0.0), 1.0)))
+    pmask[:n_short, : max_prompt // 2] = 0
+    prompts[:n_short, : max_prompt // 2] = engine.pad_id
     sampling = SamplingConfig(max_tokens=max_new, temperature=1.2, top_p=0.95, n=n_cand)
 
     def run(seed: int):
@@ -176,6 +185,8 @@ def main() -> int:
 
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
+        "bucket_used": engine.bucket_for(pmask),
+        "short_fraction": round(short_fraction, 3),
         "value": round(tps_chip, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(tps_chip / REFERENCE_TOKENS_PER_SEC_PER_GPU, 3),

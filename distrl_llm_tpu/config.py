@@ -41,6 +41,19 @@ class SamplingConfig:
         return dataclasses.replace(self, **kw)
 
 
+def parse_buckets(spec: str | None) -> tuple[int, ...]:
+    """Parse a comma-separated bucket list ("128,256") into a tuple; shared
+    by the CLI and bench so the format cannot drift."""
+    if not spec:
+        return ()
+    try:
+        return tuple(int(x) for x in str(spec).split(",") if x.strip())
+    except ValueError as e:
+        raise ValueError(
+            f"prompt_buckets must be comma-separated integers, got {spec!r}"
+        ) from e
+
+
 @dataclass
 class MeshConfig:
     """How chips are carved into roles and parallelism axes.
@@ -121,6 +134,10 @@ class TrainConfig:
     # use the exact sort-based nucleus filter (reference vLLM semantics)
     # instead of the fast bisection filter, for reproducibility runs
     top_p_exact: bool = False
+    # prompt length buckets for the rollout engine (SURVEY §2b N1): each
+    # round compiles/runs at the smallest bucket holding its longest real
+    # prompt. Empty = single bucket at max_prompt_tokens.
+    prompt_buckets: tuple[int, ...] = ()
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}

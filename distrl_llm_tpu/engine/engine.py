@@ -154,6 +154,7 @@ class GenerationEngine:
         cache_dtype=jnp.bfloat16,
         attn_impl: str = "reference",
         decode_chunk: int = 128,
+        prompt_buckets: Sequence[int] | None = None,
     ):
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
@@ -162,15 +163,21 @@ class GenerationEngine:
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale
+        self.cache_dtype = cache_dtype
+        self.attn_impl = attn_impl
         self.decode_chunk = decode_chunk
+        # Length bucketing (SURVEY §2b N1 "static batch + length bucketing
+        # first"): each generation round runs at the smallest bucket holding
+        # its longest real prompt, cutting prefill FLOPs and every decode
+        # step's KV length for short batches. One compile per bucket used.
+        buckets = sorted(set(prompt_buckets or [])) or [max_prompt_tokens]
+        if any(b <= 0 or b > max_prompt_tokens for b in buckets):
+            raise ValueError(f"buckets must be in (0, {max_prompt_tokens}]: {buckets}")
+        if buckets[-1] != max_prompt_tokens:
+            buckets.append(max_prompt_tokens)
+        self.prompt_buckets = buckets
+        self._compiled: dict[int, tuple] = {}
 
-        self._prefill = jax.jit(
-            partial(
-                _prefill, cfg=cfg, max_total=self.max_total,
-                lora_scale=lora_scale, cache_dtype=cache_dtype,
-                attn_impl=attn_impl,
-            )
-        )
         # n and max_steps are static (shape-determining)
         self._decode_init = jax.jit(
             partial(_decode_init, pad_id=self.pad_id),
@@ -178,16 +185,44 @@ class GenerationEngine:
             # no cache donation: the candidate fan-out (jnp.repeat to B·n
             # rows) allocates fresh buffers the prefill cache can't alias
         )
-        # state is donated: each step updates the multi-GB cache in place
-        # (verified zero HBM temp bytes via compile memory_analysis)
-        self._decode_step = jax.jit(
-            partial(
-                _decode_step, cfg=cfg, prompt_len=max_prompt_tokens,
-                pad_id=self.pad_id, lora_scale=lora_scale, attn_impl=attn_impl,
-            ),
-            donate_argnames=("state",),
-            static_argnames=("top_p_impl",),
-        )
+
+    def bucket_for(self, prompt_mask) -> int:
+        """The bucket a batch with this mask will run at: the smallest bucket
+        holding the longest real prompt."""
+        if len(self.prompt_buckets) == 1:
+            return self.prompt_buckets[0]
+        longest = int(np.asarray(prompt_mask).sum(axis=-1).max())
+        return next(bb for bb in self.prompt_buckets if bb >= max(longest, 1))
+
+    def is_warm(self, bucket: int) -> bool:
+        """Whether this bucket's programs have been built (first use of a
+        bucket pays XLA compilation — callers with hang detectors exempt cold
+        buckets, trainer._call_engine)."""
+        return bucket in self._compiled
+
+    def _fns_for_bucket(self, bucket: int) -> tuple:
+        """(prefill, decode_step) jits for one prompt bucket — the step is
+        donated so the cache updates in place (verified zero HBM temp bytes
+        via compile memory_analysis)."""
+        if bucket not in self._compiled:
+            prefill = jax.jit(
+                partial(
+                    _prefill, cfg=self.cfg, max_total=bucket + self.max_new_tokens,
+                    lora_scale=self.lora_scale, cache_dtype=self.cache_dtype,
+                    attn_impl=self.attn_impl,
+                )
+            )
+            step = jax.jit(
+                partial(
+                    _decode_step, cfg=self.cfg, prompt_len=bucket,
+                    pad_id=self.pad_id, lora_scale=self.lora_scale,
+                    attn_impl=self.attn_impl,
+                ),
+                donate_argnames=("state",),
+                static_argnames=("top_p_impl",),
+            )
+            self._compiled[bucket] = (prefill, step)
+        return self._compiled[bucket]
 
     def generate(
         self,
@@ -202,7 +237,16 @@ class GenerationEngine:
         if p != self.max_prompt_tokens:
             raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
         max_steps = min(sampling.max_tokens, self.max_new_tokens)
-        cache, key_mask, last_logits = self._prefill(
+
+        # bucket selection: smallest bucket holding the longest real prompt;
+        # prompts are left-padded, so the bucket keeps the trailing columns
+        bucket = self.bucket_for(prompt_mask)
+        if bucket < p:
+            prompt_ids = prompt_ids[:, p - bucket:]
+            prompt_mask = prompt_mask[:, p - bucket:]
+        prefill_fn, decode_step_fn = self._fns_for_bucket(bucket)
+
+        cache, key_mask, last_logits = prefill_fn(
             params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
         )
         row_alive = jnp.asarray(prompt_mask).sum(axis=-1) > 0
@@ -227,7 +271,7 @@ class GenerationEngine:
         steps_done = 0
         stop = False
         while steps_done < max_steps and not stop:
-            state = self._decode_step(
+            state = decode_step_fn(
                 params, lora, state, rng,
                 eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
                 top_p_impl=top_p_impl,

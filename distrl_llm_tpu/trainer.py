@@ -230,6 +230,7 @@ class Trainer:
             pad_token_id=tokenizer.pad_token_id or tokenizer.eos_token_id,
             lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
             attn_impl=config.attn_impl,
+            prompt_buckets=config.prompt_buckets or None,
         )
         return cls(
             train_dataset, test_dataset, reward_function, config,
@@ -317,6 +318,12 @@ class Trainer:
         be interrupted — like the reference, the recovery unit is the process
         (checkpoint + restart with resume=True)."""
         timeout = self.config.generation_timeout_s
+        if timeout > 0 and hasattr(self.engine, "bucket_for"):
+            # first use of a length bucket pays XLA compilation (minutes at
+            # scale) — a cold bucket mid-run is slow, not hung; exempt it
+            bucket = self.engine.bucket_for(args[3])  # args: (params, lora, ids, MASK, ...)
+            if not self.engine.is_warm(bucket):
+                timeout = 0.0
         if timeout <= 0:
             return self.engine.generate(*args)
 

@@ -138,3 +138,69 @@ class TestValidation:
                 params, None, ids[:, :4], mask[:, :4],
                 SamplingConfig(max_tokens=4, n=1), jax.random.PRNGKey(0),
             )
+
+
+class TestLengthBucketing:
+    """SURVEY §2b N1: short batches run at a smaller compiled bucket with
+    identical outputs (left-pad columns are fully masked, so dropping them
+    cannot change the math)."""
+
+    def make_bucketed(self, buckets, max_new=6):
+        return GenerationEngine(
+            TINY, max_prompt_tokens=P_LEN, max_new_tokens=max_new,
+            eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32, prompt_buckets=buckets,
+        )
+
+    def test_short_batch_uses_small_bucket(self, setup):
+        params, ids, mask = setup
+        # longest real prompt: row 1 with 8 real tokens → full bucket; shrink
+        # both rows to ≤4 real tokens to hit the small bucket
+        ids2, mask2 = ids.copy(), mask.copy()
+        ids2[:, :4] = 0
+        mask2[:, :4] = 0
+        engine = self.make_bucketed([4])
+        res = engine.generate(
+            params, None, ids2, mask2,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        assert list(engine._compiled) == [4]
+        expected = naive_greedy(params, ids2, mask2, 6)
+        np.testing.assert_array_equal(res.tokens[:, 0, :], expected)
+
+    def test_long_batch_uses_full_bucket(self, setup):
+        params, ids, mask = setup
+        engine = self.make_bucketed([4])
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        assert list(engine._compiled) == [P_LEN]
+        expected = naive_greedy(params, ids, mask, 6)
+        np.testing.assert_array_equal(res.tokens[:, 0, :], expected)
+
+    def test_bucket_choice_matches_unbucketed_outputs(self, setup):
+        params, ids, mask = setup
+        ids2, mask2 = ids.copy(), mask.copy()
+        ids2[:, :4] = 0
+        mask2[:, :4] = 0
+        plain = make_engine(max_new=6).generate(
+            params, None, ids2, mask2,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        bucketed = self.make_bucketed([4]).generate(
+            params, None, ids2, mask2,
+            SamplingConfig(max_tokens=6, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        np.testing.assert_array_equal(plain.tokens, bucketed.tokens)
+        np.testing.assert_array_equal(plain.lengths, bucketed.lengths)
+
+    def test_invalid_buckets_raise(self):
+        with pytest.raises(ValueError, match="buckets"):
+            self.make_bucketed([0])
+        with pytest.raises(ValueError, match="buckets"):
+            self.make_bucketed([P_LEN + 1])

@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write_adapter_file", action="store_true",
                    help="export the reference's per-step adapter artifact")
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--prompt_buckets", type=str, default="",
+                   help="comma-separated prompt length buckets for the "
+                        "rollout engine, e.g. 128,256 (max_prompt_tokens is "
+                        "always included)")
     p.add_argument("--top_p_exact", action="store_true",
                    help="exact sort-based nucleus filter (reference vLLM "
                         "semantics) instead of the fast bisection filter")
@@ -88,6 +92,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         k: v for k, v in vars(args).items()
         if k in TrainConfig.__dataclass_fields__
     }
+    from distrl_llm_tpu.config import parse_buckets
+
+    fields["prompt_buckets"] = parse_buckets(args.prompt_buckets)
     return TrainConfig(mesh=mesh, **fields)
 
 
