@@ -28,6 +28,7 @@ TP/DP-sharded; pass host arrays and it runs single-chip.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Any, NamedTuple, Sequence
 
@@ -177,6 +178,9 @@ class GenerationEngine:
             buckets.append(max_prompt_tokens)
         self.prompt_buckets = buckets
         self._compiled: dict[int, tuple] = {}
+        # concurrent generate() calls (hybrid rollout: actor + learner
+        # submeshes decode in parallel threads) share the compiled-fn cache
+        self._compile_mu = threading.Lock()
 
         # n and max_steps are static (shape-determining)
         self._decode_init = jax.jit(
@@ -204,25 +208,26 @@ class GenerationEngine:
         """(prefill, decode_step) jits for one prompt bucket — the step is
         donated so the cache updates in place (verified zero HBM temp bytes
         via compile memory_analysis)."""
-        if bucket not in self._compiled:
-            prefill = jax.jit(
-                partial(
-                    _prefill, cfg=self.cfg, max_total=bucket + self.max_new_tokens,
-                    lora_scale=self.lora_scale, cache_dtype=self.cache_dtype,
-                    attn_impl=self.attn_impl,
+        with self._compile_mu:
+            if bucket not in self._compiled:
+                prefill = jax.jit(
+                    partial(
+                        _prefill, cfg=self.cfg, max_total=bucket + self.max_new_tokens,
+                        lora_scale=self.lora_scale, cache_dtype=self.cache_dtype,
+                        attn_impl=self.attn_impl,
+                    )
                 )
-            )
-            step = jax.jit(
-                partial(
-                    _decode_step, cfg=self.cfg, prompt_len=bucket,
-                    pad_id=self.pad_id, lora_scale=self.lora_scale,
-                    attn_impl=self.attn_impl,
-                ),
-                donate_argnames=("state",),
-                static_argnames=("top_p_impl",),
-            )
-            self._compiled[bucket] = (prefill, step)
-        return self._compiled[bucket]
+                step = jax.jit(
+                    partial(
+                        _decode_step, cfg=self.cfg, prompt_len=bucket,
+                        pad_id=self.pad_id, lora_scale=self.lora_scale,
+                        attn_impl=self.attn_impl,
+                    ),
+                    donate_argnames=("state",),
+                    static_argnames=("top_p_impl",),
+                )
+                self._compiled[bucket] = (prefill, step)
+            return self._compiled[bucket]
 
     def generate(
         self,

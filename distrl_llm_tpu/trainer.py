@@ -151,6 +151,9 @@ class Trainer:
         self.batch_in_episode = 0  # mid-episode resume cursor (SURVEY §5)
         self.weight_version = 0  # incremented per optimizer step
         self._rollout_weight_version = -1  # version resident on the rollout mesh
+        # (role, bucket, rows, n) executables seen — cold ones are exempt
+        # from the generation hang detector (compile is slow, not hung)
+        self._warm_engine_keys: set[tuple] = set()
 
         self.profiler = None
         if config.profile_dir:
@@ -310,22 +313,98 @@ class Trainer:
         self._rng, key = jax.random.split(self._rng)
         return key
 
-    def _call_engine(self, *args):
+    def _dispatch_rollout(
+        self, prompt_ids, prompt_mask, sampling: SamplingConfig, n_real: int
+    ):
+        """Run one generation round over every role's chips.
+
+        Hybrid learner-generation (README.md:19; dispatch at
+        distributed_trainer.py:194–197): with disjoint role submeshes, the
+        batch splits by ``chunk_sizes`` — the actors' share decodes on the
+        rollout mesh while the learners' ``learner_chunk_size`` share decodes
+        CONCURRENTLY on the otherwise-idle learner mesh (two threads; JAX
+        dispatches to disjoint devices in parallel). Timeshared roles, and
+        partial batches whose real rows all fit the actor share (the padding
+        rows at the tail would be the learners' only work), take the
+        single-call path."""
+        cfg = self.config
+        hybrid = (
+            self.meshes is not None
+            and not self.meshes.timeshared
+            and cfg.number_of_actors > 0
+            and cfg.learner_chunk_size > 0
+        )
+        if hybrid:
+            sizes = chunk_sizes(
+                prompt_ids.shape[0], cfg.number_of_actors,
+                cfg.number_of_learners, cfg.learner_chunk_size,
+            )
+            actor_rows = sum(sizes[: cfg.number_of_actors])
+            if actor_rows >= n_real:
+                hybrid = False  # learner share would be padding-only
+        if not hybrid:
+            return self._call_engine(
+                self.base_params, self._lora_rollout,
+                prompt_ids, prompt_mask, sampling, self._next_rng(),
+                role="rollout",
+            )
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        key_a, key_l = self._next_rng(), self._next_rng()
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            fut_a = pool.submit(
+                self._call_engine, self.base_params, self._lora_rollout,
+                prompt_ids[:actor_rows], prompt_mask[:actor_rows], sampling, key_a,
+                role="rollout",
+            )
+            # the learner share samples with the learner-resident adapter —
+            # definitionally the current version
+            fut_l = pool.submit(
+                self._call_engine, self.base_params_learner, self.lora,
+                prompt_ids[actor_rows:], prompt_mask[actor_rows:], sampling, key_l,
+                role="learner",
+            )
+            res_a, res_l = fut_a.result(), fut_l.result()
+        finally:
+            # never join a possibly-hung sibling here: a raised
+            # EngineHangError must reach train()'s checkpoint handler
+            pool.shutdown(wait=False)
+        from distrl_llm_tpu.engine.engine import GenerationResult
+
+        return GenerationResult(
+            tokens=np.concatenate([res_a.tokens, res_l.tokens], axis=0),
+            lengths=np.concatenate([res_a.lengths, res_l.lengths], axis=0),
+        )
+
+    def _call_engine(self, *args, role: str = "rollout"):
         """Engine call with the configured hang detector: the generation runs
         in a watchdog thread and exceeding ``generation_timeout_s`` raises
         ``EngineHangError`` (the reference's ray.get(timeout=240) equivalent,
         distributed_trainer.py:200). The hung device computation itself cannot
         be interrupted — like the reference, the recovery unit is the process
-        (checkpoint + restart with resume=True)."""
+        (checkpoint + restart with resume=True).
+
+        Cold executables are exempt: XLA specializes per (bucket, batch
+        shape, placement), so warmness is tracked per (role, bucket, rows) —
+        a first compile minutes long is slow, not hung."""
         timeout = self.config.generation_timeout_s
-        if timeout > 0 and hasattr(self.engine, "bucket_for"):
-            # first use of a length bucket pays XLA compilation (minutes at
-            # scale) — a cold bucket mid-run is slow, not hung; exempt it
-            bucket = self.engine.bucket_for(args[3])  # args: (params, lora, ids, MASK, ...)
-            if not self.engine.is_warm(bucket):
+        warm_key = None
+        if timeout > 0:
+            ids, mask, sampling = args[2], args[3], args[4]
+            bucket = (
+                self.engine.bucket_for(mask)
+                if hasattr(self.engine, "bucket_for") else 0
+            )
+            warm_key = (role, bucket, ids.shape[0], sampling.n)
+            if warm_key not in self._warm_engine_keys:
                 timeout = 0.0
         if timeout <= 0:
-            return self.engine.generate(*args)
+            result = self.engine.generate(*args)
+            if warm_key is not None:
+                self._warm_engine_keys.add(warm_key)
+            return result
 
         import threading
 
@@ -377,14 +456,7 @@ class Trainer:
                 f"but learner is at v{self.weight_version}; _push_weights() "
                 "was not called after the last optimizer step"
             )
-        result = self._call_engine(
-            self.base_params,
-            self._lora_rollout,
-            prompt_ids,
-            prompt_mask,
-            sampling,
-            self._next_rng(),
-        )
+        result = self._dispatch_rollout(prompt_ids, prompt_mask, sampling, b_real)
 
         n = sampling.n
         answers, token_lengths = [], []

@@ -136,6 +136,34 @@ class TestDisjointRoles:
         finally:
             trainer.weight_version -= 1
 
+    def test_hybrid_generation_uses_both_meshes(self, trainer):
+        """The reference's learners generate too (README.md:19,
+        distributed_trainer.py:194–197): with disjoint submeshes the batch
+        splits by chunk_sizes and the learner share decodes on the learner
+        mesh with the learner-resident adapter."""
+        calls = []
+        orig = trainer._call_engine
+
+        def spy(*args, **kw):
+            calls.append(args)
+            return orig(*args, **kw)
+
+        trainer._call_engine = spy
+        try:
+            cands = trainer._generate_all_candidates(BATCH)
+        finally:
+            trainer._call_engine = orig
+        assert len(calls) == 2  # chunk_sizes(4, 1, 1, 1) → [3, 1]
+        assert calls[0][2].shape[0] == 3 and calls[1][2].shape[0] == 1
+        # actor share samples the rollout-mesh copies; learner share the
+        # learner-resident base + adapter
+        assert calls[0][0] is trainer.base_params
+        assert calls[0][1] is trainer._lora_rollout
+        assert calls[1][0] is trainer.base_params_learner
+        assert calls[1][1] is trainer.lora
+        # the merged round still covers the full batch in order
+        assert len(cands[0]["answers"]) == len(BATCH["problem"])
+
     def test_lora_is_sharded_not_replicated(self, trainer):
         """The adapter itself must actually shard over the learner mesh's
         fsdp/tp axes — a replicated adapter would make `--fsdp` a lie."""
