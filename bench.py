@@ -124,7 +124,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from distrl_llm_tpu.config import SamplingConfig
-    from distrl_llm_tpu.engine import GenerationEngine
+    from distrl_llm_tpu.engine import GenerationEngine, PagedGenerationEngine
     from distrl_llm_tpu.models import QWEN2_0_5B, TINY, init_lora_params, init_params
     from distrl_llm_tpu.models.configs import QWEN2_7B
 
@@ -149,7 +149,11 @@ def main() -> int:
     # bucket ≥ max_prompt/2 (bucket choice follows the batch's LONGEST real
     # prompt, so any full-length row pins the full bucket).
     short_fraction = float(os.environ.get("BENCH_SHORT_FRACTION", str(1 / 3)))
-    engine = GenerationEngine(
+    engine_cls = (
+        PagedGenerationEngine if os.environ.get("BENCH_ENGINE") == "paged"
+        else GenerationEngine
+    )
+    engine = engine_cls(
         cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
         eos_token_ids=[151645 % cfg.vocab_size], pad_token_id=151643 % cfg.vocab_size,
         prompt_buckets=buckets or None,
@@ -185,6 +189,7 @@ def main() -> int:
 
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
+        "engine": os.environ.get("BENCH_ENGINE", "dense"),
         "bucket_used": engine.bucket_for(pmask),
         "short_fraction": round(short_fraction, 3),
         "value": round(tps_chip, 1),

@@ -138,6 +138,9 @@ class TrainConfig:
     # round compiles/runs at the smallest bucket holding its longest real
     # prompt. Empty = single bucket at max_prompt_tokens.
     prompt_buckets: tuple[int, ...] = ()
+    # rollout engine implementation: "dense" (fixed-shape cache) or "paged"
+    # (packed ragged KV pages + Pallas paged-attention decode — the full N1)
+    engine_impl: str = "dense"
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}
@@ -166,6 +169,8 @@ class TrainConfig:
             raise ValueError(f"learner must be 'pg' or 'grpo', got {self.learner!r}")
         if self.base_quant not in ("none", "int8", "int4"):
             raise ValueError(f"base_quant must be none/int8/int4, got {self.base_quant!r}")
+        if self.engine_impl not in ("dense", "paged"):
+            raise ValueError(f"engine_impl must be dense/paged, got {self.engine_impl!r}")
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if self.number_of_learners <= 0:

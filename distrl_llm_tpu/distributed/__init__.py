@@ -6,10 +6,16 @@ from distrl_llm_tpu.distributed.control_plane import (
     WorkerServer,
 )
 from distrl_llm_tpu.distributed.launch import initialize_distributed
+from distrl_llm_tpu.distributed.remote_engine import (
+    RemoteEngine,
+    connect_remote_engine,
+)
 
 __all__ = [
     "DriverClient",
+    "RemoteEngine",
     "WorkerDeadError",
     "WorkerServer",
+    "connect_remote_engine",
     "initialize_distributed",
 ]

@@ -192,6 +192,7 @@ class DriverClient:
         self._lib = _Lib.get()
         self._workers: list[_Worker] = []
         self._req_id = 0
+        self._id_mu = threading.Lock()  # per-worker drain threads share it
         for host, port in addresses:
             fd = self._lib.cp_connect(host.encode(), port, connect_timeout_ms)
             if fd < 0:
@@ -203,8 +204,9 @@ class DriverClient:
         return sum(w.healthy for w in self._workers)
 
     def _next_id(self) -> int:
-        self._req_id += 1
-        return self._req_id
+        with self._id_mu:
+            self._req_id += 1
+            return self._req_id
 
     def ping_all(self, timeout_ms: int = 5000) -> list[bool]:
         """Health check every worker (SURVEY §5: health-checked workers).
@@ -253,39 +255,52 @@ class DriverClient:
 
     def dispatch_round(self, shards: Sequence[bytes],
                        timeout_ms: int = 240_000) -> list[bytes]:
-        """Dispatch shard i to worker (i mod N); collect all results.
+        """Dispatch shards round-robin over healthy workers, ALL workers
+        working concurrently (one thread per worker draining its queue — the
+        parallel fan-out that is this plane's whole purpose; a worker's own
+        shards run sequentially over its single connection).
 
         The reference's equivalent is actor.generate.remote per chunk +
         ray.get(timeout=240) (distributed_trainer.py:190–200) — except a
         timeout there kills the run. Here a dead worker is marked unhealthy
-        and its shard is RESUBMITTED to the next healthy worker; the round
+        and its shards are RESUBMITTED to the remaining workers; the round
         only fails when no healthy workers remain."""
+        from concurrent.futures import ThreadPoolExecutor
+
         results: list[bytes | None] = [None] * len(shards)
         pending = list(range(len(shards)))
         while pending:
             healthy = [w for w in self._workers if w.healthy and w.conn]
             if not healthy:
                 raise WorkerDeadError("no healthy workers remain")
-            failed: list[int] = []
-            # assign round-robin over currently-healthy workers; collect
-            # synchronously per worker (one in-flight shard per worker,
-            # matching the reference's per-actor chunk)
-            assignment = [(i, healthy[k % len(healthy)])
-                          for k, i in enumerate(pending)]
-            for i, w in assignment:
-                if not w.healthy:
-                    failed.append(i)
-                    continue
-                try:
-                    results[i] = self._call(w, shards[i], timeout_ms)
-                except WorkerDeadError as e:
-                    log.warning("resubmitting shard %d: %s", i, e)
-                    w.healthy = False
-                    if w.conn:
-                        w.conn.close()
-                        w.conn = None
-                    failed.append(i)
-            pending = failed
+            queues: dict[int, list[int]] = {id(w): [] for w in healthy}
+            for k, i in enumerate(pending):
+                queues[id(healthy[k % len(healthy)])].append(i)
+
+            def drain(w: _Worker, idxs: list[int]) -> list[int]:
+                failed: list[int] = []
+                for i in idxs:
+                    try:
+                        results[i] = self._call(w, shards[i], timeout_ms)
+                    except WorkerDeadError as e:
+                        log.warning("resubmitting shard %d: %s", i, e)
+                        w.healthy = False
+                        if w.conn:
+                            w.conn.close()
+                            w.conn = None
+                        failed.extend(idxs[idxs.index(i):])
+                        break
+                return failed
+
+            pool = ThreadPoolExecutor(max_workers=len(healthy))
+            try:
+                futs = [
+                    pool.submit(drain, w, queues[id(w)])
+                    for w in healthy if queues[id(w)]
+                ]
+                pending = [i for f in futs for i in f.result()]
+            finally:
+                pool.shutdown(wait=False)
         return [r for r in results if r is not None]
 
     def dispatch_objects(self, shards: Sequence[Any],

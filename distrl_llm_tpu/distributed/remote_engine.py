@@ -1,0 +1,103 @@
+"""RemoteEngine: the engine protocol over control-plane worker processes.
+
+The multi-process rollout fan-out (SURVEY §2c "DP rollout"): the reference
+dispatches batch chunks to Ray actor processes, each running its own GPU
+engine (distributed_trainer.py:187–200). This adapter implements the exact
+engine surface the Trainer drives (``generate(params, lora, prompt_ids,
+prompt_mask, sampling, rng) -> GenerationResult``) by splitting the batch
+with the reference's ``even_chunks`` math, shipping each shard — WITH the
+current LoRA adapter as arrays, the over-the-wire weight sync replacing the
+shared-filesystem bus (distributed_actor.py:150) — to a worker process, and
+reassembling the results in order. Worker failure triggers the control
+plane's shard resubmission, not a run abort.
+
+``params`` is intentionally ignored: each worker holds its own resident base
+model, exactly like a Ray actor holds its own GPU copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.distributed.control_plane import DriverClient
+from distrl_llm_tpu.engine.engine import GenerationResult
+from distrl_llm_tpu.utils.chunking import even_chunks
+
+
+class RemoteEngine:
+    """Engine facade over N control-plane workers."""
+
+    def __init__(
+        self,
+        driver: DriverClient,
+        *,
+        max_prompt_tokens: int,
+        max_new_tokens: int,
+        timeout_ms: int = 240_000,  # the reference's ray.get(timeout=240)
+    ):
+        self.driver = driver
+        self.max_prompt_tokens = max_prompt_tokens
+        self.max_new_tokens = max_new_tokens
+        self.timeout_ms = timeout_ms
+
+    def generate(
+        self,
+        params,  # unused: workers hold their own base model
+        lora,
+        prompt_ids: np.ndarray,
+        prompt_mask: np.ndarray,
+        sampling: SamplingConfig,
+        rng: jax.Array,
+    ) -> GenerationResult:
+        b, p = prompt_ids.shape
+        if p != self.max_prompt_tokens:
+            raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
+        n_workers = max(self.driver.num_healthy, 1)
+        sizes = even_chunks(b, min(n_workers, b))
+        lora_np = (
+            jax.tree_util.tree_map(np.asarray, lora) if lora is not None else None
+        )
+        # per-shard rng seeds derived from the round key so candidates differ
+        # across shards and rounds but replay deterministically
+        seeds = np.asarray(
+            jax.random.randint(rng, (len(sizes),), 0, np.iinfo(np.int32).max)
+        )
+        shards = []
+        start = 0
+        for i, size in enumerate(sizes):
+            shards.append((
+                "generate",
+                {
+                    "prompt_ids": np.asarray(prompt_ids[start : start + size]),
+                    "prompt_mask": np.asarray(prompt_mask[start : start + size]),
+                    "sampling": dataclasses.asdict(sampling),
+                    "lora": lora_np,
+                    "rng_seed": int(seeds[i]),
+                },
+            ))
+            start += size
+        results = self.driver.dispatch_objects(shards, timeout_ms=self.timeout_ms)
+        tokens = np.concatenate([r["tokens"] for r in results], axis=0)
+        lengths = np.concatenate([r["lengths"] for r in results], axis=0)
+        return GenerationResult(tokens=tokens, lengths=lengths)
+
+
+def connect_remote_engine(
+    addresses: Sequence[tuple[str, int]],
+    *,
+    max_prompt_tokens: int,
+    max_new_tokens: int,
+    timeout_ms: int = 240_000,
+) -> RemoteEngine:
+    """Connect to running workers and wrap them as an engine."""
+    return RemoteEngine(
+        DriverClient(addresses),
+        max_prompt_tokens=max_prompt_tokens,
+        max_new_tokens=max_new_tokens,
+        timeout_ms=timeout_ms,
+    )

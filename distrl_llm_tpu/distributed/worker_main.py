@@ -13,6 +13,11 @@ Request payloads are pickled ``(op, arg)`` tuples:
   arrays computed with the parity reward function (reward_functions.py:44–49).
   This is the driver-side hot loop #2 moved ONTO workers — host-parallel
   reward computation across processes (SURVEY §3.6.10).
+* ``("generate", shard)`` — a rollout shard: the worker runs its OWN
+  generation engine over ``prompt_ids``/``prompt_mask`` with the shipped
+  LoRA adapter (weight sync over the wire — the multi-host replacement for
+  the reference's shared-filesystem adapter bus, distributed_actor.py:150)
+  and returns {tokens, lengths}. Requires ``--serve-model``.
 * ``("sleep", seconds)`` → "slept" (hang-injection tests)
 """
 
@@ -22,6 +27,43 @@ import argparse
 import pickle
 import sys
 import time
+
+_ENGINE_STATE: dict = {}
+
+
+def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
+                 seed: int) -> None:
+    """Build this worker's rollout engine. "tiny" → deterministic random-init
+    TINY model (tests/smoke; every worker with the same seed holds identical
+    weights); anything else is a local HF checkpoint path."""
+    import jax
+    import jax.numpy as jnp
+
+    from distrl_llm_tpu.engine.engine import GenerationEngine
+    from distrl_llm_tpu.models import TINY, init_params
+
+    if model == "tiny":
+        cfg = TINY
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        eos = [cfg.vocab_size - 1]
+        pad = 0
+        cache_dtype = jnp.float32
+    else:
+        from distrl_llm_tpu.models.loading import load_pretrained
+        from distrl_llm_tpu.tokenizer import load_tokenizer
+
+        import numpy as np
+
+        params, cfg = load_pretrained(model, dtype=np.dtype("bfloat16"))
+        tok = load_tokenizer(model)
+        eos = [tok.eos_token_id]
+        pad = tok.pad_token_id if tok.pad_token_id is not None else tok.eos_token_id
+        cache_dtype = jnp.bfloat16
+    _ENGINE_STATE["engine"] = GenerationEngine(
+        cfg, max_prompt_tokens=max_prompt_tokens, max_new_tokens=max_new_tokens,
+        eos_token_ids=eos, pad_token_id=pad, cache_dtype=cache_dtype,
+    )
+    _ENGINE_STATE["params"] = params
 
 
 def handler(payload: bytes) -> bytes:
@@ -39,13 +81,54 @@ def handler(payload: bytes) -> bytes:
             for answers, solutions in zip(arg["answers"], arg["solution"])
         ]
         return pickle.dumps(rewards)
+    if op == "generate":
+        if "engine" not in _ENGINE_STATE:
+            raise RuntimeError("worker started without --serve-model")
+        import jax
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.config import SamplingConfig
+
+        lora = arg["lora"]
+        if lora is not None:
+            lora = jax.tree_util.tree_map(jnp.asarray, lora)
+        result = _ENGINE_STATE["engine"].generate(
+            _ENGINE_STATE["params"], lora,
+            arg["prompt_ids"], arg["prompt_mask"],
+            SamplingConfig(**arg["sampling"]),
+            jax.random.PRNGKey(arg["rng_seed"]),
+        )
+        return pickle.dumps({"tokens": result.tokens, "lengths": result.lengths})
     raise ValueError(f"unknown op {op!r}")
 
 
 def main(argv: list[str] | None = None) -> None:
+    import os
+
+    # Honor JAX_PLATFORMS even where a sitecustomize-registered TPU plugin
+    # stomps the env var and hangs with no reachable chip (same workaround as
+    # train_distributed.py / tests/conftest.py).
+    requested = os.environ.get("JAX_PLATFORMS", "").strip()
+    if requested:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--serve-model", type=str, default=None,
+                        help='"tiny" (random-init test model) or a local HF '
+                             "checkpoint path; enables the generate op")
+    parser.add_argument("--max-prompt-tokens", type=int, default=350)
+    parser.add_argument("--max-new-tokens", type=int, default=1200)
+    parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+
+    if args.serve_model:
+        _init_engine(
+            args.serve_model, args.max_prompt_tokens, args.max_new_tokens,
+            args.seed,
+        )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer
 

@@ -1,1 +1,2 @@
 from distrl_llm_tpu.engine.engine import GenerationEngine, GenerationResult  # noqa: F401
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine  # noqa: F401

@@ -80,6 +80,10 @@ def _layer(
     attn_impl: str,
     attn_mesh=None,
     key_valid: jax.Array | None = None,  # [B, S] for the ring path
+    paged_lengths: jax.Array | None = None,  # [B] — paged-cache mode
+    page_indices: jax.Array | None = None,  # [B, pps]
+    page_size: int = 0,
+    paged_impl: str = "auto",
 ):
     b, s, _ = x.shape
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -89,7 +93,29 @@ def _layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if cache_k is not None:
+    if cache_k is not None and page_indices is not None:
+        # paged cache (ops/paged.py — the N1 ragged decode path): cache_k/v
+        # are page arrays [K, total_pages, ps, hd]; sequences are PACKED, so
+        # attention reads each row's true [0, length) prefix only.
+        from distrl_llm_tpu.ops.paged import (
+            paged_attention_op, write_prompt_to_pages, write_token_to_pages,
+        )
+
+        if s == 1:
+            cache_k = write_token_to_pages(
+                cache_k, k[:, 0], paged_lengths, page_indices, page_size)
+            cache_v = write_token_to_pages(
+                cache_v, v[:, 0], paged_lengths, page_indices, page_size)
+            att = paged_attention_op(
+                q[:, 0], cache_k, cache_v, paged_lengths + 1, page_indices,
+                impl=paged_impl,
+            )[:, None]
+        else:
+            # packed prefill: write the prompt pages, attend over the input
+            cache_k = write_prompt_to_pages(cache_k, k, page_indices, page_size)
+            cache_v = write_prompt_to_pages(cache_v, v, page_indices, page_size)
+            att = attention(q, k, v, mask, impl=attn_impl, key_valid=key_valid)
+    elif cache_k is not None:
         k_t = k.astype(cache_k.dtype).transpose(0, 2, 3, 1)  # [B, K, hd, S]
         v_t = v.astype(cache_v.dtype).transpose(0, 2, 3, 1)
         cache_k = jax.lax.dynamic_update_slice(cache_k, k_t, (0, 0, 0, cache_offset))
@@ -137,20 +163,31 @@ def forward(
     attn_impl: str = "reference",
     attn_mesh=None,  # jax Mesh with an "sp" axis; required for attn_impl="ring"
     logits_slice: tuple[int, int] | None = None,  # (start, length) along seq
+    logits_positions: jax.Array | None = None,  # [B] per-row position gather
+    page_size: int = 0,  # static; paged-cache mode (ops/paged.py)
+    paged_impl: str = "auto",
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
     Without a cache this is the training/prefill path (causal over the input);
-    with a cache (per-layer tuples from init_kv_cache — NOT a stacked array;
-    the cached path also always uses attention_cached, ignoring ``attn_impl``),
-    queries attend to all cache keys marked valid by
+    with a dense cache (per-layer tuples from init_kv_cache — NOT a stacked
+    array; the cached path also always uses attention_cached, ignoring
+    ``attn_impl``), queries attend to all cache keys marked valid by
     ``attention_mask`` (length Smax) and new K/V are written at
     ``cache_offset``. Contract: ``cache_offset + S <= Smax`` — the engine sizes
     caches as prompt+max_tokens so this holds by construction; writes past
     capacity would be silently clamped by dynamic_update_slice.
+
+    A PAGED cache (``init_paged_kv_cache`` plus traced "lengths" [B] and
+    "page_indices" [B, pps] entries in the dict, with the static
+    ``page_size``/``paged_impl`` kwargs) switches to the ragged N1 path:
+    sequences are packed, prefill self-attends over the input while writing
+    prompt pages, and decode runs paged attention over each row's true
+    [0, length+1) prefix.
     """
     b, s = input_ids.shape
-    if kv_cache is not None and isinstance(cache_offset, int):
+    paged = kv_cache is not None and "page_indices" in kv_cache
+    if kv_cache is not None and not paged and isinstance(cache_offset, int):
         smax = kv_cache["k"][0].shape[-1]
         if cache_offset + s > smax:
             raise ValueError(
@@ -163,16 +200,24 @@ def forward(
 
     x = jnp.take(params["embed"], input_ids, axis=0)
 
-    sk = kv_cache["k"][0].shape[-1] if kv_cache is not None else s
+    # paged caches attend raggedly by per-row length (decode) or over the
+    # packed input only (prefill) — the dense key window is the input itself
+    sk = kv_cache["k"][0].shape[-1] if (kv_cache is not None and not paged) else s
     if attention_mask is None:
         attention_mask = jnp.ones((b, sk), dtype=jnp.int32)
     # ring and (uncached) flash consume the [B, S] validity vector directly —
     # building the [B, 1, S, S] mask for them would cost O(S²) memory on
     # exactly the long-context paths those kernels exist to avoid (it is also
     # DCE'd under jit, but eager/non-jit callers would pay it)
-    needs_dense_mask = kv_cache is not None or attn_impl not in ("ring", "flash")
+    needs_dense_mask = (
+        (kv_cache is not None and not paged)
+        or (paged and s > 1 and attn_impl not in ("ring", "flash"))
+        or (kv_cache is None and attn_impl not in ("ring", "flash"))
+    )
     mask = (
-        causal_padding_mask(attention_mask, q_len=s, q_offset=cache_offset)
+        causal_padding_mask(
+            attention_mask, q_len=s, q_offset=0 if paged else cache_offset
+        )
         if needs_dense_mask else None
     )
 
@@ -187,6 +232,10 @@ def forward(
         attn_impl=attn_impl,
         attn_mesh=attn_mesh,
         key_valid=attention_mask,
+        paged_lengths=kv_cache.get("lengths") if paged else None,
+        page_indices=kv_cache.get("page_indices") if paged else None,
+        page_size=page_size,
+        paged_impl=paged_impl,
     )
 
     xs = (params["layers"], lora["layers"] if lora is not None else None)
@@ -229,10 +278,20 @@ def forward(
         # discards all prompt logits, so slicing the hidden states first skips
         # ~P/(P+T) of the lm_head FLOPs and the [B, P, V] buffer
         x = jax.lax.dynamic_slice_in_dim(x, logits_slice[0], logits_slice[1], axis=1)
+    elif logits_positions is not None:
+        # per-row gather (packed prompts end at different columns): [B, 1, D]
+        idx = jnp.broadcast_to(
+            logits_positions[:, None, None].astype(jnp.int32),
+            (x.shape[0], 1, x.shape[-1]),
+        )
+        x = jnp.take_along_axis(x, idx, axis=1)
     lm_head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = linear(x, lm_head).astype(jnp.float32)
 
-    new_cache = {"k": new_k, "v": new_v} if kv_cache is not None else None
+    if kv_cache is None:
+        new_cache = None
+    else:
+        new_cache = {**kv_cache, "k": new_k, "v": new_v}
     return logits, new_cache
 
 

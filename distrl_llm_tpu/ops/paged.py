@@ -1,0 +1,191 @@
+"""Paged KV cache ops: page tables, token writes, and ragged paged attention.
+
+The N1 core the reference delegates to vLLM's PagedAttention
+(requirements.txt:6; engine entered via ``policy.fast_generate``,
+distributed_actor.py:148–150). TPU-native design:
+
+* **Pages** are [num_kv_heads, total_pages, page_size, head_dim] arrays per
+  layer; a row's sequence lives at the pages listed in its ``page_indices``
+  row, valid up to ``lengths[row]`` tokens. Prompts are PACKED (position 0 is
+  the first real token — no left padding inside the cache), so attention
+  bandwidth is proportional to each row's true length, not the cache
+  capacity: the decode kernel only reads [0, length) — vLLM's ragged read,
+  where the dense cache reads all of Smax every step for every row.
+* **Static page tables.** vLLM's C++ block allocator exists to multiplex an
+  unknown online request stream; an RL rollout round is a FIXED batch of
+  B·n candidates with known capacity, so the table is a host-computed
+  constant per round (row-major identity layout today; the indirection layer
+  is what lets prompt-prefix sharing land without touching the kernel).
+* **Kernel**: jaxlib's Pallas TPU ``paged_attention`` (Mosaic) on TPU; a
+  jnp reference with identical semantics elsewhere and for parity tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distrl_llm_tpu.ops.attention import NEG_INF
+
+DEFAULT_PAGE_SIZE = 128
+
+
+def pages_per_seq(max_len: int, page_size: int = DEFAULT_PAGE_SIZE) -> int:
+    return -(-max_len // page_size)
+
+
+def make_page_table(
+    n_rows: int, max_len: int, page_size: int = DEFAULT_PAGE_SIZE
+) -> np.ndarray:
+    """Row-major identity page table: row r owns pages [r·pps, (r+1)·pps).
+
+    int32 [n_rows, pages_per_seq]. Total pages = n_rows · pages_per_seq."""
+    pps = pages_per_seq(max_len, page_size)
+    return (
+        np.arange(n_rows, dtype=np.int32)[:, None] * pps
+        + np.arange(pps, dtype=np.int32)[None, :]
+    )
+
+
+def init_paged_kv_cache(
+    cfg, n_rows: int, max_len: int, page_size: int = DEFAULT_PAGE_SIZE,
+    dtype=jnp.bfloat16,
+):
+    """Per-layer page arrays for ``n_rows`` sequences of capacity ``max_len``.
+
+    Layout [K, total_pages, page_size, hd] matches the Pallas kernel's
+    contract (paged_attention_kernel.py)."""
+    pps = pages_per_seq(max_len, page_size)
+    shape = (cfg.num_kv_heads, n_rows * pps, page_size, cfg.head_dim)
+    return {
+        "k": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+        "v": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+    }
+
+
+def write_prompt_to_pages(
+    pages: jax.Array,  # [K, total_pages, ps, hd]
+    prompt_kv: jax.Array,  # [B, P, K, hd] packed (row position 0 = first token)
+    page_indices: jax.Array,  # [B, pps_total]
+    page_size: int,
+) -> jax.Array:
+    """Write every row's packed prompt KV into its leading pages.
+
+    P must be a multiple of page_size (callers pad; positions beyond a row's
+    real length hold garbage that ``lengths`` masking never reads)."""
+    b, p, kh, hd = prompt_kv.shape
+    assert p % page_size == 0, (p, page_size)
+    n_prompt_pages = p // page_size
+    # [B, P, K, hd] → [K, B·n_prompt_pages, ps, hd]
+    tiles = (
+        prompt_kv.reshape(b, n_prompt_pages, page_size, kh, hd)
+        .transpose(3, 0, 1, 2, 4)
+        .reshape(kh, b * n_prompt_pages, page_size, hd)
+    )
+    dest = page_indices[:, :n_prompt_pages].reshape(-1)  # [B·n_prompt_pages]
+    return pages.at[:, dest].set(tiles.astype(pages.dtype))
+
+
+def write_token_to_pages(
+    pages: jax.Array,  # [K, total_pages, ps, hd]
+    new_kv: jax.Array,  # [B, K, hd] — one token per row
+    lengths: jax.Array,  # [B] current token counts (write position)
+    page_indices: jax.Array,  # [B, pps]
+    page_size: int,
+) -> jax.Array:
+    """Scatter one decoded token's KV into each row's current page slot."""
+    b = new_kv.shape[0]
+    rows = jnp.arange(b)
+    page = page_indices[rows, lengths // page_size]  # [B]
+    slot = lengths % page_size  # [B]
+    return pages.at[:, page, slot].set(
+        new_kv.transpose(1, 0, 2).astype(pages.dtype)
+    )
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [B, H, hd] — single decode query per row
+    k_pages: jax.Array,  # [K, total_pages, ps, hd]
+    v_pages: jax.Array,  # [K, total_pages, ps, hd]
+    lengths: jax.Array,  # [B] valid token counts (incl. current position)
+    page_indices: jax.Array,  # [B, pps]
+    scale: float | None = None,
+) -> jax.Array:
+    """jnp semantics-reference for the Pallas kernel: gather each row's pages
+    and run masked GQA attention over its valid prefix."""
+    b, h, hd = q.shape
+    kh = k_pages.shape[0]
+    g = h // kh
+    ps = k_pages.shape[2]
+    if scale is None:
+        scale = hd**-0.5
+    # gather [K, B, pps, ps, hd] → [B, K, S, hd]
+    k = k_pages[:, page_indices].transpose(1, 0, 2, 3, 4)
+    v = v_pages[:, page_indices].transpose(1, 0, 2, 3, 4)
+    s = k.shape[2] * ps
+    k = k.reshape(b, kh, s, hd)
+    v = v.reshape(b, kh, s, hd)
+    qg = q.reshape(b, kh, g, hd).astype(jnp.float32)
+    logits = jnp.einsum("bkgd,bksd->bkgs", qg, k.astype(jnp.float32)) * scale
+    valid = jnp.arange(s)[None, :] < lengths[:, None]  # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgs,bksd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
+_kernel_fail_warned = False
+
+
+def paged_attention_op(
+    q: jax.Array,  # [B, H, hd]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    lengths: jax.Array,
+    page_indices: jax.Array,
+    *,
+    impl: str = "auto",
+    pages_per_compute_block: int = 4,
+) -> jax.Array:
+    """Dispatch: Pallas TPU kernel when available, jnp reference otherwise.
+
+    ``impl``: "auto" (kernel on TPU backends, reference elsewhere),
+    "kernel", or "reference"."""
+    use_kernel = impl == "kernel" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+    )
+    if use_kernel:
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention,
+            )
+
+            # the kernel computes raw q·k (no internal scaling) and requires
+            # pages_per_sequence % pages_per_compute_block == 0
+            pps = page_indices.shape[1]
+            blocks = max(
+                (d for d in range(1, min(pages_per_compute_block, pps) + 1)
+                 if pps % d == 0),
+                default=1,
+            )
+            scaled_q = q * (q.shape[-1] ** -0.5)
+            return paged_attention(
+                scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
+                page_indices, pages_per_compute_block=blocks,
+            ).astype(q.dtype)
+        except Exception as e:  # noqa: BLE001 — fall back with one warning
+            if impl == "kernel":
+                raise
+            global _kernel_fail_warned
+            if not _kernel_fail_warned:
+                _kernel_fail_warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "paged_attention kernel unavailable (%s); using reference",
+                    e,
+                )
+    return paged_attention_reference(q, k_pages, v_pages, lengths, page_indices)

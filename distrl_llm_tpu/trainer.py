@@ -195,6 +195,7 @@ class Trainer:
         already loaded it (the CLI does, for dataset templating).
         """
         from distrl_llm_tpu.engine.engine import GenerationEngine
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
         from distrl_llm_tpu.models.loading import load_pretrained
         from distrl_llm_tpu.parallel.partition import param_specs, shard_tree
         from distrl_llm_tpu.tokenizer import load_tokenizer
@@ -225,12 +226,20 @@ class Trainer:
         extra_eos = getattr(tokenizer, "eos_token_ids", None)
         if extra_eos:
             eos = sorted(set(eos) | set(extra_eos))
-        engine = GenerationEngine(
+        engine_cls = (
+            PagedGenerationEngine if config.engine_impl == "paged"
+            else GenerationEngine
+        )
+        engine = engine_cls(
             model_cfg,
             max_prompt_tokens=config.max_prompt_tokens,
             max_new_tokens=config.max_new_tokens,
             eos_token_ids=eos,
-            pad_token_id=tokenizer.pad_token_id or tokenizer.eos_token_id,
+            pad_token_id=(
+                tokenizer.pad_token_id
+                if tokenizer.pad_token_id is not None
+                else tokenizer.eos_token_id
+            ),
             lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
             attn_impl=config.attn_impl,
             prompt_buckets=config.prompt_buckets or None,

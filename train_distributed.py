@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--base_quant", type=str, default="none", choices=["none", "int8", "int4"])
     p.add_argument("--attn_impl", type=str, default="reference",
                    choices=["reference", "flash", "ring"])
+    p.add_argument("--engine_impl", type=str, default="dense",
+                   choices=["dense", "paged"],
+                   help="rollout engine: dense fixed-shape cache, or paged "
+                        "ragged KV (Pallas paged-attention decode)")
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--seed", type=int, default=3407)
     p.add_argument("--checkpoint_dir", type=str, default=None)
