@@ -141,6 +141,12 @@ class TrainConfig:
     # rollout engine implementation: "dense" (fixed-shape cache) or "paged"
     # (packed ragged KV pages + Pallas paged-attention decode — the full N1)
     engine_impl: str = "dense"
+    # control-plane rollout workers ("host:port", ...): when set, generation
+    # dispatches to these worker processes (distributed/worker_main.py) over
+    # the C++ control plane instead of running on local chips — the
+    # multi-host actor fan-out (SURVEY §2b N5). The adapter ships with every
+    # round; the local mesh serves the learner only.
+    rollout_workers: tuple[str, ...] = ()
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}

@@ -32,6 +32,8 @@ from distrl_llm_tpu.utils.chunking import even_chunks
 class RemoteEngine:
     """Engine facade over N control-plane workers."""
 
+    is_remote = True  # trainer: disables local hybrid dispatch
+
     def __init__(
         self,
         driver: DriverClient,
@@ -39,11 +41,16 @@ class RemoteEngine:
         max_prompt_tokens: int,
         max_new_tokens: int,
         timeout_ms: int = 240_000,  # the reference's ray.get(timeout=240)
+        cold_timeout_ms: int = 1_800_000,  # first round: worker-side XLA compile
+        lora_scale: float = 1.0,
     ):
         self.driver = driver
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
         self.timeout_ms = timeout_ms
+        self.cold_timeout_ms = cold_timeout_ms
+        self.lora_scale = lora_scale
+        self._warm = False
 
     def generate(
         self,
@@ -77,11 +84,18 @@ class RemoteEngine:
                     "prompt_mask": np.asarray(prompt_mask[start : start + size]),
                     "sampling": dataclasses.asdict(sampling),
                     "lora": lora_np,
+                    "lora_scale": self.lora_scale,
                     "rng_seed": int(seeds[i]),
                 },
             ))
             start += size
-        results = self.driver.dispatch_objects(shards, timeout_ms=self.timeout_ms)
+        # a cold worker's first shard pays full XLA compilation — minutes,
+        # not a hang; the steady-state deadline applies from round 2
+        timeout = self.timeout_ms if self._warm else max(
+            self.timeout_ms, self.cold_timeout_ms
+        )
+        results = self.driver.dispatch_objects(shards, timeout_ms=timeout)
+        self._warm = True
         tokens = np.concatenate([r["tokens"] for r in results], axis=0)
         lengths = np.concatenate([r["lengths"] for r in results], axis=0)
         return GenerationResult(tokens=tokens, lengths=lengths)
@@ -93,6 +107,7 @@ def connect_remote_engine(
     max_prompt_tokens: int,
     max_new_tokens: int,
     timeout_ms: int = 240_000,
+    lora_scale: float = 1.0,
 ) -> RemoteEngine:
     """Connect to running workers and wrap them as an engine."""
     return RemoteEngine(
@@ -100,4 +115,5 @@ def connect_remote_engine(
         max_prompt_tokens=max_prompt_tokens,
         max_new_tokens=max_new_tokens,
         timeout_ms=timeout_ms,
+        lora_scale=lora_scale,
     )

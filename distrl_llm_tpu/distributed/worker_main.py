@@ -32,7 +32,7 @@ _ENGINE_STATE: dict = {}
 
 
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
-                 seed: int) -> None:
+                 seed: int, lora_rank: int = 32, lora_alpha: float = 16.0) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -59,9 +59,13 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         eos = [tok.eos_token_id]
         pad = tok.pad_token_id if tok.pad_token_id is not None else tok.eos_token_id
         cache_dtype = jnp.bfloat16
+    from distrl_llm_tpu.models.lora import lora_scale as _scale
+
+    _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
     _ENGINE_STATE["engine"] = GenerationEngine(
         cfg, max_prompt_tokens=max_prompt_tokens, max_new_tokens=max_new_tokens,
         eos_token_ids=eos, pad_token_id=pad, cache_dtype=cache_dtype,
+        lora_scale=_ENGINE_STATE["lora_scale"],
     )
     _ENGINE_STATE["params"] = params
 
@@ -92,6 +96,16 @@ def handler(payload: bytes) -> bytes:
         lora = arg["lora"]
         if lora is not None:
             lora = jax.tree_util.tree_map(jnp.asarray, lora)
+            # the adapter is only meaningful at the trainer's alpha/rank
+            # scale — a mismatch means sampling a DIFFERENT policy than the
+            # learner optimizes; fail loudly instead (review r2)
+            want = arg.get("lora_scale")
+            have = _ENGINE_STATE["lora_scale"]
+            if want is not None and abs(want - have) > 1e-9:
+                raise ValueError(
+                    f"lora_scale mismatch: trainer sends {want}, worker "
+                    f"engine built with {have} (--lora-rank/--lora-alpha)"
+                )
         result = _ENGINE_STATE["engine"].generate(
             _ENGINE_STATE["params"], lora,
             arg["prompt_ids"], arg["prompt_mask"],
@@ -122,12 +136,14 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--max-prompt-tokens", type=int, default=350)
     parser.add_argument("--max-new-tokens", type=int, default=1200)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--lora-rank", type=int, default=32)
+    parser.add_argument("--lora-alpha", type=float, default=16.0)
     args = parser.parse_args(argv)
 
     if args.serve_model:
         _init_engine(
             args.serve_model, args.max_prompt_tokens, args.max_new_tokens,
-            args.seed,
+            args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer

@@ -136,6 +136,42 @@ def _decode_step(params, lora, state: _DecodeState, rng,
     )
 
 
+def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
+    """Host-dispatched decode loop shared by the dense and paged engines:
+    call ``step_fn(state) -> state`` up to ``max_steps`` times with async
+    early exit.
+
+    Every ``check`` steps a COPY of the done flags (the original is donated
+    into the next step) starts an async device→host transfer; the oldest
+    snapshot is read only once a newer one is in flight, so the read waits on
+    a transfer that finished steps ago, never on the device's current step.
+    Worst-case overshoot after all rows hit EOS is ~2·check steps — the
+    fixed-shape analogue of continuous batching draining its tail."""
+    from collections import deque
+
+    check = max(1, min(decode_chunk, 16))
+    snapshots: deque = deque()
+    steps_done = 0
+    while steps_done < max_steps:
+        state = step_fn(state)
+        steps_done += 1
+        if steps_done % check == 0 or steps_done == max_steps:
+            snap = jnp.copy(state.done)
+            try:
+                snap.copy_to_host_async()
+            except AttributeError:
+                pass
+            snapshots.append(snap)
+            stop = False
+            while len(snapshots) > 1:
+                if bool(np.asarray(snapshots.popleft()).all()):
+                    stop = True
+                    break
+            if stop:
+                break
+    return state
+
+
 class GenerationEngine:
     """Compiled rollout engine bound to (model config, shapes, eos/pad ids).
 
@@ -262,37 +298,14 @@ class GenerationEngine:
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
         top_p_impl = "exact" if sampling.top_p_exact else "bisect"
-        # Early exit without pipeline bubbles: every ``check`` steps a COPY of
-        # the done flags (the original is donated into the next step) starts
-        # an async device→host transfer; the oldest snapshot is read only
-        # once a newer one is in flight, so the read waits on a transfer that
-        # finished steps ago, never on the device's current step. Worst-case
-        # overshoot after all rows hit EOS is ~2·check steps — the fixed-shape
-        # analogue of continuous batching draining its tail.
-        check = max(1, min(self.decode_chunk, 16))
-        from collections import deque
-
-        snapshots: deque = deque()
-        steps_done = 0
-        stop = False
-        while steps_done < max_steps and not stop:
-            state = decode_step_fn(
-                params, lora, state, rng,
+        state = run_decode_loop(
+            lambda s: decode_step_fn(
+                params, lora, s, rng,
                 eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
                 top_p_impl=top_p_impl,
-            )
-            steps_done += 1
-            if steps_done % check == 0 or steps_done == max_steps:
-                snap = jnp.copy(state.done)
-                try:
-                    snap.copy_to_host_async()
-                except AttributeError:
-                    pass
-                snapshots.append(snap)
-                while len(snapshots) > 1:
-                    if bool(np.asarray(snapshots.popleft()).all()):
-                        stop = True
-                        break
+            ),
+            state, max_steps, self.decode_chunk,
+        )
         out = np.asarray(state.out).reshape(b, sampling.n, max_steps)
         lengths = np.asarray(state.lengths).reshape(b, sampling.n)
         return GenerationResult(tokens=out, lengths=lengths)

@@ -20,7 +20,6 @@ distributed_actor.py:148–150), built TPU-native:
 
 from __future__ import annotations
 
-from collections import deque
 from functools import partial
 from typing import Any, NamedTuple, Sequence
 
@@ -29,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distrl_llm_tpu.config import SamplingConfig
-from distrl_llm_tpu.engine.engine import GenerationResult
+from distrl_llm_tpu.engine.engine import GenerationResult, run_decode_loop
 from distrl_llm_tpu.models.configs import ModelConfig
 from distrl_llm_tpu.models.transformer import forward
 from distrl_llm_tpu.ops.paged import (
@@ -264,28 +263,14 @@ class PagedGenerationEngine:
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
         top_p_impl = "exact" if sampling.top_p_exact else "bisect"
-        check = max(1, min(self.decode_chunk, 16))
-        snapshots: deque = deque()
-        steps_done = 0
-        stop = False
-        while steps_done < max_steps and not stop:
-            state = self._decode_step(
-                params, lora, state, rng, page_indices,
+        state = run_decode_loop(
+            lambda s: self._decode_step(
+                params, lora, s, rng, page_indices,
                 eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
                 top_p_impl=top_p_impl,
-            )
-            steps_done += 1
-            if steps_done % check == 0 or steps_done == max_steps:
-                snap = jnp.copy(state.done)
-                try:
-                    snap.copy_to_host_async()
-                except AttributeError:
-                    pass
-                snapshots.append(snap)
-                while len(snapshots) > 1:
-                    if bool(np.asarray(snapshots.popleft()).all()):
-                        stop = True
-                        break
+            ),
+            state, max_steps, self.decode_chunk,
+        )
         out = np.asarray(state.out).reshape(b, n, max_steps)
         lengths = np.asarray(state.gen_lengths).reshape(b, n)
         return GenerationResult(tokens=out, lengths=lengths)

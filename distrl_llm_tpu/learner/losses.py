@@ -42,6 +42,8 @@ def answer_logprobs(
     remat: bool = True,
     attn_impl: str = "reference",
     attn_mesh=None,
+    lora_dropout: float = 0.0,
+    dropout_rng: jax.Array | None = None,
 ) -> jax.Array:
     """Per-token logprobs of the answer under the current policy, [B, T] f32.
 
@@ -60,6 +62,7 @@ def answer_logprobs(
         attention_mask=full_mask, lora=lora, lora_scale=lora_scale,
         remat=remat, attn_impl=attn_impl, attn_mesh=attn_mesh,
         logits_slice=(p - 1, t),
+        lora_dropout=lora_dropout, dropout_rng=dropout_rng,
     )  # [B, T, V]
     gathered = jnp.take_along_axis(pred, answer_ids[..., None], axis=-1)[..., 0]
     return gathered - jax.nn.logsumexp(pred, axis=-1)

@@ -49,13 +49,15 @@ class UpdateBatch(NamedTuple):
 def _microbatch_loss(
     lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
-    attn_impl: str, attn_mesh=None,
+    attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
+    dropout_rng=None,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight."""
     logps = answer_logprobs(
         base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
         mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
         attn_impl=attn_impl, attn_mesh=attn_mesh,
+        lora_dropout=lora_dropout, dropout_rng=dropout_rng,
     )
     loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
     loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
@@ -87,6 +89,7 @@ def make_train_step(
     attn_impl: str = "reference",
     attn_mesh=None,
     donate: bool = True,
+    lora_dropout: float = 0.0,
 ) -> Callable:
     """Build the jitted train step.
 
@@ -105,9 +108,11 @@ def make_train_step(
         remat=remat,
         attn_impl=attn_impl,
         attn_mesh=attn_mesh,
+        lora_dropout=lora_dropout,
     )
 
-    def step(lora, opt_state, base_params, batch: UpdateBatch):
+    def step(lora, opt_state, base_params, batch: UpdateBatch,
+             dropout_rng=None):
         n = batch.prompt_ids.shape[0]
         assert n % micro_size == 0, f"batch {n} not divisible by micro {micro_size}"
         num_micro = n // micro_size
@@ -116,18 +121,26 @@ def make_train_step(
         )
 
         grad_fn = jax.value_and_grad(
-            lambda lo, mb: loss_fn(lo, base_params, mb=mb), has_aux=True
+            lambda lo, mb, key: loss_fn(lo, base_params, mb=mb, dropout_rng=key),
+            has_aux=True,
+        )
+        # independent dropout masks per microbatch (None → dropout disabled)
+        micro_keys = (
+            jax.random.split(dropout_rng, num_micro)
+            if dropout_rng is not None else None
         )
 
-        def accumulate(carry, mb):
+        def accumulate(carry, xs):
+            mb, key = xs
             grads_acc, loss_acc, nb_acc = carry
-            (loss, (weight, has_real)), grads = grad_fn(lora, mb)
+            (loss, (weight, has_real)), grads = grad_fn(lora, mb, key)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, loss_acc + loss, nb_acc + has_real), None
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, lora)
         (grads, loss_sum, num_real_micro), _ = jax.lax.scan(
-            accumulate, (zero_grads, jnp.zeros([]), jnp.zeros([])), micro
+            accumulate, (zero_grads, jnp.zeros([]), jnp.zeros([])),
+            (micro, micro_keys),
         )
         # reference scaling: each microbatch contributes grad/num_batches
         # (distributed_actor.py:382); num_batches counts microbatches with real

@@ -56,11 +56,25 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
-def _proj(h, p, lora, key, bias_key, lora_scale):
+# stable per-target stream ids so each projection's dropout mask differs
+_TARGET_STREAM = {
+    "wq": 0, "wk": 1, "wv": 2, "wo": 3, "w_gate": 4, "w_up": 5, "w_down": 6,
+}
+
+
+def _proj(h, p, lora, key, bias_key, lora_scale,
+          lora_dropout: float = 0.0, dropout_rng=None):
     """One projection with optional bias and optional LoRA delta."""
     y = linear(h, p[key], p.get(bias_key))
     if lora is not None and key in lora:
-        y = y + lora_delta(h, lora[key]["a"], lora[key]["b"], lora_scale)
+        rng = (
+            jax.random.fold_in(dropout_rng, _TARGET_STREAM[key])
+            if dropout_rng is not None else None
+        )
+        y = y + lora_delta(
+            h, lora[key]["a"], lora[key]["b"], lora_scale,
+            dropout_rate=lora_dropout, dropout_rng=rng,
+        )
     return y
 
 
@@ -84,12 +98,15 @@ def _layer(
     page_indices: jax.Array | None = None,  # [B, pps]
     page_size: int = 0,
     paged_impl: str = "auto",
+    lora_dropout: float = 0.0,
+    dropout_rng: jax.Array | None = None,  # per-layer key (training only)
 ):
     b, s, _ = x.shape
+    proj = partial(_proj, lora_dropout=lora_dropout, dropout_rng=dropout_rng)
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-    q = _proj(h, p, lora, "wq", "bq", lora_scale).reshape(b, s, cfg.num_heads, cfg.head_dim)
-    k = _proj(h, p, lora, "wk", "bk", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = _proj(h, p, lora, "wv", "bv", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = proj(h, p, lora, "wq", "bq", lora_scale).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = proj(h, p, lora, "wk", "bk", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = proj(h, p, lora, "wv", "bv", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -139,12 +156,12 @@ def _layer(
     else:
         att = attention(q, k, v, mask, impl=attn_impl, key_valid=key_valid)
     att = att.reshape(b, s, cfg.q_dim)
-    x = x + _proj(att, p, lora, "wo", "bo", lora_scale)
+    x = x + proj(att, p, lora, "wo", "bo", lora_scale)
 
     h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(_proj(h, p, lora, "w_gate", "b_gate", lora_scale))
-    up = _proj(h, p, lora, "w_up", "b_up", lora_scale)
-    x = x + _proj(gate * up, p, lora, "w_down", "b_down", lora_scale)
+    gate = jax.nn.silu(proj(h, p, lora, "w_gate", "b_gate", lora_scale))
+    up = proj(h, p, lora, "w_up", "b_up", lora_scale)
+    x = x + proj(gate * up, p, lora, "w_down", "b_down", lora_scale)
     return x, cache_k, cache_v
 
 
@@ -166,6 +183,8 @@ def forward(
     logits_positions: jax.Array | None = None,  # [B] per-row position gather
     page_size: int = 0,  # static; paged-cache mode (ops/paged.py)
     paged_impl: str = "auto",
+    lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
+    dropout_rng: jax.Array | None = None,
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
@@ -236,14 +255,23 @@ def forward(
         page_indices=kv_cache.get("page_indices") if paged else None,
         page_size=page_size,
         paged_impl=paged_impl,
+        lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
     )
 
-    xs = (params["layers"], lora["layers"] if lora is not None else None)
+    layer_keys = (
+        jax.random.split(dropout_rng, cfg.num_layers)
+        if (dropout_rng is not None and lora_dropout > 0.0) else None
+    )
+    xs = (
+        params["layers"],
+        lora["layers"] if lora is not None else None,
+        layer_keys,
+    )
 
     if kv_cache is None:
         def scan_body(x, xs):
-            p, lora_p = xs
-            y, _, _ = layer_fn(x, p, lora_p, None, None)
+            p, lora_p, key = xs
+            y, _, _ = layer_fn(x, p, lora_p, None, None, dropout_rng=key)
             return y, None
 
         if remat:
@@ -267,7 +295,11 @@ def forward(
                 jax.tree_util.tree_map(lambda w: w[i], lora["layers"])
                 if lora is not None else None
             )
-            x, ck, cv = layer_fn(x, p_i, lora_i, kv_cache["k"][i], kv_cache["v"][i])
+            key_i = layer_keys[i] if layer_keys is not None else None
+            x, ck, cv = layer_fn(
+                x, p_i, lora_i, kv_cache["k"][i], kv_cache["v"][i],
+                dropout_rng=key_i,
+            )
             new_k.append(ck)
             new_v.append(cv)
         new_k, new_v = tuple(new_k), tuple(new_v)

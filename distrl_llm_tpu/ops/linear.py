@@ -32,11 +32,22 @@ def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
     return y
 
 
-def lora_delta(x: jax.Array, a: jax.Array, b: jax.Array, scale) -> jax.Array:
+def lora_delta(
+    x: jax.Array, a: jax.Array, b: jax.Array, scale,
+    dropout_rate: float = 0.0, dropout_rng: jax.Array | None = None,
+) -> jax.Array:
     """LoRA contribution (x @ A) @ B · scale, computed in the activation dtype.
     A: [in, r], B: [r, out], scale = alpha / r (rsLoRA off — helper.py:44).
     Factors stored at higher precision (f32 LoRA over a bf16 base) are cast to
-    the activation dtype so the delta never widens the residual stream."""
+    the activation dtype so the delta never widens the residual stream.
+
+    ``dropout_rate`` + ``dropout_rng`` enable peft-style LoRA dropout: the
+    adapter INPUT is dropped (inverted scaling), the base path is untouched —
+    matching ``lora_dropout`` in the reference's init_peft_model
+    (helper.py:40). Inference callers pass no rng and pay nothing."""
     a = a.astype(x.dtype)
     b = b.astype(x.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, x.shape)
+        x = jnp.where(keep, x / (1.0 - dropout_rate), 0.0).astype(x.dtype)
     return (x @ a @ b) * jnp.asarray(scale, dtype=x.dtype)

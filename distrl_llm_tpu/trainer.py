@@ -143,6 +143,7 @@ class Trainer:
             attn_mesh=meshes.learner if (
                 config.attn_impl == "ring" and meshes is not None
             ) else None,
+            lora_dropout=config.lora_dropout,
         )
 
         self.total_batch_steps = 0
@@ -226,24 +227,42 @@ class Trainer:
         extra_eos = getattr(tokenizer, "eos_token_ids", None)
         if extra_eos:
             eos = sorted(set(eos) | set(extra_eos))
-        engine_cls = (
-            PagedGenerationEngine if config.engine_impl == "paged"
-            else GenerationEngine
-        )
-        engine = engine_cls(
-            model_cfg,
-            max_prompt_tokens=config.max_prompt_tokens,
-            max_new_tokens=config.max_new_tokens,
-            eos_token_ids=eos,
-            pad_token_id=(
-                tokenizer.pad_token_id
-                if tokenizer.pad_token_id is not None
-                else tokenizer.eos_token_id
-            ),
-            lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
-            attn_impl=config.attn_impl,
-            prompt_buckets=config.prompt_buckets or None,
-        )
+        if config.rollout_workers:
+            from distrl_llm_tpu.distributed import connect_remote_engine
+
+            addresses = []
+            for spec in config.rollout_workers:
+                host, _, port = spec.rpartition(":")
+                addresses.append((host or "127.0.0.1", int(port)))
+            engine = connect_remote_engine(
+                addresses,
+                max_prompt_tokens=config.max_prompt_tokens,
+                max_new_tokens=config.max_new_tokens,
+                timeout_ms=(
+                    int(config.generation_timeout_s * 1000)
+                    if config.generation_timeout_s > 0 else 240_000
+                ),
+                lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+            )
+        else:
+            engine_cls = (
+                PagedGenerationEngine if config.engine_impl == "paged"
+                else GenerationEngine
+            )
+            engine = engine_cls(
+                model_cfg,
+                max_prompt_tokens=config.max_prompt_tokens,
+                max_new_tokens=config.max_new_tokens,
+                eos_token_ids=eos,
+                pad_token_id=(
+                    tokenizer.pad_token_id
+                    if tokenizer.pad_token_id is not None
+                    else tokenizer.eos_token_id
+                ),
+                lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+                attn_impl=config.attn_impl,
+                prompt_buckets=config.prompt_buckets or None,
+            )
         return cls(
             train_dataset, test_dataset, reward_function, config,
             tokenizer=tokenizer, engine=engine, base_params=params_rollout,
@@ -342,6 +361,9 @@ class Trainer:
             and not self.meshes.timeshared
             and cfg.number_of_actors > 0
             and cfg.learner_chunk_size > 0
+            # a remote engine already fans out over worker processes; a
+            # second local dispatch would double-generate the batch
+            and not getattr(self.engine, "is_remote", False)
         )
         if hybrid:
             sizes = chunk_sizes(
@@ -580,7 +602,10 @@ class Trainer:
                 mesh=self.meshes.learner if self.meshes is not None else None,
             )
             self.lora, self.opt_state, loss = self.train_step(
-                self.lora, self.opt_state, self.base_params_learner, update
+                self.lora, self.opt_state, self.base_params_learner, update,
+                # adapter-input dropout (helper.py:40) needs a fresh key per
+                # update; disabled (None) when the rate is 0
+                self._next_rng() if cfg.lora_dropout > 0.0 else None,
             )
             loss = float(loss)
         self.weight_version += 1

@@ -38,7 +38,7 @@ def spawn_worker():
             sys.executable, "-m", "distrl_llm_tpu.distributed.worker_main",
             "--port", "0", "--serve-model", "tiny",
             "--max-prompt-tokens", str(P_LEN), "--max-new-tokens", str(MAX_NEW),
-            "--seed", "7",
+            "--seed", "7", "--lora-rank", "4", "--lora-alpha", "8",
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
@@ -74,16 +74,57 @@ def batch():
     return ids, mask
 
 
+class TestRemoteTrainerRound:
+    def test_full_train_round_with_remote_rollout(self, workers):
+        """A complete trainer round where generation runs in worker
+        PROCESSES (the reference's actor fan-out, distributed_trainer.py:
+        190–200) and the update runs locally: loss finite, adapter moves."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        from distrl_llm_tpu.models.lora import lora_scale
+
+        _, addrs = workers
+        cfg = make_config(max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        base = init_params(jax.random.PRNGKey(7), TINY)  # workers' twin
+        engine = connect_remote_engine(
+            addrs, max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW,
+            timeout_ms=60_000,
+            # must match the workers' --lora-rank/--lora-alpha (the scale
+            # guard fails the round loudly otherwise)
+            lora_scale=lora_scale(cfg.max_lora_rank, cfg.lora_alpha),
+        )
+        sink = MemorySink()
+        trainer = Trainer(
+            train, test, reward_function, cfg,
+            tokenizer=tok, engine=engine, base_params=base, model_cfg=TINY,
+            sink=sink,
+        )
+        batch = {"problem": train["problem"][:4], "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+        assert trainer.weight_version == 1
+        engine.driver.shutdown()
+
+
 class TestRemoteRollout:
     def test_remote_greedy_matches_local(self, workers, batch):
         _, addrs = workers
         ids, mask = batch
         # local twin of the workers' model (same init seed, same shapes)
         params = init_params(jax.random.PRNGKey(7), TINY)
+        from distrl_llm_tpu.models.lora import lora_scale
+
         local = GenerationEngine(
             TINY, max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW,
             eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
-            cache_dtype=jnp.float32,
+            cache_dtype=jnp.float32, lora_scale=lora_scale(4, 8.0),
         )
         lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
         sampling = SamplingConfig(max_tokens=MAX_NEW, temperature=0.0, n=1)
@@ -91,7 +132,7 @@ class TestRemoteRollout:
         want = local.generate(params, lora, ids, mask, sampling, jax.random.PRNGKey(0))
         remote = connect_remote_engine(
             addrs, max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW,
-            timeout_ms=60_000,
+            timeout_ms=60_000, lora_scale=lora_scale(4, 8.0),
         )
         got = remote.generate(None, lora, ids, mask, sampling, jax.random.PRNGKey(0))
         np.testing.assert_array_equal(got.tokens, want.tokens)

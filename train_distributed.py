@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["dense", "paged"],
                    help="rollout engine: dense fixed-shape cache, or paged "
                         "ragged KV (Pallas paged-attention decode)")
+    p.add_argument("--rollout_workers", type=str, default="",
+                   help="comma-separated control-plane workers "
+                        "(host:port,...) to dispatch generation to; start "
+                        "them with python -m "
+                        "distrl_llm_tpu.distributed.worker_main --serve-model")
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--seed", type=int, default=3407)
     p.add_argument("--checkpoint_dir", type=str, default=None)
@@ -99,6 +104,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     from distrl_llm_tpu.config import parse_buckets
 
     fields["prompt_buckets"] = parse_buckets(args.prompt_buckets)
+    fields["rollout_workers"] = tuple(
+        w.strip() for w in str(args.rollout_workers or "").split(",") if w.strip()
+    )
     return TrainConfig(mesh=mesh, **fields)
 
 
