@@ -43,6 +43,7 @@ class RemoteEngine:
         timeout_ms: int = 240_000,  # the reference's ray.get(timeout=240)
         cold_timeout_ms: int = 1_800_000,  # first round: worker-side XLA compile
         lora_scale: float = 1.0,
+        eos_token_ids: Sequence[int] | None = None,
     ):
         self.driver = driver
         self.max_prompt_tokens = max_prompt_tokens
@@ -50,7 +51,14 @@ class RemoteEngine:
         self.timeout_ms = timeout_ms
         self.cold_timeout_ms = cold_timeout_ms
         self.lora_scale = lora_scale
-        self._warm = False
+        # full stop-token set shipped with every shard — workers default to
+        # their tokenizer's single eos, which can differ from the trainer's
+        # merged set (silently changing the sampling distribution)
+        self.eos_token_ids = list(eos_token_ids) if eos_token_ids else None
+        # workers recompile per (shard sizes, n) shape — every unseen shape
+        # gets the cold-compile allowance, like trainer._call_engine's
+        # per-(role, bucket, rows, n) warm keys on the local path
+        self._warm_keys: set[tuple] = set()
 
     def generate(
         self,
@@ -85,17 +93,20 @@ class RemoteEngine:
                     "sampling": dataclasses.asdict(sampling),
                     "lora": lora_np,
                     "lora_scale": self.lora_scale,
+                    "eos_token_ids": self.eos_token_ids,
                     "rng_seed": int(seeds[i]),
                 },
             ))
             start += size
-        # a cold worker's first shard pays full XLA compilation — minutes,
-        # not a hang; the steady-state deadline applies from round 2
-        timeout = self.timeout_ms if self._warm else max(
+        # a cold shard shape pays full worker-side XLA compilation — minutes,
+        # not a hang; the steady-state deadline applies once this shape has
+        # run before
+        warm_key = (tuple(sizes), sampling.n)
+        timeout = self.timeout_ms if warm_key in self._warm_keys else max(
             self.timeout_ms, self.cold_timeout_ms
         )
         results = self.driver.dispatch_objects(shards, timeout_ms=timeout)
-        self._warm = True
+        self._warm_keys.add(warm_key)
         tokens = np.concatenate([r["tokens"] for r in results], axis=0)
         lengths = np.concatenate([r["lengths"] for r in results], axis=0)
         return GenerationResult(tokens=tokens, lengths=lengths)
@@ -108,6 +119,7 @@ def connect_remote_engine(
     max_new_tokens: int,
     timeout_ms: int = 240_000,
     lora_scale: float = 1.0,
+    eos_token_ids: Sequence[int] | None = None,
 ) -> RemoteEngine:
     """Connect to running workers and wrap them as an engine."""
     return RemoteEngine(
@@ -116,4 +128,5 @@ def connect_remote_engine(
         max_new_tokens=max_new_tokens,
         timeout_ms=timeout_ms,
         lora_scale=lora_scale,
+        eos_token_ids=eos_token_ids,
     )

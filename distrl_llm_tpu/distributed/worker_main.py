@@ -106,6 +106,13 @@ def handler(payload: bytes) -> bytes:
                     f"lora_scale mismatch: trainer sends {want}, worker "
                     f"engine built with {have} (--lora-rank/--lora-alpha)"
                 )
+        eos_override = arg.get("eos_token_ids")
+        if eos_override:
+            # the trainer's merged stop-token set wins over the worker's
+            # single tokenizer eos (same compiled fns — eos ids are traced)
+            _ENGINE_STATE["engine"].eos_ids = jnp.asarray(
+                sorted(set(int(e) for e in eos_override)), jnp.int32
+            )
         result = _ENGINE_STATE["engine"].generate(
             _ENGINE_STATE["params"], lora,
             arg["prompt_ids"], arg["prompt_mask"],

@@ -10,10 +10,13 @@ distributed_actor.py:148–150), built TPU-native:
   short prompt costs its own length, not ``max_prompt_tokens``;
 * decode attention is jaxlib's Pallas ``paged_attention`` kernel on TPU (jnp
   reference elsewhere — ops/paged.py);
-* the page table is a static host constant per round (SURVEY §2b N1: the RL
-  rollout round is a fixed batch, so vLLM's dynamic C++ block allocator
-  reduces to a constant identity layout; the indirection is retained so
-  prompt-prefix sharing can land without kernel changes);
+* candidates SHARE their prompt's full prompt pages (vLLM prefix sharing):
+  the page table points each candidate's leading columns at a shared pool
+  written once by prefill; only the partial last prompt page — extended in
+  place by decode — is private per candidate. Prompt KV memory is ~B copies
+  instead of B·n. The table is data-dependent but shape-static, so it rides
+  as a traced array (an RL rollout round is a fixed batch, so vLLM's dynamic
+  C++ block allocator reduces to this host-computed table);
 * the host-dispatched donated decode-step loop, candidate fan-out after a
   shared prefill, and async early-exit snapshots all match the dense engine.
 """
@@ -104,25 +107,60 @@ def _paged_prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
 
 
 def _paged_fanout(prompt_k, prompt_v, last_logits, real_len, row_alive,
-                  *, n: int, b: int, prompt_pages: int, total_pages_per_row: int,
+                  *, n: int, b: int, prompt_pages: int, private_pages: int,
                   page_size: int, max_steps: int):
-    """Expand B prompts to B·n candidate rows, each owning a private copy of
-    its prompt pages plus fresh decode pages (prefix sharing is the next
-    stage; the page-table indirection already supports it)."""
-    bn = b * n
+    """Expand B prompts to B·n candidate rows with SHARED prompt prefixes.
 
-    def expand(pages):  # [K, B·prompt_pages, ps, hd] → [K, Bn·tpr, ps, hd]
+    vLLM's prefix sharing, static-shape edition: every candidate's page table
+    points its leading columns at the prompt's FULL pages in the shared pool
+    (written once by prefill, never written again), and only the partial last
+    prompt page — which decode tokens will extend in place — is copied per
+    candidate into a private region alongside its decode pages. At the
+    reference volume this drops prompt KV memory from B·n to ~B copies.
+
+    Returns (state, page_indices): the table is data-DEPENDENT (each prompt's
+    full-page count is real_len // page_size) but shape-static, so it rides
+    as a traced array and never forces a recompile."""
+    bn = b * n
+    total_shared = b * prompt_pages
+    width = prompt_pages + private_pages
+
+    full = real_len // page_size  # [B] full shared pages per prompt
+    full_r = jnp.repeat(full, n)  # [Bn]
+    prompt_of_row = jnp.repeat(jnp.arange(b), n)  # [Bn]
+    priv0 = total_shared + jnp.arange(bn) * private_pages  # [Bn]
+
+    # column t of row r holds position block t: shared pages below full_r,
+    # private pages after; trailing unused columns clamp to a valid private
+    # page (the jnp reference gathers the whole table width)
+    col = jnp.arange(width)[None, :]
+    shared_entry = prompt_of_row[:, None] * prompt_pages + col
+    private_entry = jnp.minimum(
+        priv0[:, None] + (col - full_r[:, None]),
+        priv0[:, None] + private_pages - 1,
+    )
+    page_indices = jnp.where(
+        col < full_r[:, None], shared_entry, private_entry
+    ).astype(jnp.int32)
+
+    # the partial prompt page each candidate must own privately (clamped for
+    # page-aligned prompts, where the copy content is never read)
+    src_partial = prompt_of_row * prompt_pages + jnp.repeat(
+        jnp.minimum(full, prompt_pages - 1), n
+    )
+
+    def expand(pages):  # [K, B·prompt_pages, ps, hd] → [K, shared+Bn·priv, ps, hd]
         kh, _, ps, hd = pages.shape
-        tiles = pages.reshape(kh, b, prompt_pages, ps, hd)
-        tiles = jnp.repeat(tiles, n, axis=1)  # [K, Bn, prompt_pages, ps, hd]
         out = jnp.zeros(
-            (kh, bn, total_pages_per_row, ps, hd), pages.dtype
-        ).at[:, :, :prompt_pages].set(tiles)
-        return out.reshape(kh, bn * total_pages_per_row, ps, hd)
+            (kh, total_shared + bn * private_pages, ps, hd), pages.dtype
+        )
+        out = out.at[:, :total_shared].set(pages)
+        out = out.at[:, priv0].set(pages[:, src_partial])
+        return out
 
     k_pages = tuple(expand(x) for x in prompt_k)
     v_pages = tuple(expand(x) for x in prompt_v)
-    return _PagedDecodeState(
+    state = _PagedDecodeState(
         step=jnp.zeros((), jnp.int32),
         out=jnp.zeros((bn, max_steps), jnp.int32),
         gen_lengths=jnp.zeros((bn,), jnp.int32),
@@ -132,6 +170,7 @@ def _paged_fanout(prompt_k, prompt_v, last_logits, real_len, row_alive,
         k_pages=k_pages,
         v_pages=v_pages,
     )
+    return state, page_indices
 
 
 def _paged_decode_step(params, lora, state: _PagedDecodeState, rng, page_indices,
@@ -192,9 +231,9 @@ class PagedGenerationEngine:
         self.max_new_tokens = max_new_tokens
         self.page_size = page_size
         self.prompt_pages = pages_per_seq(max_prompt_tokens, page_size)
-        self.total_pages_per_row = pages_per_seq(
-            self.prompt_pages * page_size + max_new_tokens, page_size
-        )
+        # per-candidate private region: the partial prompt page (extended in
+        # place by decode) + decode pages; full prompt pages are SHARED
+        self.private_pages = 1 + pages_per_seq(max_new_tokens, page_size)
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale
@@ -211,7 +250,7 @@ class PagedGenerationEngine:
         self._fanout = jax.jit(
             partial(
                 _paged_fanout, prompt_pages=self.prompt_pages,
-                total_pages_per_row=self.total_pages_per_row,
+                private_pages=self.private_pages,
                 page_size=page_size,
             ),
             static_argnames=("n", "b", "max_steps"),
@@ -244,20 +283,14 @@ class PagedGenerationEngine:
             raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
         max_steps = min(sampling.max_tokens, self.max_new_tokens)
         n = sampling.n
-        bn = b * n
 
         prompt_k, prompt_v, last_logits, real_len = self._prefill(
             params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
         )
         row_alive = jnp.asarray(prompt_mask).sum(axis=-1) > 0
-        state = self._fanout(
+        state, page_indices = self._fanout(
             prompt_k, prompt_v, last_logits, real_len, row_alive,
             n=n, b=b, max_steps=max_steps,
-        )
-        page_indices = jnp.asarray(
-            make_page_table(
-                bn, self.total_pages_per_row * self.page_size, self.page_size
-            )
         )
 
         temperature = jnp.asarray(sampling.temperature, jnp.float32)

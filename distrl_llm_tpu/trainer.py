@@ -215,21 +215,25 @@ class Trainer:
                 params, bits=bits, group_size=default_group_size(bits)
             )
         specs = param_specs(params)
-        params_rollout = shard_tree(params, meshes.rollout, specs)
-        # non-timeshared roles each hold the frozen base (the reference loads
-        # the model once per worker, distributed_actor.py:58); timeshared
-        # roles alias one copy
-        params_learner = (
-            params_rollout if meshes.timeshared
-            else shard_tree(params, meshes.learner, specs)
-        )
         eos = [tokenizer.eos_token_id]
         extra_eos = getattr(tokenizer, "eos_token_ids", None)
         if extra_eos:
             eos = sorted(set(eos) | set(extra_eos))
         if config.rollout_workers:
+            # generation runs in worker processes: the local mesh serves the
+            # LEARNER only — no rollout-mesh base copy, no per-step adapter
+            # push (the adapter ships over the wire instead)
             from distrl_llm_tpu.distributed import connect_remote_engine
 
+            params_learner = shard_tree(params, meshes.learner, specs)
+            params_rollout = params_learner
+            if config.number_of_actors > 0 and not meshes.timeshared:
+                log.warning(
+                    "rollout_workers is set but number_of_actors=%d local "
+                    "chips are carved for a rollout mesh that never "
+                    "generates; consider --number_of_actors 0",
+                    config.number_of_actors,
+                )
             addresses = []
             for spec in config.rollout_workers:
                 host, _, port = spec.rpartition(":")
@@ -238,13 +242,24 @@ class Trainer:
                 addresses,
                 max_prompt_tokens=config.max_prompt_tokens,
                 max_new_tokens=config.max_new_tokens,
+                # generation_timeout_s <= 0 means "hang detector disabled";
+                # the control plane still needs SOME deadline — use a day
                 timeout_ms=(
                     int(config.generation_timeout_s * 1000)
-                    if config.generation_timeout_s > 0 else 240_000
+                    if config.generation_timeout_s > 0 else 86_400_000
                 ),
                 lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+                eos_token_ids=eos,
             )
         else:
+            params_rollout = shard_tree(params, meshes.rollout, specs)
+            # non-timeshared roles each hold the frozen base (the reference
+            # loads the model once per worker, distributed_actor.py:58);
+            # timeshared roles alias one copy
+            params_learner = (
+                params_rollout if meshes.timeshared
+                else shard_tree(params, meshes.learner, specs)
+            )
             engine_cls = (
                 PagedGenerationEngine if config.engine_impl == "paged"
                 else GenerationEngine
@@ -327,7 +342,11 @@ class Trainer:
         bus (save_lora distributed_actor.py:85 / load_lora :150). Records the
         version now resident on the rollout mesh; ``_generate_round`` asserts
         it before sampling."""
-        if self.meshes is not None and not self.meshes.timeshared:
+        if getattr(self.engine, "is_remote", False):
+            # remote rollout: the adapter ships over the wire with each
+            # round — no local rollout-mesh copy to refresh
+            self._lora_rollout = self.lora
+        elif self.meshes is not None and not self.meshes.timeshared:
             from distrl_llm_tpu.parallel.partition import shard_tree
 
             self._lora_rollout = shard_tree(self.lora, self.meshes.rollout)

@@ -168,3 +168,69 @@ class TestPagedEngine:
         assert res.tokens.shape == (2, 5, 4)
         unique = {tuple(res.tokens[1, j]) for j in range(5)}
         assert len(unique) > 1
+
+
+class TestPrefixSharing:
+    """Candidates of one prompt share its full prompt pages; the KV pool
+    shrinks from B·n to ~B prompt copies (vLLM prefix sharing)."""
+
+    def test_candidates_share_full_prompt_pages(self, setup):
+        from distrl_llm_tpu.engine.paged_engine import _paged_fanout
+        import jax.numpy as jnp
+        from functools import partial
+
+        b, n, pp, priv = 2, 3, 2, 2
+        kh, hd = 2, 4
+        prompt_pages = tuple(
+            jnp.arange(kh * b * pp * PS * hd, dtype=jnp.float32).reshape(
+                kh, b * pp, PS, hd
+            )
+            for _ in range(1)
+        )
+        real_len = jnp.asarray([PS + 3, 5])  # row 0: 1 full page; row 1: none
+        state, table = jax.jit(
+            partial(_paged_fanout, prompt_pages=pp, private_pages=priv,
+                    page_size=PS),
+            static_argnames=("n", "b", "max_steps"),
+        )(prompt_pages, prompt_pages, jnp.zeros((b, 8)), real_len,
+          jnp.ones((b,), bool), n=n, b=b, max_steps=4)
+        table = np.asarray(table)
+        # prompt 0's three candidates all point column 0 at the SAME shared page
+        assert table[0, 0] == table[1, 0] == table[2, 0] == 0
+        # their partial/private pages are DISTINCT
+        assert len({table[j, 1] for j in range(3)}) == 3
+        # prompt 1 (no full pages): column 0 is already private and distinct
+        assert len({table[3 + j, 0] for j in range(3)}) == 3
+        # pool is shared+private sized, smaller than per-candidate duplication
+        total_pages = state.k_pages[0].shape[1]
+        assert total_pages == b * pp + b * n * priv
+        assert total_pages < b * n * (pp + priv)
+
+    def test_shared_pages_hold_prompt_kv(self, setup):
+        """The shared pool region is the prefill pages verbatim, and each
+        candidate's private partial page is a copy of its prompt's partial."""
+        from distrl_llm_tpu.engine.paged_engine import _paged_fanout
+        from functools import partial
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        b, n, pp, priv = 2, 2, 2, 2
+        kh, hd = 2, 4
+        pages = tuple(
+            jnp.asarray(rng.normal(size=(kh, b * pp, PS, hd)), jnp.float32)
+            for _ in range(1)
+        )
+        real_len = jnp.asarray([PS + 1, PS + 2])
+        state, table = jax.jit(
+            partial(_paged_fanout, prompt_pages=pp, private_pages=priv,
+                    page_size=PS),
+            static_argnames=("n", "b", "max_steps"),
+        )(pages, pages, jnp.zeros((b, 8)), real_len, jnp.ones((b,), bool),
+          n=n, b=b, max_steps=4)
+        pool = np.asarray(state.k_pages[0])
+        src = np.asarray(pages[0])
+        np.testing.assert_array_equal(pool[:, : b * pp], src)
+        # candidate (b=1, j=1): partial page copy of prompt 1's page index 1·pp+1
+        r = 1 * n + 1
+        priv0 = int(np.asarray(table)[r, 1])  # column 1 = first private (full=1)
+        np.testing.assert_array_equal(pool[:, priv0], src[:, 1 * pp + 1])
