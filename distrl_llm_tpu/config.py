@@ -151,8 +151,11 @@ class TrainConfig:
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}
     # attention implementation for learner/prefill forwards:
-    # "reference" (XLA softmax) or "flash" (Pallas blockwise kernel, TPU only;
-    # falls back with a warning elsewhere) — ops/flash_attention.py
+    # "reference" (XLA softmax), "flash" (Pallas blockwise kernel, TPU only,
+    # GQA via repeat — ops/flash_attention.py), "splash" (Pallas multi-query
+    # kernel, native GQA with no KV repeat — ops/splash.py), or "ring"
+    # (sequence-parallel — ops/ring_attention.py); non-TPU backends fall back
+    # to the reference path with a warning
     attn_impl: str = "reference"
     write_adapter_file: bool = False  # artifact-parity adapter writer
     # jax.profiler trace capture (SURVEY §5 tracing): traces the step window

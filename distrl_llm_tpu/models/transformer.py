@@ -230,8 +230,8 @@ def forward(
     # DCE'd under jit, but eager/non-jit callers would pay it)
     needs_dense_mask = (
         (kv_cache is not None and not paged)
-        or (paged and s > 1 and attn_impl not in ("ring", "flash"))
-        or (kv_cache is None and attn_impl not in ("ring", "flash"))
+        or (paged and s > 1 and attn_impl not in ("ring", "flash", "splash"))
+        or (kv_cache is None and attn_impl not in ("ring", "flash", "splash"))
     )
     mask = (
         causal_padding_mask(

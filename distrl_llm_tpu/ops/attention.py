@@ -118,16 +118,36 @@ def attention(
     """Dispatching front door. ``impl``: "reference" (XLA) or "flash" (Pallas,
     TPU only; warns once and falls back to reference where unsupported).
 
-    ``key_valid`` is the [B, Sk] validity vector; the flash path consumes it
-    directly (no [B, 1, Sq, Sk] mask needs to exist). When only ``key_valid``
-    is given and the fallback runs, the dense causal mask is built here."""
+    ``key_valid`` is the [B, Sk] validity vector; the flash/splash paths
+    consume it directly (no [B, 1, Sq, Sk] mask needs to exist). When only
+    ``key_valid`` is given and the fallback runs, the dense causal mask is
+    built here."""
+    global _flash_fallback_warned
+    if impl == "splash":
+        try:
+            if jax.default_backend() != "tpu":
+                raise NotImplementedError(
+                    "splash kernel requires the TPU backend (interpret mode "
+                    "is test-only)"
+                )
+            from distrl_llm_tpu.ops.splash import splash_attention
+
+            return splash_attention(q, k, v, key_valid, scale=scale)
+        except Exception as e:  # noqa: BLE001 — fall back with one warning
+            if not _flash_fallback_warned:
+                _flash_fallback_warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "splash attention unavailable (%s); falling back to the "
+                    "XLA reference path", e,
+                )
     if impl == "flash":
         try:
             from distrl_llm_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, mask, scale=scale, key_valid=key_valid)
         except (ImportError, NotImplementedError) as e:
-            global _flash_fallback_warned
             if not _flash_fallback_warned:
                 _flash_fallback_warned = True
                 import logging

@@ -54,7 +54,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", type=int, default=1, help="learner parameter sharding")
     p.add_argument("--base_quant", type=str, default="none", choices=["none", "int8", "int4"])
     p.add_argument("--attn_impl", type=str, default="reference",
-                   choices=["reference", "flash", "ring"])
+                   choices=["reference", "flash", "splash", "ring"])
     p.add_argument("--engine_impl", type=str, default="dense",
                    choices=["dense", "paged"],
                    help="rollout engine: dense fixed-shape cache, or paged "
