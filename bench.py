@@ -153,10 +153,14 @@ def main() -> int:
         PagedGenerationEngine if os.environ.get("BENCH_ENGINE") == "paged"
         else GenerationEngine
     )
+    engine_kwargs = {}
+    if os.environ.get("BENCH_ENGINE") == "paged":
+        engine_kwargs["kv_quant"] = os.environ.get("BENCH_KV_QUANT", "none")
     engine = engine_cls(
         cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
         eos_token_ids=[151645 % cfg.vocab_size], pad_token_id=151643 % cfg.vocab_size,
         prompt_buckets=buckets or None,
+        **engine_kwargs,
     )
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, min(cfg.vocab_size, 50000), size=(n_prompts, max_prompt)).astype(np.int32)

@@ -141,6 +141,11 @@ class TrainConfig:
     # rollout engine implementation: "dense" (fixed-shape cache) or "paged"
     # (packed ragged KV pages + Pallas paged-attention decode — the full N1)
     engine_impl: str = "dense"
+    # KV cache quantization for the paged engine: "none" or "int8" (per-token
+    # absmax). Halves the cache's RESIDENT memory (fit bigger batches); note
+    # the current jaxlib kernel materializes broadcast scales per step, so
+    # this is a capacity knob, not a decode-speed knob (ops/paged.py)
+    kv_cache_quant: str = "none"
     # control-plane rollout workers ("host:port", ...): when set, generation
     # dispatches to these worker processes (distributed/worker_main.py) over
     # the C++ control plane instead of running on local chips — the
@@ -180,6 +185,21 @@ class TrainConfig:
             raise ValueError(f"base_quant must be none/int8/int4, got {self.base_quant!r}")
         if self.engine_impl not in ("dense", "paged"):
             raise ValueError(f"engine_impl must be dense/paged, got {self.engine_impl!r}")
+        if self.kv_cache_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_cache_quant must be none/int8, got {self.kv_cache_quant!r}"
+            )
+        if self.kv_cache_quant != "none" and self.engine_impl != "paged":
+            raise ValueError("kv_cache_quant requires engine_impl='paged'")
+        if self.rollout_workers and (
+            self.kv_cache_quant != "none" or self.engine_impl != "dense"
+        ):
+            # remote workers build their own engines (worker_main flags);
+            # silently ignoring these knobs would misreport memory behavior
+            raise ValueError(
+                "engine_impl/kv_cache_quant are local-engine knobs; with "
+                "rollout_workers, configure the workers via worker_main flags"
+            )
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if self.number_of_learners <= 0:

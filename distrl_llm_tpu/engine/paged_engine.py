@@ -67,7 +67,7 @@ def _pack_rows(ids: jax.Array, mask: jax.Array) -> tuple[jax.Array, jax.Array, j
 
 def _paged_prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
                    prompt_pages: int, page_size: int, lora_scale: float,
-                   cache_dtype, attn_impl: str):
+                   cache_dtype, attn_impl: str, kv_quant: str = "none"):
     """Pack prompts, run one forward over B rows, return per-prompt page
     tiles [K, B, prompt_pages, ps, hd] per layer + sampling logits."""
     b, p = prompt_ids.shape
@@ -76,17 +76,20 @@ def _paged_prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
     packed_ids = jnp.pad(packed_ids, ((0, 0), (0, pad_to - p)))
     packed_mask = jnp.pad(packed_mask, ((0, 0), (0, pad_to - p)))
 
+    shape = (cfg.num_kv_heads, b * prompt_pages, page_size, cfg.head_dim)
+
+    def make_pages():
+        if kv_quant == "int8":
+            # int8 KV: halves resident cache memory (see the bandwidth caveat
+            # on ops/paged.py:quantize_pages)
+            from distrl_llm_tpu.ops.paged import init_quantized_pages
+
+            return init_quantized_pages(shape)
+        return jnp.zeros(shape, cache_dtype)
+
     cache = {
-        "k": tuple(
-            jnp.zeros((cfg.num_kv_heads, b * prompt_pages, page_size, cfg.head_dim),
-                      cache_dtype)
-            for _ in range(cfg.num_layers)
-        ),
-        "v": tuple(
-            jnp.zeros((cfg.num_kv_heads, b * prompt_pages, page_size, cfg.head_dim),
-                      cache_dtype)
-            for _ in range(cfg.num_layers)
-        ),
+        "k": tuple(make_pages() for _ in range(cfg.num_layers)),
+        "v": tuple(make_pages() for _ in range(cfg.num_layers)),
         "lengths": real_len,
         "page_indices": jnp.asarray(
             make_page_table(b, pad_to, page_size)
@@ -149,14 +152,23 @@ def _paged_fanout(prompt_k, prompt_v, last_logits, real_len, row_alive,
         jnp.minimum(full, prompt_pages - 1), n
     )
 
-    def expand(pages):  # [K, B·prompt_pages, ps, hd] → [K, shared+Bn·priv, ps, hd]
-        kh, _, ps, hd = pages.shape
+    def expand_arr(pages):  # [K, B·pp, ps, tail] → [K, shared+Bn·priv, ps, tail]
+        kh, _, ps, tail = pages.shape
         out = jnp.zeros(
-            (kh, total_shared + bn * private_pages, ps, hd), pages.dtype
+            (kh, total_shared + bn * private_pages, ps, tail), pages.dtype
         )
         out = out.at[:, :total_shared].set(pages)
         out = out.at[:, priv0].set(pages[:, src_partial])
         return out
+
+    def expand(pages):
+        from distrl_llm_tpu.ops.paged import is_quantized_pages
+
+        if is_quantized_pages(pages):  # int8 KV: expand weight + scales alike
+            return type(pages)(
+                weight=expand_arr(pages.weight), scales=expand_arr(pages.scales)
+            )
+        return expand_arr(pages)
 
     k_pages = tuple(expand(x) for x in prompt_k)
     v_pages = tuple(expand(x) for x in prompt_v)
@@ -224,8 +236,11 @@ class PagedGenerationEngine:
         paged_impl: str = "auto",
         page_size: int = 128,
         decode_chunk: int = 128,
+        kv_quant: str = "none",  # "none" | "int8" (per-token absmax KV cache)
         prompt_buckets: Sequence[int] | None = None,  # accepted for interface parity
     ):
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
@@ -244,7 +259,7 @@ class PagedGenerationEngine:
             partial(
                 _paged_prefill, cfg=cfg, prompt_pages=self.prompt_pages,
                 page_size=page_size, lora_scale=lora_scale,
-                cache_dtype=cache_dtype, attn_impl=attn_impl,
+                cache_dtype=cache_dtype, attn_impl=attn_impl, kv_quant=kv_quant,
             )
         )
         self._fanout = jax.jit(

@@ -33,6 +33,51 @@ from distrl_llm_tpu.ops.attention import NEG_INF
 DEFAULT_PAGE_SIZE = 128
 
 
+def _quant_utils():
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        quantization_utils,
+    )
+
+    return quantization_utils
+
+
+def is_quantized_pages(pages) -> bool:
+    """True for the kernel's QuantizedTensor page container (int8 weight +
+    per-token absmax scales)."""
+    return hasattr(pages, "weight") and hasattr(pages, "scales")
+
+
+def quantize_pages(pages: jax.Array):
+    """float pages [K, P, ps, hd] → QuantizedTensor (int8 + f32 scales
+    [K, P, ps, 1]). Halves the cache's resident HBM footprint.
+
+    CAVEAT (verified against the installed kernel source): jaxlib's
+    ``paged_attention`` broadcasts the scales to head_dim before the
+    pallas_call (paged_attention_kernel.py:422), materializing a full-cache-
+    sized f32 buffer per layer per decode step — on the TPU kernel path the
+    per-step bandwidth/temp cost currently NEGATES the read-bandwidth win.
+    Use int8 KV for memory-at-rest headroom (bigger batches fit), not for
+    decode speed, until a scale-aware kernel wrapper lands."""
+    return _quant_utils().quantize_to_int8(pages)
+
+
+def init_quantized_pages(shape: tuple[int, int, int, int]):
+    """Zero-initialized QuantizedTensor pages for ``shape``
+    [K, total_pages, ps, hd] — the single owner of the quantized-page layout
+    contract (int8 weight + f32 per-token scales [..., 1])."""
+    qu = _quant_utils()
+    return qu.QuantizedTensor(
+        weight=jnp.zeros(shape, jnp.int8),
+        scales=jnp.zeros(shape[:3] + (1,), jnp.float32),
+    )
+
+
+def dequantize_pages(pages, dtype=jnp.float32) -> jax.Array:
+    if not is_quantized_pages(pages):
+        return pages.astype(dtype)
+    return _quant_utils().from_int8(pages.weight, pages.scales, dtype=dtype)
+
+
 def pages_per_seq(max_len: int, page_size: int = DEFAULT_PAGE_SIZE) -> int:
     return -(-max_len // page_size)
 
@@ -67,11 +112,11 @@ def init_paged_kv_cache(
 
 
 def write_prompt_to_pages(
-    pages: jax.Array,  # [K, total_pages, ps, hd]
+    pages,  # [K, total_pages, ps, hd] array, or QuantizedTensor
     prompt_kv: jax.Array,  # [B, P, K, hd] packed (row position 0 = first token)
     page_indices: jax.Array,  # [B, pps_total]
     page_size: int,
-) -> jax.Array:
+):
     """Write every row's packed prompt KV into its leading pages.
 
     P must be a multiple of page_size (callers pad; positions beyond a row's
@@ -86,45 +131,72 @@ def write_prompt_to_pages(
         .reshape(kh, b * n_prompt_pages, page_size, hd)
     )
     dest = page_indices[:, :n_prompt_pages].reshape(-1)  # [B·n_prompt_pages]
+    if is_quantized_pages(pages):
+        qu = _quant_utils()
+        scales = qu.get_quantization_scales(tiles)  # [K, tiles, ps, 1]
+        weight = pages.weight.at[:, dest].set(qu.to_int8(tiles, scales))
+        return type(pages)(
+            weight=weight,
+            scales=pages.scales.at[:, dest].set(scales.astype(pages.scales.dtype)),
+        )
     return pages.at[:, dest].set(tiles.astype(pages.dtype))
 
 
 def write_token_to_pages(
-    pages: jax.Array,  # [K, total_pages, ps, hd]
+    pages,  # [K, total_pages, ps, hd] array, or QuantizedTensor
     new_kv: jax.Array,  # [B, K, hd] — one token per row
     lengths: jax.Array,  # [B] current token counts (write position)
     page_indices: jax.Array,  # [B, pps]
     page_size: int,
-) -> jax.Array:
+):
     """Scatter one decoded token's KV into each row's current page slot."""
     b = new_kv.shape[0]
     rows = jnp.arange(b)
     page = page_indices[rows, lengths // page_size]  # [B]
     slot = lengths % page_size  # [B]
-    return pages.at[:, page, slot].set(
-        new_kv.transpose(1, 0, 2).astype(pages.dtype)
-    )
+    tok = new_kv.transpose(1, 0, 2)  # [K, B, hd]
+    if is_quantized_pages(pages):
+        qu = _quant_utils()
+        scales = qu.get_quantization_scales(tok)  # [K, B, 1]
+        weight = pages.weight.at[:, page, slot].set(qu.to_int8(tok, scales))
+        return type(pages)(
+            weight=weight,
+            scales=pages.scales.at[:, page, slot].set(
+                scales.astype(pages.scales.dtype)
+            ),
+        )
+    return pages.at[:, page, slot].set(tok.astype(pages.dtype))
 
 
 def paged_attention_reference(
     q: jax.Array,  # [B, H, hd] — single decode query per row
-    k_pages: jax.Array,  # [K, total_pages, ps, hd]
-    v_pages: jax.Array,  # [K, total_pages, ps, hd]
+    k_pages,  # [K, total_pages, ps, hd] array, or QuantizedTensor
+    v_pages,
     lengths: jax.Array,  # [B] valid token counts (incl. current position)
     page_indices: jax.Array,  # [B, pps]
     scale: float | None = None,
 ) -> jax.Array:
     """jnp semantics-reference for the Pallas kernel: gather each row's pages
-    and run masked GQA attention over its valid prefix."""
+    and run masked GQA attention over its valid prefix (quantized pools
+    dequantize AFTER the gather — only the rows' own pages)."""
+
+    def gather(pages):
+        if is_quantized_pages(pages):
+            w = pages.weight[:, page_indices]
+            s_ = pages.scales[:, page_indices]
+            return _quant_utils().from_int8(w, s_, dtype=jnp.float32)
+        return pages[:, page_indices].astype(jnp.float32)
+
+    raw_k = k_pages.weight if is_quantized_pages(k_pages) else k_pages
     b, h, hd = q.shape
-    kh = k_pages.shape[0]
+    kh = raw_k.shape[0]
     g = h // kh
-    ps = k_pages.shape[2]
+    ps = raw_k.shape[2]
     if scale is None:
         scale = hd**-0.5
     # gather [K, B, pps, ps, hd] → [B, K, S, hd]
-    k = k_pages[:, page_indices].transpose(1, 0, 2, 3, 4)
-    v = v_pages[:, page_indices].transpose(1, 0, 2, 3, 4)
+    k = gather(k_pages).transpose(1, 0, 2, 3, 4)
+    v = gather(v_pages).transpose(1, 0, 2, 3, 4)
     s = k.shape[2] * ps
     k = k.reshape(b, kh, s, hd)
     v = v.reshape(b, kh, s, hd)

@@ -264,6 +264,9 @@ class Trainer:
                 PagedGenerationEngine if config.engine_impl == "paged"
                 else GenerationEngine
             )
+            engine_kwargs = {}
+            if config.engine_impl == "paged":
+                engine_kwargs["kv_quant"] = config.kv_cache_quant
             engine = engine_cls(
                 model_cfg,
                 max_prompt_tokens=config.max_prompt_tokens,
@@ -277,6 +280,7 @@ class Trainer:
                 lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
                 attn_impl=config.attn_impl,
                 prompt_buckets=config.prompt_buckets or None,
+                **engine_kwargs,
             )
         return cls(
             train_dataset, test_dataset, reward_function, config,

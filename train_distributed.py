@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["dense", "paged"],
                    help="rollout engine: dense fixed-shape cache, or paged "
                         "ragged KV (Pallas paged-attention decode)")
+    p.add_argument("--kv_cache_quant", type=str, default="none",
+                   choices=["none", "int8"],
+                   help="paged-engine KV cache quantization (int8 halves "
+                        "cache memory + decode bandwidth)")
     p.add_argument("--rollout_workers", type=str, default="",
                    help="comma-separated control-plane workers "
                         "(host:port,...) to dispatch generation to; start "
