@@ -152,6 +152,9 @@ class TrainConfig:
     # multi-host actor fan-out (SURVEY §2b N5). The adapter ships with every
     # round; the local mesh serves the learner only.
     rollout_workers: tuple[str, ...] = ()
+    # per-update sample dump (the reference prints a problem/completion/
+    # reward sample every update, distributed_trainer.py:297–299)
+    print_samples: bool = True
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}

@@ -19,6 +19,13 @@ distributed_actor.py:148–150), built TPU-native:
   C++ block allocator reduces to this host-computed table);
 * the host-dispatched donated decode-step loop, candidate fan-out after a
   shared prefill, and async early-exit snapshots all match the dense engine.
+
+Parallelism note: this engine targets one rollout replica — a single chip or
+a TP group (KV heads shard over "tp"). Data-parallel scale-out runs one
+engine per replica (the remote-worker fan-out, distributed/remote_engine.py),
+matching vLLM's one-engine-per-GPU model; the shared page pool deliberately
+interleaves prompts, so slicing it across a dp axis needs a pool-partitioned
+shard_map design (future work — the dense engine covers GSPMD dp today).
 """
 
 from __future__ import annotations

@@ -609,6 +609,13 @@ class Trainer:
         with timer("reward"):
             self._compute_round_rewards(candidates)
 
+        if cfg.print_samples and candidates and candidates[0]["answers"]:
+            # sample dump parity (distributed_trainer.py:297–299)
+            c = candidates[0]
+            log.info("sample problem: %.200s", c["problem"][0][0])
+            log.info("sample completion: %.400s", c["answers"][0][0])
+            log.info("sample reward: %s", np.asarray(c["rewards"][0])[0])
+
         # shaping: baselines / GRPO group-norm advantages + metric collection
         # (distributed_trainer.py:262–279), then top-k (:281–294)
         stats = shape_rewards(candidates, cfg.learner)

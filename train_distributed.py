@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "distrl_llm_tpu.distributed.worker_main --serve-model")
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--seed", type=int, default=3407)
+    p.add_argument("--no_print_samples", dest="print_samples",
+                   action="store_false",
+                   help="disable the per-update sample dump (reference "
+                        "prints one sample per update)")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics_backend", type=str, default="auto",
