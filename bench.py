@@ -156,6 +156,8 @@ def main() -> int:
     engine_kwargs = {}
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["kv_quant"] = os.environ.get("BENCH_KV_QUANT", "none")
+    if os.environ.get("BENCH_MAX_CONCURRENT"):
+        engine_kwargs["max_concurrent_rows"] = int(os.environ["BENCH_MAX_CONCURRENT"])
     engine = engine_cls(
         cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
         eos_token_ids=[151645 % cfg.vocab_size], pad_token_id=151643 % cfg.vocab_size,

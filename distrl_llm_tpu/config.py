@@ -152,6 +152,11 @@ class TrainConfig:
     # multi-host actor fan-out (SURVEY §2b N5). The adapter ships with every
     # round; the local mesh serves the learner only.
     rollout_workers: tuple[str, ...] = ()
+    # cap on concurrent candidate rows in the rollout engine (vLLM
+    # max_num_seqs; the reference tunes the same capacity knob — 256
+    # concurrent sequences, train_distributed.py:34). 0 = unlimited; rounds
+    # beyond the cap run as sequential waves of whole prompt groups.
+    max_concurrent_sequences: int = 0
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True

@@ -136,6 +136,55 @@ def _decode_step(params, lora, state: _DecodeState, rng,
     )
 
 
+def generate_in_waves(
+    inner_generate,
+    max_rows: int,
+    params,
+    lora,
+    prompt_ids,
+    prompt_mask,
+    sampling: SamplingConfig,
+    rng: jax.Array,
+    pad_id: int,
+) -> GenerationResult:
+    """Cap concurrent candidate rows at ``max_rows`` by running the round in
+    sequential WAVES of whole prompt groups — vLLM's ``max_num_seqs``
+    admission control, static-shape edition (the reference tunes the same
+    knob as engine capacity: 256 concurrent sequences @ actor_gpu_usage,
+    train_distributed.py:34). This is what lets a 7B model run the
+    reference's 480-row rollout volume on one chip: each wave's KV cache
+    fits, waves reuse one compiled program (the tail wave pads with dead
+    rows), and early exit drains each wave's stragglers."""
+    b = prompt_ids.shape[0]
+    n = max(sampling.n, 1)
+    if not max_rows or b * n <= max_rows:
+        return inner_generate(params, lora, prompt_ids, prompt_mask, sampling, rng)
+    per_wave = max(max_rows // n, 1)
+    tokens, lengths = [], []
+    for w in range(-(-b // per_wave)):
+        lo = w * per_wave
+        ids = prompt_ids[lo : lo + per_wave]
+        mask = prompt_mask[lo : lo + per_wave]
+        pad = per_wave - ids.shape[0]
+        if pad:  # tail wave: dead rows keep the compiled shape
+            ids = jnp.concatenate(
+                [jnp.asarray(ids), jnp.full((pad, ids.shape[1]), pad_id, jnp.int32)]
+            )
+            mask = jnp.concatenate(
+                [jnp.asarray(mask), jnp.zeros((pad, mask.shape[1]), jnp.int32)]
+            )
+        res = inner_generate(
+            params, lora, ids, mask, sampling, jax.random.fold_in(rng, w)
+        )
+        keep = per_wave - pad
+        tokens.append(res.tokens[:keep])
+        lengths.append(res.lengths[:keep])
+    return GenerationResult(
+        tokens=np.concatenate(tokens, axis=0),
+        lengths=np.concatenate(lengths, axis=0),
+    )
+
+
 def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
     """Host-dispatched decode loop shared by the dense and paged engines:
     call ``step_fn(state) -> state`` up to ``max_steps`` times with async
@@ -192,7 +241,9 @@ class GenerationEngine:
         attn_impl: str = "reference",
         decode_chunk: int = 128,
         prompt_buckets: Sequence[int] | None = None,
+        max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
     ):
+        self.max_concurrent_rows = max_concurrent_rows
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
@@ -273,6 +324,15 @@ class GenerationEngine:
         prompt_mask: np.ndarray,
         sampling: SamplingConfig,
         rng: jax.Array,
+    ) -> GenerationResult:
+        return generate_in_waves(
+            self._generate_wave, self.max_concurrent_rows, params, lora,
+            prompt_ids, prompt_mask, sampling, rng, self.pad_id,
+        )
+
+    def _generate_wave(
+        self, params, lora, prompt_ids, prompt_mask,
+        sampling: SamplingConfig, rng: jax.Array,
     ) -> GenerationResult:
         b, p = prompt_ids.shape
         if p != self.max_prompt_tokens:

@@ -38,7 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from distrl_llm_tpu.config import SamplingConfig
-from distrl_llm_tpu.engine.engine import GenerationResult, run_decode_loop
+from distrl_llm_tpu.engine.engine import (
+    GenerationResult,
+    generate_in_waves,
+    run_decode_loop,
+)
 from distrl_llm_tpu.models.configs import ModelConfig
 from distrl_llm_tpu.models.transformer import forward
 from distrl_llm_tpu.ops.paged import (
@@ -245,7 +249,9 @@ class PagedGenerationEngine:
         decode_chunk: int = 128,
         kv_quant: str = "none",  # "none" | "int8" (per-token absmax KV cache)
         prompt_buckets: Sequence[int] | None = None,  # accepted for interface parity
+        max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
     ):
+        self.max_concurrent_rows = max_concurrent_rows
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         self.cfg = cfg
@@ -299,6 +305,15 @@ class PagedGenerationEngine:
         prompt_mask: np.ndarray,
         sampling: SamplingConfig,
         rng: jax.Array,
+    ) -> GenerationResult:
+        return generate_in_waves(
+            self._generate_wave, self.max_concurrent_rows, params, lora,
+            prompt_ids, prompt_mask, sampling, rng, self.pad_id,
+        )
+
+    def _generate_wave(
+        self, params, lora, prompt_ids, prompt_mask,
+        sampling: SamplingConfig, rng: jax.Array,
     ) -> GenerationResult:
         b, p = prompt_ids.shape
         if p != self.max_prompt_tokens:

@@ -204,3 +204,37 @@ class TestLengthBucketing:
             self.make_bucketed([0])
         with pytest.raises(ValueError, match="buckets"):
             self.make_bucketed([P_LEN + 1])
+
+
+class TestWaveScheduling:
+    """max_concurrent_rows runs rounds as sequential waves (vLLM
+    max_num_seqs); greedy results must equal the unlimited path."""
+
+    def test_waves_match_unlimited_greedy(self, setup):
+        params, ids, mask = setup
+        cfg = SamplingConfig(max_tokens=4, temperature=0.0, n=2)
+        want = make_engine(max_new=4).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        waved = GenerationEngine(
+            TINY, max_prompt_tokens=P_LEN, max_new_tokens=4,
+            eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32, max_concurrent_rows=2,  # 1 prompt/wave
+        ).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(waved.tokens, want.tokens)
+        np.testing.assert_array_equal(waved.lengths, want.lengths)
+
+    def test_tail_wave_pads_with_dead_rows(self, setup):
+        params, ids, mask = setup
+        # 3 prompts, 2 per wave → tail wave has 1 real + 1 dead row
+        ids3 = np.concatenate([ids, ids[:1]], axis=0)
+        mask3 = np.concatenate([mask, mask[:1]], axis=0)
+        cfg = SamplingConfig(max_tokens=4, temperature=0.0, n=1)
+        want = make_engine(max_new=4).generate(
+            params, None, ids3, mask3, cfg, jax.random.PRNGKey(0))
+        waved = GenerationEngine(
+            TINY, max_prompt_tokens=P_LEN, max_new_tokens=4,
+            eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32, max_concurrent_rows=2,
+        ).generate(params, None, ids3, mask3, cfg, jax.random.PRNGKey(0))
+        assert waved.tokens.shape == want.tokens.shape == (3, 1, 4)
+        np.testing.assert_array_equal(waved.tokens, want.tokens)

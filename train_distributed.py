@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["dense", "paged"],
                    help="rollout engine: dense fixed-shape cache, or paged "
                         "ragged KV (Pallas paged-attention decode)")
+    p.add_argument("--max_concurrent_sequences", type=int, default=0,
+                   help="cap on concurrent candidate rows (vLLM max_num_seqs"
+                        "); rounds beyond the cap run as sequential waves. "
+                        "0 = unlimited")
     p.add_argument("--kv_cache_quant", type=str, default="none",
                    choices=["none", "int8"],
                    help="paged-engine KV cache quantization (int8 halves "
