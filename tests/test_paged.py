@@ -300,3 +300,48 @@ class TestKvQuant:
                 TINY, max_prompt_tokens=P_LEN, max_new_tokens=4,
                 eos_token_ids=[1], pad_token_id=0, kv_quant="int4",
             )
+
+
+class TestComposition:
+    def test_quantized_base_with_paged_engine(self, setup):
+        """int8 weight-only base (N4) composes with the paged engine (N1):
+        linear() handles quantized containers independent of the cache."""
+        from distrl_llm_tpu.ops.quant import quantize_params
+
+        params, ids, mask = setup
+        qparams = quantize_params(params, bits=8, group_size=16)
+        cfg = SamplingConfig(max_tokens=4, temperature=0.0, n=1)
+        dense = make_dense(max_new=4).generate(
+            qparams, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        paged = make_paged(max_new=4).generate(
+            qparams, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(paged.tokens, dense.tokens)
+
+    def test_trainer_round_on_paged_engine(self):
+        """A full trainer batch with the PAGED engine as the rollout backend
+        (interface drift between the engines would surface here)."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        cfg = make_config(max_prompt_tokens=16, max_new_tokens=8)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, page_size=8,
+        )
+        sink = MemorySink()
+        trainer = Trainer(
+            train, test, reward_function, cfg,
+            tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+            sink=sink,
+        )
+        batch = {"problem": train["problem"][:4], "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
