@@ -114,3 +114,60 @@ class TestKVCacheConsistency:
             np.testing.assert_allclose(
                 np.asarray(logits)[:, 0], np.asarray(full)[:, t], atol=3e-4, rtol=3e-4
             )
+
+
+class TestLlamaFamilyShapes:
+    """The Llama-3 family differs from Qwen2 in exactly the knobs that can
+    silently break a shared implementation: NO qkv bias, UNTIED embeddings,
+    different rms eps. Exercise that configuration end-to-end on tiny shapes
+    (the Qwen2 path is covered by the torch golden test above)."""
+
+    def _tiny_llama(self):
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        return ModelConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            rope_theta=500000.0, rms_norm_eps=1e-5,
+            attention_bias=False, tie_word_embeddings=False,
+        )
+
+    def test_forward_and_engine(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.config import SamplingConfig
+        from distrl_llm_tpu.engine import GenerationEngine
+        from distrl_llm_tpu.models import init_lora_params, init_params
+        from distrl_llm_tpu.models.transformer import forward
+
+        cfg = self._tiny_llama()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        assert "bq" not in params["layers"]  # no attention bias
+        assert "lm_head" in params  # untied
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(1, cfg.vocab_size, (2, 8)), jnp.int32
+        )
+        logits, _ = forward(params, cfg, ids)
+        assert logits.shape == (2, 8, cfg.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+        lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=4)
+        engine = GenerationEngine(
+            cfg, max_prompt_tokens=8, max_new_tokens=4,
+            eos_token_ids=[cfg.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32,
+        )
+        res = engine.generate(
+            params, lora, np.asarray(ids), np.ones((2, 8), np.int32),
+            SamplingConfig(max_tokens=4, temperature=0.0, n=2),
+            jax.random.PRNGKey(2),
+        )
+        assert res.tokens.shape == (2, 2, 4)
+
+    def test_preset_mapping(self):
+        from distrl_llm_tpu.models.configs import LLAMA3_8B, preset_for_model_name
+
+        assert preset_for_model_name("meta-llama/Meta-Llama-3-8B") is LLAMA3_8B

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Probe the TPU every 3 minutes; when it answers, run the benchmark matrix
+# once and exit. Results land in /tmp/bench_tpu_*.json, progress in the log.
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python - <<'EOF' 2>/dev/null
+import threading, sys
+ok = []
+def p():
+    import jax
+    ok.append(len(jax.devices()))
+t = threading.Thread(target=p, daemon=True); t.start(); t.join(60)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+for i in $(seq 1 200); do
+  if probe; then
+    echo "$(date -u +%H:%M:%S) TPU UP — running benches"
+    BENCH_NO_FALLBACK=1 timeout 900 python bench.py > /tmp/bench_tpu_dense.json 2>/tmp/bench_tpu_dense.err
+    echo "dense rc=$?: $(tail -c 300 /tmp/bench_tpu_dense.json)"
+    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged timeout 900 python bench.py > /tmp/bench_tpu_paged.json 2>/tmp/bench_tpu_paged.err
+    echo "paged rc=$?: $(tail -c 300 /tmp/bench_tpu_paged.json)"
+    timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1
+    echo "kernel check rc=$?:"; cat /tmp/tpu_kernel_tests.log | grep -E "PASS|FAIL" || tail -3 /tmp/tpu_kernel_tests.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe $i: TPU down"
+  sleep 180
+done
+echo "gave up"

@@ -1,0 +1,105 @@
+"""On-chip kernel validation: flash / splash / paged attention vs references.
+
+Runs on the REAL TPU (no conftest CPU forcing) — the validation VERDICT r1
+asked for ("run the 2 skipped tests on the chip ... record tolerance vs the
+XLA path"). Prints one PASS/FAIL line per kernel with the max error.
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    from distrl_llm_tpu.ops.attention import (
+        attention_reference, causal_padding_mask,
+    )
+
+    failures = 0
+    rng = np.random.default_rng(0)
+
+    # ---- flash attention (S=4096, the VERDICT-requested scale) ------------
+    from distrl_llm_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, kh, d = 2, 4096, 4, 2, 64
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, s, kh, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, s, kh, d)), jnp.bfloat16)
+    valid = np.ones((b, s), np.int32)
+    valid[0, : s // 3] = 0  # left padding
+    valid = jnp.asarray(valid)
+    mask = causal_padding_mask(valid, q_len=s)
+    got = np.asarray(flash_attention(q, k, v, mask).astype(jnp.float32))
+    want = np.asarray(attention_reference(q, k, v, mask).astype(jnp.float32))
+    err = np.abs(got - want) * np.asarray(valid)[:, :, None, None]
+    ok = err.max() < 3e-2  # bf16 blockwise vs xla
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} flash_attention S={s} max_err={err.max():.4f}")
+
+    # ---- splash attention (native GQA, real Mosaic compile) ---------------
+    from distrl_llm_tpu.ops.splash import splash_attention
+
+    s2 = 1024
+    q2 = jnp.asarray(rng.normal(size=(b, s2, h, d)), jnp.bfloat16)
+    k2 = jnp.asarray(rng.normal(size=(b, s2, kh, d)), jnp.bfloat16)
+    v2 = jnp.asarray(rng.normal(size=(b, s2, kh, d)), jnp.bfloat16)
+    valid2 = np.ones((b, s2), np.int32)
+    valid2[1, 900:] = 0  # right padding (packed layout)
+    valid2 = jnp.asarray(valid2)
+    got = np.asarray(
+        splash_attention(q2, k2, v2, valid2, interpret=False).astype(jnp.float32)
+    )
+    want = np.asarray(
+        attention_reference(
+            q2, k2, v2, causal_padding_mask(valid2, q_len=s2)
+        ).astype(jnp.float32)
+    )
+    err = np.abs(got - want) * np.asarray(valid2)[:, :, None, None]
+    ok = err.max() < 3e-2
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} splash_attention S={s2} max_err={err.max():.4f}")
+
+    # ---- paged attention kernel vs jnp reference --------------------------
+    from distrl_llm_tpu.ops.paged import (
+        make_page_table, paged_attention_op, paged_attention_reference,
+        pages_per_seq, write_prompt_to_pages,
+    )
+
+    ps = 128
+    cap = 1536
+    nb = 8
+    pps = pages_per_seq(cap, ps)
+    lengths = jnp.asarray(rng.integers(5, cap, size=(nb,)), jnp.int32)
+    q3 = jnp.asarray(rng.normal(size=(nb, h, d)), jnp.bfloat16)
+    k3 = jnp.asarray(rng.normal(size=(nb, cap, kh, d)), jnp.bfloat16)
+    v3 = jnp.asarray(rng.normal(size=(nb, cap, kh, d)), jnp.bfloat16)
+    table = jnp.asarray(make_page_table(nb, cap, ps))
+    k_pages = write_prompt_to_pages(
+        jnp.zeros((kh, nb * pps, ps, d), jnp.bfloat16), k3, table, ps)
+    v_pages = write_prompt_to_pages(
+        jnp.zeros((kh, nb * pps, ps, d), jnp.bfloat16), v3, table, ps)
+    got = np.asarray(
+        paged_attention_op(q3, k_pages, v_pages, lengths, table, impl="kernel")
+        .astype(jnp.float32)
+    )
+    want = np.asarray(
+        paged_attention_reference(q3, k_pages, v_pages, lengths, table)
+        .astype(jnp.float32)
+    )
+    err = np.abs(got - want)
+    ok = err.max() < 3e-2
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} paged_attention cap={cap} max_err={err.max():.4f}")
+
+    print(f"{'ALL PASS' if failures == 0 else f'{failures} FAILURES'}")
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(main())
