@@ -160,6 +160,11 @@ class TrainConfig:
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True
+    # write HF-format merged-model snapshots to run_dir/model_{step} at every
+    # save_every step and episode end (the reference's save_pretrained
+    # artifacts, distributed_trainer.py:372–380). Heavy (full model write);
+    # requires run_name and an unquantized base.
+    export_hf_snapshots: bool = False
     checkpoint_dir: str | None = None
     resume: bool = False
     metrics_backend: str = "auto"  # {"auto","wandb","jsonl","null"}

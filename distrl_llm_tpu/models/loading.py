@@ -92,6 +92,70 @@ def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
     return sd
 
 
+def state_dict_from_params(params: Params, cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Our stacked param pytree → HF-named numpy state dict (the exact
+    inverse of ``params_from_state_dict``)."""
+    sd: dict[str, np.ndarray] = {}
+    layers = params["layers"]
+    for key, hf_name in _HF_LAYER_MAP.items():
+        if key not in layers:
+            continue
+        stacked = np.asarray(layers[key])
+        if key.startswith("w"):  # ours [L, in, out] → HF [out, in]
+            stacked = stacked.transpose(0, 2, 1)
+        for i in range(cfg.num_layers):
+            sd[f"model.layers.{i}.{hf_name}"] = np.ascontiguousarray(stacked[i])
+    sd["model.embed_tokens.weight"] = np.asarray(params["embed"])
+    sd["model.norm.weight"] = np.asarray(params["final_norm"])
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
+    return sd
+
+
+def save_hf_checkpoint(
+    params: Params,
+    cfg: ModelConfig,
+    path: str,
+    *,
+    lora: Params | None = None,
+    lora_alpha: float = 16.0,
+    model_type: str = "qwen2",
+) -> None:
+    """Write an HF-format checkpoint directory (model.safetensors +
+    config.json), optionally with the LoRA adapter MERGED into the base —
+    the reference's per-``save_every`` ``save_pretrained`` snapshot
+    (distributed_actor.py:263–264 ← distributed_trainer.py:372–380), loadable
+    back through ``load_pretrained`` or transformers."""
+    from safetensors.numpy import save_file
+
+    from distrl_llm_tpu.models.lora import merge_lora
+
+    if lora is not None:
+        params = merge_lora(params, lora, lora_alpha)
+    os.makedirs(path, exist_ok=True)
+    sd = state_dict_from_params(params, cfg)
+    save_file(sd, os.path.join(path, "model.safetensors"))
+    torch_dtype = str(sd["model.embed_tokens.weight"].dtype)
+    hf_cfg = {
+        "model_type": model_type,
+        "architectures": ["Qwen2ForCausalLM" if model_type == "qwen2" else "LlamaForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "torch_dtype": torch_dtype,
+    }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+
 def load_pretrained(
     path: str,
     cfg: ModelConfig | None = None,

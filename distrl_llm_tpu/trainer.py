@@ -156,6 +156,13 @@ class Trainer:
         # from the generation hang detector (compile is slow, not hung)
         self._warm_engine_keys: set[tuple] = set()
 
+        self._last_hf_export_step = -1
+        if config.export_hf_snapshots and not config.run_name:
+            log.warning(
+                "export_hf_snapshots is set but run_name is not — no "
+                "snapshots will be written (run_dir is derived from run_name)"
+            )
+
         self.profiler = None
         if config.profile_dir:
             from distrl_llm_tpu.metrics import TraceProfiler
@@ -330,6 +337,33 @@ class Trainer:
     def save_checkpoint(self) -> None:
         if self.ckpt is not None:
             self.ckpt.save(self.total_batch_steps, self._state_tree())
+
+    def export_hf_snapshot(self) -> None:
+        """The reference's ``save_pretrained`` artifact: an HF-format
+        checkpoint of the MERGED model at run_dir/model_{step}
+        (distributed_trainer.py:372–380). Single-process runs only (a
+        multi-host gather/write-race-free export needs a
+        multihost_utils.process_allgather pass — skipped with a warning)."""
+        if self.total_batch_steps == self._last_hf_export_step:
+            return  # episode end landing on a save_every step: already written
+        if jax.process_count() > 1:
+            log.warning("HF snapshot export skipped on multi-process runs")
+            return
+        from distrl_llm_tpu.models.loading import save_hf_checkpoint
+
+        path = os.path.join(
+            self.config.run_directory, f"model_{self.total_batch_steps}"
+        )
+        try:
+            save_hf_checkpoint(
+                self.base_params_learner, self.model_cfg, path,
+                lora=self.lora, lora_alpha=self.config.lora_alpha,
+                model_type="qwen2" if self.model_cfg.attention_bias else "llama",
+            )
+            self._last_hf_export_step = self.total_batch_steps
+        except (NotImplementedError, RuntimeError) as e:  # quantized base /
+            # non-addressable shards: skip rather than kill the run
+            log.warning("HF snapshot skipped: %s", e)
 
     def save_adapter(self) -> None:
         """The reference's per-step adapter artifact (distributed_trainer.py:346
@@ -587,9 +621,13 @@ class Trainer:
                         self.evaluate()
                     if cfg.save_every and self.total_batch_steps % cfg.save_every == 0:
                         self.save_checkpoint()
+                        if cfg.export_hf_snapshots and cfg.run_name:
+                            self.export_hf_snapshot()
                 self.episode = episode + 1
                 self.batch_in_episode = 0
                 self.save_checkpoint()
+                if cfg.export_hf_snapshots and cfg.run_name:
+                    self.export_hf_snapshot()
         except EngineHangError:
             # last-gasp state capture so the documented restart path
             # (resume=True) continues from the final completed step

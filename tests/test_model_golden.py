@@ -171,3 +171,37 @@ class TestLlamaFamilyShapes:
         from distrl_llm_tpu.models.configs import LLAMA3_8B, preset_for_model_name
 
         assert preset_for_model_name("meta-llama/Meta-Llama-3-8B") is LLAMA3_8B
+
+
+class TestHfSnapshotRoundtrip:
+    """save_hf_checkpoint (the reference's save_pretrained artifact) must
+    round-trip through load_pretrained with the adapter merged."""
+
+    def test_merged_save_load(self, tmp_path):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+        from distrl_llm_tpu.models.lora import merge_lora
+        from distrl_llm_tpu.models.loading import load_pretrained, save_hf_checkpoint
+        from distrl_llm_tpu.models.transformer import forward
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        # nonzero B so the merge actually changes the weights
+        lora = jax.tree_util.tree_map(lambda x: x + 0.01, lora)
+
+        path = str(tmp_path / "model_5")
+        save_hf_checkpoint(params, TINY, path, lora=lora, lora_alpha=8.0)
+        restored, cfg2 = load_pretrained(path)
+        assert cfg2.num_layers == TINY.num_layers
+        assert cfg2.attention_bias == TINY.attention_bias
+
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(1, TINY.vocab_size, (2, 6)), jnp.int32
+        )
+        want, _ = forward(merge_lora(params, lora, 8.0), TINY, ids)
+        got, _ = forward(restored, cfg2, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

@@ -82,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics_backend", type=str, default="auto",
                    choices=["auto", "wandb", "jsonl", "null"])
+    p.add_argument("--export_hf_snapshots", action="store_true",
+                   help="write HF-format merged-model snapshots to "
+                        "run_dir/model_{step} (reference save_pretrained "
+                        "artifacts)")
     p.add_argument("--write_adapter_file", action="store_true",
                    help="export the reference's per-step adapter artifact")
     p.add_argument("--profile_dir", type=str, default=None)
