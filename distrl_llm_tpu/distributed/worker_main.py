@@ -32,7 +32,8 @@ _ENGINE_STATE: dict = {}
 
 
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
-                 seed: int, lora_rank: int = 32, lora_alpha: float = 16.0) -> None:
+                 seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
+                 engine_impl: str = "dense", kv_quant: str = "none") -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -40,6 +41,7 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     import jax.numpy as jnp
 
     from distrl_llm_tpu.engine.engine import GenerationEngine
+    from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
     from distrl_llm_tpu.models import TINY, init_params
 
     if model == "tiny":
@@ -62,10 +64,16 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     from distrl_llm_tpu.models.lora import lora_scale as _scale
 
     _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
-    _ENGINE_STATE["engine"] = GenerationEngine(
+    kwargs = {}
+    if engine_impl == "paged":
+        engine_cls = PagedGenerationEngine
+        kwargs["kv_quant"] = kv_quant
+    else:
+        engine_cls = GenerationEngine
+    _ENGINE_STATE["engine"] = engine_cls(
         cfg, max_prompt_tokens=max_prompt_tokens, max_new_tokens=max_new_tokens,
         eos_token_ids=eos, pad_token_id=pad, cache_dtype=cache_dtype,
-        lora_scale=_ENGINE_STATE["lora_scale"],
+        lora_scale=_ENGINE_STATE["lora_scale"], **kwargs,
     )
     _ENGINE_STATE["params"] = params
 
@@ -145,12 +153,17 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--lora-rank", type=int, default=32)
     parser.add_argument("--lora-alpha", type=float, default=16.0)
+    parser.add_argument("--engine-impl", type=str, default="dense",
+                        choices=["dense", "paged"])
+    parser.add_argument("--kv-quant", type=str, default="none",
+                        choices=["none", "int8"])
     args = parser.parse_args(argv)
 
     if args.serve_model:
         _init_engine(
             args.serve_model, args.max_prompt_tokens, args.max_new_tokens,
             args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+            engine_impl=args.engine_impl, kv_quant=args.kv_quant,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer
