@@ -157,6 +157,12 @@ class TrainConfig:
     # concurrent sequences, train_distributed.py:34). 0 = unlimited; rounds
     # beyond the cap run as sequential waves of whole prompt groups.
     max_concurrent_sequences: int = 0
+    # continuous batching for the paged engine: keep exactly
+    # max_concurrent_sequences candidate rows decoding and admit a pending
+    # candidate into every slot whose occupant hit EOS (vLLM's scheduler),
+    # instead of draining whole waves. Requires engine_impl="paged" and a
+    # max_concurrent_sequences cap.
+    continuous_batching: bool = False
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True
@@ -204,6 +210,13 @@ class TrainConfig:
             )
         if self.kv_cache_quant != "none" and self.engine_impl != "paged":
             raise ValueError("kv_cache_quant requires engine_impl='paged'")
+        if self.continuous_batching and (
+            self.engine_impl != "paged" or not self.max_concurrent_sequences
+        ):
+            raise ValueError(
+                "continuous_batching requires engine_impl='paged' and a "
+                "max_concurrent_sequences cap (the decode slot count)"
+            )
         if self.rollout_workers and (
             self.kv_cache_quant != "none" or self.engine_impl != "dense"
         ):

@@ -33,7 +33,8 @@ _ENGINE_STATE: dict = {}
 
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
-                 engine_impl: str = "dense", kv_quant: str = "none") -> None:
+                 engine_impl: str = "dense", kv_quant: str = "none",
+                 max_concurrent: int = 0, scheduler: str = "waves") -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -68,8 +69,11 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     if engine_impl == "paged":
         engine_cls = PagedGenerationEngine
         kwargs["kv_quant"] = kv_quant
+        kwargs["scheduler"] = scheduler
     else:
         engine_cls = GenerationEngine
+    if max_concurrent:
+        kwargs["max_concurrent_rows"] = max_concurrent
     _ENGINE_STATE["engine"] = engine_cls(
         cfg, max_prompt_tokens=max_prompt_tokens, max_new_tokens=max_new_tokens,
         eos_token_ids=eos, pad_token_id=pad, cache_dtype=cache_dtype,
@@ -157,6 +161,12 @@ def main(argv: list[str] | None = None) -> None:
                         choices=["dense", "paged"])
     parser.add_argument("--kv-quant", type=str, default="none",
                         choices=["none", "int8"])
+    parser.add_argument("--max-concurrent-sequences", type=int, default=0,
+                        help="decode row cap (vLLM max_num_seqs); 0 = unlimited")
+    parser.add_argument("--scheduler", type=str, default="waves",
+                        choices=["waves", "refill"],
+                        help="paged-engine batching: whole-prompt waves or "
+                             "per-candidate slot refill (continuous batching)")
     args = parser.parse_args(argv)
 
     if args.serve_model:
@@ -164,6 +174,8 @@ def main(argv: list[str] | None = None) -> None:
             args.serve_model, args.max_prompt_tokens, args.max_new_tokens,
             args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
+            max_concurrent=args.max_concurrent_sequences,
+            scheduler=args.scheduler,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer

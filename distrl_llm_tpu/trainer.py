@@ -274,6 +274,8 @@ class Trainer:
             engine_kwargs = {}
             if config.engine_impl == "paged":
                 engine_kwargs["kv_quant"] = config.kv_cache_quant
+                if config.continuous_batching:
+                    engine_kwargs["scheduler"] = "refill"
             if config.max_concurrent_sequences:
                 engine_kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
             engine = engine_cls(

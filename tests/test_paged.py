@@ -125,11 +125,11 @@ def make_dense(max_new=6, eos=()):
     )
 
 
-def make_paged(max_new=6, eos=()):
+def make_paged(max_new=6, eos=(), **kw):
     return PagedGenerationEngine(
         TINY, max_prompt_tokens=P_LEN, max_new_tokens=max_new,
         eos_token_ids=eos or [TINY.vocab_size - 1], pad_token_id=0,
-        cache_dtype=jnp.float32, page_size=PS,
+        cache_dtype=jnp.float32, page_size=PS, **kw,
     )
 
 
@@ -345,3 +345,141 @@ class TestComposition:
         trainer._train_batch(batch, episode=0)
         recs = [m for _, m in sink.records if "loss" in m]
         assert recs and np.isfinite(recs[-1]["loss"])
+
+
+def make_refill(max_new=6, eos=(), slots=2, **kw):
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=P_LEN, max_new_tokens=max_new,
+        eos_token_ids=eos or [TINY.vocab_size - 1], pad_token_id=0,
+        cache_dtype=jnp.float32, page_size=PS,
+        scheduler="refill", max_concurrent_rows=slots, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup4():
+    """Four distinct prompts (different greedy streams) with ragged lengths."""
+    params = init_params(jax.random.PRNGKey(7), TINY)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, TINY.vocab_size, size=(4, P_LEN)).astype(np.int32)
+    mask = np.ones((4, P_LEN), np.int32)
+    mask[0, :3] = 0
+    ids[0, :3] = 0
+    mask[2, :6] = 0
+    ids[2, :6] = 0
+    return params, ids, mask
+
+
+class TestRefillScheduler:
+    """Continuous batching: per-candidate slot refill (PagedGenerationEngine
+    scheduler="refill"). Greedy decode is scheduler-invariant, so wave mode is
+    the oracle: every candidate must produce the same stream no matter when
+    its slot admits it."""
+
+    def test_greedy_matches_waves_with_refill(self, setup4):
+        """4 candidates through 2 slots: candidates 2 and 3 are admitted only
+        after earlier occupants finish, mid-decode of the compiled program."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        oracle = make_paged().generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        res = make_refill(slots=2).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, oracle.tokens)
+        np.testing.assert_array_equal(res.lengths, oracle.lengths)
+
+    def test_eos_frees_slots_early(self, setup4):
+        """Rows hitting EOS at different steps: freed slots admit pending
+        candidates; outputs and lengths still match wave mode exactly."""
+        params, ids, mask = setup4
+        probe = make_paged(max_new=3).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=3, temperature=0.0, n=1), jax.random.PRNGKey(0),
+        )
+        # rows 0/2 stop at step 1 or 2, rows 1/3 run longer (or also stop)
+        eos = sorted({int(probe.tokens[0, 0, 1]), int(probe.tokens[2, 0, 2])})
+        cfg = SamplingConfig(max_tokens=10, temperature=0.0, n=1)
+        oracle = make_paged(max_new=10, eos=eos).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        res = make_refill(max_new=10, eos=eos, slots=2).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, oracle.tokens)
+        np.testing.assert_array_equal(res.lengths, oracle.lengths)
+
+    def test_candidate_granularity_fanout(self, setup4):
+        """n=3 candidates per prompt through 4 slots: slots mix candidates of
+        different prompts (wave mode admits whole prompt groups — refill is
+        strictly finer). Greedy keeps every candidate equal to its prompt's
+        stream."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=5, temperature=0.0, n=3)
+        oracle = make_paged(max_new=5).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(2))
+        res = make_refill(max_new=5, slots=4).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(res.tokens, oracle.tokens)
+        np.testing.assert_array_equal(res.lengths, oracle.lengths)
+
+    def test_sampling_shapes_and_bounds(self, setup4):
+        params, ids, mask = setup4
+        res = make_refill(max_new=4, slots=3).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=4, temperature=1.5, n=2), jax.random.PRNGKey(3),
+        )
+        assert res.tokens.shape == (4, 2, 4)
+        assert (res.lengths >= 1).all() and (res.lengths <= 4).all()
+
+    def test_int8_kv_refill_matches_int8_waves(self, setup4):
+        """Admit's partial-page recopy must preserve the quantized (weight,
+        scales) pair: int8-KV refill ≡ int8-KV waves under greedy."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=5, temperature=0.0, n=1)
+        oracle = make_paged(max_new=5, kv_quant="int8").generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        res = make_refill(max_new=5, slots=2, kv_quant="int8").generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, oracle.tokens)
+        np.testing.assert_array_equal(res.lengths, oracle.lengths)
+
+    def test_dead_prompt_rows_stay_padded(self, setup4):
+        """Batch-padding rows (empty mask) are never admitted: pad tokens,
+        zero length — same contract as wave mode's born-done rows."""
+        params, ids, mask = setup4
+        mask = mask.copy()
+        ids = ids.copy()
+        mask[3] = 0
+        ids[3] = 0
+        res = make_refill(max_new=4, slots=2).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=4, temperature=0.0, n=2), jax.random.PRNGKey(0),
+        )
+        np.testing.assert_array_equal(res.tokens[3], 0)
+        np.testing.assert_array_equal(res.lengths[3], 0)
+
+    def test_config_flag_requires_paged_and_cap(self):
+        from distrl_llm_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="continuous_batching"):
+            TrainConfig(continuous_batching=True)  # dense engine
+        with pytest.raises(ValueError, match="continuous_batching"):
+            TrainConfig(continuous_batching=True, engine_impl="paged")  # no cap
+        cfg = TrainConfig(
+            continuous_batching=True, engine_impl="paged",
+            max_concurrent_sequences=64,
+        )
+        assert cfg.continuous_batching
+
+    def test_dead_slots_never_corrupt_shared_pages(self, setup4):
+        """Review regression: live candidates < slot count leaves slots
+        never-admitted. Their per-step garbage KV writes must land in their
+        own private pages — an all-zero init table would alias physical page
+        0 (prompt 0's SHARED prefill page) and silently corrupt prompt 0."""
+        params, ids, mask = setup4
+        mask = mask.copy()
+        ids = ids.copy()
+        for r in (1, 2, 3):  # only prompt 0 is live
+            mask[r] = 0
+            ids[r] = 0
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=2)
+        oracle = make_paged().generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        # total=8 > slots=4 engages refill; pending holds only 2 live candidates
+        res = make_refill(slots=4).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens[0], oracle.tokens[0])
+        np.testing.assert_array_equal(res.lengths[0], oracle.lengths[0])

@@ -4,13 +4,17 @@
 cd "$(dirname "$0")/.."
 
 probe() {
-  timeout 75 python - <<'EOF' 2>/dev/null
+  # init alone can succeed while compute hangs (observed: jax.devices() in
+  # ~25s, then a 1k matmul stuck >2min) — require a real matmul to finish
+  timeout 120 python - <<'EOF' 2>/dev/null
 import threading, sys
 ok = []
 def p():
-    import jax
-    ok.append(len(jax.devices()))
-t = threading.Thread(target=p, daemon=True); t.start(); t.join(60)
+    import jax, jax.numpy as jnp
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    (x @ x).block_until_ready()
+    ok.append(1)
+t = threading.Thread(target=p, daemon=True); t.start(); t.join(110)
 sys.exit(0 if ok else 1)
 EOF
 }

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "int8"],
                    help="paged-engine KV cache quantization (int8 halves "
                         "cache memory + decode bandwidth)")
+    p.add_argument("--continuous_batching", action="store_true",
+                   help="paged-engine slot refill: keep max_concurrent_"
+                        "sequences rows decoding, admit a pending candidate "
+                        "whenever a slot's occupant hits EOS (vLLM continuous "
+                        "batching) instead of draining whole waves")
     p.add_argument("--rollout_workers", type=str, default="",
                    help="comma-separated control-plane workers "
                         "(host:port,...) to dispatch generation to; start "
