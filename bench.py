@@ -156,11 +156,25 @@ def main() -> int:
     engine_kwargs = {}
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["kv_quant"] = os.environ.get("BENCH_KV_QUANT", "none")
+        engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")
     if os.environ.get("BENCH_MAX_CONCURRENT"):
         engine_kwargs["max_concurrent_rows"] = int(os.environ["BENCH_MAX_CONCURRENT"])
+    # BENCH_EOS_RATE: approximate per-step stop probability. Random-init
+    # weights essentially never sample the real EOS id, so every row decodes
+    # max_new tokens — which hides scheduler differences (waves vs refill
+    # only diverge under length VARIANCE). A random id subset covering
+    # ~rate of the vocab makes stops ~geometric with mean ~1/rate, the
+    # realistic shape (reference rollouts average ~470 of 1200 tokens).
+    eos_rate = float(os.environ.get("BENCH_EOS_RATE", "0"))
+    if eos_rate > 0:
+        eos_rng = np.random.default_rng(42)
+        n_eos = max(1, round(eos_rate * cfg.vocab_size))
+        eos_ids = eos_rng.choice(cfg.vocab_size, size=n_eos, replace=False).tolist()
+    else:
+        eos_ids = [151645 % cfg.vocab_size]
     engine = engine_cls(
         cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
-        eos_token_ids=[151645 % cfg.vocab_size], pad_token_id=151643 % cfg.vocab_size,
+        eos_token_ids=eos_ids, pad_token_id=151643 % cfg.vocab_size,
         prompt_buckets=buckets or None,
         **engine_kwargs,
     )
@@ -193,9 +207,25 @@ def main() -> int:
     flops_per_token = _decode_flops_per_token(cfg, mean_kv)
     mfu = tps_chip * flops_per_token / (peak_tflops * 1e12)
 
+    # report the scheduler that actually RAN: the refill path only engages
+    # when the row cap is exceeded (otherwise generate() falls through to a
+    # single wave) — recording the requested value would let an A/B
+    # comparison attribute wave-mode throughput to "refill"
+    if os.environ.get("BENCH_ENGINE") == "paged":
+        cap = int(os.environ.get("BENCH_MAX_CONCURRENT", "0"))
+        engaged = (
+            engine_kwargs.get("scheduler") == "refill"
+            and cap and n_prompts * n_cand > cap
+        )
+        scheduler_ran = "refill" if engaged else "waves"
+    else:
+        scheduler_ran = None  # dense engine has no batching scheduler
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
+        "scheduler": scheduler_ran,
+        "eos_rate": eos_rate,
+        "mean_gen_tokens": round(mean_new, 1),
         "bucket_used": engine.bucket_for(pmask),
         "short_fraction": round(short_fraction, 3),
         "value": round(tps_chip, 1),

@@ -168,6 +168,13 @@ def main(argv: list[str] | None = None) -> None:
                         help="paged-engine batching: whole-prompt waves or "
                              "per-candidate slot refill (continuous batching)")
     args = parser.parse_args(argv)
+    if args.scheduler == "refill" and args.engine_impl != "paged":
+        parser.error("--scheduler refill requires --engine-impl paged")
+    if args.scheduler == "refill" and not args.max_concurrent_sequences:
+        parser.error(
+            "--scheduler refill requires --max-concurrent-sequences "
+            "(the decode slot count)"
+        )
 
     if args.serve_model:
         _init_engine(

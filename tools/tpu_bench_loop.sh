@@ -26,6 +26,15 @@ for i in $(seq 1 200); do
     echo "dense rc=$?: $(tail -c 300 /tmp/bench_tpu_dense.json)"
     BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged timeout 900 python bench.py > /tmp/bench_tpu_paged.json 2>/tmp/bench_tpu_paged.err
     echo "paged rc=$?: $(tail -c 300 /tmp/bench_tpu_paged.json)"
+    # scheduler A/B at realistic length variance (mean ~1/0.002 = 500 of
+    # 1200 tokens ≈ the reference's ~470 mean): waves pay each wave's
+    # straggler tail, refill keeps all slots busy
+    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+      timeout 900 python bench.py > /tmp/bench_tpu_waves_eos.json 2>/tmp/bench_tpu_waves_eos.err
+    echo "waves+eos rc=$?: $(tail -c 300 /tmp/bench_tpu_waves_eos.json)"
+    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill \
+      timeout 900 python bench.py > /tmp/bench_tpu_refill_eos.json 2>/tmp/bench_tpu_refill_eos.err
+    echo "refill+eos rc=$?: $(tail -c 300 /tmp/bench_tpu_refill_eos.json)"
     timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1
     echo "kernel check rc=$?:"; cat /tmp/tpu_kernel_tests.log | grep -E "PASS|FAIL" || tail -3 /tmp/tpu_kernel_tests.log
     exit 0
