@@ -212,10 +212,12 @@ def main() -> int:
     # single wave) — recording the requested value would let an A/B
     # comparison attribute wave-mode throughput to "refill"
     if os.environ.get("BENCH_ENGINE") == "paged":
-        cap = int(os.environ.get("BENCH_MAX_CONCURRENT", "0"))
+        # read the dispatch decision off the ENGINE (same condition as
+        # PagedGenerationEngine.generate) so the record can't drift from it
         engaged = (
-            engine_kwargs.get("scheduler") == "refill"
-            and cap and n_prompts * n_cand > cap
+            engine.scheduler == "refill"
+            and engine.max_concurrent_rows
+            and n_prompts * n_cand > engine.max_concurrent_rows
         )
         scheduler_ran = "refill" if engaged else "waves"
     else:

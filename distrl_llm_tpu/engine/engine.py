@@ -248,6 +248,7 @@ class GenerationEngine:
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
         self.max_total = max_prompt_tokens + max_new_tokens
+        cfg.check_within_window(self.max_total)
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale

@@ -119,7 +119,7 @@ def save_hf_checkpoint(
     *,
     lora: Params | None = None,
     lora_alpha: float = 16.0,
-    model_type: str = "qwen2",
+    model_type: str | None = None,  # default: derived from cfg.model_type
 ) -> None:
     """Write an HF-format checkpoint directory (model.safetensors +
     config.json), optionally with the LoRA adapter MERGED into the base —
@@ -136,9 +136,16 @@ def save_hf_checkpoint(
     sd = state_dict_from_params(params, cfg)
     save_file(sd, os.path.join(path, "model.safetensors"))
     torch_dtype = str(sd["model.embed_tokens.weight"].dtype)
+    model_type = model_type or cfg.model_type
+    arch = {
+        "qwen2": "Qwen2ForCausalLM",
+        "llama": "LlamaForCausalLM",
+        "mistral": "MistralForCausalLM",
+        "gemma": "GemmaForCausalLM",
+    }.get(model_type, "LlamaForCausalLM")
     hf_cfg = {
         "model_type": model_type,
-        "architectures": ["Qwen2ForCausalLM" if model_type == "qwen2" else "LlamaForCausalLM"],
+        "architectures": [arch],
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -152,6 +159,10 @@ def save_hf_checkpoint(
         "max_position_embeddings": cfg.max_position_embeddings,
         "torch_dtype": torch_dtype,
     }
+    if cfg.hidden_act == "gelu_tanh":
+        hf_cfg["hidden_act"] = "gelu_pytorch_tanh"
+    if cfg.sliding_window is not None:
+        hf_cfg["sliding_window"] = cfg.sliding_window
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 

@@ -33,11 +33,15 @@ from distrl_llm_tpu.ops.linear import linear, lora_delta
 Params = dict[str, Any]
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             offset: bool = False) -> jax.Array:
     orig_dtype = x.dtype
     x = x.astype(jnp.float32)
     x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
-    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+    w = weight.astype(jnp.float32)
+    if offset:  # Gemma stores the norm weight as a delta around 1
+        w = w + 1.0
+    return (x * w).astype(orig_dtype)
 
 
 def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
@@ -103,7 +107,7 @@ def _layer(
 ):
     b, s, _ = x.shape
     proj = partial(_proj, lora_dropout=lora_dropout, dropout_rng=dropout_rng)
-    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
+    h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps, offset=cfg.rmsnorm_offset)
     q = proj(h, p, lora, "wq", "bq", lora_scale).reshape(b, s, cfg.num_heads, cfg.head_dim)
     k = proj(h, p, lora, "wk", "bk", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     v = proj(h, p, lora, "wv", "bv", lora_scale).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
@@ -158,8 +162,12 @@ def _layer(
     att = att.reshape(b, s, cfg.q_dim)
     x = x + proj(att, p, lora, "wo", "bo", lora_scale)
 
-    h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(proj(h, p, lora, "w_gate", "b_gate", lora_scale))
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps, offset=cfg.rmsnorm_offset)
+    act = (
+        jax.nn.silu if cfg.hidden_act == "silu"
+        else partial(jax.nn.gelu, approximate=True)  # Gemma gelu_pytorch_tanh
+    )
+    gate = act(proj(h, p, lora, "w_gate", "b_gate", lora_scale))
     up = proj(h, p, lora, "w_up", "b_up", lora_scale)
     x = x + proj(gate * up, p, lora, "w_down", "b_down", lora_scale)
     return x, cache_k, cache_v
@@ -218,10 +226,13 @@ def forward(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     x = jnp.take(params["embed"], input_ids, axis=0)
+    if cfg.scale_embeddings:  # Gemma: hidden states enter at sqrt(D) scale
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
 
     # paged caches attend raggedly by per-row length (decode) or over the
     # packed input only (prefill) — the dense key window is the input itself
     sk = kv_cache["k"][0].shape[-1] if (kv_cache is not None and not paged) else s
+    cfg.check_within_window(sk)
     if attention_mask is None:
         attention_mask = jnp.ones((b, sk), dtype=jnp.int32)
     # ring and (uncached) flash consume the [B, S] validity vector directly —
@@ -304,7 +315,8 @@ def forward(
             new_v.append(cv)
         new_k, new_v = tuple(new_k), tuple(new_v)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 offset=cfg.rmsnorm_offset)
     if logits_slice is not None:
         # project only the needed positions — the learner's logprob recompute
         # discards all prompt logits, so slicing the hidden states first skips

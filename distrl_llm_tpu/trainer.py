@@ -360,7 +360,7 @@ class Trainer:
             save_hf_checkpoint(
                 self.base_params_learner, self.model_cfg, path,
                 lora=self.lora, lora_alpha=self.config.lora_alpha,
-                model_type="qwen2" if self.model_cfg.attention_bias else "llama",
+                model_type=self.model_cfg.model_type,
             )
             self._last_hf_export_step = self.total_batch_steps
         except (NotImplementedError, RuntimeError) as e:  # quantized base /

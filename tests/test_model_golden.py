@@ -205,3 +205,203 @@ class TestHfSnapshotRoundtrip:
         want, _ = forward(merge_lora(params, lora, 8.0), TINY, ids)
         got, _ = forward(restored, cfg2, ids)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+class TestMistralGolden:
+    """Mistral is Llama-structured (no bias, untied) plus a recorded sliding
+    window. Within the window, full attention is exact — golden-checked
+    against transformers' MistralForCausalLM."""
+
+    def _configs(self):
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        hf_cfg = transformers.MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-5,
+            sliding_window=64, tie_word_embeddings=False,
+            attention_dropout=0.0,
+        )
+        ours = ModelConfig.from_hf_config(hf_cfg)
+        assert ours.sliding_window == 64
+        assert not ours.attention_bias
+        return hf_cfg, ours
+
+    def test_golden_logits(self):
+        hf_cfg, cfg = self._configs()
+        torch.manual_seed(1)
+        model = transformers.MistralForCausalLM(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        from distrl_llm_tpu.models.loading import params_from_state_dict
+
+        params = params_from_state_dict(sd, cfg, dtype=np.float32)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(2, 17))
+        ours, _ = forward(params, cfg, jnp.asarray(ids))
+        theirs = hf_logits(model, ids)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-4)
+
+    def test_window_guard(self):
+        """Sequences past the window must fail loudly, not silently run full
+        attention where the checkpoint was trained with SWA."""
+        import jax
+
+        _, cfg = self._configs()
+        from distrl_llm_tpu.engine import GenerationEngine
+        from distrl_llm_tpu.models import init_params
+
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = np.random.default_rng(0).integers(1, cfg.vocab_size, (1, 70))
+        with pytest.raises(ValueError, match="sliding_window"):
+            forward(params, cfg, jnp.asarray(ids))
+        with pytest.raises(ValueError, match="sliding_window"):
+            GenerationEngine(
+                cfg, max_prompt_tokens=40, max_new_tokens=40,
+                eos_token_ids=[1], pad_token_id=0,
+            )
+
+    def test_preset_mapping(self):
+        from distrl_llm_tpu.models.configs import (
+            GEMMA_7B, MISTRAL_7B, preset_for_model_name,
+        )
+
+        assert preset_for_model_name("mistralai/Mistral-7B-Instruct-v0.1") is MISTRAL_7B
+        assert preset_for_model_name("google/gemma-7b-it") is GEMMA_7B
+
+
+class TestGemmaGolden:
+    """Gemma differs in every knob ModelConfig added for it: tanh-GELU MLP,
+    RMSNorm (1+w) offset, sqrt(hidden) embedding scaling, tied embeddings,
+    MQA-style few kv heads. Golden-checked against transformers' torch
+    GemmaForCausalLM."""
+
+    @pytest.fixture(scope="class")
+    def golden_gemma(self):
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+            head_dim=16, rope_theta=10000.0, rms_norm_eps=1e-6,
+            tie_word_embeddings=True, hidden_activation="gelu_pytorch_tanh",
+            attention_dropout=0.0,
+        )
+        cfg = ModelConfig.from_hf_config(hf_cfg)
+        assert cfg.hidden_act == "gelu_tanh"
+        assert cfg.rmsnorm_offset and cfg.scale_embeddings
+        assert cfg.tie_word_embeddings
+        torch.manual_seed(2)
+        model = transformers.GemmaForCausalLM(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        from distrl_llm_tpu.models.loading import params_from_state_dict
+
+        params = params_from_state_dict(sd, cfg, dtype=np.float32)
+        return model, params, cfg
+
+    def test_golden_logits(self, golden_gemma):
+        model, params, cfg = golden_gemma
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, cfg.vocab_size, size=(2, 13))
+        ours, _ = forward(params, cfg, jnp.asarray(ids))
+        theirs = hf_logits(model, ids)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4, rtol=3e-4)
+
+    def test_engine_decode(self, golden_gemma):
+        """Greedy engine decode matches torch greedy generation."""
+        import jax
+
+        model, params, cfg = golden_gemma
+        from distrl_llm_tpu.config import SamplingConfig
+        from distrl_llm_tpu.engine import GenerationEngine
+
+        rng = np.random.default_rng(4)
+        ids = rng.integers(1, cfg.vocab_size, size=(1, 8))
+        engine = GenerationEngine(
+            cfg, max_prompt_tokens=8, max_new_tokens=5,
+            eos_token_ids=[cfg.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32,
+        )
+        import jax as _jax
+
+        res = engine.generate(
+            params, None, ids, np.ones_like(ids),
+            SamplingConfig(max_tokens=5, temperature=0.0, n=1),
+            _jax.random.PRNGKey(0),
+        )
+        with torch.no_grad():
+            out = model.generate(
+                torch.tensor(ids), max_new_tokens=5, do_sample=False,
+                pad_token_id=0,
+            )
+        np.testing.assert_array_equal(res.tokens[0, 0], out[0, 8:].numpy())
+
+
+class TestFamilyReviewRegressions:
+    def test_gemma_snapshot_roundtrip_keeps_family(self, tmp_path):
+        """HF snapshot export must label Gemma checkpoints model_type='gemma'
+        so reload keeps the (1+w) norm offset and embedding scaling (review:
+        the old caller hardcoded qwen2/llama)."""
+        import jax
+
+        from distrl_llm_tpu.models import init_params
+        from distrl_llm_tpu.models.configs import ModelConfig
+        from distrl_llm_tpu.models.loading import load_pretrained, save_hf_checkpoint
+
+        cfg = ModelConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=2, num_kv_heads=1, head_dim=16,
+            tie_word_embeddings=True, hidden_act="gelu_tanh",
+            rmsnorm_offset=True, scale_embeddings=True,
+        )
+        assert cfg.model_type == "gemma"
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        path = str(tmp_path / "snap")
+        save_hf_checkpoint(params, cfg, path)
+        restored, cfg2 = load_pretrained(path)
+        assert cfg2.rmsnorm_offset and cfg2.scale_embeddings
+        assert cfg2.hidden_act == "gelu_tanh"
+        ids = jnp.asarray([[1, 2, 3]])
+        want, _ = forward(params, cfg, ids)
+        got, _ = forward(restored, cfg2, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_mistral_snapshot_roundtrip_keeps_window(self, tmp_path):
+        import jax
+
+        from distrl_llm_tpu.models import init_params
+        from distrl_llm_tpu.models.configs import ModelConfig
+        from distrl_llm_tpu.models.loading import load_pretrained, save_hf_checkpoint
+
+        cfg = ModelConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=2, num_kv_heads=1, head_dim=16,
+            sliding_window=128,
+        )
+        assert cfg.model_type == "mistral"
+        path = str(tmp_path / "snap")
+        save_hf_checkpoint(init_params(jax.random.PRNGKey(0), cfg), cfg, path)
+        _, cfg2 = load_pretrained(path)
+        assert cfg2.sliding_window == 128
+
+    def test_gemma2_rejected_loudly(self):
+        """Gemma-2/3 state dicts carry norms/softcapping the mapper would
+        silently drop — from_hf_config must refuse them."""
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        class _NS:
+            model_type = "gemma2"
+            vocab_size = 64
+            hidden_size = 32
+            intermediate_size = 64
+            num_hidden_layers = 2
+            num_attention_heads = 2
+
+        with pytest.raises(ValueError, match="gemma2"):
+            ModelConfig.from_hf_config(_NS())
+
+    def test_preset_does_not_claim_mixtral_or_v02(self):
+        from distrl_llm_tpu.models.configs import preset_for_model_name
+
+        assert preset_for_model_name("mistralai/Mixtral-8x7B-Instruct-v0.1") is None
+        assert preset_for_model_name("mistralai/Mistral-7B-Instruct-v0.2") is None
+        assert preset_for_model_name("mistralai/Mistral-7B-Instruct-v0.3") is None
