@@ -134,6 +134,13 @@ class TrainConfig:
     # use the exact sort-based nucleus filter (reference vLLM semantics)
     # instead of the fast bisection filter, for reproducibility runs
     top_p_exact: bool = False
+    # chunked fused-cross-entropy logprobs in the learner (unsloth CE-kernel
+    # equivalent, SURVEY §2b N3): lm_head + logsumexp run per time-chunk of
+    # this many answer positions under scan+checkpoint, shrinking the live
+    # logits buffer from [B, T, V] to [B, chunk, V] with bit-identical math.
+    # 0 = dense. At the default learner shapes (8×1200×152k vocab, f32)
+    # chunk=128 is ~5.8 GB → ~0.6 GB of logits memory.
+    logprob_chunk: int = 128
     # prompt length buckets for the rollout engine (SURVEY §2b N1): each
     # round compiles/runs at the smallest bucket holding its longest real
     # prompt. Empty = single bucket at max_prompt_tokens.

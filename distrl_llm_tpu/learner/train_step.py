@@ -50,7 +50,7 @@ def _microbatch_loss(
     lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
     attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
-    dropout_rng=None,
+    dropout_rng=None, logit_chunk: int = 0,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight."""
     logps = answer_logprobs(
@@ -58,6 +58,7 @@ def _microbatch_loss(
         mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
         attn_impl=attn_impl, attn_mesh=attn_mesh,
         lora_dropout=lora_dropout, dropout_rng=dropout_rng,
+        logit_chunk=logit_chunk,
     )
     loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
     loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
@@ -90,6 +91,7 @@ def make_train_step(
     attn_mesh=None,
     donate: bool = True,
     lora_dropout: float = 0.0,
+    logit_chunk: int = 0,  # chunked fused-CE logprobs (losses.answer_logprobs)
 ) -> Callable:
     """Build the jitted train step.
 
@@ -109,6 +111,7 @@ def make_train_step(
         attn_impl=attn_impl,
         attn_mesh=attn_mesh,
         lora_dropout=lora_dropout,
+        logit_chunk=logit_chunk,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,

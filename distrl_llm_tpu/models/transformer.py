@@ -193,6 +193,7 @@ def forward(
     paged_impl: str = "auto",
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
+    skip_lm_head: bool = False,  # return final-norm hidden states, not logits
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
@@ -329,8 +330,13 @@ def forward(
             (x.shape[0], 1, x.shape[-1]),
         )
         x = jnp.take_along_axis(x, idx, axis=1)
-    lm_head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = linear(x, lm_head).astype(jnp.float32)
+    if skip_lm_head:
+        # caller projects to the vocab itself (e.g. the learner's CHUNKED
+        # logprob path, which never wants the whole [B, S, V] buffer live)
+        logits = x
+    else:
+        lm_head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+        logits = linear(x, lm_head).astype(jnp.float32)
 
     if kv_cache is None:
         new_cache = None

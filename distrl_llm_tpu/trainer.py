@@ -144,6 +144,7 @@ class Trainer:
                 config.attn_impl == "ring" and meshes is not None
             ) else None,
             lora_dropout=config.lora_dropout,
+            logit_chunk=config.logprob_chunk,
         )
 
         self.total_batch_steps = 0

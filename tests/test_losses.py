@@ -90,3 +90,128 @@ class TestAnswerLogprobs:
         out = answer_logprobs(params, TINY, pids, pmask, aids, amask)
         assert out.shape == (2, 5)
         assert out.dtype == jnp.float32
+
+
+class TestChunkedLogprobs:
+    """logit_chunk runs lm_head + logsumexp per time-chunk (the fused-CE
+    equivalent of unsloth's Triton kernel, SURVEY §2b N3). Each position's
+    math is unchanged — values and gradients must match the dense path."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        params = init_params(jax.random.PRNGKey(5), TINY)
+        rng = np.random.default_rng(7)
+        B, P, T = 2, 6, 8
+        pids = rng.integers(1, TINY.vocab_size, size=(B, P))
+        pmask = np.ones((B, P), np.int32)
+        pmask[0, :2] = 0
+        aids = rng.integers(1, TINY.vocab_size, size=(B, T))
+        amask = np.ones((B, T), np.int32)
+        amask[1, 5:] = 0
+        return params, tuple(map(jnp.asarray, (pids, pmask, aids, amask)))
+
+    @pytest.mark.parametrize("chunk", [1, 2, 4, 3, 5])  # 3, 5: non-divisors → padded tail chunk
+    def test_values_match_dense(self, setup, chunk):
+        params, (pids, pmask, aids, amask) = setup
+        dense = answer_logprobs(params, TINY, pids, pmask, aids, amask, remat=False)
+        chunked = answer_logprobs(
+            params, TINY, pids, pmask, aids, amask, remat=False, logit_chunk=chunk
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked), np.asarray(dense), atol=1e-5, rtol=1e-5
+        )
+
+    def test_chunk_ge_t_is_dense(self, setup):
+        params, (pids, pmask, aids, amask) = setup
+        dense = answer_logprobs(params, TINY, pids, pmask, aids, amask)
+        big = answer_logprobs(params, TINY, pids, pmask, aids, amask, logit_chunk=64)
+        np.testing.assert_allclose(np.asarray(big), np.asarray(dense), atol=1e-6)
+
+    def test_gradients_match_dense(self, setup):
+        """Grad through the scan+checkpoint chunks wrt LoRA must equal the
+        dense path's — this is what the train step differentiates."""
+        from distrl_llm_tpu.models import init_lora_params
+
+        params, (pids, pmask, aids, amask) = setup
+        lora = init_lora_params(jax.random.PRNGKey(9), TINY, rank=4)
+        lora = jax.tree_util.tree_map(lambda x: x + 0.01, lora)
+
+        def loss(lora_p, chunk):
+            lp = answer_logprobs(
+                params, TINY, pids, pmask, aids, amask,
+                lora=lora_p, lora_scale=0.5, remat=False, logit_chunk=chunk,
+            )
+            return (lp * amask).sum()
+
+        g_dense = jax.grad(lambda l: loss(l, 0))(lora)
+        g_chunk = jax.grad(lambda l: loss(l, 2))(lora)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            ),
+            g_dense, g_chunk,
+        )
+
+    def test_train_step_with_chunking(self):
+        """End-to-end: a jitted train step built with logit_chunk reduces the
+        same loss as the dense one on identical inputs."""
+        import optax
+
+        from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
+        from distrl_llm_tpu.models import init_lora_params
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        rng = np.random.default_rng(11)
+        N, P, T = 4, 6, 8
+        batch = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (N, P)), jnp.int32),
+            prompt_mask=jnp.ones((N, P), jnp.int32),
+            answer_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (N, T)), jnp.int32),
+            answer_mask=jnp.ones((N, T), jnp.int32),
+            coeffs=jnp.asarray(rng.normal(size=N), jnp.float32),
+            sample_mask=jnp.ones((N,), jnp.float32),
+        )
+        losses = {}
+        for chunk in (0, 4):
+            lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+            opt = optax.sgd(1e-3)
+            step = make_train_step(
+                TINY, learner_type="pg", optimizer=opt, lora_scale=0.5,
+                micro_size=2, donate=False, logit_chunk=chunk,
+            )
+            _, _, loss = step(lora, opt.init(lora), params, batch)
+            losses[chunk] = float(loss)
+        assert np.isclose(losses[0], losses[4], atol=1e-5)
+
+    def test_chunking_shrinks_compiled_temp_memory(self):
+        """The point of the chunked path: compiled temp bytes for the grad
+        drop by at least 2× (measured ~6× at V=32k, T=512 — the dense path
+        keeps [B,T,V] logits + cotangent alive)."""
+        from distrl_llm_tpu.models import init_lora_params
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        cfg = ModelConfig(
+            vocab_size=8000, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=4)
+        B, P, T = 2, 16, 256
+        pids = jnp.ones((B, P), jnp.int32)
+        aids = jnp.ones((B, T), jnp.int32)
+        ones_p, ones_a = jnp.ones((B, P), jnp.int32), jnp.ones((B, T), jnp.int32)
+
+        def temp_bytes(chunk):
+            def loss(l):
+                lp = answer_logprobs(
+                    params, cfg, pids, ones_p, aids, ones_a,
+                    lora=l, lora_scale=0.5, remat=True, logit_chunk=chunk,
+                )
+                return (lp * ones_a).sum()
+
+            m = jax.jit(jax.grad(loss)).lower(lora).compile().memory_analysis()
+            if m is None:  # backend without memory analysis
+                pytest.skip("memory_analysis unavailable on this backend")
+            return m.temp_size_in_bytes
+
+        assert temp_bytes(32) < temp_bytes(0) / 2

@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "int8"],
                    help="paged-engine KV cache quantization (int8 halves "
                         "cache memory + decode bandwidth)")
+    p.add_argument("--logprob_chunk", type=int, default=128,
+                   help="learner fused-CE chunk: lm_head+logsumexp per this "
+                        "many answer positions (live logits [B,chunk,V] "
+                        "instead of [B,T,V]); 0 = dense")
     p.add_argument("--continuous_batching", action="store_true",
                    help="paged-engine slot refill: keep max_concurrent_"
                         "sequences rows decoding, admit a pending candidate "
