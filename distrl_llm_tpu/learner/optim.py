@@ -3,9 +3,20 @@
 TPU-native equivalent of the reference's ``bnb.optim.Adam8bit``
 (distributed_actor.py:209–211, :432–434 — SURVEY §2b N4): both Adam moments are
 stored int8 with per-block absmax scales (block = 256 elements, matching
-bitsandbytes' blockwise dynamic quantization granularity), dequantized for the
-update and requantized after. For LoRA-sized states the memory win is modest,
-but the transform works for full-rank fine-tuning too.
+bitsandbytes' blockwise quantization granularity), dequantized for the update
+and requantized after. For LoRA-sized states the memory win is modest, but the
+transform works for full-rank fine-tuning too.
+
+Moment codes are DYNAMIC (exponent + linear fraction), not linear. Linear
+absmax codes round any element below 1/254 of its block's max to ZERO — for
+the second moment that turns ``1/(sqrt(nu)+eps)`` into ``1/eps`` and the Adam
+step explodes by ~1e8·lr (observed as adapter weights at 1e6 in an RL
+training run; this is why bitsandbytes uses its "dynamic" quantization map
+for optimizer state). The dynamic code splits the 127 magnitude levels across
+7 decades with 2^(6−d) linear fractions in decade d: ~0.7% relative error
+near the block max (where most moment mass sits), coarser but NEVER ZERO down
+to 1e-7·blockmax — so a denominator can be off by a bounded factor but can
+never collapse to eps.
 
 The quantize/dequantize round-trip runs inside the jitted update — XLA fuses it
 with the Adam arithmetic, so there is no extra HBM traffic beyond reading int8
@@ -19,9 +30,34 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 BLOCK = 256
+
+
+def _dynamic_table() -> np.ndarray:
+    """127 ascending magnitudes in (0, 1]: decade d (values f·10^−d,
+    f ∈ [0.1, 1)) gets 2^(6−d) linear fraction levels — 64 in the top decade
+    down to a single level at 1e-7. The max (1.0) is exactly representable so
+    each block's absmax round-trips bit-exact."""
+    mags: list[float] = []
+    for d in range(7):
+        n = 2 ** (6 - d)
+        if d == 0:
+            fr = np.linspace(0.1, 1.0, n)  # include 1.0
+        else:
+            fr = np.linspace(0.1, 1.0, n, endpoint=False)
+        mags.extend((fr * 10.0**-d).tolist())
+    table = np.sort(np.asarray(mags, np.float64))
+    assert table.shape == (127,) and table[-1] == 1.0
+    return table
+
+
+_TABLE = _dynamic_table()
+# decision boundaries: below mid(0) → code 0 (zero); else nearest table entry
+_MIDS = np.concatenate(([_TABLE[0] / 2.0], (_TABLE[:-1] + _TABLE[1:]) / 2.0))
+_LUT = np.concatenate(([0.0], _TABLE)).astype(np.float32)  # code → magnitude
 
 
 @dataclass
@@ -43,27 +79,39 @@ jax.tree_util.register_pytree_node(
 
 
 def _quantize(x: jax.Array) -> _Quantized:
+    """Signed dynamic code: q = sign·m, m ∈ {0..127} indexing ``_LUT``."""
     flat = x.reshape(-1)
     size = flat.shape[0]
     pad = (-size) % BLOCK
     flat = jnp.pad(flat, (0, pad))
     blocks = flat.reshape(-1, BLOCK)
     scale = jnp.max(jnp.abs(blocks), axis=1)
-    safe = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.round(blocks / safe[:, None] * 127.0), -127, 127).astype(jnp.int8)
+    safe = jnp.where(scale > 0, scale, 1.0)[:, None]
+    r = jnp.abs(blocks) / safe
+    m = jnp.searchsorted(jnp.asarray(_MIDS, jnp.float32), r, side="right")
+    q = (jnp.sign(blocks) * m.astype(jnp.float32)).astype(jnp.int8)
     return _Quantized(q.reshape(-1), scale, size, tuple(x.shape))
 
 
 def _dequantize(z: _Quantized, dtype=jnp.float32) -> jax.Array:
-    blocks = z.q.reshape(-1, BLOCK).astype(dtype)
-    x = blocks * (z.scale[:, None] / 127.0).astype(dtype)
-    return x.reshape(-1)[: z.size].reshape(z.shape)
+    q = z.q.reshape(-1, BLOCK).astype(jnp.int32)
+    mag = jnp.asarray(_LUT)[jnp.abs(q)]
+    val = jnp.sign(q.astype(jnp.float32)) * mag * z.scale[:, None]
+    return val.astype(dtype).reshape(-1)[: z.size].reshape(z.shape)
+
+
+# bump when the int8 code semantics change (v2 = dynamic LUT + sqrt-nu
+# storage; v1 was linear absmax over raw nu). The version leaf makes a resume
+# from an incompatible checkpoint fail LOUDLY at restore (tree-structure /
+# value mismatch) instead of silently mis-decoding the moment payloads.
+STATE_FORMAT = 2
 
 
 class Adam8bitState(NamedTuple):
     count: jax.Array
     mu: dict
-    nu: dict
+    nu: dict  # stores sqrt(nu) — see adam8bit docstring
+    code_version: jax.Array  # == STATE_FORMAT
 
 
 def adam8bit(
@@ -71,25 +119,47 @@ def adam8bit(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    clip_normalized: float = 5.0,
 ) -> optax.GradientTransformation:
     """Adam(lr) with int8 blockwise moment state. Defaults match
-    bnb.optim.Adam8bit's (the reference passes only lr)."""
+    bnb.optim.Adam8bit's (the reference passes only lr).
+
+    Two hardening choices beyond bnb, both motivated by an observed RL
+    blowup (see module docstring):
+
+    * the second moment is stored as ``sqrt(nu)`` — squaring on dequant
+      doubles the code's dynamic range in nu-space (grad ratios down to
+      1e-7 of the block max stay representable, vs 3e-4 if nu were stored
+      directly);
+    * the normalized update ``mu_hat/(sqrt(nu_hat)+eps)`` is clipped to
+      ``±clip_normalized`` (exact Adam keeps it near ±1, so 5.0 never binds
+      on healthy steps) — the backstop for elements whose second moment
+      still quantizes to zero, where the step would otherwise be
+      ``mu_hat/eps ~ 1e8``.
+    """
 
     def init_fn(params):
         zeros = jax.tree_util.tree_map(lambda p: _quantize(jnp.zeros_like(p, jnp.float32)), params)
         nu = jax.tree_util.tree_map(lambda p: _quantize(jnp.zeros_like(p, jnp.float32)), params)
-        return Adam8bitState(count=jnp.zeros([], jnp.int32), mu=zeros, nu=nu)
+        return Adam8bitState(
+            count=jnp.zeros([], jnp.int32), mu=zeros, nu=nu,
+            code_version=jnp.asarray(STATE_FORMAT, jnp.int32),
+        )
 
     def update_fn(updates, state, params=None):
         count = state.count + 1
         def upd(g, mu_q, nu_q):
             g = g.astype(jnp.float32)
             mu = b1 * _dequantize(mu_q) + (1 - b1) * g
-            nu = b2 * _dequantize(nu_q) + (1 - b2) * g * g
+            nu = b2 * jnp.square(_dequantize(nu_q)) + (1 - b2) * g * g
             mu_hat = mu / (1 - b1 ** count.astype(jnp.float32))
             nu_hat = nu / (1 - b2 ** count.astype(jnp.float32))
-            step = -learning_rate * mu_hat / (jnp.sqrt(nu_hat) + eps)
-            return step, _quantize(mu), _quantize(nu)
+            normalized = jnp.clip(
+                mu_hat / (jnp.sqrt(nu_hat) + eps),
+                -clip_normalized, clip_normalized,
+            )
+            step = -learning_rate * normalized
+            return step, _quantize(mu), _quantize(jnp.sqrt(nu))
 
         flat_u, treedef = jax.tree_util.tree_flatten(updates)
         flat_mu = treedef.flatten_up_to(state.mu)
@@ -101,7 +171,10 @@ def adam8bit(
         steps = jax.tree_util.tree_map(
             lambda s, g: s.astype(g.dtype), steps, updates
         )
-        return steps, Adam8bitState(count=count, mu=new_mu, nu=new_nu)
+        return steps, Adam8bitState(
+            count=count, mu=new_mu, nu=new_nu,
+            code_version=state.code_version,
+        )
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import multiprocessing
 import re
 from concurrent.futures import ProcessPoolExecutor
+from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -92,8 +93,8 @@ def reward_function(completions: Sequence[str], solutions: Sequence[str]) -> np.
     return np.column_stack((fmt, accuracy))
 
 
-def _reward_task(args: tuple[Sequence[str], Sequence[str]]) -> np.ndarray:
-    return reward_function(*args)
+def _reward_task(fn, args: tuple[Sequence[str], Sequence[str]]) -> np.ndarray:
+    return fn(*args)
 
 
 class RewardComputer:
@@ -103,11 +104,20 @@ class RewardComputer:
     (distributed_trainer.py:205–219). On a TPU host with dozens of cores we fan
     groups out across processes; for small workloads the serial path avoids
     pool overhead.
+
+    ``reward_fn`` is the function actually evaluated — the trainer builds the
+    computer around its ``reward_function`` argument (the reference's
+    ``Trainer(train_ds, test_ds, reward_fn, config)`` contract,
+    distributed_trainer.py:14), defaulting to the parity ``reward_function``.
+    The parallel path pickles the fn to worker processes, so custom fns must
+    be module-level for ``num_workers > 0`` (closures work on the serial path).
     """
 
-    def __init__(self, num_workers: int = 0, parallel_threshold: int = 256):
+    def __init__(self, num_workers: int = 0, parallel_threshold: int = 256,
+                 reward_fn=None):
         self.num_workers = num_workers
         self.parallel_threshold = parallel_threshold
+        self.reward_fn = reward_fn if reward_fn is not None else reward_function
         self._pool: ProcessPoolExecutor | None = None
 
     def _ensure_pool(self) -> ProcessPoolExecutor:
@@ -123,8 +133,9 @@ class RewardComputer:
     ) -> list[np.ndarray]:
         total = sum(len(c) for c, _ in groups)
         if self.num_workers and total >= self.parallel_threshold:
-            return list(self._ensure_pool().map(_reward_task, groups))
-        return [reward_function(c, s) for c, s in groups]
+            task = partial(_reward_task, self.reward_fn)
+            return list(self._ensure_pool().map(task, groups))
+        return [self.reward_fn(c, s) for c, s in groups]
 
     def close(self):
         if self._pool is not None:

@@ -89,7 +89,6 @@ class Trainer:
         self.config = config
         self.train_dataset = DictDataset.wrap(train_dataset)
         self.test_dataset = DictDataset.wrap(test_dataset)
-        self.reward_function = reward_function
         self.tokenizer = tokenizer
         self.engine = engine
         self.base_params = base_params
@@ -103,7 +102,23 @@ class Trainer:
         self.model_cfg = model_cfg
         self.meshes = meshes
         self.sink = sink
-        self.rewards = reward_computer or RewardComputer()
+        # the computer evaluates THIS trainer's reward_function (a custom fn
+        # passed positionally — the reference contract — must actually run).
+        # An explicit reward_computer carries parallelism config; if it still
+        # holds the default fn, it adopts the trainer's; a computer customized
+        # with a DIFFERENT fn than the trainer's is ambiguous — refuse.
+        from distrl_llm_tpu.rewards import reward_function as _default_reward
+
+        if reward_computer is None:
+            reward_computer = RewardComputer(reward_fn=reward_function)
+        elif reward_computer.reward_fn is _default_reward:
+            reward_computer.reward_fn = reward_function
+        elif reward_computer.reward_fn is not reward_function:
+            raise ValueError(
+                "reward_computer was built with a different reward_fn than "
+                "the one passed to Trainer — pass the fn in exactly one place"
+            )
+        self.rewards = reward_computer
 
         # chunk-composition validation parity (distributed_trainer.py:34–36)
         assert config.number_of_learners > 0, "Need at least one learner"

@@ -309,3 +309,43 @@ class TestLearningDynamics:
             lora, opt_state, loss = step(lora, opt_state, base, batch)
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
+
+
+class TestTensorParallelStep:
+    """BASELINE configs 2/5 train with TP (and FSDP) learner shardings; the
+    update must be invariant to them. Base params take the Megatron specs
+    (parallel/partition.py), the batch shards over dp, and the LoRA update
+    must equal the single-device step's."""
+
+    @pytest.mark.parametrize("tp,fsdp,dp", [(2, 1, 4), (2, 2, 2), (4, 2, 1)])
+    def test_tp_fsdp_sharded_step_matches_single_device(self, model, tp, fsdp, dp):
+        from distrl_llm_tpu.parallel import param_specs, shard_tree
+        from distrl_llm_tpu.parallel.mesh import _make_mesh
+        from distrl_llm_tpu.parallel.partition import shard_opt_state
+
+        base, lora = model
+        rng = np.random.default_rng(5)
+        batch = make_batch(rng, 8)
+        opt = make_optimizer(1e-2, use_8bit=False)
+        step = make_train_step(
+            TINY, learner_type="pg", optimizer=opt, lora_scale=0.5,
+            micro_size=4, remat=False, donate=False,
+            logit_chunk=4,  # chunked CE must also be sharding-invariant
+        )
+        expected, _, expected_loss = step(lora, opt.init(lora), base, batch)
+
+        mesh = _make_mesh(jax.devices()[: tp * fsdp * dp], tp, 1, fsdp)
+        base_sh = shard_tree(base, mesh, param_specs(base))
+        lora_sh = shard_tree(lora, mesh)
+        opt_sh = shard_opt_state(opt.init(lora_sh), mesh)
+        shard_rows = lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("dp") if x.ndim == 1 else P("dp", None))
+        )
+        batch_sh = jax.tree_util.tree_map(shard_rows, batch)
+        with mesh:
+            got, _, got_loss = step(lora_sh, opt_sh, base_sh, batch_sh)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(expected), jax.tree_util.tree_leaves(got)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+        assert float(got_loss) == pytest.approx(float(expected_loss), rel=1e-4)
