@@ -184,9 +184,11 @@ class TrainConfig:
     # attention implementation for learner/prefill forwards:
     # "reference" (XLA softmax), "flash" (Pallas blockwise kernel, TPU only,
     # GQA via repeat — ops/flash_attention.py), "splash" (Pallas multi-query
-    # kernel, native GQA with no KV repeat — ops/splash.py), or "ring"
-    # (sequence-parallel — ops/ring_attention.py); non-TPU backends fall back
-    # to the reference path with a warning
+    # kernel, native GQA with no KV repeat — ops/splash.py), "ring"
+    # (sequence-parallel by KV rotation — ops/ring_attention.py), or
+    # "ulysses" (sequence-parallel by all-to-all head scatter — ops/ulysses.py;
+    # needs heads divisible by sp); non-TPU backends fall back to the
+    # reference path with a warning
     attn_impl: str = "reference"
     write_adapter_file: bool = False  # artifact-parity adapter writer
     # jax.profiler trace capture (SURVEY §5 tracing): traces the step window

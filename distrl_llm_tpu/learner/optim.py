@@ -179,6 +179,21 @@ def adam8bit(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def check_state_format(opt_state) -> None:
+    """Raise if a (restored) 8-bit Adam state's code version differs from
+    this build's ``STATE_FORMAT`` — same-structure format changes would
+    otherwise restore cleanly and silently mis-decode the moment payloads
+    (different-structure changes already fail at Orbax restore)."""
+    if isinstance(opt_state, Adam8bitState):
+        got = int(opt_state.code_version)
+        if got != STATE_FORMAT:
+            raise ValueError(
+                f"checkpointed 8-bit Adam state is format v{got}; this build "
+                f"reads v{STATE_FORMAT} — restart without resume (the moment "
+                "payloads are not decodable across formats)"
+            )
+
+
 def make_optimizer(lr: float, use_8bit: bool = True) -> optax.GradientTransformation:
     """The learner optimizer: Adam(lr), 8-bit state by default (reference:
     Adam8bit with no weight decay — distributed_actor.py:209–211)."""

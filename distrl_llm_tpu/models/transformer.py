@@ -157,6 +157,12 @@ def _layer(
         from distrl_llm_tpu.ops.ring_attention import ring_attention
 
         att = ring_attention(q, k, v, key_valid, mesh=attn_mesh)
+    elif attn_impl == "ulysses" and attn_mesh is not None:
+        # sequence parallelism by head scatter (two all-to-alls per layer);
+        # needs H and K divisible by sp — ring covers the rest
+        from distrl_llm_tpu.ops.ulysses import ulysses_attention
+
+        att = ulysses_attention(q, k, v, key_valid, mesh=attn_mesh)
     else:
         att = attention(q, k, v, mask, impl=attn_impl, key_valid=key_valid)
     att = att.reshape(b, s, cfg.q_dim)
@@ -242,8 +248,8 @@ def forward(
     # DCE'd under jit, but eager/non-jit callers would pay it)
     needs_dense_mask = (
         (kv_cache is not None and not paged)
-        or (paged and s > 1 and attn_impl not in ("ring", "flash", "splash"))
-        or (kv_cache is None and attn_impl not in ("ring", "flash", "splash"))
+        or (paged and s > 1 and attn_impl not in ("ring", "ulysses", "flash", "splash"))
+        or (kv_cache is None and attn_impl not in ("ring", "ulysses", "flash", "splash"))
     )
     mask = (
         causal_padding_mask(

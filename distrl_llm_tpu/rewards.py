@@ -117,6 +117,10 @@ class RewardComputer:
                  reward_fn=None):
         self.num_workers = num_workers
         self.parallel_threshold = parallel_threshold
+        # distinguishes "caller explicitly chose a fn" from the default, so a
+        # Trainer can refuse a genuine conflict without mutating a computer
+        # that is shared across Trainers
+        self.fn_explicit = reward_fn is not None
         self.reward_fn = reward_fn if reward_fn is not None else reward_function
         self._pool: ProcessPoolExecutor | None = None
 
@@ -129,13 +133,16 @@ class RewardComputer:
         return self._pool
 
     def __call__(
-        self, groups: Sequence[tuple[Sequence[str], Sequence[str]]]
+        self,
+        groups: Sequence[tuple[Sequence[str], Sequence[str]]],
+        reward_fn=None,
     ) -> list[np.ndarray]:
+        fn = reward_fn if reward_fn is not None else self.reward_fn
         total = sum(len(c) for c, _ in groups)
         if self.num_workers and total >= self.parallel_threshold:
-            task = partial(_reward_task, self.reward_fn)
+            task = partial(_reward_task, fn)
             return list(self._ensure_pool().map(task, groups))
-        return [self.reward_fn(c, s) for c, s in groups]
+        return [fn(c, s) for c, s in groups]
 
     def close(self):
         if self._pool is not None:

@@ -104,21 +104,22 @@ class Trainer:
         self.sink = sink
         # the computer evaluates THIS trainer's reward_function (a custom fn
         # passed positionally — the reference contract — must actually run).
-        # An explicit reward_computer carries parallelism config; if it still
-        # holds the default fn, it adopts the trainer's; a computer customized
-        # with a DIFFERENT fn than the trainer's is ambiguous — refuse.
-        from distrl_llm_tpu.rewards import reward_function as _default_reward
-
+        # An explicit reward_computer carries parallelism config; the fn is
+        # passed per call so a computer shared across Trainers is never
+        # mutated. A computer EXPLICITLY built with a different fn than the
+        # trainer's is ambiguous — refuse.
         if reward_computer is None:
             reward_computer = RewardComputer(reward_fn=reward_function)
-        elif reward_computer.reward_fn is _default_reward:
-            reward_computer.reward_fn = reward_function
-        elif reward_computer.reward_fn is not reward_function:
+        elif (
+            reward_computer.fn_explicit
+            and reward_computer.reward_fn is not reward_function
+        ):
             raise ValueError(
                 "reward_computer was built with a different reward_fn than "
                 "the one passed to Trainer — pass the fn in exactly one place"
             )
         self.rewards = reward_computer
+        self._reward_fn = reward_function
 
         # chunk-composition validation parity (distributed_trainer.py:34–36)
         assert config.number_of_learners > 0, "Need at least one learner"
@@ -156,7 +157,7 @@ class Trainer:
             ),
             attn_impl=config.attn_impl,
             attn_mesh=meshes.learner if (
-                config.attn_impl == "ring" and meshes is not None
+                config.attn_impl in ("ring", "ulysses") and meshes is not None
             ) else None,
             lora_dropout=config.lora_dropout,
             logit_chunk=config.logprob_chunk,
@@ -336,6 +337,9 @@ class Trainer:
             return
         self.lora = restored["lora"]
         self.opt_state = restored["opt_state"]
+        from distrl_llm_tpu.learner.optim import check_state_format
+
+        check_state_format(self.opt_state)
         if self.meshes is not None:
             from distrl_llm_tpu.parallel.partition import shard_opt_state, shard_tree
 
@@ -588,7 +592,7 @@ class Trainer:
                 (cand["answers"][j], cand["solution"][j])
                 for j in range(len(cand["answers"]))
             ]
-            cand["rewards"] = self.rewards(groups)
+            cand["rewards"] = self.rewards(groups, reward_fn=self._reward_fn)
 
     def _generate_all_candidates(
         self, batch: Mapping[str, Sequence[str]], sampling: SamplingConfig | None = None

@@ -1,0 +1,160 @@
+"""Ulysses attention vs the single-device reference, on the 8-device CPU mesh.
+
+Same oracle as the ring tests: ``attention_reference`` with the causal padding
+mask. Ulysses' distinguishing constraints (heads divisible by sp; GQA block
+alignment through the head scatter) get their own cases.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.ops.attention import attention_reference, causal_padding_mask
+from distrl_llm_tpu.ops.ulysses import ulysses_attention
+from distrl_llm_tpu.parallel.mesh import _make_mesh
+
+
+def make_qkv(b=2, s=32, h=4, kh=2, d=16, seed=0):
+    r = np.random.default_rng(seed)
+    q = jnp.asarray(r.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(b, s, kh, d)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(b, s, kh, d)), jnp.float32)
+    return q, k, v
+
+
+def reference(q, k, v, valid):
+    mask = causal_padding_mask(valid, q_len=q.shape[1])
+    return attention_reference(q, k, v, mask)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("sp", [1, 2])
+    def test_matches_reference(self, sp):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=sp, fsdp=1)
+        q, k, v = make_qkv(s=32)  # h=4, kh=2 → sp ≤ 2
+        valid = jnp.ones((2, 32), jnp.int32)
+        out = ulysses_attention(q, k, v, valid, mesh=mesh)
+        ref = reference(q, k, v, valid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_mha_many_shards(self):
+        """sp=8 with 8 MHA heads: one head per device after the scatter."""
+        mesh = _make_mesh(jax.devices(), tp=1, sp=8, fsdp=1)
+        q, k, v = make_qkv(s=32, h=8, kh=8, seed=5)
+        valid = jnp.ones((2, 32), jnp.int32)
+        out = ulysses_attention(q, k, v, valid, mesh=mesh)
+        ref = reference(q, k, v, valid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_left_padding(self):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
+        q, k, v = make_qkv(s=32, seed=1)
+        am = np.ones((2, 32), np.int32)
+        am[0, :10] = 0
+        am[1, :31] = 0  # a single valid token
+        valid = jnp.asarray(am)
+        out = ulysses_attention(q, k, v, valid, mesh=mesh)
+        ref = reference(q, k, v, valid)
+        real = np.asarray(am, bool)
+        np.testing.assert_allclose(
+            np.asarray(out)[real], np.asarray(ref)[real], atol=1e-5
+        )
+
+    def test_gradients_match_reference(self):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
+        q, k, v = make_qkv(s=16, seed=3)
+        valid = jnp.ones((2, 16), jnp.int32)
+
+        def loss_uly(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, valid, mesh=mesh) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference(q, k, v, valid) ** 2)
+
+        gu = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_indivisible_heads_raise(self):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=4, fsdp=1)
+        q, k, v = make_qkv(s=32)  # kh=2 < sp=4
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(q, k, v, jnp.ones((2, 32), jnp.int32), mesh=mesh)
+
+    def test_indivisible_sequence_raises(self):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
+        q, k, v = make_qkv(s=31)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, k, v, jnp.ones((2, 31), jnp.int32), mesh=mesh)
+
+    def test_works_under_jit_with_dp(self):
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)  # dp=4 × sp=2
+        q, k, v = make_qkv(b=4, s=32, seed=4)
+        valid = jnp.ones((4, 32), jnp.int32)
+
+        @jax.jit
+        def run(q, k, v):
+            return ulysses_attention(q, k, v, valid, mesh=mesh)
+
+        np.testing.assert_allclose(
+            np.asarray(run(q, k, v)), np.asarray(reference(q, k, v, valid)), atol=1e-5
+        )
+
+
+class TestUlyssesInModel:
+    def test_forward_matches_reference_impl(self):
+        from distrl_llm_tpu.models import TINY, forward, init_lora_params, init_params
+
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, TINY.vocab_size, (2, 16)), jnp.int32
+        )
+        am = np.ones((2, 16), np.int32)
+        am[0, :5] = 0
+        ref, _ = forward(params, TINY, ids, attention_mask=jnp.asarray(am),
+                         lora=lora, lora_scale=0.5)
+        uly, _ = forward(params, TINY, ids, attention_mask=jnp.asarray(am),
+                         lora=lora, lora_scale=0.5, attn_impl="ulysses",
+                         attn_mesh=mesh)
+        real = np.asarray(am, bool)
+        np.testing.assert_allclose(
+            np.asarray(uly)[real], np.asarray(ref)[real], atol=2e-4, rtol=2e-4
+        )
+
+    def test_train_step_matches_reference_impl(self):
+        from distrl_llm_tpu.learner.optim import make_optimizer
+        from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+
+        mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        opt = make_optimizer(1e-3, use_8bit=False)
+        rng = np.random.default_rng(0)
+        batch = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(2, TINY.vocab_size, (2, 6)), jnp.int32),
+            prompt_mask=jnp.ones((2, 6), jnp.int32),
+            answer_ids=jnp.asarray(rng.integers(2, TINY.vocab_size, (2, 6)), jnp.int32),
+            answer_mask=jnp.ones((2, 6), jnp.int32),
+            coeffs=jnp.asarray([1.0, -0.5], jnp.float32),
+            sample_mask=jnp.ones((2,), jnp.float32),
+        )
+        outs = {}
+        for impl, m in (("reference", None), ("ulysses", mesh)):
+            step = make_train_step(
+                TINY, learner_type="grpo", optimizer=opt, lora_scale=0.5,
+                micro_size=2, attn_impl=impl, attn_mesh=m, donate=False,
+            )
+            new_lora, _, loss = step(lora, opt.init(lora), params, batch)
+            outs[impl] = (new_lora, float(loss))
+        assert np.isclose(outs["ulysses"][1], outs["reference"][1], atol=1e-4)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(outs["ulysses"][0]),
+            jax.tree_util.tree_leaves(outs["reference"][0]),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

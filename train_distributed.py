@@ -50,11 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--learner_gpu_usage", type=float, default=0.35)
     # --- TPU-native additions
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel chips per role")
-    p.add_argument("--sp", type=int, default=1, help="sequence-parallel (ring attention) chips")
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel chips (ring/ulysses attention)")
     p.add_argument("--fsdp", type=int, default=1, help="learner parameter sharding")
     p.add_argument("--base_quant", type=str, default="none", choices=["none", "int8", "int4"])
     p.add_argument("--attn_impl", type=str, default="reference",
-                   choices=["reference", "flash", "splash", "ring"])
+                   choices=["reference", "flash", "splash", "ring", "ulysses"])
     p.add_argument("--engine_impl", type=str, default="dense",
                    choices=["dense", "paged"],
                    help="rollout engine: dense fixed-shape cache, or paged "
