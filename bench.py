@@ -83,6 +83,106 @@ def _emit(record: dict) -> None:
     print(json.dumps(record))
 
 
+def _train_flops_per_token(cfg, seq_len: int) -> float:
+    """Model FLOPs per trained token: 3× the forward's 2·matmul-params
+    (fwd + ~2× for backward through frozen base + LoRA) plus causal
+    attention dot-products at mean key length seq_len/2, also ×3."""
+    per_layer = (
+        cfg.hidden_size * cfg.q_dim
+        + 2 * cfg.hidden_size * cfg.kv_dim
+        + cfg.q_dim * cfg.hidden_size
+        + 3 * cfg.hidden_size * cfg.intermediate_size
+    )
+    matmul_params = cfg.num_layers * per_layer + cfg.hidden_size * cfg.vocab_size
+    attn = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim * (seq_len / 2.0)
+    return 3.0 * (2.0 * matmul_params + attn)
+
+
+def _learner_bench(cfg, name: str, fallback_err) -> int:
+    """BENCH_MODE=learner: time the jitted train step at the reference
+    learner shapes (micro 8 × [350 prompt + 1200 answer], distributed_
+    actor.py:217–229) — the second headline metric next to rollout tok/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from distrl_llm_tpu.learner.optim import make_optimizer
+    from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
+    from distrl_llm_tpu.models import init_lora_params, init_params
+    from distrl_llm_tpu.models.lora import lora_scale
+
+    n_rows = int(os.environ.get("BENCH_ROWS", "8"))
+    p_len = int(os.environ.get("BENCH_MAX_PROMPT", "350"))
+    t_len = int(os.environ.get("BENCH_MAX_NEW", "1200"))
+    micro = int(os.environ.get("BENCH_MICRO", str(min(n_rows, 8))))
+    lora_rank = int(os.environ.get("BENCH_LORA_RANK", "32"))
+    logit_chunk = int(os.environ.get("BENCH_LOGPROB_CHUNK", "128"))
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    steps = int(os.environ.get("BENCH_STEPS", "3"))
+
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank)
+    optimizer = make_optimizer(2e-5, use_8bit=True)
+    opt_state = optimizer.init(lora)
+    step = make_train_step(
+        cfg, learner_type="grpo", optimizer=optimizer,
+        lora_scale=lora_scale(lora_rank, 16.0), micro_size=micro,
+        donate=False, logit_chunk=logit_chunk,
+    )
+    rng = np.random.default_rng(0)
+    batch = UpdateBatch(
+        prompt_ids=jnp.asarray(rng.integers(1, cfg.vocab_size, (n_rows, p_len)), jnp.int32),
+        prompt_mask=jnp.ones((n_rows, p_len), jnp.int32),
+        answer_ids=jnp.asarray(rng.integers(1, cfg.vocab_size, (n_rows, t_len)), jnp.int32),
+        answer_mask=jnp.ones((n_rows, t_len), jnp.int32),
+        coeffs=jnp.asarray(rng.normal(size=n_rows), jnp.float32),
+        sample_mask=jnp.ones((n_rows,), jnp.float32),
+    )
+    t0 = time.perf_counter()
+    lora, opt_state, loss = step(lora, opt_state, params, batch)
+    jax.block_until_ready(loss)
+    compile_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lora, opt_state, loss = step(lora, opt_state, params, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = n_rows * (p_len + t_len)
+    tps = tokens / dt
+    # the step here is built with NO mesh, so jit places it on ONE device —
+    # dividing by device_count would understate per-chip throughput/MFU by
+    # the host's chip count (sharded-step benching comes with a mesh config)
+    n_chips = 1
+    flops = _train_flops_per_token(cfg, p_len + t_len)
+    mfu = (tps / n_chips) * flops / (peak_tflops * 1e12)
+    record = {
+        "metric": "learner_tokens_per_sec_per_chip",
+        "value": round(tps / n_chips, 1),
+        "unit": "tok/s/chip",
+        # baseline: reference learner processes 480 completions × ~1550
+        # tokens per ~20 s update (timing split, BASELINE.md) ≈ 37k tok/s
+        # over 1 GPU doing the update
+        "vs_baseline": round(tps / n_chips / 37000.0, 3),
+        "mfu": round(mfu, 6),
+        "model": name,
+        "backend": jax.devices()[0].platform,
+        "rows": n_rows, "micro": micro, "seq": p_len + t_len,
+        "logprob_chunk": logit_chunk,
+        "step_seconds": round(dt, 3),
+        "compile_plus_first_step_seconds": round(compile_dt, 2),
+        "chips": n_chips,
+        "devices_visible": jax.device_count(),
+        "train_flops_per_token_gflop": round(flops / 1e9, 6),
+        "loss": float(loss),
+    }
+    if fallback_err:
+        record["error"] = f"TPU backend unavailable ({fallback_err}); CPU fallback"
+        record["vs_baseline"] = 0.0
+    _emit(record)
+    return 0
+
+
 def main() -> int:
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
     fallback_err = os.environ.get("BENCH_FALLBACK_ERROR")  # set by the re-exec
@@ -130,6 +230,8 @@ def main() -> int:
 
     name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
     cfg = {"tiny": TINY, "qwen2.5-0.5b": QWEN2_0_5B, "qwen2.5-7b": QWEN2_7B}[name]
+    if os.environ.get("BENCH_MODE") == "learner":
+        return _learner_bench(cfg, name, fallback_err)
     n_prompts = int(os.environ.get("BENCH_PROMPTS", "30"))
     n_cand = int(os.environ.get("BENCH_CANDIDATES", "16"))
     max_prompt = int(os.environ.get("BENCH_MAX_PROMPT", "350"))

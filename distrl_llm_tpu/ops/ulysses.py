@@ -23,41 +23,27 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distrl_llm_tpu.ops.attention import NEG_INF
+from distrl_llm_tpu.ops.attention import attention
 
 
-def _ulysses_local(q, k, v, kv_valid, *, axis_name: str, sp: int, scale: float):
+def _ulysses_local(q, k, v, kv_valid, *, axis_name: str, sp: int, scale: float,
+                   local_impl: str):
     """Per-shard body. q [B, c, H, D], k/v [B, c, K, D], kv_valid [B, c]
     (c = S/sp) → [B, c, H, D]."""
-    b, c, h, d = q.shape
-    kh = k.shape[2]
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
     # seq-sharded → head-sharded: [B, c, H, D] → [B, S, H/sp, D]
     q = a2a(q, split_axis=2, concat_axis=1)
     k = a2a(k, split_axis=2, concat_axis=1)
     v = a2a(v, split_axis=2, concat_axis=1)
     valid = jax.lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)  # [B, S]
-
-    s = c * sp
-    kl = kh // sp
-    g = h // kh  # GQA group size is sharding-invariant (see module doc)
-    qg = q.astype(jnp.float32).reshape(b, s, kl, g, d)
-    logits = jnp.einsum(
-        "bqkgd,bskd->bkgqs", qg, k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, 1), 0)
-    kv_pos = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
-    allowed = (kv_pos <= q_pos)[None, None, None] & valid[
-        :, None, None, None, :
-    ].astype(bool)
-    logits = jnp.where(allowed, logits, NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bkgqd", p, v.astype(jnp.float32))
-    out = out.transpose(0, 3, 1, 2, 4).reshape(b, s, h // sp, d).astype(q.dtype)
+    # the per-device full-sequence attention goes through the dispatching
+    # front door so long-context runs use the O(S)-memory Pallas kernels
+    # (splash: native GQA) — materializing [*, S, S] logits here would defeat
+    # the sequence parallelism exactly at the lengths it exists for; the
+    # reference fallback (CPU tests) builds the dense causal mask itself
+    out = attention(q, k, v, None, scale=scale, impl=local_impl, key_valid=valid)
     # head-sharded → seq-sharded: [B, S, H/sp, D] → [B, c, H, D]
     return a2a(out, split_axis=1, concat_axis=2)
 
@@ -72,12 +58,16 @@ def ulysses_attention(
     scale: float | None = None,
     axis_name: str = "sp",
     batch_axis: str | None = "dp",
+    local_impl: str = "auto",  # per-device attention: auto | splash | flash | reference
 ) -> jax.Array:
     """Causal GQA self-attention, sequence-parallel via head scatter.
 
     Semantics match ``attention_reference(q, k, v,
     causal_padding_mask(key_valid, S))`` up to f32 accumulation order.
     """
+    if local_impl == "auto":
+        # splash (native GQA, O(S) memory) on TPU; the dense reference off it
+        local_impl = "splash" if jax.default_backend() == "tpu" else "reference"
     sp = mesh.shape[axis_name]
     b, s, h, _ = q.shape
     kh = k.shape[2]
@@ -95,7 +85,8 @@ def ulysses_attention(
         b_ax not in mesh.shape or b % mesh.shape[b_ax] != 0
     ):
         b_ax = None
-    body = partial(_ulysses_local, axis_name=axis_name, sp=sp, scale=scale)
+    body = partial(_ulysses_local, axis_name=axis_name, sp=sp, scale=scale,
+                   local_impl=local_impl)
     seq_spec = P(b_ax, axis_name, None, None)
     return jax.shard_map(
         body,
