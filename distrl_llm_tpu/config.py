@@ -141,6 +141,12 @@ class TrainConfig:
     # 0 = dense. At the default learner shapes (8×1200×152k vocab, f32)
     # chunk=128 is ~5.8 GB → ~0.6 GB of logits memory.
     logprob_chunk: int = 128
+    # bf16 full-rank fine-tuning (BASELINE config 3: "bf16 full-rank, no
+    # 4-bit"): the WHOLE param tree trains instead of a LoRA adapter; weight
+    # sync pushes the full tree to the rollout mesh each step. Requires an
+    # unquantized base; LoRA rank/alpha/dropout and the adapter-file writer
+    # do not apply.
+    full_finetune: bool = False
     # prompt length buckets for the rollout engine (SURVEY §2b N1): each
     # round compiles/runs at the smallest bucket holding its longest real
     # prompt. Empty = single bucket at max_prompt_tokens.
@@ -219,6 +225,29 @@ class TrainConfig:
             )
         if self.kv_cache_quant != "none" and self.engine_impl != "paged":
             raise ValueError("kv_cache_quant requires engine_impl='paged'")
+        if self.full_finetune and self.base_quant != "none":
+            raise ValueError(
+                "full_finetune trains the base weights — they cannot be "
+                "quantized (base_quant must be 'none')"
+            )
+        if self.full_finetune and self.write_adapter_file:
+            raise ValueError(
+                "full_finetune has no LoRA adapter to export; use "
+                "export_hf_snapshots for full-model artifacts"
+            )
+        if self.full_finetune and self.lora_dropout:
+            raise ValueError(
+                "full_finetune has no adapter for lora_dropout to act on — "
+                "set lora_dropout=0"
+            )
+        if self.full_finetune and self.rollout_workers:
+            # remote workers hold their own frozen base and receive only the
+            # adapter; with no adapter the trained weights would never reach
+            # them — silently severely-off-policy RL
+            raise ValueError(
+                "full_finetune cannot ship full weights to rollout_workers "
+                "(workers receive adapters only); run local rollout"
+            )
         if self.continuous_batching and (
             self.engine_impl != "paged" or not self.max_concurrent_sequences
         ):

@@ -50,16 +50,29 @@ def _microbatch_loss(
     lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
     attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
-    dropout_rng=None, logit_chunk: int = 0,
+    dropout_rng=None, logit_chunk: int = 0, train_mode: str = "lora",
 ):
-    """Loss for one microbatch with the zero-reward skip folded in as a weight."""
-    logps = answer_logprobs(
-        base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
-        mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
-        attn_impl=attn_impl, attn_mesh=attn_mesh,
-        lora_dropout=lora_dropout, dropout_rng=dropout_rng,
-        logit_chunk=logit_chunk,
-    )
+    """Loss for one microbatch with the zero-reward skip folded in as a weight.
+
+    ``train_mode="lora"``: ``lora`` is the trainable adapter over the frozen
+    ``base_params``. ``train_mode="full"``: ``lora`` IS the full trainable
+    param tree (bf16 full-rank — BASELINE config 3's no-LoRA mode) and
+    ``base_params`` is ignored."""
+    if train_mode == "full":
+        logps = answer_logprobs(
+            lora, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
+            mb.answer_mask, lora=None, remat=remat,
+            attn_impl=attn_impl, attn_mesh=attn_mesh,
+            logit_chunk=logit_chunk,
+        )
+    else:
+        logps = answer_logprobs(
+            base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
+            mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
+            attn_impl=attn_impl, attn_mesh=attn_mesh,
+            lora_dropout=lora_dropout, dropout_rng=dropout_rng,
+            logit_chunk=logit_chunk,
+        )
     loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
     loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
 
@@ -92,6 +105,7 @@ def make_train_step(
     donate: bool = True,
     lora_dropout: float = 0.0,
     logit_chunk: int = 0,  # chunked fused-CE logprobs (losses.answer_logprobs)
+    train_mode: str = "lora",  # "lora" | "full" (arg0 is the whole param tree)
 ) -> Callable:
     """Build the jitted train step.
 
@@ -112,6 +126,7 @@ def make_train_step(
         attn_mesh=attn_mesh,
         lora_dropout=lora_dropout,
         logit_chunk=logit_chunk,
+        train_mode=train_mode,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,

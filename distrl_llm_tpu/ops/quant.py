@@ -38,6 +38,14 @@ def is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w and "scale" in w
 
 
+def is_quantized_tree(params) -> bool:
+    """True if any layer projection in the param tree is a quantized
+    container (full fine-tuning must refuse these — int payloads have no
+    gradients)."""
+    layers = params.get("layers", {}) if isinstance(params, dict) else {}
+    return any(is_quantized(w) for w in layers.values())
+
+
 def quantize(w: jax.Array, bits: int = 8, group_size: int | None = None) -> Params:
     """Quantize [..., in, out] → {"q": [..., G, g, out], "scale": [..., G, 1, out]}.
 

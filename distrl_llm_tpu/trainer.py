@@ -133,10 +133,22 @@ class Trainer:
         self.scale = lora_scale(config.max_lora_rank, config.lora_alpha)
         self._rng = jax.random.PRNGKey(config.seed)
         self._rng, lora_key = jax.random.split(self._rng)
-        self.lora = init_lora_params(
-            lora_key, model_cfg, config.max_lora_rank,
-            dtype=jnp.float32,  # adapters train in f32; base stays bf16
-        )
+        if config.full_finetune:
+            # BASELINE config 3 (bf16 full-rank, no 4-bit): the WHOLE param
+            # tree is the trainable state; there is no adapter. self.lora
+            # holds whichever tree trains — the engine call sites and weight
+            # push branch on _full below.
+            from distrl_llm_tpu.ops.quant import is_quantized_tree
+
+            if is_quantized_tree(self.base_params_learner):
+                raise ValueError("full_finetune requires an unquantized base")
+            self.lora = self.base_params_learner
+        else:
+            self.lora = init_lora_params(
+                lora_key, model_cfg, config.max_lora_rank,
+                dtype=jnp.float32,  # adapters train in f32; base stays bf16
+            )
+        self._full = config.full_finetune
         self.optimizer = make_optimizer(config.lr, use_8bit=config.optimizer_8bit)
         self.opt_state = self.optimizer.init(self.lora)
         if meshes is not None:
@@ -144,6 +156,8 @@ class Trainer:
             # explicit shardings (FSDP sharding of learner state, SURVEY §2c)
             from distrl_llm_tpu.parallel.partition import shard_opt_state, shard_tree
 
+            # shard_tree derives the right specs for either tree shape
+            # (param_specs handles LoRA and full param trees alike)
             self.lora = shard_tree(self.lora, meshes.learner)
             self.opt_state = shard_opt_state(self.opt_state, meshes.learner)
         self.train_step = make_train_step(
@@ -161,6 +175,7 @@ class Trainer:
             ) else None,
             lora_dropout=config.lora_dropout,
             logit_chunk=config.logprob_chunk,
+            train_mode="full" if self._full else "lora",
         )
 
         self.total_batch_steps = 0
@@ -276,14 +291,22 @@ class Trainer:
                 eos_token_ids=eos,
             )
         else:
-            params_rollout = shard_tree(params, meshes.rollout, specs)
-            # non-timeshared roles each hold the frozen base (the reference
-            # loads the model once per worker, distributed_actor.py:58);
-            # timeshared roles alias one copy
-            params_learner = (
-                params_rollout if meshes.timeshared
-                else shard_tree(params, meshes.learner, specs)
-            )
+            if config.full_finetune and not meshes.timeshared:
+                # full mode never reads a frozen base on the rollout mesh —
+                # _push_weights places the TRAINED tree there each step, so a
+                # resident base copy would just double rollout-mesh HBM in
+                # exactly the memory-tight config
+                params_learner = shard_tree(params, meshes.learner, specs)
+                params_rollout = None
+            else:
+                params_rollout = shard_tree(params, meshes.rollout, specs)
+                # non-timeshared roles each hold the frozen base (the
+                # reference loads the model once per worker,
+                # distributed_actor.py:58); timeshared roles alias one copy
+                params_learner = (
+                    params_rollout if meshes.timeshared
+                    else shard_tree(params, meshes.learner, specs)
+                )
             engine_cls = (
                 PagedGenerationEngine if config.engine_impl == "paged"
                 else GenerationEngine
@@ -377,11 +400,17 @@ class Trainer:
             self.config.run_directory, f"model_{self.total_batch_steps}"
         )
         try:
-            save_hf_checkpoint(
-                self.base_params_learner, self.model_cfg, path,
-                lora=self.lora, lora_alpha=self.config.lora_alpha,
-                model_type=self.model_cfg.model_type,
-            )
+            if self._full:
+                save_hf_checkpoint(
+                    self.lora, self.model_cfg, path,
+                    model_type=self.model_cfg.model_type,
+                )
+            else:
+                save_hf_checkpoint(
+                    self.base_params_learner, self.model_cfg, path,
+                    lora=self.lora, lora_alpha=self.config.lora_alpha,
+                    model_type=self.model_cfg.model_type,
+                )
             self._last_hf_export_step = self.total_batch_steps
         except (NotImplementedError, RuntimeError) as e:  # quantized base /
             # non-addressable shards: skip rather than kill the run
@@ -390,6 +419,8 @@ class Trainer:
     def save_adapter(self) -> None:
         """The reference's per-step adapter artifact (distributed_trainer.py:346
         → save_lora). Export-only here — weight sync is in-memory."""
+        if self._full:
+            raise RuntimeError("full_finetune has no LoRA adapter to export")
         save_adapter_file(
             self.lora, self.config.lora_save_path,
             rank=self.config.max_lora_rank, alpha=self.config.lora_alpha,
@@ -456,7 +487,7 @@ class Trainer:
                 hybrid = False  # learner share would be padding-only
         if not hybrid:
             return self._call_engine(
-                self.base_params, self._lora_rollout,
+                *self._engine_params("rollout"),
                 prompt_ids, prompt_mask, sampling, self._next_rng(),
                 role="rollout",
             )
@@ -467,14 +498,14 @@ class Trainer:
         pool = ThreadPoolExecutor(max_workers=2)
         try:
             fut_a = pool.submit(
-                self._call_engine, self.base_params, self._lora_rollout,
+                self._call_engine, *self._engine_params("rollout"),
                 prompt_ids[:actor_rows], prompt_mask[:actor_rows], sampling, key_a,
                 role="rollout",
             )
             # the learner share samples with the learner-resident adapter —
             # definitionally the current version
             fut_l = pool.submit(
-                self._call_engine, self.base_params_learner, self.lora,
+                self._call_engine, *self._engine_params("learner"),
                 prompt_ids[actor_rows:], prompt_mask[actor_rows:], sampling, key_l,
                 role="learner",
             )
@@ -488,6 +519,20 @@ class Trainer:
         return GenerationResult(
             tokens=np.concatenate([res_a.tokens, res_l.tokens], axis=0),
             lengths=np.concatenate([res_a.lengths, res_l.lengths], axis=0),
+        )
+
+    def _engine_params(self, role: str) -> tuple:
+        """(params, lora) for an engine call. LoRA mode: frozen base + the
+        role's adapter copy. Full-finetune mode: the trained tree IS the
+        model — rollout uses the pushed copy, the learner its resident one."""
+        if self._full:
+            return (
+                (self._lora_rollout, None) if role == "rollout"
+                else (self.lora, None)
+            )
+        return (
+            (self.base_params, self._lora_rollout) if role == "rollout"
+            else (self.base_params_learner, self.lora)
         )
 
     def _call_engine(self, *args, role: str = "rollout"):
@@ -694,7 +739,8 @@ class Trainer:
                 mesh=self.meshes.learner if self.meshes is not None else None,
             )
             self.lora, self.opt_state, loss = self.train_step(
-                self.lora, self.opt_state, self.base_params_learner, update,
+                self.lora, self.opt_state,
+                None if self._full else self.base_params_learner, update,
                 # adapter-input dropout (helper.py:40) needs a fresh key per
                 # update; disabled (None) when the rate is 0
                 self._next_rng() if cfg.lora_dropout > 0.0 else None,

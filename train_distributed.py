@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "int8"],
                    help="paged-engine KV cache quantization (int8 halves "
                         "cache memory + decode bandwidth)")
+    p.add_argument("--full_finetune", action="store_true",
+                   help="bf16 full-rank fine-tuning (no LoRA): the whole "
+                        "param tree trains; requires --base_quant none")
     p.add_argument("--logprob_chunk", type=int, default=128,
                    help="learner fused-CE chunk: lm_head+logsumexp per this "
                         "many answer positions (live logits [B,chunk,V] "
