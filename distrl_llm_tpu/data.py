@@ -83,6 +83,52 @@ def prepare_math500(dataset_name: str, tokenizer, test_size: float = 0.1, seed: 
     return train, test
 
 
+def extract_gsm8k_solution(answer: str) -> str:
+    """GSM8K gold answers end with ``#### <number>`` — the graded solution is
+    that number with thousands separators stripped (the community-standard
+    extraction; the reward's exact-match contract then works unchanged)."""
+    tail = answer.rsplit("####", 1)[-1] if "####" in answer else answer
+    return tail.strip().replace(",", "").replace("$", "")
+
+
+def prepare_gsm8k(dataset_name: str, tokenizer, test_size: float = 0.1,
+                  seed: int | None = None):
+    """Load + template GSM8K (BASELINE config 3's dataset). Unlike MATH-500
+    (a single 'test' split the reference carves 90/10,
+    train_distributed.py:44), GSM8K ships dedicated splits — training on its
+    official 1,319-row test set would contaminate every published-accuracy
+    comparison, so RL trains on the 7,473-row TRAIN split and evaluates on
+    the untouched test split (``test_size`` is unused here; kept for the
+    dispatcher's uniform signature)."""
+    from datasets import load_dataset  # deferred: heavy import
+
+    raw = load_dataset(dataset_name, "main")
+
+    def remap(ds):
+        ds = ds.map(
+            lambda x: {
+                "problem": x["question"],
+                "solution": extract_gsm8k_solution(x["answer"]),
+            }
+        )
+        return ds.remove_columns(
+            [c for c in ("question", "answer") if c in ds.column_names]
+        )
+
+    train = process_dataset(tokenizer, remap(raw["train"]), R1_PREPROMPT, "")
+    test = process_dataset(tokenizer, remap(raw["test"]), R1_PREPROMPT, "")
+    return train, test
+
+
+def prepare_dataset(dataset_name: str, tokenizer, test_size: float = 0.1,
+                    seed: int | None = None):
+    """Dispatch on the dataset id: GSM8K-style (question/#### answer) or
+    MATH-500-style (problem/answer) preparation."""
+    if "gsm8k" in dataset_name.lower():
+        return prepare_gsm8k(dataset_name, tokenizer, test_size, seed)
+    return prepare_math500(dataset_name, tokenizer, test_size, seed)
+
+
 class DictDataset:
     """Minimal dict-of-lists dataset with the iteration surface the Trainer
     uses (``shuffle()`` / ``iter(batch_size)`` — distributed_trainer.py:245–246).

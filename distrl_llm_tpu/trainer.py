@@ -137,12 +137,26 @@ class Trainer:
             # BASELINE config 3 (bf16 full-rank, no 4-bit): the WHOLE param
             # tree is the trainable state; there is no adapter. self.lora
             # holds whichever tree trains — the engine call sites and weight
-            # push branch on _full below.
+            # push branch on _full below. The trainable copy is kept in f32
+            # (master weights): with lr=2e-5 a typical update is below bf16's
+            # ~0.4% relative resolution, so bf16 apply_updates would round
+            # many steps to no-ops; _push_weights casts back down for rollout.
             from distrl_llm_tpu.ops.quant import is_quantized_tree
 
             if is_quantized_tree(self.base_params_learner):
                 raise ValueError("full_finetune requires an unquantized base")
-            self.lora = self.base_params_learner
+            self._rollout_dtype = jax.tree_util.tree_leaves(
+                self.base_params_learner
+            )[0].dtype  # rollout samples at the base's dtype (bf16 on TPU)
+            self.lora = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), self.base_params_learner
+            )
+            # the donating train step deletes self.lora's buffers each step;
+            # keeping base_params* pointing anywhere would leave stale
+            # references whose reads fail far from the cause — full mode has
+            # no frozen base, make that explicit
+            self.base_params = None
+            self.base_params_learner = None
         else:
             self.lora = init_lora_params(
                 lora_key, model_cfg, config.max_lora_rank,
@@ -435,16 +449,22 @@ class Trainer:
         bus (save_lora distributed_actor.py:85 / load_lora :150). Records the
         version now resident on the rollout mesh; ``_generate_round`` asserts
         it before sampling."""
+        pushed = self.lora
+        if self._full:
+            # master weights train in f32; rollout samples at the base dtype
+            pushed = jax.tree_util.tree_map(
+                lambda x: x.astype(self._rollout_dtype), pushed
+            )
         if getattr(self.engine, "is_remote", False):
             # remote rollout: the adapter ships over the wire with each
             # round — no local rollout-mesh copy to refresh
-            self._lora_rollout = self.lora
+            self._lora_rollout = pushed
         elif self.meshes is not None and not self.meshes.timeshared:
             from distrl_llm_tpu.parallel.partition import shard_tree
 
-            self._lora_rollout = shard_tree(self.lora, self.meshes.rollout)
+            self._lora_rollout = shard_tree(pushed, self.meshes.rollout)
         else:
-            self._lora_rollout = self.lora
+            self._lora_rollout = pushed
         self._rollout_weight_version = self.weight_version
 
     # ---------------------------------------------------------------- rollout

@@ -66,3 +66,44 @@ class TestDictDataset:
         ds = DictDataset({"a": [1]})
         assert DictDataset.wrap(ds) is ds
         assert isinstance(DictDataset.wrap({"a": [1]}), DictDataset)
+
+
+class TestGsm8k:
+    """GSM8K prep (BASELINE config 3's dataset): '#### N' gold-answer
+    extraction feeding the same exact-match reward contract."""
+
+    @pytest.mark.parametrize("raw,want", [
+        ("Natalia sold clips.\n#### 72", "72"),
+        ("Step one.\nStep two.\n#### 1,234", "1234"),
+        ("#### $18", "18"),
+        ("   #### -5   ", "-5"),
+        ("no marker at all", "no marker at all"),
+    ])
+    def test_extract_solution(self, raw, want):
+        from distrl_llm_tpu.data import extract_gsm8k_solution
+
+        assert extract_gsm8k_solution(raw) == want
+
+    def test_reward_contract_on_extracted_solution(self):
+        from distrl_llm_tpu.data import extract_gsm8k_solution
+        from distrl_llm_tpu.rewards import reward_function
+
+        sol = extract_gsm8k_solution("reasoning...\n#### 42")
+        r = reward_function(["<answer>42</answer>", "<answer>41</answer>"], [sol, sol])
+        assert r[0, 1] == 1.0 and r[1, 1] == 0.0
+
+    def test_prepare_dataset_dispatch(self, monkeypatch):
+        """Dispatch by dataset id: gsm8k ids route to the GSM8K loader,
+        everything else to the MATH-500 loader (hub access stubbed out)."""
+        import distrl_llm_tpu.data as data
+
+        calls = []
+        monkeypatch.setattr(
+            data, "prepare_gsm8k", lambda *a, **k: calls.append("gsm8k")
+        )
+        monkeypatch.setattr(
+            data, "prepare_math500", lambda *a, **k: calls.append("math500")
+        )
+        data.prepare_dataset("openai/gsm8k", None)
+        data.prepare_dataset("HuggingFaceH4/MATH-500", None)
+        assert calls == ["gsm8k", "math500"]

@@ -141,3 +141,39 @@ class TestFullFinetuneTrainer:
             trainer.save_adapter()
         recs = [m for _, m in sink.records if "loss" in m]
         assert recs and np.isfinite(recs[-1]["loss"])
+
+    def test_bf16_base_trains_in_f32_master_weights(self):
+        """Review regression: with a bf16 base, per-step updates (~lr) sit
+        below bf16's ~0.4% relative resolution — the trainable copy must be
+        f32, and the pushed rollout tree must come back down to bf16."""
+        from distrl_llm_tpu.engine import GenerationEngine
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        config = make_config(full_finetune=True)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=config.max_prompt_tokens,
+            max_new_tokens=config.max_new_tokens,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+        )
+        trainer = Trainer(
+            train, test, reward_function, config,
+            tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+            sink=MemorySink(),
+        )
+        assert all(
+            leaf.dtype == jnp.float32
+            for leaf in jax.tree_util.tree_leaves(trainer.lora)
+        )
+        assert trainer.base_params is None and trainer.base_params_learner is None
+        trainer._push_weights()
+        assert all(
+            leaf.dtype == jnp.bfloat16
+            for leaf in jax.tree_util.tree_leaves(trainer._lora_rollout)
+        )

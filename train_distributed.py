@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 
 from distrl_llm_tpu.config import MeshConfig, TrainConfig
-from distrl_llm_tpu.data import prepare_math500
+from distrl_llm_tpu.data import prepare_dataset
 from distrl_llm_tpu.rewards import reward_function
 from distrl_llm_tpu.tokenizer import load_tokenizer
 from distrl_llm_tpu.trainer import Trainer
@@ -225,7 +225,7 @@ def main(argv: list[str] | None = None) -> None:
         return
 
     tokenizer = load_tokenizer(args.checkpoint_path or config.model)
-    train_ds, test_ds = prepare_math500(
+    train_ds, test_ds = prepare_dataset(
         config.dataset, tokenizer, test_size=0.1, seed=config.seed
     )
     trainer = Trainer.from_pretrained(
