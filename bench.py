@@ -259,6 +259,9 @@ def main() -> int:
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["kv_quant"] = os.environ.get("BENCH_KV_QUANT", "none")
         engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")
+        if os.environ.get("BENCH_SPEC_DRAFT"):
+            # n-gram speculative decoding (needs the refill scheduler + cap)
+            engine_kwargs["spec_draft"] = int(os.environ["BENCH_SPEC_DRAFT"])
     if os.environ.get("BENCH_MAX_CONCURRENT"):
         engine_kwargs["max_concurrent_rows"] = int(os.environ["BENCH_MAX_CONCURRENT"])
     # BENCH_EOS_RATE: approximate per-step stop probability. Random-init
@@ -319,15 +322,21 @@ def main() -> int:
         engaged = (
             engine.scheduler == "refill"
             and engine.max_concurrent_rows
-            and n_prompts * n_cand > engine.max_concurrent_rows
+            and (
+                n_prompts * n_cand > engine.max_concurrent_rows
+                or engine.spec_draft
+            )
         )
         scheduler_ran = "refill" if engaged else "waves"
+        spec_ran = engine.spec_draft if engaged else 0
     else:
         scheduler_ran = None  # dense engine has no batching scheduler
+        spec_ran = 0
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
         "scheduler": scheduler_ran,
+        "spec_draft": spec_ran,
         "eos_rate": eos_rate,
         "mean_gen_tokens": round(mean_new, 1),
         "bucket_used": engine.bucket_for(pmask),

@@ -176,6 +176,13 @@ class TrainConfig:
     # instead of draining whole waves. Requires engine_impl="paged" and a
     # max_concurrent_sequences cap.
     continuous_batching: bool = False
+    # n-gram speculative decoding (prompt lookup) for the paged refill
+    # engine: draft spec_draft tokens from the sequence's own history and
+    # verify them in one forward; rejection sampling keeps the output
+    # distribution identical to plain decoding (exact under greedy).
+    # Requires continuous_batching. 0 = off.
+    spec_draft: int = 0
+    spec_ngram: int = 2
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True
@@ -254,6 +261,11 @@ class TrainConfig:
             raise ValueError(
                 "continuous_batching requires engine_impl='paged' and a "
                 "max_concurrent_sequences cap (the decode slot count)"
+            )
+        if self.spec_draft and not self.continuous_batching:
+            raise ValueError(
+                "spec_draft (speculative decoding) requires "
+                "continuous_batching (the refill scheduler hosts it)"
             )
         if self.rollout_workers and (
             self.kv_cache_quant != "none" or self.engine_impl != "dense"

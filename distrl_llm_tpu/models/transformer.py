@@ -102,6 +102,7 @@ def _layer(
     page_indices: jax.Array | None = None,  # [B, pps]
     page_size: int = 0,
     paged_impl: str = "auto",
+    paged_verify: bool = False,  # S>1 per-row draft-block decode (spec decode)
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,  # per-layer key (training only)
 ):
@@ -120,6 +121,7 @@ def _layer(
         # attention reads each row's true [0, length) prefix only.
         from distrl_llm_tpu.ops.paged import (
             paged_attention_op, write_prompt_to_pages, write_token_to_pages,
+            write_tokens_to_pages,
         )
 
         if s == 1:
@@ -131,6 +133,27 @@ def _layer(
                 q[:, 0], cache_k, cache_v, paged_lengths + 1, page_indices,
                 impl=paged_impl,
             )[:, None]
+        elif paged_verify:
+            # speculative-decode verify: S draft tokens extend each row's
+            # sequence at its own per-row offset. QKV/MLP batch over the
+            # whole block (the weight-bandwidth amortization speculative
+            # decoding buys); attention unrolls per draft position — draft
+            # position i attends over the prefix plus draft tokens ≤ i
+            # (lengths + i + 1), which is exact causality
+            cache_k = write_tokens_to_pages(
+                cache_k, k, paged_lengths, page_indices, page_size)
+            cache_v = write_tokens_to_pages(
+                cache_v, v, paged_lengths, page_indices, page_size)
+            att = jnp.stack(
+                [
+                    paged_attention_op(
+                        q[:, i], cache_k, cache_v, paged_lengths + i + 1,
+                        page_indices, impl=paged_impl,
+                    )
+                    for i in range(s)
+                ],
+                axis=1,
+            )
         else:
             # packed prefill: write the prompt pages, attend over the input
             cache_k = write_prompt_to_pages(cache_k, k, page_indices, page_size)
@@ -197,6 +220,7 @@ def forward(
     logits_positions: jax.Array | None = None,  # [B] per-row position gather
     page_size: int = 0,  # static; paged-cache mode (ops/paged.py)
     paged_impl: str = "auto",
+    paged_verify: bool = False,  # speculative-decode draft-block verify
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
     skip_lm_head: bool = False,  # return final-norm hidden states, not logits
@@ -273,6 +297,7 @@ def forward(
         page_indices=kv_cache.get("page_indices") if paged else None,
         page_size=page_size,
         paged_impl=paged_impl,
+        paged_verify=paged_verify,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
     )
 

@@ -168,6 +168,24 @@ def write_token_to_pages(
     return pages.at[:, page, slot].set(tok.astype(pages.dtype))
 
 
+def write_tokens_to_pages(
+    pages,  # [K, total_pages, ps, hd] array, or QuantizedTensor
+    new_kv: jax.Array,  # [B, D, K, hd] — D tokens per row
+    lengths: jax.Array,  # [B] current token counts (first write position)
+    page_indices: jax.Array,  # [B, pps]
+    page_size: int,
+):
+    """Scatter D consecutive tokens' KV per row (speculative-decode verify
+    writes the whole draft block at once; D is small and static, so the loop
+    unrolls inside the jitted step)."""
+    d = new_kv.shape[1]
+    for i in range(d):
+        pages = write_token_to_pages(
+            pages, new_kv[:, i], lengths + i, page_indices, page_size
+        )
+    return pages
+
+
 def paged_attention_reference(
     q: jax.Array,  # [B, H, hd] — single decode query per row
     k_pages,  # [K, total_pages, ps, hd] array, or QuantizedTensor

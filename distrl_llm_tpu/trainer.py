@@ -330,6 +330,9 @@ class Trainer:
                 engine_kwargs["kv_quant"] = config.kv_cache_quant
                 if config.continuous_batching:
                     engine_kwargs["scheduler"] = "refill"
+                    if config.spec_draft:
+                        engine_kwargs["spec_draft"] = config.spec_draft
+                        engine_kwargs["spec_ngram"] = config.spec_ngram
             if config.max_concurrent_sequences:
                 engine_kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
             engine = engine_cls(

@@ -35,6 +35,9 @@ for i in $(seq 1 200); do
     BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill \
       timeout 900 python bench.py > /tmp/bench_tpu_refill_eos.json 2>/tmp/bench_tpu_refill_eos.err
     echo "refill+eos rc=$?: $(tail -c 300 /tmp/bench_tpu_refill_eos.json)"
+    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 \
+      timeout 900 python bench.py > /tmp/bench_tpu_spec.json 2>/tmp/bench_tpu_spec.err
+    echo "spec rc=$?: $(tail -c 300 /tmp/bench_tpu_spec.json)"
     BENCH_NO_FALLBACK=1 BENCH_MODE=learner timeout 900 python bench.py > /tmp/bench_tpu_learner.json 2>/tmp/bench_tpu_learner.err
     echo "learner rc=$?: $(tail -c 300 /tmp/bench_tpu_learner.json)"
     timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1

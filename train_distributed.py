@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequences rows decoding, admit a pending candidate "
                         "whenever a slot's occupant hits EOS (vLLM continuous "
                         "batching) instead of draining whole waves")
+    p.add_argument("--spec_draft", type=int, default=0,
+                   help="n-gram speculative decoding: draft this many tokens "
+                        "per step from the sequence's own history (prompt "
+                        "lookup) and verify in one forward; distribution-"
+                        "identical to plain decoding. Requires "
+                        "--continuous_batching. 0 = off")
+    p.add_argument("--spec_ngram", type=int, default=2,
+                   help="lookup n-gram size for --spec_draft")
     p.add_argument("--rollout_workers", type=str, default="",
                    help="comma-separated control-plane workers "
                         "(host:port,...) to dispatch generation to; start "
