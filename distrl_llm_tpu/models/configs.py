@@ -194,6 +194,11 @@ def preset_for_model_name(name: str) -> ModelConfig | None:
     low = name.lower()
     if low == "tiny":  # exact only — "tiny" substrings occur in real model ids
         return TINY
+    if "r1-distill-qwen" in low and "7b" in low:
+        # BASELINE config 4's model: Qwen2 architecture distilled from R1 —
+        # DeepSeek-R1-Distill-Qwen-7B shares Qwen2.5-7B's exact dims (other
+        # distill sizes fall through to config.json-driven loading)
+        return QWEN2_7B
     for key, cfg in PRESETS.items():
         # tiny: exact-match only; mistral-7b: guarded below (the v0.1 preset
         # must not claim v0.2/v0.3 checkpoints, which drop the window)

@@ -405,3 +405,15 @@ class TestFamilyReviewRegressions:
         assert preset_for_model_name("mistralai/Mixtral-8x7B-Instruct-v0.1") is None
         assert preset_for_model_name("mistralai/Mistral-7B-Instruct-v0.2") is None
         assert preset_for_model_name("mistralai/Mistral-7B-Instruct-v0.3") is None
+
+
+class TestR1DistillPreset:
+    def test_r1_distill_qwen_7b_maps_to_qwen2_preset(self):
+        """BASELINE config 4's model shares Qwen2.5-7B's exact dims; other
+        distill sizes must fall through to config.json-driven loading."""
+        from distrl_llm_tpu.models.configs import QWEN2_7B, preset_for_model_name
+
+        assert preset_for_model_name(
+            "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B") is QWEN2_7B
+        assert preset_for_model_name(
+            "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B") is None
