@@ -332,11 +332,23 @@ def main() -> int:
     else:
         scheduler_ran = None  # dense engine has no batching scheduler
         spec_ran = 0
+    # realized speculation: mean tokens emitted per slot per dispatched step
+    # (1.0 = plain decode; > 1 = drafts being accepted)
+    accept_rate = None
+    if result.steps_dispatched:
+        slots = min(
+            engine.max_concurrent_rows or n_prompts * n_cand,
+            n_prompts * n_cand,
+        )
+        accept_rate = round(
+            total_tokens / (result.steps_dispatched * slots), 3
+        )
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
         "scheduler": scheduler_ran,
         "spec_draft": spec_ran,
+        "tokens_per_slot_step": accept_rate,
         "eos_rate": eos_rate,
         "mean_gen_tokens": round(mean_new, 1),
         "bucket_used": engine.bucket_for(pmask),

@@ -47,6 +47,11 @@ Params = dict[str, Any]
 class GenerationResult(NamedTuple):
     tokens: np.ndarray  # [B, n, T] int32, pad-filled after EOS
     lengths: np.ndarray  # [B, n] generated token counts (incl. EOS)
+    # decode step programs dispatched for this round (None where the engine
+    # doesn't count them). With speculative decoding, tokens/steps/slots > 1
+    # measures the realized draft acceptance — the number to tune spec_draft
+    # against on real hardware.
+    steps_dispatched: int | None = None
 
 
 class _DecodeState(NamedTuple):

@@ -194,11 +194,13 @@ def preset_for_model_name(name: str) -> ModelConfig | None:
     low = name.lower()
     if low == "tiny":  # exact only — "tiny" substrings occur in real model ids
         return TINY
-    if "r1-distill-qwen" in low and "7b" in low:
-        # BASELINE config 4's model: Qwen2 architecture distilled from R1 —
-        # DeepSeek-R1-Distill-Qwen-7B shares Qwen2.5-7B's exact dims (other
-        # distill sizes fall through to config.json-driven loading)
-        return QWEN2_7B
+    if "r1-distill" in low:
+        # BASELINE config 4's model family: tensor dims match the Qwen2/Llama
+        # presets but NOT the RoPE config (R1-Distill-Qwen-7B derives from
+        # Qwen2.5-MATH-7B: rope_theta 1e4 vs the preset's 1e6, 131k context).
+        # A preset would silently rotate positions at the wrong frequencies —
+        # force config.json-driven loading instead.
+        return None
     for key, cfg in PRESETS.items():
         # tiny: exact-match only; mistral-7b: guarded below (the v0.1 preset
         # must not claim v0.2/v0.3 checkpoints, which drop the window)

@@ -408,12 +408,16 @@ class TestFamilyReviewRegressions:
 
 
 class TestR1DistillPreset:
-    def test_r1_distill_qwen_7b_maps_to_qwen2_preset(self):
-        """BASELINE config 4's model shares Qwen2.5-7B's exact dims; other
-        distill sizes must fall through to config.json-driven loading."""
-        from distrl_llm_tpu.models.configs import QWEN2_7B, preset_for_model_name
+    def test_r1_distill_models_refuse_presets(self):
+        """BASELINE config 4's models match preset tensor dims but NOT RoPE
+        (R1-Distill-Qwen-7B derives from Qwen2.5-Math-7B: rope_theta 1e4 vs
+        the preset's 1e6) — a preset would silently produce garbage logits,
+        so every distill id must force config.json-driven loading (review)."""
+        from distrl_llm_tpu.models.configs import preset_for_model_name
 
         assert preset_for_model_name(
-            "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B") is QWEN2_7B
+            "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B") is None
         assert preset_for_model_name(
             "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B") is None
+        assert preset_for_model_name(
+            "deepseek-ai/DeepSeek-R1-Distill-Llama-8B") is None
