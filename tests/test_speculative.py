@@ -233,3 +233,51 @@ class TestSpecEdgeCases:
         plain = make_engine(slots=64).generate(
             params, None, ids, mask, cfg, jax.random.PRNGKey(0))
         np.testing.assert_array_equal(res.tokens, plain.tokens)
+
+
+class TestSpecTrainerIntegration:
+    def test_trainer_round_on_speculative_engine(self):
+        """A full trainer batch with the speculative refill engine as the
+        rollout backend — config-flag wiring (--continuous_batching
+        --spec_draft) through Trainer to the engine."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        cfg = make_config(
+            max_prompt_tokens=16, max_new_tokens=8,
+            engine_impl="paged", continuous_batching=True,
+            max_concurrent_sequences=6, spec_draft=3,
+        )
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, page_size=8,
+            scheduler="refill", max_concurrent_rows=6, spec_draft=3,
+        )
+        sink = MemorySink()
+        trainer = Trainer(
+            train, test, reward_function, cfg,
+            tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+            sink=sink,
+        )
+        batch = {"problem": train["problem"][:4], "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+
+    def test_from_config_kwargs(self):
+        """Trainer.from_pretrained's engine kwargs mapping includes the spec
+        knobs when continuous batching is on."""
+        from distrl_llm_tpu.config import TrainConfig
+
+        cfg = TrainConfig(
+            engine_impl="paged", continuous_batching=True,
+            max_concurrent_sequences=64, spec_draft=4, spec_ngram=3,
+        )
+        assert cfg.spec_draft == 4 and cfg.spec_ngram == 3
