@@ -183,6 +183,14 @@ class TrainConfig:
     # Requires continuous_batching. 0 = off.
     spec_draft: int = 0
     spec_ngram: int = 2
+    # one-step-off-policy pipelined rollout (LlamaRL/PipelineRL-style async
+    # actor-learner overlap): batch t+1 generates on the rollout mesh WHILE
+    # the learner updates on batch t, so neither mesh idles. Rollouts sample
+    # with weights exactly one optimizer step stale (the staleness detector
+    # allows lag <= 1 instead of 0); single-update GRPO/PG tolerate this by
+    # construction (the loss's ratio is computed under the current policy).
+    # Off (default) = the reference's strictly synchronous loop.
+    async_rollout: bool = False
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True

@@ -51,6 +51,23 @@ log = logging.getLogger(__name__)
 RewardFn = Callable[[Sequence[str], Sequence[str]], np.ndarray]
 
 
+def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
+    """Engine-constructor kwargs derived from the config (paged-engine knobs:
+    KV quant, continuous batching, speculative decoding, row cap). Module
+    level so the config→engine wiring is unit-testable without a checkpoint."""
+    kwargs: dict[str, Any] = {}
+    if config.engine_impl == "paged":
+        kwargs["kv_quant"] = config.kv_cache_quant
+        if config.continuous_batching:
+            kwargs["scheduler"] = "refill"
+            if config.spec_draft:
+                kwargs["spec_draft"] = config.spec_draft
+                kwargs["spec_ngram"] = config.spec_ngram
+    if config.max_concurrent_sequences:
+        kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
+    return kwargs
+
+
 class StaleWeightsError(RuntimeError):
     """The rollout mesh holds an adapter older than the learner's — the race
     the reference structurally prevents with its synchronous barrier and we
@@ -131,7 +148,10 @@ class Trainer:
         )
 
         self.scale = lora_scale(config.max_lora_rank, config.lora_alpha)
+        import threading as _threading
+
         self._rng = jax.random.PRNGKey(config.seed)
+        self._rng_mu = _threading.Lock()
         self._rng, lora_key = jax.random.split(self._rng)
         if config.full_finetune:
             # BASELINE config 3 (bf16 full-rank, no 4-bit): the WHOLE param
@@ -325,16 +345,7 @@ class Trainer:
                 PagedGenerationEngine if config.engine_impl == "paged"
                 else GenerationEngine
             )
-            engine_kwargs = {}
-            if config.engine_impl == "paged":
-                engine_kwargs["kv_quant"] = config.kv_cache_quant
-                if config.continuous_batching:
-                    engine_kwargs["scheduler"] = "refill"
-                    if config.spec_draft:
-                        engine_kwargs["spec_draft"] = config.spec_draft
-                        engine_kwargs["spec_ngram"] = config.spec_ngram
-            if config.max_concurrent_sequences:
-                engine_kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
+            engine_kwargs = engine_kwargs_from_config(config)
             engine = engine_cls(
                 model_cfg,
                 max_prompt_tokens=config.max_prompt_tokens,
@@ -458,6 +469,12 @@ class Trainer:
             pushed = jax.tree_util.tree_map(
                 lambda x: x.astype(self._rollout_dtype), pushed
             )
+        if self.config.async_rollout:
+            # the train step DONATES self.lora's buffers; in the overlap
+            # window the next batch's generation still reads the pushed tree,
+            # so it must own its buffers (same-device/same-dtype paths would
+            # otherwise alias the donated arrays → "buffer deleted" crashes)
+            pushed = jax.tree_util.tree_map(jnp.copy, pushed)
         if getattr(self.engine, "is_remote", False):
             # remote rollout: the adapter ships over the wire with each
             # round — no local rollout-mesh copy to refresh
@@ -473,8 +490,11 @@ class Trainer:
     # ---------------------------------------------------------------- rollout
 
     def _next_rng(self) -> jax.Array:
-        self._rng, key = jax.random.split(self._rng)
-        return key
+        # async_rollout draws keys from the generation thread while the main
+        # thread draws dropout keys — serialize the split
+        with self._rng_mu:
+            self._rng, key = jax.random.split(self._rng)
+            return key
 
     def _dispatch_rollout(
         self, prompt_ids, prompt_mask, sampling: SamplingConfig, n_real: int
@@ -492,7 +512,8 @@ class Trainer:
         single-call path."""
         cfg = self.config
         hybrid = (
-            self.meshes is not None
+            not self.config.async_rollout  # learner mesh is busy updating
+            and self.meshes is not None
             and not self.meshes.timeshared
             and cfg.number_of_actors > 0
             and cfg.learner_chunk_size > 0
@@ -548,6 +569,11 @@ class Trainer:
         """(params, lora) for an engine call. LoRA mode: frozen base + the
         role's adapter copy. Full-finetune mode: the trained tree IS the
         model — rollout uses the pushed copy, the learner its resident one."""
+        if self.config.async_rollout:
+            # during the pipeline overlap the trainable tree's buffers are
+            # being donated by the concurrent train step — every role must
+            # sample the pushed copy (one step stale by design)
+            role = "rollout"
         if self._full:
             return (
                 (self._lora_rollout, None) if role == "rollout"
@@ -629,12 +655,19 @@ class Trainer:
         )
         # race detector (SURVEY §5): the engine must only ever sample with the
         # adapter version the learner last published — the check the
-        # reference's filesystem bus never had
-        if self._rollout_weight_version != self.weight_version:
+        # reference's filesystem bus never had. async_rollout deliberately
+        # samples one step stale (generation overlaps the update), so its
+        # allowed lag is 1; anything beyond is still a bug.
+        allowed_lag = 1 if self.config.async_rollout else 0
+        lag = self.weight_version - self._rollout_weight_version
+        if not 0 <= lag <= allowed_lag:
+            # lag < 0 (rollout AHEAD of the learner) is version-bookkeeping
+            # corruption — e.g. a resume that restored an older learner state
             raise StaleWeightsError(
                 f"rollout mesh holds adapter v{self._rollout_weight_version} "
-                f"but learner is at v{self.weight_version}; _push_weights() "
-                "was not called after the last optimizer step"
+                f"but learner is at v{self.weight_version} (allowed lag "
+                f"{allowed_lag}); _push_weights() was not called after the "
+                "last optimizer step"
             )
         result = self._dispatch_rollout(prompt_ids, prompt_mask, sampling, b_real)
 
@@ -696,16 +729,50 @@ class Trainer:
             # re-derives the same batch order and skips the batches already
             # trained instead of re-sampling them (SURVEY §5 checkpoint).
             start_episode = self.episode
+            gen_pool = None
+            if cfg.async_rollout:
+                from concurrent.futures import ThreadPoolExecutor
+
+                gen_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rollout"
+                )
+                self._gen_pool = gen_pool
             for episode in range(start_episode, cfg.episodes):
                 self.episode = episode
                 dataset = self.train_dataset.shuffle(seed=cfg.seed + 1000 * episode)
                 skip = self.batch_in_episode if episode == start_episode else 0
-                for bi, batch in enumerate(dataset.iter(cfg.batch_size)):
-                    if bi < skip:
-                        continue
+
+                # ONE-batch lookahead iterator, streamed — the sync path must
+                # not materialize the episode (reference parity: it iterates),
+                # and the async pipeline only ever needs the next batch.
+                # async_rollout: batch t+1's generation is submitted BEFORE
+                # batch t's update (LlamaRL/PipelineRL-style overlap), so it
+                # samples with weights one step stale while the learner mesh
+                # works; the pipeline stays within the episode (batch order
+                # and the resume cursor are unchanged).
+                stream = (
+                    (bi, b)
+                    for bi, b in enumerate(dataset.iter(cfg.batch_size))
+                    if bi >= skip
+                )
+                pending = next(stream, None)
+                gen_future = None
+                if gen_pool is not None and pending is not None:
+                    gen_future = gen_pool.submit(
+                        self._generate_round, pending[1], cfg.train_sampling()
+                    )
+                while pending is not None:
+                    bi, batch = pending
+                    pending = next(stream, None)
                     if self.profiler is not None:
                         self.profiler.step_begin(self.total_batch_steps + 1)
-                    self._train_batch(batch, episode)
+                    next_future = None
+                    if gen_pool is not None and pending is not None:
+                        next_future = gen_pool.submit(
+                            self._generate_round, pending[1], cfg.train_sampling()
+                        )
+                    self._train_batch(batch, episode, gen_future=gen_future)
+                    gen_future = next_future
                     self.batch_in_episode = bi + 1
                     if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
                         self.evaluate()
@@ -725,17 +792,32 @@ class Trainer:
             self.save_checkpoint()
             raise
         finally:
+            pool = getattr(self, "_gen_pool", None)
+            if pool is not None:
+                # never join a possibly-hung generation thread (a raised
+                # EngineHangError's documented recovery is process restart),
+                # and cancel any queued next-batch generation — letting it
+                # start against a hung engine would wedge interpreter exit
+                # (ThreadPoolExecutor threads are joined at atexit)
+                pool.shutdown(wait=False, cancel_futures=True)
+                self._gen_pool = None
             if self.profiler is not None:
                 self.profiler.finish()
             self.sink.finish()
             self.rewards.close()
 
-    def _train_batch(self, batch: Mapping[str, Sequence[str]], episode: int) -> None:
+    def _train_batch(self, batch: Mapping[str, Sequence[str]], episode: int,
+                     gen_future=None) -> None:
         cfg = self.config
         timer = PhaseTimer()
 
         with timer("generation"):
-            candidates = self._generate_round(batch, cfg.train_sampling())
+            # async_rollout hands in a future: timing/generation_duration then
+            # honestly records the BLOCKED time (overlap hides the rest)
+            if gen_future is not None:
+                candidates = gen_future.result()
+            else:
+                candidates = self._generate_round(batch, cfg.train_sampling())
         with timer("reward"):
             self._compute_round_rewards(candidates)
 

@@ -272,12 +272,25 @@ class TestSpecTrainerIntegration:
         assert recs and np.isfinite(recs[-1]["loss"])
 
     def test_from_config_kwargs(self):
-        """Trainer.from_pretrained's engine kwargs mapping includes the spec
-        knobs when continuous batching is on."""
+        """The config→engine kwargs mapping (used by Trainer.from_pretrained)
+        must carry the spec knobs exactly when continuous batching is on."""
         from distrl_llm_tpu.config import TrainConfig
+        from distrl_llm_tpu.trainer import engine_kwargs_from_config
 
         cfg = TrainConfig(
             engine_impl="paged", continuous_batching=True,
             max_concurrent_sequences=64, spec_draft=4, spec_ngram=3,
         )
-        assert cfg.spec_draft == 4 and cfg.spec_ngram == 3
+        kw = engine_kwargs_from_config(cfg)
+        assert kw == {
+            "kv_quant": "none", "scheduler": "refill",
+            "spec_draft": 4, "spec_ngram": 3, "max_concurrent_rows": 64,
+        }
+        # and the kwargs construct a real engine in the configured mode
+        engine = PagedGenerationEngine(
+            TINY, max_prompt_tokens=8, max_new_tokens=8,
+            eos_token_ids=[1], pad_token_id=0, **kw,
+        )
+        assert engine.scheduler == "refill" and engine.spec_draft == 4
+        # dense config maps to no paged knobs at all
+        assert engine_kwargs_from_config(TrainConfig()) == {}

@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--continuous_batching. 0 = off")
     p.add_argument("--spec_ngram", type=int, default=2,
                    help="lookup n-gram size for --spec_draft")
+    p.add_argument("--async_rollout", action="store_true",
+                   help="pipeline generation of batch t+1 with the update on "
+                        "batch t (one-step-off-policy; LlamaRL/PipelineRL-"
+                        "style overlap). Default: reference-parity "
+                        "synchronous loop")
     p.add_argument("--rollout_workers", type=str, default="",
                    help="comma-separated control-plane workers "
                         "(host:port,...) to dispatch generation to; start "
