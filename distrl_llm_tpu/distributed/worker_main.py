@@ -34,7 +34,8 @@ _ENGINE_STATE: dict = {}
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
                  engine_impl: str = "dense", kv_quant: str = "none",
-                 max_concurrent: int = 0, scheduler: str = "waves") -> None:
+                 max_concurrent: int = 0, scheduler: str = "waves",
+                 spec_draft: int = 0) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -70,6 +71,8 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         engine_cls = PagedGenerationEngine
         kwargs["kv_quant"] = kv_quant
         kwargs["scheduler"] = scheduler
+        if spec_draft:
+            kwargs["spec_draft"] = spec_draft
     else:
         engine_cls = GenerationEngine
     if max_concurrent:
@@ -167,9 +170,14 @@ def main(argv: list[str] | None = None) -> None:
                         choices=["waves", "refill"],
                         help="paged-engine batching: whole-prompt waves or "
                              "per-candidate slot refill (continuous batching)")
+    parser.add_argument("--spec-draft", type=int, default=0,
+                        help="n-gram speculative decoding draft length "
+                             "(requires --scheduler refill)")
     args = parser.parse_args(argv)
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
+    if args.spec_draft and args.scheduler != "refill":
+        parser.error("--spec-draft requires --scheduler refill")
     if args.scheduler == "refill" and not args.max_concurrent_sequences:
         parser.error(
             "--scheduler refill requires --max-concurrent-sequences "
@@ -182,7 +190,7 @@ def main(argv: list[str] | None = None) -> None:
             args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
             max_concurrent=args.max_concurrent_sequences,
-            scheduler=args.scheduler,
+            scheduler=args.scheduler, spec_draft=args.spec_draft,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer
