@@ -55,3 +55,42 @@ def test_config_roundtrip():
 def test_invalid_learner_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["--learner", "ppo"])
+
+
+class TestReadmeBaselineCommands:
+    """The README's five BASELINE-config commands must parse into valid
+    TrainConfigs — documentation that cannot rot."""
+
+    CMDS = [
+        "--model /ckpts/Qwen2.5-0.5B-Instruct --learner pg "
+        "--number_of_actors 1 --number_of_learners 1",
+        "--model /ckpts/Qwen2.5-7B-Instruct --learner grpo "
+        "--number_of_actors 2 --number_of_learners 1 --engine_impl paged "
+        "--max_concurrent_sequences 128 --continuous_batching --spec_draft 4 "
+        "--kv_cache_quant int8 --tp 2",
+        "--model /ckpts/Meta-Llama-3-8B-Instruct --dataset openai/gsm8k "
+        "--learner grpo --full_finetune --fsdp 4",
+        "--model /ckpts/DeepSeek-R1-Distill-Qwen-7B --learner grpo "
+        "--max_new_tokens 4096 --engine_impl paged "
+        "--max_concurrent_sequences 64 --continuous_batching "
+        "--attn_impl ring --sp 4 --logprob_chunk 256",
+        "--model /ckpts/Qwen2.5-72B-Instruct --learner grpo --tp 4 --fsdp 8 "
+        "--rollout_workers host1:7201,host2:7201",
+    ]
+
+    @pytest.mark.parametrize("cmd", CMDS)
+    def test_baseline_config_command_parses(self, cmd):
+        import shlex
+
+        from train_distributed import build_parser, config_from_args
+
+        cfg = config_from_args(build_parser().parse_args(shlex.split(cmd)))
+        assert cfg.model
+
+    def test_commands_match_readme(self):
+        """Every flag string tested above appears verbatim in README.md."""
+        readme = open("README.md").read().replace("\\\n", " ")
+        squashed = " ".join(readme.split())
+        for cmd in self.CMDS:
+            for token in cmd.split():
+                assert token in squashed, f"{token} not in README"
