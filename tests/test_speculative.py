@@ -294,3 +294,47 @@ class TestSpecTrainerIntegration:
         assert engine.scheduler == "refill" and engine.spec_draft == 4
         # dense config maps to no paged knobs at all
         assert engine_kwargs_from_config(TrainConfig()) == {}
+
+
+class TestSchedulerFuzz:
+    """Randomized configurations of the greedy-equality invariant: for ANY
+    (slots, draft length, EOS set, prompt raggedness), wave, refill, and
+    speculative decoding must produce identical greedy output."""
+
+    @pytest.mark.parametrize("seed", [11, 22, 33, 44])
+    def test_random_configs_agree(self, seed):
+        r = np.random.default_rng(seed)
+        params = init_params(jax.random.PRNGKey(int(r.integers(100))), TINY)
+        b = int(r.integers(2, 5))
+        n = int(r.integers(1, 4))
+        max_new = int(r.integers(4, 14))
+        slots = int(r.integers(1, b * n + 1))
+        d = int(r.integers(1, 5))
+        ids = r.integers(1, TINY.vocab_size, (b, P_LEN)).astype(np.int32)
+        mask = np.ones((b, P_LEN), np.int32)
+        for row in range(b):  # ragged left padding
+            cut = int(r.integers(0, P_LEN - 1))
+            mask[row, :cut] = 0
+            ids[row, :cut] = 0
+        # EOS ids drawn from a probe so some rows stop mid-decode
+        probe = make_engine(max_new=max_new, slots=b * n).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=max_new, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        eos = sorted({
+            int(probe.tokens[i % b, 0, int(r.integers(0, max_new))])
+            for i in range(2)
+        })
+        cfg = SamplingConfig(max_tokens=max_new, temperature=0.0, n=n)
+        base = make_engine(max_new=max_new, eos=eos, slots=b * n).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(1))
+        refill = make_engine(max_new=max_new, eos=eos, slots=slots).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(1))
+        spec = make_engine(
+            max_new=max_new, eos=eos, slots=slots, spec_draft=d
+        ).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(1))
+        label = f"seed={seed} b={b} n={n} slots={slots} d={d} eos={eos}"
+        np.testing.assert_array_equal(refill.tokens, base.tokens, err_msg=label)
+        np.testing.assert_array_equal(spec.tokens, base.tokens, err_msg=label)
+        np.testing.assert_array_equal(spec.lengths, base.lengths, err_msg=label)
