@@ -191,6 +191,14 @@ class TrainConfig:
     # construction (the loss's ratio is computed under the current policy).
     # Off (default) = the reference's strictly synchronous loop.
     async_rollout: bool = False
+    # PPO-clip surrogate epsilon (0 = reference parity: the no-KL/no-clip
+    # single-update objective). With clip_ratio > 0 the learner ratios the
+    # current policy against ENGINE-CAPTURED behavior logprobs
+    # (GenerationResult.logprobs — the vLLM-logprobs equivalent) and trains
+    # on the engine's raw token ids, making updates stable off-policy
+    # (async_rollout staleness; the reference's documented long-training
+    # instability, README.md:91).
+    clip_ratio: float = 0.0
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True

@@ -109,7 +109,10 @@ class RemoteEngine:
         self._warm_keys.add(warm_key)
         tokens = np.concatenate([r["tokens"] for r in results], axis=0)
         lengths = np.concatenate([r["lengths"] for r in results], axis=0)
-        return GenerationResult(tokens=tokens, lengths=lengths)
+        logps = None
+        if all(r.get("logprobs") is not None for r in results):
+            logps = np.concatenate([r["logprobs"] for r in results], axis=0)
+        return GenerationResult(tokens=tokens, lengths=lengths, logprobs=logps)
 
 
 def connect_remote_engine(

@@ -134,7 +134,10 @@ def handler(payload: bytes) -> bytes:
             SamplingConfig(**arg["sampling"]),
             jax.random.PRNGKey(arg["rng_seed"]),
         )
-        return pickle.dumps({"tokens": result.tokens, "lengths": result.lengths})
+        return pickle.dumps({
+            "tokens": result.tokens, "lengths": result.lengths,
+            "logprobs": result.logprobs,
+        })
     raise ValueError(f"unknown op {op!r}")
 
 

@@ -39,7 +39,7 @@ import numpy as np
 from distrl_llm_tpu.config import SamplingConfig
 from distrl_llm_tpu.models.configs import ModelConfig
 from distrl_llm_tpu.models.transformer import forward, init_kv_cache
-from distrl_llm_tpu.ops.sampling import sample
+from distrl_llm_tpu.ops.sampling import sample, token_logprob
 
 Params = dict[str, Any]
 
@@ -52,11 +52,16 @@ class GenerationResult(NamedTuple):
     # measures the realized draft acceptance — the number to tune spec_draft
     # against on real hardware.
     steps_dispatched: int | None = None
+    # RAW-model log-probabilities of the sampled tokens [B, n, T] f32 (the
+    # behavior policy's logprobs — what vLLM returns as `logprobs`); the
+    # PPO-clip learner objective ratios the current policy against these.
+    logprobs: np.ndarray | None = None
 
 
 class _DecodeState(NamedTuple):
     step: jax.Array
     out: jax.Array  # [Bn, T]
+    logps: jax.Array  # [Bn, T] raw-model logprob of each sampled token
     lengths: jax.Array  # [Bn]
     done: jax.Array  # [Bn] bool
     key_mask: jax.Array  # [Bn, Smax]
@@ -89,6 +94,7 @@ def _decode_init(cache, key_mask, first_logits, row_alive,
     return _DecodeState(
         step=jnp.zeros((), jnp.int32),
         out=jnp.full((bn, max_steps), pad_id, jnp.int32),
+        logps=jnp.zeros((bn, max_steps), jnp.float32),
         lengths=jnp.zeros((bn,), jnp.int32),
         # rows with an empty prompt are batch padding — born done, so they
         # never gate the early-exit or sample from their NaN logits
@@ -102,7 +108,7 @@ def _decode_init(cache, key_mask, first_logits, row_alive,
 def _decode_step(params, lora, state: _DecodeState, rng,
                  *, cfg: ModelConfig, prompt_len: int, eos_ids, pad_id: int,
                  temperature, top_p, lora_scale: float, attn_impl: str,
-                 top_p_impl: str = "bisect"):
+                 top_p_impl: str = "bisect", capture_logprobs: bool = False):
     """One decode step: sample from the carried logits, write token + KV,
     forward one position.
 
@@ -120,6 +126,13 @@ def _decode_step(params, lora, state: _DecodeState, rng,
                  top_p_impl=top_p_impl)
     tok = jnp.where(s.done, pad_id, tok)
     out = jax.lax.dynamic_update_slice(s.out, tok[:, None], (0, s.step))
+    if capture_logprobs:  # per-step vocab logsumexp — only when requested
+        logp = jnp.where(s.done, 0.0, token_logprob(s.logits, tok))
+        logps = jax.lax.dynamic_update_slice(
+            s.logps, logp[:, None], (0, s.step)
+        )
+    else:
+        logps = s.logps
     lengths = s.lengths + (~s.done).astype(jnp.int32)
     hit_eos = jnp.isin(tok, eos_ids)
     # the just-sampled token occupies position prompt_len + step for rows
@@ -136,7 +149,7 @@ def _decode_step(params, lora, state: _DecodeState, rng,
         attn_impl=attn_impl,
     )
     return _DecodeState(
-        step=s.step + 1, out=out, lengths=lengths, done=done,
+        step=s.step + 1, out=out, logps=logps, lengths=lengths, done=done,
         key_mask=key_mask, logits=next_logits[:, 0], cache=cache,
     )
 
@@ -165,7 +178,9 @@ def generate_in_waves(
     if not max_rows or b * n <= max_rows:
         return inner_generate(params, lora, prompt_ids, prompt_mask, sampling, rng)
     per_wave = max(max_rows // n, 1)
-    tokens, lengths = [], []
+    tokens, lengths, logps = [], [], []
+    steps = 0
+    have_steps = have_logps = True
     for w in range(-(-b // per_wave)):
         lo = w * per_wave
         ids = prompt_ids[lo : lo + per_wave]
@@ -184,9 +199,19 @@ def generate_in_waves(
         keep = per_wave - pad
         tokens.append(res.tokens[:keep])
         lengths.append(res.lengths[:keep])
+        if res.logprobs is None:
+            have_logps = False
+        else:
+            logps.append(res.logprobs[:keep])
+        if res.steps_dispatched is None:
+            have_steps = False
+        else:
+            steps += res.steps_dispatched
     return GenerationResult(
         tokens=np.concatenate(tokens, axis=0),
         lengths=np.concatenate(lengths, axis=0),
+        steps_dispatched=steps if have_steps else None,
+        logprobs=np.concatenate(logps, axis=0) if have_logps else None,
     )
 
 
@@ -247,8 +272,10 @@ class GenerationEngine:
         decode_chunk: int = 128,
         prompt_buckets: Sequence[int] | None = None,
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
+        capture_logprobs: bool = False,  # record behavior logprobs (clip_ratio)
     ):
         self.max_concurrent_rows = max_concurrent_rows
+        self.capture_logprobs = capture_logprobs
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
@@ -315,6 +342,7 @@ class GenerationEngine:
                         _decode_step, cfg=self.cfg, prompt_len=bucket,
                         pad_id=self.pad_id, lora_scale=self.lora_scale,
                         attn_impl=self.attn_impl,
+                        capture_logprobs=self.capture_logprobs,
                     ),
                     donate_argnames=("state",),
                     static_argnames=("top_p_impl",),
@@ -374,4 +402,8 @@ class GenerationEngine:
         )
         out = np.asarray(state.out).reshape(b, sampling.n, max_steps)
         lengths = np.asarray(state.lengths).reshape(b, sampling.n)
-        return GenerationResult(tokens=out, lengths=lengths)
+        logps = (
+            np.asarray(state.logps).reshape(b, sampling.n, max_steps)
+            if self.capture_logprobs else None
+        )
+        return GenerationResult(tokens=out, lengths=lengths, logprobs=logps)

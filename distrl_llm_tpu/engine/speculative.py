@@ -136,6 +136,7 @@ class SpecRefillState(NamedTuple):
 
     step: jax.Array
     out: jax.Array  # [total, T]
+    logps_buf: jax.Array  # [total, T] behavior logprobs (raw log_softmax)
     lengths_buf: jax.Array  # [total]
     cand: jax.Array  # [R]
     done: jax.Array  # [R]

@@ -148,6 +148,39 @@ def grpo_loss(
     return -(per_row * sample_mask).sum() / denom
 
 
+def grpo_clip_loss(
+    logprobs: jax.Array,  # [B, T] current-policy logprobs
+    behavior_logps: jax.Array,  # [B, T] rollout-time logprobs (engine-captured)
+    answer_mask: jax.Array,  # [B, T]
+    advantages: jax.Array,  # [B]
+    sample_mask: jax.Array | None = None,
+    clip_ratio: float = 0.2,
+) -> jax.Array:
+    """PPO-clip surrogate over raw-basis importance ratios — the stability
+    mechanism the reference lacks (its GRPO has "no KL, no clipping",
+    distributed_actor.py:467–470, and its README admits "training becomes
+    unstable with longer training", README.md:91). The behavior logprobs
+    come from the engine at sample time (GenerationResult.logprobs, the
+    vLLM-logprobs equivalent), so the ratio is exact even when the update
+    is off-policy (async_rollout's one-step staleness, or multiple
+    optimizer steps per rollout batch). Both logprob sides are RAW
+    log_softmax (see ops/sampling.token_logprob for the convention and its
+    approximation at temperature != 1):
+
+        ratio_t = exp(logp_current − logp_behavior)
+        loss = −mean_rows( mean_t min(ratio·A, clip(ratio, 1±ε)·A) )
+    """
+    ratio = jnp.exp(logprobs - behavior_logps)
+    clipped = jnp.clip(ratio, 1.0 - clip_ratio, 1.0 + clip_ratio)
+    adv = advantages[:, None]
+    surrogate = jnp.minimum(ratio * adv, clipped * adv)
+    per_row = _masked_mean_seq(surrogate, answer_mask)
+    if sample_mask is None:
+        return -per_row.mean()
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    return -(per_row * sample_mask).sum() / denom
+
+
 def entropy_bonus(logprobs_full: jax.Array, alpha: float) -> jax.Array:
     """Entropy regularizer over the vocab distribution — defined for API parity
     with the reference's compute_entropy_bonus (distributed_actor.py:266–281),

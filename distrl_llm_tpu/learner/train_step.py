@@ -31,7 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from distrl_llm_tpu.learner.losses import answer_logprobs, grpo_loss, pg_loss
+from distrl_llm_tpu.learner.losses import (
+    answer_logprobs, grpo_clip_loss, grpo_loss, pg_loss,
+)
 from distrl_llm_tpu.models.configs import ModelConfig
 
 
@@ -44,6 +46,9 @@ class UpdateBatch(NamedTuple):
     answer_mask: jax.Array  # [N, T]
     coeffs: jax.Array  # [N] f32 — reward−baseline (PG) or advantage (GRPO)
     sample_mask: jax.Array  # [N] f32 — 0 for padding rows
+    # rollout-time logprobs of answer tokens [N, T] (engine-captured) — the
+    # PPO-clip objective's behavior policy; None for the no-clip losses
+    behavior_logps: jax.Array | None = None
 
 
 def _microbatch_loss(
@@ -51,6 +56,7 @@ def _microbatch_loss(
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
     attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
     dropout_rng=None, logit_chunk: int = 0, train_mode: str = "lora",
+    clip_ratio: float = 0.0,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight.
 
@@ -73,8 +79,16 @@ def _microbatch_loss(
             lora_dropout=lora_dropout, dropout_rng=dropout_rng,
             logit_chunk=logit_chunk,
         )
-    loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
-    loss = loss_fn(logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask)
+    if clip_ratio > 0.0:
+        loss = grpo_clip_loss(
+            logps, mb.behavior_logps, mb.answer_mask.astype(jnp.float32),
+            mb.coeffs, mb.sample_mask, clip_ratio=clip_ratio,
+        )
+    else:
+        loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
+        loss = loss_fn(
+            logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask
+        )
 
     # The skip operates on COEFFS (baseline-subtracted rewards / advantages),
     # exactly like the reference: Learner.train flattens `r - b` and GRPO
@@ -106,6 +120,7 @@ def make_train_step(
     lora_dropout: float = 0.0,
     logit_chunk: int = 0,  # chunked fused-CE logprobs (losses.answer_logprobs)
     train_mode: str = "lora",  # "lora" | "full" (arg0 is the whole param tree)
+    clip_ratio: float = 0.0,  # >0: PPO-clip surrogate over engine logprobs
 ) -> Callable:
     """Build the jitted train step.
 
@@ -127,6 +142,7 @@ def make_train_step(
         lora_dropout=lora_dropout,
         logit_chunk=logit_chunk,
         train_mode=train_mode,
+        clip_ratio=clip_ratio,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,
@@ -183,6 +199,7 @@ def prepare_update_batch(
     max_new_tokens: int,
     micro_size: int,
     mesh=None,
+    raw_rollout: dict | None = None,
 ) -> UpdateBatch:
     """Host-side tokenize+pad to the fixed learner shapes.
 
@@ -201,9 +218,31 @@ def prepare_update_batch(
     prompt_ids, prompt_mask = encode_fixed(
         tokenizer, problems, max_prompt_tokens, side="left"
     )
-    answer_ids, answer_mask = encode_fixed(
-        tokenizer, answers, max_new_tokens, side="right"
-    )
+    behavior_logps = None
+    if raw_rollout is not None:
+        # PPO-clip path: train on the ENGINE'S token ids (retokenizing the
+        # decoded text can shift token boundaries and desync the per-token
+        # behavior logprobs — flatten_for_update docstring)
+        eng_tokens = np.asarray(raw_rollout["answer_tokens"], np.int32)
+        eng_logps = np.asarray(raw_rollout["behavior_logps"], np.float32)
+        t_eng = eng_tokens.shape[1]
+        width = min(t_eng, max_new_tokens)
+        answer_ids = np.zeros((n_real, max_new_tokens), np.int32)
+        behavior = np.zeros((n_real, max_new_tokens), np.float32)
+        answer_ids[:, :width] = eng_tokens[:, :width]
+        behavior[:, :width] = eng_logps[:, :width]
+        # mask from real generated lengths: engine pads after EOS with a pad
+        # token whose id may be a REAL vocab id, so the text-derived mask
+        # cannot be reused
+        lengths = np.asarray(raw_rollout["lengths"], np.int32)
+        answer_mask = (
+            np.arange(max_new_tokens)[None, :] < lengths[:, None]
+        ).astype(np.int32)
+        behavior_logps = behavior
+    else:
+        answer_ids, answer_mask = encode_fixed(
+            tokenizer, answers, max_new_tokens, side="right"
+        )
     n = -(-max(n_real, 1) // micro_size) * micro_size
     pad = n - n_real
 
@@ -215,10 +254,14 @@ def prepare_update_batch(
     batch = UpdateBatch(
         prompt_ids=jnp.asarray(pad_rows(prompt_ids)),
         prompt_mask=jnp.asarray(pad_rows(prompt_mask)),
-        answer_ids=jnp.asarray(pad_rows(answer_ids)),
-        answer_mask=jnp.asarray(pad_rows(answer_mask)),
+        answer_ids=jnp.asarray(pad_rows(np.asarray(answer_ids))),
+        answer_mask=jnp.asarray(pad_rows(np.asarray(answer_mask))),
         coeffs=jnp.asarray(pad_rows(np.asarray(coeffs, np.float32))),
         sample_mask=jnp.asarray(sample_mask),
+        behavior_logps=(
+            jnp.asarray(pad_rows(behavior_logps))
+            if behavior_logps is not None else None
+        ),
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -87,3 +87,20 @@ def sample(
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
     return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)
+
+
+def token_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """RAW-model log-probability of ``tokens`` under ``logits`` ([..., V] ×
+    [...] → [...] f32). This is the rollout-time BEHAVIOR logprob the
+    PPO-clip objective ratios against the learner's recompute. Both sides
+    use unscaled log_softmax — the RLHF/vLLM convention. Note this is an
+    APPROXIMATION when temperature != 1 or top_p < 1: tokens were actually
+    drawn from the tempered/filtered distribution, so the raw-basis ratio
+    is not the exact importance ratio against the sampler; it is exact for
+    the policy the LOSS optimizes (the raw model), which is why the
+    convention is standard."""
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logits.astype(jnp.float32), tokens[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return picked - logz

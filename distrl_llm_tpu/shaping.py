@@ -73,36 +73,75 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
     in a group is the identical prompt (SURVEY §3.6.5)."""
     for cand in candidates:
         kept_answers, kept_rewards, kept_problems = [], [], []
+        kept_tokens, kept_logps, kept_lens = [], [], []
+        has_raw = "answer_tokens" in cand
         for j, rewards in enumerate(cand["rewards"]):
             idx = np.argsort(rewards)[-topk:]
             kept_answers.append([cand["answers"][j][i] for i in idx])
             kept_rewards.append(np.asarray(rewards)[idx])
             kept_problems.append(cand["problem"][j][:topk])
+            if has_raw:  # raw engine tokens/logps follow the same selection
+                kept_tokens.append(np.asarray(cand["answer_tokens"][j])[idx])
+                kept_logps.append(np.asarray(cand["behavior_logps"][j])[idx])
+                kept_lens.append(np.asarray(cand["gen_lengths"][j])[idx])
         cand["answers"] = kept_answers
         cand["rewards"] = kept_rewards
         cand["problem"] = kept_problems
+        if has_raw:
+            cand["answer_tokens"] = kept_tokens
+            cand["behavior_logps"] = kept_logps
+            cand["gen_lengths"] = kept_lens
 
 
 def flatten_for_update(
     candidates: Sequence[MutableMapping[str, Any]], learner_type: str
-) -> tuple[list[str], list[str], np.ndarray]:
-    """Flatten shaped candidates into (problems, answers, scalar-coefficient)
-    lists for the learner. PG applies reward − baseline here
-    (distributed_actor.py:399–406); GRPO passes advantages through (:495–504)."""
+) -> tuple[list[str], list[str], np.ndarray, dict | None]:
+    """Flatten shaped candidates into (problems, answers, coefficients,
+    raw_rollout) lists for the learner. PG applies reward − baseline here
+    (distributed_actor.py:399–406); GRPO passes advantages through (:495–504).
+
+    ``raw_rollout`` (None when the engine captured no logprobs) carries the
+    engine's own answer token ids and behavior logprobs row-aligned with the
+    text lists — the PPO-clip objective trains on these instead of
+    retokenized text."""
     problems: list[str] = []
     answers: list[str] = []
     coeffs: list[float] = []
+    tokens: list[np.ndarray] = []
+    logps: list[np.ndarray] = []
+    lens: list[int] = []
+    has_raw = all("answer_tokens" in c for c in candidates) and candidates
     for cand in candidates:
         if learner_type == "grpo":
-            for a, p, r in zip(cand["answers"], cand["problem"], cand["rewards"]):
+            for j, (a, p, r) in enumerate(
+                zip(cand["answers"], cand["problem"], cand["rewards"])
+            ):
                 problems.extend(p)
                 answers.extend(a)
                 coeffs.extend(np.asarray(r).tolist())
+                if has_raw:
+                    tokens.extend(np.asarray(cand["answer_tokens"][j]))
+                    logps.extend(np.asarray(cand["behavior_logps"][j]))
+                    lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
         else:
-            for a, p, r, b in zip(
-                cand["answers"], cand["problem"], cand["rewards"], cand["baselines"]
+            for j, (a, p, r, b) in enumerate(
+                zip(
+                    cand["answers"], cand["problem"], cand["rewards"],
+                    cand["baselines"],
+                )
             ):
                 problems.extend(p)
                 answers.extend(a)
                 coeffs.extend((np.asarray(r) - b).tolist())
-    return problems, answers, np.asarray(coeffs, dtype=np.float32)
+                if has_raw:
+                    tokens.extend(np.asarray(cand["answer_tokens"][j]))
+                    logps.extend(np.asarray(cand["behavior_logps"][j]))
+                    lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
+    raw = None
+    if has_raw and tokens:
+        raw = {
+            "answer_tokens": np.asarray(tokens),
+            "behavior_logps": np.asarray(logps, dtype=np.float32),
+            "lengths": np.asarray(lens, dtype=np.int32),
+        }
+    return problems, answers, np.asarray(coeffs, dtype=np.float32), raw

@@ -65,6 +65,10 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
                 kwargs["spec_ngram"] = config.spec_ngram
     if config.max_concurrent_sequences:
         kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
+    if config.clip_ratio > 0.0:
+        # behavior-logprob capture costs a per-step vocab logsumexp plus the
+        # [B, n, T] f32 transport — only pay it when the clip objective needs it
+        kwargs["capture_logprobs"] = True
     return kwargs
 
 
@@ -210,6 +214,7 @@ class Trainer:
             lora_dropout=config.lora_dropout,
             logit_chunk=config.logprob_chunk,
             train_mode="full" if self._full else "lora",
+            clip_ratio=config.clip_ratio,
         )
 
         self.total_batch_steps = 0
@@ -560,9 +565,22 @@ class Trainer:
             pool.shutdown(wait=False)
         from distrl_llm_tpu.engine.engine import GenerationResult
 
+        both_logps = res_a.logprobs is not None and res_l.logprobs is not None
+        both_steps = (
+            res_a.steps_dispatched is not None
+            and res_l.steps_dispatched is not None
+        )
         return GenerationResult(
             tokens=np.concatenate([res_a.tokens, res_l.tokens], axis=0),
             lengths=np.concatenate([res_a.lengths, res_l.lengths], axis=0),
+            steps_dispatched=(
+                res_a.steps_dispatched + res_l.steps_dispatched
+                if both_steps else None
+            ),
+            logprobs=(
+                np.concatenate([res_a.logprobs, res_l.logprobs], axis=0)
+                if both_logps else None
+            ),
         )
 
     def _engine_params(self, role: str) -> tuple:
@@ -676,14 +694,21 @@ class Trainer:
         for i in range(b_real):
             answers.append(decode_batch(self.tokenizer, result.tokens[i], result.lengths[i]))
             token_lengths.append([int(x) for x in result.lengths[i]])
-        return [
-            {
-                "answers": answers,
-                "problem": [[p] * n for p in problems],
-                "solution": [[s] * n for s in solutions],
-                "token_lengths": token_lengths,
-            }
-        ]
+        cand: dict[str, Any] = {
+            "answers": answers,
+            "problem": [[p] * n for p in problems],
+            "solution": [[s] * n for s in solutions],
+            "token_lengths": token_lengths,
+        }
+        # raw engine tokens + behavior logprobs (when the engine captures
+        # them): the PPO-clip objective trains on THESE ids — retokenizing
+        # decoded text (the reference's path) can shift token boundaries and
+        # corrupt per-token importance ratios
+        if result.logprobs is not None:
+            cand["answer_tokens"] = [result.tokens[i] for i in range(b_real)]
+            cand["behavior_logps"] = [result.logprobs[i] for i in range(b_real)]
+            cand["gen_lengths"] = [result.lengths[i] for i in range(b_real)]
+        return [cand]
 
     def _compute_round_rewards(self, candidates: list[dict[str, Any]]) -> None:
         """Per-task-group (n, 2) rewards (distributed_trainer.py:205–219),
@@ -835,13 +860,21 @@ class Trainer:
             topk_filter(candidates, cfg.topk)
 
         with timer("update"):
-            problems, answers, coeffs = flatten_for_update(candidates, cfg.learner)
+            problems, answers, coeffs, raw = flatten_for_update(
+                candidates, cfg.learner
+            )
+            if cfg.clip_ratio > 0.0 and raw is None:
+                raise RuntimeError(
+                    "clip_ratio requires engine-captured behavior logprobs; "
+                    "this engine returned none (GenerationResult.logprobs)"
+                )
             update = prepare_update_batch(
                 self.tokenizer, problems, answers, coeffs,
                 max_prompt_tokens=cfg.max_prompt_tokens,
                 max_new_tokens=cfg.max_new_tokens,
                 micro_size=cfg.train_batch_size,
                 mesh=self.meshes.learner if self.meshes is not None else None,
+                raw_rollout=raw if cfg.clip_ratio > 0.0 else None,
             )
             self.lora, self.opt_state, loss = self.train_step(
                 self.lora, self.opt_state,

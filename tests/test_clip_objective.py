@@ -1,0 +1,187 @@
+"""Behavior logprobs + the PPO-clip objective.
+
+The stability mechanism the reference lacks (no KL, no clipping — SURVEY
+§3.6.2; "training becomes unstable with longer training", README.md:91):
+engines capture each sampled token's RAW-model logprob at rollout time
+(GenerationResult.logprobs — the vLLM-logprobs equivalent) and the learner
+ratios its recompute against them under a clipped surrogate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine import GenerationEngine
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+from distrl_llm_tpu.learner.losses import answer_logprobs, grpo_clip_loss, grpo_loss
+from distrl_llm_tpu.models import TINY, init_params
+
+P_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(7), TINY)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, TINY.vocab_size, size=(3, P_LEN)).astype(np.int32)
+    mask = np.ones((3, P_LEN), np.int32)
+    mask[0, :3] = 0
+    ids[0, :3] = 0
+    return params, ids, mask
+
+
+def engines():
+    kw = dict(max_prompt_tokens=P_LEN, max_new_tokens=6,
+              eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+              cache_dtype=jnp.float32, capture_logprobs=True)
+    return {
+        "dense": GenerationEngine(TINY, **kw),
+        "paged": PagedGenerationEngine(TINY, **kw, page_size=8),
+        "refill": PagedGenerationEngine(
+            TINY, **kw, page_size=8, scheduler="refill", max_concurrent_rows=3),
+        "spec": PagedGenerationEngine(
+            TINY, **kw, page_size=8, scheduler="refill", max_concurrent_rows=3,
+            spec_draft=2),
+    }
+
+
+class TestBehaviorLogprobs:
+    @pytest.mark.parametrize("name", ["dense", "paged", "refill", "spec"])
+    def test_engine_logprobs_match_learner_recompute(self, setup, name):
+        """THE cross-stack consistency check: the engine's rollout-time
+        logprob of every sampled token must equal the learner's
+        answer_logprobs recompute under the SAME weights (raw log_softmax
+        basis on both sides) — any drift in cache math, positions, or the
+        sampling path shows up here."""
+        params, ids, mask = setup
+        engine = engines()[name]
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=6, temperature=1.3, top_p=0.95, n=2),
+            jax.random.PRNGKey(3),
+        )
+        assert res.logprobs is not None
+        b, n, t = res.tokens.shape
+        # learner-side recompute on the engine's raw tokens
+        pid = np.repeat(ids, n, axis=0)
+        pmask = np.repeat(mask, n, axis=0)
+        aid = res.tokens.reshape(b * n, t)
+        lengths = res.lengths.reshape(b * n)
+        amask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.int32)
+        recomputed = np.asarray(answer_logprobs(
+            params, TINY, jnp.asarray(pid), jnp.asarray(pmask),
+            jnp.asarray(aid), jnp.asarray(amask), remat=False,
+        ))
+        got = res.logprobs.reshape(b * n, t)
+        real = amask.astype(bool)
+        np.testing.assert_allclose(got[real], recomputed[real], atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_greedy_logprob_is_argmax_logprob(self, setup):
+        params, ids, mask = setup
+        res = engines()["dense"].generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=4, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        # greedy tokens still record their true (raw) logprob — finite, ≤ 0
+        real = (np.arange(4)[None, :] < res.lengths.reshape(-1)[:, None])
+        lp = res.logprobs.reshape(-1, 4)[real]
+        assert np.isfinite(lp).all() and (lp <= 0).all()
+
+
+class TestClipLoss:
+    def test_on_policy_matches_grpo(self):
+        """With behavior == current logprobs (ratio 1), the clip surrogate
+        equals the plain GRPO loss value (the min never binds at ratio 1)."""
+        rng = np.random.default_rng(0)
+        lp = jnp.asarray(rng.normal(size=(4, 6)) - 2.0, jnp.float32)
+        mask = jnp.ones((4, 6), jnp.float32)
+        adv = jnp.asarray(rng.normal(size=4), jnp.float32)
+        clip = grpo_clip_loss(lp, lp, mask, adv, clip_ratio=0.2)
+        plain = grpo_loss(lp, mask, adv)
+        np.testing.assert_allclose(float(clip), float(plain), atol=1e-6)
+
+    def test_clip_bounds_the_update(self):
+        """Far off-policy rows must contribute the CLIPPED surrogate: the
+        gradient through ratios beyond 1±eps with positive advantage is
+        zero (the PPO pessimism bound)."""
+        lp_cur = jnp.asarray([[0.0]])
+        lp_beh = jnp.asarray([[-3.0]])  # ratio e^3 >> 1+eps
+        mask = jnp.ones((1, 1), jnp.float32)
+        adv = jnp.asarray([1.0])
+
+        def loss(l):
+            return grpo_clip_loss(l, lp_beh, mask, adv, clip_ratio=0.2)
+
+        g = jax.grad(loss)(lp_cur)
+        np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-7)
+        # value equals the clipped bound
+        np.testing.assert_allclose(float(loss(lp_cur)), -1.2, atol=1e-6)
+
+    def test_negative_advantage_unclipped_when_ratio_high(self):
+        """min(r·A, clip(r)·A) with A<0 keeps the UNCLIPPED (more negative)
+        branch for r > 1+eps — gradient must flow (pessimism is one-sided)."""
+        lp_cur = jnp.asarray([[0.0]])
+        lp_beh = jnp.asarray([[-3.0]])
+        mask = jnp.ones((1, 1), jnp.float32)
+        adv = jnp.asarray([-1.0])
+
+        def loss(l):
+            return grpo_clip_loss(l, lp_beh, mask, adv, clip_ratio=0.2)
+
+        g = jax.grad(loss)(lp_cur)
+        assert abs(float(g[0, 0])) > 1e-3
+
+
+class TestClipTrainerIntegration:
+    def test_trainer_round_with_clip(self):
+        """Full batch with clip_ratio on: the engine's logprobs flow through
+        candidates → topk → flatten → UpdateBatch, and the learner trains on
+        the ENGINE's token ids (no retokenize roundtrip)."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        cfg = make_config(learner="grpo", clip_ratio=0.2, topk=3,
+                          num_candidates=4)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=cfg.max_prompt_tokens,
+            max_new_tokens=cfg.max_new_tokens,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, capture_logprobs=True,
+        )
+        sink = MemorySink()
+
+        def dense_reward(completions, solutions):
+            return np.asarray(
+                [(0.0, 0.1 + (len(c) % 5) / 10.0) for c in completions],
+                np.float32,
+            )
+
+        trainer = Trainer(
+            train, test, dense_reward, cfg,
+            tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+            sink=sink,
+        )
+        batch = {"problem": train["problem"][:4], "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+
+    def test_clip_without_logprobs_fails_loudly(self):
+        """An engine that captures no logprobs (FakeEngine) + clip_ratio
+        must raise, not silently train without the correction."""
+        from tests.test_trainer import make_trainer
+
+        trainer = make_trainer(clip_ratio=0.2, learner="grpo")
+        batch = {"problem": ["q a", "q b"], "solution": ["A", "B"]}
+        with pytest.raises(RuntimeError, match="logprobs"):
+            trainer._train_batch(batch, episode=0)

@@ -82,7 +82,7 @@ class TestFlattenForUpdate:
             "rewards": [np.array([1.0, 0.5])],
             "baselines": [0.75],
         }
-        problems, answers, coeffs = flatten_for_update([cand], "pg")
+        problems, answers, coeffs, _ = flatten_for_update([cand], "pg")
         assert problems == ["p", "p"] and answers == ["a", "b"]
         np.testing.assert_allclose(coeffs, [0.25, -0.25])
 
@@ -92,13 +92,57 @@ class TestFlattenForUpdate:
             "answers": [["a"]],
             "rewards": [np.array([1.5])],
         }
-        _, _, coeffs = flatten_for_update([cand], "grpo")
+        _, _, coeffs, _ = flatten_for_update([cand], "grpo")
         np.testing.assert_allclose(coeffs, [1.5])
 
     def test_roundtrip_through_shaping(self):
         r = [[0.0, 1.0], [0.0, 0.0]]
         cand = make_candidate([(r, [1, 1])])
         shape_rewards([cand], "pg")
-        _, _, coeffs = flatten_for_update([cand], "pg")
+        _, _, coeffs, _ = flatten_for_update([cand], "pg")
         # summed − baseline: [1.0, 0.0] − 0.5
         np.testing.assert_allclose(coeffs, [0.5, -0.5])
+
+
+class TestRawRolloutAlignment:
+    """The engine's raw tokens / behavior logprobs / lengths must follow
+    EXACTLY the same top-k selection and flatten order as the text answers —
+    a desync silently trains on wrong importance ratios (no crash)."""
+
+    def _cand(self):
+        # 1 group of 4 candidates with distinct rewards and raw payloads
+        tokens = np.arange(4 * 3).reshape(4, 3).astype(np.int32)
+        logps = -np.arange(4 * 3).reshape(4, 3).astype(np.float32)
+        return {
+            "answers": [["a0", "a1", "a2", "a3"]],
+            "problem": [["p"] * 4],
+            "rewards": [np.asarray([0.1, 0.9, 0.5, 0.7], np.float32)],
+            "answer_tokens": [tokens],
+            "behavior_logps": [logps],
+            "gen_lengths": [np.asarray([3, 1, 2, 3], np.int32)],
+        }
+
+    def test_topk_selects_raw_fields_with_answers(self):
+        cand = self._cand()
+        topk_filter([cand], 2)
+        # top-2 by reward = candidates 3 (0.7) then 1 (0.9), argsort order
+        assert cand["answers"][0] == ["a3", "a1"]
+        np.testing.assert_array_equal(cand["answer_tokens"][0][:, 0], [9, 3])
+        np.testing.assert_array_equal(cand["behavior_logps"][0][:, 0], [-9.0, -3.0])
+        np.testing.assert_array_equal(cand["gen_lengths"][0], [3, 1])
+
+    def test_flatten_rows_stay_aligned(self):
+        cand = self._cand()
+        problems, answers, coeffs, raw = flatten_for_update([cand], "grpo")
+        assert raw is not None
+        assert answers == ["a0", "a1", "a2", "a3"]
+        np.testing.assert_array_equal(raw["answer_tokens"][1], [3, 4, 5])
+        np.testing.assert_array_equal(raw["behavior_logps"][2], [-6.0, -7.0, -8.0])
+        np.testing.assert_array_equal(raw["lengths"], [3, 1, 2, 3])
+
+    def test_raw_absent_returns_none(self):
+        cand = self._cand()
+        for k in ("answer_tokens", "behavior_logps", "gen_lengths"):
+            del cand[k]
+        _, _, _, raw = flatten_for_update([cand], "grpo")
+        assert raw is None

@@ -87,6 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "--continuous_batching. 0 = off")
     p.add_argument("--spec_ngram", type=int, default=2,
                    help="lookup n-gram size for --spec_draft")
+    p.add_argument("--clip_ratio", type=float, default=0.0,
+                   help="PPO-clip epsilon over engine-captured behavior "
+                        "logprobs (0 = reference-parity no-clip objective)")
     p.add_argument("--async_rollout", action="store_true",
                    help="pipeline generation of batch t+1 with the update on "
                         "batch t (one-step-off-policy; LlamaRL/PipelineRL-"
