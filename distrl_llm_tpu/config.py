@@ -199,6 +199,11 @@ class TrainConfig:
     # (async_rollout staleness; the reference's documented long-training
     # instability, README.md:91).
     clip_ratio: float = 0.0
+    # KL(π‖π_ref) penalty coefficient (the GRPO paper's regularizer; the
+    # reference never loads a reference model — SURVEY §3.6.2). π_ref is the
+    # FROZEN BASE, so this is LoRA-mode only (full_finetune would need a
+    # second resident tree) and costs one extra no-adapter forward.
+    kl_coeff: float = 0.0
     # per-update sample dump (the reference prints a problem/completion/
     # reward sample every update, distributed_trainer.py:297–299)
     print_samples: bool = True
@@ -262,6 +267,11 @@ class TrainConfig:
             raise ValueError(
                 "full_finetune has no adapter for lora_dropout to act on — "
                 "set lora_dropout=0"
+            )
+        if self.full_finetune and self.kl_coeff:
+            raise ValueError(
+                "kl_coeff uses the frozen base as the reference policy — "
+                "full_finetune has no frozen base (keep a LoRA run, or 0)"
             )
         if self.full_finetune and self.rollout_workers:
             # remote workers hold their own frozen base and receive only the

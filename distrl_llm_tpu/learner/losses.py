@@ -181,6 +181,29 @@ def grpo_clip_loss(
     return -(per_row * sample_mask).sum() / denom
 
 
+def kl_to_ref(
+    logprobs: jax.Array,  # [B, T] current-policy logprobs of sampled tokens
+    ref_logps: jax.Array,  # [B, T] reference-policy logprobs (stop-gradient)
+    answer_mask: jax.Array,  # [B, T]
+    sample_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Per-token KL(π‖π_ref) via the k3 estimator the GRPO paper uses
+    (unbiased, always ≥ 0): exp(ref − cur) − (ref − cur) − 1, masked-meaned
+    per row then averaged over real rows. The reference repo never loads a
+    reference model (SURVEY §3.6.2); with LoRA the frozen base IS π_ref, so
+    the penalty costs one extra no-adapter forward and no extra memory."""
+    # zero the exponent at masked pads BEFORE exp: pad positions hold
+    # garbage logprobs of the zero-filled token id, and exp(diff) overflows
+    # to inf past ~88 nats — inf·0 mask would then poison the mean with NaN
+    diff = (ref_logps - logprobs) * answer_mask
+    k3 = jnp.exp(diff) - diff - 1.0
+    per_row = _masked_mean_seq(k3, answer_mask)
+    if sample_mask is None:
+        return per_row.mean()
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    return (per_row * sample_mask).sum() / denom
+
+
 def entropy_bonus(logprobs_full: jax.Array, alpha: float) -> jax.Array:
     """Entropy regularizer over the vocab distribution — defined for API parity
     with the reference's compute_entropy_bonus (distributed_actor.py:266–281),

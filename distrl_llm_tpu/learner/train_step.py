@@ -32,7 +32,7 @@ import numpy as np
 import optax
 
 from distrl_llm_tpu.learner.losses import (
-    answer_logprobs, grpo_clip_loss, grpo_loss, pg_loss,
+    answer_logprobs, grpo_clip_loss, grpo_loss, kl_to_ref, pg_loss,
 )
 from distrl_llm_tpu.models.configs import ModelConfig
 
@@ -56,7 +56,7 @@ def _microbatch_loss(
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
     attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
     dropout_rng=None, logit_chunk: int = 0, train_mode: str = "lora",
-    clip_ratio: float = 0.0,
+    clip_ratio: float = 0.0, kl_coeff: float = 0.0,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight.
 
@@ -88,6 +88,18 @@ def _microbatch_loss(
         loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
         loss = loss_fn(
             logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask
+        )
+    if kl_coeff > 0.0:
+        # π_ref = the frozen base (no adapter): one extra stop-gradient
+        # forward; the GRPO paper's KL term the reference never wires up
+        ref_logps = jax.lax.stop_gradient(answer_logprobs(
+            base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
+            mb.answer_mask, lora=None, remat=remat,
+            attn_impl=attn_impl, attn_mesh=attn_mesh, logit_chunk=logit_chunk,
+        ))
+        loss = loss + kl_coeff * kl_to_ref(
+            logps, ref_logps, mb.answer_mask.astype(jnp.float32),
+            mb.sample_mask,
         )
 
     # The skip operates on COEFFS (baseline-subtracted rewards / advantages),
@@ -121,6 +133,7 @@ def make_train_step(
     logit_chunk: int = 0,  # chunked fused-CE logprobs (losses.answer_logprobs)
     train_mode: str = "lora",  # "lora" | "full" (arg0 is the whole param tree)
     clip_ratio: float = 0.0,  # >0: PPO-clip surrogate over engine logprobs
+    kl_coeff: float = 0.0,  # >0: + coeff·KL(π‖frozen base); LoRA mode only
 ) -> Callable:
     """Build the jitted train step.
 
@@ -130,6 +143,10 @@ def make_train_step(
     distributed_actor.py:387–389 cancels the /num_batches scaling).
     """
 
+    if train_mode == "full" and kl_coeff > 0.0:
+        # the config layer also rejects this; guard the mechanism too — in
+        # full mode there is no frozen base to serve as the reference policy
+        raise ValueError("kl_coeff requires train_mode='lora' (frozen base = ref)")
     loss_fn = partial(
         _microbatch_loss,
         cfg=cfg,
@@ -143,6 +160,7 @@ def make_train_step(
         logit_chunk=logit_chunk,
         train_mode=train_mode,
         clip_ratio=clip_ratio,
+        kl_coeff=kl_coeff,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,

@@ -215,6 +215,7 @@ class Trainer:
             logit_chunk=config.logprob_chunk,
             train_mode="full" if self._full else "lora",
             clip_ratio=config.clip_ratio,
+            kl_coeff=config.kl_coeff,
         )
 
         self.total_batch_steps = 0

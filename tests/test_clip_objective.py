@@ -185,3 +185,84 @@ class TestClipTrainerIntegration:
         batch = {"problem": ["q a", "q b"], "solution": ["A", "B"]}
         with pytest.raises(RuntimeError, match="logprobs"):
             trainer._train_batch(batch, episode=0)
+
+
+class TestKlToRef:
+    def test_zero_at_reference(self):
+        """KL is exactly 0 when the policy equals the reference."""
+        from distrl_llm_tpu.learner.losses import kl_to_ref
+
+        lp = jnp.asarray(np.random.default_rng(0).normal(size=(3, 5)), jnp.float32)
+        k = kl_to_ref(lp, lp, jnp.ones((3, 5), jnp.float32))
+        np.testing.assert_allclose(float(k), 0.0, atol=1e-7)
+
+    def test_positive_and_pulls_toward_ref(self):
+        from distrl_llm_tpu.learner.losses import kl_to_ref
+
+        cur = jnp.asarray([[-2.0]])
+        ref = jnp.asarray([[-1.0]])
+        mask = jnp.ones((1, 1), jnp.float32)
+        val = float(kl_to_ref(cur, ref, mask))
+        assert val > 0
+        # d/dcur of k3 = 1 − exp(ref−cur) < 0 here → gradient DESCENT raises
+        # cur toward ref
+        g = jax.grad(lambda c: kl_to_ref(c, ref, mask))(cur)
+        assert float(g[0, 0]) < 0
+
+    def test_zero_init_adapter_means_zero_kl_in_step(self):
+        """With a B=0-initialized LoRA, π == π_ref exactly, so the kl_coeff
+        term must not change the first step's loss at all."""
+        import optax
+
+        from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
+        from distrl_llm_tpu.models import init_lora_params, init_params
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)  # B = 0
+        rng = np.random.default_rng(2)
+        batch = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (2, 6)), jnp.int32),
+            prompt_mask=jnp.ones((2, 6), jnp.int32),
+            answer_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (2, 6)), jnp.int32),
+            answer_mask=jnp.ones((2, 6), jnp.int32),
+            coeffs=jnp.asarray([1.0, -0.5], jnp.float32),
+            sample_mask=jnp.ones((2,), jnp.float32),
+        )
+        opt = optax.sgd(1e-3)
+        losses = {}
+        for coeff in (0.0, 0.5):
+            step = make_train_step(
+                TINY, learner_type="grpo", optimizer=opt, lora_scale=0.5,
+                micro_size=2, donate=False, kl_coeff=coeff,
+            )
+            _, _, loss = step(lora, opt.init(lora), params, batch)
+            losses[coeff] = float(loss)
+        np.testing.assert_allclose(losses[0.5], losses[0.0], atol=1e-6)
+
+    def test_config_rejects_full_finetune(self):
+        from distrl_llm_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="kl_coeff"):
+            TrainConfig(full_finetune=True, kl_coeff=0.1)
+
+    def test_no_nan_when_policy_drifts_far_at_pads(self):
+        """Review regression: garbage pad-position logprobs with a large
+        positive ref−cur gap must not overflow exp into inf·0 = NaN."""
+        from distrl_llm_tpu.learner.losses import kl_to_ref
+
+        cur = jnp.asarray([[-1.0, -200.0]])  # pad position wildly off
+        ref = jnp.asarray([[-1.5, 0.0]])
+        mask = jnp.asarray([[1.0, 0.0]])  # second position is padding
+        val = float(kl_to_ref(cur, ref, mask))
+        assert np.isfinite(val)
+
+    def test_make_train_step_guards_full_mode(self):
+        import optax
+
+        from distrl_llm_tpu.learner.train_step import make_train_step
+
+        with pytest.raises(ValueError, match="kl_coeff"):
+            make_train_step(
+                TINY, learner_type="grpo", optimizer=optax.sgd(1e-3),
+                lora_scale=1.0, micro_size=2, train_mode="full", kl_coeff=0.1,
+            )

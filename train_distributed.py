@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip_ratio", type=float, default=0.0,
                    help="PPO-clip epsilon over engine-captured behavior "
                         "logprobs (0 = reference-parity no-clip objective)")
+    p.add_argument("--kl_coeff", type=float, default=0.0,
+                   help="KL(policy || frozen base) penalty coefficient (the "
+                        "GRPO paper's regularizer; LoRA mode only; 0 = "
+                        "reference parity)")
     p.add_argument("--async_rollout", action="store_true",
                    help="pipeline generation of batch t+1 with the update on "
                         "batch t (one-step-off-policy; LlamaRL/PipelineRL-"
