@@ -8,7 +8,7 @@ carries a chat template; falls back to a plain template for test tokenizers.
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Iterator, Mapping, Sequence
+from typing import Any, Iterator, Mapping, Sequence
 
 import numpy as np
 

@@ -9,7 +9,7 @@ function, tokenized to the same fixed shapes the real engine emits.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable
 
 import numpy as np
 

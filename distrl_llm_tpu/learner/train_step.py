@@ -24,7 +24,7 @@ N = num_micro · micro_size; rows beyond the real sample count are padding with
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, NamedTuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

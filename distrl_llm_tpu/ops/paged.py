@@ -22,8 +22,6 @@ distributed_actor.py:148–150). TPU-native design:
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
