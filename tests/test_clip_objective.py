@@ -266,3 +266,52 @@ class TestKlToRef:
                 TINY, learner_type="grpo", optimizer=optax.sgd(1e-3),
                 lora_scale=1.0, micro_size=2, train_mode="full", kl_coeff=0.1,
             )
+
+
+class TestClipKlLearningDynamics:
+    def test_reward_climbs_under_clip_and_kl(self):
+        """The full regularized objective (PPO-clip + KL-to-base) must still
+        LEARN end-to-end: the digit-fraction reward climbs over 60 steps
+        (slightly damped vs plain GRPO, as a KL anchor should). Deterministic
+        seeds; ~30 s."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.models.lora import lora_scale
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        def digit_reward(completions, solutions):
+            return np.asarray(
+                [(0.0, sum(1 for ch in c if "0" <= ch <= "9") / max(len(c), 1))
+                 for c in completions],
+                np.float32,
+            )
+
+        config = make_config(
+            learner="grpo", episodes=30, lr=3e-1, max_new_tokens=12,
+            batch_size=4, num_candidates=8, topk=8, train_batch_size=8,
+            max_lora_rank=8, lora_alpha=16, clip_ratio=0.2, kl_coeff=0.02,
+        )
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=config.max_prompt_tokens,
+            max_new_tokens=config.max_new_tokens,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, lora_scale=lora_scale(8, 16),
+            capture_logprobs=True,
+        )
+        sink = MemorySink()
+        trainer = Trainer(
+            train, test, digit_reward, config,
+            tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+            sink=sink,
+        )
+        trainer.train()
+        curve = [m["mean_accuracy_reward"] for _, m in sink.records
+                 if "mean_accuracy_reward" in m]
+        assert len(curve) == 60
+        early = float(np.mean(curve[:10]))
+        late = float(np.mean(curve[-10:]))
+        assert late > early * 1.1, f"no climb under clip+kl: {early} -> {late}"
