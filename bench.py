@@ -50,8 +50,15 @@ def _probe_backend(timeout_s: float) -> tuple[list | None, str | None]:
     def probe() -> None:
         try:
             import jax
+            import jax.numpy as jnp
 
-            result["devices"] = jax.devices()
+            devices = jax.devices()
+            # init alone succeeding while COMPUTE hangs is this tunnel's
+            # observed failure mode (devices() returns in ~25 s, a 1k matmul
+            # never does) — the probe must execute real work
+            x = jnp.ones((512, 512), jnp.float32)
+            (x @ x).block_until_ready()
+            result["devices"] = devices
         except Exception as e:  # noqa: BLE001 — recorded in the JSON line
             result["error"] = f"{type(e).__name__}: {e}"
 
@@ -59,7 +66,7 @@ def _probe_backend(timeout_s: float) -> tuple[list | None, str | None]:
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        return None, f"backend init timed out after {timeout_s:.0f}s"
+        return None, f"backend init/compute timed out after {timeout_s:.0f}s"
     if "error" in result:
         return None, result["error"]
     return result["devices"], None
