@@ -854,6 +854,22 @@ class Trainer:
             log.info("sample completion: %.400s", c["answers"][0][0])
             log.info("sample reward: %s", np.asarray(c["rewards"][0])[0])
 
+        # policy-sharpening observability: mean rollout-time logprob of the
+        # sampled tokens (only when the engine captures them — clip_ratio
+        # runs); a steadily rising value = the policy concentrating
+        extra_metrics: dict[str, float] = {}
+        if candidates and "behavior_logps" in candidates[0]:
+            tot, cnt = 0.0, 0
+            for cand in candidates:
+                for lp_g, len_g in zip(cand["behavior_logps"], cand["gen_lengths"]):
+                    lp = np.asarray(lp_g)
+                    ln = np.asarray(len_g)
+                    m = np.arange(lp.shape[1])[None, :] < ln[:, None]
+                    tot += float(lp[m].sum())
+                    cnt += int(m.sum())
+            if cnt:
+                extra_metrics["mean_behavior_logprob"] = tot / cnt
+
         # shaping: baselines / GRPO group-norm advantages + metric collection
         # (distributed_trainer.py:262–279), then top-k (:281–294)
         stats = shape_rewards(candidates, cfg.learner)
@@ -904,6 +920,7 @@ class Trainer:
             "total_batch_steps": self.total_batch_steps,
             "total_samples_processed": self.total_samples_processed,
         }
+        metrics.update(extra_metrics)
         metrics.update(timer.metrics())
         self.sink.log(metrics, step=self.total_batch_steps)
 

@@ -315,3 +315,37 @@ class TestClipKlLearningDynamics:
         early = float(np.mean(curve[:10]))
         late = float(np.mean(curve[-10:]))
         assert late > early * 1.1, f"no climb under clip+kl: {early} -> {late}"
+
+    def test_behavior_logprob_metric_logged(self):
+        """Rounds that capture logprobs log mean_behavior_logprob (policy-
+        sharpening observability); plain rounds don't emit the key."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        cfg = make_config(learner="grpo", clip_ratio=0.2)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=cfg.max_prompt_tokens,
+            max_new_tokens=cfg.max_new_tokens,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, capture_logprobs=True,
+        )
+        sink = MemorySink()
+
+        def r(completions, solutions):
+            return np.asarray(
+                [(0.0, 0.1 + (len(c) % 3) / 10.0) for c in completions], np.float32
+            )
+
+        trainer = Trainer(train, test, r, cfg, tokenizer=tok, engine=engine,
+                          base_params=params, model_cfg=TINY, sink=sink)
+        batch = {"problem": train["problem"][:4], "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        rec = [m for _, m in sink.records if "loss" in m][-1]
+        assert "mean_behavior_logprob" in rec
+        assert np.isfinite(rec["mean_behavior_logprob"])
+        assert rec["mean_behavior_logprob"] <= 0.0
