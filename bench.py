@@ -342,7 +342,12 @@ def main() -> int:
     # realized speculation: mean tokens emitted per slot per dispatched step
     # (1.0 = plain decode; > 1 = drafts being accepted)
     accept_rate = None
-    if result.steps_dispatched:
+    if getattr(result, "alive_slot_steps", None):
+        # divide by alive-slot-steps, not steps*slots: during the refill
+        # drain tail many slots are idle while steps still dispatch, and the
+        # constant-slot denominator understates realized acceptance
+        accept_rate = round(total_tokens / result.alive_slot_steps, 3)
+    elif result.steps_dispatched:
         slots = min(
             engine.max_concurrent_rows or n_prompts * n_cand,
             n_prompts * n_cand,

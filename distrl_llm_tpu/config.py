@@ -293,6 +293,15 @@ class TrainConfig:
                 "spec_draft (speculative decoding) requires "
                 "continuous_batching (the refill scheduler hosts it)"
             )
+        if self.clip_ratio > 0 and self.rollout_workers:
+            # clip needs per-token behavior logprobs captured at generation
+            # time; worker engines are built without capture_logprobs, so a
+            # remote-rollout clip run would only fail at the first training
+            # batch — reject it up front instead
+            raise ValueError(
+                "clip_ratio > 0 requires local rollout (behavior-logprob "
+                "capture is not plumbed to rollout_workers)"
+            )
         if self.rollout_workers and (
             self.kv_cache_quant != "none" or self.engine_impl != "dense"
         ):

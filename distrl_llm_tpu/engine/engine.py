@@ -52,6 +52,11 @@ class GenerationResult(NamedTuple):
     # measures the realized draft acceptance — the number to tune spec_draft
     # against on real hardware.
     steps_dispatched: int | None = None
+    # sum over dispatched steps of the number of ALIVE slots at that step
+    # (refill scheduler only). tokens/alive_slot_steps is the realized
+    # per-slot emission rate with the drain-tail idle slots excluded —
+    # steps_dispatched*slots systematically understates spec acceptance.
+    alive_slot_steps: int | None = None
     # RAW-model log-probabilities of the sampled tokens [B, n, T] f32 (the
     # behavior policy's logprobs — what vLLM returns as `logprobs`); the
     # PPO-clip learner objective ratios the current policy against these.

@@ -135,6 +135,7 @@ class SpecRefillState(NamedTuple):
     token sequence for the n-gram lookup."""
 
     step: jax.Array
+    alive_steps: jax.Array  # [] sum over steps of alive-slot count
     out: jax.Array  # [total, T]
     logps_buf: jax.Array  # [total, T] behavior logprobs (raw log_softmax)
     lengths_buf: jax.Array  # [total]

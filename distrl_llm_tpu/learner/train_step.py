@@ -252,7 +252,10 @@ def prepare_update_batch(
         # mask from real generated lengths: engine pads after EOS with a pad
         # token whose id may be a REAL vocab id, so the text-derived mask
         # cannot be reused
-        lengths = np.asarray(raw_rollout["lengths"], np.int32)
+        # defensive clamp: engine lengths are bounded by the engine's token
+        # buffer (t_eng), but if that invariant ever broke, an unclamped
+        # length would unmask positions holding zero-filled ids / logprobs
+        lengths = np.minimum(np.asarray(raw_rollout["lengths"], np.int32), width)
         answer_mask = (
             np.arange(max_new_tokens)[None, :] < lengths[:, None]
         ).astype(np.int32)

@@ -24,6 +24,7 @@ TPU-native replacement for the reference Trainer (distributed_trainer.py:13–41
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import os
 from typing import Any, Callable, Mapping, Sequence
@@ -578,6 +579,12 @@ class Trainer:
                 res_a.steps_dispatched + res_l.steps_dispatched
                 if both_steps else None
             ),
+            alive_slot_steps=(
+                res_a.alive_slot_steps + res_l.alive_slot_steps
+                if res_a.alive_slot_steps is not None
+                and res_l.alive_slot_steps is not None
+                else None
+            ),
             logprobs=(
                 np.concatenate([res_a.logprobs, res_l.logprobs], axis=0)
                 if both_logps else None
@@ -801,6 +808,12 @@ class Trainer:
                     gen_future = next_future
                     self.batch_in_episode = bi + 1
                     if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
+                        if gen_future is not None:
+                            # drain the in-flight next-batch generation first:
+                            # running eval concurrently would hold two decode
+                            # states/KV caches at once (HBM pressure on tight
+                            # configs) and skew the eval timing numbers
+                            concurrent.futures.wait([gen_future])
                         self.evaluate()
                     if cfg.save_every and self.total_batch_steps % cfg.save_every == 0:
                         self.save_checkpoint()
