@@ -269,6 +269,10 @@ def main() -> int:
         if os.environ.get("BENCH_SPEC_DRAFT"):
             # n-gram speculative decoding (needs the refill scheduler + cap)
             engine_kwargs["spec_draft"] = int(os.environ["BENCH_SPEC_DRAFT"])
+        if os.environ.get("BENCH_KV_PAGES"):
+            # refill decode-page pool budget (--actor_gpu_usage equivalent);
+            # exercises page-gated admission + preempt-by-recompute
+            engine_kwargs["max_kv_pages"] = int(os.environ["BENCH_KV_PAGES"])
     if os.environ.get("BENCH_MAX_CONCURRENT"):
         engine_kwargs["max_concurrent_rows"] = int(os.environ["BENCH_MAX_CONCURRENT"])
     # BENCH_EOS_RATE: approximate per-step stop probability. Random-init
@@ -378,6 +382,7 @@ def main() -> int:
         "chips": n_chips,
         "flops_per_token_gflop": round(flops_per_token / 1e9, 6),
         "peak_tflops": peak_tflops,
+        "pool_stats": getattr(engine, "last_pool_stats", None),
         "baseline_note": "baseline 1500 tok/s/GPU derived from reference's ~2h/100-step "
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "
                          "model is recorded in 'model'",

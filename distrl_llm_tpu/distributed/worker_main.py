@@ -35,7 +35,8 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
                  engine_impl: str = "dense", kv_quant: str = "none",
                  max_concurrent: int = 0, scheduler: str = "waves",
-                 spec_draft: int = 0) -> None:
+                 spec_draft: int = 0, gpu_usage: float = 0.0,
+                 budget_batch: int = 0) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -73,6 +74,20 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         kwargs["scheduler"] = scheduler
         if spec_draft:
             kwargs["spec_draft"] = spec_draft
+        if gpu_usage > 0:
+            # --actor-gpu-usage → KV page budget, same contract as the
+            # trainer's local engine (engine/budget.py)
+            from distrl_llm_tpu.engine.budget import kv_pool_pages, tree_bytes
+            from distrl_llm_tpu.ops.paged import DEFAULT_PAGE_SIZE
+
+            kwargs["max_kv_pages"] = kv_pool_pages(
+                cfg, gpu_usage=gpu_usage, param_bytes=tree_bytes(params),
+                batch_prompts=budget_batch or 8,
+                max_prompt_tokens=max_prompt_tokens,
+                max_new_tokens=max_new_tokens,
+                page_size=DEFAULT_PAGE_SIZE, kv_quant=kv_quant,
+                spec_draft=spec_draft,
+            )
     else:
         engine_cls = GenerationEngine
     if max_concurrent:
@@ -176,6 +191,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--spec-draft", type=int, default=0,
                         help="n-gram speculative decoding draft length "
                              "(requires --scheduler refill)")
+    parser.add_argument("--actor-gpu-usage", type=float, default=0.0,
+                        help="HBM fraction for weights+KV (vLLM "
+                             "gpu_memory_utilization); sizes the paged "
+                             "engine's KV page pool. 0 = worst-case pool")
+    parser.add_argument("--budget-batch", type=int, default=0,
+                        help="prompts per round assumed by the page-budget "
+                             "math (shared prompt-page region)")
     args = parser.parse_args(argv)
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
@@ -194,6 +216,7 @@ def main(argv: list[str] | None = None) -> None:
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
             max_concurrent=args.max_concurrent_sequences,
             scheduler=args.scheduler, spec_draft=args.spec_draft,
+            gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer

@@ -1,0 +1,105 @@
+"""HBM budget → KV page-pool sizing (the ``--actor_gpu_usage`` contract).
+
+The reference passes ``--actor_gpu_usage`` straight to vLLM's
+``gpu_memory_utilization`` (train_distributed.py:34-35), which sizes the KV
+block pool as: usable = usage × device_memory − weights − activation
+workspace, pool_blocks = usable / block_bytes. This module is the TPU-native
+equivalent: it converts the same fraction into ``max_kv_pages`` for the paged
+engine's refill pool (engine/page_pool.py), measured against real HBM when a
+TPU is attached and a v5e-sized fallback otherwise.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# v5e/v5p chips carry 16 GiB; used only when memory_stats() is unavailable
+# (CPU test runs, older runtimes)
+DEFAULT_HBM_BYTES = 16 * 1024**3
+
+# slice of the budget held back for XLA workspace, decode activations, and
+# the donated-state double buffers (vLLM hides the analogous costs inside its
+# profiling "dummy run"; a fixed fraction is the static-shape equivalent)
+ACTIVATION_RESERVE = 0.08
+
+
+def device_hbm_bytes(device=None) -> int:
+    """Accelerator memory capacity, from the runtime when it reports one."""
+    try:
+        dev = device or jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — CPU/interpreted backends
+        pass
+    return DEFAULT_HBM_BYTES
+
+
+def tree_bytes(params) -> int:
+    """Total bytes of a host/device param tree (quantized containers count
+    weight + scales — whatever the leaves actually store)."""
+    return sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+        if hasattr(leaf, "nbytes")
+    )
+
+
+def page_bytes(model_cfg, page_size: int, kv_quant: str = "none") -> int:
+    """HBM bytes one KV page costs across ALL layers (k + v)."""
+    per_layer_one = model_cfg.num_kv_heads * page_size * model_cfg.head_dim
+    if kv_quant == "int8":
+        # int8 payload + f32 per-token absmax scales [K, P, ps, 1]
+        one = per_layer_one * 1 + model_cfg.num_kv_heads * page_size * 4
+    else:
+        one = per_layer_one * 2  # bf16
+    return one * 2 * model_cfg.num_layers
+
+
+def kv_pool_pages(
+    model_cfg,
+    *,
+    gpu_usage: float,
+    param_bytes: int,
+    batch_prompts: int,
+    max_prompt_tokens: int,
+    max_new_tokens: int,
+    page_size: int,
+    kv_quant: str = "none",
+    spec_draft: int = 0,
+    hbm_bytes: int | None = None,
+) -> int:
+    """Pages available to the refill decode pool under ``gpu_usage``.
+
+    Subtracts, in order: the (1 - usage) exclusion the knob demands, the
+    activation reserve, resident weights, and the SHARED prompt page region
+    (batch_prompts × prompt_pages — prefill owns those regardless of the
+    pool). Clamped below at the single-sequence minimum the engine requires,
+    so a too-small budget degrades to serial decoding instead of refusing to
+    run (with a warning naming the shortfall)."""
+    from distrl_llm_tpu.ops.paged import pages_per_seq
+
+    hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    pb = page_bytes(model_cfg, page_size, kv_quant)
+    prompt_pages = pages_per_seq(max_prompt_tokens, page_size)
+    shared_bytes = batch_prompts * prompt_pages * pb
+    budget = int(
+        hbm * (gpu_usage - ACTIVATION_RESERVE) - param_bytes - shared_bytes
+    )
+    pool = budget // pb if budget > 0 else 0
+    private_pages = 1 + pages_per_seq(max_new_tokens + max(spec_draft, 0),
+                                      page_size)
+    floor = 1 + private_pages
+    if pool < floor:
+        log.warning(
+            "actor_gpu_usage=%.2f leaves %d KV pages (< single-sequence "
+            "minimum %d) after %.2f GiB weights on %.2f GiB HBM; clamping — "
+            "decode will serialize",
+            gpu_usage, pool, floor, param_bytes / 1024**3, hbm / 1024**3,
+        )
+        return floor
+    return pool

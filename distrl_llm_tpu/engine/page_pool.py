@@ -1,0 +1,136 @@
+"""Host-side KV page allocator for the refill scheduler's page budget.
+
+vLLM sizes its KV block pool from ``gpu_memory_utilization`` and admits /
+preempts sequences against that budget (the knob the reference tunes as
+``--actor_gpu_usage``, train_distributed.py:34-35). On TPU the page ARRAYS
+must be shape-static, but which pages a slot owns is data — so the pool array
+is allocated once at the budgeted size and this class tracks ownership and
+builds the [R, width] page-table rows on the host. The device only ever sees
+the table (a tiny int32 array re-shipped per decode dispatch via
+``state._replace``); allocation, admission, and preemption-victim choice are
+plain Python against a free list.
+
+Layout contract (shared with paged_engine):
+* shared prompt pages occupy ids [0, b·prompt_pages) — written once by
+  prefill, never owned by the pool;
+* pool pages occupy [first_page, first_page + n_pages); page ``first_page``
+  is the SCRATCH page: every dead slot's table row points all columns at it,
+  so dead slots' garbage decode writes land somewhere harmless that no live
+  row ever reads;
+* a slot's table row is: shared full prompt pages below ``full`` columns,
+  then its owned pages (partial prompt page first), trailing columns clamped
+  to the last owned page (the attention gather reads the whole width; clamped
+  columns are beyond every read window).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PagePool:
+    """Free-list page allocator + page-table builder (host-side, numpy)."""
+
+    def __init__(
+        self,
+        *,
+        first_page: int,  # == b·prompt_pages (pool starts after shared region)
+        n_pages: int,  # pool size INCLUDING the scratch page
+        r_slots: int,
+        width: int,  # table columns (prompt_pages + private_pages)
+        page_size: int,
+        prompt_pages: int,
+    ):
+        if n_pages < 2:
+            raise ValueError(f"pool needs >= 2 pages (scratch + 1), got {n_pages}")
+        self.scratch = first_page
+        self.page_size = page_size
+        self.prompt_pages = prompt_pages
+        self.n_pages = n_pages
+        # LIFO free list: recently-released pages are re-granted first (their
+        # tiles are warm in whatever cache level still holds them)
+        self.free: list[int] = list(
+            range(first_page + n_pages - 1, first_page, -1)
+        )
+        self.owned: list[list[int]] = [[] for _ in range(r_slots)]
+        self.full = np.zeros(r_slots, np.int32)  # shared full pages per slot
+        self.table = np.full((r_slots, width), self.scratch, np.int32)
+        self.peak_pages_used = 0
+        self.preemptions = 0
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self.free)
+
+    @property
+    def used_pages(self) -> int:
+        return sum(len(o) for o in self.owned)
+
+    def check_invariants(self) -> None:
+        """free + owned must tile the pool exactly, with no page owned twice
+        (test hook; O(pool) but pools are small on the host)."""
+        all_pages = sorted(self.free + [p for o in self.owned for p in o])
+        expected = list(range(self.scratch + 1, self.scratch + self.n_pages))
+        assert all_pages == expected, (
+            f"pool accounting broken: {len(all_pages)} tracked vs "
+            f"{len(expected)} expected"
+        )
+
+    # -- sizing helpers ----------------------------------------------------
+
+    def pages_to_cover(self, slot: int, last_position: int) -> int:
+        """Owned pages required for the slot's writes through
+        ``last_position`` (positions below full·ps live in shared pages)."""
+        return max(last_position // self.page_size - int(self.full[slot]) + 1, 1)
+
+    # -- transitions -------------------------------------------------------
+
+    def admit(
+        self, slot: int, prompt_idx: int, real_len: int, last_position: int
+    ) -> bool:
+        """Claim pages for an admission covering writes through
+        ``last_position``; build the slot's table row. False (and no state
+        change) when the free list can't cover it."""
+        assert not self.owned[slot], f"slot {slot} admitted while owning pages"
+        full = real_len // self.page_size
+        self.full[slot] = full
+        need = max(last_position // self.page_size - full + 1, 1)
+        if need > len(self.free):
+            return False
+        grant = [self.free.pop() for _ in range(need)]
+        self.owned[slot] = grant
+        row = self.table[slot]
+        row[:] = self.scratch
+        row[:full] = prompt_idx * self.prompt_pages + np.arange(full)
+        row[full:full + need] = grant
+        row[full + need:] = grant[-1]
+        self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+        return True
+
+    def ensure(self, slot: int, last_position: int) -> int:
+        """Grow the slot's grant to cover writes through ``last_position``.
+        Returns the number of pages still MISSING (0 = fully granted)."""
+        owned = self.owned[slot]
+        assert owned, f"ensure() on unowned slot {slot}"
+        need = self.pages_to_cover(slot, last_position)
+        missing = need - len(owned)
+        take = min(max(missing, 0), len(self.free))
+        if take:
+            full = int(self.full[slot])
+            grant = [self.free.pop() for _ in range(take)]
+            row = self.table[slot]
+            row[full + len(owned):full + len(owned) + take] = grant
+            owned.extend(grant)
+            row[full + len(owned):] = owned[-1]
+            self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+        return max(missing - take, 0)
+
+    def release(self, slot: int) -> None:
+        """Return the slot's pages to the free list; redirect its table row
+        to scratch (the slot's post-mortem garbage writes must not land in
+        pages another slot may be granted)."""
+        self.free.extend(reversed(self.owned[slot]))
+        self.owned[slot] = []
+        self.table[slot, :] = self.scratch

@@ -103,6 +103,7 @@ def _layer(
     page_size: int = 0,
     paged_impl: str = "auto",
     paged_verify: bool = False,  # S>1 per-row draft-block decode (spec decode)
+    paged_chunked: bool = False,  # S>1 continuation (chunked) prefill
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,  # per-layer key (training only)
 ):
@@ -133,6 +134,30 @@ def _layer(
                 q[:, 0], cache_k, cache_v, paged_lengths + 1, page_indices,
                 impl=paged_impl,
             )[:, None]
+        elif paged_chunked:
+            # continuation (chunked) prefill: S tokens extend each row's
+            # sequence at its own per-row offset (recompute after preemption —
+            # vLLM's chunked prefill). KV is written to pages first (padding
+            # positions dropped via ``valid``), then attention runs over the
+            # row's dense-gathered context with exact per-position causality.
+            from distrl_llm_tpu.ops.paged import (
+                chunked_context_attention, gather_pages_dense,
+            )
+
+            q_valid = key_valid[:, :s] if key_valid is not None else (
+                jnp.ones((b, s), jnp.int32)
+            )
+            cache_k = write_tokens_to_pages(
+                cache_k, k, paged_lengths, page_indices, page_size,
+                valid=q_valid > 0)
+            cache_v = write_tokens_to_pages(
+                cache_v, v, paged_lengths, page_indices, page_size,
+                valid=q_valid > 0)
+            att = chunked_context_attention(
+                q, gather_pages_dense(cache_k, page_indices),
+                gather_pages_dense(cache_v, page_indices),
+                paged_lengths, q_valid,
+            )
         elif paged_verify:
             # speculative-decode verify: S draft tokens extend each row's
             # sequence at its own per-row offset. QKV/MLP batch over the
@@ -221,6 +246,7 @@ def forward(
     page_size: int = 0,  # static; paged-cache mode (ops/paged.py)
     paged_impl: str = "auto",
     paged_verify: bool = False,  # speculative-decode draft-block verify
+    paged_chunked: bool = False,  # continuation (chunked) prefill over pages
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
     skip_lm_head: bool = False,  # return final-norm hidden states, not logits
@@ -272,7 +298,8 @@ def forward(
     # DCE'd under jit, but eager/non-jit callers would pay it)
     needs_dense_mask = (
         (kv_cache is not None and not paged)
-        or (paged and s > 1 and attn_impl not in ("ring", "ulysses", "flash", "splash"))
+        or (paged and s > 1 and not paged_chunked
+            and attn_impl not in ("ring", "ulysses", "flash", "splash"))
         or (kv_cache is None and attn_impl not in ("ring", "ulysses", "flash", "splash"))
     )
     mask = (
@@ -298,6 +325,7 @@ def forward(
         page_size=page_size,
         paged_impl=paged_impl,
         paged_verify=paged_verify,
+        paged_chunked=paged_chunked,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
     )
 

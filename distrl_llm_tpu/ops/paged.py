@@ -146,24 +146,34 @@ def write_token_to_pages(
     lengths: jax.Array,  # [B] current token counts (write position)
     page_indices: jax.Array,  # [B, pps]
     page_size: int,
+    valid: jax.Array | None = None,  # [B] bool; False rows write nothing
 ):
-    """Scatter one decoded token's KV into each row's current page slot."""
+    """Scatter one decoded token's KV into each row's current page slot.
+
+    With ``valid``, rows marked False are DROPPED (out-of-range page index +
+    ``mode="drop"``) instead of written — the continuation-prefill path uses
+    this so padding positions never touch pages the row doesn't own."""
     b = new_kv.shape[0]
     rows = jnp.arange(b)
     page = page_indices[rows, lengths // page_size]  # [B]
     slot = lengths % page_size  # [B]
+    raw = pages.weight if is_quantized_pages(pages) else pages
+    if valid is not None:
+        page = jnp.where(valid, page, raw.shape[1])  # OOB → dropped
     tok = new_kv.transpose(1, 0, 2)  # [K, B, hd]
     if is_quantized_pages(pages):
         qu = _quant_utils()
         scales = qu.get_quantization_scales(tok)  # [K, B, 1]
-        weight = pages.weight.at[:, page, slot].set(qu.to_int8(tok, scales))
+        weight = pages.weight.at[:, page, slot].set(
+            qu.to_int8(tok, scales), mode="drop"
+        )
         return type(pages)(
             weight=weight,
             scales=pages.scales.at[:, page, slot].set(
-                scales.astype(pages.scales.dtype)
+                scales.astype(pages.scales.dtype), mode="drop"
             ),
         )
-    return pages.at[:, page, slot].set(tok.astype(pages.dtype))
+    return pages.at[:, page, slot].set(tok.astype(pages.dtype), mode="drop")
 
 
 def write_tokens_to_pages(
@@ -172,6 +182,7 @@ def write_tokens_to_pages(
     lengths: jax.Array,  # [B] current token counts (first write position)
     page_indices: jax.Array,  # [B, pps]
     page_size: int,
+    valid: jax.Array | None = None,  # [B, D] bool per-token validity
 ):
     """Scatter D consecutive tokens' KV per row (speculative-decode verify
     writes the whole draft block at once; D is small and static, so the loop
@@ -179,9 +190,57 @@ def write_tokens_to_pages(
     d = new_kv.shape[1]
     for i in range(d):
         pages = write_token_to_pages(
-            pages, new_kv[:, i], lengths + i, page_indices, page_size
+            pages, new_kv[:, i], lengths + i, page_indices, page_size,
+            valid=valid[:, i] if valid is not None else None,
         )
     return pages
+
+
+def gather_pages_dense(pages, page_indices: jax.Array) -> jax.Array:
+    """Gather each row's pages into a dense position-ordered context
+    [B, width·ps, K, hd] f32 (page-table column t covers positions
+    [t·ps, (t+1)·ps), so the concatenation is position order). Quantized
+    pools dequantize AFTER the gather — only the rows' own pages."""
+    if is_quantized_pages(pages):
+        w = pages.weight[:, page_indices]
+        s_ = pages.scales[:, page_indices]
+        dense = _quant_utils().from_int8(w, s_, dtype=jnp.float32)
+    else:
+        dense = pages[:, page_indices].astype(jnp.float32)
+    # [K, B, width, ps, hd] → [B, width·ps, K, hd]
+    kh, b, width, ps, hd = dense.shape
+    return dense.transpose(1, 2, 3, 0, 4).reshape(b, width * ps, kh, hd)
+
+
+def chunked_context_attention(
+    q: jax.Array,  # [B, S, H, hd] — S continuation queries per row
+    ctx_k: jax.Array,  # [B, Sk, K, hd] dense-gathered context (f32)
+    ctx_v: jax.Array,
+    lengths: jax.Array,  # [B] resident tokens BEFORE the continuation block
+    q_valid: jax.Array,  # [B, S] bool/int — which continuation tokens are real
+) -> jax.Array:
+    """Attention for chunked (continuation) prefill over a paged cache: query
+    i at global position lengths+i attends context positions j <= lengths+i.
+    The context already contains the continuation block's own KV (written to
+    pages before the gather), so this is exact causality — vLLM's chunked
+    prefill, dense-gather edition (ops are plain einsums; XLA fuses)."""
+    b, s, h, hd = q.shape
+    kh = ctx_k.shape[2]
+    g = h // kh
+    sk = ctx_k.shape[1]
+    scale = hd**-0.5
+    qg = q.reshape(b, s, kh, g, hd).astype(jnp.float32)
+    logits = jnp.einsum("bskgd,bjkd->bkgsj", qg, ctx_k) * scale  # [B,K,g,S,Sk]
+    jpos = jnp.arange(sk)[None, None, :]  # [1, 1, Sk]
+    qpos = lengths[:, None, None] + jnp.arange(s)[None, :, None]  # [B, S, 1]
+    causal = jpos <= qpos  # [B, S, Sk]
+    logits = jnp.where(causal[:, None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgsj,bjkd->bskgd", probs, ctx_v)  # [B, S, K, g, hd]
+    # invalid (padding) queries produce garbage rows — zero them so NaNs
+    # can't propagate into downstream reductions
+    out = jnp.where(q_valid[:, :, None, None, None] > 0, out, 0.0)
+    return out.reshape(b, s, h, hd).astype(q.dtype)
 
 
 def paged_attention_reference(

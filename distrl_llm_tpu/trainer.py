@@ -353,6 +353,25 @@ class Trainer:
                 else GenerationEngine
             )
             engine_kwargs = engine_kwargs_from_config(config)
+            if config.engine_impl == "paged":
+                # --actor_gpu_usage → KV page budget (the reference's vLLM
+                # gpu_memory_utilization contract, train_distributed.py:34-35)
+                from distrl_llm_tpu.engine.budget import kv_pool_pages, tree_bytes
+                from distrl_llm_tpu.ops.paged import DEFAULT_PAGE_SIZE
+
+                engine_kwargs["max_kv_pages"] = kv_pool_pages(
+                    model_cfg,
+                    gpu_usage=config.actor_gpu_usage,
+                    param_bytes=tree_bytes(params),
+                    batch_prompts=config.batch_size,
+                    max_prompt_tokens=config.max_prompt_tokens,
+                    max_new_tokens=config.max_new_tokens,
+                    page_size=DEFAULT_PAGE_SIZE,
+                    kv_quant=config.kv_cache_quant,
+                    spec_draft=(
+                        config.spec_draft if config.continuous_batching else 0
+                    ),
+                )
             engine = engine_cls(
                 model_cfg,
                 max_prompt_tokens=config.max_prompt_tokens,

@@ -1,0 +1,255 @@
+"""Page-budgeted admission + preempt-by-recompute for the refill scheduler.
+
+The reference tunes vLLM's ``gpu_memory_utilization`` via ``--actor_gpu_usage``
+(train_distributed.py:34-35); vLLM sizes its KV block pool from it and admits /
+preempts sequences against that budget. These tests pin the TPU-native
+equivalent (engine/page_pool.py + the paged engine's grant/preempt host loop):
+
+* a budgeted pool yields IDENTICAL greedy outputs to the worst-case pool —
+  preempt-by-recompute (continuation chunked prefill) must reproduce the
+  evicted prefix's KV exactly, or the greedy continuation diverges;
+* admission stalls (never crashes) when the pool is tight, down to fully
+  serial execution at the single-sequence minimum;
+* pool accounting invariants hold under fuzzed EOS patterns;
+* captured behavior logprobs survive preemption.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine.page_pool import PagePool
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+from distrl_llm_tpu.models import TINY, init_params
+
+
+PAGE = 8
+
+
+def _make_engine(max_new=24, rows=4, pool=0, spec=0, capture=False):
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=16, max_new_tokens=max_new,
+        eos_token_ids=[1], pad_token_id=0, page_size=PAGE,
+        max_concurrent_rows=rows, scheduler="refill",
+        max_kv_pages=pool, spec_draft=spec,
+        capture_logprobs=capture, decode_chunk=4,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+
+
+def _prompts(b=6, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, TINY.vocab_size, size=(b, 16)).astype(np.int32)
+    mask = np.ones((b, 16), np.int32)
+    # ragged real lengths so full/partial prompt pages vary per row
+    for i in range(b):
+        pad = rng.integers(0, 9)
+        ids[i, :pad] = 0
+        mask[i, :pad] = 0
+    return ids, mask
+
+
+def _greedy(max_tokens=24, n=2):
+    # temperature 0 → rng-independent decoding: recompute after preemption
+    # must reproduce the same KV or the argmax continuation diverges
+    return SamplingConfig(max_tokens=max_tokens, temperature=0.0, top_p=1.0, n=n)
+
+
+class TestPagePool:
+    def test_admit_release_roundtrip(self):
+        pool = PagePool(first_page=10, n_pages=8, r_slots=2, width=6,
+                        page_size=PAGE, prompt_pages=2)
+        assert pool.free_pages == 7  # scratch excluded
+        assert pool.admit(0, prompt_idx=1, real_len=12, last_position=20)
+        # full = 12//8 = 1 shared page; cover through pos 20 → pages 1..2 → 2 owned
+        assert len(pool.owned[0]) == 2
+        assert pool.table[0, 0] == 1 * 2  # shared page of prompt 1
+        assert pool.table[0, 1] == pool.owned[0][0]
+        assert pool.table[0, 2] == pool.owned[0][1]
+        assert (pool.table[0, 3:] == pool.owned[0][1]).all()  # trailing clamp
+        pool.check_invariants()
+        pool.release(0)
+        assert pool.free_pages == 7
+        assert (pool.table[0] == pool.scratch).all()
+        pool.check_invariants()
+
+    def test_admit_fails_clean_when_dry(self):
+        pool = PagePool(first_page=0, n_pages=3, r_slots=2, width=8,
+                        page_size=PAGE, prompt_pages=2)
+        assert pool.admit(0, 0, real_len=8, last_position=17)  # needs 2 pages
+        before = (pool.free_pages, pool.table[1].copy())
+        assert not pool.admit(1, 0, real_len=8, last_position=17)
+        assert pool.free_pages == before[0]
+        assert (pool.table[1] == before[1]).all()
+
+    def test_ensure_grows_and_reports_missing(self):
+        pool = PagePool(first_page=0, n_pages=4, r_slots=1, width=8,
+                        page_size=PAGE, prompt_pages=2)
+        assert pool.admit(0, 0, real_len=8, last_position=8)  # 1 page
+        assert pool.ensure(0, last_position=23) == 0  # grow to 2 pages
+        assert len(pool.owned[0]) == 2
+        assert pool.ensure(0, last_position=100) > 0  # pool too small
+        pool.check_invariants()
+
+
+class TestBudgetMath:
+    """--actor_gpu_usage → pool pages (engine/budget.py): the reference's
+    vLLM gpu_memory_utilization contract, train_distributed.py:34-35."""
+
+    def test_pool_scales_with_usage_and_subtracts_weights(self):
+        from distrl_llm_tpu.engine.budget import kv_pool_pages, page_bytes
+
+        pb = page_bytes(TINY, page_size=128)
+        common = dict(
+            param_bytes=4 * 1024**2, batch_prompts=8,
+            max_prompt_tokens=256, max_new_tokens=512, page_size=128,
+            hbm_bytes=1024**3,
+        )
+        lo = kv_pool_pages(TINY, gpu_usage=0.5, **common)
+        hi = kv_pool_pages(TINY, gpu_usage=0.9, **common)
+        assert hi > lo > 0
+        # the delta is exactly 0.4 HBM worth of pages
+        assert abs((hi - lo) - int(0.4 * 1024**3) // pb) <= 1
+
+    def test_int8_kv_doubles_pool(self):
+        from distrl_llm_tpu.engine.budget import kv_pool_pages
+
+        common = dict(
+            gpu_usage=0.9, param_bytes=0, batch_prompts=0,
+            max_prompt_tokens=256, max_new_tokens=512, page_size=128,
+            hbm_bytes=1024**3,
+        )
+        from distrl_llm_tpu.engine.budget import page_bytes
+
+        bf16 = kv_pool_pages(TINY, **common)
+        int8 = kv_pool_pages(TINY, kv_quant="int8", **common)
+        # pool ratio tracks the per-page byte ratio (2·hd vs hd + 4 scale
+        # bytes per token — TINY's small head_dim keeps this below 2×)
+        expected = page_bytes(TINY, 128) / page_bytes(TINY, 128, "int8")
+        assert expected > 1.2
+        assert abs(int8 / bf16 - expected) < 0.05
+
+    def test_too_small_budget_clamps_to_single_sequence(self):
+        from distrl_llm_tpu.engine.budget import kv_pool_pages
+        from distrl_llm_tpu.ops.paged import pages_per_seq
+
+        pool = kv_pool_pages(
+            TINY, gpu_usage=0.5, param_bytes=10 * 1024**3, batch_prompts=8,
+            max_prompt_tokens=256, max_new_tokens=512, page_size=128,
+            hbm_bytes=1024**3,
+        )
+        assert pool == 1 + 1 + pages_per_seq(512, 128)
+
+    def test_trainer_wiring_passes_pool_to_engine(self):
+        """from_pretrained must hand the computed budget to the engine (the
+        knob is only live if this plumbing exists)."""
+        import inspect
+
+        from distrl_llm_tpu import trainer as trainer_mod
+
+        src = inspect.getsource(trainer_mod.Trainer.from_pretrained)
+        assert "kv_pool_pages" in src and "max_kv_pages" in src
+        assert "actor_gpu_usage" in src
+
+
+class TestBudgetedRefill:
+    def test_budgeted_greedy_matches_worst_case(self, tiny_params):
+        """The load-bearing test: a pool tight enough to force preemptions
+        must still produce bit-identical greedy rollouts (recompute parity)."""
+        ids, mask = _prompts(b=6)
+        sampling = _greedy(max_tokens=24, n=2)
+        ref_eng = _make_engine(max_new=24, rows=4, pool=0)
+        ref = ref_eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(1))
+        assert not ref_eng.last_pool_stats["budgeted"]
+
+        # worst case would be 1 + 4*(1+3)=17 pool pages; squeeze hard
+        eng = _make_engine(max_new=24, rows=4, pool=9)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(1))
+        stats = eng.last_pool_stats
+        assert stats["budgeted"] and stats["pool_pages"] == 9
+        assert stats["peak_pages_used"] <= 8
+        assert stats["preemptions"] > 0, "pool not tight enough to exercise preemption"
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+
+    def test_preemption_fires_and_is_transparent(self, tiny_params):
+        """At the single-sequence minimum pool every admission beyond the
+        first must stall or preempt; outputs still match worst case."""
+        ids, mask = _prompts(b=4, seed=3)
+        sampling = _greedy(max_tokens=24, n=2)
+        ref = _make_engine(max_new=24, rows=4, pool=0).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(2))
+        # minimum viable: scratch + one private region (1 + 1+ceil(24/8)=5)
+        eng = _make_engine(max_new=24, rows=4, pool=5)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+
+    def test_pool_below_single_sequence_rejected(self):
+        with pytest.raises(ValueError, match="cannot fit one sequence"):
+            _make_engine(max_new=24, pool=4)
+
+    def test_logprobs_survive_preemption(self, tiny_params):
+        ids, mask = _prompts(b=4, seed=5)
+        sampling = _greedy(max_tokens=16, n=2)
+        ref = _make_engine(max_new=16, rows=4, pool=0, capture=True).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+        eng = _make_engine(max_new=16, rows=4, pool=4)  # 1 + 1+ceil(16/8)=4
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+
+        eng_c = _make_engine(max_new=16, rows=4, pool=4, capture=True)
+        res_c = eng_c.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+        np.testing.assert_array_equal(res_c.tokens, ref.tokens)
+        # prefix logprobs recorded pre-preemption must survive the evict +
+        # recompute round-trip (they live in the candidate-indexed buffer)
+        valid = (
+            np.arange(16)[None, None, :] < res_c.lengths[..., None]
+        )
+        np.testing.assert_allclose(
+            np.where(valid, res_c.logprobs, 0.0),
+            np.where(valid, ref.logprobs, 0.0),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_fuzzed_pools_all_complete(self, tiny_params):
+        """Random tight pool sizes: every candidate finishes, lengths are
+        within bounds, and the recorded peak never exceeds the budget."""
+        ids, mask = _prompts(b=5, seed=7)
+        sampling = _greedy(max_tokens=16, n=2)
+        ref = _make_engine(max_new=16, rows=5, pool=0).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(6))
+        for pool_pages in (4, 6, 9):
+            eng = _make_engine(max_new=16, rows=5, pool=pool_pages)
+            res = eng.generate(
+                tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(6))
+            stats = eng.last_pool_stats
+            assert stats["peak_pages_used"] <= pool_pages - 1, stats
+            np.testing.assert_array_equal(res.tokens, ref.tokens)
+
+    def test_spec_mode_budget_stalls_but_completes(self, tiny_params):
+        """Speculative slots reserve worst-case pages; a pool that fits only
+        ~2 concurrent spec sequences still finishes everything (admission
+        stalls; spec never preempts)."""
+        ids, mask = _prompts(b=4, seed=9)
+        sampling = SamplingConfig(max_tokens=16, temperature=0.0, top_p=1.0, n=2)
+        ref = _make_engine(max_new=16, rows=4, pool=0, spec=2).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(8))
+        # spec private need ≤ 1 + ceil((16+2)/8) = 4; pool of 9 fits 2 slots
+        eng = _make_engine(max_new=16, rows=4, pool=9, spec=2)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(8))
+        assert eng.last_pool_stats["preemptions"] == 0
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
