@@ -440,29 +440,46 @@ class Trainer:
     def export_hf_snapshot(self) -> None:
         """The reference's ``save_pretrained`` artifact: an HF-format
         checkpoint of the MERGED model at run_dir/model_{step}
-        (distributed_trainer.py:372–380). Single-process runs only (a
-        multi-host gather/write-race-free export needs a
-        multihost_utils.process_allgather pass — skipped with a warning)."""
+        (distributed_trainer.py:372–380). On multi-process runs every process
+        joins a ``multihost_utils.process_allgather`` pass (each host's
+        shards may be non-addressable elsewhere, so the gather is a
+        collective all processes MUST enter), then process 0 alone writes —
+        write-race-free and byte-identical to the single-host artifact."""
         if self.total_batch_steps == self._last_hf_export_step:
             return  # episode end landing on a save_every step: already written
-        if jax.process_count() > 1:
-            log.warning("HF snapshot export skipped on multi-process runs")
-            return
         from distrl_llm_tpu.models.loading import save_hf_checkpoint
 
+        trained, base = self.lora, None if self._full else self.base_params_learner
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            def gather(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: (
+                        multihost_utils.process_allgather(x, tiled=True)
+                        if isinstance(x, jax.Array) else np.asarray(x)
+                    ),
+                    tree,
+                )
+
+            trained = gather(trained)
+            base = gather(base) if base is not None else None
+            if jax.process_index() != 0:
+                self._last_hf_export_step = self.total_batch_steps
+                return
         path = os.path.join(
             self.config.run_directory, f"model_{self.total_batch_steps}"
         )
         try:
             if self._full:
                 save_hf_checkpoint(
-                    self.lora, self.model_cfg, path,
+                    trained, self.model_cfg, path,
                     model_type=self.model_cfg.model_type,
                 )
             else:
                 save_hf_checkpoint(
-                    self.base_params_learner, self.model_cfg, path,
-                    lora=self.lora, lora_alpha=self.config.lora_alpha,
+                    base, self.model_cfg, path,
+                    lora=trained, lora_alpha=self.config.lora_alpha,
                     model_type=self.model_cfg.model_type,
                 )
             self._last_hf_export_step = self.total_batch_steps

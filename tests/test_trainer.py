@@ -154,6 +154,66 @@ class TestRolloutPlumbing:
         assert trainer._rollout_weight_version == trainer.weight_version
 
 
+class TestMultihostHfExport:
+    """The reference always produces ``save_pretrained`` artifacts
+    (distributed_actor.py:263-264); on multi-process runs the export gathers
+    every shard via ``multihost_utils.process_allgather`` (all processes
+    enter the collective) and process 0 alone writes."""
+
+    def _export(self, tmp_path, name):
+        import os
+
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            trainer = make_trainer(run_name=name)
+            trainer.export_hf_snapshot()
+        finally:
+            os.chdir(cwd)
+        return tmp_path / f"run_{name}" / "model_0"
+
+    def test_multiprocess_export_matches_single_process(self, tmp_path, monkeypatch):
+        from jax.experimental import multihost_utils
+
+        from distrl_llm_tpu.models.loading import load_pretrained
+
+        single = self._export(tmp_path, "single")
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        # the real collective reshapes by the (patched) process count; on one
+        # actual process the gather of a fully-addressable array is a host
+        # copy — shim exactly that, keeping the trainer's plumbing under test
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda x, **kw: np.asarray(x),
+        )
+        multi = self._export(tmp_path, "multi")
+
+        assert (multi / "model.safetensors").exists()
+        p_single, _ = load_pretrained(str(single))
+        p_multi, _ = load_pretrained(str(multi))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_single),
+            jax.tree_util.tree_leaves(p_multi),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_nonzero_process_joins_gather_but_never_writes(self, tmp_path, monkeypatch):
+        calls = []
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda x, **kw: (calls.append(1), np.asarray(x))[1],
+        )
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        out = self._export(tmp_path, "p1")
+        assert not out.exists()  # process 1 writes nothing
+        assert calls  # ...but DID enter the collective (deadlock otherwise)
+
+
 class TestCheckpointResume:
     def test_roundtrip(self, tmp_path):
         cfg = make_config(checkpoint_dir=str(tmp_path / "ckpt"))
