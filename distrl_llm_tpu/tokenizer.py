@@ -71,12 +71,13 @@ def load_tokenizer(model_name_or_path: str, prefer_native: bool = True):
     """Load the checkpoint's tokenizer (the reference's
     load_correct_tokenizer, train_distributed.py:46).
 
-    Default path: the C++ ``NativeBPETokenizer`` (N7 parity component —
-    differential-tested against the Rust implementation in
-    tests/test_native_tokenizer.py) when the checkpoint directory carries a
-    ``tokenizer.json``. Falls back to HF AutoTokenizer when the native build
-    is unavailable, the vocabulary is not byte-level BPE, or no local
-    tokenizer.json exists (hub model ids)."""
+    Default path: the C++ N7 parity cores — ``NativeBPETokenizer`` for
+    byte-level BPE vocabularies and ``NativeSPMTokenizer`` for sentencepiece
+    Unigram ones (Gemma) — both differential-tested against the Rust
+    implementation (tests/test_native_tokenizer.py, tests/test_native_spm.py)
+    when the checkpoint directory carries a ``tokenizer.json``. Falls back to
+    HF AutoTokenizer when the native build is unavailable, the model type is
+    neither, or no local tokenizer.json exists (hub model ids)."""
     import logging
     import os
 
@@ -84,17 +85,23 @@ def load_tokenizer(model_name_or_path: str, prefer_native: bool = True):
         tj = os.path.join(model_name_or_path, "tokenizer.json")
         if os.path.isfile(tj):
             try:
-                from distrl_llm_tpu.native.tokenizer import NativeBPETokenizer
+                import json as _json
 
                 kw = {}
                 cfg_path = os.path.join(model_name_or_path, "tokenizer_config.json")
                 if os.path.isfile(cfg_path):
-                    import json as _json
-
                     with open(cfg_path, encoding="utf-8") as f:
                         tok_cfg = _json.load(f)
                     if tok_cfg.get("chat_template"):
                         kw["chat_template"] = tok_cfg["chat_template"]
+                with open(tj, encoding="utf-8") as f:
+                    model_type = (_json.load(f).get("model") or {}).get("type")
+                if model_type == "Unigram":
+                    from distrl_llm_tpu.native.spm import NativeSPMTokenizer
+
+                    return NativeSPMTokenizer.from_hf_file(tj, **kw)
+                from distrl_llm_tpu.native.tokenizer import NativeBPETokenizer
+
                 return NativeBPETokenizer.from_hf_file(tj, **kw)
             except Exception as e:  # noqa: BLE001 — any native failure → HF path
                 logging.getLogger(__name__).warning(
