@@ -151,8 +151,11 @@ class TrainConfig:
     # round compiles/runs at the smallest bucket holding its longest real
     # prompt. Empty = single bucket at max_prompt_tokens.
     prompt_buckets: tuple[int, ...] = ()
-    # rollout engine implementation: "dense" (fixed-shape cache) or "paged"
-    # (packed ragged KV pages + Pallas paged-attention decode — the full N1)
+    # rollout engine implementation: "dense" (fixed-shape cache), "paged"
+    # (packed ragged KV pages + Pallas paged-attention decode — the full N1),
+    # or "paged_sharded" (ONE paged engine whose page pool is partitioned
+    # over the rollout mesh's dp axis via shard_map — engine/sharded_paged.py;
+    # wave scheduler, dp-only meshes)
     engine_impl: str = "dense"
     # KV cache quantization for the paged engine: "none" or "int8" (per-token
     # absmax). Halves the cache's RESIDENT memory (fit bigger batches); note
@@ -245,14 +248,25 @@ class TrainConfig:
             raise ValueError(f"learner must be 'pg' or 'grpo', got {self.learner!r}")
         if self.base_quant not in ("none", "int8", "int4"):
             raise ValueError(f"base_quant must be none/int8/int4, got {self.base_quant!r}")
-        if self.engine_impl not in ("dense", "paged"):
-            raise ValueError(f"engine_impl must be dense/paged, got {self.engine_impl!r}")
+        if self.engine_impl not in ("dense", "paged", "paged_sharded"):
+            raise ValueError(
+                f"engine_impl must be dense/paged/paged_sharded, got "
+                f"{self.engine_impl!r}"
+            )
         if self.kv_cache_quant not in ("none", "int8"):
             raise ValueError(
                 f"kv_cache_quant must be none/int8, got {self.kv_cache_quant!r}"
             )
-        if self.kv_cache_quant != "none" and self.engine_impl != "paged":
-            raise ValueError("kv_cache_quant requires engine_impl='paged'")
+        if self.kv_cache_quant != "none" and self.engine_impl == "dense":
+            raise ValueError("kv_cache_quant requires a paged engine")
+        if self.engine_impl == "paged_sharded" and (
+            self.continuous_batching or self.spec_draft
+        ):
+            raise ValueError(
+                "paged_sharded runs the wave scheduler only; continuous "
+                "batching / speculative decoding are per-replica engine "
+                "features (engine/sharded_paged.py)"
+            )
         if self.full_finetune and self.base_quant != "none":
             raise ValueError(
                 "full_finetune trains the base weights — they cannot be "

@@ -1,0 +1,266 @@
+"""One SHARDED paged engine over a GSPMD dp axis (shard_map edition).
+
+Closes the round-2 "deliberate gap" (PARITY.md): the paged engine targeted
+one replica, with data-parallel scale-out running one engine per replica
+(vLLM's one-engine-per-GPU model, fanned out via remote workers). On a
+single TPU slice the natural idiom is ONE engine whose page pool is
+partitioned across the dp axis — this module builds exactly that with
+``jax.experimental.shard_map``:
+
+* each dp shard owns a LOCAL page pool and LOCAL page tables (page ids index
+  the shard's own pool slice), so the per-step page gather never crosses the
+  axis — the pool-partitioned design sketched in paged_engine.py;
+* the per-replica jitted pieces (``_paged_prefill``, ``_paged_fanout``,
+  ``_paged_decode_step``) are REUSED verbatim as the shard-local program —
+  per-shard semantics are identical to a per-replica engine by construction
+  (pinned by greedy bit-parity tests, tests/test_sharded_paged.py);
+* decode steps dispatch from the host with donated state and async
+  early-exit done-snapshots (``run_decode_loop``), exactly like the local
+  engines; one dispatch steps every shard;
+* sampling folds ``lax.axis_index("dp")`` into the step rng so rows in
+  different shards draw independent noise.
+
+Scope: the WAVE scheduler (whole-batch prefill → decode → drain). The
+refill/speculative schedulers keep per-candidate host bookkeeping and stay
+per-replica (remote-worker fan-out); TP inside a shard is likewise the
+per-replica engines' job — this engine requires every non-dp mesh axis to
+be size 1.
+
+Reference anchor: vLLM data-parallel serving (one engine per GPU,
+requirements.txt:6); the sharded pool is the TPU-native alternative the
+round-2 verdict asked to build or refute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine.engine import GenerationResult, run_decode_loop
+from distrl_llm_tpu.engine.paged_engine import (
+    _paged_decode_step,
+    _paged_fanout,
+    _paged_prefill,
+    _PagedDecodeState,
+)
+from distrl_llm_tpu.models.configs import ModelConfig
+from distrl_llm_tpu.ops.paged import pages_per_seq
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map as _raw_shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import (  # type: ignore[no-redef]
+        shard_map as _raw_shard_map,
+    )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """Replication checks off across both shard_map generations (the new API
+    renamed check_rep → check_vma)."""
+    try:
+        return _raw_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return _raw_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+Params = dict[str, Any]
+
+
+class ShardedPagedEngine:
+    """Paged wave-mode generation with the page pool partitioned over "dp"."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        mesh: Mesh,
+        *,
+        max_prompt_tokens: int,
+        max_new_tokens: int,
+        eos_token_ids: Sequence[int],
+        pad_token_id: int,
+        lora_scale: float = 1.0,
+        cache_dtype=jnp.bfloat16,
+        attn_impl: str = "reference",
+        paged_impl: str = "auto",
+        page_size: int = 128,
+        decode_chunk: int = 128,
+        kv_quant: str = "none",
+        prompt_buckets: Sequence[int] | None = None,  # interface parity
+        capture_logprobs: bool = False,
+    ):
+        if "dp" not in mesh.shape:
+            raise ValueError(f"mesh needs a 'dp' axis, got {dict(mesh.shape)}")
+        other = {k: v for k, v in mesh.shape.items() if k != "dp" and v > 1}
+        if other:
+            raise ValueError(
+                f"ShardedPagedEngine shards over dp only; non-trivial axes "
+                f"{other} belong to per-replica engines (TP) — see module doc"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp = mesh.shape["dp"]
+        self.max_prompt_tokens = max_prompt_tokens
+        self.max_new_tokens = max_new_tokens
+        cfg.check_within_window(max_prompt_tokens + max_new_tokens)
+        self.page_size = page_size
+        self.prompt_pages = pages_per_seq(max_prompt_tokens, page_size)
+        self.private_pages = 1 + pages_per_seq(max_new_tokens, page_size)
+        self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
+        self.pad_id = int(pad_token_id)
+        self.lora_scale = lora_scale
+        self.decode_chunk = decode_chunk
+        self.capture_logprobs = capture_logprobs
+        self.prompt_buckets = [max_prompt_tokens]
+        self._kv_quant = kv_quant
+        self._prefill_kw = dict(
+            cfg=cfg, prompt_pages=self.prompt_pages, page_size=page_size,
+            lora_scale=lora_scale, cache_dtype=cache_dtype,
+            attn_impl=attn_impl, kv_quant=kv_quant,
+        )
+        self._step_kw = dict(
+            cfg=cfg, page_size=page_size, pad_id=self.pad_id,
+            lora_scale=lora_scale, paged_impl=paged_impl,
+            capture_logprobs=capture_logprobs,
+        )
+        self._built: dict[tuple, tuple] = {}
+
+    def bucket_for(self, prompt_mask) -> int:
+        return self.max_prompt_tokens
+
+    # ------------------------------------------------------------------ build
+
+    def _state_specs(self) -> _PagedDecodeState:
+        page = P(None, "dp", None, None)
+        pages = lambda: tuple(  # noqa: E731 — spec tuple per layer
+            page for _ in range(self.cfg.num_layers)
+        )
+
+        def quant_aware(spec_tuple):
+            # quantized pools are QuantizedTensor pytrees (weight + scales):
+            # shard_map specs are pytree PREFIXES, so a per-layer P() prefix
+            # covers both leaves
+            return spec_tuple
+
+        return _PagedDecodeState(
+            step=P(),
+            out=P("dp", None),
+            logps=P("dp", None),
+            gen_lengths=P("dp"),
+            done=P("dp"),
+            logits=P("dp", None),
+            seq_lengths=P("dp"),
+            k_pages=quant_aware(pages()),
+            v_pages=quant_aware(pages()),
+        )
+
+    def _build(self, n: int, b_local: int, max_steps: int,
+               top_p_impl: str) -> tuple:
+        key = (n, b_local, max_steps, top_p_impl)
+        if key in self._built:
+            return self._built[key]
+        mesh = self.mesh
+        sspec = self._state_specs()
+
+        def local_setup(params, lora, ids, mask):
+            pk, pv, last_logits, real_len = _paged_prefill(
+                params, lora, ids, mask, **self._prefill_kw
+            )
+            row_alive = mask.sum(axis=-1) > 0
+            state, table = _paged_fanout(
+                pk, pv, last_logits, real_len, row_alive,
+                n=n, b=b_local, prompt_pages=self.prompt_pages,
+                private_pages=self.private_pages, page_size=self.page_size,
+                max_steps=max_steps,
+            )
+            return state, table
+
+        setup = jax.jit(
+            shard_map(
+                local_setup, mesh=mesh,
+                in_specs=(P(), P(), P("dp", None), P("dp", None)),
+                out_specs=(sspec, P("dp", None)),
+            )
+        )
+
+        def local_step(params, lora, state, rng, table, temperature, top_p):
+            # decorrelate shards: every shard holds the same round rng, so
+            # without the fold every shard's rows would draw IDENTICAL noise
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            return _paged_decode_step(
+                params, lora, state, rng, table,
+                eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
+                top_p_impl=top_p_impl, **self._step_kw,
+            )
+
+        step = jax.jit(
+            shard_map(
+                local_step, mesh=mesh,
+                in_specs=(P(), P(), sspec, P(), P("dp", None), P(), P()),
+                out_specs=sspec,
+            ),
+            donate_argnums=(2,),
+        )
+        self._built[key] = (setup, step)
+        return self._built[key]
+
+    # --------------------------------------------------------------- generate
+
+    def generate(
+        self,
+        params: Params,
+        lora: Params | None,
+        prompt_ids: np.ndarray,  # [B, P] left-padded (trainer contract)
+        prompt_mask: np.ndarray,
+        sampling: SamplingConfig,
+        rng: jax.Array,
+    ) -> GenerationResult:
+        b, p = prompt_ids.shape
+        if p != self.max_prompt_tokens:
+            raise ValueError(
+                f"prompts must be padded to {self.max_prompt_tokens}, got {p}"
+            )
+        max_steps = min(sampling.max_tokens, self.max_new_tokens)
+        n = max(sampling.n, 1)
+        # pad the prompt batch to a dp multiple; padding rows have all-zero
+        # masks → born done in fanout, pad-token output, zero lengths
+        pad_rows = (-b) % self.dp
+        if pad_rows:
+            prompt_ids = np.concatenate(
+                [np.asarray(prompt_ids),
+                 np.zeros((pad_rows, p), np.int32)], axis=0
+            )
+            prompt_mask = np.concatenate(
+                [np.asarray(prompt_mask),
+                 np.zeros((pad_rows, p), np.int32)], axis=0
+            )
+        b_pad = b + pad_rows
+        top_p_impl = "exact" if sampling.top_p_exact else "bisect"
+        setup, step = self._build(n, b_pad // self.dp, max_steps, top_p_impl)
+
+        state, table = setup(
+            params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
+        )
+        temperature = jnp.asarray(sampling.temperature, jnp.float32)
+        top_p = jnp.asarray(sampling.top_p, jnp.float32)
+        state = run_decode_loop(
+            lambda s: step(params, lora, s, rng, table, temperature, top_p),
+            state, max_steps, self.decode_chunk,
+        )
+        out = np.asarray(state.out).reshape(b_pad, n, max_steps)[:b]
+        lengths = np.asarray(state.gen_lengths).reshape(b_pad, n)[:b]
+        logps = (
+            np.asarray(state.logps).reshape(b_pad, n, max_steps)[:b]
+            if self.capture_logprobs else None
+        )
+        return GenerationResult(tokens=out, lengths=lengths, logprobs=logps)

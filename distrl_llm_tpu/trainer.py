@@ -27,6 +27,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import os
+from functools import partial
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
@@ -57,14 +58,17 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     KV quant, continuous batching, speculative decoding, row cap). Module
     level so the config→engine wiring is unit-testable without a checkpoint."""
     kwargs: dict[str, Any] = {}
-    if config.engine_impl == "paged":
+    if config.engine_impl in ("paged", "paged_sharded"):
         kwargs["kv_quant"] = config.kv_cache_quant
+    if config.engine_impl == "paged":
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
             if config.spec_draft:
                 kwargs["spec_draft"] = config.spec_draft
                 kwargs["spec_ngram"] = config.spec_ngram
-    if config.max_concurrent_sequences:
+    if config.max_concurrent_sequences and config.engine_impl != "paged_sharded":
+        # the sharded engine admits whole dp-sharded waves; a row cap is the
+        # per-replica engines' admission knob
         kwargs["max_concurrent_rows"] = config.max_concurrent_sequences
     if config.clip_ratio > 0.0:
         # behavior-logprob capture costs a per-step vocab logsumexp plus the
@@ -353,6 +357,12 @@ class Trainer:
                 else GenerationEngine
             )
             engine_kwargs = engine_kwargs_from_config(config)
+            if config.engine_impl == "paged_sharded":
+                # one paged engine, page pool partitioned over the rollout
+                # mesh's dp axis (engine/sharded_paged.py)
+                from distrl_llm_tpu.engine.sharded_paged import ShardedPagedEngine
+
+                engine_cls = partial(ShardedPagedEngine, mesh=meshes.rollout)
             if config.engine_impl == "paged":
                 # --actor_gpu_usage → KV page budget (the reference's vLLM
                 # gpu_memory_utilization contract, train_distributed.py:34-35)
