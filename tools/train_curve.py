@@ -1,0 +1,191 @@
+"""Produce a reward-curve artifact a reviewer can overlay against the
+reference's published runs (media/initial_pg_test.png, ref README.md:73-85).
+
+Two scales:
+
+* ``--model tiny`` (default, any host): the CPU-scale end-to-end RL loop —
+  random-init TINY policy, dense digit-fraction reward (~8% base rate),
+  engine sampling → reward → GRPO shaping → 8-bit-Adam LoRA updates →
+  weight sync. The curve climbing is the same "de-facto integration test"
+  the reference's screenshots document, at toy scale.
+* ``--model <local checkpoint dir>`` (TPU): the real thing — BASELINE
+  config-1 shape via ``Trainer.from_pretrained`` with the native tokenizer
+  and MATH-style data; logs the exact reference metric names.
+
+Artifacts: ``media/reward_curve_<tag>.jsonl`` (one record per train step,
+exact wandb metric names per distributed_trainer.py:348-366) and
+``media/reward_curve_<tag>.png``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_tiny(episodes: int, learner: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distrl_llm_tpu.config import TrainConfig
+    from distrl_llm_tpu.engine import GenerationEngine
+    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.models import TINY, init_params
+    from distrl_llm_tpu.models.lora import lora_scale
+    from distrl_llm_tpu.tokenizer import CharTokenizer
+    from distrl_llm_tpu.trainer import Trainer
+
+    class Capture(MetricsSink):
+        def __init__(self):
+            self.records = []
+
+        def log(self, metrics, step=None):
+            self.records.append((step, dict(metrics)))
+
+        def finish(self):
+            pass
+
+    def digit_reward(completions, solutions):
+        return np.asarray(
+            [(0.0, sum(1 for ch in c if "0" <= ch <= "9") / max(len(c), 1))
+             for c in completions],
+            np.float32,
+        )
+
+    config = TrainConfig(
+        model="tiny", learner=learner, episodes=episodes, lr=3e-1,
+        max_prompt_tokens=16, max_new_tokens=12, batch_size=4,
+        num_candidates=8, topk=8, train_batch_size=8, max_lora_rank=8,
+        lora_alpha=16, number_of_actors=1, number_of_learners=1,
+        learner_chunk_size=1, metrics_backend="null",
+    )
+    tok = CharTokenizer()
+    problems = [f"q {c}" for c in "abcdefgh"]
+    train = {"problem": problems, "solution": [p[-1].upper() for p in problems]}
+    engine = GenerationEngine(
+        TINY, max_prompt_tokens=config.max_prompt_tokens,
+        max_new_tokens=config.max_new_tokens,
+        eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+        cache_dtype=jnp.float32,
+        lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+    )
+    sink = Capture()
+    trainer = Trainer(
+        train, dict(train), digit_reward, config,
+        tokenizer=tok, engine=engine,
+        base_params=init_params(jax.random.PRNGKey(0), TINY),
+        model_cfg=TINY, sink=sink,
+    )
+    trainer.train()
+    return [m for _, m in sink.records if "mean_accuracy_reward" in m], "tiny-cpu"
+
+
+def run_checkpoint(path: str, episodes: int, learner: str):
+    from distrl_llm_tpu.config import TrainConfig
+    from distrl_llm_tpu.data import prepare_dataset
+    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.rewards import reward_function
+    from distrl_llm_tpu.tokenizer import load_tokenizer
+    from distrl_llm_tpu.trainer import Trainer
+
+    class Capture(MetricsSink):
+        def __init__(self):
+            self.records = []
+
+        def log(self, metrics, step=None):
+            self.records.append((step, dict(metrics)))
+
+        def finish(self):
+            pass
+
+    config = TrainConfig(
+        model=path, learner=learner, episodes=episodes,
+        metrics_backend="null", engine_impl="paged",
+        max_concurrent_sequences=128, continuous_batching=True,
+        kv_cache_quant="int8",
+    )
+    tokenizer = load_tokenizer(path)
+    train, test = prepare_dataset(
+        config.dataset, tokenizer, test_size=0.1, seed=config.seed
+    )
+    sink = Capture()
+    trainer = Trainer.from_pretrained(
+        train, test, reward_function, config, checkpoint_path=path,
+        tokenizer=tokenizer, sink=sink,
+    )
+    trainer.train()
+    name = os.path.basename(path.rstrip("/"))
+    return [m for _, m in sink.records if "mean_accuracy_reward" in m], name
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny",
+                    help="'tiny' (CPU-scale) or a local HF checkpoint dir")
+    ap.add_argument("--episodes", type=int, default=60)
+    ap.add_argument("--learner", default="grpo", choices=["pg", "grpo"])
+    ap.add_argument("--out-dir", default=os.path.join(
+        os.path.dirname(__file__), "..", "media"))
+    args = ap.parse_args()
+
+    if args.model == "tiny":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        records, tag = run_tiny(args.episodes, args.learner)
+    else:
+        records, tag = run_checkpoint(args.model, args.episodes, args.learner)
+
+    import jax
+
+    backend = jax.devices()[0].platform
+    tag = f"{tag}-{args.learner}"
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = os.path.join(args.out_dir, f"reward_curve_{tag}.jsonl")
+    with open(jsonl, "w") as f:
+        f.write(json.dumps({"meta": {
+            "model": args.model, "learner": args.learner,
+            "episodes": args.episodes, "backend": backend,
+        }}) + "\n")
+        for m in records:
+            f.write(json.dumps(m) + "\n")
+
+    steps = list(range(1, len(records) + 1))
+    rewards = [m["mean_accuracy_reward"] for m in records]
+    k = max(len(rewards) // 20, 1)
+    smooth = [
+        sum(rewards[max(0, i - k + 1):i + 1]) / len(rewards[max(0, i - k + 1):i + 1])
+        for i in range(len(rewards))
+    ]
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(steps, rewards, alpha=0.35, label="mean_accuracy_reward")
+        ax.plot(steps, smooth, label=f"rolling mean (k={k})")
+        ax.set_xlabel("train step")
+        ax.set_ylabel("mean_accuracy_reward")
+        ax.set_title(f"{tag} ({backend}) — the curve the reference publishes "
+                     "as media/*.png")
+        ax.legend()
+        fig.tight_layout()
+        png = os.path.join(args.out_dir, f"reward_curve_{tag}.png")
+        fig.savefig(png, dpi=120)
+        print(f"wrote {png}")
+    except Exception as e:  # noqa: BLE001 — headless plotting is best-effort
+        print(f"plot skipped: {e}")
+    print(f"wrote {jsonl}")
+    print(f"first→last reward: {rewards[0]:.4f} → {rewards[-1]:.4f} "
+          f"(rolling: {smooth[0]:.4f} → {smooth[-1]:.4f}) over {len(rewards)} steps")
+
+
+if __name__ == "__main__":
+    main()
