@@ -110,8 +110,12 @@ class TrainConfig:
     lora_alpha: int = 16
     lora_dropout: float = 0.0
     topk: int = 16
-    # GPU-memory knobs kept for CLI compatibility; on TPU they scale the
-    # engine's KV-cache HBM budget instead of a vLLM memory fraction.
+    # HBM fraction for weights+KV (vLLM gpu_memory_utilization contract,
+    # ref train_distributed.py:34-35): sizes the paged engine's KV page
+    # pool (engine/budget.py). actor_gpu_usage applies on disjoint rollout
+    # meshes (the reference's actor GPUs); learner_gpu_usage applies when
+    # roles timeshare one mesh (the reference's learner GPU, where training
+    # state shares the chip with the engine).
     actor_gpu_usage: float = 0.91
     learner_gpu_usage: float = 0.35
 

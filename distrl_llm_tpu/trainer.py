@@ -369,9 +369,16 @@ class Trainer:
                 from distrl_llm_tpu.engine.budget import kv_pool_pages, tree_bytes
                 from distrl_llm_tpu.ops.paged import DEFAULT_PAGE_SIZE
 
+                # timeshared roles = the reference's LEARNER GPU (training
+                # state shares the chip with the engine → the 0.35 fraction);
+                # disjoint rollout meshes = its ACTOR GPUs (0.91)
+                usage = (
+                    config.learner_gpu_usage if meshes.timeshared
+                    else config.actor_gpu_usage
+                )
                 engine_kwargs["max_kv_pages"] = kv_pool_pages(
                     model_cfg,
-                    gpu_usage=config.actor_gpu_usage,
+                    gpu_usage=usage,
                     param_bytes=tree_bytes(params),
                     batch_prompts=config.batch_size,
                     max_prompt_tokens=config.max_prompt_tokens,
