@@ -38,6 +38,12 @@ for i in $(seq 1 200); do
     BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 \
       timeout 900 python bench.py > /tmp/bench_tpu_spec.json 2>/tmp/bench_tpu_spec.err
     echo "spec rc=$?: $(tail -c 300 /tmp/bench_tpu_spec.json)"
+    # page-budgeted pool (the --actor_gpu_usage path): grow-as-you-go grants
+    # + preempt-by-recompute at ~realized-length provisioning (1 + 128*6
+    # pages would be worst case at these shapes; 500 forces the budget on)
+    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500 \
+      timeout 900 python bench.py > /tmp/bench_tpu_budget.json 2>/tmp/bench_tpu_budget.err
+    echo "budget rc=$?: $(tail -c 300 /tmp/bench_tpu_budget.json)"
     BENCH_NO_FALLBACK=1 BENCH_MODE=learner timeout 900 python bench.py > /tmp/bench_tpu_learner.json 2>/tmp/bench_tpu_learner.err
     echo "learner rc=$?: $(tail -c 300 /tmp/bench_tpu_learner.json)"
     timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1
