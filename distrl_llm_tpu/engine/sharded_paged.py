@@ -24,7 +24,9 @@ Scope: the WAVE scheduler (whole-batch prefill → decode → drain). The
 refill/speculative schedulers keep per-candidate host bookkeeping and stay
 per-replica (remote-worker fan-out); TP inside a shard is likewise the
 per-replica engines' job — this engine requires every non-dp mesh axis to
-be size 1.
+be size 1. The trainer detects the bound ``mesh`` attribute and routes the
+WHOLE batch here (hybrid learner-share generation needs per-role device
+placement the bound mesh precludes).
 
 Reference anchor: vLLM data-parallel serving (one engine per GPU,
 requirements.txt:6); the sharded pool is the TPU-native alternative the

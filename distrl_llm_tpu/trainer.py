@@ -580,6 +580,10 @@ class Trainer:
             # a remote engine already fans out over worker processes; a
             # second local dispatch would double-generate the batch
             and not getattr(self.engine, "is_remote", False)
+            # a mesh-bound engine (paged_sharded) compiles against the
+            # rollout mesh; the learner share's params live on a different
+            # device set — the whole batch decodes on the sharded engine
+            and getattr(self.engine, "mesh", None) is None
         )
         if hybrid:
             sizes = chunk_sizes(

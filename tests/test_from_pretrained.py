@@ -97,5 +97,27 @@ class TestFromPretrained:
         from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
 
         assert isinstance(trainer.engine, PagedGenerationEngine)
+        # --actor_gpu_usage → a real page budget on the assembled engine
+        # (vLLM's gpu_memory_utilization contract; engine/budget.py)
+        assert trainer.engine.max_kv_pages > 0
+        res = trainer._generate_round(train, cfg.train_sampling())
+        assert len(res[0]["answers"]) == 2
+
+    def test_engine_impl_paged_sharded_assembles(self, checkpoint_dir):
+        cfg = TrainConfig(
+            model=checkpoint_dir,
+            episodes=1, batch_size=2, num_candidates=2, topk=2,
+            train_batch_size=2, max_prompt_tokens=16, max_new_tokens=8,
+            number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
+            eval_every=0, save_every=0, metrics_backend="null",
+            max_lora_rank=4, lora_alpha=8, engine_impl="paged_sharded",
+        )
+        train = {"problem": ["1+1?", "2+2?"], "solution": ["2", "4"]}
+        trainer = Trainer.from_pretrained(
+            train, train, reward_function, cfg, sink=MemorySink(),
+        )
+        from distrl_llm_tpu.engine.sharded_paged import ShardedPagedEngine
+
+        assert isinstance(trainer.engine, ShardedPagedEngine)
         res = trainer._generate_round(train, cfg.train_sampling())
         assert len(res[0]["answers"]) == 2
