@@ -80,9 +80,17 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
             from distrl_llm_tpu.engine.budget import kv_pool_pages, tree_bytes
             from distrl_llm_tpu.ops.paged import DEFAULT_PAGE_SIZE
 
+            if budget_batch <= 0:
+                # silently guessing the round size would under-account the
+                # shared prompt-page region and OOM exactly when the knob
+                # should have prevented it
+                raise ValueError(
+                    "--actor-gpu-usage requires --budget-batch (prompts per "
+                    "round, for the shared prompt-page accounting)"
+                )
             kwargs["max_kv_pages"] = kv_pool_pages(
                 cfg, gpu_usage=gpu_usage, param_bytes=tree_bytes(params),
-                batch_prompts=budget_batch or 8,
+                batch_prompts=budget_batch,
                 max_prompt_tokens=max_prompt_tokens,
                 max_new_tokens=max_new_tokens,
                 page_size=DEFAULT_PAGE_SIZE, kv_quant=kv_quant,

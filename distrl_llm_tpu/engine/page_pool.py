@@ -96,7 +96,7 @@ class PagePool:
         assert not self.owned[slot], f"slot {slot} admitted while owning pages"
         full = real_len // self.page_size
         self.full[slot] = full
-        need = max(last_position // self.page_size - full + 1, 1)
+        need = self.pages_to_cover(slot, last_position)
         if need > len(self.free):
             return False
         grant = [self.free.pop() for _ in range(need)]

@@ -142,7 +142,10 @@ class NativeSPMTokenizer:
     @classmethod
     def from_hf_file(cls, path: str, **kw) -> "NativeSPMTokenizer":
         with open(path, encoding="utf-8") as f:
-            tj = json.load(f)
+            return cls.from_hf_dict(json.load(f), **kw)
+
+    @classmethod
+    def from_hf_dict(cls, tj: dict, **kw) -> "NativeSPMTokenizer":
         data = serialize_hf_unigram(tj)
         kw.setdefault("normalizer_ops", _parse_normalizer(tj))
         specials = {t["content"]: t["id"] for t in tj.get("added_tokens", [])}

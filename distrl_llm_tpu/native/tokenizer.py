@@ -179,7 +179,10 @@ class NativeBPETokenizer:
     @classmethod
     def from_hf_file(cls, path: str, **kw) -> "NativeBPETokenizer":
         with open(path, encoding="utf-8") as f:
-            tj = json.load(f)
+            return cls.from_hf_dict(json.load(f), **kw)
+
+    @classmethod
+    def from_hf_dict(cls, tj: dict, **kw) -> "NativeBPETokenizer":
         data = serialize_hf_tokenizer(tj)
         if "nfc_normalize" not in kw:
             kw["nfc_normalize"] = "NFC" in json.dumps(tj.get("normalizer") or {})

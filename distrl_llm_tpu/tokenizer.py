@@ -95,14 +95,14 @@ def load_tokenizer(model_name_or_path: str, prefer_native: bool = True):
                     if tok_cfg.get("chat_template"):
                         kw["chat_template"] = tok_cfg["chat_template"]
                 with open(tj, encoding="utf-8") as f:
-                    model_type = (_json.load(f).get("model") or {}).get("type")
-                if model_type == "Unigram":
+                    tj_dict = _json.load(f)  # parsed once; multi-MB for 7B+
+                if (tj_dict.get("model") or {}).get("type") == "Unigram":
                     from distrl_llm_tpu.native.spm import NativeSPMTokenizer
 
-                    return NativeSPMTokenizer.from_hf_file(tj, **kw)
+                    return NativeSPMTokenizer.from_hf_dict(tj_dict, **kw)
                 from distrl_llm_tpu.native.tokenizer import NativeBPETokenizer
 
-                return NativeBPETokenizer.from_hf_file(tj, **kw)
+                return NativeBPETokenizer.from_hf_dict(tj_dict, **kw)
             except Exception as e:  # noqa: BLE001 — any native failure → HF path
                 logging.getLogger(__name__).warning(
                     "native tokenizer unavailable for %s (%s); using HF",
