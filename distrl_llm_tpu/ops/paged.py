@@ -49,13 +49,12 @@ def quantize_pages(pages: jax.Array):
     """float pages [K, P, ps, hd] → QuantizedTensor (int8 + f32 scales
     [K, P, ps, 1]). Halves the cache's resident HBM footprint.
 
-    CAVEAT (verified against the installed kernel source): jaxlib's
-    ``paged_attention`` broadcasts the scales to head_dim before the
-    pallas_call (paged_attention_kernel.py:422), materializing a full-cache-
-    sized f32 buffer per layer per decode step — on the TPU kernel path the
-    per-step bandwidth/temp cost currently NEGATES the read-bandwidth win.
-    Use int8 KV for memory-at-rest headroom (bigger batches fit), not for
-    decode speed, until a scale-aware kernel wrapper lands."""
+    Decode-speed note: jaxlib's public ``paged_attention`` wrapper broadcasts
+    these scales to head_dim before its pallas_call (a full-cache f32 temp
+    per step, which would negate the bandwidth win); the TPU kernel path
+    here uses the COMPACT-scales launch instead (ops/paged_int8.py — same
+    jaxlib kernel, scales shipped [ps, 1], ~1 + 4/head_dim bytes/element),
+    so int8 KV buys both capacity AND read bandwidth."""
     return _quant_utils().quantize_to_int8(pages)
 
 
@@ -319,6 +318,16 @@ def paged_attention_op(
                 default=1,
             )
             scaled_q = q * (q.shape[-1] ** -0.5)
+            if is_quantized_pages(k_pages):
+                # jaxlib's wrapper broadcasts scales to head_dim (a
+                # full-cache f32 temp per step); our launch ships them
+                # compact — same kernel, ~1/5 the int8 read traffic
+                from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
+
+                return paged_attention_int8(
+                    scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
+                    page_indices, pages_per_compute_block=blocks,
+                ).astype(q.dtype)
             return paged_attention(
                 scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
                 page_indices, pages_per_compute_block=blocks,

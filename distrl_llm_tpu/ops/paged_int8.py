@@ -1,0 +1,145 @@
+"""Scale-aware int8 paged-attention launch: compact scales, no broadcast.
+
+jaxlib's public ``paged_attention`` wrapper broadcasts QuantizedTensor
+scales [K, P, ps, 1] → [K, P, ps, head_dim] f32 BEFORE its pallas_call
+(paged_attention_kernel.py:422), materializing a full-cache-sized f32 array
+in HBM on every decode step — per-element traffic becomes 1 (int8) + 4
+(scales) = 5 bytes vs bf16's 2, NEGATING the int8 bandwidth win (the caveat
+previously documented on ops/paged.py::quantize_pages).
+
+The kernel itself never needed the broadcast: its per-page DMA descriptor
+slices whatever scale shape it is given, and the in-VMEM dequantize is a
+broadcasting multiply. This module re-assembles the SAME jaxlib kernel
+function (a public dependency, reused like any library op) with:
+
+* compact scales shipped as-is — [K, P, ps, 1] f32 in HBM, [2, blk, ps, 1]
+  VMEM scratch (per-element traffic 1 + 4/head_dim ≈ 1.03 bytes);
+* the no-megacore, inline-seq-dim launch configuration the engine uses;
+* an ``interpret`` flag so CPU tests can pin numerics against the jnp
+  reference without a chip (tools/tpu_kernel_check.py revalidates the
+  Mosaic lowering on silicon).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas.ops.tpu.paged_attention import quantization_utils
+from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel import (
+    DEFAULT_MASK_VALUE,
+    paged_flash_attention_kernel_inline_seq_dim,
+)
+
+
+def paged_attention_int8(
+    q: jax.Array,  # [B, H, hd]
+    k_pages,  # QuantizedTensor: weight int8 [K, P, ps, hd], scales [K, P, ps, 1]
+    v_pages,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pages_per_sequence]
+    *,
+    pages_per_compute_block: int = 4,
+    mask_value: float = DEFAULT_MASK_VALUE,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA paged decode attention over int8 pages with COMPACT scales."""
+    assert isinstance(k_pages, quantization_utils.QuantizedTensor)
+    assert isinstance(v_pages, quantization_utils.QuantizedTensor)
+    k_w, k_s = k_pages.weight, k_pages.scales
+    v_w, v_s = v_pages.weight, v_pages.scales
+
+    batch_size, num_q_heads, head_dim = q.shape
+    num_kv_heads, _, page_size, head_dim_k = k_w.shape
+    _, pages_per_sequence = page_indices.shape
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch: {head_dim_k} vs {head_dim}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(f"H={num_q_heads} not divisible by K={num_kv_heads}")
+    if pages_per_sequence % pages_per_compute_block:
+        raise ValueError(
+            f"pages_per_sequence={pages_per_sequence} not divisible by "
+            f"pages_per_compute_block={pages_per_compute_block}"
+        )
+    num_groups = num_q_heads // num_kv_heads
+
+    if num_groups % 8 != 0:
+        # same layout hint as the jaxlib wrapper: a [1, G, hd] block would
+        # get an <8x128> memref layout and fail to lower
+        q = q.reshape(batch_size, num_q_heads, 1, head_dim)
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, None, head_dim),
+            lambda core_index, b, h, *_: (b, h, 0, 0),
+        )
+        q_dtype_for_kernel_launch = jnp.float32
+    else:
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, head_dim),
+            lambda core_index, b, h, *_: (b, h, 0),
+        )
+        q_dtype_for_kernel_launch = q.dtype
+
+    grid = (1, batch_size, num_kv_heads)  # no megacore
+    in_specs = [
+        q_block_spec,
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    # the one material difference from jaxlib's launch: scale buffers stay
+    # at their stored [ps, 1] shape instead of a broadcast [ps, head_dim]
+    scratch_shapes = (
+        pltpu.VMEM(
+            (2, pages_per_compute_block, page_size, head_dim), k_w.dtype
+        ),
+        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), k_s.dtype),
+        pltpu.VMEM(
+            (2, pages_per_compute_block, page_size, head_dim), v_w.dtype
+        ),
+        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), v_s.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    )
+
+    out, _, _ = pl.pallas_call(
+        functools.partial(
+            paged_flash_attention_kernel_inline_seq_dim,
+            pages_per_sequence=pages_per_sequence,
+            batch_size=batch_size,
+            pages_per_compute_block=pages_per_compute_block,
+            mask_value=mask_value,
+            attn_logits_soft_cap=None,
+            megacore_mode=None,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            in_specs=in_specs,
+            out_specs=[q_block_spec, q_block_spec, q_block_spec],
+            grid=grid,
+            scratch_shapes=scratch_shapes,
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q_dtype_for_kernel_launch),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        lengths,
+        page_indices.reshape(-1),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),  # init flag
+        q.astype(q_dtype_for_kernel_launch),
+        k_w,
+        k_s,
+        v_w,
+        v_s,
+    )
+    return out.reshape(batch_size, num_q_heads, head_dim).astype(q.dtype)

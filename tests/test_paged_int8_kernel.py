@@ -1,0 +1,75 @@
+"""Compact-scales int8 paged-attention launch (ops/paged_int8.py).
+
+jaxlib's wrapper broadcasts QuantizedTensor scales to head_dim before its
+pallas_call — a full-cache-sized f32 HBM temp per decode step. Our launch
+reuses the SAME jaxlib kernel with the scales kept [ps, 1]; these tests pin
+numerics under the Pallas interpreter (tools/tpu_kernel_check.py revalidates
+the Mosaic lowering on a real chip).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrl_llm_tpu.ops.paged import (
+    make_page_table,
+    paged_attention_reference,
+    quantize_pages,
+)
+from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
+
+
+def _setup(b, h, k, hd, ps, pps, seed=0):
+    rng = np.random.default_rng(seed)
+    total = b * pps
+    kk = jnp.asarray(rng.normal(size=(k, total, ps, hd)), jnp.float32) * 0.3
+    vv = jnp.asarray(rng.normal(size=(k, total, ps, hd)), jnp.float32) * 0.3
+    q = jnp.asarray(rng.normal(size=(b, h, hd)), jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, pps * ps + 1, size=b), jnp.int32)
+    table = jnp.asarray(make_page_table(b, pps * ps, ps))
+    return q, quantize_pages(kk), quantize_pages(vv), lengths, table
+
+
+class TestCompactScalesKernel:
+    @pytest.mark.parametrize(
+        "b,h,k,hd,ps,pps",
+        [
+            (4, 8, 2, 64, 16, 4),   # small GQA group (the <8-group q path)
+            (2, 16, 2, 64, 16, 4),  # group == 8 (the direct-layout q path)
+            (3, 4, 4, 32, 8, 2),    # MQA-ish, odd batch
+        ],
+    )
+    def test_matches_reference(self, b, h, k, hd, ps, pps):
+        q, kq, vq, lengths, table = _setup(b, h, k, hd, ps, pps)
+        ref = paged_attention_reference(q, kq, vq, lengths, table)
+        out = paged_attention_int8(
+            q * hd**-0.5, kq, vq, lengths, table,
+            pages_per_compute_block=2, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
+        )
+
+    def test_scales_stay_compact(self):
+        """The whole point: the launch must consume [K, P, ps, 1] scales —
+        a broadcast would show up as a shape mismatch here."""
+        q, kq, vq, lengths, table = _setup(4, 8, 2, 64, 16, 4)
+        assert kq.scales.shape[-1] == 1
+        out = paged_attention_int8(
+            q * 64**-0.5, kq, vq, lengths, table,
+            pages_per_compute_block=4, interpret=True,
+        )
+        assert out.shape == q.shape
+
+    def test_single_token_rows(self):
+        q, kq, vq, _, table = _setup(4, 8, 2, 64, 16, 4, seed=3)
+        lengths = jnp.ones((4,), jnp.int32)
+        ref = paged_attention_reference(q, kq, vq, lengths, table)
+        out = paged_attention_int8(
+            q * 64**-0.5, kq, vq, lengths, table,
+            pages_per_compute_block=2, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
+        )

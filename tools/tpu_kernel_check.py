@@ -97,6 +97,26 @@ def main() -> int:
     failures += not ok
     print(f"{'PASS' if ok else 'FAIL'} paged_attention cap={cap} max_err={err.max():.4f}")
 
+    # ---- int8 compact-scales kernel launch (ops/paged_int8.py) ------------
+    from distrl_llm_tpu.ops.paged import quantize_pages
+
+    kq, vq = quantize_pages(k_pages.astype(jnp.float32)), quantize_pages(
+        v_pages.astype(jnp.float32)
+    )
+    got = np.asarray(
+        paged_attention_op(q3, kq, vq, lengths, table, impl="kernel")
+        .astype(jnp.float32)
+    )
+    want = np.asarray(
+        paged_attention_reference(q3, kq, vq, lengths, table)
+        .astype(jnp.float32)
+    )
+    err = np.abs(got - want)
+    ok = err.max() < 3e-2
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} paged_attention_int8_compact cap={cap} "
+          f"max_err={err.max():.4f}")
+
     # ---- donated decode-step HBM audit (TPU only — CPU memory_analysis
     # does not model donation aliasing, so this cannot run in CI): the
     # refill/spec step programs must NOT materialize page-pool-sized temps.
