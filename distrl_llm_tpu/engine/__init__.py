@@ -1,2 +1,4 @@
 from distrl_llm_tpu.engine.engine import GenerationEngine, GenerationResult  # noqa: F401
+from distrl_llm_tpu.engine.page_pool import PagePool  # noqa: F401
 from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine  # noqa: F401
+from distrl_llm_tpu.engine.sharded_paged import ShardedPagedEngine  # noqa: F401
