@@ -57,6 +57,10 @@ class PagePool:
         self.table = np.full((r_slots, width), self.scratch, np.int32)
         self.peak_pages_used = 0
         self.preemptions = 0
+        # opt-in per-boundary self-check (tests; DISTRL_POOL_CHECK=1)
+        import os
+
+        self.self_check = os.environ.get("DISTRL_POOL_CHECK", "0") == "1"
 
     # -- accounting --------------------------------------------------------
 

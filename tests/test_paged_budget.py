@@ -236,6 +236,37 @@ class TestBudgetedRefill:
             rtol=2e-4, atol=2e-4,
         )
 
+    def test_fuzzed_eos_and_pools_hold_invariants(self, tiny_params, monkeypatch):
+        """Random EOS sets × pool sizes with the per-boundary pool self-check
+        on: free + owned must tile the pool at EVERY grant/preempt boundary,
+        all candidates finish, outputs match the unbudgeted run."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        rng = np.random.default_rng(21)
+        ids, mask = _prompts(b=5, seed=21)
+        for trial, (pool, n_eos) in enumerate([(6, 3), (9, 1), (7, 6)]):
+            eos = sorted(
+                int(t) for t in rng.choice(TINY.vocab_size - 2, n_eos, replace=False) + 2
+            )
+            sampling = _greedy(max_tokens=24, n=2)
+
+            def build(p):
+                return PagedGenerationEngine(
+                    TINY, max_prompt_tokens=16, max_new_tokens=24,
+                    eos_token_ids=eos, pad_token_id=0, page_size=PAGE,
+                    max_concurrent_rows=4, scheduler="refill",
+                    max_kv_pages=p, decode_chunk=4,
+                )
+
+            ref = build(0).generate(
+                tiny_params, None, ids, mask, sampling,
+                jax.random.PRNGKey(trial))
+            eng = build(pool)
+            res = eng.generate(
+                tiny_params, None, ids, mask, sampling,
+                jax.random.PRNGKey(trial))
+            np.testing.assert_array_equal(res.tokens, ref.tokens, err_msg=str(trial))
+            assert eng.last_pool_stats["peak_pages_used"] <= pool - 1
+
     def test_fuzzed_pools_all_complete(self, tiny_params):
         """Random tight pool sizes: every candidate finishes, lengths are
         within bounds, and the recorded peak never exceeds the budget."""
