@@ -61,6 +61,57 @@ def _greedy(max_tokens=24, n=2):
     return SamplingConfig(max_tokens=max_tokens, temperature=0.0, top_p=1.0, n=n)
 
 
+class TestTrainerWithBudgetedEngine:
+    def test_clip_training_batch_over_preempted_rollouts(self, tiny_params):
+        """End-to-end: a PPO-clip training batch whose rollouts came from a
+        preemption-forcing budgeted engine — the raw-rollout path must train
+        on the engine's token ids + behavior logprobs, including candidates
+        that were evicted and resumed mid-decode."""
+        from distrl_llm_tpu.config import TrainConfig
+        from distrl_llm_tpu.metrics import MetricsSink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+
+        class Sink(MetricsSink):
+            def __init__(self):
+                self.records = []
+
+            def log(self, metrics, step=None):
+                self.records.append(dict(metrics))
+
+            def finish(self):
+                pass
+
+        cfg = TrainConfig(
+            model="tiny", episodes=1, batch_size=4, num_candidates=4, topk=4,
+            train_batch_size=4, max_prompt_tokens=16, max_new_tokens=24,
+            number_of_actors=1, number_of_learners=1, learner_chunk_size=0,
+            metrics_backend="null", max_lora_rank=4, lora_alpha=8,
+            learner="grpo", clip_ratio=0.2, engine_impl="paged",
+            max_concurrent_sequences=4, continuous_batching=True,
+        )
+        tok = CharTokenizer()
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=24,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            page_size=PAGE, max_concurrent_rows=4, scheduler="refill",
+            max_kv_pages=6, decode_chunk=4, capture_logprobs=True,
+        )
+        train = {"problem": ["q a", "q b", "q c", "q d"],
+                 "solution": ["A", "B", "C", "D"]}
+        sink = Sink()
+        trainer = Trainer(
+            train, dict(train), reward_function, cfg,
+            tokenizer=tok, engine=eng, base_params=tiny_params,
+            model_cfg=TINY, sink=sink,
+        )
+        trainer._train_batch(train, episode=0)
+        assert eng.last_pool_stats["preemptions"] > 0, eng.last_pool_stats
+        recs = [m for m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+
+
 class TestPagePool:
     def test_admit_release_roundtrip(self):
         pool = PagePool(first_page=10, n_pages=8, r_slots=2, width=6,
