@@ -11,13 +11,17 @@ distributed_actor.py:148–150). TPU-native design:
   bandwidth is proportional to each row's true length, not the cache
   capacity: the decode kernel only reads [0, length) — vLLM's ragged read,
   where the dense cache reads all of Smax every step for every row.
-* **Static page tables.** vLLM's C++ block allocator exists to multiplex an
-  unknown online request stream; an RL rollout round is a FIXED batch of
-  B·n candidates with known capacity, so the table is a host-computed
-  constant per round (row-major identity layout today; the indirection layer
-  is what lets prompt-prefix sharing land without touching the kernel).
-* **Kernel**: jaxlib's Pallas TPU ``paged_attention`` (Mosaic) on TPU; a
-  jnp reference with identical semantics elsewhere and for parity tests.
+* **Shape-static, host-authored page tables.** vLLM's C++ block allocator
+  multiplexes an unknown online request stream; an RL rollout round is a
+  FIXED batch of B·n candidates, so the tables are host-computed int32
+  arrays of STATIC shape whose CONTENT changes (engine/page_pool.py: the
+  free-list allocator behind ``--actor_gpu_usage`` grants pages as
+  sequences grow and rewrites rows on admission/preemption; wave mode uses
+  a per-round constant layout). The indirection layer is also what lets
+  prompt-prefix sharing land without touching the kernel.
+* **Kernel**: jaxlib's Pallas TPU ``paged_attention`` (Mosaic) on TPU — via
+  the compact-scales launch (ops/paged_int8.py) for int8 pages; a jnp
+  reference with identical semantics elsewhere and for parity tests.
 """
 
 from __future__ import annotations
