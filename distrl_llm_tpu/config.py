@@ -198,6 +198,14 @@ class TrainConfig:
     # construction (the loss's ratio is computed under the current policy).
     # Off (default) = the reference's strictly synchronous loop.
     async_rollout: bool = False
+    # in-flight weight updates (PipelineRL-style): push each optimizer
+    # step's adapter into the generation round still in flight instead of
+    # waiting for it to drain — the engines swap at the next decode
+    # dispatch, and the PPO-clip objective ratios every token against the
+    # captured behavior logprob of the policy that actually sampled it.
+    # Requires async_rollout (there must BE an in-flight round), clip_ratio
+    # > 0 (the off-policy correction), local LoRA rollout.
+    inflight_weight_updates: bool = False
     # PPO-clip surrogate epsilon (0 = reference parity: the no-KL/no-clip
     # single-update objective). With clip_ratio > 0 the learner ratios the
     # current policy against ENGINE-CAPTURED behavior logprobs
@@ -311,6 +319,25 @@ class TrainConfig:
                 "spec_draft (speculative decoding) requires "
                 "continuous_batching (the refill scheduler hosts it)"
             )
+        if self.inflight_weight_updates:
+            if not self.async_rollout:
+                raise ValueError(
+                    "inflight_weight_updates requires async_rollout (there "
+                    "must be an in-flight generation round to update)"
+                )
+            if self.clip_ratio <= 0:
+                raise ValueError(
+                    "inflight_weight_updates requires clip_ratio > 0: tokens "
+                    "sampled pre-swap are off-policy for the update, and the "
+                    "clip objective is the correction that consumes their "
+                    "captured behavior logprobs"
+                )
+            if self.rollout_workers or self.full_finetune:
+                raise ValueError(
+                    "inflight_weight_updates requires local LoRA rollout "
+                    "(worker rounds are blocking calls; full_finetune swaps "
+                    "the whole param tree, not an adapter)"
+                )
         if self.clip_ratio > 0 and self.rollout_workers:
             # clip needs per-token behavior logprobs captured at generation
             # time; worker engines are built without capture_logprobs, so a

@@ -306,6 +306,9 @@ class GenerationEngine:
         # concurrent generate() calls (hybrid rollout: actor + learner
         # submeshes decode in parallel threads) share the compiled-fn cache
         self._compile_mu = threading.Lock()
+        # in-flight weight-update mailbox (push_lora)
+        self._pending_lora = None
+        self.last_swap_steps: list[int] = []
 
         # n and max_steps are static (shape-determining)
         self._decode_init = jax.jit(
@@ -314,6 +317,27 @@ class GenerationEngine:
             # no cache donation: the candidate fan-out (jnp.repeat to B·n
             # rows) allocates fresh buffers the prefill cache can't alias
         )
+
+    def push_lora(self, lora) -> None:
+        """In-flight weight update (PipelineRL-style): the next dispatched
+        decode step onwards samples under this adapter, without waiting for
+        the round to drain. Adapter shapes must match (the jitted step sees
+        new VALUES, not new shapes — no recompile).
+
+        Semantics: KV already resident stays as the OLD adapter computed it
+        (the stale-KV regime in-flight updating accepts); post-swap tokens
+        sample from the new adapter's forward over that cache. The captured
+        per-token behavior logprob is the TRUE probability of that mixed
+        sampling process, which is exactly what the PPO-clip ratio needs —
+        enable via ``--inflight_weight_updates`` (requires clip_ratio > 0)."""
+        self._pending_lora = lora
+
+    def _take_pending_lora(self, lora_cell: list, dispatched: int) -> None:
+        pending = self._pending_lora
+        if pending is not None:
+            self._pending_lora = None
+            lora_cell[0] = pending
+            self.last_swap_steps.append(dispatched)
 
     def bucket_for(self, prompt_mask) -> int:
         """The bucket a batch with this mask will run at: the smallest bucket
@@ -397,14 +421,22 @@ class GenerationEngine:
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
         top_p_impl = "exact" if sampling.top_p_exact else "bisect"
-        state = run_decode_loop(
-            lambda s: decode_step_fn(
-                params, lora, s, rng,
+        lora_cell = [lora]
+        steps_seen = [0]
+
+        def step(s):
+            # in-flight weight-update mailbox: swap BEFORE sampling, so the
+            # recorded swap step is the first position decoded under the new
+            # adapter (dense decode: step index == generated position)
+            self._take_pending_lora(lora_cell, steps_seen[0])
+            steps_seen[0] += 1
+            return decode_step_fn(
+                params, lora_cell[0], s, rng,
                 eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
                 top_p_impl=top_p_impl,
-            ),
-            state, max_steps, self.decode_chunk,
-        )
+            )
+
+        state = run_decode_loop(step, state, max_steps, self.decode_chunk)
         out = np.asarray(state.out).reshape(b, sampling.n, max_steps)
         lengths = np.asarray(state.lengths).reshape(b, sampling.n)
         logps = (

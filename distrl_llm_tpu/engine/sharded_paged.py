@@ -136,9 +136,17 @@ class ShardedPagedEngine:
             capture_logprobs=capture_logprobs,
         )
         self._built: dict[tuple, tuple] = {}
+        # in-flight weight-update mailbox (push_lora — see engine.py)
+        self._pending_lora = None
+        self.last_swap_steps: list[int] = []
 
     def bucket_for(self, prompt_mask) -> int:
         return self.max_prompt_tokens
+
+    def push_lora(self, lora) -> None:
+        """In-flight weight update (see GenerationEngine.push_lora); the
+        replicated adapter reaches every dp shard on the next dispatch."""
+        self._pending_lora = lora
 
     # ------------------------------------------------------------------ build
 
@@ -255,10 +263,19 @@ class ShardedPagedEngine:
         )
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
-        state = run_decode_loop(
-            lambda s: step(params, lora, s, rng, table, temperature, top_p),
-            state, max_steps, self.decode_chunk,
-        )
+        lora_cell = [lora]
+        steps_seen = [0]
+
+        def step_fn(s):
+            pending = self._pending_lora
+            if pending is not None:
+                self._pending_lora = None
+                lora_cell[0] = pending
+                self.last_swap_steps.append(steps_seen[0])
+            steps_seen[0] += 1
+            return step(params, lora_cell[0], s, rng, table, temperature, top_p)
+
+        state = run_decode_loop(step_fn, state, max_steps, self.decode_chunk)
         out = np.asarray(state.out).reshape(b_pad, n, max_steps)[:b]
         lengths = np.asarray(state.gen_lengths).reshape(b_pad, n)[:b]
         logps = (

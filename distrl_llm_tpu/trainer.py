@@ -973,6 +973,15 @@ class Trainer:
             loss = float(loss)
         self.weight_version += 1
         self._push_weights()
+        if cfg.inflight_weight_updates:
+            # PipelineRL-style: hand the fresh adapter to the generation
+            # round still in flight on the rollout thread — engines swap at
+            # their next decode dispatch (push_lora mailbox); the captured
+            # behavior logprobs keep the clip objective honest about which
+            # policy sampled each token
+            push = getattr(self.engine, "push_lora", None)
+            if push is not None:
+                push(self._lora_rollout)
 
         if cfg.write_adapter_file:
             self.save_adapter()

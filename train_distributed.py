@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "batch t (one-step-off-policy; LlamaRL/PipelineRL-"
                         "style overlap). Default: reference-parity "
                         "synchronous loop")
+    p.add_argument("--inflight_weight_updates", action="store_true",
+                   help="push each optimizer step's adapter into the "
+                        "generation round still in flight (PipelineRL-style; "
+                        "requires --async_rollout and --clip_ratio > 0 — the "
+                        "clip objective consumes the captured per-token "
+                        "behavior logprobs)")
     p.add_argument("--rollout_workers", type=str, default="",
                    help="comma-separated control-plane workers "
                         "(host:port,...) to dispatch generation to; start "
