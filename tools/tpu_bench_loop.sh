@@ -48,6 +48,15 @@ for i in $(seq 1 200); do
     echo "learner rc=$?: $(tail -c 300 /tmp/bench_tpu_learner.json)"
     timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1
     echo "kernel check rc=$?:"; cat /tmp/tpu_kernel_tests.log | grep -E "PASS|FAIL" || tail -3 /tmp/tpu_kernel_tests.log
+    # real-scale learning curve on silicon (random-init 0.5B + digit reward;
+    # no weights needed) — artifact lands in media/
+    timeout 3000 python tools/train_curve.py --model synth-qwen2.5-0.5b \
+      --episodes 12 > /tmp/train_curve_tpu.log 2>&1
+    echo "train curve rc=$?: $(tail -2 /tmp/train_curve_tpu.log)"
+    # compile-time HBM ground truth for the config-2 table (BASELINE.md)
+    GRAFT_MEMORY_COMPILE=1 timeout 1200 python tools/memory_envelope.py \
+      > /tmp/memory_envelope_tpu.log 2>&1
+    echo "memory envelope rc=$?: $(tail -5 /tmp/memory_envelope_tpu.log)"
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i: TPU down"

@@ -27,6 +27,70 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
+    """Real-scale learning without downloadable weights: a RANDOM-INIT
+    QWEN2_0_5B policy + the dense digit-fraction reward. The policy can't
+    solve MATH from random init, but it CAN learn to emit digits — the same
+    full-loop learning signal as the tiny run at BASELINE config-1 model
+    scale, runnable the moment a chip answers (no egress required)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distrl_llm_tpu.config import TrainConfig
+    from distrl_llm_tpu.engine import PagedGenerationEngine
+    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.models import PRESETS, init_params
+    from distrl_llm_tpu.models.lora import lora_scale
+    from distrl_llm_tpu.tokenizer import CharTokenizer
+    from distrl_llm_tpu.trainer import Trainer
+
+    class Capture(MetricsSink):
+        def __init__(self):
+            self.records = []
+
+        def log(self, metrics, step=None):
+            self.records.append((step, dict(metrics)))
+
+        def finish(self):
+            pass
+
+    def digit_reward(completions, solutions):
+        return np.asarray(
+            [(0.0, sum(1 for ch in c if "0" <= ch <= "9") / max(len(c), 1))
+             for c in completions],
+            np.float32,
+        )
+
+    cfg_model = PRESETS[model_name]
+    config = TrainConfig(
+        model=model_name, learner=learner, episodes=episodes, lr=5e-4,
+        max_prompt_tokens=64, max_new_tokens=128, batch_size=8,
+        num_candidates=8, topk=8, train_batch_size=16, max_lora_rank=16,
+        lora_alpha=32, number_of_actors=1, number_of_learners=1,
+        learner_chunk_size=0, metrics_backend="null",
+    )
+    tok = CharTokenizer(vocab_size=cfg_model.vocab_size)
+    problems = [f"write numbers about {c}" for c in "abcdefghijklmnop"]
+    train = {"problem": problems, "solution": ["0"] * len(problems)}
+    engine = PagedGenerationEngine(
+        cfg_model, max_prompt_tokens=64, max_new_tokens=128,
+        eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+        lora_scale=lora_scale(16, 32.0), page_size=64,
+        max_concurrent_rows=64, scheduler="refill", decode_chunk=16,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg_model, dtype=jnp.bfloat16)
+    sink = Capture()
+    trainer = Trainer(
+        train, dict(train), digit_reward, config,
+        tokenizer=tok, engine=engine, base_params=params,
+        model_cfg=cfg_model, sink=sink,
+    )
+    trainer.train()
+    recs = [m for _, m in sink.records if "mean_accuracy_reward" in m]
+    return recs, f"synth-{model_name}"
+
+
 def run_tiny(episodes: int, learner: str):
     import jax
     import jax.numpy as jnp
@@ -138,6 +202,10 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         records, tag = run_tiny(args.episodes, args.learner)
+    elif args.model.startswith("synth-"):
+        records, tag = run_synth(
+            args.episodes, args.learner, args.model.removeprefix("synth-")
+        )
     else:
         records, tag = run_checkpoint(args.model, args.episodes, args.learner)
 
