@@ -306,8 +306,12 @@ class GenerationEngine:
         # concurrent generate() calls (hybrid rollout: actor + learner
         # submeshes decode in parallel threads) share the compiled-fn cache
         self._compile_mu = threading.Lock()
-        # in-flight weight-update mailbox (push_lora)
+        # in-flight weight-update mailbox (push_lora); _swapped_lora carries
+        # a consumed swap across the WAVES of one round (each wave builds a
+        # fresh closure from the round-entry adapter, which would otherwise
+        # silently revert the swap)
         self._pending_lora = None
+        self._swapped_lora = None
         self.last_swap_steps: list[int] = []
 
         # n and max_steps are static (shape-determining)
@@ -336,6 +340,7 @@ class GenerationEngine:
         pending = self._pending_lora
         if pending is not None:
             self._pending_lora = None
+            self._swapped_lora = pending
             lora_cell[0] = pending
             self.last_swap_steps.append(dispatched)
 
@@ -388,6 +393,9 @@ class GenerationEngine:
         sampling: SamplingConfig,
         rng: jax.Array,
     ) -> GenerationResult:
+        # a new round supersedes any swap consumed during the previous one
+        # (the trainer hands the freshest adapter at round entry)
+        self._swapped_lora = None
         return generate_in_waves(
             self._generate_wave, self.max_concurrent_rows, params, lora,
             prompt_ids, prompt_mask, sampling, rng, self.pad_id,
@@ -401,6 +409,10 @@ class GenerationEngine:
         if p != self.max_prompt_tokens:
             raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
         max_steps = min(sampling.max_tokens, self.max_new_tokens)
+        if self._swapped_lora is not None:
+            # an in-flight swap from an earlier wave of THIS round also
+            # covers this wave's prefill (its rows haven't sampled yet)
+            lora = self._swapped_lora
 
         # bucket selection: smallest bucket holding the longest real prompt;
         # prompts are left-padded, so the bucket keeps the trailing columns

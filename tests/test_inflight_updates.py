@@ -112,6 +112,42 @@ class TestSwapSemantics:
         # ...and the post-swap distribution is genuinely not A's anymore
         assert np.abs(got[post] - under_a[post]).max() > 1e-3
 
+    def test_swap_persists_across_waves(self, setup):
+        """A row cap forces multiple waves; a swap consumed in wave 1 must
+        NOT revert in wave 2 (each wave builds a fresh closure from the
+        round-entry adapter), and wave 2's prefill runs under the swap."""
+        params, lora_a, lora_b, ids, mask = setup
+        big_ids = np.concatenate([ids, ids], axis=0)
+        big_mask = np.concatenate([mask, mask], axis=0)
+
+        def run(push):
+            eng = GenerationEngine(
+                TINY, max_prompt_tokens=16, max_new_tokens=24,
+                eos_token_ids=[1], pad_token_id=0, cache_dtype=jnp.float32,
+                lora_scale=SCALE, decode_chunk=4,
+                max_concurrent_rows=4,  # 8 prompts → 2 waves
+            )
+            if push:
+                eng.push_lora(lora_b)
+            res = eng.generate(
+                params, lora_a, big_ids, big_mask, GREEDY, jax.random.PRNGKey(7))
+            return eng, res
+
+        _, base = run(push=False)
+        eng, swapped = run(push=True)
+        assert len(eng.last_swap_steps) == 1  # consumed once, in wave 1
+        # wave 2 (rows 4..8) decodes fully under B — must diverge from pure A
+        assert not np.array_equal(swapped.tokens[4:], base.tokens[4:])
+        full_b = run(push=False)[0].generate(
+            params, lora_b, big_ids, big_mask, GREEDY, jax.random.PRNGKey(7))
+        # wave 2 started fresh under B (prefill + decode): identical to a
+        # pure-B run's wave 2
+        np.testing.assert_array_equal(swapped.tokens[4:], full_b.tokens[4:])
+        # a NEW round resets the carried swap back to the passed adapter
+        again = eng.generate(
+            params, lora_a, big_ids, big_mask, GREEDY, jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(again.tokens, base.tokens)
+
     def test_refill_scheduler_swaps_and_completes(self, setup):
         params, lora_a, lora_b, ids, mask = setup
         eng = PagedGenerationEngine(
