@@ -256,7 +256,49 @@ def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
     return state
 
 
-class GenerationEngine:
+class LoraMailbox:
+    """In-flight weight-update mailbox shared by every engine (PipelineRL —
+    see ``push_lora``). ``_swapped_lora`` carries a consumed swap across the
+    WAVES of one round (each wave builds a fresh closure from the
+    round-entry adapter, which would otherwise silently revert the swap);
+    ``_reset_lora_mailbox_round`` runs at round entry so a new round's
+    trainer-passed adapter supersedes the carry."""
+
+    _pending_lora = None
+    _swapped_lora = None
+
+    def push_lora(self, lora) -> None:
+        """In-flight weight update (PipelineRL-style): the next dispatched
+        decode step onwards samples under this adapter, without waiting for
+        the round to drain. Adapter shapes must match (the jitted step sees
+        new VALUES, not new shapes — no recompile).
+
+        Semantics: KV already resident stays as the OLD adapter computed it
+        (the stale-KV regime in-flight updating accepts); post-swap tokens
+        sample from the new adapter's forward over that cache. The captured
+        per-token behavior logprob is the TRUE probability of that mixed
+        sampling process, which is exactly what the PPO-clip ratio needs —
+        enable via ``--inflight_weight_updates`` (requires clip_ratio > 0)."""
+        self._pending_lora = lora
+
+    def _take_pending_lora(self, lora_cell: list, dispatched: int) -> None:
+        pending = self._pending_lora
+        if pending is not None:
+            self._pending_lora = None
+            self._swapped_lora = pending
+            lora_cell[0] = pending
+            self.last_swap_steps.append(dispatched)
+
+    def _round_entry_lora(self, lora):
+        """Adapter a wave should open with: the in-round swap if one
+        happened, else the caller's."""
+        return self._swapped_lora if self._swapped_lora is not None else lora
+
+    def _reset_lora_mailbox_round(self) -> None:
+        self._swapped_lora = None
+
+
+class GenerationEngine(LoraMailbox):
     """Compiled rollout engine bound to (model config, shapes, eos/pad ids).
 
     ``generate`` is the ``vllm_generate`` equivalent: prompts in, per-candidate
@@ -306,12 +348,7 @@ class GenerationEngine:
         # concurrent generate() calls (hybrid rollout: actor + learner
         # submeshes decode in parallel threads) share the compiled-fn cache
         self._compile_mu = threading.Lock()
-        # in-flight weight-update mailbox (push_lora); _swapped_lora carries
-        # a consumed swap across the WAVES of one round (each wave builds a
-        # fresh closure from the round-entry adapter, which would otherwise
-        # silently revert the swap)
-        self._pending_lora = None
-        self._swapped_lora = None
+        # in-flight weight-update mailbox (LoraMailbox base)
         self.last_swap_steps: list[int] = []
 
         # n and max_steps are static (shape-determining)
@@ -321,28 +358,6 @@ class GenerationEngine:
             # no cache donation: the candidate fan-out (jnp.repeat to B·n
             # rows) allocates fresh buffers the prefill cache can't alias
         )
-
-    def push_lora(self, lora) -> None:
-        """In-flight weight update (PipelineRL-style): the next dispatched
-        decode step onwards samples under this adapter, without waiting for
-        the round to drain. Adapter shapes must match (the jitted step sees
-        new VALUES, not new shapes — no recompile).
-
-        Semantics: KV already resident stays as the OLD adapter computed it
-        (the stale-KV regime in-flight updating accepts); post-swap tokens
-        sample from the new adapter's forward over that cache. The captured
-        per-token behavior logprob is the TRUE probability of that mixed
-        sampling process, which is exactly what the PPO-clip ratio needs —
-        enable via ``--inflight_weight_updates`` (requires clip_ratio > 0)."""
-        self._pending_lora = lora
-
-    def _take_pending_lora(self, lora_cell: list, dispatched: int) -> None:
-        pending = self._pending_lora
-        if pending is not None:
-            self._pending_lora = None
-            self._swapped_lora = pending
-            lora_cell[0] = pending
-            self.last_swap_steps.append(dispatched)
 
     def bucket_for(self, prompt_mask) -> int:
         """The bucket a batch with this mask will run at: the smallest bucket
@@ -395,7 +410,7 @@ class GenerationEngine:
     ) -> GenerationResult:
         # a new round supersedes any swap consumed during the previous one
         # (the trainer hands the freshest adapter at round entry)
-        self._swapped_lora = None
+        self._reset_lora_mailbox_round()
         return generate_in_waves(
             self._generate_wave, self.max_concurrent_rows, params, lora,
             prompt_ids, prompt_mask, sampling, rng, self.pad_id,
@@ -409,10 +424,9 @@ class GenerationEngine:
         if p != self.max_prompt_tokens:
             raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
         max_steps = min(sampling.max_tokens, self.max_new_tokens)
-        if self._swapped_lora is not None:
-            # an in-flight swap from an earlier wave of THIS round also
-            # covers this wave's prefill (its rows haven't sampled yet)
-            lora = self._swapped_lora
+        # an in-flight swap from an earlier wave of THIS round also covers
+        # this wave's prefill (its rows haven't sampled yet)
+        lora = self._round_entry_lora(lora)
 
         # bucket selection: smallest bucket holding the longest real prompt;
         # prompts are left-padded, so the bucket keeps the trailing columns

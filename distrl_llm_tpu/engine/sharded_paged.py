@@ -44,7 +44,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrl_llm_tpu.config import SamplingConfig
-from distrl_llm_tpu.engine.engine import GenerationResult, run_decode_loop
+from distrl_llm_tpu.engine.engine import (
+    GenerationResult,
+    LoraMailbox,
+    run_decode_loop,
+)
 from distrl_llm_tpu.engine.paged_engine import (
     _paged_decode_step,
     _paged_fanout,
@@ -79,7 +83,7 @@ def shard_map(f, *, mesh, in_specs, out_specs):
 Params = dict[str, Any]
 
 
-class ShardedPagedEngine:
+class ShardedPagedEngine(LoraMailbox):
     """Paged wave-mode generation with the page pool partitioned over "dp"."""
 
     def __init__(
@@ -136,17 +140,11 @@ class ShardedPagedEngine:
             capture_logprobs=capture_logprobs,
         )
         self._built: dict[tuple, tuple] = {}
-        # in-flight weight-update mailbox (push_lora — see engine.py)
-        self._pending_lora = None
+        # in-flight weight-update mailbox (LoraMailbox base)
         self.last_swap_steps: list[int] = []
 
     def bucket_for(self, prompt_mask) -> int:
         return self.max_prompt_tokens
-
-    def push_lora(self, lora) -> None:
-        """In-flight weight update (see GenerationEngine.push_lora); the
-        replicated adapter reaches every dp shard on the next dispatch."""
-        self._pending_lora = lora
 
     # ------------------------------------------------------------------ build
 
@@ -263,15 +261,12 @@ class ShardedPagedEngine:
         )
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
+        self._reset_lora_mailbox_round()
         lora_cell = [lora]
         steps_seen = [0]
 
         def step_fn(s):
-            pending = self._pending_lora
-            if pending is not None:
-                self._pending_lora = None
-                lora_cell[0] = pending
-                self.last_swap_steps.append(steps_seen[0])
+            self._take_pending_lora(lora_cell, steps_seen[0])
             steps_seen[0] += 1
             return step(params, lora_cell[0], s, rng, table, temperature, top_p)
 

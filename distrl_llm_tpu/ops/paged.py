@@ -288,6 +288,43 @@ def paged_attention_reference(
 
 
 _kernel_fail_warned = False
+_compact_int8_state: dict = {"ok": None}
+
+
+def _compact_int8_available() -> bool:
+    """One-time probe: compile + run the compact-scales int8 launch at tiny
+    shapes on the REAL backend. The launch is validated under the Pallas
+    interpreter in CI, but a Mosaic lowering rejection (or jaxlib internal
+    kernel drift) would otherwise surface as a compile error inside the
+    engine's jitted step — past the point where ``impl="auto"`` could fall
+    back. Probing in an isolated jit keeps auto mode graceful: on failure
+    we warn once and route int8 pages through jaxlib's broadcasting wrapper
+    (slower, but working)."""
+    st = _compact_int8_state
+    if st["ok"] is None:
+        try:
+            from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
+
+            b, h, k, hd, ps, pps = 1, 8, 1, 128, 16, 4
+            kq = init_quantized_pages((k, b * pps, ps, hd))
+            out = paged_attention_int8(
+                jnp.zeros((b, h, hd), jnp.bfloat16), kq, kq,
+                jnp.ones((b,), jnp.int32),
+                jnp.asarray(make_page_table(b, pps * ps, ps)),
+                pages_per_compute_block=1,
+            )
+            jax.block_until_ready(out)
+            st["ok"] = True
+        except Exception as e:  # noqa: BLE001 — any failure → jaxlib path
+            st["ok"] = False
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "compact-scales int8 launch unavailable on this backend "
+                "(%s); int8 KV falls back to jaxlib's broadcasting wrapper",
+                e,
+            )
+    return st["ok"]
 
 
 def paged_attention_op(
@@ -322,10 +359,14 @@ def paged_attention_op(
                 default=1,
             )
             scaled_q = q * (q.shape[-1] ** -0.5)
-            if is_quantized_pages(k_pages):
+            if is_quantized_pages(k_pages) and (
+                impl == "kernel" or _compact_int8_available()
+            ):
                 # jaxlib's wrapper broadcasts scales to head_dim (a
                 # full-cache f32 temp per step); our launch ships them
-                # compact — same kernel, ~1/5 the int8 read traffic
+                # compact — same kernel, ~1/5 the int8 read traffic. auto
+                # mode probe-compiles once and falls back to the jaxlib
+                # wrapper below if the backend rejects the compact launch
                 from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
 
                 return paged_attention_int8(

@@ -195,22 +195,12 @@ class TestTrainerIntegration:
     def test_async_training_pushes_inflight(self, setup):
         """Full async loop with a REAL engine: the trainer must push each
         update's adapter into the engine mailbox; training stays finite."""
-        from distrl_llm_tpu.metrics import MetricsSink
+        from distrl_llm_tpu.metrics import MemorySink
         from distrl_llm_tpu.rewards import reward_function
         from distrl_llm_tpu.tokenizer import CharTokenizer
         from distrl_llm_tpu.trainer import Trainer
 
         params, *_ = setup
-
-        class Sink(MetricsSink):
-            def __init__(self):
-                self.records = []
-
-            def log(self, metrics, step=None):
-                self.records.append(dict(metrics))
-
-            def finish(self):
-                pass
 
         tok = CharTokenizer()
         cfg = TrainConfig(
@@ -229,14 +219,14 @@ class TestTrainerIntegration:
         )
         train = {"problem": ["q a", "q b", "q c", "q d"],
                  "solution": ["A", "B", "C", "D"]}
-        sink = Sink()
+        sink = MemorySink()
         trainer = Trainer(
             train, dict(train), reward_function, cfg,
             tokenizer=tok, engine=eng, base_params=params,
             model_cfg=TINY, sink=sink,
         )
         trainer.train()
-        recs = [m for m in sink.records if "loss" in m]
+        recs = [m for _, m in sink.records if "loss" in m]
         assert recs and all(np.isfinite(m["loss"]) for m in recs)
         # at least one update landed while a round was in flight (the last
         # batch of the last episode has no successor round to swap into)

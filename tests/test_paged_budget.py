@@ -68,20 +68,10 @@ class TestTrainerWithBudgetedEngine:
         on the engine's token ids + behavior logprobs, including candidates
         that were evicted and resumed mid-decode."""
         from distrl_llm_tpu.config import TrainConfig
-        from distrl_llm_tpu.metrics import MetricsSink
+        from distrl_llm_tpu.metrics import MemorySink
         from distrl_llm_tpu.rewards import reward_function
         from distrl_llm_tpu.tokenizer import CharTokenizer
         from distrl_llm_tpu.trainer import Trainer
-
-        class Sink(MetricsSink):
-            def __init__(self):
-                self.records = []
-
-            def log(self, metrics, step=None):
-                self.records.append(dict(metrics))
-
-            def finish(self):
-                pass
 
         cfg = TrainConfig(
             model="tiny", episodes=1, batch_size=4, num_candidates=4, topk=4,
@@ -100,7 +90,7 @@ class TestTrainerWithBudgetedEngine:
         )
         train = {"problem": ["q a", "q b", "q c", "q d"],
                  "solution": ["A", "B", "C", "D"]}
-        sink = Sink()
+        sink = MemorySink()
         trainer = Trainer(
             train, dict(train), reward_function, cfg,
             tokenizer=tok, engine=eng, base_params=tiny_params,
@@ -108,7 +98,7 @@ class TestTrainerWithBudgetedEngine:
         )
         trainer._train_batch(train, episode=0)
         assert eng.last_pool_stats["preemptions"] > 0, eng.last_pool_stats
-        recs = [m for m in sink.records if "loss" in m]
+        recs = [m for _, m in sink.records if "loss" in m]
         assert recs and np.isfinite(recs[-1]["loss"])
 
 

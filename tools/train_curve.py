@@ -39,21 +39,11 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
 
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.engine import PagedGenerationEngine
-    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.models import PRESETS, init_params
     from distrl_llm_tpu.models.lora import lora_scale
     from distrl_llm_tpu.tokenizer import CharTokenizer
     from distrl_llm_tpu.trainer import Trainer
-
-    class Capture(MetricsSink):
-        def __init__(self):
-            self.records = []
-
-        def log(self, metrics, step=None):
-            self.records.append((step, dict(metrics)))
-
-        def finish(self):
-            pass
 
     def digit_reward(completions, solutions):
         return np.asarray(
@@ -80,7 +70,7 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
         max_concurrent_rows=64, scheduler="refill", decode_chunk=16,
     )
     params = init_params(jax.random.PRNGKey(0), cfg_model, dtype=jnp.bfloat16)
-    sink = Capture()
+    sink = MemorySink()
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine, base_params=params,
@@ -98,21 +88,11 @@ def run_tiny(episodes: int, learner: str):
 
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.engine import GenerationEngine
-    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.models import TINY, init_params
     from distrl_llm_tpu.models.lora import lora_scale
     from distrl_llm_tpu.tokenizer import CharTokenizer
     from distrl_llm_tpu.trainer import Trainer
-
-    class Capture(MetricsSink):
-        def __init__(self):
-            self.records = []
-
-        def log(self, metrics, step=None):
-            self.records.append((step, dict(metrics)))
-
-        def finish(self):
-            pass
 
     def digit_reward(completions, solutions):
         return np.asarray(
@@ -138,7 +118,7 @@ def run_tiny(episodes: int, learner: str):
         cache_dtype=jnp.float32,
         lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
     )
-    sink = Capture()
+    sink = MemorySink()
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine,
@@ -152,20 +132,10 @@ def run_tiny(episodes: int, learner: str):
 def run_checkpoint(path: str, episodes: int, learner: str):
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.data import prepare_dataset
-    from distrl_llm_tpu.metrics import MetricsSink
+    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.rewards import reward_function
     from distrl_llm_tpu.tokenizer import load_tokenizer
     from distrl_llm_tpu.trainer import Trainer
-
-    class Capture(MetricsSink):
-        def __init__(self):
-            self.records = []
-
-        def log(self, metrics, step=None):
-            self.records.append((step, dict(metrics)))
-
-        def finish(self):
-            pass
 
     config = TrainConfig(
         model=path, learner=learner, episodes=episodes,
@@ -177,7 +147,7 @@ def run_checkpoint(path: str, episodes: int, learner: str):
     train, test = prepare_dataset(
         config.dataset, tokenizer, test_size=0.1, seed=config.seed
     )
-    sink = Capture()
+    sink = MemorySink()
     trainer = Trainer.from_pretrained(
         train, test, reward_function, config, checkpoint_path=path,
         tokenizer=tokenizer, sink=sink,
