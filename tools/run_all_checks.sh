@@ -1,0 +1,57 @@
+#!/bin/bash
+# Full local validation battery (CPU host): the checks a round should be
+# green on before it ends. Each stage prints PASS/FAIL; exits nonzero if any
+# stage fails. Suite stages are chunked so each stays under ~10 minutes.
+#
+# Usage: bash tools/run_all_checks.sh [--quick]
+#   --quick: entry points + one representative suite chunk only
+cd "$(dirname "$0")/.."
+set -u
+fails=0
+
+stage() {
+  local name="$1"; shift
+  echo "=== $name"
+  if "$@"; then echo "PASS $name"; else echo "FAIL $name"; fails=$((fails+1)); fi
+}
+
+stage "dryrun_multichip" timeout 300 python __graft_entry__.py
+stage "cli_smoke" env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  timeout 600 python train_distributed.py --smoke
+stage "bench_fallback" env JAX_PLATFORMS=cpu BENCH_MODEL=tiny BENCH_PROMPTS=4 \
+  BENCH_CANDIDATES=2 BENCH_MAX_PROMPT=32 BENCH_MAX_NEW=32 \
+  timeout 600 python bench.py
+
+if [ "${1:-}" = "--quick" ]; then
+  stage "suite_quick" timeout 600 python -m pytest \
+    tests/test_paged_budget.py tests/test_config.py -q
+  echo "quick done: $fails failure(s)"; exit $((fails > 0))
+fi
+
+stage "suite_trainer" timeout 600 python -m pytest -q \
+  tests/test_trainer.py tests/test_async_rollout.py tests/test_clip_objective.py \
+  tests/test_failure_and_resume.py tests/test_role_separation.py
+stage "suite_engines_1" timeout 600 python -m pytest -q \
+  tests/test_engine.py tests/test_paged.py
+stage "suite_engines_2" timeout 600 python -m pytest -q \
+  tests/test_speculative.py tests/test_sharded_paged.py
+stage "suite_engines_3" timeout 600 python -m pytest -q \
+  tests/test_paged_budget.py tests/test_inflight_updates.py \
+  tests/test_paged_int8_kernel.py
+stage "suite_learner" timeout 600 python -m pytest -q \
+  tests/test_train_step.py tests/test_losses.py tests/test_model_golden.py \
+  tests/test_lora.py tests/test_optim.py tests/test_quant.py tests/test_sharding.py
+stage "suite_ops" timeout 600 python -m pytest -q \
+  tests/test_flash_attention.py tests/test_splash.py tests/test_ring_attention.py \
+  tests/test_ulysses.py tests/test_chunking.py tests/test_sampling.py
+stage "suite_misc" timeout 600 python -m pytest -q \
+  tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
+  tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py
+stage "suite_io" timeout 600 python -m pytest -q \
+  tests/test_from_pretrained.py tests/test_remote_engine.py \
+  tests/test_native_tokenizer.py tests/test_native_spm.py \
+  tests/test_config.py tests/test_cli.py
+
+echo "done: $fails failure(s)"
+exit $((fails > 0))
