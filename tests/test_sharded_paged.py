@@ -124,6 +124,34 @@ class TestShardedParity:
             np.array_equal(rows[0], rows[k]) for k in (2, 4, 6)
         )
 
+    def test_inflight_swap_reaches_all_shards(self, tiny_params):
+        """push_lora (LoraMailbox) must swap the adapter on every dp shard:
+        greedy outputs diverge from the no-swap run in rows of more than one
+        shard."""
+        from distrl_llm_tpu.models import init_lora_params
+
+        lora = init_lora_params(jax.random.PRNGKey(11), TINY, rank=4)
+        bumped = jax.tree_util.tree_map(
+            lambda l: l + 0.5, init_lora_params(jax.random.PRNGKey(12), TINY, rank=4)
+        )
+        ids, mask = _prompts(8, seed=15, ragged=False)
+
+        def run(push):
+            _, eng = _engines(tiny_params)
+            if push:
+                eng.push_lora(bumped)
+            return eng, eng.generate(
+                tiny_params, lora, ids, mask, GREEDY, jax.random.PRNGKey(6))
+
+        _, base = run(False)
+        eng, swapped = run(True)
+        assert eng.last_swap_steps == [0]
+        changed_shards = {
+            r // 2 for r in range(8)
+            if not np.array_equal(swapped.tokens[r], base.tokens[r])
+        }
+        assert len(changed_shards) > 1, changed_shards
+
     def test_mesh_validation(self, tiny_params):
         devs = np.array(jax.devices()[:4]).reshape(2, 2)
         mesh = Mesh(devs, ("dp", "tp"))
