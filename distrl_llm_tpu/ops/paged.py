@@ -288,43 +288,67 @@ def paged_attention_reference(
 
 
 _kernel_fail_warned = False
-_compact_int8_state: dict = {"ok": None}
+_fixed_launch_state: dict = {}
 
 
-def _compact_int8_available() -> bool:
-    """One-time probe: compile + run the compact-scales int8 launch at tiny
-    shapes on the REAL backend. The launch is validated under the Pallas
+def _fixed_launch_available(
+    quantized: bool,
+    num_groups: int,
+    head_dim: int,
+    page_size: int,
+    q_dtype,
+    kv_dtype,
+    blocks: int,
+) -> bool:
+    """Per-config probe: compile + run our corrected launch at tiny shapes
+    on the REAL backend. The launch is validated under the Pallas
     interpreter in CI, but a Mosaic lowering rejection (or jaxlib internal
     kernel drift) would otherwise surface as a compile error inside the
     engine's jitted step — past the point where ``impl="auto"`` could fall
-    back. Probing in an isolated jit keeps auto mode graceful: on failure
-    we warn once and route int8 pages through jaxlib's broadcasting wrapper
-    (slower, but working)."""
-    st = _compact_int8_state
-    if st["ok"] is None:
-        try:
-            from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
+    back. Probing in an isolated computation keeps auto mode graceful: on
+    failure we warn once and route through jaxlib's public wrapper.
 
-            b, h, k, hd, ps, pps = 1, 8, 1, 128, 16, 4
-            kq = init_quantized_pages((k, b * pps, ps, hd))
-            out = paged_attention_int8(
-                jnp.zeros((b, h, hd), jnp.bfloat16), kq, kq,
+    Keyed on the quantities that select Mosaic code paths: the quantization
+    flag (scale scratch layout), num_groups (3-d vs 4-d block specs via
+    ``num_groups % 8``), head_dim, page_size and the compute-block count
+    (VMEM scratch tiling), and the q/KV dtypes (Mosaic tiles bf16 (16,128)
+    vs f32 (8,128), and the 3-d path launches q at its own dtype)."""
+    key = (quantized, num_groups, head_dim, page_size, q_dtype, kv_dtype,
+           blocks)
+    if key not in _fixed_launch_state:
+        try:
+            from distrl_llm_tpu.ops.paged_int8 import (
+                paged_attention_gqa,
+                paged_attention_int8,
+            )
+
+            b, pps = 1, blocks  # one compute block at the real block count
+            shape = (1, b * pps, page_size, head_dim)  # K=1 → H=num_groups
+            if quantized:
+                kq = init_quantized_pages(shape)
+                fn, kp, vp = paged_attention_int8, kq, kq
+            else:
+                kd = jnp.zeros(shape, kv_dtype)
+                fn, kp, vp = paged_attention_gqa, kd, kd
+            out = fn(
+                jnp.zeros((b, num_groups, head_dim), q_dtype), kp, vp,
                 jnp.ones((b,), jnp.int32),
-                jnp.asarray(make_page_table(b, pps * ps, ps)),
-                pages_per_compute_block=1,
+                jnp.asarray(make_page_table(b, pps * page_size, page_size)),
+                pages_per_compute_block=blocks,
             )
             jax.block_until_ready(out)
-            st["ok"] = True
+            _fixed_launch_state[key] = True
         except Exception as e:  # noqa: BLE001 — any failure → jaxlib path
-            st["ok"] = False
+            _fixed_launch_state[key] = False
             import logging
 
             logging.getLogger(__name__).warning(
-                "compact-scales int8 launch unavailable on this backend "
-                "(%s); int8 KV falls back to jaxlib's broadcasting wrapper",
+                "corrected paged-attention launch unavailable on this "
+                "backend for %s (%s); falling back to jaxlib's wrapper",
+                key,
                 e,
             )
-    return st["ok"]
+    return _fixed_launch_state[key]
 
 
 def paged_attention_op(
@@ -359,17 +383,27 @@ def paged_attention_op(
                 default=1,
             )
             scaled_q = q * (q.shape[-1] ** -0.5)
-            if is_quantized_pages(k_pages) and (
-                impl == "kernel" or _compact_int8_available()
+            quantized = is_quantized_pages(k_pages)
+            kw = k_pages.weight if quantized else k_pages
+            num_groups = q.shape[1] // kw.shape[0]
+            head_dim, page_size = kw.shape[-1], kw.shape[-2]
+            # Route through our corrected launch (compact int8 scales +
+            # legal m/l block specs for every (num_groups, head_dim) —
+            # jaxlib's wrapper rejects head_dim % 128 != 0; see
+            # ops/paged_int8.py). auto mode probe-compiles once per config
+            # and falls back to the jaxlib wrapper if the backend rejects
+            # the corrected launch.
+            if impl == "kernel" or _fixed_launch_available(
+                quantized, num_groups, head_dim, page_size,
+                scaled_q.dtype, kw.dtype, blocks,
             ):
-                # jaxlib's wrapper broadcasts scales to head_dim (a
-                # full-cache f32 temp per step); our launch ships them
-                # compact — same kernel, ~1/5 the int8 read traffic. auto
-                # mode probe-compiles once and falls back to the jaxlib
-                # wrapper below if the backend rejects the compact launch
-                from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
+                from distrl_llm_tpu.ops.paged_int8 import (
+                    paged_attention_gqa,
+                    paged_attention_int8,
+                )
 
-                return paged_attention_int8(
+                fn = paged_attention_int8 if quantized else paged_attention_gqa
+                return fn(
                     scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
                     page_indices, pages_per_compute_block=blocks,
                 ).astype(q.dtype)

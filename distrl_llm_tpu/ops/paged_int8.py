@@ -1,23 +1,37 @@
-"""Scale-aware int8 paged-attention launch: compact scales, no broadcast.
+"""Corrected paged-attention launches: compact int8 scales + GQA block fix.
 
-jaxlib's public ``paged_attention`` wrapper broadcasts QuantizedTensor
-scales [K, P, ps, 1] → [K, P, ps, head_dim] f32 BEFORE its pallas_call
-(paged_attention_kernel.py:422), materializing a full-cache-sized f32 array
-in HBM on every decode step — per-element traffic becomes 1 (int8) + 4
-(scales) = 5 bytes vs bf16's 2, NEGATING the int8 bandwidth win (the caveat
-previously documented on ops/paged.py::quantize_pages).
+This module assembles jaxlib's Pallas TPU paged-attention kernel function
+(``paged_flash_attention_kernel_inline_seq_dim`` — a public dependency,
+reused like any library op) with a launch configuration that fixes two
+defects of the public ``paged_attention`` wrapper in the pinned jaxlib:
 
-The kernel itself never needed the broadcast: its per-page DMA descriptor
-slices whatever scale shape it is given, and the in-VMEM dequantize is a
-broadcasting multiply. This module re-assembles the SAME jaxlib kernel
-function (a public dependency, reused like any library op) with:
+1. **Broadcast scales** (int8 KV): the wrapper broadcasts QuantizedTensor
+   scales [K, P, ps, 1] → [K, P, ps, head_dim] f32 BEFORE its pallas_call
+   (paged_attention_kernel.py:422), materializing a full-cache-sized f32
+   array in HBM on every decode step — per-element traffic becomes 1 (int8)
+   + 4 (scales) = 5 bytes vs bf16's 2, NEGATING the int8 bandwidth win.
+   The kernel itself never needed the broadcast: its per-page DMA
+   descriptor slices whatever scale shape it is given, and the in-VMEM
+   dequantize is a broadcasting multiply. We ship scales compact —
+   [K, P, ps, 1] f32 in HBM, [2, blk, ps, 1] VMEM scratch (per-element
+   traffic 1 + 4/head_dim ≈ 1.03 bytes).
 
-* compact scales shipped as-is — [K, P, ps, 1] f32 in HBM, [2, blk, ps, 1]
-  VMEM scratch (per-element traffic 1 + 4/head_dim ≈ 1.03 bytes);
-* the no-megacore, inline-seq-dim launch configuration the engine uses;
-* an ``interpret`` flag so CPU tests can pin numerics against the jnp
-  reference without a chip (tools/tpu_kernel_check.py revalidates the
-  Mosaic lowering on silicon).
+2. **Broken m/l output block specs** (first observed on real silicon,
+   round 3): the wrapper reuses the q block spec — whose last-dim block is
+   ``head_dim`` — for the running-max/denominator outputs, whose arrays
+   have last dim 1. Mosaic's block-shape check ("last two block dims
+   divisible by (8, 128) or equal to the array dims") rejects that
+   whenever ``head_dim`` is not a multiple of 128 (e.g. Qwen2.5-0.5B's
+   head_dim=64, 14q/2kv → 7 groups). The Pallas interpreter never enforces
+   the rule, so CPU parity tests pass while the identical launch fails to
+   lower on a chip. Our launch gives m/l their own block spec with last-dim
+   block 1 — always legal, and the kernel body only ever broadcasts into
+   those refs, so numerics are unchanged.
+
+Both the int8 and the plain (bf16/f32) page paths route through the same
+corrected launch. An ``interpret`` flag lets CPU tests pin numerics against
+the jnp reference without a chip (tools/tpu_kernel_check.py revalidates the
+Mosaic lowering on silicon).
 """
 
 from __future__ import annotations
@@ -35,23 +49,19 @@ from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel impo
 )
 
 
-def paged_attention_int8(
+def _launch(
     q: jax.Array,  # [B, H, hd]
-    k_pages,  # QuantizedTensor: weight int8 [K, P, ps, hd], scales [K, P, ps, 1]
-    v_pages,
+    k_w: jax.Array,  # [K, P, ps, hd] (int8 or bf16/f32)
+    k_s,  # f32 [K, P, ps, 1] or None
+    v_w: jax.Array,
+    v_s,
     lengths: jax.Array,  # i32 [B]
     page_indices: jax.Array,  # i32 [B, pages_per_sequence]
     *,
-    pages_per_compute_block: int = 4,
-    mask_value: float = DEFAULT_MASK_VALUE,
-    interpret: bool = False,
+    pages_per_compute_block: int,
+    mask_value: float,
+    interpret: bool,
 ) -> jax.Array:
-    """GQA paged decode attention over int8 pages with COMPACT scales."""
-    assert isinstance(k_pages, quantization_utils.QuantizedTensor)
-    assert isinstance(v_pages, quantization_utils.QuantizedTensor)
-    k_w, k_s = k_pages.weight, k_pages.scales
-    v_w, v_s = v_pages.weight, v_pages.scales
-
     batch_size, num_q_heads, head_dim = q.shape
     num_kv_heads, _, page_size, head_dim_k = k_w.shape
     _, pages_per_sequence = page_indices.shape
@@ -74,37 +84,63 @@ def paged_attention_int8(
             (None, num_groups, None, head_dim),
             lambda core_index, b, h, *_: (b, h, 0, 0),
         )
+        # m/l arrays are [B, H, 1, 1]: last-dim block must be 1, not head_dim
+        lm_block_spec = pl.BlockSpec(
+            (None, num_groups, None, 1),
+            lambda core_index, b, h, *_: (b, h, 0, 0),
+        )
         q_dtype_for_kernel_launch = jnp.float32
     else:
         q_block_spec = pl.BlockSpec(
             (None, num_groups, head_dim),
             lambda core_index, b, h, *_: (b, h, 0),
         )
+        # m/l arrays are [B, H, 1]
+        lm_block_spec = pl.BlockSpec(
+            (None, num_groups, 1),
+            lambda core_index, b, h, *_: (b, h, 0),
+        )
         q_dtype_for_kernel_launch = q.dtype
 
     grid = (1, batch_size, num_kv_heads)  # no megacore
+    quantized = k_s is not None
     in_specs = [
         q_block_spec,
         pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY) if quantized else None,
         pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY) if quantized else None,
     ]
-    # the one material difference from jaxlib's launch: scale buffers stay
-    # at their stored [ps, 1] shape instead of a broadcast [ps, head_dim]
+    # int8 scale buffers stay at their stored [ps, 1] shape instead of a
+    # broadcast [ps, head_dim]
     scratch_shapes = (
         pltpu.VMEM(
             (2, pages_per_compute_block, page_size, head_dim), k_w.dtype
         ),
-        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), k_s.dtype),
+        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), k_s.dtype)
+        if quantized
+        else None,
         pltpu.VMEM(
             (2, pages_per_compute_block, page_size, head_dim), v_w.dtype
         ),
-        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), v_s.dtype),
+        pltpu.VMEM((2, pages_per_compute_block, page_size, 1), v_s.dtype)
+        if quantized
+        else None,
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
     )
 
+    operands = (
+        lengths,
+        page_indices.reshape(-1),
+        jnp.zeros((1,), jnp.int32),  # buffer index
+        jnp.ones((1,), jnp.int32),  # init flag
+        q.astype(q_dtype_for_kernel_launch),
+        k_w,
+        k_s,  # None when unquantized — matches the None in_spec/scratch
+        v_w,
+        v_s,
+    )
     out, _, _ = pl.pallas_call(
         functools.partial(
             paged_flash_attention_kernel_inline_seq_dim,
@@ -118,7 +154,7 @@ def paged_attention_int8(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             in_specs=in_specs,
-            out_specs=[q_block_spec, q_block_spec, q_block_spec],
+            out_specs=[q_block_spec, lm_block_spec, lm_block_spec],
             grid=grid,
             scratch_shapes=scratch_shapes,
         ),
@@ -131,15 +167,63 @@ def paged_attention_int8(
             jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
         ],
         interpret=interpret,
-    )(
-        lengths,
-        page_indices.reshape(-1),
-        jnp.zeros((1,), jnp.int32),  # buffer index
-        jnp.ones((1,), jnp.int32),  # init flag
-        q.astype(q_dtype_for_kernel_launch),
-        k_w,
-        k_s,
-        v_w,
-        v_s,
-    )
+    )(*operands)
     return out.reshape(batch_size, num_q_heads, head_dim).astype(q.dtype)
+
+
+def paged_attention_int8(
+    q: jax.Array,  # [B, H, hd]
+    k_pages,  # QuantizedTensor: weight int8 [K, P, ps, hd], scales [K, P, ps, 1]
+    v_pages,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pages_per_sequence]
+    *,
+    pages_per_compute_block: int = 4,
+    mask_value: float = DEFAULT_MASK_VALUE,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA paged decode attention over int8 pages with COMPACT scales."""
+    assert isinstance(k_pages, quantization_utils.QuantizedTensor)
+    assert isinstance(v_pages, quantization_utils.QuantizedTensor)
+    return _launch(
+        q,
+        k_pages.weight,
+        k_pages.scales,
+        v_pages.weight,
+        v_pages.scales,
+        lengths,
+        page_indices,
+        pages_per_compute_block=pages_per_compute_block,
+        mask_value=mask_value,
+        interpret=interpret,
+    )
+
+
+def paged_attention_gqa(
+    q: jax.Array,  # [B, H, hd]
+    k_pages: jax.Array,  # [K, P, ps, hd] bf16/f32
+    v_pages: jax.Array,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pages_per_sequence]
+    *,
+    pages_per_compute_block: int = 4,
+    mask_value: float = DEFAULT_MASK_VALUE,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA paged decode attention over plain pages, corrected launch.
+
+    Identical numerics to jaxlib's ``paged_attention`` wrapper, but lowers
+    for every (num_groups, head_dim) combination — the wrapper's m/l block
+    specs reject head_dim not divisible by 128 (see module docstring)."""
+    return _launch(
+        q,
+        k_pages,
+        None,
+        v_pages,
+        None,
+        lengths,
+        page_indices,
+        pages_per_compute_block=pages_per_compute_block,
+        mask_value=mask_value,
+        interpret=interpret,
+    )

@@ -73,3 +73,41 @@ class TestCompactScalesKernel:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
         )
+
+
+class TestCorrectedLaunchPlainPages:
+    """Plain-pages (bf16/f32) route through the same corrected launch.
+
+    Round-3 silicon found jaxlib's public wrapper reuses the q block spec
+    (last-dim block = head_dim) for the m/l outputs (last dim 1), which
+    Mosaic rejects whenever head_dim % 128 != 0 — e.g. Qwen2.5-0.5B
+    (14q/2kv, head_dim 64), the exact shape below. Our launch gives m/l a
+    last-dim-1 block; these tests pin numerics, the on-chip kernel check
+    revalidates lowering."""
+
+    @pytest.mark.parametrize(
+        "b,h,k,hd,ps,pps",
+        [
+            (4, 14, 2, 64, 16, 4),  # qwen2.5-0.5b head geometry (7 groups)
+            (2, 16, 2, 64, 16, 4),  # group == 8 path, head_dim 64
+            (2, 8, 1, 128, 16, 4),  # the only geometry jaxlib's wrapper took
+        ],
+    )
+    def test_matches_reference(self, b, h, k, hd, ps, pps):
+        from distrl_llm_tpu.ops.paged_int8 import paged_attention_gqa
+
+        rng = np.random.default_rng(11)
+        total = b * pps
+        kk = jnp.asarray(rng.normal(size=(k, total, ps, hd)), jnp.float32) * 0.3
+        vv = jnp.asarray(rng.normal(size=(k, total, ps, hd)), jnp.float32) * 0.3
+        q = jnp.asarray(rng.normal(size=(b, h, hd)), jnp.float32)
+        lengths = jnp.asarray(rng.integers(1, pps * ps + 1, size=b), jnp.int32)
+        table = jnp.asarray(make_page_table(b, pps * ps, ps))
+        ref = paged_attention_reference(q, kk, vv, lengths, table)
+        out = paged_attention_gqa(
+            q * hd**-0.5, kk, vv, lengths, table,
+            pages_per_compute_block=2, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
+        )

@@ -1,6 +1,9 @@
 #!/bin/bash
-# Probe the TPU every 3 minutes; when it answers, run the benchmark matrix
-# once and exit. Results land in /tmp/bench_tpu_*.json, progress in the log.
+# Resumable TPU bench matrix. Probes the chip before EVERY stage (the axon
+# tunnel dies mid-session: rounds 1-3 all saw compute hangs), runs each
+# stage once, and marks completion in /tmp/graft_stage_<name>.done so a
+# restart resumes where it left off. Results: /tmp/bench_tpu_*.json,
+# logs:   /tmp/*_tpu.log.  Delete the .done markers to force a re-run.
 cd "$(dirname "$0")/.."
 
 probe() {
@@ -19,47 +22,86 @@ sys.exit(0 if ok else 1)
 EOF
 }
 
-for i in $(seq 1 200); do
-  if probe; then
-    echo "$(date -u +%H:%M:%S) TPU UP — running benches"
-    BENCH_NO_FALLBACK=1 timeout 900 python bench.py > /tmp/bench_tpu_dense.json 2>/tmp/bench_tpu_dense.err
-    echo "dense rc=$?: $(tail -c 300 /tmp/bench_tpu_dense.json)"
-    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged timeout 900 python bench.py > /tmp/bench_tpu_paged.json 2>/tmp/bench_tpu_paged.err
-    echo "paged rc=$?: $(tail -c 300 /tmp/bench_tpu_paged.json)"
-    # scheduler A/B at realistic length variance (mean ~1/0.002 = 500 of
-    # 1200 tokens ≈ the reference's ~470 mean): waves pay each wave's
-    # straggler tail, refill keeps all slots busy
-    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
-      timeout 900 python bench.py > /tmp/bench_tpu_waves_eos.json 2>/tmp/bench_tpu_waves_eos.err
-    echo "waves+eos rc=$?: $(tail -c 300 /tmp/bench_tpu_waves_eos.json)"
-    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill \
-      timeout 900 python bench.py > /tmp/bench_tpu_refill_eos.json 2>/tmp/bench_tpu_refill_eos.err
-    echo "refill+eos rc=$?: $(tail -c 300 /tmp/bench_tpu_refill_eos.json)"
-    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 \
-      timeout 900 python bench.py > /tmp/bench_tpu_spec.json 2>/tmp/bench_tpu_spec.err
-    echo "spec rc=$?: $(tail -c 300 /tmp/bench_tpu_spec.json)"
-    # page-budgeted pool (the --actor_gpu_usage path): grow-as-you-go grants
-    # + preempt-by-recompute at ~realized-length provisioning (1 + 128*6
-    # pages would be worst case at these shapes; 500 forces the budget on)
-    BENCH_NO_FALLBACK=1 BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500 \
-      timeout 900 python bench.py > /tmp/bench_tpu_budget.json 2>/tmp/bench_tpu_budget.err
-    echo "budget rc=$?: $(tail -c 300 /tmp/bench_tpu_budget.json)"
-    BENCH_NO_FALLBACK=1 BENCH_MODE=learner timeout 900 python bench.py > /tmp/bench_tpu_learner.json 2>/tmp/bench_tpu_learner.err
-    echo "learner rc=$?: $(tail -c 300 /tmp/bench_tpu_learner.json)"
-    timeout 900 python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1
-    echo "kernel check rc=$?:"; cat /tmp/tpu_kernel_tests.log | grep -E "PASS|FAIL" || tail -3 /tmp/tpu_kernel_tests.log
-    # real-scale learning curve on silicon (random-init 0.5B + digit reward;
-    # no weights needed) — artifact lands in media/
-    timeout 3000 python tools/train_curve.py --model synth-qwen2.5-0.5b \
-      --episodes 12 > /tmp/train_curve_tpu.log 2>&1
-    echo "train curve rc=$?: $(tail -2 /tmp/train_curve_tpu.log)"
-    # compile-time HBM ground truth for the config-2 table (BASELINE.md)
-    GRAFT_MEMORY_COMPILE=1 timeout 1200 python tools/memory_envelope.py \
-      > /tmp/memory_envelope_tpu.log 2>&1
-    echo "memory envelope rc=$?: $(tail -5 /tmp/memory_envelope_tpu.log)"
-    exit 0
+wait_for_tpu() {
+  local i
+  for i in $(seq 1 300); do
+    if probe; then return 0; fi
+    echo "$(date -u +%H:%M:%S) probe: TPU down (waiting)"
+    sleep 150
+  done
+  return 1
+}
+
+# stage_begin <name>: marker check + TPU wait + stage banner.
+# Sets $marker. Returns 1 if the stage is already done.
+stage_begin() {
+  local name="$1"
+  marker="/tmp/graft_stage_${name}.done"
+  if [ -f "$marker" ]; then
+    echo "$(date -u +%H:%M:%S) skip $name (done)"
+    return 1
   fi
-  echo "$(date -u +%H:%M:%S) probe $i: TPU down"
-  sleep 180
-done
-echo "gave up"
+  wait_for_tpu || { echo "gave up waiting for TPU before $name"; exit 1; }
+  echo "$(date -u +%H:%M:%S) stage $name"
+  return 0
+}
+
+# run_stage <name> <timeout_s> <cmd...>
+run_stage() {
+  local name="$1" tmo="$2"; shift 2
+  stage_begin "$name" || return 0
+  timeout "$tmo" "$@"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) $name rc=$rc"
+  if [ "$rc" = 0 ]; then touch "$marker"; fi
+  return $rc
+}
+
+# bench <name> <out.json> [ENV=V ...] — success additionally requires the
+# result record to be a real TPU measurement, not a fallback.
+bench() {
+  local name="$1" out="$2"; shift 2
+  stage_begin "$name" || return 0
+  env BENCH_NO_FALLBACK=1 "$@" timeout 900 python bench.py \
+      > "$out" 2>"${out%.json}.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) $name rc=$rc: $(tail -c 300 "$out")"
+  if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out"; then touch "$marker"; fi
+}
+
+# --- ordered by information value; dense first (the headline number) -------
+bench dense   /tmp/bench_tpu_dense.json
+bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
+# scheduler A/B at realistic length variance (mean ~1/0.002 = 500 of 1200
+# tokens ≈ the reference's ~470 mean): waves pay each wave's straggler
+# tail, refill keeps all slots busy
+bench refill_eos /tmp/bench_tpu_refill_eos.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill
+bench waves_eos /tmp/bench_tpu_waves_eos.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
+bench spec    /tmp/bench_tpu_spec.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
+# page-budgeted pool (the --actor_gpu_usage path): grow-as-you-go grants
+# + preempt-by-recompute at ~realized-length provisioning (1 + 128*6 pages
+# would be worst case at these shapes; 500 forces the budget on)
+bench budget  /tmp/bench_tpu_budget.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
+bench int8kv  /tmp/bench_tpu_int8kv.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
+bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
+
+run_stage kernel_check 900 bash -c \
+  'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
+   grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
+   exit $rc'
+# real-scale learning curve on silicon (random-init 0.5B + digit reward;
+# no weights needed) — artifact lands in media/
+run_stage train_curve 3000 bash -c \
+  'python tools/train_curve.py --model synth-qwen2.5-0.5b --episodes 12 \
+     > /tmp/train_curve_tpu.log 2>&1; rc=$?; tail -2 /tmp/train_curve_tpu.log; exit $rc'
+# compile-time HBM ground truth for the config-2 table (BASELINE.md)
+run_stage mem_envelope 1200 bash -c \
+  'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
+     > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
+
+echo "$(date -u +%H:%M:%S) matrix complete"

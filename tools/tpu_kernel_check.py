@@ -97,6 +97,23 @@ def main() -> int:
     failures += not ok
     print(f"{'PASS' if ok else 'FAIL'} paged_attention cap={cap} max_err={err.max():.4f}")
 
+    # ---- paged attention, groups%8==0 spec path (3-d block specs) ---------
+    h8 = 16  # 16 q heads / 2 kv heads → 8 groups: the direct-layout path
+    q8 = jnp.asarray(rng.normal(size=(nb, h8, d)), jnp.bfloat16)
+    got = np.asarray(
+        paged_attention_op(q8, k_pages, v_pages, lengths, table, impl="kernel")
+        .astype(jnp.float32)
+    )
+    want = np.asarray(
+        paged_attention_reference(q8, k_pages, v_pages, lengths, table)
+        .astype(jnp.float32)
+    )
+    err = np.abs(got - want)
+    ok = err.max() < 3e-2
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} paged_attention_groups8 cap={cap} "
+          f"max_err={err.max():.4f}")
+
     # ---- int8 compact-scales kernel launch (ops/paged_int8.py) ------------
     from distrl_llm_tpu.ops.paged import quantize_pages
 
