@@ -248,7 +248,34 @@ def main() -> int:
 
     # the CPU fallback's dot thunk has no bf16 support — use f32 off-TPU
     dtype = jnp.bfloat16 if devices[0].platform == "tpu" else jnp.float32
-    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    base_quant = os.environ.get("BENCH_BASE_QUANT", "none")
+    if base_quant not in ("none", "int8", "int4"):
+        # keep the driver contract: ONE parseable JSON line, even on misuse
+        _emit({
+            "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": f"invalid BENCH_BASE_QUANT={base_quant!r} "
+                     "(expected none/int8/int4)",
+            "backend": devices[0].platform,
+        })
+        return 1
+    if base_quant != "none":
+        from distrl_llm_tpu.ops.quant import (
+            default_group_size, quant_bits_for, quantize_params,
+        )
+
+        # init + quantize on the HOST: materializing the full-precision 7B
+        # tree in HBM just to quantize it would blow the very budget int4
+        # exists to fit under
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+            bits = quant_bits_for(base_quant)
+            params = quantize_params(
+                params, bits=bits, group_size=default_group_size(bits)
+            )
+        params = jax.device_put(params, devices[0])
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank, dtype=dtype)
     from distrl_llm_tpu.config import parse_buckets
 
@@ -374,6 +401,7 @@ def main() -> int:
         "vs_baseline": round(tps_chip / REFERENCE_TOKENS_PER_SEC_PER_GPU, 3),
         "mfu": round(mfu, 6),
         "model": name,
+        "base_quant": base_quant,
         "backend": jax.devices()[0].platform,
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,

@@ -57,12 +57,14 @@ run_stage() {
   return $rc
 }
 
-# bench <name> <out.json> [ENV=V ...] — success additionally requires the
-# result record to be a real TPU measurement, not a fallback.
+# bench <name> <out.json> [timeout_s] [ENV=V ...] — success additionally
+# requires the result record to be a real TPU measurement, not a fallback.
 bench() {
   local name="$1" out="$2"; shift 2
+  local tmo=900
+  case "${1:-}" in [0-9]*) tmo="$1"; shift;; esac
   stage_begin "$name" || return 0
-  env BENCH_NO_FALLBACK=1 "$@" timeout 900 python bench.py \
+  env BENCH_NO_FALLBACK=1 "$@" timeout "$tmo" python bench.py \
       > "$out" 2>"${out%.json}.err"
   local rc=$?
   echo "$(date -u +%H:%M:%S) $name rc=$rc: $(tail -c 300 "$out")"
@@ -103,5 +105,13 @@ run_stage train_curve 3000 bash -c \
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
+
+# 7B capacity config (BASELINE config-2): int4 base + int8 KV + refill —
+# the like-for-like model scale against the reference's 7B headline runs.
+# Longer timeout: host-side init+quantize of 7B plus a 7B Mosaic compile.
+bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
+  BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
+  BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
+  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16
 
 echo "$(date -u +%H:%M:%S) matrix complete"
