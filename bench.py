@@ -266,8 +266,15 @@ def main() -> int:
 
         # init + quantize on the HOST: materializing the full-precision 7B
         # tree in HBM just to quantize it would blow the very budget int4
-        # exists to fit under
-        with jax.default_device(jax.devices("cpu")[0]):
+        # exists to fit under. If JAX_PLATFORMS pinned a non-cpu backend
+        # list, the cpu backend is unavailable — quantize on-device then
+        # (fine for small models; a forced-platform run opted out of the
+        # host path explicitly).
+        try:
+            host = jax.devices("cpu")[0]
+        except RuntimeError:
+            host = devices[0]
+        with jax.default_device(host):
             params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
             bits = quant_bits_for(base_quant)
             params = quantize_params(

@@ -74,6 +74,9 @@ bench() {
 # --- ordered by information value; dense first (the headline number) -------
 bench dense   /tmp/bench_tpu_dense.json
 bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
+# dense at realistic length variance: quantifies the wave-straggler cost
+# the refill scheduler exists to remove (A/B against refill_eos below)
+bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
 # scheduler A/B at realistic length variance (mean ~1/0.002 = 500 of 1200
 # tokens ≈ the reference's ~470 mean): waves pay each wave's straggler
 # tail, refill keeps all slots busy

@@ -27,6 +27,45 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+class _StreamingSink:
+    """MemorySink-compatible sink that ALSO streams each record to a
+    partial JSONL (via the package's JsonlSink, so records carry ``_step``
+    and survive non-serializable values) — the axon tunnel can die
+    mid-run, and a half-finished on-chip curve is worth infinitely more
+    than none."""
+
+    def __init__(self, partial_path: str):
+        from distrl_llm_tpu.metrics import JsonlSink
+
+        self.records: list[tuple[int, dict]] = []
+        if os.path.exists(partial_path):
+            os.remove(partial_path)  # JsonlSink appends; start fresh
+        self._jsonl = JsonlSink(partial_path)
+
+    def log(self, metrics, step: int) -> None:
+        self.records.append((step, dict(metrics)))
+        self._jsonl.log(metrics, step)
+
+    def finish(self) -> None:
+        self._jsonl.finish()
+
+
+def _train_collect(trainer, sink):
+    """Run training; on ANY failure keep the steps already collected.
+
+    Returns (records, completed). Callers propagate ``completed`` as the
+    process exit status so the resumable bench matrix retries interrupted
+    runs instead of marking a truncated curve done."""
+    completed = True
+    try:
+        trainer.train()
+    except BaseException as e:  # noqa: BLE001 — partial curve > no curve
+        completed = False
+        print(f"training interrupted after {len(sink.records)} records: {e!r}")
+    recs = [m for _, m in sink.records if "mean_accuracy_reward" in m]
+    return recs, completed
+
+
 def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
     """Real-scale learning without downloadable weights: a RANDOM-INIT
     QWEN2_0_5B policy + the dense digit-fraction reward. The policy can't
@@ -39,7 +78,6 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
 
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.engine import PagedGenerationEngine
-    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.models import PRESETS, init_params
     from distrl_llm_tpu.models.lora import lora_scale
     from distrl_llm_tpu.tokenizer import CharTokenizer
@@ -70,15 +108,13 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
         max_concurrent_rows=64, scheduler="refill", decode_chunk=16,
     )
     params = init_params(jax.random.PRNGKey(0), cfg_model, dtype=jnp.bfloat16)
-    sink = MemorySink()
+    sink = _StreamingSink(f"/tmp/reward_curve_partial_synth-{model_name}.jsonl")
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine, base_params=params,
         model_cfg=cfg_model, sink=sink,
     )
-    trainer.train()
-    recs = [m for _, m in sink.records if "mean_accuracy_reward" in m]
-    return recs, f"synth-{model_name}"
+    return _train_collect(trainer, sink), f"synth-{model_name}"
 
 
 def run_tiny(episodes: int, learner: str):
@@ -88,7 +124,6 @@ def run_tiny(episodes: int, learner: str):
 
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.engine import GenerationEngine
-    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.models import TINY, init_params
     from distrl_llm_tpu.models.lora import lora_scale
     from distrl_llm_tpu.tokenizer import CharTokenizer
@@ -118,21 +153,19 @@ def run_tiny(episodes: int, learner: str):
         cache_dtype=jnp.float32,
         lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
     )
-    sink = MemorySink()
+    sink = _StreamingSink("/tmp/reward_curve_partial_tiny-cpu.jsonl")
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine,
         base_params=init_params(jax.random.PRNGKey(0), TINY),
         model_cfg=TINY, sink=sink,
     )
-    trainer.train()
-    return [m for _, m in sink.records if "mean_accuracy_reward" in m], "tiny-cpu"
+    return _train_collect(trainer, sink), "tiny-cpu"
 
 
 def run_checkpoint(path: str, episodes: int, learner: str):
     from distrl_llm_tpu.config import TrainConfig
     from distrl_llm_tpu.data import prepare_dataset
-    from distrl_llm_tpu.metrics import MemorySink
     from distrl_llm_tpu.rewards import reward_function
     from distrl_llm_tpu.tokenizer import load_tokenizer
     from distrl_llm_tpu.trainer import Trainer
@@ -147,17 +180,16 @@ def run_checkpoint(path: str, episodes: int, learner: str):
     train, test = prepare_dataset(
         config.dataset, tokenizer, test_size=0.1, seed=config.seed
     )
-    sink = MemorySink()
+    name = os.path.basename(path.rstrip("/"))
+    sink = _StreamingSink(f"/tmp/reward_curve_partial_{name}.jsonl")
     trainer = Trainer.from_pretrained(
         train, test, reward_function, config, checkpoint_path=path,
         tokenizer=tokenizer, sink=sink,
     )
-    trainer.train()
-    name = os.path.basename(path.rstrip("/"))
-    return [m for _, m in sink.records if "mean_accuracy_reward" in m], name
+    return _train_collect(trainer, sink), name
 
 
-def main() -> None:
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny",
                     help="'tiny' (CPU-scale) or a local HF checkpoint dir")
@@ -171,18 +203,26 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        records, tag = run_tiny(args.episodes, args.learner)
+        (records, completed), tag = run_tiny(args.episodes, args.learner)
     elif args.model.startswith("synth-"):
-        records, tag = run_synth(
+        (records, completed), tag = run_synth(
             args.episodes, args.learner, args.model.removeprefix("synth-")
         )
     else:
-        records, tag = run_checkpoint(args.model, args.episodes, args.learner)
+        (records, completed), tag = run_checkpoint(
+            args.model, args.episodes, args.learner
+        )
 
     import jax
 
     backend = jax.devices()[0].platform
     tag = f"{tag}-{args.learner}"
+    if not records:
+        # nothing to plot; the partial-stream file and the exception print
+        # from _train_collect are the diagnostics. Nonzero exit keeps the
+        # resumable bench matrix retrying the stage.
+        print(f"no train records collected for {tag}; see /tmp partial jsonl")
+        return 1
     os.makedirs(args.out_dir, exist_ok=True)
     jsonl = os.path.join(args.out_dir, f"reward_curve_{tag}.jsonl")
     with open(jsonl, "w") as f:
@@ -223,7 +263,11 @@ def main() -> None:
     print(f"wrote {jsonl}")
     print(f"first→last reward: {rewards[0]:.4f} → {rewards[-1]:.4f} "
           f"(rolling: {smooth[0]:.4f} → {smooth[-1]:.4f}) over {len(rewards)} steps")
+    if not completed:
+        print("run was INTERRUPTED — artifacts above are partial")
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
