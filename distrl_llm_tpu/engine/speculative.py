@@ -33,7 +33,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from distrl_llm_tpu.ops.sampling import top_p_filter, top_p_filter_bisect
+from distrl_llm_tpu.ops.sampling import _TOP_P_IMPLS
 
 
 def sampling_probs(
@@ -47,8 +47,9 @@ def sampling_probs(
     must use THIS distribution — not raw softmax — or speculative sampling
     would silently change semantics vs plain decoding."""
     t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
-    filter_fn = top_p_filter if top_p_impl == "exact" else top_p_filter_bisect
-    filtered = filter_fn(logits.astype(jnp.float32) / t, top_p)
+    # shared impl registry: draft/verify sampling must use the SAME
+    # filter as the main decode sampler for every impl string
+    filtered = _TOP_P_IMPLS[top_p_impl](logits.astype(jnp.float32) / t, top_p)
     probs = jax.nn.softmax(filtered, axis=-1)
     greedy = jax.nn.one_hot(
         jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32

@@ -62,6 +62,57 @@ def top_p_filter_bisect(
     return jnp.where(probs >= lo[..., None], logits, NEG_INF)
 
 
+def top_p_filter_bisect_multiway(
+    logits: jax.Array, top_p: jax.Array | float,
+    passes: int = 4, k: int = 15,
+) -> jax.Array:
+    """Nucleus filter with MULTIWAY bisection: each pass tests ``k``
+    thresholds of the current interval in one fused read of ``probs`` (the
+    k masked reductions share one operand, which XLA's sibling multi-output
+    fusion turns into a single V-pass with k accumulators), narrowing the
+    interval (k+1)-fold. 4 passes × 15 thresholds reach the same 2^16
+    resolution as 16 sequential binary iterations with ~1/4 the HBM
+    traffic — at decode shapes ([480, 152k] f32) the binary loop's 16
+    un-fusable passes are ~4.6 GB/step of pure sampler reads.
+
+    Same kept-mass guarantee as ``top_p_filter_bisect``: the returned
+    threshold always keeps mass ≥ top_p (lo only ever moves onto a tested
+    threshold whose kept mass still reached top_p)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    frac = jnp.arange(1, k + 1, dtype=jnp.float32) / (k + 1)  # (0,1) interior
+
+    def body(_, interval):
+        lo, hi = interval  # [...]
+        ts = lo[..., None] + (hi - lo)[..., None] * frac  # [..., k], increasing
+        # unrolled so XLA sees k sibling reduces over the SAME probs operand
+        masses = [
+            jnp.sum(
+                jnp.where(probs >= ts[..., j][..., None], probs, 0.0), axis=-1
+            )
+            for j in range(k)
+        ]
+        mass = jnp.stack(masses, axis=-1)  # [..., k]
+        ok = mass >= top_p[..., None]  # top_p may be scalar or per-row
+        # robust to float non-monotonicity: take the LARGEST passing
+        # threshold and the SMALLEST failing one, not prefix counts
+        new_lo = jnp.max(jnp.where(ok, ts, lo[..., None]), axis=-1)
+        new_hi = jnp.min(jnp.where(ok, hi[..., None], ts), axis=-1)
+        return new_lo, new_hi
+
+    lo = jnp.zeros(probs.shape[:-1], jnp.float32)
+    hi = jnp.max(probs, axis=-1)
+    lo, _ = jax.lax.fori_loop(0, passes, body, (lo, hi))
+    return jnp.where(probs >= lo[..., None], logits, NEG_INF)
+
+
+_TOP_P_IMPLS = {
+    "exact": top_p_filter,
+    "bisect": top_p_filter_bisect,
+    "bisect_mw": top_p_filter_bisect_multiway,
+}
+
+
 def sample(
     rng: jax.Array,
     logits: jax.Array,  # [B, V]
@@ -75,15 +126,17 @@ def sample(
     (1.2/0.95 vs 0.6/0.95 — distributed_trainer.py:53–58) share one compiled
     decode loop.
 
-    ``top_p_impl`` (static): "bisect" (default, sort-free — the fast path) or
-    "exact" (rank-based sort filter, byte-identical to the reference's vLLM
-    nucleus semantics) for reproducibility runs — SamplingConfig.top_p_exact.
+    ``top_p_impl`` (static): "bisect" (default, sort-free — the fast path),
+    "bisect_mw" (multiway bisection, ~1/4 the sampler HBM traffic — flip
+    the default once tools/sampler_probe.py confirms the fusion on a real
+    chip), or "exact" (rank-based sort filter, byte-identical to the
+    reference's vLLM nucleus semantics) for reproducibility runs —
+    SamplingConfig.top_p_exact.
     """
     greedy = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     scaled = logits.astype(jnp.float32) / t
-    filter_fn = top_p_filter if top_p_impl == "exact" else top_p_filter_bisect
-    filtered = filter_fn(scaled, top_p)
+    filtered = _TOP_P_IMPLS[top_p_impl](scaled, top_p)
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
     return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)

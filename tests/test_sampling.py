@@ -122,3 +122,63 @@ class TestTopPBisect:
         logits = jnp.asarray([[0.0, 1.0, -2.0, 3.0]], jnp.float32)
         kept = np.asarray(top_p_filter_bisect(logits, 1.0)) > -1e29
         assert kept.all()
+
+
+class TestTopPBisectMultiway:
+    """Multiway bisection must honor the same contracts as binary bisection:
+    a superset of the exact filter's kept set, kept mass >= top_p."""
+
+    def test_superset_of_sort_filter(self):
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import (
+            top_p_filter, top_p_filter_bisect_multiway,
+        )
+
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.normal(size=(8, 512)) * 3.0, jnp.float32)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        for p in (0.1, 0.5, 0.95, 0.999):
+            exact = np.asarray(top_p_filter(logits, p)) > -1e29
+            mw = np.asarray(top_p_filter_bisect_multiway(logits, p)) > -1e29
+            assert (mw | exact == mw).all(), "dropped an exact-kept token"
+            extra_mass = (probs * (mw & ~exact)).sum(-1)
+            assert (extra_mass < 5e-3).all()
+
+    def test_kept_mass_at_least_top_p(self):
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import top_p_filter_bisect_multiway
+
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(16, 1024)), jnp.float32)
+        for p in (0.5, 0.9, 0.99):
+            kept = np.asarray(top_p_filter_bisect_multiway(logits, p)) > -1e29
+            probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+            assert ((probs * kept).sum(-1) >= p - 1e-6).all()
+
+    def test_agrees_with_binary_bisect_resolution(self):
+        """Same 2^16 resolution target: the two bisect variants should keep
+        nearly identical sets away from threshold-window boundaries."""
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import (
+            top_p_filter_bisect, top_p_filter_bisect_multiway,
+        )
+
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.normal(size=(4, 2048)) * 2.0, jnp.float32)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        bi = np.asarray(top_p_filter_bisect(logits, 0.95)) > -1e29
+        mw = np.asarray(top_p_filter_bisect_multiway(logits, 0.95)) > -1e29
+        sym_diff_mass = (probs * (bi ^ mw)).sum(-1)
+        assert (sym_diff_mass < 2e-3).all()
+
+    def test_top_p_1_keeps_everything(self):
+        import numpy as np
+
+        from distrl_llm_tpu.ops.sampling import top_p_filter_bisect_multiway
+
+        logits = jnp.asarray([[0.0, 1.0, -2.0, 3.0]], jnp.float32)
+        kept = np.asarray(top_p_filter_bisect_multiway(logits, 1.0)) > -1e29
+        assert kept.all()

@@ -1,0 +1,84 @@
+"""Sweep on-chip artifacts from /tmp into benchmarks/r3/ and print the
+BASELINE.md table rows for whatever has landed so far.
+
+Run after (or during) a TPU window: copies every /tmp/bench_tpu_*.json
+whose record is a real TPU measurement, plus the kernel-check / dispatch
+probe / memory-envelope / train-curve logs if present, then prints a
+markdown row per bench for pasting into BASELINE.md's on-chip table.
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DEST = os.path.join(REPO, "benchmarks", "r3")
+
+LOGS = [
+    "/tmp/tpu_kernel_tests.log",
+    "/tmp/dispatch_probe.log",
+    "/tmp/sampler_probe.log",
+    "/tmp/memory_envelope_tpu.log",
+    "/tmp/train_curve_tpu.log",
+]
+
+
+def main() -> int:
+    os.makedirs(DEST, exist_ok=True)
+    rows = []
+    for path in sorted(glob.glob("/tmp/bench_tpu_*.json")):
+        try:
+            rec = json.loads(open(path).read().strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            continue
+        if rec.get("backend") != "tpu":
+            continue
+        name = os.path.basename(path)[len("bench_tpu_"):-len(".json")]
+        shutil.copy(path, os.path.join(DEST, f"{name}.json"))
+        if rec.get("metric") == "learner_tokens_per_sec_per_chip" or "step_seconds" in rec:
+            rows.append(
+                f"| {name} | learner step | {rec.get('model')} | "
+                f"{rec.get('value'):,} | {100*rec.get('mfu', 0):.1f}% | "
+                f"{rec.get('vs_baseline')}× | step {rec.get('step_seconds')}s |"
+            )
+        else:
+            pool = rec.get("pool_stats") or {}
+            notes = []
+            if rec.get("scheduler"):
+                notes.append(rec["scheduler"])
+            if rec.get("spec_draft"):
+                notes.append(f"spec d={rec['spec_draft']}")
+            if rec.get("base_quant", "none") != "none":
+                notes.append(f"base {rec['base_quant']}")
+            if pool.get("budgeted"):
+                notes.append(
+                    f"pool {pool.get('pool_pages')}p peak {pool.get('peak_pages_used')}p "
+                    f"{pool.get('preemptions')} preempt"
+                )
+            if rec.get("tokens_per_slot_step"):
+                notes.append(f"{rec['tokens_per_slot_step']} tok/slot-step")
+            rows.append(
+                f"| {name} | {rec.get('engine')} | {rec.get('model')} | "
+                f"**{rec.get('value'):,}** | {100*rec.get('mfu', 0):.2f}% | "
+                f"**{rec.get('vs_baseline')}×** | {'; '.join(notes) or '—'} |"
+            )
+    for log in LOGS:
+        if os.path.exists(log):
+            shutil.copy(log, os.path.join(DEST, os.path.basename(log)))
+    curves = glob.glob("/tmp/reward_curve_partial_*.jsonl")
+    for c in curves:
+        shutil.copy(c, os.path.join(DEST, os.path.basename(c)))
+    print(f"collected into {os.path.relpath(DEST, REPO)}:")
+    for f in sorted(os.listdir(DEST)):
+        print(" ", f)
+    if rows:
+        print("\n| run | engine | model | tok/s/chip | MFU | vs baseline | notes |")
+        print("|---|---|---|---|---|---|---|")
+        print("\n".join(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

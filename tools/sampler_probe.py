@@ -1,0 +1,54 @@
+"""On-chip A/B of the top-p sampler implementations at decode shape.
+
+The decode step's sampler runs over [B, 152k] f32 logits every token. The
+binary bisection does 16 sequential full passes (~4.6 GB/step at B=480);
+the multiway variant tests 15 thresholds per pass in what should be ONE
+fused read (XLA sibling multi-output reduce fusion), finishing in 4
+passes. Whether that fusion actually happens on the Mosaic/XLA version in
+play decides the engines' default — this probe measures both (plus the
+exact sort filter for reference) and prints a verdict.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distrl_llm_tpu.ops.sampling import sample
+
+    print("backend:", jax.default_backend())
+    b, v = 480, 151936
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(b, v)) * 2.0, jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    t = jnp.asarray(1.2, jnp.float32)
+    p = jnp.asarray(0.95, jnp.float32)
+
+    results = {}
+    for impl in ("bisect", "bisect_mw", "exact"):
+        fn = jax.jit(lambda k, lg, impl=impl: sample(k, lg, t, p, top_p_impl=impl))
+        out = fn(key, logits).block_until_ready()  # compile
+        n = 20
+        t0 = time.perf_counter()
+        for i in range(n):
+            out = fn(jax.random.fold_in(key, i), logits)
+        out.block_until_ready()
+        per = (time.perf_counter() - t0) / n
+        results[impl] = per
+        print(f"{impl:10s}: {per*1e3:8.3f} ms/step at [{b}, {v}]")
+
+    speedup = results["bisect"] / max(results["bisect_mw"], 1e-9)
+    print(f"multiway speedup over binary: {speedup:.2f}x")
+    print("verdict:", "FLIP DEFAULT to bisect_mw" if speedup > 1.3
+          else "keep binary bisect (fusion didn't materialize)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,15 @@ bench() {
 }
 
 # --- ordered by information value; dense first (the headline number) -------
+# quick dispatch-latency probe: is per-step dispatch over the tunnel the
+# decode bottleneck? (informs whether to scan-chunk the decode loops)
+run_stage dispatch_probe 300 bash -c \
+  'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
+   cat /tmp/dispatch_probe.log; exit $rc'
+# sampler A/B at decode shape: decides the engines' top-p default
+run_stage sampler_probe 600 bash -c \
+  'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
+   cat /tmp/sampler_probe.log; exit $rc'
 bench dense   /tmp/bench_tpu_dense.json
 bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
 # dense at realistic length variance: quantifies the wave-straggler cost
