@@ -125,6 +125,18 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
     logit_chunk = int(os.environ.get("BENCH_LOGPROB_CHUNK", "128"))
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     steps = int(os.environ.get("BENCH_STEPS", "3"))
+    # "reference" (XLA attention, the config default) vs "flash" (Pallas
+    # kernel) — the A/B that decides the TPU-side default at S=1550
+    attn_impl = os.environ.get("BENCH_ATTN_IMPL", "reference")
+    if attn_impl not in ("reference", "flash", "splash"):
+        _emit({
+            "metric": "learner_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": f"invalid BENCH_ATTN_IMPL={attn_impl!r} "
+                     "(expected reference/flash/splash)",
+            "backend": jax.devices()[0].platform,
+        })
+        return 1
 
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
     params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
@@ -134,7 +146,7 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
     step = make_train_step(
         cfg, learner_type="grpo", optimizer=optimizer,
         lora_scale=lora_scale(lora_rank, 16.0), micro_size=micro,
-        donate=False, logit_chunk=logit_chunk,
+        donate=False, logit_chunk=logit_chunk, attn_impl=attn_impl,
     )
     rng = np.random.default_rng(0)
     batch = UpdateBatch(
@@ -175,6 +187,13 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         "model": name,
         "backend": jax.devices()[0].platform,
         "rows": n_rows, "micro": micro, "seq": p_len + t_len,
+        "attn_impl": attn_impl,
+        # honesty flag: attention() falls back to the reference path with
+        # only a warning — a "flash" record with attn_fallback true measured
+        # XLA reference attention, not the kernel
+        "attn_fallback": __import__(
+            "distrl_llm_tpu.ops.attention", fromlist=["x"]
+        )._flash_fallback_warned if attn_impl != "reference" else False,
         "logprob_chunk": logit_chunk,
         "step_seconds": round(dt, 3),
         "compile_plus_first_step_seconds": round(compile_dt, 2),

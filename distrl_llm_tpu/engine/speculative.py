@@ -33,7 +33,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from distrl_llm_tpu.ops.sampling import _TOP_P_IMPLS
+from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
 
 
 def sampling_probs(
@@ -49,7 +49,7 @@ def sampling_probs(
     t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     # shared impl registry: draft/verify sampling must use the SAME
     # filter as the main decode sampler for every impl string
-    filtered = _TOP_P_IMPLS[top_p_impl](logits.astype(jnp.float32) / t, top_p)
+    filtered = TOP_P_IMPLS[top_p_impl](logits.astype(jnp.float32) / t, top_p)
     probs = jax.nn.softmax(filtered, axis=-1)
     greedy = jax.nn.one_hot(
         jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32

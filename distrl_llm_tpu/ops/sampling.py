@@ -106,7 +106,7 @@ def top_p_filter_bisect_multiway(
     return jnp.where(probs >= lo[..., None], logits, NEG_INF)
 
 
-_TOP_P_IMPLS = {
+TOP_P_IMPLS = {
     "exact": top_p_filter,
     "bisect": top_p_filter_bisect,
     "bisect_mw": top_p_filter_bisect_multiway,
@@ -136,7 +136,7 @@ def sample(
     greedy = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     scaled = logits.astype(jnp.float32) / t
-    filtered = _TOP_P_IMPLS[top_p_impl](scaled, top_p)
+    filtered = TOP_P_IMPLS[top_p_impl](scaled, top_p)
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
     return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)

@@ -25,7 +25,9 @@ def main() -> int:
     print("backend:", jax.default_backend())
     b, v = 480, 151936
     rng = np.random.default_rng(0)
-    logits = jnp.asarray(rng.normal(size=(b, v)) * 2.0, jnp.bfloat16)
+    logits = jnp.asarray(
+        rng.standard_normal(size=(b, v), dtype=np.float32) * 2.0, jnp.bfloat16
+    )
     key = jax.random.PRNGKey(0)
     t = jnp.asarray(1.2, jnp.float32)
     p = jnp.asarray(0.95, jnp.float32)

@@ -6,6 +6,12 @@
 # logs:   /tmp/*_tpu.log.  Delete the .done markers to force a re-run.
 cd "$(dirname "$0")/.."
 
+# Persistent XLA compilation cache: the first TPU window burned 246 s of
+# ~9 minutes on compiles; with the cache, later windows reuse them.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 probe() {
   # init alone can succeed while compute hangs (observed: jax.devices() in
   # ~25s, then a 1k matmul stuck >2min) — require a real matmul to finish
@@ -72,15 +78,6 @@ bench() {
 }
 
 # --- ordered by information value; dense first (the headline number) -------
-# quick dispatch-latency probe: is per-step dispatch over the tunnel the
-# decode bottleneck? (informs whether to scan-chunk the decode loops)
-run_stage dispatch_probe 300 bash -c \
-  'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
-   cat /tmp/dispatch_probe.log; exit $rc'
-# sampler A/B at decode shape: decides the engines' top-p default
-run_stage sampler_probe 600 bash -c \
-  'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
-   cat /tmp/sampler_probe.log; exit $rc'
 bench dense   /tmp/bench_tpu_dense.json
 bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
 # dense at realistic length variance: quantifies the wave-straggler cost
@@ -103,6 +100,19 @@ bench budget  /tmp/bench_tpu_budget.json \
 bench int8kv  /tmp/bench_tpu_int8kv.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
 bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
+# flash-attention A/B for the learner step (S=1550): decides whether the
+# config-level attn_impl default should be flash on TPU
+bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
+
+# quick dispatch-latency probe: is per-step dispatch over the tunnel the
+# decode bottleneck? (informs whether to scan-chunk the decode loops)
+run_stage dispatch_probe 300 bash -c \
+  'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
+   cat /tmp/dispatch_probe.log; exit $rc'
+# sampler A/B at decode shape: decides the engines' top-p default
+run_stage sampler_probe 600 bash -c \
+  'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
+   cat /tmp/sampler_probe.log; exit $rc'
 
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
