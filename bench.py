@@ -105,6 +105,20 @@ def _train_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * (2.0 * matmul_params + attn)
 
 
+def _attn_fallback_fired(attn_impl: str) -> bool:
+    """True when attention() fell back to the XLA reference path during the
+    (traced) first step — a "flash" record with this flag set measured
+    reference attention, not the kernel."""
+    if attn_impl == "reference":
+        return False
+    import importlib
+
+    # ops/__init__ re-exports the attention FUNCTION under the same name;
+    # import_module reliably returns the module
+    attn_mod = importlib.import_module("distrl_llm_tpu.ops.attention")
+    return attn_mod._flash_fallback_warned
+
+
 def _learner_bench(cfg, name: str, fallback_err) -> int:
     """BENCH_MODE=learner: time the jitted train step at the reference
     learner shapes (micro 8 × [350 prompt + 1200 answer], distributed_
@@ -191,9 +205,7 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         # honesty flag: attention() falls back to the reference path with
         # only a warning — a "flash" record with attn_fallback true measured
         # XLA reference attention, not the kernel
-        "attn_fallback": __import__(
-            "distrl_llm_tpu.ops.attention", fromlist=["x"]
-        )._flash_fallback_warned if attn_impl != "reference" else False,
+        "attn_fallback": _attn_fallback_fired(attn_impl),
         "logprob_chunk": logit_chunk,
         "step_seconds": round(dt, 3),
         "compile_plus_first_step_seconds": round(compile_dt, 2),
@@ -353,7 +365,23 @@ def main() -> int:
     n_short = int(round(n_prompts * min(max(short_fraction, 0.0), 1.0)))
     pmask[:n_short, : max_prompt // 2] = 0
     prompts[:n_short, : max_prompt // 2] = engine.pad_id
-    sampling = SamplingConfig(max_tokens=max_new, temperature=1.2, top_p=0.95, n=n_cand)
+    top_p_impl = os.environ.get("BENCH_TOP_P_IMPL")  # e.g. "bisect_mw"
+    if top_p_impl:
+        from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
+
+        if top_p_impl not in TOP_P_IMPLS:
+            _emit({
+                "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+                "unit": "tok/s/chip", "vs_baseline": 0.0,
+                "error": f"invalid BENCH_TOP_P_IMPL={top_p_impl!r} "
+                         f"(expected one of {sorted(TOP_P_IMPLS)})",
+                "backend": jax.devices()[0].platform,
+            })
+            return 1
+    sampling = SamplingConfig(
+        max_tokens=max_new, temperature=1.2, top_p=0.95, n=n_cand,
+        top_p_impl=top_p_impl,
+    )
 
     def run(seed: int):
         t0 = time.perf_counter()
@@ -428,6 +456,7 @@ def main() -> int:
         "mfu": round(mfu, 6),
         "model": name,
         "base_quant": base_quant,
+        "top_p_impl": sampling.resolved_top_p_impl(),
         "backend": jax.devices()[0].platform,
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,

@@ -36,6 +36,22 @@ class SamplingConfig:
     # tie mass), True = exact rank-based sort filter matching the reference's
     # vLLM semantics — for eval/reproducibility runs (ADVICE r1).
     top_p_exact: bool = False
+    # explicit impl override (a key of ops.sampling.TOP_P_IMPLS, e.g.
+    # "bisect_mw"); None derives from top_p_exact. Engines resolve via
+    # resolved_top_p_impl().
+    top_p_impl: str | None = None
+
+    def resolved_top_p_impl(self) -> str:
+        if self.top_p_impl:  # "" and None both mean "derive"
+            from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
+
+            if self.top_p_impl not in TOP_P_IMPLS:
+                raise ValueError(
+                    f"top_p_impl must be one of {sorted(TOP_P_IMPLS)}, "
+                    f"got {self.top_p_impl!r}"
+                )
+            return self.top_p_impl
+        return "exact" if self.top_p_exact else "bisect"
 
     def replace(self, **kw) -> "SamplingConfig":
         return dataclasses.replace(self, **kw)

@@ -446,7 +446,7 @@ class GenerationEngine(LoraMailbox):
         )
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
-        top_p_impl = "exact" if sampling.top_p_exact else "bisect"
+        top_p_impl = sampling.resolved_top_p_impl()
         lora_cell = [lora]
         steps_seen = [0]
 

@@ -253,7 +253,7 @@ class ShardedPagedEngine(LoraMailbox):
                  np.zeros((pad_rows, p), np.int32)], axis=0
             )
         b_pad = b + pad_rows
-        top_p_impl = "exact" if sampling.top_p_exact else "bisect"
+        top_p_impl = sampling.resolved_top_p_impl()
         setup, step = self._build(n, b_pad // self.dp, max_steps, top_p_impl)
 
         state, table = setup(

@@ -9,7 +9,7 @@ cd "$(dirname "$0")/.."
 # Persistent XLA compilation cache: the first TPU window burned 246 s of
 # ~9 minutes on compiles; with the cache, later windows reuse them.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}"
-export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 probe() {
@@ -80,6 +80,9 @@ bench() {
 # --- ordered by information value; dense first (the headline number) -------
 bench dense   /tmp/bench_tpu_dense.json
 bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
+# end-to-end sampler A/B: the multiway top-p filter inside the real dense
+# decode loop, against the recorded dense (binary bisect) number
+bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
 # dense at realistic length variance: quantifies the wave-straggler cost
 # the refill scheduler exists to remove (A/B against refill_eos below)
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
