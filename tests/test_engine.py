@@ -238,3 +238,40 @@ class TestWaveScheduling:
         ).generate(params, None, ids3, mask3, cfg, jax.random.PRNGKey(0))
         assert waved.tokens.shape == want.tokens.shape == (3, 1, 4)
         np.testing.assert_array_equal(waved.tokens, want.tokens)
+
+
+class TestTopPImplOverride:
+    """SamplingConfig.top_p_impl plumbs through to the decode step: the
+    multiway filter must produce a working round, and greedy decoding must
+    be impl-invariant (temperature 0 bypasses the filter)."""
+
+    def test_multiway_round_and_greedy_invariance(self, setup):
+        params, ids, mask = setup
+        eng = make_engine(max_new=6)
+        outs = {}
+        for impl in (None, "bisect_mw", "exact"):
+            res = eng.generate(
+                params, None, ids, mask,
+                SamplingConfig(max_tokens=6, temperature=0.0, n=1,
+                               top_p_impl=impl),
+                jax.random.PRNGKey(0),
+            )
+            outs[impl] = np.asarray(res.tokens)
+        np.testing.assert_array_equal(outs[None], outs["bisect_mw"])
+        np.testing.assert_array_equal(outs[None], outs["exact"])
+
+    def test_multiway_sampling_round_completes(self, setup):
+        params, ids, mask = setup
+        eng = make_engine(max_new=5)
+        res = eng.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=5, temperature=1.2, top_p=0.9, n=2,
+                           top_p_impl="bisect_mw"),
+            jax.random.PRNGKey(1),
+        )
+        assert res.tokens.shape == (2, 2, 5)
+        assert (np.asarray(res.lengths) >= 0).all()
+
+    def test_invalid_impl_rejected(self):
+        with pytest.raises(ValueError, match="top_p_impl"):
+            SamplingConfig(top_p_impl="nope").resolved_top_p_impl()
