@@ -103,7 +103,62 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str, kv_heads_axis: in
     return out.reshape(b, sq, h, d)
 
 
+import logging
+
+logger = logging.getLogger(__name__)
+
 _flash_fallback_warned = False
+_kernel_probe_state: dict = {}
+
+# substrings marking transient device/runtime failures that say nothing
+# about lowering legality — never negative-cache these
+_TRANSIENT_ERR_MARKS = ("RESOURCE_EXHAUSTED", "DEADLINE", "UNAVAILABLE",
+                        "CANCELLED", "ABORTED")
+
+
+def _kernel_lowers(kind: str, h: int, kh: int, d: int, sq: int, dtype) -> bool:
+    """Probe-compile the flash/splash kernel — forward AND backward — at
+    this (head geometry, seq) config, once per config. Mosaic block-rule
+    rejections fire at COMPILE time — past any try/except around the
+    traced call inside a larger jit, which is exactly how the paged launch
+    failed on first silicon (round 3; see ops/paged_int8.py). An eager
+    probe catches them while the reference-path fallback is still
+    possible. The seq is part of the key because block shapes derive from
+    it (splash: block = min(512, padded seq)); the grad pass covers the
+    custom-VJP dkv/dq kernels the training path differentiates through."""
+    key = (kind, h, kh, d, sq, jnp.dtype(dtype).name)
+    if key not in _kernel_probe_state:
+        try:
+            b = 1
+            q = jnp.zeros((b, sq, h, d), dtype)
+            k = jnp.zeros((b, sq, kh, d), dtype)
+            if kind == "flash":
+                from distrl_llm_tpu.ops.flash_attention import flash_attention
+
+                fwd = lambda q_, k_: flash_attention(q_, k_, k_, None)  # noqa: E731
+            else:
+                from distrl_llm_tpu.ops.splash import splash_attention
+
+                valid = jnp.ones((b, sq), jnp.int32)
+                fwd = lambda q_, k_: splash_attention(q_, k_, k_, valid)  # noqa: E731
+            jax.block_until_ready(fwd(q, k))
+            # backward kernels (dq/dkv block specs) lower independently
+            g = jax.grad(lambda q_, k_: fwd(q_, k_).astype(jnp.float32).sum(),
+                         argnums=(0, 1))(q, k)
+            jax.block_until_ready(g)
+            _kernel_probe_state[key] = True
+        except Exception as e:  # noqa: BLE001 — classify before caching
+            msg = str(e).upper()
+            transient = any(m in msg for m in _TRANSIENT_ERR_MARKS)
+            if not transient:
+                _kernel_probe_state[key] = False
+            logger.warning(
+                "%s attention kernel failed its lowering probe for %s (%s); "
+                "using the XLA reference path%s", kind, key, e,
+                " (transient error — will re-probe)" if transient else "",
+            )
+            return False
+    return _kernel_probe_state[key]
 
 
 def attention(
@@ -123,6 +178,7 @@ def attention(
     ``key_valid`` is given and the fallback runs, the dense causal mask is
     built here."""
     global _flash_fallback_warned
+    h, kh, d = q.shape[2], k.shape[2], q.shape[3]
     if impl == "splash":
         try:
             if jax.default_backend() != "tpu":
@@ -130,29 +186,31 @@ def attention(
                     "splash kernel requires the TPU backend (interpret mode "
                     "is test-only)"
                 )
+            if not _kernel_lowers("splash", h, kh, d, q.shape[1], q.dtype):
+                raise NotImplementedError("splash failed its lowering probe")
             from distrl_llm_tpu.ops.splash import splash_attention
 
             return splash_attention(q, k, v, key_valid, scale=scale)
         except Exception as e:  # noqa: BLE001 — fall back with one warning
             if not _flash_fallback_warned:
                 _flash_fallback_warned = True
-                import logging
-
-                logging.getLogger(__name__).warning(
+                logger.warning(
                     "splash attention unavailable (%s); falling back to the "
                     "XLA reference path", e,
                 )
     if impl == "flash":
         try:
+            if jax.default_backend() == "tpu" and not _kernel_lowers(
+                "flash", h, kh, d, q.shape[1], q.dtype
+            ):
+                raise NotImplementedError("flash failed its lowering probe")
             from distrl_llm_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, mask, scale=scale, key_valid=key_valid)
         except (ImportError, NotImplementedError) as e:
             if not _flash_fallback_warned:
                 _flash_fallback_warned = True
-                import logging
-
-                logging.getLogger(__name__).warning(
+                logger.warning(
                     "flash attention unavailable (%s); falling back to the XLA "
                     "reference path — O(Sq*Sk) memory", e,
                 )
