@@ -65,6 +65,43 @@ def main() -> int:
     failures += not ok
     print(f"{'PASS' if ok else 'FAIL'} splash_attention S={s2} max_err={err.max():.4f}")
 
+    # ---- flash/splash BACKWARD kernels vs XLA grads (training path) -------
+    # the learner differentiates through these custom-VJP kernels; the
+    # lowering probe (ops/attention.py::_kernel_lowers) compiles them, this
+    # pins their numerics on silicon
+    sg = 512
+    qg = jnp.asarray(rng.normal(size=(2, sg, h, d)), jnp.bfloat16)
+    kg = jnp.asarray(rng.normal(size=(2, sg, kh, d)), jnp.bfloat16)
+    vg = jnp.asarray(rng.normal(size=(2, sg, kh, d)), jnp.bfloat16)
+    validg = jnp.ones((2, sg), jnp.int32)
+    maskg = causal_padding_mask(validg, q_len=sg)
+
+    def _loss(fn):
+        return lambda q_, k_, v_: fn(q_, k_, v_).astype(jnp.float32).sum()
+
+    ref_fn = _loss(lambda q_, k_, v_: attention_reference(q_, k_, v_, maskg))
+    g_ref = jax.grad(ref_fn, argnums=(0, 1, 2))(qg, kg, vg)
+    for kind in ("flash", "splash"):
+        try:
+            if kind == "flash":
+                kern_fn = _loss(lambda q_, k_, v_: flash_attention(q_, k_, v_, maskg))
+            else:
+                kern_fn = _loss(
+                    lambda q_, k_, v_: splash_attention(q_, k_, v_, validg)
+                )
+            g_k = jax.grad(kern_fn, argnums=(0, 1, 2))(qg, kg, vg)
+            errs = [
+                float(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)).max())
+                for a, b_ in zip(g_k, g_ref)
+            ]
+            ok = max(errs) < 5e-2  # bf16 blockwise grads vs XLA
+            failures += not ok
+            print(f"{'PASS' if ok else 'FAIL'} {kind}_backward S={sg} "
+                  f"max_err={max(errs):.4f}")
+        except Exception as e:  # noqa: BLE001 — record, count, continue
+            failures += 1
+            print(f"FAIL {kind}_backward ({e})")
+
     # ---- paged attention kernel vs jnp reference --------------------------
     from distrl_llm_tpu.ops.paged import (
         make_page_table, paged_attention_op, paged_attention_reference,
