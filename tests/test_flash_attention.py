@@ -75,3 +75,61 @@ class TestFlashNumerics:
         gf = jax.grad(lambda q: loss(q, "flash"))(q)
         gr = jax.grad(lambda q: loss(q, "ref"))(q)
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=5e-2, rtol=5e-2)
+
+
+class TestLoweringProbe:
+    """_kernel_lowers must negative-cache lowering rejections (one warning,
+    no retries) but RE-probe after transient device errors."""
+
+    def _clean(self):
+        import importlib
+
+        # ops/__init__ re-exports the attention FUNCTION under the name
+        attn_mod = importlib.import_module("distrl_llm_tpu.ops.attention")
+        attn_mod._kernel_probe_state.clear()
+        return attn_mod
+
+    def test_lowering_rejection_cached(self, monkeypatch):
+        attn_mod = self._clean()
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise ValueError(
+                "The Pallas TPU lowering currently requires that the last two "
+                "dimensions of your block shape are divisible by 8 and 128"
+            )
+
+        import distrl_llm_tpu.ops.flash_attention as fa_mod
+        monkeypatch.setattr(fa_mod, "flash_attention", boom)
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 256, jnp.float32) is False
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 256, jnp.float32) is False
+        assert len(calls) == 1  # second call served from the negative cache
+
+    def test_transient_error_reprobes(self, monkeypatch):
+        attn_mod = self._clean()
+        calls = []
+
+        def flaky(*a, **k):
+            calls.append(1)
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating probe")
+
+        import distrl_llm_tpu.ops.flash_attention as fa_mod
+        monkeypatch.setattr(fa_mod, "flash_attention", flaky)
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 256, jnp.float32) is False
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 256, jnp.float32) is False
+        assert len(calls) == 2  # transient failures are not cached
+
+    def test_success_cached(self, monkeypatch):
+        attn_mod = self._clean()
+        calls = []
+
+        def ok(q, k, v, mask, **kw):
+            calls.append(1)
+            return q
+
+        import distrl_llm_tpu.ops.flash_attention as fa_mod
+        monkeypatch.setattr(fa_mod, "flash_attention", ok)
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 128, jnp.float32) is True
+        assert attn_mod._kernel_lowers("flash", 4, 2, 64, 128, jnp.float32) is True
+        assert len(calls) == 2  # fwd + grad on first call only
