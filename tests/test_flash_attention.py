@@ -81,6 +81,19 @@ class TestLoweringProbe:
     """_kernel_lowers must negative-cache lowering rejections (one warning,
     no retries) but RE-probe after transient device errors."""
 
+    @pytest.fixture(autouse=True)
+    def _isolated_probe_cache(self):
+        """Snapshot/restore the process-wide probe cache: verdicts produced
+        by this class's FAKE kernels must never leak into later tests."""
+        import importlib
+
+        attn_mod = importlib.import_module("distrl_llm_tpu.ops.attention")
+        saved = dict(attn_mod._kernel_probe_state)
+        attn_mod._kernel_probe_state.clear()
+        yield
+        attn_mod._kernel_probe_state.clear()
+        attn_mod._kernel_probe_state.update(saved)
+
     def _clean(self):
         import importlib
 

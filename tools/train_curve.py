@@ -34,12 +34,17 @@ class _StreamingSink:
     mid-run, and a half-finished on-chip curve is worth infinitely more
     than none."""
 
-    def __init__(self, partial_path: str):
+    def __init__(self, partial_path: str, fresh: bool = True):
         from distrl_llm_tpu.metrics import JsonlSink
 
         self.records: list[tuple[int, dict]] = []
-        if os.path.exists(partial_path):
-            os.remove(partial_path)  # JsonlSink appends; start fresh
+        # fresh=False APPENDS across runs: with checkpoint+resume a retried
+        # stage only trains the remaining steps, so the partial file
+        # accumulates the whole curve across TPU windows (records carry
+        # _step for ordering). Non-resuming modes pass fresh=True so
+        # unrelated runs never interleave in one file.
+        if fresh and os.path.exists(partial_path):
+            os.remove(partial_path)
         self._jsonl = JsonlSink(partial_path)
 
     def log(self, metrics, step: int) -> None:
@@ -48,6 +53,24 @@ class _StreamingSink:
 
     def finish(self) -> None:
         self._jsonl.finish()
+
+
+def _read_partial(path: str) -> list[dict]:
+    """Parse the accumulated stream back: train-step records sorted by
+    _step. This is the artifact source of truth for resuming runs — the
+    in-process sink only saw the steps trained SINCE the last resume."""
+    recs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if "mean_accuracy_reward" in r:
+                    recs.append(r)
+    recs.sort(key=lambda r: r.get("_step", 0))
+    return recs
 
 
 def _train_collect(trainer, sink):
@@ -91,12 +114,25 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
         )
 
     cfg_model = PRESETS[model_name]
+    # run identity (model + learner) keys BOTH the checkpoint dir and the
+    # partial stream: a pg run can never resume from grpo state or
+    # interleave with its records. Delete the ckpt dir to force a fresh
+    # curve after a completed run.
+    ckpt_dir = f"/tmp/graft_synth_ckpt_{model_name}-{learner}"
+    partial = f"/tmp/reward_curve_partial_synth-{model_name}-{learner}.jsonl"
+    fresh = not (os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir))
     config = TrainConfig(
         model=model_name, learner=learner, episodes=episodes, lr=5e-4,
         max_prompt_tokens=64, max_new_tokens=128, batch_size=8,
         num_candidates=8, topk=8, train_batch_size=16, max_lora_rank=16,
         lora_alpha=32, number_of_actors=1, number_of_learners=1,
         learner_chunk_size=0, metrics_backend="null",
+        # TPU windows are short and die without warning: checkpoint every
+        # few steps and resume across retries so the on-chip curve
+        # ACCUMULATES instead of restarting (stage retry in the bench
+        # matrix + Orbax mid-episode cursor)
+        checkpoint_dir=ckpt_dir,
+        resume=True, save_every=4,
     )
     tok = CharTokenizer(vocab_size=cfg_model.vocab_size)
     problems = [f"write numbers about {c}" for c in "abcdefghijklmnop"]
@@ -108,13 +144,20 @@ def run_synth(episodes: int, learner: str, model_name: str = "qwen2.5-0.5b"):
         max_concurrent_rows=64, scheduler="refill", decode_chunk=16,
     )
     params = init_params(jax.random.PRNGKey(0), cfg_model, dtype=jnp.bfloat16)
-    sink = _StreamingSink(f"/tmp/reward_curve_partial_synth-{model_name}.jsonl")
+    sink = _StreamingSink(partial, fresh=fresh)
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine, base_params=params,
         model_cfg=cfg_model, sink=sink,
     )
-    return _train_collect(trainer, sink), f"synth-{model_name}"
+    recs, completed = _train_collect(trainer, sink)
+    # the accumulated stream covers earlier windows' steps AND the
+    # post-completion no-op retry (which trains nothing but must still
+    # produce the full artifact and exit 0)
+    merged = _read_partial(partial)
+    if merged:
+        recs = merged
+    return (recs, completed), f"synth-{model_name}"
 
 
 def run_tiny(episodes: int, learner: str):
@@ -153,7 +196,7 @@ def run_tiny(episodes: int, learner: str):
         cache_dtype=jnp.float32,
         lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
     )
-    sink = _StreamingSink("/tmp/reward_curve_partial_tiny-cpu.jsonl")
+    sink = _StreamingSink(f"/tmp/reward_curve_partial_tiny-cpu-{learner}.jsonl")
     trainer = Trainer(
         train, dict(train), digit_reward, config,
         tokenizer=tok, engine=engine,
@@ -181,7 +224,7 @@ def run_checkpoint(path: str, episodes: int, learner: str):
         config.dataset, tokenizer, test_size=0.1, seed=config.seed
     )
     name = os.path.basename(path.rstrip("/"))
-    sink = _StreamingSink(f"/tmp/reward_curve_partial_{name}.jsonl")
+    sink = _StreamingSink(f"/tmp/reward_curve_partial_{name}-{learner}.jsonl")
     trainer = Trainer.from_pretrained(
         train, test, reward_function, config, checkpoint_path=path,
         tokenizer=tokenizer, sink=sink,
@@ -198,6 +241,16 @@ def main() -> int:
     ap.add_argument("--out-dir", default=os.path.join(
         os.path.dirname(__file__), "..", "media"))
     args = ap.parse_args()
+
+    # the sitecustomize axon plugin IGNORES the JAX_PLATFORMS env var —
+    # honoring it needs jax.config.update before the first backend touch
+    # (same workaround as bench.py / tests/conftest.py). Without this a
+    # CPU-intended synth run hangs in TPU client init when the tunnel is
+    # down.
+    if os.environ.get("JAX_PLATFORMS", "").strip():
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"].strip())
 
     if args.model == "tiny":
         import jax
