@@ -77,66 +77,75 @@ bench() {
   if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out"; then touch "$marker"; fi
 }
 
-# --- ordered by information value; dense first (the headline number) -------
+# --- ordered by information value under window scarcity: each window may
+# be minutes long, so the most distinct stories come first; every stage is
+# resumable (markers) and the matrix makes up to 3 passes so a stage that
+# crashed mid-window is retried. ------------------------------------------
+matrix() {
 bench dense   /tmp/bench_tpu_dense.json
+# the flagship engine + the round-3 corrected Mosaic launch
 bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
-# end-to-end sampler A/B: the multiway top-p filter inside the real dense
-# decode loop, against the recorded dense (binary bisect) number
-bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
-# dense at realistic length variance: quantifies the wave-straggler cost
-# the refill scheduler exists to remove (A/B against refill_eos below)
-bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
-# scheduler A/B at realistic length variance (mean ~1/0.002 = 500 of 1200
-# tokens ≈ the reference's ~470 mean): waves pay each wave's straggler
-# tail, refill keeps all slots busy
+# scheduler headline at realistic length variance (mean ~1/0.002 = 500 of
+# 1200 tokens ≈ the reference's ~470 mean): refill keeps slots busy
 bench refill_eos /tmp/bench_tpu_refill_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill
-bench waves_eos /tmp/bench_tpu_waves_eos.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
-bench spec    /tmp/bench_tpu_spec.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
-# page-budgeted pool (the --actor_gpu_usage path): grow-as-you-go grants
-# + preempt-by-recompute at ~realized-length provisioning (1 + 128*6 pages
-# would be worst case at these shapes; 500 forces the budget on)
-bench budget  /tmp/bench_tpu_budget.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
-bench int8kv  /tmp/bench_tpu_int8kv.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
+# the second headline metric: jitted train-step tok/s + MFU
 bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
-# flash-attention A/B for the learner step (S=1550): decides whether the
-# config-level attn_impl default should be flash on TPU
-bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
-
-# quick dispatch-latency probe: is per-step dispatch over the tunnel the
-# decode bottleneck? (informs whether to scan-chunk the decode loops)
-run_stage dispatch_probe 300 bash -c \
-  'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
-   cat /tmp/dispatch_probe.log; exit $rc'
-# sampler A/B at decode shape: decides the engines' top-p default
-run_stage sampler_probe 600 bash -c \
-  'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
-   cat /tmp/sampler_probe.log; exit $rc'
-
+# kernel parity on silicon (fwd + bwd) — the N1/N3/N10 lowering authority
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
    grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
    exit $rc'
-# real-scale learning curve on silicon (random-init 0.5B + digit reward;
-# no weights needed) — artifact lands in media/
-run_stage train_curve 3000 bash -c \
-  'python tools/train_curve.py --model synth-qwen2.5-0.5b --episodes 12 \
-     > /tmp/train_curve_tpu.log 2>&1; rc=$?; tail -2 /tmp/train_curve_tpu.log; exit $rc'
+# A/Bs: sampler inside the real decode loop; waves straggler tail; dense
+# at variance; speculative; page budget; int8 KV; learner flash
+bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
+bench waves_eos /tmp/bench_tpu_waves_eos.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
+bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
+bench spec    /tmp/bench_tpu_spec.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
+bench budget  /tmp/bench_tpu_budget.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
+bench int8kv  /tmp/bench_tpu_int8kv.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
+bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
+# probes: dispatch overhead (scan-chunk decision), sampler microbench
+run_stage dispatch_probe 300 bash -c \
+  'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
+   cat /tmp/dispatch_probe.log; exit $rc'
+run_stage sampler_probe 600 bash -c \
+  'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
+   cat /tmp/sampler_probe.log; exit $rc'
 # compile-time HBM ground truth for the config-2 table (BASELINE.md)
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
-
 # 7B capacity config (BASELINE config-2): int4 base + int8 KV + refill —
-# the like-for-like model scale against the reference's 7B headline runs.
-# Longer timeout: host-side init+quantize of 7B plus a 7B Mosaic compile.
+# the like-for-like model scale against the reference's 7B headline runs
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
   BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
   BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
   BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16
+# longest stage last: the on-chip reward curve checkpoints+resumes, so
+# every window it reaches adds steps even if it never finishes in one
+run_stage train_curve 3000 bash -c \
+  'python tools/train_curve.py --model synth-qwen2.5-0.5b --episodes 12 \
+     > /tmp/train_curve_tpu.log 2>&1; rc=$?; tail -2 /tmp/train_curve_tpu.log; exit $rc'
+}
 
+all_done() {
+  local n
+  for n in dense paged refill_eos learner kernel_check dense_mw waves_eos \
+           dense_eos spec budget int8kv learner_flash dispatch_probe \
+           sampler_probe mem_envelope qwen7b_int4 train_curve; do
+    [ -f "/tmp/graft_stage_${n}.done" ] || return 1
+  done
+  return 0
+}
+
+for pass in 1 2 3; do
+  echo "$(date -u +%H:%M:%S) matrix pass $pass"
+  matrix
+  if all_done; then break; fi
+done
 echo "$(date -u +%H:%M:%S) matrix complete"
