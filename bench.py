@@ -327,9 +327,8 @@ def main() -> int:
         PagedGenerationEngine if os.environ.get("BENCH_ENGINE") == "paged"
         else GenerationEngine
     )
-    engine_kwargs = {}
+    engine_kwargs = {"kv_quant": os.environ.get("BENCH_KV_QUANT", "none")}
     if os.environ.get("BENCH_ENGINE") == "paged":
-        engine_kwargs["kv_quant"] = os.environ.get("BENCH_KV_QUANT", "none")
         engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")
         if os.environ.get("BENCH_SPEC_DRAFT"):
             # n-gram speculative decoding (needs the refill scheduler + cap)

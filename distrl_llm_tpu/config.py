@@ -285,8 +285,6 @@ class TrainConfig:
             raise ValueError(
                 f"kv_cache_quant must be none/int8, got {self.kv_cache_quant!r}"
             )
-        if self.kv_cache_quant != "none" and self.engine_impl == "dense":
-            raise ValueError("kv_cache_quant requires a paged engine")
         if self.engine_impl == "paged_sharded" and (
             self.continuous_batching or self.spec_draft
         ):

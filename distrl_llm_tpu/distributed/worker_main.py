@@ -67,10 +67,9 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     from distrl_llm_tpu.models.lora import lora_scale as _scale
 
     _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
-    kwargs = {}
+    kwargs = {"kv_quant": kv_quant}  # both engines support int8 KV
     if engine_impl == "paged":
         engine_cls = PagedGenerationEngine
-        kwargs["kv_quant"] = kv_quant
         kwargs["scheduler"] = scheduler
         if spec_draft:
             kwargs["spec_draft"] = spec_draft

@@ -38,7 +38,9 @@ import numpy as np
 
 from distrl_llm_tpu.config import SamplingConfig
 from distrl_llm_tpu.models.configs import ModelConfig
-from distrl_llm_tpu.models.transformer import forward, init_kv_cache
+from distrl_llm_tpu.models.transformer import (
+    forward, init_kv_cache, init_kv_cache_int8,
+)
 from distrl_llm_tpu.ops.sampling import sample, token_logprob
 
 Params = dict[str, Any]
@@ -77,7 +79,11 @@ class _DecodeState(NamedTuple):
 def _prefill(params, lora, prompt_ids, prompt_mask, *, cfg: ModelConfig,
              max_total: int, lora_scale: float, cache_dtype, attn_impl: str):
     b, p = prompt_ids.shape
-    cache = init_kv_cache(cfg, b, max_total, dtype=cache_dtype)
+    cache = (
+        init_kv_cache_int8(cfg, b, max_total)
+        if cache_dtype == "int8"
+        else init_kv_cache(cfg, b, max_total, dtype=cache_dtype)
+    )
     key_mask = jnp.pad(prompt_mask, ((0, 0), (0, max_total - p)))
     last_logits, cache = forward(
         params, cfg, prompt_ids,
@@ -315,6 +321,7 @@ class GenerationEngine(LoraMailbox):
         pad_token_id: int,
         lora_scale: float = 1.0,
         cache_dtype=jnp.bfloat16,
+        kv_quant: str = "none",  # "int8": fused-dequant cache (paged parity)
         attn_impl: str = "reference",
         decode_chunk: int = 128,
         prompt_buckets: Sequence[int] | None = None,
@@ -331,7 +338,13 @@ class GenerationEngine(LoraMailbox):
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale
-        self.cache_dtype = cache_dtype
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
+        # "int8" rides the cache_dtype static arg as a sentinel: _prefill
+        # builds the scale-carrying cache and the forward's dense-cache
+        # branch switches to attention_cached_quant
+        self.cache_dtype = "int8" if kv_quant == "int8" else cache_dtype
+        self.kv_quant = kv_quant
         self.attn_impl = attn_impl
         self.decode_chunk = decode_chunk
         # Length bucketing (SURVEY §2b N1 "static batch + length bucketing

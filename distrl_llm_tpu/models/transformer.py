@@ -89,6 +89,8 @@ def _layer(
     cache_k: jax.Array | None,  # [B, K, hd, Smax] — S minormost (attention_cached)
     cache_v: jax.Array | None,
     *,
+    cache_k_scale: jax.Array | None = None,  # f32 [B, K, 1, Smax] — int8 KV
+    cache_v_scale: jax.Array | None = None,
     cfg: ModelConfig,
     cos: jax.Array,
     sin: jax.Array,
@@ -185,8 +187,22 @@ def _layer(
             cache_v = write_prompt_to_pages(cache_v, v, page_indices, page_size)
             att = attention(q, k, v, mask, impl=attn_impl, key_valid=key_valid)
     elif cache_k is not None:
-        k_t = k.astype(cache_k.dtype).transpose(0, 2, 3, 1)  # [B, K, hd, S]
-        v_t = v.astype(cache_v.dtype).transpose(0, 2, 3, 1)
+        quant = cache_k_scale is not None
+        if quant:
+            # int8 KV cache: quantize the new positions per (B, K, position)
+            # over head_dim and write values + scales; attention reads the
+            # cache at 1 byte/element with dequant folded into the einsums
+            from distrl_llm_tpu.ops.attention import quantize_kv_position
+
+            k_t, ks = quantize_kv_position(k.transpose(0, 2, 3, 1))
+            v_t, vs = quantize_kv_position(v.transpose(0, 2, 3, 1))
+            cache_k_scale = jax.lax.dynamic_update_slice(
+                cache_k_scale, ks, (0, 0, 0, cache_offset))
+            cache_v_scale = jax.lax.dynamic_update_slice(
+                cache_v_scale, vs, (0, 0, 0, cache_offset))
+        else:
+            k_t = k.astype(cache_k.dtype).transpose(0, 2, 3, 1)  # [B, K, hd, S]
+            v_t = v.astype(cache_v.dtype).transpose(0, 2, 3, 1)
         cache_k = jax.lax.dynamic_update_slice(cache_k, k_t, (0, 0, 0, cache_offset))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v_t, (0, 0, 0, cache_offset))
         if attn_impl == "flash" and isinstance(cache_offset, int) and cache_offset == 0 and s > 1:
@@ -196,6 +212,12 @@ def _layer(
             att = attention(
                 q, k, v, mask[..., :s], impl="flash",
                 key_valid=key_valid[:, :s] if key_valid is not None else None,
+            )
+        elif quant:
+            from distrl_llm_tpu.ops.attention import attention_cached_quant
+
+            att = attention_cached_quant(
+                q, cache_k, cache_k_scale, cache_v, cache_v_scale, mask
             )
         else:
             att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
@@ -224,7 +246,7 @@ def _layer(
     gate = act(proj(h, p, lora, "w_gate", "b_gate", lora_scale))
     up = proj(h, p, lora, "w_up", "b_up", lora_scale)
     x = x + proj(gate * up, p, lora, "w_down", "b_down", lora_scale)
-    return x, cache_k, cache_v
+    return x, cache_k, cache_v, cache_k_scale, cache_v_scale
 
 
 def forward(
@@ -342,7 +364,7 @@ def forward(
     if kv_cache is None:
         def scan_body(x, xs):
             p, lora_p, key = xs
-            y, _, _ = layer_fn(x, p, lora_p, None, None, dropout_rng=key)
+            y = layer_fn(x, p, lora_p, None, None, dropout_rng=key)[0]
             return y, None
 
         if remat:
@@ -359,7 +381,8 @@ def forward(
         # reference rollout volume, measured via compile memory_analysis).
         # Separate per-layer carry leaves alias to zero temp bytes. Weight
         # slices params["layers"][w][i] are static and fuse into their matmuls.
-        new_k, new_v = [], []
+        kv_quant = "k_scale" in kv_cache  # int8 dense cache carries scales
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         for i in range(cfg.num_layers):
             p_i = jax.tree_util.tree_map(lambda w: w[i], params["layers"])
             lora_i = (
@@ -367,13 +390,21 @@ def forward(
                 if lora is not None else None
             )
             key_i = layer_keys[i] if layer_keys is not None else None
-            x, ck, cv = layer_fn(
+            x, ck, cv, cks, cvs = layer_fn(
                 x, p_i, lora_i, kv_cache["k"][i], kv_cache["v"][i],
+                cache_k_scale=kv_cache["k_scale"][i] if kv_quant else None,
+                cache_v_scale=kv_cache["v_scale"][i] if kv_quant else None,
                 dropout_rng=key_i,
             )
             new_k.append(ck)
             new_v.append(cv)
+            new_ks.append(cks)
+            new_vs.append(cvs)
         new_k, new_v = tuple(new_k), tuple(new_v)
+        new_scales = (
+            {"k_scale": tuple(new_ks), "v_scale": tuple(new_vs)}
+            if kv_quant else {}
+        )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                  offset=cfg.rmsnorm_offset)
@@ -400,7 +431,7 @@ def forward(
     if kv_cache is None:
         new_cache = None
     else:
-        new_cache = {**kv_cache, "k": new_k, "v": new_v}
+        new_cache = {**kv_cache, "k": new_k, "v": new_v, **new_scales}
     return logits, new_cache
 
 
@@ -451,4 +482,20 @@ def init_kv_cache(
     return {
         "k": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
         "v": tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+    }
+
+
+def init_kv_cache_int8(cfg: ModelConfig, batch: int, max_seq: int) -> Params:
+    """int8 dense decode cache with per-(B, K, position) f32 scales —
+    1 + 4/head_dim bytes per element vs bf16's 2. Same per-layer-tuple /
+    S-minormost layout rules as ``init_kv_cache``; the "k_scale"/"v_scale"
+    keys switch the dense-cache forward onto the fused-dequant attention
+    path (ops/attention.py::attention_cached_quant)."""
+    shape = (batch, cfg.num_kv_heads, cfg.head_dim, max_seq)
+    sshape = (batch, cfg.num_kv_heads, 1, max_seq)
+    return {
+        "k": tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+        "v": tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+        "k_scale": tuple(jnp.zeros(sshape, jnp.float32) for _ in range(cfg.num_layers)),
+        "v_scale": tuple(jnp.zeros(sshape, jnp.float32) for _ in range(cfg.num_layers)),
     }

@@ -79,18 +79,68 @@ def attention_cached(
     return _gqa_attention(q, k, v, mask, scale, kv_subscript="bkds", kv_heads_axis=1)
 
 
-def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str, kv_heads_axis: int):
+def quantize_kv_position(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(B, K, position) symmetric int8 over head_dim for the decode
+    cache: [B, K, hd, S] → (int8 [B, K, hd, S], f32 scales [B, K, 1, S])."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return q8, s
+
+
+def attention_cached_quant(
+    q: jax.Array,  # [B, Sq, H, D]
+    k8: jax.Array,  # int8 [B, K, D, Sk] — decode-cache layout
+    k_scale: jax.Array,  # f32 [B, K, 1, Sk]
+    v8: jax.Array,  # int8 [B, K, D, Sk]
+    v_scale: jax.Array,  # f32 [B, K, 1, Sk]
+    mask: jax.Array | None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Masked GQA attention against an int8 KV cache with per-position
+    scales, dequantization FOLDED into the attention math so the cache is
+    read from HBM at 1 byte/element (the paged engine's int8-KV bandwidth
+    win, for the dense engine):
+
+    * k: logits[..., s] = (Σ_d q·k8) · k_scale[s] — the scale factors out
+      of the contraction over d;
+    * v: out[..., d] = Σ_s probs·v8·v_scale[s] — the scale rides the probs
+      ([B, K, G, Sq, Sk] f32, already materialized by the softmax).
+
+    XLA fuses the int8→f32 convert into the dot-operand read; the
+    decode-step HBM audit in tools/tpu_kernel_check.py is the on-chip
+    check that no f32 cache-sized temp materializes."""
+    return _gqa_attention(
+        q, k8, v8, mask, scale, kv_subscript="bkds", kv_heads_axis=1,
+        k_scale=k_scale, v_scale=v_scale,
+    ).astype(q.dtype)
+
+
+def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
+                   kv_heads_axis: int, k_scale=None, v_scale=None):
     """Shared GQA attention body; only the kv einsum layout differs between
-    the training ([B,S,K,D]) and decode-cache ([B,K,D,S]) paths."""
+    the training ([B,S,K,D]) and decode-cache ([B,K,D,S]) paths.
+
+    ``k_scale``/``v_scale`` ([B, K, 1, Sk] f32, decode-cache layout only)
+    switch on the fused-dequant int8 path: k/v stay int8 in HBM, the k
+    scale factors out of the d-contraction onto the logits, the v scale
+    rides the (already f32) probs."""
+    quant = k_scale is not None
+    assert not quant or kv_heads_axis == 1, "scales imply the [B,K,D,S] layout"
     b, sq, h, d = q.shape
     kh = k.shape[kv_heads_axis]
     g = h // kh
     if scale is None:
         scale = d**-0.5
     qg = q.reshape(b, sq, kh, g, d)
+    if quant:
+        qg = qg.astype(jnp.float32)
+        k = k.astype(jnp.float32)  # fused into the dot-operand read by XLA
     logits = jnp.einsum(
         f"bqkgd,{kv_subscript}->bkgqs", qg, k, preferred_element_type=jnp.float32
     )
+    if quant:
+        logits = logits * k_scale[:, :, :, None, :]  # [B, K, 1, 1, Sk]
     logits = logits * scale
     if mask is not None:
         if mask.shape[1] == 1:  # head-agnostic mask
@@ -98,7 +148,12 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str, kv_heads_axis: in
         else:
             m = mask.reshape(b, kh, g, *mask.shape[2:])
         logits = jnp.where(m, logits, NEG_INF)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if quant:
+        probs = probs * v_scale[:, :, :, None, :]
+        v = v.astype(jnp.float32)
+    else:
+        probs = probs.astype(v.dtype)
     out = jnp.einsum(f"bkgqs,{kv_subscript}->bqkgd", probs, v)
     return out.reshape(b, sq, h, d)
 

@@ -57,9 +57,7 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     """Engine-constructor kwargs derived from the config (paged-engine knobs:
     KV quant, continuous batching, speculative decoding, row cap). Module
     level so the config→engine wiring is unit-testable without a checkpoint."""
-    kwargs: dict[str, Any] = {}
-    if config.engine_impl in ("paged", "paged_sharded"):
-        kwargs["kv_quant"] = config.kv_cache_quant
+    kwargs: dict[str, Any] = {"kv_quant": config.kv_cache_quant}
     if config.engine_impl == "paged":
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"

@@ -99,6 +99,10 @@ run_stage kernel_check 900 bash -c \
 # A/Bs: sampler inside the real decode loop; waves straggler tail; dense
 # at variance; speculative; page budget; int8 KV; learner flash
 bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
+# dense int8 KV (fused-dequant cache): halves the 9.1 GB/step cache read
+bench dense_int8 /tmp/bench_tpu_dense_int8.json BENCH_KV_QUANT=int8
+# dense with BOTH decode-bandwidth levers on: the headline-challenger run
+bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
 bench waves_eos /tmp/bench_tpu_waves_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
@@ -135,9 +139,10 @@ run_stage train_curve 3000 bash -c \
 
 all_done() {
   local n
-  for n in dense paged refill_eos learner kernel_check dense_mw waves_eos \
-           dense_eos spec budget int8kv learner_flash dispatch_probe \
-           sampler_probe mem_envelope qwen7b_int4 train_curve; do
+  for n in dense paged refill_eos learner kernel_check dense_mw dense_int8 \
+           dense_int8_mw waves_eos dense_eos spec budget int8kv \
+           learner_flash dispatch_probe sampler_probe mem_envelope \
+           qwen7b_int4 train_curve; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done
   return 0
