@@ -171,14 +171,19 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         coeffs=jnp.asarray(rng.normal(size=n_rows), jnp.float32),
         sample_mask=jnp.ones((n_rows,), jnp.float32),
     )
+    # Time against a device-to-host FETCH, not block_until_ready: on the
+    # tunneled PJRT client block_until_ready returned before chained steps
+    # actually ran (round-3 learner record: step_seconds 0.0, "MFU" 503x —
+    # physically impossible). float(loss) cannot return early: the scalar's
+    # bytes depend on the whole step chain.
     t0 = time.perf_counter()
     lora, opt_state, loss = step(lora, opt_state, params, batch)
-    jax.block_until_ready(loss)
+    float(loss)
     compile_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(steps):
         lora, opt_state, loss = step(lora, opt_state, params, batch)
-    jax.block_until_ready(loss)
+    loss_val = float(loss)
     dt = (time.perf_counter() - t0) / steps
 
     tokens = n_rows * (p_len + t_len)
@@ -212,8 +217,15 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         "chips": n_chips,
         "devices_visible": jax.device_count(),
         "train_flops_per_token_gflop": round(flops / 1e9, 6),
-        "loss": float(loss),
+        "loss": loss_val,
     }
+    if mfu > 0.6:
+        # >60% MFU on a fwd+bwd step means the timing is broken, not that
+        # the chip is fast — mark the record unusable rather than quotable
+        record["error"] = (
+            f"implausible timing (mfu {mfu:.2f}): steps did not synchronize"
+        )
+        record["vs_baseline"] = 0.0
     if fallback_err:
         record["error"] = f"TPU backend unavailable ({fallback_err}); CPU fallback"
         record["vs_baseline"] = 0.0
@@ -328,6 +340,10 @@ def main() -> int:
         else GenerationEngine
     )
     engine_kwargs = {"kv_quant": os.environ.get("BENCH_KV_QUANT", "none")}
+    if os.environ.get("BENCH_SCAN_CHUNK") and os.environ.get("BENCH_ENGINE") != "paged":
+        # K decode steps fused per dispatch (dense engine) — the tunnel
+        # dispatch-overhead lever; see tools/dispatch_probe.py
+        engine_kwargs["scan_chunk"] = int(os.environ["BENCH_SCAN_CHUNK"])
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")
         if os.environ.get("BENCH_SPEC_DRAFT"):
@@ -456,6 +472,8 @@ def main() -> int:
         "model": name,
         "base_quant": base_quant,
         "top_p_impl": sampling.resolved_top_p_impl(),
+        "scan_chunk": engine_kwargs.get("scan_chunk", 0),
+        "scan_chunk_active": getattr(engine, "scan_chunk_active", None),
         "backend": jax.devices()[0].platform,
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,

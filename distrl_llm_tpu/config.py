@@ -182,6 +182,14 @@ class TrainConfig:
     # the current jaxlib kernel materializes broadcast scales per step, so
     # this is a capacity knob, not a decode-speed knob (ops/paged.py)
     kv_cache_quant: str = "none"
+    # K decode steps per dispatch in the dense engine (lax.scan inside one
+    # jitted program). Over a network-tunneled PJRT client each dispatch can
+    # cost a round trip that bounds decode throughput regardless of chip
+    # speed (tools/dispatch_probe.py measures it); chunking divides that
+    # overhead by K. The engine compile-checks the chunked program's
+    # memory_analysis and falls back to one dispatch per step if the TPU
+    # compiler double-buffered the KV cache in the scan carry. 0 = off.
+    decode_scan_chunk: int = 0
     # control-plane rollout workers ("host:port", ...): when set, generation
     # dispatches to these worker processes (distributed/worker_main.py) over
     # the C++ control plane instead of running on local chips — the
@@ -320,6 +328,16 @@ class TrainConfig:
             raise ValueError(
                 "full_finetune cannot ship full weights to rollout_workers "
                 "(workers receive adapters only); run local rollout"
+            )
+        if self.decode_scan_chunk < 0:
+            raise ValueError(
+                f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
+            )
+        if self.decode_scan_chunk and self.engine_impl != "dense":
+            raise ValueError(
+                "decode_scan_chunk is a dense-engine knob (the paged "
+                "schedulers do host-side refill between steps); use "
+                "engine_impl='dense' or 0"
             )
         if self.continuous_batching and (
             self.engine_impl != "paged" or not self.max_concurrent_sequences

@@ -28,6 +28,7 @@ TP/DP-sharded; pass host arrays and it runs single-chip.
 
 from __future__ import annotations
 
+import logging
 import threading
 from functools import partial
 from typing import Any, NamedTuple, Sequence
@@ -44,6 +45,8 @@ from distrl_llm_tpu.models.transformer import (
 from distrl_llm_tpu.ops.sampling import sample, token_logprob
 
 Params = dict[str, Any]
+
+_logger = logging.getLogger(__name__)
 
 
 class GenerationResult(NamedTuple):
@@ -163,6 +166,47 @@ def _decode_step(params, lora, state: _DecodeState, rng,
         step=s.step + 1, out=out, logps=logps, lengths=lengths, done=done,
         key_mask=key_mask, logits=next_logits[:, 0], cache=cache,
     )
+
+
+def _decode_chunk(params, lora, state: _DecodeState, rng,
+                  *, chunk: int, max_steps: int, cfg: ModelConfig,
+                  prompt_len: int, eos_ids, pad_id: int, temperature, top_p,
+                  lora_scale: float, attn_impl: str, top_p_impl: str,
+                  capture_logprobs: bool):
+    """``chunk`` decode steps in ONE dispatch via ``lax.scan``.
+
+    Over the axon tunnel each host dispatch can cost a network round trip
+    (tools/dispatch_probe.py measures it); at the observed ~44 ms/step against
+    a ~5 ms/step chip time, per-dispatch overhead — not the chip — bounds
+    decode throughput. Scanning K steps into one program divides that
+    overhead by K.
+
+    The body is guarded by ``lax.cond`` on ``done.all() | step >= max_steps``:
+    the guard makes chunk overshoot free (no forward flops after every row
+    hit EOS) and makes running ceil(max_steps/chunk) full chunks safe — an
+    unguarded step at ``step >= max_steps`` would clamp its
+    dynamic_update_slice onto the last valid position and corrupt it.
+
+    The docstring caveat on on-device loops (a while-loop carry updated by
+    dynamic_update_slice can be double-buffered by the TPU compiler, costing
+    a full KV-cache-sized HBM temp) applies here too, so the engine
+    compile-checks ``memory_analysis().temp_size_in_bytes`` before trusting
+    a chunked program and falls back to the host loop if the cache got
+    double-buffered (``_chunk_fn_for_bucket``)."""
+    def run(s):
+        return _decode_step(
+            params, lora, s, rng, cfg=cfg, prompt_len=prompt_len,
+            eos_ids=eos_ids, pad_id=pad_id, temperature=temperature,
+            top_p=top_p, lora_scale=lora_scale, attn_impl=attn_impl,
+            top_p_impl=top_p_impl, capture_logprobs=capture_logprobs,
+        )
+
+    def body(s, _):
+        halt = jnp.logical_or(s.done.all(), s.step >= max_steps)
+        return jax.lax.cond(halt, lambda s: s, run, s), None
+
+    state, _ = jax.lax.scan(body, state, None, length=chunk)
+    return state
 
 
 def generate_in_waves(
@@ -324,12 +368,20 @@ class GenerationEngine(LoraMailbox):
         kv_quant: str = "none",  # "int8": fused-dequant cache (paged parity)
         attn_impl: str = "reference",
         decode_chunk: int = 128,
+        scan_chunk: int = 0,  # >0: K decode steps per dispatch via lax.scan
         prompt_buckets: Sequence[int] | None = None,
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
         capture_logprobs: bool = False,  # record behavior logprobs (clip_ratio)
     ):
         self.max_concurrent_rows = max_concurrent_rows
         self.capture_logprobs = capture_logprobs
+        if scan_chunk < 0:
+            raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        self.scan_chunk = scan_chunk
+        # buckets where the chunked program compiled WITHOUT double-buffering
+        # the KV cache (memory_analysis guard) hold their compiled fn here;
+        # buckets where it did are marked None and use the host loop
+        self._chunk_compiled: dict[int, Any] = {}
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
@@ -372,6 +424,17 @@ class GenerationEngine(LoraMailbox):
             # rows) allocates fresh buffers the prefill cache can't alias
         )
 
+    @property
+    def scan_chunk_active(self) -> bool | None:
+        """Whether chunked decode actually ran: True once a chunked program
+        compiled AND passed the memory guard, False if every attempt fell
+        back to the host loop, None before the first decode (or scan_chunk=0).
+        Bench records report this so a fallback can't masquerade as a
+        chunked measurement."""
+        if not self.scan_chunk or not self._chunk_compiled:
+            return None
+        return any(v is not None for v in self._chunk_compiled.values())
+
     def bucket_for(self, prompt_mask) -> int:
         """The bucket a batch with this mask will run at: the smallest bucket
         holding the longest real prompt."""
@@ -411,6 +474,82 @@ class GenerationEngine(LoraMailbox):
                 )
                 self._compiled[bucket] = (prefill, step)
             return self._compiled[bucket]
+
+    def _chunk_fn_for_bucket(
+        self, bucket: int, max_steps: int, params, lora, state, rng,
+        temperature, top_p, top_p_impl: str,
+    ):
+        """Compiled K-steps-per-dispatch program for this (bucket, shapes)
+        combination, or None where the host loop should be used instead.
+
+        The program is explicitly lowered + compiled so its
+        ``memory_analysis`` can be inspected BEFORE it ever runs: if the TPU
+        compiler double-buffered the scan carry (temp bytes on the order of
+        the KV cache — the failure mode that made the host-dispatched loop
+        the default, see module docstring) the chunked program would OOM the
+        very configs it is meant to speed up, so it is rejected and the wave
+        falls back to one dispatch per step. Compile failures (e.g. a Mosaic
+        lowering surprise on a new config) also fall back rather than kill
+        the round."""
+        bn = state.out.shape[0]
+        # the adapter rides the compiled call as a pytree argument: a
+        # Compiled executable (unlike a jit) raises on a structurally
+        # different tree instead of retracing, so lora=None rounds and
+        # adapter rounds need separate cache entries
+        lora_sig = (
+            jax.tree_util.tree_structure(lora),
+            tuple(
+                (tuple(x.shape), jnp.dtype(x.dtype).name)
+                for x in jax.tree_util.tree_leaves(lora)
+            ),
+        )
+        key = (bucket, max_steps, top_p_impl, bn, lora_sig)
+        with self._compile_mu:
+            if key in self._chunk_compiled:
+                return self._chunk_compiled[key]
+            fn = jax.jit(
+                partial(
+                    _decode_chunk, chunk=min(self.scan_chunk, max_steps),
+                    max_steps=max_steps, cfg=self.cfg, prompt_len=bucket,
+                    pad_id=self.pad_id, lora_scale=self.lora_scale,
+                    attn_impl=self.attn_impl, top_p_impl=top_p_impl,
+                    capture_logprobs=self.capture_logprobs,
+                ),
+                donate_argnames=("state",),
+            )
+            compiled = None
+            try:
+                compiled = fn.lower(
+                    params, lora, state, rng, eos_ids=self.eos_ids,
+                    temperature=temperature, top_p=top_p,
+                ).compile()
+                cache_bytes = sum(
+                    x.nbytes for x in jax.tree_util.tree_leaves(state.cache)
+                )
+                temp = None
+                try:
+                    ma = compiled.memory_analysis()
+                    temp = getattr(ma, "temp_size_in_bytes", None)
+                except Exception:  # backend without memory analysis (cpu)
+                    ma = None
+                if temp is not None and temp > 0.5 * cache_bytes:
+                    _logger.warning(
+                        "scan_chunk=%d: chunked decode program double-buffers "
+                        "the KV cache (temp %.2f GiB vs cache %.2f GiB) — "
+                        "falling back to host-dispatched steps for bucket %d",
+                        self.scan_chunk, temp / 2**30, cache_bytes / 2**30,
+                        bucket,
+                    )
+                    compiled = None
+            except Exception as e:  # pragma: no cover - backend-specific
+                _logger.warning(
+                    "scan_chunk=%d: chunked decode compile failed (%s: %s) — "
+                    "falling back to host-dispatched steps for bucket %d",
+                    self.scan_chunk, type(e).__name__, e, bucket,
+                )
+                compiled = None
+            self._chunk_compiled[key] = compiled
+            return compiled
 
     def generate(
         self,
@@ -463,19 +602,44 @@ class GenerationEngine(LoraMailbox):
         lora_cell = [lora]
         steps_seen = [0]
 
-        def step(s):
-            # in-flight weight-update mailbox: swap BEFORE sampling, so the
-            # recorded swap step is the first position decoded under the new
-            # adapter (dense decode: step index == generated position)
-            self._take_pending_lora(lora_cell, steps_seen[0])
-            steps_seen[0] += 1
-            return decode_step_fn(
-                params, lora_cell[0], s, rng,
-                eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
-                top_p_impl=top_p_impl,
+        chunk_fn = (
+            self._chunk_fn_for_bucket(
+                bucket, max_steps, params, lora, state, rng,
+                temperature, top_p, top_p_impl,
             )
+            if self.scan_chunk > 0 and max_steps > 1
+            else None
+        )
+        if chunk_fn is not None:
+            k = min(self.scan_chunk, max_steps)
 
-        state = run_decode_loop(step, state, max_steps, self.decode_chunk)
+            def step(s):
+                # in-flight swaps land at chunk boundaries: the recorded swap
+                # step is the first position decoded under the new adapter
+                self._take_pending_lora(lora_cell, steps_seen[0])
+                steps_seen[0] += k
+                return chunk_fn(
+                    params, lora_cell[0], s, rng, eos_ids=self.eos_ids,
+                    temperature=temperature, top_p=top_p,
+                )
+
+            # one "step" per chunk; snapshot done flags every chunk (check=1)
+            state = run_decode_loop(step, state, -(-max_steps // k), 1)
+        else:
+
+            def step(s):
+                # in-flight weight-update mailbox: swap BEFORE sampling, so
+                # the recorded swap step is the first position decoded under
+                # the new adapter (dense decode: step index == position)
+                self._take_pending_lora(lora_cell, steps_seen[0])
+                steps_seen[0] += 1
+                return decode_step_fn(
+                    params, lora_cell[0], s, rng,
+                    eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
+                    top_p_impl=top_p_impl,
+                )
+
+            state = run_decode_loop(step, state, max_steps, self.decode_chunk)
         out = np.asarray(state.out).reshape(b, sampling.n, max_steps)
         lengths = np.asarray(state.lengths).reshape(b, sampling.n)
         logps = (

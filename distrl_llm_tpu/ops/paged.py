@@ -26,6 +26,8 @@ distributed_actor.py:148–150). TPU-native design:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -291,30 +293,37 @@ _kernel_fail_warned = False
 _fixed_launch_state: dict = {}
 
 
-def _fixed_launch_available(
+def _probe_launch(
+    fn_name: str,
     quantized: bool,
+    num_kv_heads: int,
     num_groups: int,
     head_dim: int,
     page_size: int,
     q_dtype,
     kv_dtype,
     blocks: int,
+    pps: int,
 ) -> bool:
-    """Per-config probe: compile + run our corrected launch at tiny shapes
-    on the REAL backend. The launch is validated under the Pallas
+    """Per-config probe: compile + run a paged-attention launch at tiny
+    shapes on the REAL backend. Launches are validated under the Pallas
     interpreter in CI, but a Mosaic lowering rejection (or jaxlib internal
     kernel drift) would otherwise surface as a compile error inside the
     engine's jitted step — past the point where ``impl="auto"`` could fall
-    back. Probing in an isolated computation keeps auto mode graceful: on
-    failure we warn once and route through jaxlib's public wrapper.
+    back. Probing in an isolated computation keeps auto mode graceful.
 
-    Keyed on the quantities that select Mosaic code paths: the quantization
-    flag (scale scratch layout), num_groups (3-d vs 4-d block specs via
-    ``num_groups % 8``), head_dim, page_size and the compute-block count
-    (VMEM scratch tiling), and the q/KV dtypes (Mosaic tiles bf16 (16,128)
-    vs f32 (8,128), and the 3-d path launches q at its own dtype)."""
-    key = (quantized, num_groups, head_dim, page_size, q_dtype, kv_dtype,
-           blocks)
+    Keyed on the quantities that select Mosaic code paths: the launch, the
+    quantization flag (scale scratch layout), num_kv_heads (the kernel's
+    per-head HBM DMA slice — probing K=1 hid a real Mosaic rejection of
+    ``pages.at[head]`` for head_dim 64, first seen on silicon round 3),
+    num_groups (3-d vs 4-d block specs via ``num_groups % 8``), head_dim,
+    page_size and the compute-block count (VMEM scratch tiling), the
+    q/KV dtypes (Mosaic tiles bf16 (16,128) vs f32 (8,128)), and the REAL
+    pages_per_sequence — a pps=1 probe compiled a single-page program whose
+    DMA pattern differed from the real call's, passing where the real shape
+    failed (second silicon lesson of round 3)."""
+    key = (fn_name, quantized, num_kv_heads, num_groups, head_dim, page_size,
+           q_dtype, kv_dtype, blocks, pps)
     if key not in _fixed_launch_state:
         try:
             from distrl_llm_tpu.ops.paged_int8 import (
@@ -322,32 +331,45 @@ def _fixed_launch_available(
                 paged_attention_int8,
             )
 
-            b, pps = 1, blocks  # one compute block at the real block count
-            shape = (1, b * pps, page_size, head_dim)  # K=1 → H=num_groups
-            if quantized:
-                kq = init_quantized_pages(shape)
-                fn, kp, vp = paged_attention_int8, kq, kq
+            if fn_name == "fixed":
+                fn = paged_attention_int8 if quantized else paged_attention_gqa
             else:
-                kd = jnp.zeros(shape, kv_dtype)
-                fn, kp, vp = paged_attention_gqa, kd, kd
+                from jax.experimental.pallas.ops.tpu.paged_attention import (
+                    paged_attention as fn,
+                )
+
+            b = 1  # one sequence at the REAL pages-per-sequence count
+            shape = (num_kv_heads, b * pps, page_size, head_dim)
+            if quantized:
+                kp = vp = init_quantized_pages(shape)
+            else:
+                kp = vp = jnp.zeros(shape, kv_dtype)
             out = fn(
-                jnp.zeros((b, num_groups, head_dim), q_dtype), kp, vp,
+                jnp.zeros((b, num_kv_heads * num_groups, head_dim), q_dtype),
+                kp, vp,
                 jnp.ones((b,), jnp.int32),
                 jnp.asarray(make_page_table(b, pps * page_size, page_size)),
                 pages_per_compute_block=blocks,
             )
             jax.block_until_ready(out)
             _fixed_launch_state[key] = True
-        except Exception as e:  # noqa: BLE001 — any failure → jaxlib path
-            _fixed_launch_state[key] = False
+        except Exception as e:  # noqa: BLE001 — classify before caching
+            from distrl_llm_tpu.ops.attention import _TRANSIENT_ERR_MARKS
+
+            transient = any(m in str(e).upper() for m in _TRANSIENT_ERR_MARKS)
+            if not transient:
+                _fixed_launch_state[key] = False
             import logging
 
             logging.getLogger(__name__).warning(
-                "corrected paged-attention launch unavailable on this "
-                "backend for %s (%s); falling back to jaxlib's wrapper",
+                "paged-attention %s launch unavailable on this backend for "
+                "%s (%s)%s",
+                fn_name,
                 key,
                 e,
+                " (transient error — will re-probe)" if transient else "",
             )
+            return False
     return _fixed_launch_state[key]
 
 
@@ -385,18 +407,24 @@ def paged_attention_op(
             scaled_q = q * (q.shape[-1] ** -0.5)
             quantized = is_quantized_pages(k_pages)
             kw = k_pages.weight if quantized else k_pages
-            num_groups = q.shape[1] // kw.shape[0]
+            num_kv_heads = kw.shape[0]
+            num_groups = q.shape[1] // num_kv_heads
             head_dim, page_size = kw.shape[-1], kw.shape[-2]
             # Route through our corrected launch (compact int8 scales +
-            # legal m/l block specs for every (num_groups, head_dim) —
-            # jaxlib's wrapper rejects head_dim % 128 != 0; see
-            # ops/paged_int8.py). auto mode probe-compiles once per config
-            # and falls back to the jaxlib wrapper if the backend rejects
-            # the corrected launch.
-            if impl == "kernel" or _fixed_launch_available(
-                quantized, num_groups, head_dim, page_size,
-                scaled_q.dtype, kw.dtype, blocks,
-            ):
+            # legal m/l block specs — see ops/paged_int8.py). auto mode
+            # probe-compiles once per config at the REAL kv-head count and
+            # walks the chain corrected → jaxlib wrapper → jnp reference;
+            # both kernels share the per-head HBM DMA slice Mosaic rejects
+            # for head_dim % 128 != 0, so e.g. Qwen2.5-0.5B (hd=64) decodes
+            # via the reference path on-chip until our own kernel lands.
+            probe = functools.partial(
+                _probe_launch, quantized=quantized,
+                num_kv_heads=num_kv_heads, num_groups=num_groups,
+                head_dim=head_dim, page_size=page_size,
+                q_dtype=scaled_q.dtype, kv_dtype=kw.dtype, blocks=blocks,
+                pps=pps,
+            )
+            if impl == "kernel" or probe("fixed"):
                 from distrl_llm_tpu.ops.paged_int8 import (
                     paged_attention_gqa,
                     paged_attention_int8,
@@ -407,10 +435,11 @@ def paged_attention_op(
                     scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
                     page_indices, pages_per_compute_block=blocks,
                 ).astype(q.dtype)
-            return paged_attention(
-                scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
-                page_indices, pages_per_compute_block=blocks,
-            ).astype(q.dtype)
+            if probe("jaxlib"):
+                return paged_attention(
+                    scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
+                    page_indices, pages_per_compute_block=blocks,
+                ).astype(q.dtype)
         except Exception as e:  # noqa: BLE001 — fall back with one warning
             if impl == "kernel":
                 raise

@@ -321,3 +321,90 @@ class TestInt8KvCache:
                 TINY, max_prompt_tokens=8, max_new_tokens=4,
                 eos_token_ids=[1], pad_token_id=0, kv_quant="int4",
             )
+
+
+class TestScanChunk:
+    """K-steps-per-dispatch decode (``scan_chunk``): the chunked program must
+    be bit-identical to the host-dispatched loop — sampling rng depends only
+    on the step index (``fold_in(rng, step)``), so any divergence is a bug in
+    the chunk body, its overshoot guard, or the done masking."""
+
+    def _pair(self, scan_chunk, max_new=6, capture=False, eos=()):
+        kw = dict(max_prompt_tokens=P_LEN, max_new_tokens=max_new,
+                  eos_token_ids=eos or [TINY.vocab_size - 1], pad_token_id=0,
+                  cache_dtype=jnp.float32, capture_logprobs=capture)
+        host = GenerationEngine(TINY, **kw)
+        chunked = GenerationEngine(TINY, scan_chunk=scan_chunk, **kw)
+        return host, chunked
+
+    def test_greedy_parity_chunk_divides(self, setup):
+        params, ids, mask = setup
+        host, chunked = self._pair(scan_chunk=3, max_new=6)
+        sc = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+
+    def test_sampled_parity_with_overshoot_and_logprobs(self, setup):
+        """chunk=4 over max_new=6: the second chunk overshoots by 2 guarded
+        steps — tokens, lengths AND captured behavior logprobs must still be
+        bit-identical to the per-step loop."""
+        params, ids, mask = setup
+        host, chunked = self._pair(scan_chunk=4, max_new=6, capture=True)
+        sc = SamplingConfig(max_tokens=6, temperature=1.1, top_p=0.9, n=2)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        np.testing.assert_array_equal(a.logprobs, b.logprobs)
+
+    def test_eos_stop_parity(self, setup):
+        """Rows that hit EOS mid-chunk must stop, pad, and stop counting
+        exactly as in the host loop (the done masking rides inside the
+        scanned body)."""
+        params, ids, mask = setup
+        probe = make_engine(max_new=1).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=1, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        eos = [int(np.asarray(probe.tokens)[0, 0, 0])]  # row 0 stops at step 1
+        host, chunked = self._pair(scan_chunk=5, max_new=8, eos=eos)
+        sc = SamplingConfig(max_tokens=8, temperature=0.0, n=1)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+
+    def test_chunk_larger_than_max_steps(self, setup):
+        params, ids, mask = setup
+        host, chunked = self._pair(scan_chunk=16, max_new=3)
+        sc = SamplingConfig(max_tokens=3, temperature=0.0, n=1)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_negative_scan_chunk_rejected(self):
+        with pytest.raises(ValueError, match="scan_chunk"):
+            GenerationEngine(
+                TINY, max_prompt_tokens=8, max_new_tokens=4,
+                eos_token_ids=[1], pad_token_id=0, scan_chunk=-1,
+            )
+
+    def test_none_then_adapter_rounds_share_engine(self, setup):
+        """Round with lora=None then a round with an adapter (and back):
+        a Compiled chunk program raises on a structurally different pytree
+        instead of retracing, so the cache must key on the adapter
+        signature (round-3 review finding)."""
+        from distrl_llm_tpu.models import init_lora_params
+
+        params, ids, mask = setup
+        _, chunked = self._pair(scan_chunk=3, max_new=6)
+        host, _ = self._pair(scan_chunk=0, max_new=6)
+        lora = init_lora_params(jax.random.PRNGKey(5), TINY, rank=4)
+        sc = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        for adapter in (None, lora, None):
+            a = host.generate(params, adapter, ids, mask, sc, jax.random.PRNGKey(0))
+            b = chunked.generate(params, adapter, ids, mask, sc, jax.random.PRNGKey(0))
+            np.testing.assert_array_equal(a.tokens, b.tokens)

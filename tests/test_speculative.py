@@ -292,8 +292,9 @@ class TestSpecTrainerIntegration:
             eos_token_ids=[1], pad_token_id=0, **kw,
         )
         assert engine.scheduler == "refill" and engine.spec_draft == 4
-        # dense config maps to no paged knobs at all
-        assert engine_kwargs_from_config(TrainConfig()) == {}
+        # default (dense) config maps to no scheduler/spec/row knobs; kv_quant
+        # always rides along (the dense engine takes int8 KV too)
+        assert engine_kwargs_from_config(TrainConfig()) == {"kv_quant": "none"}
 
 
 class TestSchedulerFuzz:

@@ -33,7 +33,7 @@ def main() -> int:
             rec = json.loads(open(path).read().strip().splitlines()[-1])
         except (ValueError, IndexError):
             continue
-        if rec.get("backend") != "tpu":
+        if rec.get("backend") != "tpu" or rec.get("error"):
             continue
         name = os.path.basename(path)[len("bench_tpu_"):-len(".json")]
         shutil.copy(path, os.path.join(DEST, f"{name}.json"))

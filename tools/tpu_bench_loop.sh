@@ -74,7 +74,8 @@ bench() {
       > "$out" 2>"${out%.json}.err"
   local rc=$?
   echo "$(date -u +%H:%M:%S) $name rc=$rc: $(tail -c 300 "$out")"
-  if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out"; then touch "$marker"; fi
+  if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out" \
+      && ! grep -q '"error"' "$out"; then touch "$marker"; fi
 }
 
 # --- ordered by information value under window scarcity: each window may
@@ -103,6 +104,14 @@ bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
 bench dense_int8 /tmp/bench_tpu_dense_int8.json BENCH_KV_QUANT=int8
 # dense with BOTH decode-bandwidth levers on: the headline-challenger run
 bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+# scan-chunked decode: K steps per dispatch — the tunnel dispatch-overhead
+# lever (dense ran ~22 steps/s against a ~5 ms/step chip estimate; see
+# tools/dispatch_probe.py). scan_chunk_active=false in the record means the
+# memory guard rejected the chunked program and this measured the host loop.
+bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
+# all three decode levers stacked: the headline-challenger run
+bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
+  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
 bench waves_eos /tmp/bench_tpu_waves_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
@@ -140,7 +149,8 @@ run_stage train_curve 3000 bash -c \
 all_done() {
   local n
   for n in dense paged refill_eos learner kernel_check dense_mw dense_int8 \
-           dense_int8_mw waves_eos dense_eos spec budget int8kv \
+           dense_int8_mw dense_scan dense_scan_int8 waves_eos dense_eos \
+           spec budget int8kv \
            learner_flash dispatch_probe sampler_probe mem_envelope \
            qwen7b_int4 train_curve; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1

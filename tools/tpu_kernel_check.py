@@ -90,14 +90,22 @@ def main() -> int:
                     lambda q_, k_, v_: splash_attention(q_, k_, v_, validg)
                 )
             g_k = jax.grad(kern_fn, argnums=(0, 1, 2))(qg, kg, vg)
-            errs = [
-                float(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)).max())
-                for a, b_ in zip(g_k, g_ref)
-            ]
-            ok = max(errs) < 5e-2  # bf16 blockwise grads vs XLA
+            # dK/dV entries reach O(10..30) at S=512 (sum-loss cotangents),
+            # where one bf16 ulp is ~2^-4 — scale the error by the grad
+            # magnitude or bf16 reorder noise fails the check (first on-chip
+            # run: max_err 0.0625 on |g|~20, i.e. ~0.3% — fine; a sign flip
+            # or missing mask term still scores O(1) scaled)
+            errs = []
+            for a, b_ in zip(g_k, g_ref):
+                af = a.astype(jnp.float32)
+                bf = b_.astype(jnp.float32)
+                errs.append(
+                    float((jnp.abs(af - bf) / (1.0 + jnp.abs(bf))).max())
+                )
+            ok = max(errs) < 3e-2  # bf16 blockwise grads vs XLA, scaled
             failures += not ok
             print(f"{'PASS' if ok else 'FAIL'} {kind}_backward S={sg} "
-                  f"max_err={max(errs):.4f}")
+                  f"max_scaled_err={max(errs):.4f}")
         except Exception as e:  # noqa: BLE001 — record, count, continue
             failures += 1
             print(f"FAIL {kind}_backward ({e})")
