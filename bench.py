@@ -105,6 +105,17 @@ def _train_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * (2.0 * matmul_params + attn)
 
 
+def _paged_dispatch_choice():
+    """Which paged-attention impl the probe chain actually dispatched
+    ("native"/"fixed"/"jaxlib"/"reference"), or None if no paged dispatch
+    ran. Distinct per-config choices are joined with '+'."""
+    import importlib
+
+    paged_mod = importlib.import_module("distrl_llm_tpu.ops.paged")
+    choices = sorted(set(paged_mod.dispatch_choices.values()))
+    return "+".join(choices) if choices else None
+
+
 def _attn_fallback_fired(attn_impl: str) -> bool:
     """True when attention() fell back to the XLA reference path during the
     (traced) first step — a "flash" record with this flag set measured
@@ -474,6 +485,9 @@ def main() -> int:
         "top_p_impl": sampling.resolved_top_p_impl(),
         "scan_chunk": engine_kwargs.get("scan_chunk", 0),
         "scan_chunk_active": getattr(engine, "scan_chunk_active", None),
+        # which paged-attention impl the probe chain actually dispatched
+        # (None for dense runs / before any paged dispatch)
+        "paged_attn_impl": _paged_dispatch_choice(),
         "backend": jax.devices()[0].platform,
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,

@@ -291,6 +291,30 @@ def paged_attention_reference(
 
 _kernel_fail_warned = False
 _fixed_launch_state: dict = {}
+# per-config record of what the auto-dispatch chain actually chose
+# ("native" | "fixed" | "jaxlib" | "reference") — bench records surface
+# this so a reference-fallback run cannot masquerade as a kernel
+# measurement (same honesty contract as attn_fallback / scan_chunk_active)
+dispatch_choices: dict = {}
+
+
+def _native_call(q, k_pages, v_pages, lengths, page_indices,
+                 *, quantized: bool, pages_per_compute_block: int = 0,
+                 interpret: bool = False):
+    """Adapter: the probe/dispatch launch signature → our native kernel
+    (ops/paged_native.py), which takes int8 weights and compact scales as
+    separate arrays and has no compute-block knob (one page per grid step)."""
+    from distrl_llm_tpu.ops.paged_native import paged_attention_native
+
+    if quantized:
+        return paged_attention_native(
+            q, k_pages.weight, v_pages.weight, lengths, page_indices,
+            k_scales=k_pages.scales, v_scales=v_pages.scales,
+            interpret=interpret,
+        )
+    return paged_attention_native(
+        q, k_pages, v_pages, lengths, page_indices, interpret=interpret
+    )
 
 
 def _probe_launch(
@@ -331,7 +355,9 @@ def _probe_launch(
                 paged_attention_int8,
             )
 
-            if fn_name == "fixed":
+            if fn_name == "native":
+                fn = functools.partial(_native_call, quantized=quantized)
+            elif fn_name == "fixed":
                 fn = paged_attention_int8 if quantized else paged_attention_gqa
             else:
                 from jax.experimental.pallas.ops.tpu.paged_attention import (
@@ -385,11 +411,14 @@ def paged_attention_op(
 ) -> jax.Array:
     """Dispatch: Pallas TPU kernel when available, jnp reference otherwise.
 
-    ``impl``: "auto" (kernel on TPU backends, reference elsewhere),
-    "kernel", or "reference"."""
-    use_kernel = impl == "kernel" or (
+    ``impl``: "auto" (probe-gated kernel chain on TPU backends, reference
+    elsewhere), "kernel" (force the corrected jaxlib launch), "native"
+    (force our pipeline-gather kernel, ops/paged_native.py), or
+    "reference"."""
+    use_kernel = impl in ("kernel", "native") or (
         impl == "auto" and jax.default_backend() == "tpu"
     )
+    choice_key = None
     if use_kernel:
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
@@ -410,13 +439,16 @@ def paged_attention_op(
             num_kv_heads = kw.shape[0]
             num_groups = q.shape[1] // num_kv_heads
             head_dim, page_size = kw.shape[-1], kw.shape[-2]
-            # Route through our corrected launch (compact int8 scales +
-            # legal m/l block specs — see ops/paged_int8.py). auto mode
-            # probe-compiles once per config at the REAL kv-head count and
-            # walks the chain corrected → jaxlib wrapper → jnp reference;
-            # both kernels share the per-head HBM DMA slice Mosaic rejects
-            # for head_dim % 128 != 0, so e.g. Qwen2.5-0.5B (hd=64) decodes
-            # via the reference path on-chip until our own kernel lands.
+            # auto mode walks a probe-gated chain (probes run once per
+            # config at the REAL kv-head count and pages-per-sequence):
+            # - hd % 128 == 0: corrected jaxlib launch (proven, multi-page
+            #   DMA blocks) → our native kernel → jaxlib wrapper → jnp
+            #   reference;
+            # - hd % 128 != 0: our native kernel FIRST — both jaxlib
+            #   kernels' manual per-head HBM DMA slice is rejected by
+            #   Mosaic for unaligned head_dim (round-3 silicon finding;
+            #   ops/paged_native.py), which two rounds of interpreter
+            #   parity could not see.
             probe = functools.partial(
                 _probe_launch, quantized=quantized,
                 num_kv_heads=num_kv_heads, num_groups=num_groups,
@@ -424,25 +456,55 @@ def paged_attention_op(
                 q_dtype=scaled_q.dtype, kv_dtype=kw.dtype, blocks=blocks,
                 pps=pps,
             )
-            if impl == "kernel" or probe("fixed"):
-                from distrl_llm_tpu.ops.paged_int8 import (
-                    paged_attention_gqa,
-                    paged_attention_int8,
-                )
+            chain = (
+                ("native", "fixed", "jaxlib")
+                if head_dim % 128
+                else ("fixed", "native", "jaxlib")
+            )
+            if impl == "kernel":  # forced: corrected launch, no probe
+                chain = ("fixed",)
+            elif impl == "native":  # forced: our kernel, no probe
+                chain = ("native",)
+            choice_key = (quantized, num_kv_heads, num_groups, head_dim,
+                          page_size, blocks, pps)
+            dispatch_choices[choice_key] = "reference"
+            for fn_name in chain:
+                if len(chain) > 1 and not probe(fn_name):
+                    continue
+                dispatch_choices[choice_key] = fn_name
+                if fn_name == "native":
+                    return _native_call(
+                        scaled_q, k_pages, v_pages,
+                        lengths.astype(jnp.int32), page_indices,
+                        quantized=quantized,
+                    ).astype(q.dtype)
+                if fn_name == "fixed":
+                    from distrl_llm_tpu.ops.paged_int8 import (
+                        paged_attention_gqa,
+                        paged_attention_int8,
+                    )
 
-                fn = paged_attention_int8 if quantized else paged_attention_gqa
-                return fn(
-                    scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
-                    page_indices, pages_per_compute_block=blocks,
-                ).astype(q.dtype)
-            if probe("jaxlib"):
+                    fn = (
+                        paged_attention_int8
+                        if quantized
+                        else paged_attention_gqa
+                    )
+                    return fn(
+                        scaled_q, k_pages, v_pages,
+                        lengths.astype(jnp.int32), page_indices,
+                        pages_per_compute_block=blocks,
+                    ).astype(q.dtype)
                 return paged_attention(
                     scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
                     page_indices, pages_per_compute_block=blocks,
                 ).astype(q.dtype)
         except Exception as e:  # noqa: BLE001 — fall back with one warning
-            if impl == "kernel":
+            if impl in ("kernel", "native"):
                 raise
+            # the chain recorded its pick before launching; the launch
+            # failed, so what actually runs below is the reference
+            if choice_key is not None:
+                dispatch_choices[choice_key] = "reference"
             global _kernel_fail_warned
             if not _kernel_fail_warned:
                 _kernel_fail_warned = True
@@ -452,4 +514,8 @@ def paged_attention_op(
                     "paged_attention kernel unavailable (%s); using reference",
                     e,
                 )
+    if choice_key is None:
+        # non-kernel path (CPU/GPU backend or impl="reference") — still a
+        # paged dispatch, and the honesty field must say so
+        dispatch_choices[("no-kernel-path",)] = "reference"
     return paged_attention_reference(q, k_pages, v_pages, lengths, page_indices)

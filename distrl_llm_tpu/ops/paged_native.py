@@ -1,0 +1,207 @@
+"""Native paged decode attention — our own Pallas TPU kernel.
+
+Why this exists (round 3, first silicon): both jaxlib paged-attention
+kernels are unusable for head_dim % 128 != 0 models (e.g. Qwen2.5-0.5B,
+hd=64, 14q/2kv). Their manual-DMA design slices the KV page array per
+kv-head (``pages.at[head_index]`` — MultiPageAsyncCopyDescriptor,
+paged_attention_kernel.py:52), and Mosaic rejects any ``tpu.memref_slice``
+whose minor dimension is not lane-aligned: "Slice shape along dimension 3
+must be aligned to tiling (128), but is 64". The newer ragged kernel
+hard-asserts 128-lane accumulator shapes at trace time instead.
+
+This kernel takes the other road: **no manual DMA at all**. The grid is
+(batch, kv_head, page) and the page gather happens in the k/v BlockSpec
+``index_map``, which reads the scalar-prefetched page table —
+``(b, kv, j) -> (kv, table[b, j], 0, 0)``. The pipeline emitter then moves
+whole ``[1, page_size, head_dim]`` blocks, never slicing inside the minor
+dims — the exact pattern our flash/splash launches already proved on this
+Mosaic version at d=64 (tools/tpu_kernel_check.py, S=4096 PASS).
+
+Per (b, kv) series the kernel runs classic online softmax over the pages:
+m/l/acc VMEM scratch carried across the innermost grid dimension, page
+positions masked against the sequence length, output emitted at the last
+page. Compute is skipped (``pl.when``) for pages past the length; their
+DMAs still run — the admission/capacity win of paging is unchanged, and
+bounding the DMA walk per row is a follow-up (bucketed pps compiles).
+
+The int8 path consumes the engine's COMPACT per-token scales ([K, P, ps,
+1] f32, see ops/paged_int8.py) directly: dequantization is one broadcast
+multiply in VMEM, so int8 stays a bandwidth win (~1.03 bytes/element
+moved) rather than the 5 bytes/element of jaxlib's pre-broadcast wrapper.
+
+Parity: CI pins numerics against ``paged_attention_reference`` under the
+Pallas interpreter; tools/tpu_kernel_check.py revalidates the Mosaic
+lowering + numerics on silicon (SURVEY §2b N1/N10).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas.ops.tpu.paged_attention.quantization_utils import (
+    MAX_INT8,  # 127.5 — the to_int8/from_int8 contract the pages use
+)
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(
+    lengths_ref,  # SMEM [B] i32 (scalar prefetch)
+    tables_ref,  # SMEM [B, pps] i32 (scalar prefetch)
+    q_ref,  # VMEM [G, hd] — this (b, kv)'s query group
+    k_ref,  # VMEM [1, ps, hd] — page j of kv head kv (gathered by index_map)
+    v_ref,  # VMEM [1, ps, hd]
+    k_s_ref,  # VMEM [1, ps, 1] f32 compact scales, or None (unquantized)
+    v_s_ref,
+    o_ref,  # VMEM [G, hd]
+    m_scr,  # VMEM [G, 1] f32 running max
+    l_scr,  # VMEM [G, 1] f32 running denominator
+    acc_scr,  # VMEM [G, hd] f32 running numerator
+    *,
+    page_size: int,
+    pps: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _page():
+        q = q_ref[...].astype(jnp.float32)  # [G, hd] (pre-scaled)
+        k = k_ref[0].astype(jnp.float32)  # [ps, hd]
+        v = v_ref[0].astype(jnp.float32)
+        if k_s_ref is not None:
+            # compact per-token absmax scales; dequant = w * scale /
+            # MAX_INT8 (quantization_utils.from_int8 contract — 127.5,
+            # not 127: /127 would bias every K/V value by +0.39%)
+            k = k * (k_s_ref[0] * (1.0 / MAX_INT8))  # [ps, 1] broadcast
+            v = v * (v_s_ref[0] * (1.0 / MAX_INT8))
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_scr[...]  # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [G, ps]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_new
+
+    @pl.when(j == pps - 1)
+    def _emit():
+        # rows with length 0 (empty decode slots) never accumulate: emit 0
+        # instead of 0/0 — their logits are discarded by the done mask, but
+        # NaNs must not exist to propagate
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "interpret"),
+)
+def paged_attention_native(
+    q: jax.Array,  # [B, H, hd] — pre-scaled by hd**-0.5 (op contract)
+    k_pages: jax.Array,  # [K, P, ps, hd] bf16/f32, or int8 weight
+    v_pages: jax.Array,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pps]
+    k_scales: jax.Array | None = None,  # f32 [K, P, ps, 1] compact (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    page_size: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    batch, num_q_heads, head_dim = q.shape
+    num_kv_heads, total_pages, ps, head_dim_k = k_pages.shape
+    if page_size is None:
+        page_size = ps
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch: {head_dim_k} vs {head_dim}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"H={num_q_heads} not divisible by K={num_kv_heads}"
+        )
+    groups = num_q_heads // num_kv_heads
+    _, pps = page_indices.shape
+    quantized = k_scales is not None
+
+    # index_map gathers pages from the table for EVERY j, including slots
+    # past a row's allocation — clamp so garbage entries stay addressable
+    # (their compute is masked by the length check)
+    tables = jnp.clip(page_indices.astype(jnp.int32), 0, total_pages - 1)
+    q4 = q.reshape(batch, num_kv_heads, groups, head_dim)
+
+    # index_maps receive the grid indices plus EVERY scalar-prefetch ref
+    # (lengths, tables) appended — the page gather reads the table ref
+    q_spec = pl.BlockSpec(
+        (None, None, groups, head_dim),
+        lambda b, kv, j, lens, tabs: (b, kv, 0, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (None, 1, page_size, head_dim),
+        lambda b, kv, j, lens, tabs: (kv, tabs[b, j], 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (None, 1, page_size, 1),
+        lambda b, kv, j, lens, tabs: (kv, tabs[b, j], 0, 0),
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q4, k_pages, v_pages]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
+        body = functools.partial(_paged_kernel, page_size=page_size, pps=pps)
+    else:
+
+        def body(lens, tabs, qr, kr, vr, o, m, l, a):  # noqa: E741
+            _paged_kernel(
+                lens, tabs, qr, kr, vr, None, None, o, m, l, a,
+                page_size=page_size, pps=pps,
+            )
+
+    out = pl.pallas_call(
+        body,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # lengths, tables ride SMEM
+            grid=(batch, num_kv_heads, pps),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (None, None, groups, head_dim),
+                lambda b, kv, j, lens, tabs: (b, kv, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((groups, 1), jnp.float32),
+                pltpu.VMEM((groups, 1), jnp.float32),
+                pltpu.VMEM((groups, head_dim), jnp.float32),
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, num_kv_heads, groups, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables, *operands)
+    return out.reshape(batch, num_q_heads, head_dim)

@@ -1,0 +1,124 @@
+"""Native paged-decode kernel parity vs the jnp reference (interpreter mode).
+
+The kernel exists because both jaxlib paged kernels reject head_dim % 128
+!= 0 on real Mosaic (round-3 silicon finding — ops/paged_native.py). CI
+pins its numerics here at exactly the shapes that broke: GQA 14q/2kv,
+hd=64, ragged lengths, dead rows; tools/tpu_kernel_check.py revalidates
+the lowering on-chip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.ops.paged import (
+    make_page_table,
+    paged_attention_reference,
+    quantize_pages,
+)
+from distrl_llm_tpu.ops.paged_native import paged_attention_native
+
+
+def _setup(b, h, kh, hd, ps, pps, seed=0, lengths=None):
+    rng = np.random.default_rng(seed)
+    cap = pps * ps
+    kp = jnp.asarray(rng.standard_normal((kh, b * pps, ps, hd)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((kh, b * pps, ps, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, hd)), jnp.float32)
+    table = jnp.asarray(make_page_table(b, cap, ps))
+    if lengths is None:
+        lengths = rng.integers(1, cap + 1, size=(b,))
+    lengths = jnp.asarray(lengths, jnp.int32)
+    return q, kp, vp, lengths, table
+
+
+def _native(q, kp, vp, lengths, table, **kw):
+    hd = q.shape[-1]
+    return paged_attention_native(
+        q * hd**-0.5, kp, vp, lengths, table, interpret=True, **kw
+    )
+
+
+class TestNativePagedParity:
+    def test_qwen05b_geometry(self):
+        """14 q heads / 2 kv heads / hd=64 — the exact config both jaxlib
+        kernels reject on real Mosaic."""
+        q, kp, vp, lengths, table = _setup(b=4, h=14, kh=2, hd=64, ps=8, pps=3)
+        got = _native(q, kp, vp, lengths, table)
+        want = paged_attention_reference(q, kp, vp, lengths, table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_hd128_and_mha(self):
+        for h, kh, hd in ((8, 8, 128), (4, 1, 32)):
+            q, kp, vp, lengths, table = _setup(
+                b=3, h=h, kh=kh, hd=hd, ps=8, pps=2, seed=h
+            )
+            got = _native(q, kp, vp, lengths, table)
+            want = paged_attention_reference(q, kp, vp, lengths, table)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+            )
+
+    def test_dead_rows_emit_zeros_not_nan(self):
+        """length-0 rows (empty decode slots) must produce finite output —
+        a NaN would poison the logsumexp capture path even though the done
+        mask discards the sampled token."""
+        q, kp, vp, _, table = _setup(b=3, h=4, kh=2, hd=64, ps=8, pps=2)
+        lengths = jnp.asarray([10, 0, 16], jnp.int32)
+        got = np.asarray(_native(q, kp, vp, lengths, table))
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got[1], 0.0)
+        want = np.asarray(paged_attention_reference(q, kp, vp, lengths, table))
+        np.testing.assert_allclose(got[[0, 2]], want[[0, 2]], atol=2e-5, rtol=2e-5)
+
+    def test_single_page_sequences(self):
+        q, kp, vp, _, table = _setup(b=2, h=4, kh=2, hd=64, ps=8, pps=1)
+        lengths = jnp.asarray([3, 8], jnp.int32)
+        got = _native(q, kp, vp, lengths, table)
+        want = paged_attention_reference(q, kp, vp, lengths, table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_garbage_table_entries_beyond_length_ignored(self):
+        """Entries past a row's allocated pages may be stale ids — clamped
+        and masked, they must not affect the output."""
+        q, kp, vp, _, table = _setup(b=2, h=4, kh=2, hd=64, ps=8, pps=3)
+        lengths = jnp.asarray([5, 9], jnp.int32)  # rows use 1 and 2 pages
+        base = _native(q, kp, vp, lengths, table)
+        poisoned = np.asarray(table).copy()
+        poisoned[0, 1:] = 99999  # out of range — clamp must keep it legal
+        poisoned[1, 2:] = -7
+        got = _native(q, kp, vp, lengths, jnp.asarray(poisoned))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base), atol=0, rtol=0)
+
+    def test_int8_compact_scales(self):
+        q, kp, vp, lengths, table = _setup(b=4, h=14, kh=2, hd=64, ps=8, pps=3)
+        kq = quantize_pages(jnp.asarray(kp, jnp.bfloat16))
+        vq = quantize_pages(jnp.asarray(vp, jnp.bfloat16))
+        got = _native(
+            q.astype(jnp.bfloat16), kq.weight, vq.weight, lengths, table,
+            k_scales=kq.scales, v_scales=vq.scales,
+        )
+        want = paged_attention_reference(
+            q.astype(jnp.bfloat16), kq, vq, lengths, table
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+    def test_validation(self):
+        q, kp, vp, lengths, table = _setup(b=2, h=4, kh=2, hd=64, ps=8, pps=2)
+        with pytest.raises(ValueError, match="head_dim"):
+            paged_attention_native(
+                q[..., :32], kp, vp, lengths, table, interpret=True
+            )
+        with pytest.raises(ValueError, match="divisible"):
+            paged_attention_native(
+                q[:, :3], kp, vp, lengths, table, interpret=True
+            )
