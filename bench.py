@@ -351,9 +351,9 @@ def main() -> int:
         else GenerationEngine
     )
     engine_kwargs = {"kv_quant": os.environ.get("BENCH_KV_QUANT", "none")}
-    if os.environ.get("BENCH_SCAN_CHUNK") and os.environ.get("BENCH_ENGINE") != "paged":
-        # K decode steps fused per dispatch (dense engine) — the tunnel
-        # dispatch-overhead lever; see tools/dispatch_probe.py
+    if os.environ.get("BENCH_SCAN_CHUNK"):
+        # K decode steps fused per dispatch (dense engine / paged refill) —
+        # the tunnel dispatch-overhead lever; see tools/dispatch_probe.py
         engine_kwargs["scan_chunk"] = int(os.environ["BENCH_SCAN_CHUNK"])
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")

@@ -333,11 +333,27 @@ class TrainConfig:
             raise ValueError(
                 f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
             )
-        if self.decode_scan_chunk and self.engine_impl != "dense":
+        if self.decode_scan_chunk and self.engine_impl not in ("dense", "paged"):
             raise ValueError(
-                "decode_scan_chunk is a dense-engine knob (the paged "
-                "schedulers do host-side refill between steps); use "
-                "engine_impl='dense' or 0"
+                "decode_scan_chunk applies to the dense engine and the "
+                "paged refill scheduler; engine_impl="
+                f"{self.engine_impl!r} does not support it"
+            )
+        if (
+            self.decode_scan_chunk > 1
+            and self.engine_impl == "paged"
+            and not self.continuous_batching
+        ):
+            raise ValueError(
+                "decode_scan_chunk on the paged engine requires "
+                "continuous_batching (the refill scheduler hosts the "
+                "chunked step; the wave scheduler does not support it yet)"
+            )
+        if self.decode_scan_chunk > 1 and self.spec_draft:
+            raise ValueError(
+                "decode_scan_chunk does not cover the speculative "
+                "scheduler (its step carries host-visible draft state); "
+                "set one of decode_scan_chunk/spec_draft to 0"
             )
         if self.continuous_batching and (
             self.engine_impl != "paged" or not self.max_concurrent_sequences

@@ -270,6 +270,55 @@ def generate_in_waves(
     )
 
 
+def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
+                          *args, **kwargs):
+    """Lower + compile a K-steps-per-dispatch program and inspect its
+    ``memory_analysis`` BEFORE it ever runs: if the TPU compiler
+    double-buffered the scanned carry (temp bytes on the order of the KV
+    buffers it was supposed to alias — ``alias_bytes``), the chunked
+    program would OOM the very configs it is meant to speed up, so reject
+    it (return None) and let the caller fall back to one dispatch per
+    step. Compile failures also return None rather than kill the round.
+    Backends without memory analysis (CPU tests) accept the program."""
+    try:
+        compiled = fn_jit.lower(*args, **kwargs).compile()
+        temp = None
+        try:
+            ma = compiled.memory_analysis()
+            temp = getattr(ma, "temp_size_in_bytes", None)
+        except Exception:  # noqa: BLE001 — backend without memory analysis
+            pass
+        if temp is not None and temp > 0.5 * alias_bytes:
+            _logger.warning(
+                "%s: chunked program double-buffers its carry (temp %.2f "
+                "GiB vs aliased buffers %.2f GiB) — falling back to "
+                "host-dispatched steps",
+                what, temp / 2**30, alias_bytes / 2**30,
+            )
+            return None
+        return compiled
+    except Exception as e:  # pragma: no cover - backend-specific
+        _logger.warning(
+            "%s: chunked program compile failed (%s: %s) — falling back "
+            "to host-dispatched steps",
+            what, type(e).__name__, e,
+        )
+        return None
+
+
+def lora_signature(lora):
+    """Hashable (structure, leaf shapes/dtypes) key for an adapter pytree.
+    Compiled executables (unlike jits) raise on a structurally different
+    tree instead of retracing, so chunk-program caches must key on this."""
+    return (
+        jax.tree_util.tree_structure(lora),
+        tuple(
+            (tuple(x.shape), jnp.dtype(x.dtype).name)
+            for x in jax.tree_util.tree_leaves(lora)
+        ),
+    )
+
+
 def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
     """Host-dispatched decode loop shared by the dense and paged engines:
     call ``step_fn(state) -> state`` up to ``max_steps`` times with async
@@ -492,18 +541,10 @@ class GenerationEngine(LoraMailbox):
         lowering surprise on a new config) also fall back rather than kill
         the round."""
         bn = state.out.shape[0]
-        # the adapter rides the compiled call as a pytree argument: a
-        # Compiled executable (unlike a jit) raises on a structurally
-        # different tree instead of retracing, so lora=None rounds and
-        # adapter rounds need separate cache entries
-        lora_sig = (
-            jax.tree_util.tree_structure(lora),
-            tuple(
-                (tuple(x.shape), jnp.dtype(x.dtype).name)
-                for x in jax.tree_util.tree_leaves(lora)
-            ),
-        )
-        key = (bucket, max_steps, top_p_impl, bn, lora_sig)
+        # lora=None rounds and adapter rounds need separate cache entries
+        # (Compiled executables raise on structure changes, see
+        # lora_signature)
+        key = (bucket, max_steps, top_p_impl, bn, lora_signature(lora))
         with self._compile_mu:
             if key in self._chunk_compiled:
                 return self._chunk_compiled[key]
@@ -517,37 +558,14 @@ class GenerationEngine(LoraMailbox):
                 ),
                 donate_argnames=("state",),
             )
-            compiled = None
-            try:
-                compiled = fn.lower(
-                    params, lora, state, rng, eos_ids=self.eos_ids,
-                    temperature=temperature, top_p=top_p,
-                ).compile()
-                cache_bytes = sum(
-                    x.nbytes for x in jax.tree_util.tree_leaves(state.cache)
-                )
-                temp = None
-                try:
-                    ma = compiled.memory_analysis()
-                    temp = getattr(ma, "temp_size_in_bytes", None)
-                except Exception:  # backend without memory analysis (cpu)
-                    ma = None
-                if temp is not None and temp > 0.5 * cache_bytes:
-                    _logger.warning(
-                        "scan_chunk=%d: chunked decode program double-buffers "
-                        "the KV cache (temp %.2f GiB vs cache %.2f GiB) — "
-                        "falling back to host-dispatched steps for bucket %d",
-                        self.scan_chunk, temp / 2**30, cache_bytes / 2**30,
-                        bucket,
-                    )
-                    compiled = None
-            except Exception as e:  # pragma: no cover - backend-specific
-                _logger.warning(
-                    "scan_chunk=%d: chunked decode compile failed (%s: %s) — "
-                    "falling back to host-dispatched steps for bucket %d",
-                    self.scan_chunk, type(e).__name__, e, bucket,
-                )
-                compiled = None
+            cache_bytes = sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(state.cache)
+            )
+            compiled = compile_chunk_guarded(
+                fn, cache_bytes, f"scan_chunk={self.scan_chunk} bucket={bucket}",
+                params, lora, state, rng, eos_ids=self.eos_ids,
+                temperature=temperature, top_p=top_p,
+            )
             self._chunk_compiled[key] = compiled
             return compiled
 

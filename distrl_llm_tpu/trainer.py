@@ -63,6 +63,8 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     if config.engine_impl == "paged":
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
+            if config.decode_scan_chunk:
+                kwargs["scan_chunk"] = config.decode_scan_chunk
             if config.spec_draft:
                 kwargs["spec_draft"] = config.spec_draft
                 kwargs["spec_ngram"] = config.spec_ngram

@@ -509,3 +509,98 @@ class TestPagedEngineTP:
             sharded, None, ids, mask, cfg, jax.random.PRNGKey(0))
         np.testing.assert_array_equal(got.tokens, want.tokens)
         np.testing.assert_array_equal(got.lengths, want.lengths)
+
+
+class TestRefillScanChunk:
+    """K-steps-per-dispatch refill decode (``scan_chunk``): chunk size never
+    exceeds the host cadence ``check``, so with scan_chunk >= check the host
+    acts at exactly the same dispatched-step counts as the per-step loop and
+    outputs must be BIT-identical (including rng: the all-done skip branch
+    still advances the fold_in index). With a smaller chunk the host cadence
+    shifts, which greedy decoding cannot observe (schedule-invariance)."""
+
+    def test_greedy_parity_with_refills(self, setup4):
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        base = make_refill(slots=2).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        chunked = make_refill(slots=2, scan_chunk=16).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)
+
+    def test_sampled_parity_with_eos_and_logprobs(self, setup4):
+        """EOS mid-round frees slots for refills; sampled tokens, lengths
+        and captured behavior logprobs must match the per-step loop."""
+        params, ids, mask = setup4
+        probe = make_paged(max_new=3).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=3, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        eos = sorted({int(probe.tokens[0, 0, 1]), int(probe.tokens[2, 0, 2])})
+        cfg = SamplingConfig(max_tokens=8, temperature=1.3, top_p=0.9, n=2)
+        kw = dict(max_new=8, eos=eos, slots=3, capture_logprobs=True)
+        base = make_refill(**kw).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(5))
+        chunked = make_refill(scan_chunk=16, **kw).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)
+        np.testing.assert_array_equal(base.logprobs, chunked.logprobs)
+
+    def test_non_divisor_chunk_rounds_down_and_keeps_parity(self, setup4):
+        """scan_chunk=4 with check=6 (max_new=6) rounds down to the divisor
+        3 — a non-divisor K would stretch the host cadence past the
+        budgeted pool's grant horizon (review finding). With the divisor,
+        sampled output stays bit-identical to the per-step loop."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=1.1, top_p=0.9, n=2)
+        base = make_refill(slots=2).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(7))
+        res = make_refill(slots=2, scan_chunk=4).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(res.tokens, base.tokens)
+        np.testing.assert_array_equal(res.lengths, base.lengths)
+
+    def test_tight_budget_with_non_divisor_chunk(self, setup4):
+        """Budgeted pool + non-divisor scan_chunk: the divisor rounding is
+        what keeps grants ahead of the write frontier; outputs must match
+        the per-step loop exactly."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        eng = make_refill(slots=2)
+        pages = 1 + eng.private_pages + 2
+        base = make_refill(slots=2, max_kv_pages=pages).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        res = make_refill(
+            slots=2, max_kv_pages=pages, scan_chunk=4
+        ).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, base.tokens)
+        np.testing.assert_array_equal(res.lengths, base.lengths)
+
+    def test_budgeted_pool_preemption_parity(self, setup4):
+        """A pool tight enough to stall admissions (grow-as-you-go grants +
+        possible preemption) must not change greedy outputs under chunking."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        eng = make_refill(slots=2)
+        pages = 1 + eng.private_pages + 2  # one full region + a little slack
+        base = make_refill(slots=2, max_kv_pages=pages).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        chunked = make_refill(
+            slots=2, max_kv_pages=pages, scan_chunk=16
+        ).generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)
+
+    def test_waves_scheduler_rejects_scan_chunk(self):
+        with pytest.raises(ValueError, match="refill"):
+            PagedGenerationEngine(
+                TINY, max_prompt_tokens=P_LEN, max_new_tokens=4,
+                eos_token_ids=[1], pad_token_id=0, scan_chunk=8,
+            )
+
+    def test_spec_rejects_scan_chunk(self):
+        with pytest.raises(ValueError, match="speculative"):
+            make_refill(slots=2, scan_chunk=8, spec_draft=2)

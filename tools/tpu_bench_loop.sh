@@ -112,6 +112,10 @@ bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
 # all three decode levers stacked: the headline-challenger run
 bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
   BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+# refill scheduler with chunked dispatch (chunk = the host cadence)
+bench refill_scan /tmp/bench_tpu_refill_scan.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
 bench waves_eos /tmp/bench_tpu_waves_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
@@ -149,8 +153,8 @@ run_stage train_curve 3000 bash -c \
 all_done() {
   local n
   for n in dense paged refill_eos learner kernel_check dense_mw dense_int8 \
-           dense_int8_mw dense_scan dense_scan_int8 waves_eos dense_eos \
-           spec budget int8kv \
+           dense_int8_mw dense_scan dense_scan_int8 refill_scan waves_eos \
+           dense_eos spec budget int8kv \
            learner_flash dispatch_probe sampler_probe mem_envelope \
            qwen7b_int4 train_curve; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1

@@ -68,11 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="paged-engine KV cache quantization (int8 halves "
                         "cache memory + decode bandwidth)")
     p.add_argument("--decode_scan_chunk", type=int, default=0,
-                   help="dense engine: decode steps fused per dispatch via "
-                        "lax.scan — amortizes per-dispatch overhead on "
-                        "network-tunneled PJRT clients (tools/"
-                        "dispatch_probe.py measures it); auto-falls back if "
-                        "the compiler double-buffers the KV cache. 0 = off")
+                   help="decode steps fused per dispatch via lax.scan "
+                        "(dense engine, or paged with --continuous_batching)"
+                        " — amortizes per-dispatch overhead on network-"
+                        "tunneled PJRT clients (tools/dispatch_probe.py "
+                        "measures it); auto-falls back if the compiler "
+                        "double-buffers the KV cache. 0 = off")
     p.add_argument("--full_finetune", action="store_true",
                    help="bf16 full-rank fine-tuning (no LoRA): the whole "
                         "param tree trains; requires --base_quant none")
