@@ -335,19 +335,9 @@ class TrainConfig:
             )
         if self.decode_scan_chunk and self.engine_impl not in ("dense", "paged"):
             raise ValueError(
-                "decode_scan_chunk applies to the dense engine and the "
-                "paged refill scheduler; engine_impl="
+                "decode_scan_chunk applies to the dense and paged engines "
+                f"(wave and refill schedulers); engine_impl="
                 f"{self.engine_impl!r} does not support it"
-            )
-        if (
-            self.decode_scan_chunk > 1
-            and self.engine_impl == "paged"
-            and not self.continuous_batching
-        ):
-            raise ValueError(
-                "decode_scan_chunk on the paged engine requires "
-                "continuous_batching (the refill scheduler hosts the "
-                "chunked step; the wave scheduler does not support it yet)"
             )
         if self.decode_scan_chunk > 1 and self.spec_draft:
             raise ValueError(

@@ -279,7 +279,13 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
     program would OOM the very configs it is meant to speed up, so reject
     it (return None) and let the caller fall back to one dispatch per
     step. Compile failures also return None rather than kill the round.
-    Backends without memory analysis (CPU tests) accept the program."""
+    Backends without memory analysis (CPU tests) accept the program.
+
+    The rejection needs BOTH a relative and an absolute threshold: at tiny
+    test scales, legitimate scratch (attention workspaces, gathers) can
+    exceed half of a kilobyte-sized cache without any double-buffering —
+    the failure mode this guards against is a CACHE-sized temp, which at
+    any scale that matters is hundreds of MBs."""
     try:
         compiled = fn_jit.lower(*args, **kwargs).compile()
         temp = None
@@ -288,7 +294,7 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
             temp = getattr(ma, "temp_size_in_bytes", None)
         except Exception:  # noqa: BLE001 — backend without memory analysis
             pass
-        if temp is not None and temp > 0.5 * alias_bytes:
+        if temp is not None and temp > 0.5 * alias_bytes and temp > 256 * 2**20:
             _logger.warning(
                 "%s: chunked program double-buffers its carry (temp %.2f "
                 "GiB vs aliased buffers %.2f GiB) — falling back to "

@@ -61,10 +61,10 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     if config.engine_impl == "dense" and config.decode_scan_chunk:
         kwargs["scan_chunk"] = config.decode_scan_chunk
     if config.engine_impl == "paged":
+        if config.decode_scan_chunk:
+            kwargs["scan_chunk"] = config.decode_scan_chunk
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
-            if config.decode_scan_chunk:
-                kwargs["scan_chunk"] = config.decode_scan_chunk
             if config.spec_draft:
                 kwargs["spec_draft"] = config.spec_draft
                 kwargs["spec_ngram"] = config.spec_ngram

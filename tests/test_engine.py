@@ -355,6 +355,7 @@ class TestScanChunk:
         sc = SamplingConfig(max_tokens=6, temperature=1.1, top_p=0.9, n=2)
         a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
         b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
+        assert chunked.scan_chunk_active  # chunked program ran, not a fallback
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
         np.testing.assert_array_equal(a.logprobs, b.logprobs)

@@ -524,8 +524,10 @@ class TestRefillScanChunk:
         cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
         base = make_refill(slots=2).generate(
             params, None, ids, mask, cfg, jax.random.PRNGKey(0))
-        chunked = make_refill(slots=2, scan_chunk=16).generate(
+        eng = make_refill(slots=2, scan_chunk=16)
+        chunked = eng.generate(
             params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        assert eng.scan_chunk_active  # chunked program ran, not a fallback
         np.testing.assert_array_equal(base.tokens, chunked.tokens)
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
@@ -594,13 +596,43 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.tokens, chunked.tokens)
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
-    def test_waves_scheduler_rejects_scan_chunk(self):
-        with pytest.raises(ValueError, match="refill"):
-            PagedGenerationEngine(
-                TINY, max_prompt_tokens=P_LEN, max_new_tokens=4,
-                eos_token_ids=[1], pad_token_id=0, scan_chunk=8,
-            )
-
     def test_spec_rejects_scan_chunk(self):
         with pytest.raises(ValueError, match="speculative"):
             make_refill(slots=2, scan_chunk=8, spec_draft=2)
+
+
+class TestWaveScanChunk:
+    """Wave-scheduler chunked dispatch: exact mirror of the dense engine's
+    scan_chunk (guarded overshoot, bit-parity with the per-step loop)."""
+
+    def test_sampled_parity_with_overshoot_and_logprobs(self, setup4):
+        """chunk=5 over max_new=7: the second chunk overshoots by 3 guarded
+        steps; sampled tokens/lengths/logprobs must be bit-identical."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=7, temperature=1.2, top_p=0.9, n=2)
+        kw = dict(max_new=7, capture_logprobs=True)
+        base = make_paged(**kw).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(9))
+        eng = make_paged(scan_chunk=5, **kw)
+        chunked = eng.generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(9))
+        assert eng.scan_chunk_active  # chunked program ran, not a fallback
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)
+        np.testing.assert_array_equal(base.logprobs, chunked.logprobs)
+
+    def test_greedy_eos_parity(self, setup4):
+        params, ids, mask = setup4
+        probe = make_paged(max_new=3).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=3, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        eos = [int(probe.tokens[0, 0, 1])]
+        cfg = SamplingConfig(max_tokens=8, temperature=0.0, n=1)
+        base = make_paged(max_new=8, eos=eos).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        chunked = make_paged(max_new=8, eos=eos, scan_chunk=3).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)

@@ -142,7 +142,8 @@ run_stage mem_envelope 1200 bash -c \
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
   BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
   BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
-  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16
+  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16 \
+  BENCH_SCAN_CHUNK=16
 # longest stage last: the on-chip reward curve checkpoints+resumes, so
 # every window it reaches adds steps even if it never finishes in one
 run_stage train_curve 3000 bash -c \

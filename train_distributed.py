@@ -69,8 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "cache memory + decode bandwidth)")
     p.add_argument("--decode_scan_chunk", type=int, default=0,
                    help="decode steps fused per dispatch via lax.scan "
-                        "(dense engine, or paged with --continuous_batching)"
-                        " — amortizes per-dispatch overhead on network-"
+                        "(dense and paged engines; not speculative) — "
+                        "amortizes per-dispatch overhead on network-"
                         "tunneled PJRT clients (tools/dispatch_probe.py "
                         "measures it); auto-falls back if the compiler "
                         "double-buffers the KV cache. 0 = off")
