@@ -333,12 +333,8 @@ class TrainConfig:
             raise ValueError(
                 f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
             )
-        if self.decode_scan_chunk and self.engine_impl not in ("dense", "paged"):
-            raise ValueError(
-                "decode_scan_chunk applies to the dense and paged engines "
-                f"(wave and refill schedulers); engine_impl="
-                f"{self.engine_impl!r} does not support it"
-            )
+        # decode_scan_chunk covers every engine_impl (dense, paged wave +
+        # refill, paged_sharded); only the speculative scheduler is out
         if self.decode_scan_chunk > 1 and self.spec_draft:
             raise ValueError(
                 "decode_scan_chunk does not cover the speculative "

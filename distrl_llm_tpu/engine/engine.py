@@ -312,6 +312,28 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
         return None
 
 
+def cached_chunk_program(cache: dict, mu, key, fn_jit, alias_bytes: int,
+                         what: str, *args, **kwargs):
+    """Mutex-guarded memoization of ``compile_chunk_guarded`` — one shared
+    implementation so every engine's chunk-program cache carries the same
+    locking (concurrent generate() calls share an engine in the trainer's
+    hybrid split) and the same None-means-fell-back convention."""
+    with mu:
+        if key not in cache:
+            cache[key] = compile_chunk_guarded(
+                fn_jit, alias_bytes, what, *args, **kwargs
+            )
+        return cache[key]
+
+
+def pool_nbytes(*trees) -> int:
+    """Total bytes of the KV buffers a chunked program must alias in place
+    (the denominator of compile_chunk_guarded's double-buffer check)."""
+    return sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(trees)
+    )
+
+
 def lora_signature(lora):
     """Hashable (structure, leaf shapes/dtypes) key for an adapter pytree.
     Compiled executables (unlike jits) raise on a structurally different

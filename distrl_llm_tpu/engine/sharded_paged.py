@@ -44,12 +44,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrl_llm_tpu.config import SamplingConfig
+import threading
+
 from distrl_llm_tpu.engine.engine import (
     GenerationResult,
     LoraMailbox,
+    cached_chunk_program,
+    lora_signature,
+    pool_nbytes,
     run_decode_loop,
 )
 from distrl_llm_tpu.engine.paged_engine import (
+    _paged_decode_chunk,
     _paged_decode_step,
     _paged_fanout,
     _paged_prefill,
@@ -103,8 +109,11 @@ class ShardedPagedEngine(LoraMailbox):
         decode_chunk: int = 128,
         kv_quant: str = "none",
         prompt_buckets: Sequence[int] | None = None,  # interface parity
+        scan_chunk: int = 0,  # >1: K decode steps per dispatch via lax.scan
         capture_logprobs: bool = False,
     ):
+        if scan_chunk < 0:
+            raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
         if "dp" not in mesh.shape:
             raise ValueError(f"mesh needs a 'dp' axis, got {dict(mesh.shape)}")
         other = {k: v for k, v in mesh.shape.items() if k != "dp" and v > 1}
@@ -139,9 +148,21 @@ class ShardedPagedEngine(LoraMailbox):
             lora_scale=lora_scale, paged_impl=paged_impl,
             capture_logprobs=capture_logprobs,
         )
+        self.scan_chunk = scan_chunk
         self._built: dict[tuple, tuple] = {}
+        self._chunk_compiled: dict = {}
+        self._chunk_mu = threading.Lock()
         # in-flight weight-update mailbox (LoraMailbox base)
         self.last_swap_steps: list[int] = []
+
+    @property
+    def scan_chunk_active(self) -> bool | None:
+        """Honesty flag: whether chunked decode actually ran (None before
+        the first round / scan_chunk off; False if every attempt fell back
+        to per-step dispatch)."""
+        if self.scan_chunk <= 1 or not self._chunk_compiled:
+            return None
+        return any(v is not None for v in self._chunk_compiled.values())
 
     def bucket_for(self, prompt_mask) -> int:
         return self.max_prompt_tokens
@@ -219,7 +240,34 @@ class ShardedPagedEngine(LoraMailbox):
             ),
             donate_argnums=(2,),
         )
-        self._built[key] = (setup, step)
+
+        chunk_jit = None
+        k = min(self.scan_chunk, max_steps)
+        if k > 1:
+            # K steps per dispatch inside the SAME shard_map program: the
+            # cond guard (shard-LOCAL done.all()) is plain per-device
+            # control flow — legal in manual SPMD because the dp-only
+            # forward contains no collectives for the branches to diverge
+            # over. Each shard drains its own rows independently.
+            def local_chunk(params, lora, state, rng, table,
+                            temperature, top_p):
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+                return _paged_decode_chunk(
+                    params, lora, state, rng, table, chunk=k,
+                    max_steps=max_steps, eos_ids=self.eos_ids,
+                    temperature=temperature, top_p=top_p,
+                    top_p_impl=top_p_impl, **self._step_kw,
+                )
+
+            chunk_jit = jax.jit(
+                shard_map(
+                    local_chunk, mesh=mesh,
+                    in_specs=(P(), P(), sspec, P(), P("dp", None), P(), P()),
+                    out_specs=sspec,
+                ),
+                donate_argnums=(2,),
+            )
+        self._built[key] = (setup, step, chunk_jit, k)
         return self._built[key]
 
     # --------------------------------------------------------------- generate
@@ -254,7 +302,9 @@ class ShardedPagedEngine(LoraMailbox):
             )
         b_pad = b + pad_rows
         top_p_impl = sampling.resolved_top_p_impl()
-        setup, step = self._build(n, b_pad // self.dp, max_steps, top_p_impl)
+        setup, step, chunk_jit, k = self._build(
+            n, b_pad // self.dp, max_steps, top_p_impl
+        )
 
         state, table = setup(
             params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
@@ -265,12 +315,37 @@ class ShardedPagedEngine(LoraMailbox):
         lora_cell = [lora]
         steps_seen = [0]
 
-        def step_fn(s):
-            self._take_pending_lora(lora_cell, steps_seen[0])
-            steps_seen[0] += 1
-            return step(params, lora_cell[0], s, rng, table, temperature, top_p)
+        chunk_fn = None
+        if chunk_jit is not None:
+            chunk_fn = cached_chunk_program(
+                self._chunk_compiled, self._chunk_mu,
+                (n, b_pad, max_steps, top_p_impl, lora_signature(lora)),
+                chunk_jit, pool_nbytes(state.k_pages, state.v_pages),
+                f"sharded-wave scan_chunk={k}",
+                params, lora, state, rng, table, temperature, top_p,
+            )
 
-        state = run_decode_loop(step_fn, state, max_steps, self.decode_chunk)
+        if chunk_fn is not None:
+
+            def step_fn(s):
+                # in-flight swaps land at chunk boundaries
+                self._take_pending_lora(lora_cell, steps_seen[0])
+                steps_seen[0] += k
+                return chunk_fn(
+                    params, lora_cell[0], s, rng, table, temperature, top_p
+                )
+
+            state = run_decode_loop(step_fn, state, -(-max_steps // k), 1)
+        else:
+
+            def step_fn(s):
+                self._take_pending_lora(lora_cell, steps_seen[0])
+                steps_seen[0] += 1
+                return step(
+                    params, lora_cell[0], s, rng, table, temperature, top_p
+                )
+
+            state = run_decode_loop(step_fn, state, max_steps, self.decode_chunk)
         out = np.asarray(state.out).reshape(b_pad, n, max_steps)[:b]
         lengths = np.asarray(state.gen_lengths).reshape(b_pad, n)[:b]
         logps = (

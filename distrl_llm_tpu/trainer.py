@@ -58,11 +58,11 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     KV quant, continuous batching, speculative decoding, row cap). Module
     level so the config→engine wiring is unit-testable without a checkpoint."""
     kwargs: dict[str, Any] = {"kv_quant": config.kv_cache_quant}
-    if config.engine_impl == "dense" and config.decode_scan_chunk:
+    if config.decode_scan_chunk:
+        # every engine_impl hosts the chunked step (dense, paged wave +
+        # refill, paged_sharded); config validation excludes spec_draft
         kwargs["scan_chunk"] = config.decode_scan_chunk
     if config.engine_impl == "paged":
-        if config.decode_scan_chunk:
-            kwargs["scan_chunk"] = config.decode_scan_chunk
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
             if config.spec_draft:
