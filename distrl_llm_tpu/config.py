@@ -333,14 +333,8 @@ class TrainConfig:
             raise ValueError(
                 f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
             )
-        # decode_scan_chunk covers every engine_impl (dense, paged wave +
-        # refill, paged_sharded); only the speculative scheduler is out
-        if self.decode_scan_chunk > 1 and self.spec_draft:
-            raise ValueError(
-                "decode_scan_chunk does not cover the speculative "
-                "scheduler (its step carries host-visible draft state); "
-                "set one of decode_scan_chunk/spec_draft to 0"
-            )
+        # decode_scan_chunk covers every engine_impl and scheduler (dense,
+        # paged wave + refill + speculative, paged_sharded)
         if self.continuous_batching and (
             self.engine_impl != "paged" or not self.max_concurrent_sequences
         ):

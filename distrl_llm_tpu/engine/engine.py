@@ -201,12 +201,11 @@ def _decode_chunk(params, lora, state: _DecodeState, rng,
             top_p_impl=top_p_impl, capture_logprobs=capture_logprobs,
         )
 
-    def body(s, _):
-        halt = jnp.logical_or(s.done.all(), s.step >= max_steps)
-        return jax.lax.cond(halt, lambda s: s, run, s), None
-
-    state, _ = jax.lax.scan(body, state, None, length=chunk)
-    return state
+    return scan_steps_guarded(
+        run, state, chunk,
+        halt_fn=lambda s: jnp.logical_or(s.done.all(), s.step >= max_steps),
+        skip_fn=lambda s: s,
+    )
 
 
 def generate_in_waves(
@@ -268,6 +267,23 @@ def generate_in_waves(
         steps_dispatched=steps if have_steps else None,
         logprobs=np.concatenate(logps, axis=0) if have_logps else None,
     )
+
+
+def scan_steps_guarded(run, state, chunk: int, *, halt_fn, skip_fn):
+    """The one copy of the chunked-dispatch scaffolding every engine's
+    chunk body shares: ``chunk`` iterations of ``lax.scan`` whose body
+    runs ``run(s)`` unless ``halt_fn(s)`` — then ``skip_fn(s)`` instead.
+
+    The skip branch carries a subtle invariant per scheduler: wave-style
+    loops (dense engine, paged waves) halt for good once every row is
+    done, so identity is correct; refill-style loops (refill, spec) keep
+    sampling after refills, so their skip MUST still advance the rng step
+    index (``s._replace(step=s.step + 1)``) to match what the
+    host-dispatched loop would have done."""
+    def body(s, _):
+        return jax.lax.cond(halt_fn(s), skip_fn, run, s), None
+
+    return jax.lax.scan(body, state, None, length=chunk)[0]
 
 
 def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,

@@ -596,9 +596,48 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.tokens, chunked.tokens)
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
-    def test_spec_rejects_scan_chunk(self):
-        with pytest.raises(ValueError, match="speculative"):
-            make_refill(slots=2, scan_chunk=8, spec_draft=2)
+    def test_spec_budget_chunk_parity(self, setup4):
+        """Tight pool + speculative + chunking: the (d+1)-scaled grant
+        horizon must stay ahead of the fused steps' write frontier; greedy
+        outputs must match the per-step loop exactly."""
+        params, ids, mask = setup4
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        eng = make_refill(slots=2, spec_draft=2)
+        pages = 1 + eng.private_pages + 2
+        kw = dict(slots=2, spec_draft=2, max_kv_pages=pages)
+        base = make_refill(**kw).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        eng = make_refill(scan_chunk=16, **kw)
+        res = eng.generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        assert eng.scan_chunk_active  # chunked program ran, not a fallback
+        np.testing.assert_array_equal(res.tokens, base.tokens)
+        np.testing.assert_array_equal(res.lengths, base.lengths)
+
+    def test_spec_scan_chunk_parity(self, setup4):
+        """Speculative scheduler + chunked dispatch: the spec step is fully
+        functional (draft/verify/accept all device-side), so K fused steps
+        must be bit-identical to the per-step loop — here under sampling
+        with EOS mid-round and logprob capture."""
+        params, ids, mask = setup4
+        probe = make_paged(max_new=3).generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=3, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        eos = sorted({int(probe.tokens[0, 0, 1]), int(probe.tokens[2, 0, 2])})
+        cfg = SamplingConfig(max_tokens=8, temperature=1.2, top_p=0.9, n=2)
+        kw = dict(max_new=8, eos=eos, slots=3, spec_draft=2,
+                  capture_logprobs=True)
+        base = make_refill(**kw).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(5))
+        eng = make_refill(scan_chunk=16, **kw)
+        chunked = eng.generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(5))
+        assert eng.scan_chunk_active  # chunked program ran, not a fallback
+        np.testing.assert_array_equal(base.tokens, chunked.tokens)
+        np.testing.assert_array_equal(base.lengths, chunked.lengths)
+        np.testing.assert_array_equal(base.logprobs, chunked.logprobs)
 
 
 class TestWaveScanChunk:

@@ -121,6 +121,10 @@ bench waves_eos /tmp/bench_tpu_waves_eos.json \
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
 bench spec    /tmp/bench_tpu_spec.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
+# speculative + chunked dispatch: amortization compounds with acceptance
+bench spec_scan /tmp/bench_tpu_spec_scan.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SCAN_CHUNK=16
 bench budget  /tmp/bench_tpu_budget.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
 bench int8kv  /tmp/bench_tpu_int8kv.json \
@@ -155,7 +159,7 @@ all_done() {
   local n
   for n in dense paged refill_eos learner kernel_check dense_mw dense_int8 \
            dense_int8_mw dense_scan dense_scan_int8 refill_scan waves_eos \
-           dense_eos spec budget int8kv \
+           dense_eos spec spec_scan budget int8kv \
            learner_flash dispatch_probe sampler_probe mem_envelope \
            qwen7b_int4 train_curve; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
