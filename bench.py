@@ -248,6 +248,16 @@ def main() -> int:
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
     fallback_err = os.environ.get("BENCH_FALLBACK_ERROR")  # set by the re-exec
 
+    # Persistent XLA compilation cache: the driver's bench run must fit in a
+    # tunnel window, and round 3 burned 246 s of a ~9-minute window on
+    # compiles — share the cache with the watcher so they are paid once.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    try:
+        os.makedirs(os.environ["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
+    except OSError:
+        pass
+
     if fallback_err is not None or os.environ.get("JAX_PLATFORMS", "").strip():
         # The sitecustomize-registered TPU plugin ignores the JAX_PLATFORMS
         # env var; forcing a platform needs jax.config.update before the
@@ -268,6 +278,29 @@ def main() -> int:
                 "backend": "none",
             })
             return 0
+        # Bounded wait for a tunnel window before giving up on TPU: the
+        # axon tunnel serves compute intermittently, and the round-3 driver
+        # bench landed exactly in a dead stretch (BENCH_r03 = CPU fallback).
+        # A fresh interpreter is required per attempt — the failed plugin
+        # may have poisoned backend state in this one — so the retry
+        # re-execs with a wall-clock deadline in the env.
+        wait_s = float(os.environ.get("BENCH_TPU_WAIT_S", "600"))
+        deadline_env = os.environ.get("BENCH_TPU_DEADLINE")
+        deadline = float(deadline_env) if deadline_env else time.time() + wait_s
+        if time.time() + 30 < deadline:
+            print(
+                f"bench: TPU probe failed ({err}); retrying until "
+                f"{deadline - time.time():.0f}s from now",
+                file=sys.stderr,
+            )
+            time.sleep(30)
+            env = dict(os.environ)
+            env["BENCH_TPU_DEADLINE"] = str(deadline)
+            os.execve(
+                sys.executable,
+                [sys.executable, os.path.abspath(__file__)],
+                env,
+            )
         # Re-exec on the CPU backend: a fresh interpreter is required because
         # the failed plugin may have poisoned backend state in this one.
         env = dict(os.environ)
@@ -283,6 +316,25 @@ def main() -> int:
 
     import jax
     import jax.numpy as jnp
+
+    # Driver-default production config: the plain `python bench.py` the
+    # driver runs should measure this framework's best honest TPU config
+    # (int8 fused-dequant KV + multiway top-p + chunked dispatch — every
+    # knob is recorded in the JSON line). Watcher/A-B invocations set
+    # BENCH_NO_FALLBACK=1 and configure knobs explicitly, so the defaults
+    # stay out of their way; BENCH_PRODUCTION_DEFAULTS=0/1 overrides.
+    prod_defaults = os.environ.get(
+        "BENCH_PRODUCTION_DEFAULTS",
+        "0" if os.environ.get("BENCH_NO_FALLBACK") == "1" else "1",
+    ) == "1"
+    if (
+        prod_defaults
+        and devices[0].platform == "tpu"
+        and os.environ.get("BENCH_MODE") != "learner"
+    ):
+        os.environ.setdefault("BENCH_SCAN_CHUNK", "16")
+        os.environ.setdefault("BENCH_KV_QUANT", "int8")
+        os.environ.setdefault("BENCH_TOP_P_IMPL", "bisect_mw")
 
     from distrl_llm_tpu.config import SamplingConfig
     from distrl_llm_tpu.engine import GenerationEngine, PagedGenerationEngine
@@ -415,6 +467,13 @@ def main() -> int:
         dt = time.perf_counter() - t0
         return out, dt
 
+    # clear stale dispatch records (e.g. a pre-run trace on another backend
+    # or the "no-kernel-path" sentinel from an unrelated config): dispatch
+    # decisions are made at trace time, i.e. during the warmup below, so
+    # clearing here scopes paged_attn_impl to THIS run's geometry (ADVICE r3)
+    import importlib
+
+    importlib.import_module("distrl_llm_tpu.ops.paged").dispatch_choices.clear()
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
     result, dt = run(1)
     # random weights rarely emit EOS, so rows typically decode max_new tokens;
@@ -482,6 +541,7 @@ def main() -> int:
         "mfu": round(mfu, 6),
         "model": name,
         "base_quant": base_quant,
+        "kv_quant": engine_kwargs["kv_quant"],
         "top_p_impl": sampling.resolved_top_p_impl(),
         "scan_chunk": engine_kwargs.get("scan_chunk", 0),
         "scan_chunk_active": getattr(engine, "scan_chunk_active", None),

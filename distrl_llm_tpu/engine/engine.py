@@ -363,6 +363,50 @@ def lora_signature(lora):
     )
 
 
+def make_swap_aware_chunk_step(mailbox, lora_cell: list, steps_seen: list,
+                               k: int, max_steps: int, chunk_fn, lora0,
+                               rebuild, run_chunk, run_step):
+    """Chunk-dispatch step closure shared by the dense, paged-wave, and
+    sharded engines: consumes in-flight adapter swaps at chunk boundaries,
+    and refetches the chunk program from its signature-keyed cache when a
+    swap changes the adapter's STRUCTURE (e.g. a None-adapter round
+    receiving its first adapter) — compiled executables raise on a
+    structurally different pytree instead of retracing (ADVICE r3).
+
+    When the new signature's program fell back (memory guard / compile
+    failure), the round finishes per-step at the same k-step cadence,
+    capped at ``max_steps`` total: the per-step functions are UNGUARDED
+    (they clamp-write onto the last output column and keep advancing
+    lengths past the buffer), and only the chunk program's internal scan
+    carries the ``done | step >= max_steps`` guard.
+
+    ``rebuild(lora, state) -> program|None``;
+    ``run_chunk(program, lora, state) -> state``;
+    ``run_step(lora, state) -> state``.
+    """
+    cell = [chunk_fn, lora_signature(lora0)]
+
+    def step(s):
+        # in-flight swaps land at chunk boundaries: the recorded swap step
+        # is the first position decoded under the new adapter
+        prev = lora_cell[0]
+        mailbox._take_pending_lora(lora_cell, steps_seen[0])
+        if lora_cell[0] is not prev:
+            sig = lora_signature(lora_cell[0])
+            if sig != cell[1]:
+                cell[0] = rebuild(lora_cell[0], s)
+                cell[1] = sig
+        start = steps_seen[0]
+        steps_seen[0] += k
+        if cell[0] is None:
+            for _ in range(min(k, max_steps - start)):
+                s = run_step(lora_cell[0], s)
+            return s
+        return run_chunk(cell[0], lora_cell[0], s)
+
+    return step
+
+
 def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
     """Host-dispatched decode loop shared by the dense and paged engines:
     call ``step_fn(state) -> state`` up to ``max_steps`` times with async
@@ -669,22 +713,29 @@ class GenerationEngine(LoraMailbox):
                 bucket, max_steps, params, lora, state, rng,
                 temperature, top_p, top_p_impl,
             )
-            if self.scan_chunk > 0 and max_steps > 1
+            # > 1, matching the paged engines: a scan-of-one program has no
+            # fusion benefit but would still report scan_chunk_active=True
+            if self.scan_chunk > 1 and max_steps > 1
             else None
         )
         if chunk_fn is not None:
             k = min(self.scan_chunk, max_steps)
-
-            def step(s):
-                # in-flight swaps land at chunk boundaries: the recorded swap
-                # step is the first position decoded under the new adapter
-                self._take_pending_lora(lora_cell, steps_seen[0])
-                steps_seen[0] += k
-                return chunk_fn(
-                    params, lora_cell[0], s, rng, eos_ids=self.eos_ids,
+            step = make_swap_aware_chunk_step(
+                self, lora_cell, steps_seen, k, max_steps, chunk_fn, lora,
+                rebuild=lambda l, s: self._chunk_fn_for_bucket(
+                    bucket, max_steps, params, l, s, rng,
+                    temperature, top_p, top_p_impl,
+                ),
+                run_chunk=lambda fn, l, s: fn(
+                    params, l, s, rng, eos_ids=self.eos_ids,
                     temperature=temperature, top_p=top_p,
-                )
-
+                ),
+                run_step=lambda l, s: decode_step_fn(
+                    params, l, s, rng, eos_ids=self.eos_ids,
+                    temperature=temperature, top_p=top_p,
+                    top_p_impl=top_p_impl,
+                ),
+            )
             # one "step" per chunk; snapshot done flags every chunk (check=1)
             state = run_decode_loop(step, state, -(-max_steps // k), 1)
         else:

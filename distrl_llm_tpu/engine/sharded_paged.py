@@ -51,6 +51,7 @@ from distrl_llm_tpu.engine.engine import (
     LoraMailbox,
     cached_chunk_program,
     lora_signature,
+    make_swap_aware_chunk_step,
     pool_nbytes,
     run_decode_loop,
 )
@@ -326,15 +327,23 @@ class ShardedPagedEngine(LoraMailbox):
             )
 
         if chunk_fn is not None:
-
-            def step_fn(s):
-                # in-flight swaps land at chunk boundaries
-                self._take_pending_lora(lora_cell, steps_seen[0])
-                steps_seen[0] += k
-                return chunk_fn(
-                    params, lora_cell[0], s, rng, table, temperature, top_p
-                )
-
+            step_fn = make_swap_aware_chunk_step(
+                self, lora_cell, steps_seen, k, max_steps, chunk_fn, lora,
+                rebuild=lambda l, s: cached_chunk_program(
+                    self._chunk_compiled, self._chunk_mu,
+                    (n, b_pad, max_steps, top_p_impl, lora_signature(l)),
+                    chunk_jit,
+                    pool_nbytes(s.k_pages, s.v_pages),
+                    f"sharded-wave scan_chunk={k}",
+                    params, l, s, rng, table, temperature, top_p,
+                ),
+                run_chunk=lambda fn, l, s: fn(
+                    params, l, s, rng, table, temperature, top_p
+                ),
+                run_step=lambda l, s: step(
+                    params, l, s, rng, table, temperature, top_p
+                ),
+            )
             state = run_decode_loop(step_fn, state, -(-max_steps // k), 1)
         else:
 

@@ -296,6 +296,13 @@ _fixed_launch_state: dict = {}
 # this so a reference-fallback run cannot masquerade as a kernel
 # measurement (same honesty contract as attn_fallback / scan_chunk_active)
 dispatch_choices: dict = {}
+# probe keys whose latest failure was transient (RESOURCE_EXHAUSTED etc.):
+# transient failures are never negative-cached, but the dispatch decision is
+# made at TRACE time and baked into the compiled program — a transient probe
+# error during the first trace silently downgrades that shape until retrace.
+# The chain marks affected dispatch_choices with "!transient-probe" so bench
+# records can flag the downgrade instead of presenting it as a settled pick.
+transient_probe_keys: set = set()
 
 
 def _native_call(q, k_pages, v_pages, lengths, page_indices,
@@ -379,12 +386,16 @@ def _probe_launch(
             )
             jax.block_until_ready(out)
             _fixed_launch_state[key] = True
+            transient_probe_keys.discard(key)
         except Exception as e:  # noqa: BLE001 — classify before caching
             from distrl_llm_tpu.ops.attention import _TRANSIENT_ERR_MARKS
 
             transient = any(m in str(e).upper() for m in _TRANSIENT_ERR_MARKS)
-            if not transient:
+            if transient:
+                transient_probe_keys.add(key)
+            else:
                 _fixed_launch_state[key] = False
+                transient_probe_keys.discard(key)
             import logging
 
             logging.getLogger(__name__).warning(
@@ -393,7 +404,10 @@ def _probe_launch(
                 fn_name,
                 key,
                 e,
-                " (transient error — will re-probe)" if transient else "",
+                " (transient error — not cached, but a trace consuming this"
+                " result bakes the downgrade into its compiled program until"
+                " retrace; dispatch_choices marks it '!transient-probe')"
+                if transient else "",
             )
             return False
     return _fixed_launch_state[key]
@@ -467,11 +481,29 @@ def paged_attention_op(
                 chain = ("native",)
             choice_key = (quantized, num_kv_heads, num_groups, head_dim,
                           page_size, blocks, pps)
-            dispatch_choices[choice_key] = "reference"
+            # sticky across calls sharing this choice_key (one trace calls
+            # this op once PER LAYER): if any earlier layer's chain was
+            # transiently downgraded, the compiled program mixes reference-
+            # path layers with kernel layers — a later layer's clean probe
+            # must not erase the flag
+            transient_seen = dispatch_choices.get(choice_key, "").endswith(
+                "!transient-probe"
+            )
+            dispatch_choices[choice_key] = "reference" + (
+                "!transient-probe" if transient_seen else ""
+            )
             for fn_name in chain:
                 if len(chain) > 1 and not probe(fn_name):
+                    pkey = (fn_name, quantized, num_kv_heads, num_groups,
+                            head_dim, page_size, scaled_q.dtype, kw.dtype,
+                            blocks, pps)
+                    transient_seen = transient_seen or (
+                        pkey in transient_probe_keys
+                    )
                     continue
-                dispatch_choices[choice_key] = fn_name
+                dispatch_choices[choice_key] = fn_name + (
+                    "!transient-probe" if transient_seen else ""
+                )
                 if fn_name == "native":
                     return _native_call(
                         scaled_q, k_pages, v_pages,
@@ -498,13 +530,21 @@ def paged_attention_op(
                     scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
                     page_indices, pages_per_compute_block=blocks,
                 ).astype(q.dtype)
+            if transient_seen:
+                # every chain member's probe failed and at least one failure
+                # was transient: this trace runs the reference path until a
+                # retrace re-probes — flag it
+                dispatch_choices[choice_key] = "reference!transient-probe"
         except Exception as e:  # noqa: BLE001 — fall back with one warning
             if impl in ("kernel", "native"):
                 raise
             # the chain recorded its pick before launching; the launch
-            # failed, so what actually runs below is the reference
+            # failed, so what actually runs below is the reference (keep the
+            # transient marker sticky — see above)
             if choice_key is not None:
-                dispatch_choices[choice_key] = "reference"
+                dispatch_choices[choice_key] = "reference" + (
+                    "!transient-probe" if transient_seen else ""
+                )
             global _kernel_fail_warned
             if not _kernel_fail_warned:
                 _kernel_fail_warned = True

@@ -60,7 +60,8 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     kwargs: dict[str, Any] = {"kv_quant": config.kv_cache_quant}
     if config.decode_scan_chunk:
         # every engine_impl hosts the chunked step (dense, paged wave +
-        # refill, paged_sharded); config validation excludes spec_draft
+        # refill, paged_sharded, and the speculative scheduler via
+        # _spec_chunk_fn — chunk counts verify rounds there)
         kwargs["scan_chunk"] = config.decode_scan_chunk
     if config.engine_impl == "paged":
         if config.continuous_batching:

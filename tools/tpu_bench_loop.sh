@@ -82,46 +82,41 @@ bench() {
 # be minutes long, so the most distinct stories come first; every stage is
 # resumable (markers) and the matrix makes up to 3 passes so a stage that
 # crashed mid-window is retried. ------------------------------------------
+# Round-4 priority order (VERDICT r3 "Next round"): the native paged
+# kernel has zero silicon validation, so kernel_check gates everything
+# paged; then the paged matrix, the scan-chunk A/B (roofline), the
+# learner, 7B, and the curve. Dense stages from r3 keep their markers.
 matrix() {
-bench dense   /tmp/bench_tpu_dense.json
-# the flagship engine + the round-3 corrected Mosaic launch
-bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
-# scheduler headline at realistic length variance (mean ~1/0.002 = 500 of
-# 1200 tokens ≈ the reference's ~470 mean): refill keeps slots busy
-bench refill_eos /tmp/bench_tpu_refill_eos.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill
-# the second headline metric: jitted train-step tok/s + MFU
-bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
-# kernel parity on silicon (fwd + bwd) — the N1/N3/N10 lowering authority
+# 1. kernel parity on silicon — native-kernel stanzas at the 0.5B geometry
+#    (hd=64, 14q/2kv) + relative-tolerance flash/splash backward rerun.
+#    This is the N1/N10 lowering authority: paged numbers mean nothing
+#    until these PASS on chip (two Mosaic classes were interpreter-blind).
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
    grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
    exit $rc'
-# A/Bs: sampler inside the real decode loop; waves straggler tail; dense
-# at variance; speculative; page budget; int8 KV; learner flash
-bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
-# dense int8 KV (fused-dequant cache): halves the 9.1 GB/step cache read
-bench dense_int8 /tmp/bench_tpu_dense_int8.json BENCH_KV_QUANT=int8
-# dense with BOTH decode-bandwidth levers on: the headline-challenger run
-bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
-# scan-chunked decode: K steps per dispatch — the tunnel dispatch-overhead
-# lever (dense ran ~22 steps/s against a ~5 ms/step chip estimate; see
-# tools/dispatch_probe.py). scan_chunk_active=false in the record means the
-# memory guard rejected the chunked program and this measured the host loop.
-bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
-# all three decode levers stacked: the headline-challenger run
-bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
-  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
-# refill scheduler with chunked dispatch (chunk = the host cadence)
+# 2. flagship paged engine on silicon — first ever paged datapoint
+bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
+# 3. refill scheduler, chunked dispatch (the production config)
 bench refill_scan /tmp/bench_tpu_refill_scan.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
-bench waves_eos /tmp/bench_tpu_waves_eos.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
-bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
-bench spec    /tmp/bench_tpu_spec.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
-# speculative + chunked dispatch: amortization compounds with acceptance
+# 4. scan-chunk A/B vs the r3 dense number → quantifies the dispatch
+#    bottleneck for the roofline statement (r3: ~22 steps/s dispatch-bound
+#    against a ~5 ms/step chip estimate)
+bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
+# 5. all three decode levers stacked: the headline-challenger run
+bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
+  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+# 6. the second headline metric: jitted train-step tok/s + MFU
+#    (fetch-timed — the tunnel's block_until_ready lies)
+bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
+bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
+# 7. scheduler headline at realistic length variance (mean ~1/0.002 = 500
+#    of 1200 tokens ≈ the reference's ~470 mean): refill keeps slots busy
+bench refill_eos /tmp/bench_tpu_refill_eos.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill
+# 8. paged A/Bs promised by benchmarks/r3/README.md: spec, budget, int8 KV
 bench spec_scan /tmp/bench_tpu_spec_scan.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SCAN_CHUNK=16
@@ -129,25 +124,33 @@ bench budget  /tmp/bench_tpu_budget.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
 bench int8kv  /tmp/bench_tpu_int8kv.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
-bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
-# probes: dispatch overhead (scan-chunk decision), sampler microbench
+# 9. compile-time HBM ground truth for the config-2 table (BASELINE.md)
+run_stage mem_envelope 1200 bash -c \
+  'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
+     > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
+# 10. 7B capacity config (BASELINE config-2): int4 base + int8 KV + refill
+#     + scan-chunk — the like-for-like scale vs the reference's 7B headline
+bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
+  BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
+  BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
+  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16 \
+  BENCH_SCAN_CHUNK=16
+# 11. remaining A/Bs + probes (dense family landed in r3)
+bench dense   /tmp/bench_tpu_dense.json
+bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
+bench dense_int8 /tmp/bench_tpu_dense_int8.json BENCH_KV_QUANT=int8
+bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+bench waves_eos /tmp/bench_tpu_waves_eos.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
+bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
+bench spec    /tmp/bench_tpu_spec.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
 run_stage dispatch_probe 300 bash -c \
   'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
    cat /tmp/dispatch_probe.log; exit $rc'
 run_stage sampler_probe 600 bash -c \
   'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
    cat /tmp/sampler_probe.log; exit $rc'
-# compile-time HBM ground truth for the config-2 table (BASELINE.md)
-run_stage mem_envelope 1200 bash -c \
-  'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
-     > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
-# 7B capacity config (BASELINE config-2): int4 base + int8 KV + refill —
-# the like-for-like model scale against the reference's 7B headline runs
-bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
-  BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
-  BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
-  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16 \
-  BENCH_SCAN_CHUNK=16
 # longest stage last: the on-chip reward curve checkpoints+resumes, so
 # every window it reaches adds steps even if it never finishes in one
 run_stage train_curve 3000 bash -c \

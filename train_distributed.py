@@ -69,7 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "cache memory + decode bandwidth)")
     p.add_argument("--decode_scan_chunk", type=int, default=0,
                    help="decode steps fused per dispatch via lax.scan "
-                        "(dense and paged engines; not speculative) — "
+                        "(all engines: dense, paged wave/refill, sharded, "
+                        "and speculative) — "
                         "amortizes per-dispatch overhead on network-"
                         "tunneled PJRT clients (tools/dispatch_probe.py "
                         "measures it); auto-falls back if the compiler "
