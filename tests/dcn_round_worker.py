@@ -1,0 +1,133 @@
+"""Two-process DCN round worker: local DP rollout → global psum train step.
+
+Spawned (one process per rank) by ``tests/test_control_plane.py::
+TestJaxDistributed::test_two_process_rollout_train_round``. Exercises the
+multi-host path end-to-end on CPU with gloo collectives: the reference's Ray
+placement-group round (distributed_actor.py:543–556 — actors roll out on
+their own GPUs, the learner all-reduces gradients over NCCL) becomes
+``jax.distributed.initialize`` via distributed/launch.py, per-process local
+rollouts through the real generation engine, and one jitted GRPO train step
+over a GLOBAL dp mesh whose gradient psum rides the (simulated) DCN.
+
+Each rank feeds DIFFERENT local rollout rows into its shard of the global
+batch; GSPMD inserts the cross-process gradient all-reduce, so the updated
+adapter (and the loss) must come out IDENTICAL on every rank — the parent
+test asserts the printed checksums match across ranks. A broken cross-host
+reduction would leave each rank with a locally-updated adapter and
+mismatched checksums.
+"""
+
+import os
+import sys
+
+rank = int(sys.argv[1])
+nprocs = int(sys.argv[2])
+addr = sys.argv[3]
+sys.path.insert(0, os.getcwd())
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# cross-process CPU collectives need an explicit backend; gloo ships in jaxlib
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from distrl_llm_tpu.distributed import initialize_distributed  # noqa: E402
+
+info = initialize_distributed(addr, nprocs, rank)
+assert info.num_processes == nprocs, info
+assert info.global_device_count == nprocs * info.local_device_count, info
+assert info.is_driver == (rank == 0), info
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from distrl_llm_tpu.config import SamplingConfig  # noqa: E402
+from distrl_llm_tpu.engine import GenerationEngine  # noqa: E402
+from distrl_llm_tpu.learner.optim import make_optimizer  # noqa: E402
+from distrl_llm_tpu.learner.train_step import (  # noqa: E402
+    UpdateBatch,
+    make_train_step,
+)
+from distrl_llm_tpu.models import TINY, init_lora_params, init_params  # noqa: E402
+from distrl_llm_tpu.models.lora import lora_scale  # noqa: E402
+
+cfg = TINY
+P_LEN = T_LEN = 8
+params_host = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+lora_host = init_lora_params(jax.random.PRNGKey(1), cfg, rank=4)
+
+# --- DP rollout: each process generates ITS shard of the episode locally
+# (the reference's one-engine-per-GPU data parallelism; rank-seeded prompts
+# make every rank's rollout rows genuinely different)
+engine = GenerationEngine(
+    cfg, max_prompt_tokens=P_LEN, max_new_tokens=T_LEN,
+    eos_token_ids=[0], pad_token_id=0,
+)
+local_rows_per_dev = 1
+local_rows = info.local_device_count * local_rows_per_dev
+prompts = (
+    np.random.default_rng(100 + rank)
+    .integers(1, cfg.vocab_size, size=(local_rows, P_LEN))
+    .astype(np.int32)
+)
+pmask = np.ones_like(prompts)
+res = engine.generate(
+    params_host, lora_host, prompts, pmask,
+    SamplingConfig(max_tokens=T_LEN, temperature=1.0, top_p=0.95, n=1),
+    jax.random.PRNGKey(10 + rank),
+)
+answers = np.asarray(res.tokens[:, 0, :]).astype(np.int32)
+answer_mask = (
+    np.arange(T_LEN)[None, :] < np.asarray(res.lengths[:, :1])
+).astype(np.int32)
+# toy deterministic "reward": rank-distinct coefficients, so a missing
+# cross-process reduction cannot cancel out by symmetry
+coeffs = (0.5 + rank + np.arange(local_rows)).astype(np.float32)
+
+# --- one GRPO train step over the GLOBAL dp mesh: every device of every
+# process participates; the batch is assembled from process-LOCAL rows
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+mat = NamedSharding(mesh, P("dp", None))
+row = NamedSharding(mesh, P("dp"))
+rep = NamedSharding(mesh, P())
+
+def glob(sharding, local):
+    return jax.make_array_from_process_local_data(sharding, local)
+
+batch = UpdateBatch(
+    prompt_ids=glob(mat, prompts),
+    prompt_mask=glob(mat, pmask),
+    answer_ids=glob(mat, answers),
+    answer_mask=glob(mat, answer_mask),
+    coeffs=glob(row, coeffs),
+    sample_mask=glob(row, np.ones((local_rows,), np.float32)),
+)
+params = jax.device_put(params_host, rep)
+lora = jax.device_put(lora_host, rep)
+optimizer = make_optimizer(2e-5, use_8bit=True)
+opt_state = jax.device_put(optimizer.init(lora_host), rep)
+step = make_train_step(
+    cfg, learner_type="grpo", optimizer=optimizer,
+    lora_scale=lora_scale(4, 8.0), micro_size=nprocs * local_rows,
+    donate=False, logit_chunk=4,
+)
+with mesh:
+    new_lora, new_opt, loss = step(lora, opt_state, params, batch)
+loss_val = float(loss)  # psum'd scalar: replicated, identical on every rank
+assert np.isfinite(loss_val), loss_val
+
+# adapter checksum: replicated output — identical across ranks ONLY if the
+# gradient all-reduce actually crossed the process boundary (each rank's
+# local shard of the batch differs)
+leaves = jax.tree_util.tree_leaves(new_lora)
+checksum = float(sum(np.abs(np.asarray(x)).sum() for x in leaves))
+delta = float(
+    sum(
+        np.abs(np.asarray(a) - np.asarray(b)).sum()
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(lora_host))
+    )
+)
+assert delta > 0, "train step did not move the adapter"
+print(f"ROUND rank={rank} loss={loss_val:.8f} checksum={checksum:.8f}", flush=True)
+print("OK", rank, flush=True)

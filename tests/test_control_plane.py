@@ -160,3 +160,46 @@ class TestJaxDistributed:
         for p, (out, err) in zip(procs, outs):
             assert p.returncode == 0, f"stdout={out}\nstderr={err}"
             assert "OK" in out
+
+    def test_two_process_rollout_train_round(self):
+        """Full round across 2 REAL jax.distributed processes (VERDICT r3
+        item 8): per-process local rollouts through the generation engine,
+        then one jitted GRPO train step over the global dp mesh — the
+        gradient psum crosses the process boundary (gloo CPU collectives,
+        the DCN stand-in). Each rank feeds different batch rows, so the
+        identical per-rank loss/adapter checksums asserted here can only
+        come from a working cross-host all-reduce. Reference anchor: the
+        Ray placement-group round, distributed_actor.py:543–556."""
+        import os
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        worker = os.path.join(os.path.dirname(__file__), "dcn_round_worker.py")
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            # 2 local devices per process -> a 4-device global dp mesh
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(pid), "2", f"127.0.0.1:{port}"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env, cwd=os.path.dirname(os.path.dirname(worker)),
+            )
+            for pid in range(2)
+        ]
+        outs = [p.communicate(timeout=600) for p in procs]
+        rounds = []
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"stdout={out}\nstderr={err}"
+            assert "OK" in out, out
+            rounds += [ln for ln in out.splitlines() if ln.startswith("ROUND")]
+        assert len(rounds) == 2, rounds
+        # rank-independent results: loss and updated-adapter checksum agree
+        r0 = dict(kv.split("=") for kv in rounds[0].split()[1:])
+        r1 = dict(kv.split("=") for kv in rounds[1].split()[1:])
+        assert r0["loss"] == r1["loss"], (r0, r1)
+        assert r0["checksum"] == r1["checksum"], (r0, r1)

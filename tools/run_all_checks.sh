@@ -51,7 +51,10 @@ stage "suite_misc" timeout 600 python -m pytest -q \
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \
-  tests/test_config.py tests/test_cli.py
+  tests/test_config.py tests/test_cli.py tests/test_real_checkpoint.py
+# the slow tier (excluded from the default run by pytest.ini addopts):
+# heavyweight fuzz/parity/scale cases, incl. the 0.5B real-format load
+stage "suite_slow" timeout 1800 python -m pytest -q -m slow tests/
 
 echo "done: $fails failure(s)"
 exit $((fails > 0))
