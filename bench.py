@@ -90,6 +90,26 @@ def _emit(record: dict) -> None:
     print(json.dumps(record))
 
 
+def _decode_roofline_tok_s(
+    params_bytes: int, cfg, kv_quant: str, batch_rows: int,
+    mean_kv_len: float, hbm_gbps: float,
+) -> float:
+    """Bandwidth-bound decode ceiling (tok/s/chip): each decode step must
+    stream every resident weight byte once (batch-amortized) plus each
+    row's KV read at the mean context length. Decode is HBM-bound on TPU
+    (arithmetic intensity ~1 per weight at batch 1), so
+    measured/roofline — not MFU — is the honest utilisation statement
+    (VERDICT r3 weak #2). v5e HBM ≈ 819 GB/s (BENCH_HBM_GBPS)."""
+    kv_bytes_per_token = {
+        # int8 pages carry f32 scales per token: ~1 + 4/head_dim B/elem
+        "int8": 1.0 + 4.0 / cfg.head_dim,
+        "none": 2.0,  # bf16 cache on TPU
+    }[kv_quant] * (2 * cfg.num_layers * cfg.kv_dim)
+    step_bytes = params_bytes + batch_rows * mean_kv_len * kv_bytes_per_token
+    steps_per_s = hbm_gbps * 1e9 / step_bytes
+    return batch_rows * steps_per_s
+
+
 def _train_flops_per_token(cfg, seq_len: int) -> float:
     """Model FLOPs per trained token: 3× the forward's 2·matmul-params
     (fwd + ~2× for backward through frozen base + LoRA) plus causal
@@ -488,6 +508,17 @@ def main() -> int:
     mean_kv = mean_prompt_len + mean_new / 2.0  # KV grows linearly over decode
     flops_per_token = _decode_flops_per_token(cfg, mean_kv)
     mfu = tps_chip * flops_per_token / (peak_tflops * 1e12)
+    # bandwidth roofline at this config's slot count and mean context
+    hbm_gbps = float(os.environ.get("BENCH_HBM_GBPS", "819"))
+    slot_rows = min(
+        engine.max_concurrent_rows or n_prompts * n_cand, n_prompts * n_cand
+    )
+    from distrl_llm_tpu.engine.budget import tree_bytes
+
+    roofline = _decode_roofline_tok_s(
+        tree_bytes(params), cfg, engine_kwargs["kv_quant"], slot_rows,
+        mean_kv, hbm_gbps,
+    )
 
     # report the scheduler that actually RAN: the refill path only engages
     # when the row cap is exceeded (otherwise generate() falls through to a
@@ -556,6 +587,14 @@ def main() -> int:
         "chips": n_chips,
         "flops_per_token_gflop": round(flops_per_token / 1e9, 6),
         "peak_tflops": peak_tflops,
+        # bandwidth-bound ceiling for THIS config (weights streamed once per
+        # step + per-slot KV read at mean context; assumes bf16/quantized
+        # residency as constructed) — decode utilisation is tok/s vs this,
+        # not MFU; a low pct with scan_chunk=0 over the tunnel quantifies
+        # the ~40 ms/dispatch bottleneck rather than chip saturation
+        "roofline_tok_s_per_chip": round(roofline, 1),
+        "pct_of_roofline": round(100.0 * tps_chip / roofline, 2) if roofline else None,
+        "hbm_gbps_assumed": hbm_gbps,
         "pool_stats": getattr(engine, "last_pool_stats", None),
         "baseline_note": "baseline 1500 tok/s/GPU derived from reference's ~2h/100-step "
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "

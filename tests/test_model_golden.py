@@ -269,6 +269,85 @@ class TestMistralGolden:
         assert preset_for_model_name("google/gemma-7b-it") is GEMMA_7B
 
 
+class TestLlamaGolden:
+    """Llama-3-style config: GQA, untied embeddings, no attention bias,
+    large rope_theta. Golden-checked against transformers'
+    LlamaForCausalLM (the LLAMA3_8B preset's family — models/configs.py)."""
+
+    def _configs(self):
+        from distrl_llm_tpu.models.configs import ModelConfig
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, rope_theta=500000.0, rms_norm_eps=1e-5,
+            tie_word_embeddings=False, attention_bias=False,
+            attention_dropout=0.0,
+        )
+        ours = ModelConfig.from_hf_config(hf_cfg)
+        assert not ours.attention_bias
+        assert not ours.tie_word_embeddings
+        assert ours.rope_theta == 500000.0
+        return hf_cfg, ours
+
+    def test_golden_logits(self):
+        hf_cfg, cfg = self._configs()
+        torch.manual_seed(2)
+        model = transformers.LlamaForCausalLM(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        from distrl_llm_tpu.models.loading import params_from_state_dict
+
+        params = params_from_state_dict(sd, cfg, dtype=np.float32)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(2, 17))
+        ours, _ = forward(params, cfg, jnp.asarray(ids))
+        theirs = hf_logits(model, ids)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-4)
+
+    def test_engine_decode(self):
+        """Greedy engine decode matches transformers' greedy generate on the
+        same checkpoint — the rollout path end-to-end for the family."""
+        import jax
+
+        from distrl_llm_tpu.config import SamplingConfig
+        from distrl_llm_tpu.engine import GenerationEngine
+        from distrl_llm_tpu.models.loading import params_from_state_dict
+
+        hf_cfg, cfg = self._configs()
+        torch.manual_seed(2)
+        model = transformers.LlamaForCausalLM(hf_cfg).eval()
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        params = params_from_state_dict(sd, cfg, dtype=np.float32)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(1, cfg.vocab_size, size=(1, 8))
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(ids), max_new_tokens=6, do_sample=False,
+                eos_token_id=None, pad_token_id=0,
+            ).numpy()[:, 8:]
+        engine = GenerationEngine(
+            cfg, max_prompt_tokens=8, max_new_tokens=6,
+            # unreachable eos: force the full 6 greedy steps, like hf above
+            eos_token_ids=[cfg.vocab_size - 1 + 10**6], pad_token_id=0,
+        )
+        got = engine.generate(
+            params, None, ids.astype(np.int32), np.ones_like(ids, np.int32),
+            SamplingConfig(max_tokens=6, temperature=0.0, top_p=1.0, n=1),
+            jax.random.PRNGKey(0),
+        ).tokens[:, 0, :]
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_preset_mapping(self):
+        from distrl_llm_tpu.models.configs import (
+            LLAMA3_8B, preset_for_model_name,
+        )
+
+        assert (
+            preset_for_model_name("meta-llama/Meta-Llama-3-8B-Instruct")
+            is LLAMA3_8B
+        )
+
+
 class TestGemmaGolden:
     """Gemma differs in every knob ModelConfig added for it: tanh-GELU MLP,
     RMSNorm (1+w) offset, sqrt(hidden) embedding scaling, tied embeddings,

@@ -1,4 +1,4 @@
-"""Sweep on-chip artifacts from /tmp into benchmarks/r3/ and print the
+"""Sweep on-chip artifacts from /tmp into benchmarks/r4/ and print the
 BASELINE.md table rows for whatever has landed so far.
 
 Run after (or during) a TPU window: copies every /tmp/bench_tpu_*.json
@@ -14,7 +14,7 @@ import shutil
 import sys
 
 REPO = os.path.join(os.path.dirname(__file__), "..")
-DEST = os.path.join(REPO, "benchmarks", "r3")
+DEST = os.path.join(REPO, "benchmarks", "r4")
 
 LOGS = [
     "/tmp/tpu_kernel_tests.log",
@@ -62,6 +62,12 @@ def main() -> int:
             rows.append(
                 f"| {name} | {rec.get('engine')} | {rec.get('model')} | "
                 f"**{rec.get('value'):,}** | {100*rec.get('mfu', 0):.2f}% | "
+                + (
+                    f"{rec['pct_of_roofline']}% | "
+                    if rec.get("pct_of_roofline") is not None
+                    else "— | "
+                )
+                +
                 f"**{rec.get('vs_baseline')}×** | {'; '.join(notes) or '—'} |"
             )
     for log in LOGS:
@@ -74,8 +80,8 @@ def main() -> int:
     for f in sorted(os.listdir(DEST)):
         print(" ", f)
     if rows:
-        print("\n| run | engine | model | tok/s/chip | MFU | vs baseline | notes |")
-        print("|---|---|---|---|---|---|---|")
+        print("\n| run | engine | model | tok/s/chip | MFU | %roofline | vs baseline | notes |")
+        print("|---|---|---|---|---|---|---|---|")
         print("\n".join(rows))
     return 0
 
