@@ -21,6 +21,7 @@ from tests.test_trainer import make_config, make_datasets, make_trainer
 
 
 class TestAsyncRollout:
+    @pytest.mark.slow
     def test_full_run_matches_sync_step_count(self):
         """An async run must process exactly the batches a sync run does
         (same episodes, same cursor bookkeeping) with finite losses."""
@@ -36,6 +37,7 @@ class TestAsyncRollout:
             assert all(np.isfinite(l) for l in losses)
         assert len(results[True]) == len(results[False])
 
+    @pytest.mark.slow
     def test_real_engine_round_with_overlap(self):
         """Async over the REAL tiny engine: generation for batch t+1 samples
         with stale-by-one weights while the update runs — rollouts must stay

@@ -49,7 +49,12 @@ def engines():
 
 
 class TestBehaviorLogprobs:
-    @pytest.mark.parametrize("name", ["dense", "paged", "refill", "spec"])
+    @pytest.mark.parametrize("name", [
+        "dense",
+        pytest.param("paged", marks=pytest.mark.slow),
+        pytest.param("refill", marks=pytest.mark.slow),
+        pytest.param("spec", marks=pytest.mark.slow),
+    ])
     def test_engine_logprobs_match_learner_recompute(self, setup, name):
         """THE cross-stack consistency check: the engine's rollout-time
         logprob of every sampled token must equal the learner's
@@ -138,6 +143,7 @@ class TestClipLoss:
 
 
 class TestClipTrainerIntegration:
+    @pytest.mark.slow
     def test_trainer_round_with_clip(self):
         """Full batch with clip_ratio on: the engine's logprobs flow through
         candidates → topk → flatten → UpdateBatch, and the learner trains on
@@ -209,6 +215,7 @@ class TestKlToRef:
         g = jax.grad(lambda c: kl_to_ref(c, ref, mask))(cur)
         assert float(g[0, 0]) < 0
 
+    @pytest.mark.slow
     def test_zero_init_adapter_means_zero_kl_in_step(self):
         """With a B=0-initialized LoRA, π == π_ref exactly, so the kl_coeff
         term must not change the first step's loss at all."""
@@ -269,6 +276,7 @@ class TestKlToRef:
 
 
 class TestClipKlLearningDynamics:
+    @pytest.mark.slow
     def test_reward_climbs_under_clip_and_kl(self):
         """The full regularized objective (PPO-clip + KL-to-base) must still
         LEARN end-to-end: the digit-fraction reward climbs over 60 steps
@@ -316,6 +324,7 @@ class TestClipKlLearningDynamics:
         late = float(np.mean(curve[-10:]))
         assert late > early * 1.1, f"no climb under clip+kl: {early} -> {late}"
 
+    @pytest.mark.slow
     def test_behavior_logprob_metric_logged(self):
         """Rounds that capture logprobs log mean_behavior_logprob (policy-
         sharpening observability); plain rounds don't emit the key."""

@@ -170,6 +170,7 @@ class TestJaxDistributed:
             assert p.returncode == 0, f"stdout={out}\nstderr={err}"
             assert "OK" in out
 
+    @pytest.mark.slow
     def test_two_process_rollout_train_round(self):
         """Full round across 2 REAL jax.distributed processes (VERDICT r3
         item 8): per-process local rollouts through the generation engine,

@@ -50,6 +50,7 @@ def naive_greedy(params, ids, mask, steps):
 
 
 class TestGreedyEquivalence:
+    @pytest.mark.slow
     def test_engine_matches_naive_full_forward(self, setup):
         params, ids, mask = setup
         engine = make_engine(max_new=6)
@@ -152,6 +153,7 @@ class TestLengthBucketing:
             cache_dtype=jnp.float32, prompt_buckets=buckets,
         )
 
+    @pytest.mark.slow
     def test_short_batch_uses_small_bucket(self, setup):
         params, ids, mask = setup
         # longest real prompt: row 1 with 8 real tokens → full bucket; shrink
@@ -169,6 +171,7 @@ class TestLengthBucketing:
         expected = naive_greedy(params, ids2, mask2, 6)
         np.testing.assert_array_equal(res.tokens[:, 0, :], expected)
 
+    @pytest.mark.slow
     def test_long_batch_uses_full_bucket(self, setup):
         params, ids, mask = setup
         engine = self.make_bucketed([4])
@@ -181,6 +184,7 @@ class TestLengthBucketing:
         expected = naive_greedy(params, ids, mask, 6)
         np.testing.assert_array_equal(res.tokens[:, 0, :], expected)
 
+    @pytest.mark.slow
     def test_bucket_choice_matches_unbucketed_outputs(self, setup):
         params, ids, mask = setup
         ids2, mask2 = ids.copy(), mask.copy()
@@ -210,6 +214,7 @@ class TestWaveScheduling:
     """max_concurrent_rows runs rounds as sequential waves (vLLM
     max_num_seqs); greedy results must equal the unlimited path."""
 
+    @pytest.mark.slow
     def test_waves_match_unlimited_greedy(self, setup):
         params, ids, mask = setup
         cfg = SamplingConfig(max_tokens=4, temperature=0.0, n=2)
@@ -223,6 +228,7 @@ class TestWaveScheduling:
         np.testing.assert_array_equal(waved.tokens, want.tokens)
         np.testing.assert_array_equal(waved.lengths, want.lengths)
 
+    @pytest.mark.slow
     def test_tail_wave_pads_with_dead_rows(self, setup):
         params, ids, mask = setup
         # 3 prompts, 2 per wave → tail wave has 1 real + 1 dead row
@@ -245,6 +251,7 @@ class TestTopPImplOverride:
     multiway filter must produce a working round, and greedy decoding must
     be impl-invariant (temperature 0 bypasses the filter)."""
 
+    @pytest.mark.slow
     def test_multiway_round_and_greedy_invariance(self, setup):
         params, ids, mask = setup
         eng = make_engine(max_new=6)
@@ -296,6 +303,7 @@ class TestInt8KvCache:
         assert res.tokens.shape == (2, 2, 6)
         assert np.asarray(res.tokens).max() < TINY.vocab_size
 
+    @pytest.mark.slow
     def test_greedy_mostly_matches_f32_cache(self, setup):
         """int8 quantization perturbs logits by ~1e-3 — on a random-init
         model ties can flip a token, but the sequences should agree at the
@@ -346,6 +354,7 @@ class TestScanChunk:
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
 
+    @pytest.mark.slow
     def test_sampled_parity_with_overshoot_and_logprobs(self, setup):
         """chunk=4 over max_new=6: the second chunk overshoots by 2 guarded
         steps — tokens, lengths AND captured behavior logprobs must still be
@@ -360,6 +369,7 @@ class TestScanChunk:
         np.testing.assert_array_equal(a.lengths, b.lengths)
         np.testing.assert_array_equal(a.logprobs, b.logprobs)
 
+    @pytest.mark.slow
     def test_eos_stop_parity(self, setup):
         """Rows that hit EOS mid-chunk must stop, pad, and stop counting
         exactly as in the host loop (the done masking rides inside the
@@ -378,6 +388,7 @@ class TestScanChunk:
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
 
+    @pytest.mark.slow
     def test_chunk_larger_than_max_steps(self, setup):
         params, ids, mask = setup
         host, chunked = self._pair(scan_chunk=16, max_new=3)
@@ -393,6 +404,7 @@ class TestScanChunk:
                 eos_token_ids=[1], pad_token_id=0, scan_chunk=-1,
             )
 
+    @pytest.mark.slow
     def test_none_then_adapter_rounds_share_engine(self, setup):
         """Round with lora=None then a round with an adapter (and back):
         a Compiled chunk program raises on a structurally different pytree

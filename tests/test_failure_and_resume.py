@@ -30,6 +30,7 @@ class HangingEngine(FakeEngine):
 
 
 class TestHangDetection:
+    @pytest.mark.slow
     def test_hang_raises_and_checkpoints(self, tmp_path):
         cfg = make_config(
             generation_timeout_s=0.3,
@@ -107,6 +108,7 @@ class TestMidEpisodeResume:
 
 
 class TestProfiler:
+    @pytest.mark.slow
     def test_trace_dir_produced(self, tmp_path):
         """profile_dir is no longer a dead flag: a smoke run produces a
         TensorBoard trace directory (VERDICT r1 item 6)."""

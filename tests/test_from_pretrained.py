@@ -57,6 +57,7 @@ def checkpoint_dir(tmp_path_factory):
 
 @pytest.mark.skipif(not native_available(), reason="g++ not available")
 class TestFromPretrained:
+    @pytest.mark.slow
     def test_assemble_and_train_a_round(self, checkpoint_dir):
         cfg = TrainConfig(
             model=checkpoint_dir,
@@ -81,6 +82,7 @@ class TestFromPretrained:
         assert recs and np.isfinite(recs[-1]["loss"])
         assert trainer.weight_version == 1
 
+    @pytest.mark.slow
     def test_engine_impl_paged_assembles(self, checkpoint_dir):
         cfg = TrainConfig(
             model=checkpoint_dir,

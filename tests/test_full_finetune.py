@@ -25,6 +25,7 @@ def make_batch(rng, n, p_len=6, t_len=8):
 
 
 class TestFullRankTrainStep:
+    @pytest.mark.slow
     def test_updates_every_param(self):
         """In full mode ALL leaves move — embed, norms, lm_head included
         (LoRA mode can only touch the adapter)."""
@@ -46,6 +47,7 @@ class TestFullRankTrainStep:
         ]
         assert all(moved), f"{sum(moved)}/{len(moved)} leaves updated"
 
+    @pytest.mark.slow
     def test_repeated_steps_reduce_pg_loss(self):
         params = init_params(jax.random.PRNGKey(0), TINY)
         opt = make_optimizer(5e-3, use_8bit=True)
@@ -90,6 +92,7 @@ class TestFullFinetuneConfig:
 
 
 class TestFullFinetuneTrainer:
+    @pytest.mark.slow
     def test_round_updates_weights_and_engine_sees_them(self):
         """A full trainer batch in full-rank mode: the engine must sample
         from the UPDATED tree on the next round (weight sync pushes the whole

@@ -75,6 +75,7 @@ class TestSwapSemantics:
         # the pure-A trajectory
         assert not np.array_equal(swapped.tokens[:, :, 1:], base.tokens[:, :, 1:])
 
+    @pytest.mark.slow
     def test_preswap_logprobs_match_recompute_postswap_diverge(self, setup):
         """The correctness contract: captured behavior logprobs ARE the true
         sampling probabilities. Pre-swap positions reproduce exactly under a
@@ -112,6 +113,7 @@ class TestSwapSemantics:
         # ...and the post-swap distribution is genuinely not A's anymore
         assert np.abs(got[post] - under_a[post]).max() > 1e-3
 
+    @pytest.mark.slow
     def test_swap_persists_across_waves(self, setup):
         """A row cap forces multiple waves; a swap consumed in wave 1 must
         NOT revert in wave 2 (each wave builds a fresh closure from the
@@ -148,6 +150,7 @@ class TestSwapSemantics:
             params, lora_a, big_ids, big_mask, GREEDY, jax.random.PRNGKey(7))
         np.testing.assert_array_equal(again.tokens, base.tokens)
 
+    @pytest.mark.slow
     def test_refill_scheduler_swaps_and_completes(self, setup):
         params, lora_a, lora_b, ids, mask = setup
         eng = PagedGenerationEngine(
@@ -192,6 +195,7 @@ class TestConfig:
 
 
 class TestTrainerIntegration:
+    @pytest.mark.slow
     def test_async_training_pushes_inflight(self, setup):
         """Full async loop with a REAL engine: the trainer must push each
         update's adapter into the engine mailbox; training stays finite."""

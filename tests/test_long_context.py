@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from distrl_llm_tpu.models import TINY, init_lora_params, init_params
 
 
+@pytest.mark.slow
 class TestLongContextLearner:
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_4k_token_step_under_sequence_parallelism(self, impl):
@@ -57,6 +58,7 @@ class TestLongContextLearner:
 
 
 class TestLongDecode:
+    @pytest.mark.slow
     def test_paged_decode_past_reference_ceiling(self):
         """The paged engine decodes 2,048 new tokens (refill scheduler) —
         past the reference's hard 1,200 ceiling — with correct lengths."""

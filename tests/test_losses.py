@@ -127,6 +127,7 @@ class TestChunkedLogprobs:
         big = answer_logprobs(params, TINY, pids, pmask, aids, amask, logit_chunk=64)
         np.testing.assert_allclose(np.asarray(big), np.asarray(dense), atol=1e-6)
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self, setup):
         """Grad through the scan+checkpoint chunks wrt LoRA must equal the
         dense path's — this is what the train step differentiates."""
@@ -152,6 +153,7 @@ class TestChunkedLogprobs:
             g_dense, g_chunk,
         )
 
+    @pytest.mark.slow
     def test_train_step_with_chunking(self):
         """End-to-end: a jitted train step built with logit_chunk reduces the
         same loss as the dense one on identical inputs."""
@@ -183,6 +185,7 @@ class TestChunkedLogprobs:
             losses[chunk] = float(loss)
         assert np.isclose(losses[0], losses[4], atol=1e-5)
 
+    @pytest.mark.slow
     def test_chunking_shrinks_compiled_temp_memory(self):
         """The point of the chunked path: compiled temp bytes for the grad
         drop by at least 2× (measured ~6× at V=32k, T=512 — the dense path

@@ -416,6 +416,7 @@ class TestGemmaGolden:
 
 
 class TestFamilyReviewRegressions:
+    @pytest.mark.slow
     def test_gemma_snapshot_roundtrip_keeps_family(self, tmp_path):
         """HF snapshot export must label Gemma checkpoints model_type='gemma'
         so reload keeps the (1+w) norm offset and embedding scaling (review:

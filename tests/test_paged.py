@@ -145,6 +145,7 @@ class TestPagedEngine:
         np.testing.assert_array_equal(paged.tokens, dense.tokens)
         np.testing.assert_array_equal(paged.lengths, dense.lengths)
 
+    @pytest.mark.slow
     def test_eos_early_exit(self, setup):
         params, ids, mask = setup
         probe = make_paged(max_new=2).generate(
@@ -278,6 +279,7 @@ class TestKvQuant:
             got = deq[:, table[r, ln // PS], ln % PS]
             np.testing.assert_allclose(np.asarray(got), np.asarray(tok[r]), atol=0.02)
 
+    @pytest.mark.slow
     def test_engine_with_int8_kv_decodes(self, setup):
         """End-to-end: the paged engine with kv_quant='int8' produces valid
         rollouts close to the float engine's greedy path."""
@@ -303,6 +305,7 @@ class TestKvQuant:
 
 
 class TestComposition:
+    @pytest.mark.slow
     def test_quantized_base_with_paged_engine(self, setup):
         """int8 weight-only base (N4) composes with the paged engine (N1):
         linear() handles quantized containers independent of the cache."""
@@ -317,6 +320,7 @@ class TestComposition:
             qparams, None, ids, mask, cfg, jax.random.PRNGKey(0))
         np.testing.assert_array_equal(paged.tokens, dense.tokens)
 
+    @pytest.mark.slow
     def test_trainer_round_on_paged_engine(self):
         """A full trainer batch with the PAGED engine as the rollout backend
         (interface drift between the engines would surface here)."""
@@ -386,6 +390,7 @@ class TestRefillScheduler:
         np.testing.assert_array_equal(res.tokens, oracle.tokens)
         np.testing.assert_array_equal(res.lengths, oracle.lengths)
 
+    @pytest.mark.slow
     def test_eos_frees_slots_early(self, setup4):
         """Rows hitting EOS at different steps: freed slots admit pending
         candidates; outputs and lengths still match wave mode exactly."""
@@ -404,6 +409,7 @@ class TestRefillScheduler:
         np.testing.assert_array_equal(res.tokens, oracle.tokens)
         np.testing.assert_array_equal(res.lengths, oracle.lengths)
 
+    @pytest.mark.slow
     def test_candidate_granularity_fanout(self, setup4):
         """n=3 candidates per prompt through 4 slots: slots mix candidates of
         different prompts (wave mode admits whole prompt groups — refill is
@@ -417,6 +423,7 @@ class TestRefillScheduler:
         np.testing.assert_array_equal(res.tokens, oracle.tokens)
         np.testing.assert_array_equal(res.lengths, oracle.lengths)
 
+    @pytest.mark.slow
     def test_sampling_shapes_and_bounds(self, setup4):
         params, ids, mask = setup4
         res = make_refill(max_new=4, slots=3).generate(
@@ -426,6 +433,7 @@ class TestRefillScheduler:
         assert res.tokens.shape == (4, 2, 4)
         assert (res.lengths >= 1).all() and (res.lengths <= 4).all()
 
+    @pytest.mark.slow
     def test_int8_kv_refill_matches_int8_waves(self, setup4):
         """Admit's partial-page recopy must preserve the quantized (weight,
         scales) pair: int8-KV refill ≡ int8-KV waves under greedy."""
@@ -466,6 +474,7 @@ class TestRefillScheduler:
         )
         assert cfg.continuous_batching
 
+    @pytest.mark.slow
     def test_dead_slots_never_corrupt_shared_pages(self, setup4):
         """Review regression: live candidates < slot count leaves slots
         never-admitted. Their per-step garbage KV writes must land in their
@@ -492,6 +501,7 @@ class TestPagedEngineTP:
     unsharded engine's (GSPMD inserts the collectives; the page pools created
     inside the jitted prefill/steps follow the propagated shardings)."""
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("scheduler", ["waves", "refill"])
     def test_tp_sharded_matches_unsharded(self, setup4, scheduler):
         from distrl_llm_tpu.parallel import shard_tree
@@ -519,6 +529,7 @@ class TestRefillScanChunk:
     still advances the fold_in index). With a smaller chunk the host cadence
     shifts, which greedy decoding cannot observe (schedule-invariance)."""
 
+    @pytest.mark.slow
     def test_greedy_parity_with_refills(self, setup4):
         params, ids, mask = setup4
         cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
@@ -531,6 +542,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.tokens, chunked.tokens)
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
+    @pytest.mark.slow
     def test_sampled_parity_with_eos_and_logprobs(self, setup4):
         """EOS mid-round frees slots for refills; sampled tokens, lengths
         and captured behavior logprobs must match the per-step loop."""
@@ -551,6 +563,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
         np.testing.assert_array_equal(base.logprobs, chunked.logprobs)
 
+    @pytest.mark.slow
     def test_non_divisor_chunk_rounds_down_and_keeps_parity(self, setup4):
         """scan_chunk=4 with check=6 (max_new=6) rounds down to the divisor
         3 — a non-divisor K would stretch the host cadence past the
@@ -565,6 +578,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(res.tokens, base.tokens)
         np.testing.assert_array_equal(res.lengths, base.lengths)
 
+    @pytest.mark.slow
     def test_tight_budget_with_non_divisor_chunk(self, setup4):
         """Budgeted pool + non-divisor scan_chunk: the divisor rounding is
         what keeps grants ahead of the write frontier; outputs must match
@@ -581,6 +595,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(res.tokens, base.tokens)
         np.testing.assert_array_equal(res.lengths, base.lengths)
 
+    @pytest.mark.slow
     def test_budgeted_pool_preemption_parity(self, setup4):
         """A pool tight enough to stall admissions (grow-as-you-go grants +
         possible preemption) must not change greedy outputs under chunking."""
@@ -596,6 +611,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.tokens, chunked.tokens)
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
+    @pytest.mark.slow
     def test_spec_budget_chunk_parity(self, setup4):
         """Tight pool + speculative + chunking: the (d+1)-scaled grant
         horizon must stay ahead of the fused steps' write frontier; greedy
@@ -614,6 +630,7 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(res.tokens, base.tokens)
         np.testing.assert_array_equal(res.lengths, base.lengths)
 
+    @pytest.mark.slow
     def test_spec_scan_chunk_parity(self, setup4):
         """Speculative scheduler + chunked dispatch: the spec step is fully
         functional (draft/verify/accept all device-side), so K fused steps
@@ -644,6 +661,7 @@ class TestWaveScanChunk:
     """Wave-scheduler chunked dispatch: exact mirror of the dense engine's
     scan_chunk (guarded overshoot, bit-parity with the per-step loop)."""
 
+    @pytest.mark.slow
     def test_sampled_parity_with_overshoot_and_logprobs(self, setup4):
         """chunk=5 over max_new=7: the second chunk overshoots by 3 guarded
         steps; sampled tokens/lengths/logprobs must be bit-identical."""
@@ -660,6 +678,7 @@ class TestWaveScanChunk:
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
         np.testing.assert_array_equal(base.logprobs, chunked.logprobs)
 
+    @pytest.mark.slow
     def test_greedy_eos_parity(self, setup4):
         params, ids, mask = setup4
         probe = make_paged(max_new=3).generate(

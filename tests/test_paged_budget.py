@@ -62,6 +62,7 @@ def _greedy(max_tokens=24, n=2):
 
 
 class TestTrainerWithBudgetedEngine:
+    @pytest.mark.slow
     def test_clip_training_batch_over_preempted_rollouts(self, tiny_params):
         """End-to-end: a PPO-clip training batch whose rollouts came from a
         preemption-forcing budgeted engine — the raw-rollout path must train
@@ -213,6 +214,7 @@ class TestBudgetMath:
 
 
 class TestBudgetedRefill:
+    @pytest.mark.slow
     def test_budgeted_greedy_matches_worst_case(self, tiny_params):
         """The load-bearing test: a pool tight enough to force preemptions
         must still produce bit-identical greedy rollouts (recompute parity)."""
@@ -234,6 +236,7 @@ class TestBudgetedRefill:
         np.testing.assert_array_equal(res.lengths, ref.lengths)
         np.testing.assert_array_equal(res.tokens, ref.tokens)
 
+    @pytest.mark.slow
     def test_preemption_fires_and_is_transparent(self, tiny_params):
         """At the single-sequence minimum pool every admission beyond the
         first must stall or preempt; outputs still match worst case."""
@@ -252,6 +255,7 @@ class TestBudgetedRefill:
         with pytest.raises(ValueError, match="cannot fit one sequence"):
             _make_engine(max_new=24, pool=4)
 
+    @pytest.mark.slow
     def test_logprobs_survive_preemption(self, tiny_params):
         ids, mask = _prompts(b=4, seed=5)
         sampling = _greedy(max_tokens=16, n=2)
@@ -277,6 +281,7 @@ class TestBudgetedRefill:
             rtol=2e-4, atol=2e-4,
         )
 
+    @pytest.mark.slow
     def test_fuzzed_eos_and_pools_hold_invariants(self, tiny_params, monkeypatch):
         """Random EOS sets × pool sizes with the per-boundary pool self-check
         on: free + owned must tile the pool at EVERY grant/preempt boundary,
@@ -308,6 +313,7 @@ class TestBudgetedRefill:
             np.testing.assert_array_equal(res.tokens, ref.tokens, err_msg=str(trial))
             assert eng.last_pool_stats["peak_pages_used"] <= pool - 1
 
+    @pytest.mark.slow
     def test_fuzzed_pools_all_complete(self, tiny_params):
         """Random tight pool sizes: every candidate finishes, lengths are
         within bounds, and the recorded peak never exceeds the budget."""
@@ -323,6 +329,7 @@ class TestBudgetedRefill:
             assert stats["peak_pages_used"] <= pool_pages - 1, stats
             np.testing.assert_array_equal(res.tokens, ref.tokens)
 
+    @pytest.mark.slow
     def test_spec_mode_budgeted_greedy_matches_worst_case(self, tiny_params):
         """Speculative decoding under a tight page pool: grow-as-you-go
         grants (with the verify overhang in the horizon) + preemption with
@@ -341,6 +348,7 @@ class TestBudgetedRefill:
             np.testing.assert_array_equal(res.lengths, ref.lengths, err_msg=str(pool))
             np.testing.assert_array_equal(res.tokens, ref.tokens, err_msg=str(pool))
 
+    @pytest.mark.slow
     def test_spec_preemption_under_sampling_keeps_logprobs_consistent(self, tiny_params):
         """Regression (round-3 review): spec re-admission samples a FRESH
         first token; without the resume fixup restoring out[c,0] /
@@ -381,6 +389,7 @@ class TestBudgetedRefill:
             got[real], recomputed[real], atol=3e-3, rtol=3e-3
         )
 
+    @pytest.mark.slow
     def test_spec_preemption_fires_on_minimum_pool(self, tiny_params):
         """At the single-sequence floor the spec scheduler must actually
         exercise the preempt+resume path, not just stall admission."""

@@ -36,8 +36,10 @@ class TestCompactScalesKernel:
         "b,h,k,hd,ps,pps",
         [
             (4, 8, 2, 64, 16, 4),   # small GQA group (the <8-group q path)
-            (2, 16, 2, 64, 16, 4),  # group == 8 (the direct-layout q path)
-            (3, 4, 4, 32, 8, 2),    # MQA-ish, odd batch
+            pytest.param(2, 16, 2, 64, 16, 4,  # group == 8 (direct layout)
+                         marks=pytest.mark.slow),
+            pytest.param(3, 4, 4, 32, 8, 2,    # MQA-ish, odd batch
+                         marks=pytest.mark.slow),
         ],
     )
     def test_matches_reference(self, b, h, k, hd, ps, pps):

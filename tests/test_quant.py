@@ -110,6 +110,7 @@ class TestQuantizedModel:
             np.asarray(dense.argmax(-1)) == np.asarray(quant.argmax(-1))
         ).mean() > 0.9
 
+    @pytest.mark.slow
     def test_forward_with_lora_and_cache(self):
         from distrl_llm_tpu.config import SamplingConfig
         from distrl_llm_tpu.engine import GenerationEngine
@@ -181,6 +182,7 @@ class TestQuantSharding:
         for (kp, leaf), (ks, spec) in zip(flat_p, flat_s):
             assert len(spec) == leaf.ndim, (kp, spec, leaf.shape)
 
+    @pytest.mark.slow
     def test_sharded_quantized_forward_matches(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
 

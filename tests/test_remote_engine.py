@@ -75,6 +75,7 @@ def batch():
 
 
 class TestRemoteTrainerRound:
+    @pytest.mark.slow
     def test_full_train_round_with_remote_rollout(self, workers):
         """A complete trainer round where generation runs in worker
         PROCESSES (the reference's actor fan-out, distributed_trainer.py:
@@ -114,6 +115,7 @@ class TestRemoteTrainerRound:
 
 
 class TestRemoteRollout:
+    @pytest.mark.slow
     def test_remote_greedy_matches_local(self, workers, batch):
         _, addrs = workers
         ids, mask = batch

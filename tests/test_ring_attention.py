@@ -26,6 +26,7 @@ def reference(q, k, v, valid):
 
 
 class TestRingAttention:
+    @pytest.mark.slow
     @pytest.mark.parametrize("sp", [1, 2, 4, 8])
     def test_matches_reference(self, sp):
         mesh = _make_mesh(jax.devices(), tp=1, sp=sp, fsdp=1)
@@ -35,6 +36,7 @@ class TestRingAttention:
         ref = reference(q, k, v, valid)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow
     def test_left_padding(self):
         mesh = _make_mesh(jax.devices(), tp=1, sp=4, fsdp=1)
         q, k, v = make_qkv(s=32, seed=1)
@@ -49,6 +51,7 @@ class TestRingAttention:
             np.asarray(out)[real], np.asarray(ref)[real], atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_fully_padded_rows_are_zero(self):
         mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
         q, k, v = make_qkv(s=16, seed=2)
@@ -57,6 +60,7 @@ class TestRingAttention:
         assert np.isfinite(np.asarray(out)).all()
         np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
 
+    @pytest.mark.slow
     def test_gradients_match_reference(self):
         mesh = _make_mesh(jax.devices(), tp=1, sp=4, fsdp=1)
         q, k, v = make_qkv(s=16, seed=3)
@@ -114,6 +118,7 @@ class TestRingInModel:
             np.asarray(ring)[real], np.asarray(ref)[real], atol=2e-4, rtol=2e-4
         )
 
+    @pytest.mark.slow
     def test_train_step_matches_reference_impl(self):
         from distrl_llm_tpu.learner.optim import make_optimizer
         from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step

@@ -96,6 +96,7 @@ class TestDisjointRoles:
         learner = set(trainer.meshes.learner.devices.flat)
         assert rollout and learner and not (rollout & learner)
 
+    @pytest.mark.slow
     def test_full_round_on_split_meshes(self, trainer):
         """One rollout + update round where generation runs on the rollout
         submesh and the train step on the learner submesh."""

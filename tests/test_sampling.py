@@ -52,6 +52,7 @@ class TestSample:
         ]
         assert set(toks) <= {0, 1}
 
+    @pytest.mark.slow
     def test_high_temperature_flattens(self):
         lg = jnp.asarray([[4.0, 0.0, 0.0, 0.0]])
         toks = [int(sample(jax.random.PRNGKey(i), lg, 50.0, 1.0)[0]) for i in range(200)]

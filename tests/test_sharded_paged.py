@@ -63,6 +63,7 @@ class TestShardedParity:
         np.testing.assert_array_equal(b.lengths, a.lengths)
         np.testing.assert_array_equal(b.tokens, a.tokens)
 
+    @pytest.mark.slow
     def test_batch_not_divisible_by_dp_pads(self, tiny_params):
         ids, mask = _prompts(6, seed=3)  # 6 rows over dp=4 → 2 pad rows
         ref, sharded = _engines(tiny_params)
@@ -71,6 +72,7 @@ class TestShardedParity:
         assert b.tokens.shape == a.tokens.shape == (6, 2, 12)
         np.testing.assert_array_equal(b.tokens, a.tokens)
 
+    @pytest.mark.slow
     def test_logprobs_parity(self, tiny_params):
         ids, mask = _prompts(4, seed=5)
         ref, sharded = _engines(tiny_params, capture_logprobs=True)
@@ -83,6 +85,7 @@ class TestShardedParity:
             rtol=2e-4, atol=2e-4,
         )
 
+    @pytest.mark.slow
     def test_int8_kv_parity(self, tiny_params):
         ids, mask = _prompts(4, seed=7)
         ref, sharded = _engines(tiny_params, kv_quant="int8")
@@ -108,6 +111,7 @@ class TestShardedParity:
         tbl = np.asarray(table)
         assert tbl.max() < shard_pages * 4
 
+    @pytest.mark.slow
     def test_sampled_rows_decorrelated_across_shards(self, tiny_params):
         """With temperature>0, identical prompts placed in different shards
         must not produce identical tokens (the axis_index rng fold)."""
@@ -124,6 +128,7 @@ class TestShardedParity:
             np.array_equal(rows[0], rows[k]) for k in (2, 4, 6)
         )
 
+    @pytest.mark.slow
     def test_inflight_swap_reaches_all_shards(self, tiny_params):
         """push_lora (LoraMailbox) must swap the adapter on every dp shard:
         greedy outputs diverge from the no-swap run in rows of more than one
@@ -167,6 +172,7 @@ class TestShardedScanChunk:
     per-step sharded loop (the shard-local done.all() guard is per-device
     control flow; no collectives in the dp-only forward)."""
 
+    @pytest.mark.slow
     def test_greedy_parity_and_active(self, tiny_params):
         ids, mask = _prompts(8, seed=11)
         _, base = _engines(tiny_params)
@@ -177,6 +183,7 @@ class TestShardedScanChunk:
         np.testing.assert_array_equal(b.tokens, a.tokens)
         np.testing.assert_array_equal(b.lengths, a.lengths)
 
+    @pytest.mark.slow
     def test_sampled_parity_with_overshoot(self, tiny_params):
         """chunk=5 over 12 steps: the last chunk overshoots by 3 guarded
         steps; shard-decorrelated sampling must match the per-step loop."""

@@ -50,7 +50,11 @@ class TestRoleMeshes:
 
 
 class TestShardedForward:
-    @pytest.mark.parametrize("tp,fsdp,dp", [(2, 1, 4), (2, 2, 2), (4, 1, 2)])
+    @pytest.mark.parametrize("tp,fsdp,dp", [
+        pytest.param(2, 1, 4, marks=pytest.mark.slow),
+        (2, 2, 2),
+        (4, 1, 2),
+    ])
     def test_sharded_matches_single_device(self, tp, fsdp, dp):
         rng = jax.random.PRNGKey(0)
         params = init_params(rng, TINY)

@@ -88,6 +88,7 @@ class TestSamplingProbs:
 
 
 class TestAcceptanceDistribution:
+    @pytest.mark.slow
     def test_one_hot_rejection_sampling_is_unbiased(self):
         """The first emitted token's distribution must equal the target p
         regardless of what the draft proposes — the whole point of the
@@ -125,7 +126,11 @@ class TestAcceptanceDistribution:
 
 
 class TestSpecEngine:
-    @pytest.mark.parametrize("d", [1, 3, 4])
+    @pytest.mark.parametrize("d", [
+        pytest.param(1, marks=pytest.mark.slow),
+        3,
+        pytest.param(4, marks=pytest.mark.slow),
+    ])
     def test_greedy_identical_to_plain_refill(self, setup, d):
         params, ids, mask = setup
         cfg = SamplingConfig(max_tokens=12, temperature=0.0, n=2)
@@ -135,6 +140,7 @@ class TestSpecEngine:
         np.testing.assert_array_equal(spec.tokens, plain.tokens)
         np.testing.assert_array_equal(spec.lengths, plain.lengths)
 
+    @pytest.mark.slow
     def test_eos_truncates_within_draft_block(self, setup):
         """EOS anywhere inside an accepted draft block must end the row AT
         that token, exactly like plain decoding."""
@@ -152,6 +158,7 @@ class TestSpecEngine:
         np.testing.assert_array_equal(spec.tokens, plain.tokens)
         np.testing.assert_array_equal(spec.lengths, plain.lengths)
 
+    @pytest.mark.slow
     def test_sampling_emits_valid_rounds(self, setup):
         params, ids, mask = setup
         res = make_engine(spec_draft=3, slots=3).generate(
@@ -162,6 +169,7 @@ class TestSpecEngine:
         assert res.tokens.shape == (4, 2, 10)
         assert (res.lengths >= 1).all() and (res.lengths <= 10).all()
 
+    @pytest.mark.slow
     def test_repetitive_sequences_accept_drafts(self, setup):
         """On a forced-repetitive stream (greedy tiny models loop), the
         n-gram drafts must actually get ACCEPTED — the host dispatches
@@ -193,6 +201,7 @@ class TestSpecEngine:
 
 
 class TestSpecEdgeCases:
+    @pytest.mark.slow
     def test_near_budget_draft_writes_do_not_corrupt_cache(self):
         """Review regression: the verify forward writes d+1 KVs even when a
         row is within d tokens of its budget — those writes must land in
@@ -223,6 +232,7 @@ class TestSpecEdgeCases:
                 spec.tokens, plain.tokens, err_msg=f"seed {seed}"
             )
 
+    @pytest.mark.slow
     def test_small_batch_still_routes_through_spec(self, setup):
         """Review regression: total <= max_concurrent_rows must not silently
         fall back to the non-speculative wave path."""
@@ -236,6 +246,7 @@ class TestSpecEdgeCases:
 
 
 class TestSpecTrainerIntegration:
+    @pytest.mark.slow
     def test_trainer_round_on_speculative_engine(self):
         """A full trainer batch with the speculative refill engine as the
         rollout backend — config-flag wiring (--continuous_batching
@@ -297,6 +308,7 @@ class TestSpecTrainerIntegration:
         assert engine_kwargs_from_config(TrainConfig()) == {"kv_quant": "none"}
 
 
+@pytest.mark.slow
 class TestSchedulerFuzz:
     """Randomized configurations of the greedy-equality invariant: for ANY
     (slots, draft length, EOS set, prompt raggedness), wave, refill, and

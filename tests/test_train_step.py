@@ -47,6 +47,7 @@ def model():
 
 
 class TestGradAccum:
+    @pytest.mark.slow
     @pytest.mark.parametrize("learner_type", ["pg", "grpo"])
     def test_micro_size_invariance(self, model, learner_type):
         """One step with micro=8 must equal one step with micro=4 (same total
@@ -72,6 +73,7 @@ class TestGradAccum:
             ):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    @pytest.mark.slow
     def test_loss_sum_parity(self, model):
         """Returned loss = Σ unscaled microbatch losses (reference total_loss,
         distributed_actor.py:387–389)."""
@@ -103,6 +105,7 @@ class TestGradAccum:
 
 
 class TestSkipSemantics:
+    @pytest.mark.slow
     def test_all_zero_microbatch_contributes_nothing(self, model):
         base, lora = model
         rng = np.random.default_rng(2)
@@ -127,6 +130,7 @@ class TestSkipSemantics:
         for a, b in zip(jax.tree_util.tree_leaves(lora1), jax.tree_util.tree_leaves(lora2)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
 
+    @pytest.mark.slow
     def test_any_zero_bug_parity_mode(self, model):
         """skip_semantics='any_zero' reproduces the reference bug: one zero
         coeff poisons the whole microbatch (SURVEY §3.6.3)."""
@@ -240,6 +244,7 @@ class TestLoraDropout:
         opt = make_optimizer(1e-3, use_8bit=False)
         return base, lora, batch, opt
 
+    @pytest.mark.slow
     def test_dropout_changes_loss_and_zero_rate_does_not(self):
         import jax
         import numpy as np
@@ -276,6 +281,7 @@ class TestLearningDynamics:
     drive the (negative logprob-weighted) PG loss down — the de-facto
     integration check behind the reference's 'reward curve goes up' runs."""
 
+    @pytest.mark.slow
     def test_repeated_steps_reduce_pg_loss(self):
         import jax
         import jax.numpy as jnp
@@ -317,7 +323,11 @@ class TestTensorParallelStep:
     (parallel/partition.py), the batch shards over dp, and the LoRA update
     must equal the single-device step's."""
 
-    @pytest.mark.parametrize("tp,fsdp,dp", [(2, 1, 4), (2, 2, 2), (4, 2, 1)])
+    @pytest.mark.parametrize("tp,fsdp,dp", [
+        pytest.param(2, 1, 4, marks=pytest.mark.slow),
+        (2, 2, 2),
+        pytest.param(4, 2, 1, marks=pytest.mark.slow),
+    ])
     def test_tp_fsdp_sharded_step_matches_single_device(self, model, tp, fsdp, dp):
         from distrl_llm_tpu.parallel import param_specs, shard_tree
         from distrl_llm_tpu.parallel.mesh import _make_mesh

@@ -80,7 +80,9 @@ TRAIN_METRICS = {
 }
 
 
-@pytest.mark.parametrize("learner", ["pg", "grpo"])
+@pytest.mark.parametrize(
+    "learner", [pytest.param("pg", marks=pytest.mark.slow), "grpo"]
+)
 class TestTrainLoop:
     def test_end_to_end(self, learner):
         sink = MemorySink()
@@ -137,6 +139,7 @@ class TestRolloutPlumbing:
         r = cands[0]["rewards"][0]
         assert r.shape == (trainer.config.num_candidates, 2)
 
+    @pytest.mark.slow
     def test_engine_sees_latest_lora(self):
         """Weight sync is in-memory: the engine must receive the post-update
         adapter on the next round (replaces the adapter-file bus,
@@ -233,6 +236,7 @@ class TestCheckpointResume:
         # optimizer moments survive (the reference never saved them)
         assert int(resumed.opt_state.count) == int(trainer.opt_state.count) == 1
 
+    @pytest.mark.slow
     def test_finished_run_resumes_as_noop(self, tmp_path):
         """End-of-episode checkpoints store the NEXT episode to start, so
         resuming a completed run trains zero additional steps."""
@@ -285,6 +289,7 @@ class TestRewardClimb:
     8-bit Adam second moment collapsing to zero and exploding the adapter
     (see learner/optim.py module docstring)."""
 
+    @pytest.mark.slow
     def test_mean_reward_increases_over_training(self):
         import jax.numpy as jnp
 
@@ -327,6 +332,7 @@ class TestRewardClimb:
         late = float(np.mean(curve[-10:]))
         assert late > early * 1.15, f"reward did not climb: early={early} late={late}"
 
+    @pytest.mark.slow
     def test_custom_reward_fn_is_actually_used(self):
         """Regression: RewardComputer hardcoded the parity reward_function,
         silently dropping any custom fn passed to Trainer (the reference's

@@ -30,6 +30,7 @@ def reference(q, k, v, valid):
 
 
 class TestUlyssesAttention:
+    @pytest.mark.slow
     @pytest.mark.parametrize("sp", [1, 2])
     def test_matches_reference(self, sp):
         mesh = _make_mesh(jax.devices(), tp=1, sp=sp, fsdp=1)
@@ -39,6 +40,7 @@ class TestUlyssesAttention:
         ref = reference(q, k, v, valid)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow
     def test_mha_many_shards(self):
         """sp=8 with 8 MHA heads: one head per device after the scatter."""
         mesh = _make_mesh(jax.devices(), tp=1, sp=8, fsdp=1)
@@ -62,6 +64,7 @@ class TestUlyssesAttention:
             np.asarray(out)[real], np.asarray(ref)[real], atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_gradients_match_reference(self):
         mesh = _make_mesh(jax.devices(), tp=1, sp=2, fsdp=1)
         q, k, v = make_qkv(s=16, seed=3)
@@ -105,6 +108,7 @@ class TestUlyssesAttention:
 
 
 class TestUlyssesInModel:
+    @pytest.mark.slow
     def test_forward_matches_reference_impl(self):
         from distrl_llm_tpu.models import TINY, forward, init_lora_params, init_params
 
@@ -126,6 +130,7 @@ class TestUlyssesInModel:
             np.asarray(uly)[real], np.asarray(ref)[real], atol=2e-4, rtol=2e-4
         )
 
+    @pytest.mark.slow
     def test_train_step_matches_reference_impl(self):
         from distrl_llm_tpu.learner.optim import make_optimizer
         from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step

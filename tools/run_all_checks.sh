@@ -53,8 +53,25 @@ stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_native_tokenizer.py tests/test_native_spm.py \
   tests/test_config.py tests/test_cli.py tests/test_real_checkpoint.py
 # the slow tier (excluded from the default run by pytest.ini addopts):
-# heavyweight fuzz/parity/scale cases, incl. the 0.5B real-format load
-stage "suite_slow" timeout 1800 python -m pytest -q -m slow tests/
+# heavyweight fuzz/parity/scale cases. Chunked like the fast stages so one
+# stage timeout can't silently drop the back half of the tier.
+stage "suite_slow_engines" timeout 1200 python -m pytest -q -m slow \
+  tests/test_engine.py tests/test_paged.py tests/test_sharded_paged.py \
+  tests/test_inflight_updates.py
+stage "suite_slow_sched" timeout 1200 python -m pytest -q -m slow \
+  tests/test_speculative.py tests/test_paged_budget.py
+stage "suite_slow_learner" timeout 1200 python -m pytest -q -m slow \
+  tests/test_train_step.py tests/test_losses.py tests/test_clip_objective.py \
+  tests/test_full_finetune.py tests/test_quant.py tests/test_trainer.py \
+  tests/test_async_rollout.py tests/test_failure_and_resume.py
+stage "suite_slow_ops" timeout 1200 python -m pytest -q -m slow \
+  tests/test_ring_attention.py tests/test_ulysses.py tests/test_sampling.py \
+  tests/test_long_context.py tests/test_paged_int8_kernel.py \
+  tests/test_sharding.py tests/test_role_separation.py
+stage "suite_slow_io" timeout 1200 python -m pytest -q -m slow \
+  tests/test_from_pretrained.py tests/test_real_checkpoint.py \
+  tests/test_remote_engine.py tests/test_control_plane.py \
+  tests/test_model_golden.py
 
 echo "done: $fails failure(s)"
 exit $((fails > 0))
