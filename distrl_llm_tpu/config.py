@@ -57,16 +57,19 @@ class SamplingConfig:
         return dataclasses.replace(self, **kw)
 
 
-def parse_buckets(spec: str | None) -> tuple[int, ...]:
+def parse_buckets(
+    spec: str | None, field: str = "prompt_buckets"
+) -> tuple[int, ...]:
     """Parse a comma-separated bucket list ("128,256") into a tuple; shared
-    by the CLI and bench so the format cannot drift."""
+    by the CLI and bench so the format cannot drift. ``field`` names the
+    flag in the error message."""
     if not spec:
         return ()
     try:
         return tuple(int(x) for x in str(spec).split(",") if x.strip())
     except ValueError as e:
         raise ValueError(
-            f"prompt_buckets must be comma-separated integers, got {spec!r}"
+            f"{field} must be comma-separated integers, got {spec!r}"
         ) from e
 
 
@@ -171,6 +174,14 @@ class TrainConfig:
     # round compiles/runs at the smallest bucket holding its longest real
     # prompt. Empty = single bucket at max_prompt_tokens.
     prompt_buckets: tuple[int, ...] = ()
+    # answer length buckets for the LEARNER update step: each update runs at
+    # the smallest bucket holding the batch's longest real answer instead of
+    # always padding to max_new_tokens (the reference pads every row to the
+    # full window, distributed_actor.py:224–229 — ~60% wasted learner FLOPs
+    # at its own ~470-token mean). Exact semantics (trailing all-masked
+    # columns contribute nothing); one compiled step per bucket. Empty =
+    # single width at max_new_tokens.
+    learner_len_buckets: tuple[int, ...] = ()
     # rollout engine implementation: "dense" (fixed-shape cache), "paged"
     # (packed ragged KV pages + Pallas paged-attention decode — the full N1),
     # or "paged_sharded" (ONE paged engine whose page pool is partitioned
@@ -386,6 +397,17 @@ class TrainConfig:
             )
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if any(
+            b <= 0 or b > self.max_new_tokens for b in self.learner_len_buckets
+        ):
+            # same contract as the engine's prompt buckets (engine.py raises
+            # for out-of-range buckets): a bucket past max_new_tokens would
+            # silently clamp into a no-op while logging answer_width as if
+            # bucketing were active
+            raise ValueError(
+                f"learner_len_buckets must be in (0, max_new_tokens="
+                f"{self.max_new_tokens}], got {self.learner_len_buckets}"
+            )
         if self.number_of_learners <= 0:
             raise ValueError("need at least one learner")
         if self.number_of_actors < 0:

@@ -218,6 +218,7 @@ def prepare_update_batch(
     micro_size: int,
     mesh=None,
     raw_rollout: dict | None = None,
+    answer_buckets: "Sequence[int] | None" = None,
 ) -> UpdateBatch:
     """Host-side tokenize+pad to the fixed learner shapes.
 
@@ -225,6 +226,17 @@ def prepare_update_batch(
     prompts left-padded/truncated to max_prompt_tokens, answers right-padded/
     truncated to max_new_tokens. N is padded up to a multiple of micro_size
     with sample_mask-0 rows so the scan shape is static.
+
+    ``answer_buckets``: learner-side length bucketing (the engine's
+    prompt-bucket idea applied to the update step). The answer width is cut
+    to the smallest bucket holding the batch's LONGEST real answer instead
+    of always padding to max_new_tokens — the reference pads every row to
+    the full window (distributed_actor.py:224–229), which at its own ~470
+    mean generation length wastes ~60% of learner FLOPs on masked padding.
+    Dropping trailing all-masked columns is exact (masked positions
+    contribute zero loss and are causally invisible to real positions —
+    pinned by TestAnswerBuckets parity). One compiled step per bucket
+    width; buckets cap the recompile count.
 
     When ``mesh`` is given, every array is placed on it with the row dim over
     "dp" — the learner-mesh equivalent of the reference dispatching chunks to
@@ -264,6 +276,24 @@ def prepare_update_batch(
         answer_ids, answer_mask = encode_fixed(
             tokenizer, answers, max_new_tokens, side="right"
         )
+    if answer_buckets:
+        # smallest bucket holding the longest real answer (answers are
+        # right-padded, so trailing columns past it are all-masked and
+        # dropping them is exact); no bucket large enough → full width
+        lens = np.asarray(answer_mask).sum(axis=1)
+        need = max(1, int(lens.max()) if lens.size else 1)
+        width = min(
+            next(
+                (b for b in sorted(answer_buckets) if b >= need),
+                max_new_tokens,
+            ),
+            max_new_tokens,
+        )
+        if width < max_new_tokens:
+            answer_ids = np.asarray(answer_ids)[:, :width]
+            answer_mask = np.asarray(answer_mask)[:, :width]
+            if behavior_logps is not None:
+                behavior_logps = behavior_logps[:, :width]
     n = -(-max(n_real, 1) // micro_size) * micro_size
     pad = n - n_real
 

@@ -965,7 +965,11 @@ class Trainer:
                 micro_size=cfg.train_batch_size,
                 mesh=self.meshes.learner if self.meshes is not None else None,
                 raw_rollout=raw if cfg.clip_ratio > 0.0 else None,
+                answer_buckets=cfg.learner_len_buckets or None,
             )
+            # visibility: which width this update compiled/ran at (equals
+            # max_new_tokens unless learner_len_buckets cut it)
+            answer_width = int(update.answer_ids.shape[1])
             self.lora, self.opt_state, loss = self.train_step(
                 self.lora, self.opt_state,
                 None if self._full else self.base_params_learner, update,
@@ -1002,6 +1006,8 @@ class Trainer:
             "total_batch_steps": self.total_batch_steps,
             "total_samples_processed": self.total_samples_processed,
         }
+        if cfg.learner_len_buckets:
+            metrics["learner/answer_width"] = answer_width
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())
         self.sink.log(metrics, step=self.total_batch_steps)

@@ -52,6 +52,11 @@ def test_config_roundtrip():
     assert cfg.max_seq_length == 1550  # 350 + 1200 (distributed_actor.py:25)
 
 
+def test_learner_len_buckets_flag():
+    args = build_parser().parse_args(["--learner_len_buckets", "256,512"])
+    assert config_from_args(args).learner_len_buckets == (256, 512)
+
+
 def test_invalid_learner_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["--learner", "ppo"])

@@ -40,6 +40,10 @@ class TestTrainConfig:
         with pytest.raises(ValueError):
             TrainConfig(learner="ppo")
 
+    def test_learner_len_buckets_must_be_positive(self):
+        with pytest.raises(ValueError, match="learner_len_buckets"):
+            TrainConfig(learner_len_buckets=(256, 0))
+
     def test_mesh_roles_sync(self):
         c = TrainConfig(number_of_actors=4, number_of_learners=2)
         assert c.mesh.number_of_actors == 4

@@ -212,6 +212,106 @@ class TestPrepareUpdateBatch:
         np.testing.assert_array_equal(np.asarray(batch.answer_ids)[0], expected)
 
 
+class TestAnswerBuckets:
+    """learner_len_buckets (the engine's prompt-bucket idea on the update
+    step): each update runs at the smallest bucket holding the batch's
+    longest real answer — and the truncation is EXACT, because trailing
+    all-masked columns contribute nothing to the loss and are causally
+    invisible to real positions. Reference contrast: distributed_actor.py
+    :224–229 pads every row to the full window."""
+
+    def test_bucket_selection_and_slicing(self):
+        tok = FakeTok()
+        batch = prepare_update_batch(
+            tok, ["pp", "q"], ["abc", "abcdef"], np.array([1.0, 1.0]),
+            max_prompt_tokens=8, max_new_tokens=32, micro_size=2,
+            answer_buckets=(4, 8, 16),
+        )
+        # longest real answer = 6 tokens -> bucket 8
+        assert batch.answer_ids.shape == (2, 8)
+        assert batch.answer_mask.shape == (2, 8)
+        np.testing.assert_array_equal(
+            np.asarray(batch.answer_mask).sum(axis=1), [3, 6]
+        )
+
+    def test_no_bucket_large_enough_falls_back_to_full_width(self):
+        tok = FakeTok()
+        batch = prepare_update_batch(
+            tok, ["p"], ["abcdefghijkl"], np.array([1.0]),
+            max_prompt_tokens=8, max_new_tokens=16, micro_size=1,
+            answer_buckets=(4, 8),
+        )
+        assert batch.answer_ids.shape == (1, 16)
+
+    def test_raw_rollout_path_slices_behavior_logps(self):
+        tok = FakeTok()
+        rng = np.random.default_rng(0)
+        t_eng = 32
+        raw = {
+            "answer_tokens": rng.integers(1, 100, (2, t_eng)),
+            "behavior_logps": rng.normal(size=(2, t_eng)).astype(np.float32),
+            "lengths": np.array([3, 6]),
+        }
+        batch = prepare_update_batch(
+            tok, ["p", "q"], ["", ""], np.array([1.0, 1.0]),
+            max_prompt_tokens=8, max_new_tokens=t_eng, micro_size=2,
+            raw_rollout=raw, answer_buckets=(8,),
+        )
+        assert batch.answer_ids.shape == (2, 8)
+        assert batch.behavior_logps.shape == (2, 8)
+        np.testing.assert_allclose(
+            np.asarray(batch.behavior_logps)[1, :6],
+            raw["behavior_logps"][1, :6],
+        )
+
+    def test_loss_and_update_exactly_match_full_width(self):
+        """The headline property: a bucketed step must produce the SAME
+        loss and the SAME updated adapter as the full-width step (masked
+        trailing columns are pure padding)."""
+        import jax
+
+        from distrl_llm_tpu.learner.optim import make_optimizer
+        from distrl_llm_tpu.learner.train_step import (
+            UpdateBatch, make_train_step,
+        )
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+
+        base = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        rng = np.random.default_rng(0)
+        n, p_len, t_full, t_cut = 4, 8, 16, 8
+        lens = np.array([3, 8, 5, 1])
+        answer_mask_full = (
+            np.arange(t_full)[None, :] < lens[:, None]
+        ).astype(np.int32)
+        full = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, p_len)), jnp.int32),
+            prompt_mask=jnp.ones((n, p_len), jnp.int32),
+            answer_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, t_full)), jnp.int32),
+            answer_mask=jnp.asarray(answer_mask_full),
+            coeffs=jnp.asarray(rng.normal(size=n), jnp.float32),
+            sample_mask=jnp.ones((n,), jnp.float32),
+        )
+        cut = full._replace(
+            answer_ids=full.answer_ids[:, :t_cut],
+            answer_mask=full.answer_mask[:, :t_cut],
+        )
+        opt = make_optimizer(1e-2, use_8bit=False)
+        step = make_train_step(
+            TINY, learner_type="grpo", optimizer=opt, lora_scale=0.5,
+            micro_size=2, remat=False, donate=False, logit_chunk=4,
+        )
+        lora_f, _, loss_f = step(lora, opt.init(lora), base, full)
+        lora_c, _, loss_c = step(lora, opt.init(lora), base, cut)
+        assert float(loss_c) == pytest.approx(float(loss_f), abs=1e-5)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(lora_f), jax.tree_util.tree_leaves(lora_c)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            )
+
+
 class TestLoraDropout:
     """lora_dropout is implemented, not a dead flag (VERDICT r1 weak #5):
     peft-style adapter-input dropout in the learner forward."""

@@ -112,6 +112,10 @@ bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
 #    (fetch-timed — the tunnel's block_until_ready lies)
 bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
 bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
+# learner length bucketing (--learner_len_buckets): the step cost at t=512,
+# the bucket a ~470-token-mean batch (the reference's own distribution)
+# runs at, vs the always-pad-to-1200 stages above
+bench learner_b512 /tmp/bench_tpu_learner_b512.json BENCH_MODE=learner BENCH_MAX_NEW=512
 # 7. scheduler headline at realistic length variance (mean ~1/0.002 = 500
 #    of 1200 tokens ≈ the reference's ~470 mean): refill keeps slots busy
 bench refill_eos /tmp/bench_tpu_refill_eos.json \
@@ -163,8 +167,8 @@ all_done() {
   for n in dense paged refill_eos learner kernel_check dense_mw dense_int8 \
            dense_int8_mw dense_scan dense_scan_int8 refill_scan waves_eos \
            dense_eos spec spec_scan budget int8kv \
-           learner_flash dispatch_probe sampler_probe mem_envelope \
-           qwen7b_int4 train_curve; do
+           learner_flash learner_b512 dispatch_probe sampler_probe \
+           mem_envelope qwen7b_int4 train_curve; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done
   return 0

@@ -139,6 +139,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
                         "always included)")
+    p.add_argument("--learner_len_buckets", type=str, default="",
+                   help="comma-separated ANSWER length buckets for the "
+                        "learner update step, e.g. 256,512: each update "
+                        "runs at the smallest bucket holding the batch's "
+                        "longest real answer instead of padding every row "
+                        "to max_new_tokens (exact semantics; one compiled "
+                        "step per bucket)")
     p.add_argument("--top_p_exact", action="store_true",
                    help="exact sort-based nucleus filter (reference vLLM "
                         "semantics) instead of the fast bisection filter")
@@ -166,6 +173,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     from distrl_llm_tpu.config import parse_buckets
 
     fields["prompt_buckets"] = parse_buckets(args.prompt_buckets)
+    fields["learner_len_buckets"] = parse_buckets(
+        args.learner_len_buckets, field="learner_len_buckets"
+    )
     fields["rollout_workers"] = tuple(
         w.strip() for w in str(args.rollout_workers or "").split(",") if w.strip()
     )
