@@ -182,6 +182,13 @@ class TrainConfig:
     # columns contribute nothing); one compiled step per bucket. Empty =
     # single width at max_new_tokens.
     learner_len_buckets: tuple[int, ...] = ()
+    # the same cut on the learner's LEFT-padded prompt side (leading
+    # all-masked columns dropped). Deliberately a SEPARATE flag from the
+    # engine's prompt_buckets: the learner slice shifts absolute RoPE
+    # positions (exact only up to float round-off — relative distances are
+    # unchanged) and multiplies compiled step widths, so it must be an
+    # explicit opt-in rather than riding an engine knob.
+    learner_prompt_buckets: tuple[int, ...] = ()
     # rollout engine implementation: "dense" (fixed-shape cache), "paged"
     # (packed ragged KV pages + Pallas paged-attention decode — the full N1),
     # or "paged_sharded" (ONE paged engine whose page pool is partitioned
@@ -407,6 +414,14 @@ class TrainConfig:
             raise ValueError(
                 f"learner_len_buckets must be in (0, max_new_tokens="
                 f"{self.max_new_tokens}], got {self.learner_len_buckets}"
+            )
+        if any(
+            b <= 0 or b > self.max_prompt_tokens
+            for b in self.learner_prompt_buckets
+        ):
+            raise ValueError(
+                f"learner_prompt_buckets must be in (0, max_prompt_tokens="
+                f"{self.max_prompt_tokens}], got {self.learner_prompt_buckets}"
             )
         if self.number_of_learners <= 0:
             raise ValueError("need at least one learner")

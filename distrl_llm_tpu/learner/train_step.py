@@ -207,6 +207,16 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def _bucket_width(mask, buckets, cap: int) -> int:
+    """Smallest bucket holding the longest real row of ``mask`` (row length
+    = mask sum), capped at ``cap``; ``cap`` when no bucket is large enough.
+    The single owner of the learner-side bucket-selection rule (the engine's
+    ``bucket_for`` is the same rule over its own bucket list)."""
+    lens = np.asarray(mask).sum(axis=1)
+    need = max(1, int(lens.max()) if lens.size else 1)
+    return min(next((b for b in sorted(buckets) if b >= need), cap), cap)
+
+
 def prepare_update_batch(
     tokenizer,
     problems: list[str],
@@ -219,6 +229,7 @@ def prepare_update_batch(
     mesh=None,
     raw_rollout: dict | None = None,
     answer_buckets: "Sequence[int] | None" = None,
+    prompt_buckets: "Sequence[int] | None" = None,
 ) -> UpdateBatch:
     """Host-side tokenize+pad to the fixed learner shapes.
 
@@ -238,6 +249,15 @@ def prepare_update_batch(
     pinned by TestAnswerBuckets parity). One compiled step per bucket
     width; buckets cap the recompile count.
 
+    ``prompt_buckets``: the same cut on the LEFT-padded prompt side
+    (leading all-masked columns dropped to the smallest bucket holding the
+    longest real prompt) — reuses the engine's prompt-bucket config, since
+    learner prompts are the same strings the engine saw. Equality here is
+    up to RoPE float round-off rather than bit-exact: dropping k leading
+    columns shifts every position in a row by the same constant, and RoPE
+    attention depends only on relative distance (the same invariance the
+    left-padded golden test pins), but the absolute angles differ.
+
     When ``mesh`` is given, every array is placed on it with the row dim over
     "dp" — the learner-mesh equivalent of the reference dispatching chunks to
     learner processes (distributed_trainer.py:312–327).
@@ -248,6 +268,14 @@ def prepare_update_batch(
     prompt_ids, prompt_mask = encode_fixed(
         tokenizer, problems, max_prompt_tokens, side="left"
     )
+    if prompt_buckets:
+        # prompts are LEFT-padded: keep the trailing `width` columns
+        # (leading all-masked columns are pure padding — exactly the
+        # engine's bucket slice, engine.py::_generate_wave)
+        p_width = _bucket_width(prompt_mask, prompt_buckets, max_prompt_tokens)
+        if p_width < max_prompt_tokens:
+            prompt_ids = np.asarray(prompt_ids)[:, -p_width:]
+            prompt_mask = np.asarray(prompt_mask)[:, -p_width:]
     behavior_logps = None
     if raw_rollout is not None:
         # PPO-clip path: train on the ENGINE'S token ids (retokenizing the
@@ -280,15 +308,7 @@ def prepare_update_batch(
         # smallest bucket holding the longest real answer (answers are
         # right-padded, so trailing columns past it are all-masked and
         # dropping them is exact); no bucket large enough → full width
-        lens = np.asarray(answer_mask).sum(axis=1)
-        need = max(1, int(lens.max()) if lens.size else 1)
-        width = min(
-            next(
-                (b for b in sorted(answer_buckets) if b >= need),
-                max_new_tokens,
-            ),
-            max_new_tokens,
-        )
+        width = _bucket_width(answer_mask, answer_buckets, max_new_tokens)
         if width < max_new_tokens:
             answer_ids = np.asarray(answer_ids)[:, :width]
             answer_mask = np.asarray(answer_mask)[:, :width]

@@ -966,10 +966,12 @@ class Trainer:
                 mesh=self.meshes.learner if self.meshes is not None else None,
                 raw_rollout=raw if cfg.clip_ratio > 0.0 else None,
                 answer_buckets=cfg.learner_len_buckets or None,
+                prompt_buckets=cfg.learner_prompt_buckets or None,
             )
-            # visibility: which width this update compiled/ran at (equals
-            # max_new_tokens unless learner_len_buckets cut it)
+            # visibility: which widths this update compiled/ran at (equal
+            # the max_* caps unless the learner buckets cut them)
             answer_width = int(update.answer_ids.shape[1])
+            prompt_width = int(update.prompt_ids.shape[1])
             self.lora, self.opt_state, loss = self.train_step(
                 self.lora, self.opt_state,
                 None if self._full else self.base_params_learner, update,
@@ -1008,6 +1010,8 @@ class Trainer:
         }
         if cfg.learner_len_buckets:
             metrics["learner/answer_width"] = answer_width
+        if cfg.learner_prompt_buckets:
+            metrics["learner/prompt_width"] = prompt_width
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())
         self.sink.log(metrics, step=self.total_batch_steps)

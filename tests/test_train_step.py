@@ -264,6 +264,61 @@ class TestAnswerBuckets:
             raw["behavior_logps"][1, :6],
         )
 
+    def test_prompt_bucket_slices_left_padded_side(self):
+        tok = FakeTok()
+        batch = prepare_update_batch(
+            tok, ["abc", "abcdef"], ["x", "y"], np.array([1.0, 1.0]),
+            max_prompt_tokens=32, max_new_tokens=4, micro_size=2,
+            prompt_buckets=(8, 16),
+        )
+        # longest real prompt = 6 -> bucket 8; left padding: real ids at END
+        assert batch.prompt_ids.shape == (2, 8)
+        pm = np.asarray(batch.prompt_mask)
+        np.testing.assert_array_equal(pm.sum(axis=1), [3, 6])
+        assert pm[0, -1] == 1 and pm[0, 0] == 0
+
+    def test_prompt_bucket_loss_matches_full_width(self):
+        """Dropping leading all-masked prompt columns shifts every position
+        in a row by the same constant; RoPE attention depends on relative
+        distance only, so the step must agree with the full-width step up
+        to float round-off."""
+        import jax
+
+        from distrl_llm_tpu.learner.optim import make_optimizer
+        from distrl_llm_tpu.learner.train_step import (
+            UpdateBatch, make_train_step,
+        )
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+
+        base = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        rng = np.random.default_rng(0)
+        n, p_full, p_cut, t_len = 4, 16, 8, 4
+        p_lens = np.array([3, 8, 5, 1])
+        pmask_full = (
+            np.arange(p_full)[None, :] >= p_full - p_lens[:, None]
+        ).astype(np.int32)  # left-padded
+        full = UpdateBatch(
+            prompt_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, p_full)), jnp.int32),
+            prompt_mask=jnp.asarray(pmask_full),
+            answer_ids=jnp.asarray(rng.integers(1, TINY.vocab_size, (n, t_len)), jnp.int32),
+            answer_mask=jnp.ones((n, t_len), jnp.int32),
+            coeffs=jnp.asarray(rng.normal(size=n), jnp.float32),
+            sample_mask=jnp.ones((n,), jnp.float32),
+        )
+        cut = full._replace(
+            prompt_ids=full.prompt_ids[:, -p_cut:],
+            prompt_mask=full.prompt_mask[:, -p_cut:],
+        )
+        opt = make_optimizer(1e-2, use_8bit=False)
+        step = make_train_step(
+            TINY, learner_type="grpo", optimizer=opt, lora_scale=0.5,
+            micro_size=2, remat=False, donate=False, logit_chunk=4,
+        )
+        _, _, loss_f = step(lora, opt.init(lora), base, full)
+        _, _, loss_c = step(lora, opt.init(lora), base, cut)
+        assert float(loss_c) == pytest.approx(float(loss_f), abs=2e-5)
+
     def test_loss_and_update_exactly_match_full_width(self):
         """The headline property: a bucketed step must produce the SAME
         loss and the SAME updated adapter as the full-width step (masked

@@ -146,6 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "longest real answer instead of padding every row "
                         "to max_new_tokens (exact semantics; one compiled "
                         "step per bucket)")
+    p.add_argument("--learner_prompt_buckets", type=str, default="",
+                   help="comma-separated PROMPT length buckets for the "
+                        "learner update step (left-padded side; exact up "
+                        "to RoPE float round-off). Separate from "
+                        "--prompt_buckets, which only shapes the rollout "
+                        "engine")
     p.add_argument("--top_p_exact", action="store_true",
                    help="exact sort-based nucleus filter (reference vLLM "
                         "semantics) instead of the fast bisection filter")
@@ -175,6 +181,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     fields["prompt_buckets"] = parse_buckets(args.prompt_buckets)
     fields["learner_len_buckets"] = parse_buckets(
         args.learner_len_buckets, field="learner_len_buckets"
+    )
+    fields["learner_prompt_buckets"] = parse_buckets(
+        args.learner_prompt_buckets, field="learner_prompt_buckets"
     )
     fields["rollout_workers"] = tuple(
         w.strip() for w in str(args.rollout_workers or "").split(",") if w.strip()
