@@ -776,6 +776,12 @@ class Trainer:
             cand["answer_tokens"] = [result.tokens[i] for i in range(b_real)]
             cand["behavior_logps"] = [result.logprobs[i] for i in range(b_real)]
             cand["gen_lengths"] = [result.lengths[i] for i in range(b_real)]
+        # snapshot pool telemetry HERE, on the thread that ran the round:
+        # with async_rollout the next round (or an eval) may overwrite the
+        # engine's shared attribute before _train_batch logs metrics
+        pool = getattr(self.engine, "last_pool_stats", None)
+        if pool:
+            cand["pool_stats"] = dict(pool)
         return [cand]
 
     def _compute_round_rewards(self, candidates: list[dict[str, Any]]) -> None:
@@ -1012,6 +1018,17 @@ class Trainer:
             metrics["learner/answer_width"] = answer_width
         if cfg.learner_prompt_buckets:
             metrics["learner/prompt_width"] = prompt_width
+        # budgeted-pool observability (vLLM's gpu_cache_usage-style
+        # telemetry): page pressure + preemption count, snapshotted by
+        # _generate_round on the thread that ran THIS round (reading the
+        # engine attribute here would race async rollout / eval rounds)
+        pool = next(
+            (c["pool_stats"] for c in candidates if "pool_stats" in c), None
+        )
+        if pool:
+            metrics["pool/pages"] = pool.get("pool_pages")
+            metrics["pool/peak_pages_used"] = pool.get("peak_pages_used")
+            metrics["pool/preemptions"] = pool.get("preemptions")
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())
         self.sink.log(metrics, step=self.total_batch_steps)

@@ -101,6 +101,9 @@ class TestTrainerWithBudgetedEngine:
         assert eng.last_pool_stats["preemptions"] > 0, eng.last_pool_stats
         recs = [m for _, m in sink.records if "loss" in m]
         assert recs and np.isfinite(recs[-1]["loss"])
+        # budgeted-pool telemetry flows into the logged metrics
+        assert recs[-1]["pool/preemptions"] > 0
+        assert recs[-1]["pool/pages"] == 6
 
 
 class TestPagePool:
