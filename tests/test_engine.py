@@ -354,6 +354,33 @@ class TestScanChunk:
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
 
+    def test_structural_swap_rebuilds_chunk_program(self, setup):
+        """ADVICE r3 regression: an in-flight swap to a STRUCTURALLY
+        different adapter (None-adapter round receiving its first adapter)
+        lands at a chunk boundary; the chunk program is a compiled
+        executable that raises on structure change instead of retracing —
+        the swap-aware step must refetch from the signature-keyed cache.
+        Pushing before generate makes the boundary deterministic (step 0)."""
+        from distrl_llm_tpu.models import init_lora_params
+
+        params, ids, mask = setup
+        _, chunked = self._pair(scan_chunk=3, max_new=6)
+        adapter = init_lora_params(jax.random.PRNGKey(5), TINY, rank=4)
+        chunked.push_lora(adapter)
+        sc = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        out = chunked.generate(
+            params, None, ids, mask, sc, jax.random.PRNGKey(0)
+        )
+        assert chunked.last_swap_steps == [0]
+        assert chunked.scan_chunk_active
+        # the swap really took effect: output matches a round that passed
+        # the adapter directly (greedy, same rng)
+        direct, _ = self._pair(scan_chunk=3, max_new=6)
+        want = direct.generate(
+            params, adapter, ids, mask, sc, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(out.tokens, want.tokens)
+
     @pytest.mark.slow
     def test_sampled_parity_with_overshoot_and_logprobs(self, setup):
         """chunk=4 over max_new=6: the second chunk overshoots by 2 guarded

@@ -543,6 +543,26 @@ class TestRefillScanChunk:
         np.testing.assert_array_equal(base.lengths, chunked.lengths)
 
     @pytest.mark.slow
+    def test_structural_swap_rebuilds_chunk_program(self, setup4):
+        """ADVICE r3 regression (refill flavor): the None->first-adapter
+        in-flight swap lands at a k-aligned dispatch; the compiled chunk
+        program must be refetched for the new signature, not crash."""
+        from distrl_llm_tpu.models import init_lora_params
+
+        params, ids, mask = setup4
+        adapter = init_lora_params(jax.random.PRNGKey(5), TINY, rank=4)
+        cfg = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        eng = make_refill(slots=2, scan_chunk=16)
+        eng.push_lora(adapter)
+        out = eng.generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        assert eng.last_swap_steps == [0]
+        assert eng.scan_chunk_active
+        want = make_refill(slots=2, scan_chunk=16).generate(
+            params, adapter, ids, mask, cfg, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(out.tokens, want.tokens)
+
+    @pytest.mark.slow
     def test_sampled_parity_with_eos_and_logprobs(self, setup4):
         """EOS mid-round frees slots for refills; sampled tokens, lengths
         and captured behavior logprobs must match the per-step loop."""
