@@ -277,6 +277,7 @@ class TestAnswerBuckets:
         np.testing.assert_array_equal(pm.sum(axis=1), [3, 6])
         assert pm[0, -1] == 1 and pm[0, 0] == 0
 
+    @pytest.mark.slow
     def test_prompt_bucket_loss_matches_full_width(self):
         """Dropping leading all-masked prompt columns shifts every position
         in a row by the same constant; RoPE attention depends on relative
@@ -319,6 +320,7 @@ class TestAnswerBuckets:
         _, _, loss_c = step(lora, opt.init(lora), base, cut)
         assert float(loss_c) == pytest.approx(float(loss_f), abs=2e-5)
 
+    @pytest.mark.slow
     def test_loss_and_update_exactly_match_full_width(self):
         """The headline property: a bucketed step must produce the SAME
         loss and the SAME updated adapter as the full-width step (masked
