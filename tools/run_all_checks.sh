@@ -24,8 +24,12 @@ stage "bench_fallback" env JAX_PLATFORMS=cpu BENCH_MODEL=tiny BENCH_PROMPTS=4 \
   timeout 600 python bench.py
 
 if [ "${1:-}" = "--quick" ]; then
-  stage "suite_quick" timeout 600 python -m pytest \
-    tests/test_paged_budget.py tests/test_config.py -q
+  # representative post-tiering mix: budget accounting + config + one
+  # engine-parity and one learner-parity anchor from the default tier
+  stage "suite_quick" timeout 600 python -m pytest -q \
+    tests/test_paged_budget.py tests/test_config.py \
+    "tests/test_paged.py::TestPagedEngine::test_greedy_matches_dense_engine" \
+    "tests/test_train_step.py::TestDataParallelStep"
   echo "quick done: $fails failure(s)"; exit $((fails > 0))
 fi
 
