@@ -389,12 +389,34 @@ def main() -> int:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_FALLBACK_ERROR"] = err or "unknown"
-        # full rollout volume on CPU would take hours — shrink honestly
-        env.setdefault("BENCH_MODEL", "tiny")
-        env.setdefault("BENCH_PROMPTS", "4")
-        env.setdefault("BENCH_CANDIDATES", "2")
-        env.setdefault("BENCH_MAX_PROMPT", "32")
-        env.setdefault("BENCH_MAX_NEW", "32")
+        # PINNED fallback config (VERDICT r4 weak #6): cross-round CPU
+        # fallback numbers were ±15% noise at differing tiny volumes
+        # (r4: 204 total tokens, 0.03 s timed). The pinned run decodes a
+        # DETERMINISTIC 8×4×128 = 4096 tokens (EOS unreachable), through
+        # the production engine path (paged+refill engaged at cap 16,
+        # scan-chunk 16, int8 KV, multiway top-p), timed over 3 repeats —
+        # so a windowless round still tracks engine-efficiency regressions.
+        # Same volume ≈ 0.6 s timed vs r4's 0.03 s. Rerunning any round's
+        # bench.py under a dead tunnel reproduces this exact config
+        # (recorded as "fallback_config" in the JSON line).
+        pinned = {
+            "BENCH_MODEL": "tiny", "BENCH_PROMPTS": "8",
+            "BENCH_CANDIDATES": "4", "BENCH_MAX_PROMPT": "64",
+            "BENCH_MAX_NEW": "128", "BENCH_ENGINE": "paged",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "16",
+            "BENCH_SCAN_CHUNK": "16", "BENCH_KV_QUANT": "int8",
+            "BENCH_TOP_P_IMPL": "bisect_mw", "BENCH_NO_EOS": "1",
+            "BENCH_REPEATS": "3",
+        }
+        # caller-set knobs win (setdefault) but then the record must NOT
+        # claim the pinned config — label it with what diverged instead
+        overridden = sorted(k for k in pinned if k in env)
+        for k, v in pinned.items():
+            env.setdefault(k, v)
+        env["BENCH_FALLBACK_CONFIG"] = (
+            "pinned-v1" if not overridden
+            else "custom:" + ",".join(overridden)
+        )
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
     import jax
@@ -504,7 +526,11 @@ def main() -> int:
     # ~rate of the vocab makes stops ~geometric with mean ~1/rate, the
     # realistic shape (reference rollouts average ~470 of 1200 tokens).
     eos_rate = float(os.environ.get("BENCH_EOS_RATE", "0"))
-    if eos_rate > 0:
+    if os.environ.get("BENCH_NO_EOS") == "1":
+        # unreachable id: every row decodes exactly max_new tokens, making
+        # the benched volume deterministic (the pinned fallback's contract)
+        eos_ids = [-1]
+    elif eos_rate > 0:
         eos_rng = np.random.default_rng(42)
         n_eos = max(1, round(eos_rate * cfg.vocab_size))
         eos_ids = eos_rng.choice(cfg.vocab_size, size=n_eos, replace=False).tolist()
@@ -554,16 +580,40 @@ def main() -> int:
 
     importlib.import_module("distrl_llm_tpu.ops.paged").dispatch_choices.clear()
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
-    result, dt = run(1)
-    # random weights rarely emit EOS, so rows typically decode max_new tokens;
-    # count actual generated lengths to stay correct if that changes
-    total_tokens = int(result.lengths.sum())
+    # BENCH_REPEATS > 1 (the pinned fallback sets 3): sum tokens over N
+    # timed runs so sub-second CPU measurements aren't dominated by
+    # single-run jitter
+    repeats = max(int(os.environ.get("BENCH_REPEATS", "1")), 1)
+    timed = []
+    total_tokens = 0
+    sum_steps = sum_alive = 0
+    have_steps = have_alive = True
+    for i in range(repeats):
+        result, dt_i = run(1 + i)
+        timed.append(dt_i)
+        # random weights rarely emit EOS, so rows typically decode max_new
+        # tokens; count actual generated lengths to stay correct if not
+        total_tokens += int(result.lengths.sum())
+        if result.steps_dispatched is None:
+            have_steps = False
+        else:
+            sum_steps += result.steps_dispatched
+        if getattr(result, "alive_slot_steps", None) is None:
+            have_alive = False
+        else:
+            sum_alive += result.alive_slot_steps
+    steps_dispatched = sum_steps if have_steps else None
+    alive_slot_steps = sum_alive if have_alive else None
+    dt = sum(timed)
     tps = total_tokens / dt
     n_chips = max(jax.device_count(), 1)
     tps_chip = tps / n_chips
 
     mean_prompt_len = float(pmask.sum(axis=1).mean())
-    mean_new = float(result.lengths.mean())  # lengths is [B, n] per-candidate
+    # mean over ALL repeats' candidates (the last run alone can be a
+    # length outlier under EOS sampling, skewing mfu/roofline vs the
+    # all-repeats tps numerator)
+    mean_new = total_tokens / (n_prompts * n_cand * repeats)
     mean_kv = mean_prompt_len + mean_new / 2.0  # KV grows linearly over decode
     flops_per_token = _decode_flops_per_token(cfg, mean_kv)
     mfu = tps_chip * flops_per_token / (peak_tflops * 1e12)
@@ -590,18 +640,18 @@ def main() -> int:
     # realized speculation: mean tokens emitted per slot per dispatched step
     # (1.0 = plain decode; > 1 = drafts being accepted)
     accept_rate = None
-    if getattr(result, "alive_slot_steps", None):
+    if alive_slot_steps:
         # divide by alive-slot-steps, not steps*slots: during the refill
         # drain tail many slots are idle while steps still dispatch, and the
         # constant-slot denominator understates realized acceptance
-        accept_rate = round(total_tokens / result.alive_slot_steps, 3)
-    elif result.steps_dispatched:
+        accept_rate = round(total_tokens / alive_slot_steps, 3)
+    elif steps_dispatched:
         slots = min(
             engine.max_concurrent_rows or n_prompts * n_cand,
             n_prompts * n_cand,
         )
         accept_rate = round(
-            total_tokens / (result.steps_dispatched * slots), 3
+            total_tokens / (steps_dispatched * slots), 3
         )
     # bandwidth roofline at this config's slot count and mean context;
     # speculative runs raise the ceiling by their realized accept rate so
@@ -644,6 +694,13 @@ def main() -> int:
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,
         "decode_seconds": round(dt, 2),
+        "repeats": repeats,
+        "decode_seconds_each": [round(t, 3) for t in timed],
+        # engine-internal counters, summed over repeats (VERDICT r4 weak
+        # #6): efficiency regressions show up as dispatch/step-count drift
+        # even when wall-clock is noisy
+        "steps_dispatched": steps_dispatched,
+        "alive_slot_steps": alive_slot_steps,
         "compile_plus_first_run_seconds": round(compile_dt, 2),
         "chips": n_chips,
         "flops_per_token_gflop": round(flops_per_token / 1e9, 6),
@@ -661,8 +718,15 @@ def main() -> int:
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "
                          "model is recorded in 'model'",
     }
+    if os.environ.get("BENCH_FALLBACK_CONFIG"):
+        # names the pinned config so cross-round fallback rows are known
+        # directly comparable (same volume, engine path, and repeats)
+        record["fallback_config"] = os.environ["BENCH_FALLBACK_CONFIG"]
     if fallback_err:
-        record["error"] = f"TPU backend unavailable ({fallback_err}); CPU fallback at reduced volume"
+        record["error"] = (
+            f"TPU backend unavailable ({fallback_err}); "
+            "pinned CPU fallback (fixed volume; see fallback_config)"
+        )
         record["vs_baseline"] = 0.0
     _emit(record)
     return 0

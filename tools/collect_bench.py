@@ -1,4 +1,4 @@
-"""Sweep on-chip artifacts from /tmp into benchmarks/r4/ and print the
+"""Sweep on-chip artifacts from /tmp into benchmarks/r5/ and print the
 BASELINE.md table rows for whatever has landed so far.
 
 Run after (or during) a TPU window: copies every /tmp/bench_tpu_*.json
@@ -14,7 +14,7 @@ import shutil
 import sys
 
 REPO = os.path.join(os.path.dirname(__file__), "..")
-DEST = os.path.join(REPO, "benchmarks", "r4")
+DEST = os.path.join(REPO, "benchmarks", "r5")
 
 LOGS = [
     "/tmp/tpu_kernel_tests.log",

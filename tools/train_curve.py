@@ -55,9 +55,21 @@ class _StreamingSink:
         self._jsonl.finish()
 
 
+def _is_eval_record(r: dict) -> bool:
+    # the reference's eval/ namespace (pass@1 / BoN,
+    # distributed_trainer.py:412–415)
+    return any(k.startswith("eval/") for k in r)
+
+
+def _is_curve_record(r: dict) -> bool:
+    # train-step records carry the reference's reward name; eval records
+    # the eval/ namespace — both belong in the curve artifact
+    return "mean_accuracy_reward" in r or _is_eval_record(r)
+
+
 def _read_partial(path: str) -> list[dict]:
-    """Parse the accumulated stream back: train-step records sorted by
-    _step. This is the artifact source of truth for resuming runs — the
+    """Parse the accumulated stream back: train-step + eval records sorted
+    by _step. This is the artifact source of truth for resuming runs — the
     in-process sink only saw the steps trained SINCE the last resume."""
     recs = []
     if os.path.exists(path):
@@ -67,7 +79,7 @@ def _read_partial(path: str) -> list[dict]:
                     r = json.loads(line)
                 except ValueError:
                     continue
-                if "mean_accuracy_reward" in r:
+                if _is_curve_record(r):
                     recs.append(r)
     recs.sort(key=lambda r: r.get("_step", 0))
     return recs
@@ -85,7 +97,12 @@ def _train_collect(trainer, sink):
     except BaseException as e:  # noqa: BLE001 — partial curve > no curve
         completed = False
         print(f"training interrupted after {len(sink.records)} records: {e!r}")
-    recs = [m for _, m in sink.records if "mean_accuracy_reward" in m]
+    recs = []
+    for step, m in sink.records:
+        if _is_curve_record(m):
+            m = dict(m)
+            m.setdefault("_step", step)
+            recs.append(m)
     return recs, completed
 
 
@@ -270,7 +287,9 @@ def main() -> int:
 
     backend = jax.devices()[0].platform
     tag = f"{tag}-{args.learner}"
-    if not records:
+    train_recs = [m for m in records if "mean_accuracy_reward" in m]
+    eval_recs = [m for m in records if _is_eval_record(m)]
+    if not train_recs:
         # nothing to plot; the partial-stream file and the exception print
         # from _train_collect are the diagnostics. Nonzero exit keeps the
         # resumable bench matrix retrying the stage.
@@ -286,8 +305,23 @@ def main() -> int:
         for m in records:
             f.write(json.dumps(m) + "\n")
 
-    steps = list(range(1, len(records) + 1))
-    rewards = [m["mean_accuracy_reward"] for m in records]
+    steps = [m.get("_step", i + 1) for i, m in enumerate(train_recs)]
+    rewards = [m["mean_accuracy_reward"] for m in train_recs]
+    # eval series (VERDICT r4 item 6): the reference's pass@1/BoN overlay
+    # (distributed_trainer.py:412–415). Key names embed eval_n, so match
+    # by prefix.
+    def _eval_series(prefix: str):
+        xs, ys = [], []
+        for m in eval_recs:
+            for k, v in m.items():
+                if k.startswith(prefix):
+                    xs.append(m.get("_step", 0))
+                    ys.append(v)
+                    break
+        return xs, ys
+
+    pass1_x, pass1_y = _eval_series("eval/pass@1")
+    bon_x, bon_y = _eval_series("eval/BoN")
     k = max(len(rewards) // 20, 1)
     smooth = [
         sum(rewards[max(0, i - k + 1):i + 1]) / len(rewards[max(0, i - k + 1):i + 1])
@@ -302,6 +336,10 @@ def main() -> int:
         fig, ax = plt.subplots(figsize=(7, 4))
         ax.plot(steps, rewards, alpha=0.35, label="mean_accuracy_reward")
         ax.plot(steps, smooth, label=f"rolling mean (k={k})")
+        if pass1_y:
+            ax.plot(pass1_x, pass1_y, "o-", ms=4, label="eval/pass@1")
+        if bon_y:
+            ax.plot(bon_x, bon_y, "s--", ms=4, label="eval/BoN")
         ax.set_xlabel("train step")
         ax.set_ylabel("mean_accuracy_reward")
         ax.set_title(f"{tag} ({backend}) — the curve the reference publishes "
@@ -316,6 +354,10 @@ def main() -> int:
     print(f"wrote {jsonl}")
     print(f"first→last reward: {rewards[0]:.4f} → {rewards[-1]:.4f} "
           f"(rolling: {smooth[0]:.4f} → {smooth[-1]:.4f}) over {len(rewards)} steps")
+    if pass1_y:
+        bon = (f", BoN: {bon_y[0]:.4f} → {bon_y[-1]:.4f}" if bon_y else "")
+        print(f"eval pass@1: {pass1_y[0]:.4f} → {pass1_y[-1]:.4f}{bon} "
+              f"over {len(pass1_y)} evals")
     if not completed:
         print("run was INTERRUPTED — artifacts above are partial")
         return 1
