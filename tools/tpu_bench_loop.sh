@@ -32,11 +32,14 @@ EOF
 }
 
 wait_for_tpu() {
+  # cycle ≈ probe(<=112s when down) + 60s sleep ≈ 3 min: a 5-minute tunnel
+  # window must not be half-burned before detection (r3's two windows were
+  # ~9 min total). 420 iterations ≈ 20 h — longer than any session.
   local i
-  for i in $(seq 1 300); do
+  for i in $(seq 1 420); do
     if probe; then return 0; fi
     echo "$(date -u +%H:%M:%S) probe: TPU down (waiting)"
-    sleep 150
+    sleep 60
   done
   return 1
 }
@@ -73,7 +76,7 @@ stage_begin() {
   return 0
 }
 
-# After any stage lands, sweep /tmp artifacts into benchmarks/r4 and
+# After any stage lands, sweep /tmp artifacts into benchmarks/r5 and
 # commit — a window that opens after the interactive session's last turn
 # must still get its results into the repo for the judge.
 collect_and_commit() {
@@ -158,6 +161,11 @@ bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
 # 5. all three decode levers stacked: the headline-challenger run
 bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
   BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+# 5b. deeper dispatch amortization: if ~40ms/dispatch dominates (r3: ~22
+#     dispatch/s), chunk 64 cuts a 1200-step decode from ~75 dispatches to
+#     ~19 — the A/B that locates the knee of the dispatch-overhead curve
+bench dense_scan64 /tmp/bench_tpu_dense_scan64.json \
+  BENCH_SCAN_CHUNK=64 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
 # 6. the second headline metric: jitted train-step tok/s + MFU
 #    (fetch-timed — the tunnel's block_until_ready lies)
 bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
@@ -219,7 +227,8 @@ all_done() {
   local n
   for n in prep_7b_params \
            dense paged refill_eos learner kernel_check dense_mw dense_int8 \
-           dense_int8_mw dense_scan dense_scan_int8 refill_scan waves_eos \
+           dense_int8_mw dense_scan dense_scan_int8 dense_scan64 \
+           refill_scan waves_eos \
            dense_eos spec spec_scan budget int8kv \
            learner_flash learner_b512 dispatch_probe sampler_probe \
            mem_envelope qwen7b_int4 train_curve; do
