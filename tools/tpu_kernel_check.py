@@ -169,11 +169,13 @@ def main() -> int:
     kp128, vp128 = make_pages(4, 128)  # 4 kv heads, hd=128 (7B-class)
     check_paged("paged_fixed_hd128", 28, kp128, vp128, "kernel")
     check_paged("paged_native_hd128", 28, kp128, vp128, "native")
-    check_paged(
-        "paged_fixed_hd128_int8_compact", 28,
-        quantize_pages(kp128.astype(jnp.float32)),
-        quantize_pages(vp128.astype(jnp.float32)), "kernel",
-    )
+    kq128 = quantize_pages(kp128.astype(jnp.float32))
+    vq128 = quantize_pages(vp128.astype(jnp.float32))
+    check_paged("paged_fixed_hd128_int8_compact", 28, kq128, vq128, "kernel")
+    # the auto chain's fallback when the stanza above Mosaic-fails — this is
+    # the path the 7B int4+int8KV config actually decodes through, so it
+    # needs its own silicon datapoint
+    check_paged("paged_native_hd128_int8", 28, kq128, vq128, "native")
 
     # ---- donated decode-step HBM audit (TPU only — CPU memory_analysis
     # does not model donation aliasing, so this cannot run in CI): the
@@ -203,7 +205,10 @@ def main() -> int:
         state_s = jax.eval_shape(partial(
             _refill_init, b=b, r_slots=r_slots, total=total, max_steps=512,
             vocab=cfg_m.vocab_size, prompt_pages=eng.prompt_pages,
-            private_pages=eng.private_pages, pad_id=0), pool_s, pool_s)
+            private_pages=eng.private_pages, pad_id=0,
+            # worst_pool sizing, mirrors paged_engine.py generate():
+            # un-budgeted pool = 1 scratch + r_slots * private_pages
+            pool_pages=1 + r_slots * eng.private_pages), pool_s, pool_s)
         pool_bytes = 2 * sum(
             int(np.prod(l.shape)) * 2
             for l in jax.tree_util.tree_leaves(state_s.k_pages)
