@@ -169,7 +169,7 @@ def _decode_step(params, lora, state: _DecodeState, rng,
 
 
 def _decode_chunk(params, lora, state: _DecodeState, rng,
-                  *, chunk: int, max_steps: int, cfg: ModelConfig,
+                  *, chunk: int, cfg: ModelConfig,
                   prompt_len: int, eos_ids, pad_id: int, temperature, top_p,
                   lora_scale: float, attn_impl: str, top_p_impl: str,
                   capture_logprobs: bool):
@@ -181,18 +181,18 @@ def _decode_chunk(params, lora, state: _DecodeState, rng,
     decode throughput. Scanning K steps into one program divides that
     overhead by K.
 
-    The body is guarded by ``lax.cond`` on ``done.all() | step >= max_steps``:
-    the guard makes chunk overshoot free (no forward flops after every row
-    hit EOS) and makes running ceil(max_steps/chunk) full chunks safe — an
-    unguarded step at ``step >= max_steps`` would clamp its
-    dynamic_update_slice onto the last valid position and corrupt it.
+    The body is NOT guarded by ``lax.cond`` — that select double-buffers
+    the carried KV cache (see scan_steps_guarded). Steps past all-done are
+    per-row no-ops (done rows write pad beyond their recorded length), but
+    a step at ``step >= max_steps`` would clamp its dynamic_update_slice
+    onto the last valid position and corrupt it, so the HOST must never
+    dispatch a chunk crossing ``max_steps``: ``_generate_wave`` runs
+    ``max_steps // chunk`` chunks and finishes a non-divisor tail with
+    per-step dispatches.
 
-    The docstring caveat on on-device loops (a while-loop carry updated by
-    dynamic_update_slice can be double-buffered by the TPU compiler, costing
-    a full KV-cache-sized HBM temp) applies here too, so the engine
-    compile-checks ``memory_analysis().temp_size_in_bytes`` before trusting
-    a chunked program and falls back to the host loop if the cache got
-    double-buffered (``_chunk_fn_for_bucket``)."""
+    The engine still compile-checks ``memory_analysis().temp_size_in_bytes``
+    before trusting a chunked program and falls back to the host loop if
+    the cache got double-buffered anyway (``_chunk_fn_for_bucket``)."""
     def run(s):
         return _decode_step(
             params, lora, s, rng, cfg=cfg, prompt_len=prompt_len,
@@ -201,11 +201,7 @@ def _decode_chunk(params, lora, state: _DecodeState, rng,
             top_p_impl=top_p_impl, capture_logprobs=capture_logprobs,
         )
 
-    return scan_steps_guarded(
-        run, state, chunk,
-        halt_fn=lambda s: jnp.logical_or(s.done.all(), s.step >= max_steps),
-        skip_fn=lambda s: s,
-    )
+    return scan_steps_guarded(run, state, chunk)
 
 
 def generate_in_waves(
@@ -269,19 +265,34 @@ def generate_in_waves(
     )
 
 
-def scan_steps_guarded(run, state, chunk: int, *, halt_fn, skip_fn):
+def scan_steps_guarded(run, state, chunk: int):
     """The one copy of the chunked-dispatch scaffolding every engine's
-    chunk body shares: ``chunk`` iterations of ``lax.scan`` whose body
-    runs ``run(s)`` unless ``halt_fn(s)`` — then ``skip_fn(s)`` instead.
+    chunk body shares: ``chunk`` iterations of ``lax.scan`` running one
+    decode step each, UNCONDITIONALLY.
 
-    The skip branch carries a subtle invariant per scheduler: wave-style
-    loops (dense engine, paged waves) halt for good once every row is
-    done, so identity is correct; refill-style loops (refill, spec) keep
-    sampling after refills, so their skip MUST still advance the rng step
-    index (``s._replace(step=s.step + 1)``) to match what the
-    host-dispatched loop would have done."""
+    Earlier rounds wrapped the body in ``lax.cond(halt, skip, run)`` to
+    spare flops once every row was done — and that cond was exactly what
+    double-buffered the carry: the select between the skipped and stepped
+    KV caches keeps both alive, so the TPU compiler materialized a full
+    cache-sized temp (r5 silicon finding, tools/scan_alias_probe.py: the
+    same body compiles with temp == cache bytes with the cond and ~0
+    without, scan and fori_loop alike). Every scan_chunk bench row had
+    silently fallen back to host dispatch because of it.
+
+    Running the body unconditionally is semantically safe because the
+    step functions are ALREADY per-row no-ops for done rows — partial
+    doneness forces that (done rows write pad beyond their length /
+    scatter to dropped sentinel rows / park dead slots on the scratch
+    page), and the rng step index advances exactly as the host loop
+    would. The one case masking does NOT cover is a step whose write
+    index would clamp past the output buffer (dense/wave flavors at
+    ``step >= max_steps``), so CALLERS must never dispatch a chunk that
+    crosses ``max_steps`` — the hosts run ``max_steps // k`` chunks and
+    finish a non-divisor tail with per-step dispatches. Refill/spec
+    flavors need no cadence guard: their slots self-stop at per-slot
+    budgets and their writes drop out-of-range rows."""
     def body(s, _):
-        return jax.lax.cond(halt_fn(s), skip_fn, run, s), None
+        return run(s), None
 
     return jax.lax.scan(body, state, None, length=chunk)[0]
 
@@ -376,9 +387,10 @@ def make_swap_aware_chunk_step(mailbox, lora_cell: list, steps_seen: list,
     When the new signature's program fell back (memory guard / compile
     failure), the round finishes per-step at the same k-step cadence,
     capped at ``max_steps`` total: the per-step functions are UNGUARDED
-    (they clamp-write onto the last output column and keep advancing
-    lengths past the buffer), and only the chunk program's internal scan
-    carries the ``done | step >= max_steps`` guard.
+    (they clamp-write onto the last output column past ``max_steps``),
+    and the chunk program's scan body is unguarded too
+    (scan_steps_guarded), so the HOST cadence is what keeps every
+    dispatched step below ``max_steps``.
 
     ``rebuild(lora, state) -> program|None``;
     ``run_chunk(program, lora, state) -> state``;
@@ -399,12 +411,34 @@ def make_swap_aware_chunk_step(mailbox, lora_cell: list, steps_seen: list,
         start = steps_seen[0]
         steps_seen[0] += k
         if cell[0] is None:
+            # min() is defensive: every caller now floor-divides the cadence
+            # (run_nondivisor_tail), so start + k <= max_steps always holds
             for _ in range(min(k, max_steps - start)):
                 s = run_step(lora_cell[0], s)
             return s
         return run_chunk(cell[0], lora_cell[0], s)
 
     return step
+
+
+def run_nondivisor_tail(mailbox, lora_cell: list, steps_seen: list,
+                        rem: int, state, run_step):
+    """Finish a chunked wave's non-divisor tail with per-step dispatches —
+    the one copy of the cadence invariant every wave engine shares:
+    unguarded scan bodies (scan_steps_guarded) must never cross
+    ``max_steps``, so hosts dispatch ``max_steps // k`` full chunks and
+    run the remaining ``rem`` steps here (skipped once every row hit
+    EOS). The in-flight-swap recording protocol matches the main loops:
+    consume pending adapters before each step, advancing ``steps_seen``.
+    ``run_step(lora, state) -> state`` — the same closure shape
+    ``make_swap_aware_chunk_step`` takes."""
+    if not rem or bool(np.asarray(state.done).all()):
+        return state
+    for _ in range(rem):
+        mailbox._take_pending_lora(lora_cell, steps_seen[0])
+        steps_seen[0] += 1
+        state = run_step(lora_cell[0], state)
+    return state
 
 
 def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
@@ -639,7 +673,7 @@ class GenerationEngine(LoraMailbox):
             fn = jax.jit(
                 partial(
                     _decode_chunk, chunk=min(self.scan_chunk, max_steps),
-                    max_steps=max_steps, cfg=self.cfg, prompt_len=bucket,
+                    cfg=self.cfg, prompt_len=bucket,
                     pad_id=self.pad_id, lora_scale=self.lora_scale,
                     attn_impl=self.attn_impl, top_p_impl=top_p_impl,
                     capture_logprobs=self.capture_logprobs,
@@ -720,6 +754,14 @@ class GenerationEngine(LoraMailbox):
         )
         if chunk_fn is not None:
             k = min(self.scan_chunk, max_steps)
+
+            def run_step(l, s):
+                return decode_step_fn(
+                    params, l, s, rng, eos_ids=self.eos_ids,
+                    temperature=temperature, top_p=top_p,
+                    top_p_impl=top_p_impl,
+                )
+
             step = make_swap_aware_chunk_step(
                 self, lora_cell, steps_seen, k, max_steps, chunk_fn, lora,
                 rebuild=lambda l, s: self._chunk_fn_for_bucket(
@@ -730,14 +772,14 @@ class GenerationEngine(LoraMailbox):
                     params, l, s, rng, eos_ids=self.eos_ids,
                     temperature=temperature, top_p=top_p,
                 ),
-                run_step=lambda l, s: decode_step_fn(
-                    params, l, s, rng, eos_ids=self.eos_ids,
-                    temperature=temperature, top_p=top_p,
-                    top_p_impl=top_p_impl,
-                ),
+                run_step=run_step,
             )
-            # one "step" per chunk; snapshot done flags every chunk (check=1)
-            state = run_decode_loop(step, state, -(-max_steps // k), 1)
+            # one "step" per chunk; snapshot done flags every chunk
+            # (check=1), then the shared non-divisor tail
+            full, rem = divmod(max_steps, k)
+            state = run_decode_loop(step, state, full, 1)
+            state = run_nondivisor_tail(
+                self, lora_cell, steps_seen, rem, state, run_step)
         else:
 
             def step(s):

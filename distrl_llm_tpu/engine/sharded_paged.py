@@ -54,6 +54,7 @@ from distrl_llm_tpu.engine.engine import (
     make_swap_aware_chunk_step,
     pool_nbytes,
     run_decode_loop,
+    run_nondivisor_tail,
 )
 from distrl_llm_tpu.engine.paged_engine import (
     _paged_decode_chunk,
@@ -245,17 +246,17 @@ class ShardedPagedEngine(LoraMailbox):
         chunk_jit = None
         k = min(self.scan_chunk, max_steps)
         if k > 1:
-            # K steps per dispatch inside the SAME shard_map program: the
-            # cond guard (shard-LOCAL done.all()) is plain per-device
-            # control flow — legal in manual SPMD because the dp-only
-            # forward contains no collectives for the branches to diverge
-            # over. Each shard drains its own rows independently.
+            # K steps per dispatch inside the SAME shard_map program. The
+            # scan body is unguarded (a cond's select would double-buffer
+            # the carried page pools — scan_steps_guarded); each shard's
+            # done rows are per-row no-ops, and the host cadence below
+            # keeps every dispatched step under max_steps.
             def local_chunk(params, lora, state, rng, table,
                             temperature, top_p):
                 rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
                 return _paged_decode_chunk(
                     params, lora, state, rng, table, chunk=k,
-                    max_steps=max_steps, eos_ids=self.eos_ids,
+                    eos_ids=self.eos_ids,
                     temperature=temperature, top_p=top_p,
                     top_p_impl=top_p_impl, **self._step_kw,
                 )
@@ -327,6 +328,10 @@ class ShardedPagedEngine(LoraMailbox):
             )
 
         if chunk_fn is not None:
+
+            def run_step(l, s):
+                return step(params, l, s, rng, table, temperature, top_p)
+
             step_fn = make_swap_aware_chunk_step(
                 self, lora_cell, steps_seen, k, max_steps, chunk_fn, lora,
                 rebuild=lambda l, s: cached_chunk_program(
@@ -340,11 +345,14 @@ class ShardedPagedEngine(LoraMailbox):
                 run_chunk=lambda fn, l, s: fn(
                     params, l, s, rng, table, temperature, top_p
                 ),
-                run_step=lambda l, s: step(
-                    params, l, s, rng, table, temperature, top_p
-                ),
+                run_step=run_step,
             )
-            state = run_decode_loop(step_fn, state, -(-max_steps // k), 1)
+            # floor chunks + shared non-divisor tail (run_nondivisor_tail
+            # has the cadence invariant)
+            full, rem = divmod(max_steps, k)
+            state = run_decode_loop(step_fn, state, full, 1)
+            state = run_nondivisor_tail(
+                self, lora_cell, steps_seen, rem, state, run_step)
         else:
 
             def step_fn(s):
