@@ -90,6 +90,43 @@ def _emit(record: dict) -> None:
     print(json.dumps(record))
 
 
+def _resolve_base_params(name: str, cfg, dtype, metric: str):
+    """One owner of the BENCH_BASE_QUANT contract for every bench mode:
+    validate the env var, build/restore the (possibly quantized) base tree
+    on the host, and place it on the bench device. Returns (params, quant)
+    or (None, quant) after emitting the one-line error record."""
+    import jax
+
+    from distrl_llm_tpu.models import init_params
+
+    base_quant = os.environ.get("BENCH_BASE_QUANT", "none")
+    if base_quant not in ("none", "int8", "int4"):
+        _emit({
+            "metric": metric, "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": f"invalid BENCH_BASE_QUANT={base_quant!r} "
+                     "(expected none/int8/int4)",
+            "backend": jax.devices()[0].platform,
+        })
+        return None, base_quant
+    if base_quant == "none":
+        return init_params(jax.random.PRNGKey(0), cfg, dtype=dtype), base_quant
+    # init + quantize on the HOST: materializing the full-precision 7B tree
+    # in HBM just to quantize it would blow the very budget int4 exists to
+    # fit under. A forced non-cpu platform list opted out of the host path.
+    try:
+        host = jax.devices("cpu")[0]
+    except RuntimeError:
+        host = jax.devices()[0]
+    params = host_quantized_params(
+        name, cfg, dtype, base_quant, host,
+        # on TPU, cache population is the watcher's ungated prep stage's
+        # job — a miss must not spend window time serializing
+        save_on_miss=jax.devices()[0].platform != "tpu",
+    )
+    return jax.device_put(params, jax.devices()[0]), base_quant
+
+
 def host_quantized_params(name: str, cfg, dtype, base_quant: str, host,
                           save_on_miss: bool = True):
     """Host-side quantized param tree, disk-cached when BENCH_PARAMS_CACHE
@@ -222,7 +259,7 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
 
     from distrl_llm_tpu.learner.optim import make_optimizer
     from distrl_llm_tpu.learner.train_step import UpdateBatch, make_train_step
-    from distrl_llm_tpu.models import init_lora_params, init_params
+    from distrl_llm_tpu.models import init_lora_params
     from distrl_llm_tpu.models.lora import lora_scale
 
     n_rows = int(os.environ.get("BENCH_ROWS", "8"))
@@ -247,7 +284,13 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         return 1
 
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
-    params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    # the learner trains LoRA over the SAME (possibly int4) base the
+    # rollout serves (QLoRA — grads flow through dequant into LoRA only,
+    # pinned by tests/test_quant.py::test_train_step_over_quantized_base)
+    params, base_quant = _resolve_base_params(
+        name, cfg, dtype, "learner_tokens_per_sec_per_chip")
+    if params is None:
+        return 1
     lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank)
     optimizer = make_optimizer(2e-5, use_8bit=True)
     opt_state = optimizer.init(lora)
@@ -298,6 +341,7 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         "vs_baseline": round(tps / n_chips / 37000.0, 3),
         "mfu": round(mfu, 6),
         "model": name,
+        "base_quant": base_quant,
         "backend": jax.devices()[0].platform,
         "rows": n_rows, "micro": micro, "seq": p_len + t_len,
         "attn_impl": attn_impl,
@@ -443,7 +487,7 @@ def main() -> int:
 
     from distrl_llm_tpu.config import SamplingConfig
     from distrl_llm_tpu.engine import GenerationEngine, PagedGenerationEngine
-    from distrl_llm_tpu.models import QWEN2_0_5B, TINY, init_lora_params, init_params
+    from distrl_llm_tpu.models import QWEN2_0_5B, TINY, init_lora_params
     from distrl_llm_tpu.models.configs import QWEN2_7B
 
     name = os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")
@@ -459,37 +503,10 @@ def main() -> int:
 
     # the CPU fallback's dot thunk has no bf16 support — use f32 off-TPU
     dtype = jnp.bfloat16 if devices[0].platform == "tpu" else jnp.float32
-    base_quant = os.environ.get("BENCH_BASE_QUANT", "none")
-    if base_quant not in ("none", "int8", "int4"):
-        # keep the driver contract: ONE parseable JSON line, even on misuse
-        _emit({
-            "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
-            "unit": "tok/s/chip", "vs_baseline": 0.0,
-            "error": f"invalid BENCH_BASE_QUANT={base_quant!r} "
-                     "(expected none/int8/int4)",
-            "backend": devices[0].platform,
-        })
+    params, base_quant = _resolve_base_params(
+        name, cfg, dtype, "rollout_tokens_per_sec_per_chip")
+    if params is None:
         return 1
-    if base_quant != "none":
-        # init + quantize on the HOST: materializing the full-precision 7B
-        # tree in HBM just to quantize it would blow the very budget int4
-        # exists to fit under. If JAX_PLATFORMS pinned a non-cpu backend
-        # list, the cpu backend is unavailable — quantize on-device then
-        # (fine for small models; a forced-platform run opted out of the
-        # host path explicitly).
-        try:
-            host = jax.devices("cpu")[0]
-        except RuntimeError:
-            host = devices[0]
-        params = host_quantized_params(
-            name, cfg, dtype, base_quant, host,
-            # on TPU, cache population is the watcher's ungated prep stage's
-            # job — a miss must not spend window time serializing
-            save_on_miss=devices[0].platform != "tpu",
-        )
-        params = jax.device_put(params, devices[0])
-    else:
-        params = init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank, dtype=dtype)
     from distrl_llm_tpu.config import parse_buckets
 

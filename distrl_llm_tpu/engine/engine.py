@@ -421,6 +421,20 @@ def make_swap_aware_chunk_step(mailbox, lora_cell: list, steps_seen: list,
     return step
 
 
+def pick_chunk(scan_chunk: int, max_steps: int) -> int:
+    """Steps-per-dispatch for a wave of ``max_steps``: the largest divisor
+    of ``max_steps`` that is ≤ ``scan_chunk``, preferred over a floor
+    cadence with a per-step tail — at 1,200 steps and scan_chunk=64 the
+    divisor 60 gives 20 full chunks and no tail, vs 18 chunks + 48
+    per-step dispatches (each a ~40 ms tunnel round trip). Falls back to
+    ``min(scan_chunk, max_steps)`` (run_nondivisor_tail handles the
+    remainder) when the best divisor would lose more than half the
+    requested amortization (e.g. a prime max_steps)."""
+    k = max(1, min(scan_chunk, max_steps))
+    best = max((d for d in range(1, k + 1) if max_steps % d == 0), default=1)
+    return best if best * 2 > k else k
+
+
 def run_nondivisor_tail(mailbox, lora_cell: list, steps_seen: list,
                         rem: int, state, run_step):
     """Finish a chunked wave's non-divisor tail with per-step dispatches —
@@ -672,7 +686,7 @@ class GenerationEngine(LoraMailbox):
                 return self._chunk_compiled[key]
             fn = jax.jit(
                 partial(
-                    _decode_chunk, chunk=min(self.scan_chunk, max_steps),
+                    _decode_chunk, chunk=pick_chunk(self.scan_chunk, max_steps),
                     cfg=self.cfg, prompt_len=bucket,
                     pad_id=self.pad_id, lora_scale=self.lora_scale,
                     attn_impl=self.attn_impl, top_p_impl=top_p_impl,
@@ -753,7 +767,7 @@ class GenerationEngine(LoraMailbox):
             else None
         )
         if chunk_fn is not None:
-            k = min(self.scan_chunk, max_steps)
+            k = pick_chunk(self.scan_chunk, max_steps)
 
             def run_step(l, s):
                 return decode_step_fn(

@@ -53,6 +53,7 @@ from distrl_llm_tpu.engine.engine import (
     lora_signature,
     make_swap_aware_chunk_step,
     pool_nbytes,
+    pick_chunk,
     run_decode_loop,
     run_nondivisor_tail,
 )
@@ -244,7 +245,7 @@ class ShardedPagedEngine(LoraMailbox):
         )
 
         chunk_jit = None
-        k = min(self.scan_chunk, max_steps)
+        k = pick_chunk(self.scan_chunk, max_steps)
         if k > 1:
             # K steps per dispatch inside the SAME shard_map program. The
             # scan body is unguarded (a cond's select would double-buffer

@@ -381,17 +381,46 @@ class TestScanChunk:
         )
         np.testing.assert_array_equal(out.tokens, want.tokens)
 
+    def test_pick_chunk_prefers_divisors(self):
+        """The host cadence never lets a chunk cross max_steps: pick_chunk
+        returns the largest divisor ≤ scan_chunk when that keeps most of
+        the amortization, else min(scan_chunk, max_steps) with the
+        remainder handled per-step (run_nondivisor_tail)."""
+        from distrl_llm_tpu.engine.engine import pick_chunk
+
+        assert pick_chunk(16, 1200) == 16   # divides exactly
+        assert pick_chunk(64, 1200) == 60   # divisor 60 beats 64 + 48-tail
+        assert pick_chunk(4, 6) == 3        # small-scale divisor
+        assert pick_chunk(4, 7) == 4        # prime: keep 4, tail of 3
+        assert pick_chunk(8, 4) == 4        # chunk larger than the wave
+        assert pick_chunk(2, 1) == 1
+
     @pytest.mark.slow
     def test_sampled_parity_with_overshoot_and_logprobs(self, setup):
-        """chunk=4 over max_new=6: the second chunk overshoots by 2 guarded
-        steps — tokens, lengths AND captured behavior logprobs must still be
-        bit-identical to the per-step loop."""
+        """scan_chunk=4 over max_new=6 (pick_chunk → 3, two exact chunks):
+        tokens, lengths AND captured behavior logprobs must be bit-identical
+        to the per-step loop."""
         params, ids, mask = setup
         host, chunked = self._pair(scan_chunk=4, max_new=6, capture=True)
         sc = SamplingConfig(max_tokens=6, temperature=1.1, top_p=0.9, n=2)
         a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
         b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
         assert chunked.scan_chunk_active  # chunked program ran, not a fallback
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        np.testing.assert_array_equal(a.logprobs, b.logprobs)
+
+    def test_nondivisor_tail_parity(self, setup):
+        """Prime max_new=7 with scan_chunk=4 forces the per-step tail
+        (pick_chunk keeps k=4: one full chunk + 3 tail steps) — the tail
+        must produce the same tokens/lengths/logprobs as the host loop,
+        and the chunk program must still have run."""
+        params, ids, mask = setup
+        host, chunked = self._pair(scan_chunk=4, max_new=7, capture=True)
+        sc = SamplingConfig(max_tokens=7, temperature=1.1, top_p=0.9, n=2)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(3))
+        assert chunked.scan_chunk_active
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
         np.testing.assert_array_equal(a.logprobs, b.logprobs)

@@ -110,8 +110,6 @@ def main():
     out = jax.ShapeDtypeStruct((B, S), jnp.int32)
     s0 = (cache, out, jnp.asarray(0, jnp.int32),
           jax.ShapeDtypeStruct((B,), jnp.bool_))
-    s0 = jax.tree.map(
-        lambda x: x if not isinstance(x, jax.ShapeDtypeStruct) else x, s0)
     cache_bytes = sum(2 * B * KH * HD * S for _ in range(LAYERS))
     print(f"cache bytes: {cache_bytes/2**30:.2f} GiB  "
           f"(B={B} S={S} chunk={CHUNK} layers={LAYERS})")

@@ -1,9 +1,10 @@
 #!/bin/bash
-# Resumable TPU bench matrix. Probes the chip before EVERY stage (the axon
-# tunnel dies mid-session: rounds 1-3 all saw compute hangs), runs each
-# stage once, and marks completion in /tmp/graft_stage_<name>.done so a
-# restart resumes where it left off. Results: /tmp/bench_tpu_*.json,
-# logs:   /tmp/*_tpu.log.  Delete the .done markers to force a re-run.
+# Resumable TPU bench matrix (round 5, post scan-chunk-aliasing fix).
+# Probes the chip before EVERY stage (the axon tunnel dies mid-session:
+# rounds 1-4 all saw it), runs each stage once, and marks completion in
+# /tmp/graft_stage_<name>.done so a restart resumes where it left off.
+# Results: /tmp/bench_tpu_*.json, logs: /tmp/*_tpu.log.
+# Delete the .done markers to force a re-run.
 cd "$(dirname "$0")/.."
 
 # Persistent XLA compilation cache: the first TPU window burned 246 s of
@@ -11,8 +12,6 @@ cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
-# one cache dir for prep + bench (bench only reads it for quantized-base
-# stages; the ungated prep stage populates it while the tunnel is down)
 export BENCH_PARAMS_CACHE="${BENCH_PARAMS_CACHE:-/tmp/graft_params_cache}"
 
 probe() {
@@ -32,9 +31,6 @@ EOF
 }
 
 wait_for_tpu() {
-  # cycle ≈ probe(<=112s when down) + 60s sleep ≈ 3 min: a 5-minute tunnel
-  # window must not be half-burned before detection (r3's two windows were
-  # ~9 min total). 420 iterations ≈ 20 h — longer than any session.
   local i
   for i in $(seq 1 420); do
     if probe; then return 0; fi
@@ -44,9 +40,6 @@ wait_for_tpu() {
   return 1
 }
 
-# run_prep <name> <timeout_s> <cmd...> — like run_stage but WITHOUT the
-# TPU wait: host-only preparation that should run while the tunnel is down
-# (forces the CPU platform itself), so windows only pay for chip work.
 run_prep() {
   local name="$1" tmo="$2"; shift 2
   marker="/tmp/graft_stage_${name}.done"
@@ -62,8 +55,6 @@ run_prep() {
   return $rc
 }
 
-# stage_begin <name>: marker check + TPU wait + stage banner.
-# Sets $marker. Returns 1 if the stage is already done.
 stage_begin() {
   local name="$1"
   marker="/tmp/graft_stage_${name}.done"
@@ -76,9 +67,6 @@ stage_begin() {
   return 0
 }
 
-# After any stage lands, sweep /tmp artifacts into benchmarks/r5 and
-# commit — a window that opens after the interactive session's last turn
-# must still get its results into the repo for the judge.
 collect_and_commit() {
   python tools/collect_bench.py > /dev/null 2>&1 || true
   if [ -n "$(git status --porcelain benchmarks media 2>/dev/null)" ]; then
@@ -88,7 +76,6 @@ collect_and_commit() {
   fi
 }
 
-# run_stage <name> <timeout_s> <cmd...>
 run_stage() {
   local name="$1" tmo="$2"; shift 2
   stage_begin "$name" || return 0
@@ -101,137 +88,118 @@ run_stage() {
 }
 
 # bench <name> <out.json> [timeout_s] [ENV=V ...] — success additionally
-# requires the result record to be a real TPU measurement, not a fallback.
+# requires the result record to be a real TPU measurement, not a fallback,
+# plus REQUIRE's pattern when set (cleared after each stage).
 bench() {
   local name="$1" out="$2"; shift 2
   local tmo=900
   case "${1:-}" in [0-9]*) tmo="$1"; shift;; esac
+  local require="${REQUIRE:-}"; REQUIRE=""
   stage_begin "$name" || return 0
   env BENCH_NO_FALLBACK=1 "$@" timeout "$tmo" python bench.py \
       > "$out" 2>"${out%.json}.err"
   local rc=$?
   echo "$(date -u +%H:%M:%S) $name rc=$rc: $(tail -c 300 "$out")"
   if [ "$rc" = 0 ] && grep -q '"backend": "tpu"' "$out" \
-      && ! grep -q '"error"' "$out"; then touch "$marker"; fi
+      && ! grep -q '"error"' "$out" \
+      && { [ -z "$require" ] || grep -q "$require" "$out"; }; then
+    touch "$marker"
+  fi
   collect_and_commit
 }
 
-# --- ordered by information value under window scarcity: each window may
-# be minutes long, so the most distinct stories come first; every stage is
-# resumable (markers) and the matrix makes up to 3 passes so a stage that
-# crashed mid-window is retried. ------------------------------------------
-# Round-4 priority order (VERDICT r3 "Next round"): the native paged
-# kernel has zero silicon validation, so kernel_check gates everything
-# paged; then the paged matrix, the scan-chunk A/B (roofline), the
-# learner, 7B, and the curve. Dense stages from r3 keep their markers.
+# bench_scan — bench, but the stage only counts once the record shows the
+# chunked program actually RAN: the whole point of these rows is the
+# dispatch-amortization A/B, and the first r5 window proved a fallback can
+# masquerade as a scan row (scan_chunk_active false in all four).
+bench_scan() {
+  REQUIRE='"scan_chunk_active": true' bench "$@"
+}
+
+# --- r5 second-half priorities (post aliasing fix, commit 06bd3c2):
+# 1. kernel stanzas (incl. the new native hd128 int8 + fixed HBM audit);
+# 2. the REAL scan-chunk A/Bs — every first-window "scan" row silently
+#    fell back (scan_chunk_active false, preserved as *_fallback.json);
+# 3. 7B rollout + 7B learner (like-for-like vs the reference's headline);
+# 4. engaged-pool paged rows, now chunked so they fit a 900s window;
+# 5. memory ground truth, curve, then the r3-covered dense family.
 matrix() {
-# 0. host-only prep (no TPU wait), in the BACKGROUND: pre-build the 7B
-#    int4 tree so the 7B stage's window time goes to compile+measure, not
-#    host quantization — and so the prep itself never delays a live window
-#    (gated stages start immediately; the 7B stage waits on this pid)
 run_prep prep_7b_params 1800 python tools/prep_params.py qwen2.5-7b int4 &
 PREP_7B_PID=$!
-# 1. kernel parity on silicon — native-kernel stanzas at the 0.5B geometry
-#    (hd=64, 14q/2kv) + relative-tolerance flash/splash backward rerun.
-#    This is the N1/N10 lowering authority: paged numbers mean nothing
-#    until these PASS on chip (two Mosaic classes were interpreter-blind).
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
    grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
-   # the stage artifact is the LOG: once >=5 stanzas actually executed on
-   # chip, mark done even if some FAILed — a deterministic FAIL needs a
-   # code fix (then clear the marker), and re-burning every window 900s
-   # on the same failure starves the rest of the matrix
    n=$(grep -cE "^(PASS|FAIL)" /tmp/tpu_kernel_tests.log);
    if [ "$rc" != 0 ] && [ "$n" -ge 5 ]; then
      echo "kernel_check: $n stanzas ran (some FAILed) — marking done; see log";
      exit 0;
    fi;
    exit $rc'
-# 2. flagship paged engine on silicon — first ever paged datapoint
-bench paged   /tmp/bench_tpu_paged.json   BENCH_ENGINE=paged
-# 3. refill scheduler, chunked dispatch (the production config)
-bench refill_scan /tmp/bench_tpu_refill_scan.json \
+# compile-only guard verdicts for every chunk flavor at bench scale; also
+# pre-warms the compile cache the bench_scan stages below reuse
+run_stage chunk_check 1500 bash -c \
+  'python tools/chunk_compile_check.py > /tmp/chunk_compile_check.log 2>&1; rc=$?;
+   grep -E "ACCEPTED|REJECTED|ALL" /tmp/chunk_compile_check.log; exit $rc'
+# the dispatch-amortization A/B against this session's *_fallback rows
+bench_scan dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
+bench_scan dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
+  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
-# 4. scan-chunk A/B vs the r3 dense number → quantifies the dispatch
-#    bottleneck for the roofline statement (r3: ~22 steps/s dispatch-bound
-#    against a ~5 ms/step chip estimate)
-bench dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
-# 5. all three decode levers stacked: the headline-challenger run
-bench dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
-  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
-# 5b. deeper dispatch amortization: if ~40ms/dispatch dominates (r3: ~22
-#     dispatch/s), chunk 64 cuts a 1200-step decode from ~75 dispatches to
-#     ~19 — the A/B that locates the knee of the dispatch-overhead curve
-bench dense_scan64 /tmp/bench_tpu_dense_scan64.json \
-  BENCH_SCAN_CHUNK=64 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
-# 6. the second headline metric: jitted train-step tok/s + MFU
-#    (fetch-timed — the tunnel's block_until_ready lies)
-bench learner /tmp/bench_tpu_learner.json BENCH_MODE=learner
-bench learner_flash /tmp/bench_tpu_learner_flash.json BENCH_MODE=learner BENCH_ATTN_IMPL=flash
-# learner length bucketing (--learner_len_buckets): the step cost at t=512,
-# the bucket a ~470-token-mean batch (the reference's own distribution)
-# runs at, vs the always-pad-to-1200 stages above
-bench learner_b512 /tmp/bench_tpu_learner_b512.json BENCH_MODE=learner BENCH_MAX_NEW=512
-# 7. scheduler headline at realistic length variance (mean ~1/0.002 = 500
-#    of 1200 tokens ≈ the reference's ~470 mean): refill keeps slots busy
-bench refill_eos /tmp/bench_tpu_refill_eos.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill
-# 8. paged A/Bs promised by benchmarks/r3/README.md: spec, budget, int8 KV
-bench spec_scan /tmp/bench_tpu_spec_scan.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
-  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SCAN_CHUNK=16
-bench budget  /tmp/bench_tpu_budget.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_PAGES=500
-bench int8kv  /tmp/bench_tpu_int8kv.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8
-# 9. compile-time HBM ground truth for the config-2 table (BASELINE.md)
-run_stage mem_envelope 1200 bash -c \
-  'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
-     > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
-# 10. 7B capacity config (BASELINE config-2): int4 base + int8 KV + refill
-#     + scan-chunk — the like-for-like scale vs the reference's 7B headline.
-#     Wait for the background param prep first (no-op once its marker is
-#     set), so the stage restores the cached tree instead of rebuilding it.
+# 7B: the reference's headline scale (config-2), rollout then learner
 wait "$PREP_7B_PID" 2>/dev/null
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
   BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
   BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
   BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16 \
   BENCH_SCAN_CHUNK=16
-# 11. remaining A/Bs + probes (dense family landed in r3)
+bench learner_7b /tmp/bench_tpu_learner_7b.json 2400 \
+  BENCH_MODE=learner BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 \
+  BENCH_MICRO=2
+bench_scan dense_scan64 /tmp/bench_tpu_dense_scan64.json \
+  BENCH_SCAN_CHUNK=64 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+# engaged-pool paged rows, chunked so they fit a window (unchunked budget
+# timed out at 900s in the first window)
+bench budget  /tmp/bench_tpu_budget.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_KV_PAGES=500 BENCH_SCAN_CHUNK=16
+bench int8kv  /tmp/bench_tpu_int8kv.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_KV_QUANT=int8 BENCH_SCAN_CHUNK=16
+bench spec_scan /tmp/bench_tpu_spec_scan.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SCAN_CHUNK=16
+run_stage mem_envelope 1200 bash -c \
+  'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
+     > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
+# the on-chip reward curve checkpoints+resumes: every window adds steps
+run_stage train_curve 3000 bash -c \
+  'python tools/train_curve.py --model synth-qwen2.5-0.5b --episodes 12 \
+     > /tmp/train_curve_tpu.log 2>&1; rc=$?; tail -2 /tmp/train_curve_tpu.log; exit $rc'
+# dense family re-measure (r3 numbers + this session's fallback rows
+# already cover these configs; lowest priority)
 bench dense   /tmp/bench_tpu_dense.json
-bench dense_mw /tmp/bench_tpu_dense_mw.json BENCH_TOP_P_IMPL=bisect_mw
-bench dense_int8 /tmp/bench_tpu_dense_int8.json BENCH_KV_QUANT=int8
 bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
 bench waves_eos /tmp/bench_tpu_waves_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
-bench spec    /tmp/bench_tpu_spec.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4
 run_stage dispatch_probe 300 bash -c \
   'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
    cat /tmp/dispatch_probe.log; exit $rc'
 run_stage sampler_probe 600 bash -c \
   'python tools/sampler_probe.py > /tmp/sampler_probe.log 2>&1; rc=$?;
    cat /tmp/sampler_probe.log; exit $rc'
-# longest stage last: the on-chip reward curve checkpoints+resumes, so
-# every window it reaches adds steps even if it never finishes in one
-run_stage train_curve 3000 bash -c \
-  'python tools/train_curve.py --model synth-qwen2.5-0.5b --episodes 12 \
-     > /tmp/train_curve_tpu.log 2>&1; rc=$?; tail -2 /tmp/train_curve_tpu.log; exit $rc'
 }
 
 all_done() {
   local n
-  for n in prep_7b_params \
-           dense paged refill_eos learner kernel_check dense_mw dense_int8 \
-           dense_int8_mw dense_scan dense_scan_int8 dense_scan64 \
-           refill_scan waves_eos \
-           dense_eos spec spec_scan budget int8kv \
-           learner_flash learner_b512 dispatch_probe sampler_probe \
-           mem_envelope qwen7b_int4 train_curve; do
+  for n in prep_7b_params kernel_check chunk_check \
+           dense_scan dense_scan_int8 dense_scan64 refill_scan \
+           qwen7b_int4 learner_7b budget int8kv spec_scan \
+           mem_envelope train_curve \
+           dense dense_int8_mw waves_eos dense_eos \
+           dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done
   return 0
