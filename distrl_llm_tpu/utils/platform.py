@@ -1,0 +1,30 @@
+"""One owner of the JAX_PLATFORMS workaround for this environment.
+
+The sitecustomize-registered axon TPU plugin IGNORES the ``JAX_PLATFORMS``
+env var, so a process that wants the CPU backend (tests, smokes, host-only
+prep) hangs in tunnel-down TPU client init unless it pins the platform via
+``jax.config`` BEFORE the first backend touch. Every entry point that
+honors the env var should call :func:`honor_jax_platforms` right after
+``import jax`` instead of carrying its own copy of the check (eight
+near-identical variants had accumulated by round 5).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms(default: str | None = None) -> str | None:
+    """Pin ``jax_platforms`` to the env-requested value (or ``default``).
+
+    Returns the platform string that was pinned, or None when neither the
+    env var nor ``default`` asks for one (leaving backend autodetection —
+    i.e. the TPU plugin — in charge). Must run before jax touches a
+    backend; safe to call multiple times with the same value.
+    """
+    import jax
+
+    requested = os.environ.get("JAX_PLATFORMS", "").strip() or default
+    if requested:
+        jax.config.update("jax_platforms", requested)
+    return requested or None

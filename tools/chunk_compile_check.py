@@ -11,12 +11,18 @@ refill, and spec.
 Usage: python tools/chunk_compile_check.py [chunk]
 """
 
+import os
 import sys
 from functools import partial
 
 sys.path.insert(0, ".")
 
 import jax
+
+from distrl_llm_tpu.utils.platform import honor_jax_platforms
+
+honor_jax_platforms()
+
 import jax.numpy as jnp
 
 CHUNK = int(sys.argv[1]) if len(sys.argv) > 1 else 16

@@ -23,10 +23,16 @@ double-buffering culprit and the engines' chunk scaffolding should drop it.
 Usage: python tools/scan_alias_probe.py [B] [S] [chunk]
 """
 
+import os
 import sys
 from functools import partial
 
 import jax
+
+from distrl_llm_tpu.utils.platform import honor_jax_platforms
+
+honor_jax_platforms()
+
 import jax.numpy as jnp
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 480

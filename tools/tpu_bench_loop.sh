@@ -147,6 +147,13 @@ bench_scan dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
 bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
+# step-time decomposition at bench shapes: forward vs sampling vs full
+# step — locates the per-step cost beyond the bandwidth roofline
+run_stage step_anatomy 900 bash -c \
+  'python tools/step_anatomy.py 480 none bisect > /tmp/step_anatomy.log 2>&1; rc1=$?;
+   python tools/step_anatomy.py 480 int8 bisect_mw >> /tmp/step_anatomy.log 2>&1; rc2=$?;
+   grep -E "ms/step|residual|backend" /tmp/step_anatomy.log;
+   exit $((rc1 | rc2))'
 # 7B: the reference's headline scale (config-2), rollout then learner
 wait "$PREP_7B_PID" 2>/dev/null
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
