@@ -386,15 +386,10 @@ def main() -> int:
         pass
 
     if fallback_err is not None or os.environ.get("JAX_PLATFORMS", "").strip():
-        # The sitecustomize-registered TPU plugin ignores the JAX_PLATFORMS
-        # env var; forcing a platform needs jax.config.update before the
-        # first backend touch (same workaround as tests/conftest.py).
-        import jax
+        from distrl_llm_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update(
-            "jax_platforms",
-            os.environ.get("JAX_PLATFORMS", "").strip() or "cpu",
-        )
+        # a fallback re-exec pins cpu even without the env var
+        honor_jax_platforms(default="cpu")
 
     devices, err = _probe_backend(init_timeout)
     if devices is None:

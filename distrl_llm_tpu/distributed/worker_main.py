@@ -169,11 +169,9 @@ def main(argv: list[str] | None = None) -> None:
     # Honor JAX_PLATFORMS even where a sitecustomize-registered TPU plugin
     # stomps the env var and hangs with no reachable chip (same workaround as
     # train_distributed.py / tests/conftest.py).
-    requested = os.environ.get("JAX_PLATFORMS", "").strip()
-    if requested:
-        import jax
+    from distrl_llm_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", requested)
+    honor_jax_platforms()
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)

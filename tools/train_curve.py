@@ -259,20 +259,12 @@ def main() -> int:
         os.path.dirname(__file__), "..", "media"))
     args = ap.parse_args()
 
-    # the sitecustomize axon plugin IGNORES the JAX_PLATFORMS env var —
-    # honoring it needs jax.config.update before the first backend touch
-    # (same workaround as bench.py / tests/conftest.py). Without this a
-    # CPU-intended synth run hangs in TPU client init when the tunnel is
-    # down.
-    if os.environ.get("JAX_PLATFORMS", "").strip():
-        import jax
+    from distrl_llm_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"].strip())
+    # tiny runs are CPU-scale by definition; anything else honors the env
+    honor_jax_platforms(default="cpu" if args.model == "tiny" else None)
 
     if args.model == "tiny":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         (records, completed), tag = run_tiny(args.episodes, args.learner)
     elif args.model.startswith("synth-"):
         (records, completed), tag = run_synth(

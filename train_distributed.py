@@ -262,16 +262,9 @@ def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
 
-    # Honor JAX_PLATFORMS even where a sitecustomize-registered TPU plugin
-    # stomps the env var (this environment's axon plugin does, and hangs when
-    # no chip is reachable — tests/conftest.py documents the same workaround).
-    import os
+    from distrl_llm_tpu.utils.platform import honor_jax_platforms
 
-    requested = os.environ.get("JAX_PLATFORMS", "").strip()
-    if requested:
-        import jax
-
-        jax.config.update("jax_platforms", requested)
+    honor_jax_platforms()
 
     if args.smoke:
         run_smoke(config)
