@@ -22,6 +22,8 @@ LOGS = [
     "/tmp/sampler_probe.log",
     "/tmp/memory_envelope_tpu.log",
     "/tmp/train_curve_tpu.log",
+    "/tmp/chunk_compile_check.log",
+    "/tmp/step_anatomy.log",
 ]
 
 
