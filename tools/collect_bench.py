@@ -24,6 +24,7 @@ LOGS = [
     "/tmp/train_curve_tpu.log",
     "/tmp/chunk_compile_check.log",
     "/tmp/step_anatomy.log",
+    "/tmp/learner_anatomy.log",
 ]
 
 

@@ -154,6 +154,11 @@ run_stage step_anatomy 900 bash -c \
    python tools/step_anatomy.py 480 int8 bisect_mw >> /tmp/step_anatomy.log 2>&1; rc2=$?;
    grep -E "ms/step|residual|backend" /tmp/step_anatomy.log;
    exit $((rc1 | rc2))'
+# learner step decomposition: loss-forward vs grad vs full update — the
+# r5 learner row is ~15x its FLOPs bound and nothing locates the gap
+run_stage learner_anatomy 900 bash -c \
+  'python tools/learner_anatomy.py > /tmp/learner_anatomy.log 2>&1; rc=$?;
+   grep -E "ms|backend" /tmp/learner_anatomy.log; exit $rc'
 # 7B: the reference's headline scale (config-2), rollout then learner
 wait "$PREP_7B_PID" 2>/dev/null
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
@@ -204,6 +209,7 @@ all_done() {
   for n in prep_7b_params kernel_check chunk_check \
            dense_scan dense_scan_int8 dense_scan64 refill_scan \
            qwen7b_int4 learner_7b budget int8kv spec_scan \
+           step_anatomy learner_anatomy \
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \
            dispatch_probe sampler_probe; do
