@@ -428,6 +428,10 @@ def main() -> int:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_FALLBACK_ERROR"] = err or "unknown"
+        # the caller's TPU-probe budget must not poison the CPU fallback's
+        # own backend probe (a short/zero BENCH_INIT_TIMEOUT would make the
+        # fallback emit backend:"none" instead of the pinned CPU record)
+        env.pop("BENCH_INIT_TIMEOUT", None)
         # PINNED fallback config (VERDICT r4 weak #6): cross-round CPU
         # fallback numbers were ±15% noise at differing tiny volumes
         # (r4: 204 total tokens, 0.03 s timed). The pinned run decodes a

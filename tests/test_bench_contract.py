@@ -1,0 +1,116 @@
+"""The driver contract of bench.py: exactly ONE parseable JSON line on
+stdout with the required keys, whatever happens — plus the round-5 honesty
+fields (scan_chunk_active, fallback_config pinning) the judge reads.
+
+These run the real script in a subprocess on the CPU backend at tiny
+volume (the same surface the driver invokes), so a refactor that breaks
+the record shape or the env-var contract fails here instead of in a
+TPU window.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(extra_env: dict, timeout: int = 600) -> dict:
+    # hermetic: strip every BENCH_* var a watcher/driver shell may have
+    # exported, and conftest's 8-virtual-device XLA_FLAGS mutation — the
+    # record must describe the single-device surface the driver invokes
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_") and k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, (
+        f"expected ONE JSON line, got {lines!r}; "
+        f"stderr tail: {out.stderr[-800:]}"
+    )
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+class TestBenchContract:
+    TINY = {
+        "BENCH_MODEL": "tiny", "BENCH_PROMPTS": "4", "BENCH_CANDIDATES": "2",
+        "BENCH_MAX_PROMPT": "16", "BENCH_MAX_NEW": "24",
+    }
+
+    def test_rollout_record_shape(self):
+        rec = run_bench(self.TINY)
+        for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                    "scan_chunk", "scan_chunk_active", "engine",
+                    "paged_attn_impl", "total_tokens"):
+            assert key in rec, key
+        assert rec["metric"] == "rollout_tokens_per_sec_per_chip"
+        assert rec["backend"] == "cpu"
+        assert rec["value"] > 0
+        assert "error" not in rec
+
+    def test_learner_record_shape(self):
+        rec = run_bench({
+            "BENCH_MODE": "learner", "BENCH_MODEL": "tiny",
+            "BENCH_ROWS": "2", "BENCH_MICRO": "1",
+            "BENCH_MAX_PROMPT": "16", "BENCH_MAX_NEW": "16",
+            "BENCH_STEPS": "1",
+        })
+        assert rec["metric"] == "learner_tokens_per_sec_per_chip"
+        for key in ("step_seconds", "mfu", "attn_impl", "attn_fallback",
+                    "base_quant", "loss"):
+            assert key in rec, key
+        assert "error" not in rec
+
+    def test_learner_quantized_base(self):
+        rec = run_bench({
+            "BENCH_MODE": "learner", "BENCH_MODEL": "tiny",
+            "BENCH_ROWS": "2", "BENCH_MICRO": "1",
+            "BENCH_MAX_PROMPT": "16", "BENCH_MAX_NEW": "16",
+            "BENCH_STEPS": "1", "BENCH_BASE_QUANT": "int4",
+            # no cache dir -> host-quantize in-process
+            "BENCH_PARAMS_CACHE": "",
+        })
+        assert rec["base_quant"] == "int4"
+        assert "error" not in rec
+
+    def test_invalid_base_quant_still_one_line(self):
+        rec = run_bench({**self.TINY, "BENCH_BASE_QUANT": "fp5"})
+        assert "error" in rec
+        assert rec["vs_baseline"] == 0.0
+
+    def test_scan_chunk_active_flag(self):
+        rec = run_bench({**self.TINY, "BENCH_SCAN_CHUNK": "4"})
+        # CPU compiles accept chunk programs (no memory analysis), so the
+        # honesty flag must report the chunked program actually ran
+        assert rec["scan_chunk"] == 4
+        assert rec["scan_chunk_active"] is True
+
+    def test_dead_tunnel_pinned_fallback(self):
+        # BENCH_INIT_TIMEOUT=0 forces the probe-timeout path regardless of
+        # the real tunnel state: bench must re-exec itself on CPU with the
+        # PINNED config (fallback_config label + deterministic counters)
+        rec = run_bench({
+            "JAX_PLATFORMS": "", "BENCH_INIT_TIMEOUT": "0",
+            "BENCH_TPU_WAIT_S": "0",  # skip the tunnel-window retry loop
+        }, timeout=900)
+        assert rec["fallback_config"] == "pinned-v1"
+        assert rec["backend"] == "cpu"
+        assert "error" in rec  # records the degradation honestly
+        assert rec["total_tokens"] == 12288  # 8*4*128 * 3 repeats
+        assert rec["steps_dispatched"] == 864
+
+    def test_fallback_override_relabels(self):
+        # a caller-overridden knob must not masquerade as the pinned config
+        rec = run_bench({
+            "JAX_PLATFORMS": "", "BENCH_INIT_TIMEOUT": "0",
+            "BENCH_TPU_WAIT_S": "0", "BENCH_CANDIDATES": "2",
+        }, timeout=900)
+        assert rec["fallback_config"] == "custom:BENCH_CANDIDATES"
