@@ -140,6 +140,22 @@ class TestSpecEngine:
         np.testing.assert_array_equal(spec.tokens, plain.tokens)
         np.testing.assert_array_equal(spec.lengths, plain.lengths)
 
+    def test_chunked_spec_parity(self, setup):
+        """scan_chunk over the speculative scheduler: the chunked program
+        (unconditional body — scan_steps_guarded) must emit exactly what
+        the host-dispatched spec loop emits, and must actually have run
+        (not a guard fallback)."""
+        params, ids, mask = setup
+        cfg = SamplingConfig(max_tokens=12, temperature=0.0, n=2)
+        host = make_engine(spec_draft=3).generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        eng = make_engine(spec_draft=3, scan_chunk=4)
+        chunked = eng.generate(params, None, ids, mask, cfg,
+                               jax.random.PRNGKey(0))
+        assert eng.scan_chunk_active
+        np.testing.assert_array_equal(chunked.tokens, host.tokens)
+        np.testing.assert_array_equal(chunked.lengths, host.lengths)
+
     @pytest.mark.slow
     def test_eos_truncates_within_draft_block(self, setup):
         """EOS anywhere inside an accepted draft block must end the row AT
