@@ -159,8 +159,19 @@ run_stage step_anatomy 900 bash -c \
 run_stage learner_anatomy 900 bash -c \
   'python tools/learner_anatomy.py > /tmp/learner_anatomy.log 2>&1; rc=$?;
    grep -E "ms|backend" /tmp/learner_anatomy.log; exit $rc'
-# 7B: the reference's headline scale (config-2), rollout then learner
+# 7B: the reference's headline scale (config-2), rollout then learner.
+# bf16 KV first: at hd=128 the int8 fixed-launch kernel Mosaic-fails, so
+# int8 KV falls through to the native kernel whose (rows x kv x pages)
+# grid is overhead-bound (~1 us/grid step; the 0.5B paged rows measured
+# it) — bf16 KV rides the FAST jaxlib fixed kernel (PASS on chip,
+# multi-page compute blocks) and fits HBM via the budget pool
+# (BASELINE.md envelope: 8.49 GiB base + 3.29 GiB realized KV @96).
 wait "$PREP_7B_PID" 2>/dev/null
+bench qwen7b_bf16kv /tmp/bench_tpu_7b_bf16kv.json 2400 \
+  BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
+  BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 BENCH_KV_PAGES=589 \
+  BENCH_EOS_RATE=0.002 BENCH_PROMPTS=12 BENCH_CANDIDATES=16 \
+  BENCH_SCAN_CHUNK=16
 bench qwen7b_int4 /tmp/bench_tpu_7b.json 2400 \
   BENCH_MODEL=qwen2.5-7b BENCH_BASE_QUANT=int4 BENCH_ENGINE=paged \
   BENCH_KV_QUANT=int8 BENCH_SCHEDULER=refill BENCH_MAX_CONCURRENT=96 \
@@ -208,7 +219,7 @@ all_done() {
   local n
   for n in prep_7b_params kernel_check chunk_check \
            dense_scan dense_scan_int8 dense_scan64 refill_scan \
-           qwen7b_int4 learner_7b budget int8kv spec_scan \
+           qwen7b_bf16kv qwen7b_int4 learner_7b budget int8kv spec_scan \
            step_anatomy learner_anatomy \
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \
