@@ -227,7 +227,7 @@ def _train_flops_per_token(cfg, seq_len: int) -> float:
 
 def _paged_dispatch_choice():
     """Which paged-attention impl the probe chain actually dispatched
-    ("native"/"fixed"/"jaxlib"/"reference"), or None if no paged dispatch
+    ("native"/"native_folded"/"fixed"/"jaxlib"/"reference"), or None if no paged dispatch
     ran. Distinct per-config choices are joined with '+'."""
     import importlib
 
@@ -526,6 +526,11 @@ def main() -> int:
         engine_kwargs["scan_chunk"] = int(os.environ["BENCH_SCAN_CHUNK"])
     if os.environ.get("BENCH_ENGINE") == "paged":
         engine_kwargs["scheduler"] = os.environ.get("BENCH_SCHEDULER", "waves")
+        if os.environ.get("BENCH_PAGED_IMPL"):
+            # force a specific paged-attention launch ("native",
+            # "native_folded", "kernel") for kernel A/Bs; default "auto"
+            # walks the probe-gated chain
+            engine_kwargs["paged_impl"] = os.environ["BENCH_PAGED_IMPL"]
         if os.environ.get("BENCH_SPEC_DRAFT"):
             # n-gram speculative decoding (needs the refill scheduler + cap)
             engine_kwargs["spec_draft"] = int(os.environ["BENCH_SPEC_DRAFT"])

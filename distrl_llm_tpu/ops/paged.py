@@ -292,7 +292,8 @@ def paged_attention_reference(
 _kernel_fail_warned = False
 _fixed_launch_state: dict = {}
 # per-config record of what the auto-dispatch chain actually chose
-# ("native" | "fixed" | "jaxlib" | "reference") — bench records surface
+# ("native" | "native_folded" | "fixed" | "jaxlib" | "reference") —
+# bench records surface
 # this so a reference-fallback run cannot masquerade as a kernel
 # measurement (same honesty contract as attn_fallback / scan_chunk_active)
 dispatch_choices: dict = {}
@@ -307,19 +308,24 @@ transient_probe_keys: set = set()
 
 def _native_call(q, k_pages, v_pages, lengths, page_indices,
                  *, quantized: bool, pages_per_compute_block: int = 0,
-                 interpret: bool = False):
+                 folded: bool = False, interpret: bool = False):
     """Adapter: the probe/dispatch launch signature → our native kernel
     (ops/paged_native.py), which takes int8 weights and compact scales as
-    separate arrays and has no compute-block knob (one page per grid step)."""
-    from distrl_llm_tpu.ops.paged_native import paged_attention_native
+    separate arrays and has no compute-block knob (one page per grid step;
+    ``folded`` selects the kv-heads-in-block variant with a (B, pps)
+    grid — half the grid steps, BASELINE.md r5 grid-overhead analysis)."""
+    from distrl_llm_tpu.ops.paged_native import (
+        paged_attention_native, paged_attention_native_folded,
+    )
 
+    kernel = paged_attention_native_folded if folded else paged_attention_native
     if quantized:
-        return paged_attention_native(
+        return kernel(
             q, k_pages.weight, v_pages.weight, lengths, page_indices,
             k_scales=k_pages.scales, v_scales=v_pages.scales,
             interpret=interpret,
         )
-    return paged_attention_native(
+    return kernel(
         q, k_pages, v_pages, lengths, page_indices, interpret=interpret
     )
 
@@ -364,6 +370,9 @@ def _probe_launch(
 
             if fn_name == "native":
                 fn = functools.partial(_native_call, quantized=quantized)
+            elif fn_name == "native_folded":
+                fn = functools.partial(
+                    _native_call, quantized=quantized, folded=True)
             elif fn_name == "fixed":
                 fn = paged_attention_int8 if quantized else paged_attention_gqa
             else:
@@ -429,7 +438,7 @@ def paged_attention_op(
     elsewhere), "kernel" (force the corrected jaxlib launch), "native"
     (force our pipeline-gather kernel, ops/paged_native.py), or
     "reference"."""
-    use_kernel = impl in ("kernel", "native") or (
+    use_kernel = impl in ("kernel", "native", "native_folded") or (
         impl == "auto" and jax.default_backend() == "tpu"
     )
     choice_key = None
@@ -470,15 +479,23 @@ def paged_attention_op(
                 q_dtype=scaled_q.dtype, kv_dtype=kw.dtype, blocks=blocks,
                 pps=pps,
             )
+            # native_folded sits BEHIND the silicon-proven native until
+            # its kernel-check stanzas PASS on chip (probes run all-zero
+            # inputs, so they catch lowering rejections but not a silent
+            # miscompile — round-3 lesson); the bench A/B forces it via
+            # BENCH_PAGED_IMPL, and the chain order flips in a follow-up
+            # once the stanzas land
             chain = (
-                ("native", "fixed", "jaxlib")
+                ("native", "native_folded", "fixed", "jaxlib")
                 if head_dim % 128
-                else ("fixed", "native", "jaxlib")
+                else ("fixed", "native", "native_folded", "jaxlib")
             )
             if impl == "kernel":  # forced: corrected launch, no probe
                 chain = ("fixed",)
             elif impl == "native":  # forced: our kernel, no probe
                 chain = ("native",)
+            elif impl == "native_folded":  # forced: kv-folded variant
+                chain = ("native_folded",)
             choice_key = (quantized, num_kv_heads, num_groups, head_dim,
                           page_size, blocks, pps)
             # sticky across calls sharing this choice_key (one trace calls
@@ -504,11 +521,12 @@ def paged_attention_op(
                 dispatch_choices[choice_key] = fn_name + (
                     "!transient-probe" if transient_seen else ""
                 )
-                if fn_name == "native":
+                if fn_name in ("native", "native_folded"):
                     return _native_call(
                         scaled_q, k_pages, v_pages,
                         lengths.astype(jnp.int32), page_indices,
                         quantized=quantized,
+                        folded=fn_name == "native_folded",
                     ).astype(q.dtype)
                 if fn_name == "fixed":
                     from distrl_llm_tpu.ops.paged_int8 import (
@@ -536,7 +554,7 @@ def paged_attention_op(
                 # retrace re-probes — flag it
                 dispatch_choices[choice_key] = "reference!transient-probe"
         except Exception as e:  # noqa: BLE001 — fall back with one warning
-            if impl in ("kernel", "native"):
+            if impl in ("kernel", "native", "native_folded"):
                 raise
             # the chain recorded its pick before launching; the launch
             # failed, so what actually runs below is the reference (keep the

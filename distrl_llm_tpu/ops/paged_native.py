@@ -205,3 +205,160 @@ def paged_attention_native(
         interpret=interpret,
     )(lengths.astype(jnp.int32), tables, *operands)
     return out.reshape(batch, num_q_heads, head_dim)
+
+
+def _paged_kernel_folded(
+    lengths_ref,  # SMEM [B] i32
+    tables_ref,  # SMEM [B, pps] i32
+    q_ref,  # VMEM [K, G, hd] — this row's full query head set
+    k_ref,  # VMEM [K, 1, ps, hd] — page j for ALL kv heads (one block)
+    v_ref,  # VMEM [K, 1, ps, hd]
+    k_s_ref,  # VMEM [K, 1, ps, 1] f32 compact scales, or None
+    v_s_ref,
+    o_ref,  # VMEM [K, G, hd]
+    m_scr,  # VMEM [K, G, 1] f32
+    l_scr,  # VMEM [K, G, 1] f32
+    acc_scr,  # VMEM [K, G, hd] f32
+    *,
+    page_size: int,
+    pps: int,
+):
+    """kv-heads-folded variant of ``_paged_kernel``: the kv-head axis rides
+    INSIDE the block instead of the grid, halving the grid-step count (the
+    0.5B paged rows measured Mosaic's ~1 µs/grid-step floor dominating at
+    (B × K × pps) granularity — BASELINE.md r5 analysis) and doubling each
+    DMA. Compute is the same online softmax, batched over K via
+    dot_general batch dims — no in-kernel head slicing, so the hd%128
+    Mosaic constraint this file exists for is still never violated."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _page():
+        q = q_ref[...].astype(jnp.float32)  # [K, G, hd] (pre-scaled)
+        k = k_ref[:, 0].astype(jnp.float32)  # [K, ps, hd]
+        v = v_ref[:, 0].astype(jnp.float32)
+        if k_s_ref is not None:
+            k = k * (k_s_ref[:, 0] * (1.0 / MAX_INT8))  # [K, ps, 1] bcast
+            v = v * (v_s_ref[:, 0] * (1.0 / MAX_INT8))
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [K, G, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_scr[...]  # [K, G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [K, G, ps]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [K, G, hd]
+        m_scr[...] = m_new
+
+    @pl.when(j == pps - 1)
+    def _emit():
+        o_ref[...] = (
+            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "interpret"),
+)
+def paged_attention_native_folded(
+    q: jax.Array,  # [B, H, hd] — pre-scaled by hd**-0.5 (op contract)
+    k_pages: jax.Array,  # [K, P, ps, hd] bf16/f32, or int8 weight
+    v_pages: jax.Array,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pps]
+    k_scales: jax.Array | None = None,  # f32 [K, P, ps, 1] compact (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    page_size: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Launch for ``_paged_kernel_folded`` — same contract as
+    ``paged_attention_native`` with a (B, pps) grid."""
+    batch, num_q_heads, head_dim = q.shape
+    num_kv_heads, total_pages, ps, head_dim_k = k_pages.shape
+    if page_size is None:
+        page_size = ps
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch: {head_dim_k} vs {head_dim}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"H={num_q_heads} not divisible by K={num_kv_heads}"
+        )
+    groups = num_q_heads // num_kv_heads
+    _, pps = page_indices.shape
+    quantized = k_scales is not None
+
+    tables = jnp.clip(page_indices.astype(jnp.int32), 0, total_pages - 1)
+    q4 = q.reshape(batch, num_kv_heads, groups, head_dim)
+
+    q_spec = pl.BlockSpec(
+        (None, num_kv_heads, groups, head_dim),
+        lambda b, j, lens, tabs: (b, 0, 0, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (num_kv_heads, 1, page_size, head_dim),
+        lambda b, j, lens, tabs: (0, tabs[b, j], 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (num_kv_heads, 1, page_size, 1),
+        lambda b, j, lens, tabs: (0, tabs[b, j], 0, 0),
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q4, k_pages, v_pages]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
+        body = functools.partial(
+            _paged_kernel_folded, page_size=page_size, pps=pps)
+    else:
+
+        def body(lens, tabs, qr, kr, vr, o, m, l, a):  # noqa: E741
+            _paged_kernel_folded(
+                lens, tabs, qr, kr, vr, None, None, o, m, l, a,
+                page_size=page_size, pps=pps,
+            )
+
+    out = pl.pallas_call(
+        body,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, pps),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (None, num_kv_heads, groups, head_dim),
+                lambda b, j, lens, tabs: (b, 0, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((num_kv_heads, groups, 1), jnp.float32),
+                pltpu.VMEM((num_kv_heads, groups, 1), jnp.float32),
+                pltpu.VMEM((num_kv_heads, groups, head_dim), jnp.float32),
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, num_kv_heads, groups, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables, *operands)
+    return out.reshape(batch, num_q_heads, head_dim)

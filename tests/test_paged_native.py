@@ -18,7 +18,15 @@ from distrl_llm_tpu.ops.paged import (
     paged_attention_reference,
     quantize_pages,
 )
-from distrl_llm_tpu.ops.paged_native import paged_attention_native
+from distrl_llm_tpu.ops.paged_native import (
+    paged_attention_native,
+    paged_attention_native_folded,
+)
+
+KERNELS = {
+    "native": paged_attention_native,
+    "folded": paged_attention_native_folded,
+}
 
 
 def _setup(b, h, kh, hd, ps, pps, seed=0, lengths=None):
@@ -34,15 +42,23 @@ def _setup(b, h, kh, hd, ps, pps, seed=0, lengths=None):
     return q, kp, vp, lengths, table
 
 
-def _native(q, kp, vp, lengths, table, **kw):
-    hd = q.shape[-1]
-    return paged_attention_native(
-        q * hd**-0.5, kp, vp, lengths, table, interpret=True, **kw
-    )
+@pytest.fixture(params=sorted(KERNELS))
+def _native(request):
+    """Both launch variants share every parity case: the folded kernel's
+    only difference is grid/block shape (kv heads inside the block)."""
+    kernel = KERNELS[request.param]
+
+    def call(q, kp, vp, lengths, table, **kw):
+        hd = q.shape[-1]
+        return kernel(
+            q * hd**-0.5, kp, vp, lengths, table, interpret=True, **kw
+        )
+
+    return call
 
 
 class TestNativePagedParity:
-    def test_qwen05b_geometry(self):
+    def test_qwen05b_geometry(self, _native):
         """14 q heads / 2 kv heads / hd=64 — the exact config both jaxlib
         kernels reject on real Mosaic."""
         q, kp, vp, lengths, table = _setup(b=4, h=14, kh=2, hd=64, ps=8, pps=3)
@@ -52,7 +68,7 @@ class TestNativePagedParity:
             np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
         )
 
-    def test_hd128_and_mha(self):
+    def test_hd128_and_mha(self, _native):
         for h, kh, hd in ((8, 8, 128), (4, 1, 32)):
             q, kp, vp, lengths, table = _setup(
                 b=3, h=h, kh=kh, hd=hd, ps=8, pps=2, seed=h
@@ -63,7 +79,7 @@ class TestNativePagedParity:
                 np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
             )
 
-    def test_dead_rows_emit_zeros_not_nan(self):
+    def test_dead_rows_emit_zeros_not_nan(self, _native):
         """length-0 rows (empty decode slots) must produce finite output —
         a NaN would poison the logsumexp capture path even though the done
         mask discards the sampled token."""
@@ -75,7 +91,7 @@ class TestNativePagedParity:
         want = np.asarray(paged_attention_reference(q, kp, vp, lengths, table))
         np.testing.assert_allclose(got[[0, 2]], want[[0, 2]], atol=2e-5, rtol=2e-5)
 
-    def test_single_page_sequences(self):
+    def test_single_page_sequences(self, _native):
         q, kp, vp, _, table = _setup(b=2, h=4, kh=2, hd=64, ps=8, pps=1)
         lengths = jnp.asarray([3, 8], jnp.int32)
         got = _native(q, kp, vp, lengths, table)
@@ -84,7 +100,7 @@ class TestNativePagedParity:
             np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
         )
 
-    def test_garbage_table_entries_beyond_length_ignored(self):
+    def test_garbage_table_entries_beyond_length_ignored(self, _native):
         """Entries past a row's allocated pages may be stale ids — clamped
         and masked, they must not affect the output."""
         q, kp, vp, _, table = _setup(b=2, h=4, kh=2, hd=64, ps=8, pps=3)
@@ -96,7 +112,7 @@ class TestNativePagedParity:
         got = _native(q, kp, vp, lengths, jnp.asarray(poisoned))
         np.testing.assert_allclose(np.asarray(got), np.asarray(base), atol=0, rtol=0)
 
-    def test_int8_compact_scales(self):
+    def test_int8_compact_scales(self, _native):
         q, kp, vp, lengths, table = _setup(b=4, h=14, kh=2, hd=64, ps=8, pps=3)
         kq = quantize_pages(jnp.asarray(kp, jnp.bfloat16))
         vq = quantize_pages(jnp.asarray(vp, jnp.bfloat16))

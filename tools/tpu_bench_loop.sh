@@ -147,6 +147,10 @@ bench_scan dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
 bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
+# kv-folded native kernel A/B vs the first window's `paged` row (1,795
+# tok/s, native): same waves config, half the Pallas grid steps
+bench paged_folded /tmp/bench_tpu_paged_folded.json \
+  BENCH_ENGINE=paged BENCH_PAGED_IMPL=native_folded
 # step-time decomposition at bench shapes: forward vs sampling vs full
 # step — locates the per-step cost beyond the bandwidth roofline
 run_stage step_anatomy 900 bash -c \
@@ -220,6 +224,7 @@ all_done() {
   for n in prep_7b_params kernel_check chunk_check \
            dense_scan dense_scan_int8 dense_scan64 refill_scan \
            qwen7b_bf16kv qwen7b_int4 learner_7b budget int8kv spec_scan \
+           paged_folded \
            step_anatomy learner_anatomy \
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \

@@ -176,6 +176,17 @@ def main() -> int:
     # the path the 7B int4+int8KV config actually decodes through, so it
     # needs its own silicon datapoint
     check_paged("paged_native_hd128_int8", 28, kq128, vq128, "native")
+    # kv-heads-folded variant (half the grid steps — BASELINE.md r5
+    # grid-overhead analysis): first in the auto chain for hd%128 once
+    # these stanzas PASS on silicon
+    check_paged("paged_folded_hd64_gqa14", 14, kp64, vp64, "native_folded")
+    check_paged("paged_folded_hd128", 28, kp128, vp128, "native_folded")
+    check_paged(
+        "paged_folded_hd64_int8", 14,
+        quantize_pages(kp64.astype(jnp.float32)),
+        quantize_pages(vp64.astype(jnp.float32)), "native_folded",
+    )
+    check_paged("paged_folded_hd128_int8", 28, kq128, vq128, "native_folded")
 
     # ---- donated decode-step HBM audit (TPU only — CPU memory_analysis
     # does not model donation aliasing, so this cannot run in CI): the
