@@ -126,6 +126,17 @@ bench_scan() {
 matrix() {
 run_prep prep_7b_params 1800 python tools/prep_params.py qwen2.5-7b int4 &
 PREP_7B_PID=$!
+# the dispatch-amortization A/B against this session's *_fallback rows
+bench_scan dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
+  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
+bench_scan dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
+bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
+# kv-folded native kernel A/B vs the first window's `paged` row (1,795
+# tok/s, native): same waves config, half the Pallas grid steps
+bench paged_folded /tmp/bench_tpu_paged_folded.json \
+  BENCH_ENGINE=paged BENCH_PAGED_IMPL=native_folded
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
    grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
@@ -140,17 +151,6 @@ run_stage kernel_check 900 bash -c \
 run_stage chunk_check 1500 bash -c \
   'python tools/chunk_compile_check.py > /tmp/chunk_compile_check.log 2>&1; rc=$?;
    grep -E "ACCEPTED|REJECTED|ALL" /tmp/chunk_compile_check.log; exit $rc'
-# the dispatch-amortization A/B against this session's *_fallback rows
-bench_scan dense_scan /tmp/bench_tpu_dense_scan.json BENCH_SCAN_CHUNK=16
-bench_scan dense_scan_int8 /tmp/bench_tpu_dense_scan_int8.json \
-  BENCH_SCAN_CHUNK=16 BENCH_KV_QUANT=int8 BENCH_TOP_P_IMPL=bisect_mw
-bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
-  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
-  BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
-# kv-folded native kernel A/B vs the first window's `paged` row (1,795
-# tok/s, native): same waves config, half the Pallas grid steps
-bench paged_folded /tmp/bench_tpu_paged_folded.json \
-  BENCH_ENGINE=paged BENCH_PAGED_IMPL=native_folded
 # step-time decomposition at bench shapes: forward vs sampling vs full
 # step — locates the per-step cost beyond the bandwidth roofline
 run_stage step_anatomy 900 bash -c \
