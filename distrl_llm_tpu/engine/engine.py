@@ -122,7 +122,8 @@ def _decode_init(cache, key_mask, first_logits, row_alive,
 def _decode_step(params, lora, state: _DecodeState, rng,
                  *, cfg: ModelConfig, prompt_len: int, eos_ids, pad_id: int,
                  temperature, top_p, lora_scale: float, attn_impl: str,
-                 top_p_impl: str = "bisect", capture_logprobs: bool = False):
+                 top_p_impl: str = "bisect", capture_logprobs: bool = False,
+                 cache_read_formulation: str = "dot"):
     """One decode step: sample from the carried logits, write token + KV,
     forward one position.
 
@@ -161,6 +162,7 @@ def _decode_step(params, lora, state: _DecodeState, rng,
         attention_mask=key_mask, lora=lora, lora_scale=lora_scale,
         kv_cache=s.cache, cache_offset=prompt_len + s.step,
         attn_impl=attn_impl,
+        cache_read_formulation=cache_read_formulation,
     )
     return _DecodeState(
         step=s.step + 1, out=out, logps=logps, lengths=lengths, done=done,
@@ -172,7 +174,8 @@ def _decode_chunk(params, lora, state: _DecodeState, rng,
                   *, chunk: int, cfg: ModelConfig,
                   prompt_len: int, eos_ids, pad_id: int, temperature, top_p,
                   lora_scale: float, attn_impl: str, top_p_impl: str,
-                  capture_logprobs: bool):
+                  capture_logprobs: bool,
+                  cache_read_formulation: str = "mulred"):
     """``chunk`` decode steps in ONE dispatch via ``lax.scan``.
 
     Over the axon tunnel each host dispatch can cost a network round trip
@@ -199,6 +202,7 @@ def _decode_chunk(params, lora, state: _DecodeState, rng,
             eos_ids=eos_ids, pad_id=pad_id, temperature=temperature,
             top_p=top_p, lora_scale=lora_scale, attn_impl=attn_impl,
             top_p_impl=top_p_impl, capture_logprobs=capture_logprobs,
+            cache_read_formulation=cache_read_formulation,
         )
 
     return scan_steps_guarded(run, state, chunk)
@@ -557,12 +561,27 @@ class GenerationEngine(LoraMailbox):
         prompt_buckets: Sequence[int] | None = None,
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
         capture_logprobs: bool = False,  # record behavior logprobs (clip_ratio)
+        cache_read_formulation: str | None = None,  # None = auto by scan_chunk
     ):
         self.max_concurrent_rows = max_concurrent_rows
         self.capture_logprobs = capture_logprobs
         if scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
         self.scan_chunk = scan_chunk
+        # Chunk-configured engines read the cache via multiply+reduce in BOTH
+        # the chunk program and the host-dispatched steps (tail / guard
+        # fallback): a dot_general over the scanned carry makes TPU layout
+        # assignment insert per-leaf relayout copies that OOM the program
+        # (see ops.attention.attention_cached), and using one formulation
+        # everywhere keeps chunk-vs-host greedy decode bit-identical. The
+        # explicit kwarg exists for parity tests and on-chip formulation
+        # A/Bs; None picks the right one for the dispatch mode.
+        if cache_read_formulation not in (None, "dot", "mulred"):
+            raise ValueError(
+                "cache_read_formulation must be None/'dot'/'mulred', got "
+                f"{cache_read_formulation!r}")
+        self.cache_read_formulation = (
+            cache_read_formulation or ("mulred" if scan_chunk else "dot"))
         # buckets where the chunked program compiled WITHOUT double-buffering
         # the KV cache (memory_analysis guard) hold their compiled fn here;
         # buckets where it did are marked None and use the host loop
@@ -653,6 +672,7 @@ class GenerationEngine(LoraMailbox):
                         pad_id=self.pad_id, lora_scale=self.lora_scale,
                         attn_impl=self.attn_impl,
                         capture_logprobs=self.capture_logprobs,
+                        cache_read_formulation=self.cache_read_formulation,
                     ),
                     donate_argnames=("state",),
                     static_argnames=("top_p_impl",),
@@ -691,6 +711,7 @@ class GenerationEngine(LoraMailbox):
                     pad_id=self.pad_id, lora_scale=self.lora_scale,
                     attn_impl=self.attn_impl, top_p_impl=top_p_impl,
                     capture_logprobs=self.capture_logprobs,
+                    cache_read_formulation=self.cache_read_formulation,
                 ),
                 donate_argnames=("state",),
             )

@@ -108,6 +108,7 @@ def _layer(
     paged_chunked: bool = False,  # S>1 continuation (chunked) prefill
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,  # per-layer key (training only)
+    cache_read_formulation: str = "dot",  # "mulred" inside scan-chunk bodies
 ):
     b, s, _ = x.shape
     proj = partial(_proj, lora_dropout=lora_dropout, dropout_rng=dropout_rng)
@@ -217,10 +218,14 @@ def _layer(
             from distrl_llm_tpu.ops.attention import attention_cached_quant
 
             att = attention_cached_quant(
-                q, cache_k, cache_k_scale, cache_v, cache_v_scale, mask
+                q, cache_k, cache_k_scale, cache_v, cache_v_scale, mask,
+                formulation=cache_read_formulation,
             )
         else:
-            att = attention_cached(q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask)
+            att = attention_cached(
+                q, cache_k.astype(q.dtype), cache_v.astype(q.dtype), mask,
+                formulation=cache_read_formulation,
+            )
     elif attn_impl == "ring" and attn_mesh is not None:
         # sequence-parallel training path: causal+padding semantics come from
         # global positions inside the ring, not from the materialized mask
@@ -272,6 +277,7 @@ def forward(
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
     skip_lm_head: bool = False,  # return final-norm hidden states, not logits
+    cache_read_formulation: str = "dot",  # see ops.attention.attention_cached
 ) -> tuple[jax.Array, Params | None]:
     """Decoder forward. Returns (logits f32 [B, S, V], updated kv_cache).
 
@@ -349,6 +355,7 @@ def forward(
         paged_verify=paged_verify,
         paged_chunked=paged_chunked,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
+        cache_read_formulation=cache_read_formulation,
     )
 
     layer_keys = (

@@ -69,14 +69,28 @@ def attention_cached(
     v: jax.Array,  # [B, K, D, Sk]
     mask: jax.Array | None,  # [B, 1|H, Sq, Sk]; True = attend
     scale: float | None = None,
+    formulation: str = "dot",
 ) -> jax.Array:
     """Masked GQA attention against a [B, K, D, S] KV cache.
 
     The cache keeps S as its minormost dim — the layout XLA's layout
     assignment picks for the decode while-loop. Storing the cache any other
     way makes XLA insert full-cache conversion copies inside the loop (two
-    extra cache-sized HBM temps that break donation aliasing)."""
-    return _gqa_attention(q, k, v, mask, scale, kv_subscript="bkds", kv_heads_axis=1)
+    extra cache-sized HBM temps that break donation aliasing).
+
+    ``formulation="mulred"`` switches the Sq==1 decode read from
+    ``dot_general`` to multiply+reduce — required inside K-steps-per-dispatch
+    scan programs, where ANY dot over the carried cache makes TPU layout
+    assignment relayout the operand to a B-minormost layout with a
+    cache-leaf-sized conversion copy per leaf per iteration, defeating
+    in-place aliasing and OOMing the program (r5 silicon finding; the
+    9-variant ladder in tools/chunk_alias_bisect.py isolates it — operand
+    order and which einsum are irrelevant, only mul+reduce keeps the native
+    layout). Reduce-of-product fuses into the cache read, so HBM traffic is
+    identical; the MXU is ~idle at one query token either way. Sq>1 calls
+    (prefill) always use the dot path."""
+    return _gqa_attention(q, k, v, mask, scale, kv_subscript="bkds",
+                          kv_heads_axis=1, formulation=formulation)
 
 
 def quantize_kv_position(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -96,6 +110,7 @@ def attention_cached_quant(
     v_scale: jax.Array,  # f32 [B, K, 1, Sk]
     mask: jax.Array | None,
     scale: float | None = None,
+    formulation: str = "dot",
 ) -> jax.Array:
     """Masked GQA attention against an int8 KV cache with per-position
     scales, dequantization FOLDED into the attention math so the cache is
@@ -109,15 +124,20 @@ def attention_cached_quant(
 
     XLA fuses the int8→f32 convert into the dot-operand read; the
     decode-step HBM audit in tools/tpu_kernel_check.py is the on-chip
-    check that no f32 cache-sized temp materializes."""
+    check that no f32 cache-sized temp materializes.
+
+    ``formulation="mulred"`` — see attention_cached: mandatory for the
+    scan-chunk programs, where a dot over the carried int8 cache costs a
+    per-leaf relayout copy per iteration."""
     return _gqa_attention(
         q, k8, v8, mask, scale, kv_subscript="bkds", kv_heads_axis=1,
-        k_scale=k_scale, v_scale=v_scale,
+        k_scale=k_scale, v_scale=v_scale, formulation=formulation,
     ).astype(q.dtype)
 
 
 def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
-                   kv_heads_axis: int, k_scale=None, v_scale=None):
+                   kv_heads_axis: int, k_scale=None, v_scale=None,
+                   formulation: str = "dot"):
     """Shared GQA attention body; only the kv einsum layout differs between
     the training ([B,S,K,D]) and decode-cache ([B,K,D,S]) paths.
 
@@ -132,6 +152,9 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
     g = h // kh
     if scale is None:
         scale = d**-0.5
+    if formulation == "mulred" and sq == 1 and kv_heads_axis == 1:
+        return _gqa_mulred(q, k, v, mask, scale, k_scale=k_scale,
+                           v_scale=v_scale)
     qg = q.reshape(b, sq, kh, g, d)
     if quant:
         qg = qg.astype(jnp.float32)
@@ -156,6 +179,43 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
         probs = probs.astype(v.dtype)
     out = jnp.einsum(f"bkgqs,{kv_subscript}->bqkgd", probs, v)
     return out.reshape(b, sq, h, d)
+
+
+def _gqa_mulred(q, k, v, mask, scale, *, k_scale=None, v_scale=None):
+    """Sq==1 decode attention as multiply+reduce over the [B, K, D, S]
+    cache — no ``dot_general`` touches the cache operands, so TPU layout
+    assignment keeps the carry's native S-minormost layout inside scan
+    programs instead of inserting cache-sized relayout copies each
+    iteration (attention_cached's docstring has the full story). Both
+    contractions accumulate in f32 (the dot path's k-side did too via
+    preferred_element_type; the v-side rounded at bf16 — mulred is the
+    same or slightly better numerically). XLA fuses reduce-of-product
+    into the cache read: one pass over K + one over V, the same HBM
+    traffic as the dot formulation."""
+    quant = k_scale is not None
+    b, _, h, d = q.shape
+    kh = k.shape[1]
+    g = h // kh
+    qv = q.reshape(b, kh, g, d).astype(jnp.float32)
+    # logits[b,k,g,s] = sum_d q[b,k,g,d] * K[b,k,d,s]
+    logits = jnp.sum(qv[..., None] * k.astype(jnp.float32)[:, :, None], axis=-2)
+    if quant:
+        logits = logits * k_scale[:, :, None, 0, :]  # [B, K, 1, Sk]
+    logits = logits * scale
+    if mask is not None:  # [B, 1|H, 1, Sk]
+        m = (
+            mask[:, :, None, 0, :]  # head-agnostic -> [B, 1, 1, Sk]
+            if mask.shape[1] == 1
+            else mask[:, :, 0, :].reshape(b, kh, g, mask.shape[-1])
+        )
+        logits = jnp.where(m, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)  # [B, K, G, Sk] f32
+    if quant:
+        probs = probs * v_scale[:, :, None, 0, :]
+    # out[b,k,g,d] = sum_s probs[b,k,g,s] * V[b,k,d,s]
+    out = jnp.sum(probs[:, :, :, None, :] * v.astype(jnp.float32)[:, :, None],
+                  axis=-1)
+    return out.reshape(b, 1, h, d).astype(q.dtype)
 
 
 import logging

@@ -341,7 +341,11 @@ class TestScanChunk:
         kw = dict(max_prompt_tokens=P_LEN, max_new_tokens=max_new,
                   eos_token_ids=eos or [TINY.vocab_size - 1], pad_token_id=0,
                   cache_dtype=jnp.float32, capture_logprobs=capture)
-        host = GenerationEngine(TINY, **kw)
+        # chunk engines decode with the mulred cache read (the dot
+        # formulation relayout-copies the scanned carry on TPU); pin the
+        # host reference to the same math so this class compares DISPATCH
+        # modes bit-exactly, not float formulations
+        host = GenerationEngine(TINY, cache_read_formulation="mulred", **kw)
         chunked = GenerationEngine(TINY, scan_chunk=scan_chunk, **kw)
         return host, chunked
 

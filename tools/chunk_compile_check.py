@@ -83,6 +83,7 @@ def main() -> int:
                 E._decode_chunk, chunk=CHUNK, cfg=cfg, prompt_len=P_,
                 pad_id=0, lora_scale=1.0, attn_impl="reference",
                 top_p_impl="bisect", capture_logprobs=False,
+                cache_read_formulation="mulred",  # what chunk engines use
             ),
             donate_argnames=("state",),
         )
