@@ -73,17 +73,9 @@ def _probe_backend(timeout_s: float) -> tuple[list | None, str | None]:
 
 
 def _decode_flops_per_token(cfg, mean_kv_len: float) -> float:
-    """Model FLOPs per decoded token: 2·(matmul params) for the dense path
-    plus the attention score/value dot-products at the mean KV length."""
-    per_layer = (
-        cfg.hidden_size * cfg.q_dim          # q proj
-        + 2 * cfg.hidden_size * cfg.kv_dim   # k, v proj
-        + cfg.q_dim * cfg.hidden_size        # o proj
-        + 3 * cfg.hidden_size * cfg.intermediate_size  # gate, up, down
-    )
-    matmul_params = cfg.num_layers * per_layer + cfg.hidden_size * cfg.vocab_size
-    attn = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim * mean_kv_len
-    return 2.0 * matmul_params + attn
+    """Model FLOPs per decoded token — the FLOPs math lives on ModelConfig
+    (models/configs.py) so bench and the telemetry MFU series agree."""
+    return cfg.decode_flops_per_token(mean_kv_len)
 
 
 def _emit(record: dict) -> None:
@@ -211,18 +203,9 @@ def _decode_roofline_tok_s(
 
 
 def _train_flops_per_token(cfg, seq_len: int) -> float:
-    """Model FLOPs per trained token: 3× the forward's 2·matmul-params
-    (fwd + ~2× for backward through frozen base + LoRA) plus causal
-    attention dot-products at mean key length seq_len/2, also ×3."""
-    per_layer = (
-        cfg.hidden_size * cfg.q_dim
-        + 2 * cfg.hidden_size * cfg.kv_dim
-        + cfg.q_dim * cfg.hidden_size
-        + 3 * cfg.hidden_size * cfg.intermediate_size
-    )
-    matmul_params = cfg.num_layers * per_layer + cfg.hidden_size * cfg.vocab_size
-    attn = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim * (seq_len / 2.0)
-    return 3.0 * (2.0 * matmul_params + attn)
+    """Model FLOPs per trained token — delegated to ModelConfig
+    (models/configs.py), the single owner of the FLOPs estimates."""
+    return cfg.train_flops_per_token(seq_len)
 
 
 def _paged_dispatch_choice():

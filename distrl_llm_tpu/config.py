@@ -289,6 +289,15 @@ class TrainConfig:
     profile_dir: str | None = None
     profile_start_step: int = 2
     profile_num_steps: int = 3
+    # Span-trace capture (telemetry.py): when set, the trainer records
+    # driver (generation/reward/update/eval), engine (prefill/decode), and
+    # worker spans — workers ship theirs back over the control plane — and
+    # writes one Chrome-trace/Perfetto JSON to trace_dir/trace.json.
+    # trace_steps > 0 limits recording to the first N train steps (the file
+    # is written when the window closes); 0 traces the whole run and writes
+    # at shutdown. Orthogonal to profile_dir (device-level XLA traces).
+    trace_dir: str | None = None
+    trace_steps: int = 0
     # Hang detector on generation rounds — parity with the reference's
     # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
     # default: a first rollout legitimately spends minutes in XLA compilation;
@@ -351,6 +360,12 @@ class TrainConfig:
             raise ValueError(
                 f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
             )
+        if self.trace_steps < 0:
+            raise ValueError(
+                f"trace_steps must be >= 0, got {self.trace_steps}"
+            )
+        if self.trace_steps and not self.trace_dir:
+            raise ValueError("trace_steps requires trace_dir")
         # decode_scan_chunk covers every engine_impl and scheduler (dense,
         # paged wave + refill + speculative, paged_sharded)
         if self.continuous_batching and (

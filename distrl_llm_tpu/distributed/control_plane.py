@@ -25,10 +25,12 @@ import ctypes
 import logging
 import pickle
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
+from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.native.build import build_library
 
 log = logging.getLogger(__name__)
@@ -39,6 +41,11 @@ MSG_PING = 3
 MSG_PONG = 4
 MSG_SHUTDOWN = 5
 MSG_ERROR = 6
+# RESULT with a telemetry blob piggybacked: payload is
+# pickle((blob, result_bytes)). Workers send it only when they actually
+# recorded spans (DISTRL_TRACE / --trace), so untraced runs keep the plain
+# MSG_RESULT frame and zero overhead.
+MSG_RESULT_TLM = 7
 
 
 class WorkerDeadError(RuntimeError):
@@ -168,7 +175,17 @@ class WorkerServer:
             elif msg_type == MSG_DISPATCH:
                 try:
                     result = handler(payload)
-                    conn.send(MSG_RESULT, req_id, result)
+                    # spans the handler recorded ride home on the response
+                    # (the worker has no trace file of its own; the driver
+                    # merges them under a per-worker track)
+                    blob = telemetry.drain_remote_blob()
+                    if blob is not None:
+                        conn.send(
+                            MSG_RESULT_TLM, req_id,
+                            pickle.dumps((blob, result)),
+                        )
+                    else:
+                        conn.send(MSG_RESULT, req_id, result)
                 except Exception:  # noqa: BLE001 — shipped to the driver
                     conn.send(
                         MSG_ERROR, req_id, traceback.format_exc().encode()
@@ -220,6 +237,7 @@ class DriverClient:
             if w.conn is not None:
                 rid = self._next_id()
                 try:
+                    t0 = time.perf_counter()
                     w.conn.send(MSG_PING, rid)
                     frame = w.conn.recv(timeout_ms)
                     ok = (
@@ -227,6 +245,10 @@ class DriverClient:
                         and frame[0] == MSG_PONG
                         and frame[1] == rid
                     )
+                    if ok:
+                        telemetry.hist_observe(
+                            "cp/rpc_ping_ms", (time.perf_counter() - t0) * 1e3
+                        )
                 except WorkerDeadError:
                     ok = False
                 if not ok:
@@ -238,19 +260,33 @@ class DriverClient:
 
     def _call(self, w: _Worker, payload: bytes, timeout_ms: int) -> bytes:
         rid = self._next_id()
-        w.conn.send(MSG_DISPATCH, rid, payload)
-        frame = w.conn.recv(timeout_ms)
+        host, port = w.address
+        with telemetry.span("cp/dispatch", worker=f"{host}:{port}",
+                            bytes=len(payload)):
+            t0 = time.perf_counter()
+            w.conn.send(MSG_DISPATCH, rid, payload)
+            frame = w.conn.recv(timeout_ms)
         if frame is None:
             raise WorkerDeadError(
                 f"worker {w.address} missed the {timeout_ms}ms deadline"
             )
         msg_type, got_rid, body = frame
-        if got_rid != rid or msg_type not in (MSG_RESULT, MSG_ERROR):
+        if got_rid != rid or msg_type not in (
+            MSG_RESULT, MSG_RESULT_TLM, MSG_ERROR
+        ):
             raise WorkerDeadError(f"worker {w.address} protocol violation")
         if msg_type == MSG_ERROR:
             raise RuntimeError(
                 f"worker {w.address} raised:\n{body.decode(errors='replace')}"
             )
+        if msg_type == MSG_RESULT_TLM:
+            # worker-recorded spans piggybacked on the result: merge them
+            # into the driver trace under this worker's track
+            blob, body = pickle.loads(body)
+            telemetry.ingest_remote(blob, track=f"worker {host}:{port}")
+        telemetry.hist_observe(
+            "cp/rpc_dispatch_ms", (time.perf_counter() - t0) * 1e3
+        )
         return body
 
     def dispatch_round(self, shards: Sequence[bytes],

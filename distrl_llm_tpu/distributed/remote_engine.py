@@ -18,14 +18,16 @@ model, exactly like a Ray actor holds its own GPU copy.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Sequence
 
 import jax
 import numpy as np
 
+from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.config import SamplingConfig
 from distrl_llm_tpu.distributed.control_plane import DriverClient
-from distrl_llm_tpu.engine.engine import GenerationResult
+from distrl_llm_tpu.engine.engine import GenerationResult, accumulate_round_stats
 from distrl_llm_tpu.utils.chunking import even_chunks
 
 
@@ -59,6 +61,10 @@ class RemoteEngine:
         # gets the cold-compile allowance, like trainer._call_engine's
         # per-(role, bucket, rows, n) warm keys on the local path
         self._warm_keys: set[tuple] = set()
+        # per-round timing/token counts (engine.accumulate_round_stats
+        # contract): remote rounds have no local prefill/decode split, so
+        # the whole RPC fan-out is accounted as decode time
+        self.last_round_stats: dict | None = None
 
     def generate(
         self,
@@ -105,10 +111,21 @@ class RemoteEngine:
         timeout = self.timeout_ms if warm_key in self._warm_keys else max(
             self.timeout_ms, self.cold_timeout_ms
         )
-        results = self.driver.dispatch_objects(shards, timeout_ms=timeout)
+        t0 = time.perf_counter()
+        with telemetry.span("engine/remote_round", rows=b,
+                            shards=len(sizes)) as sp:
+            results = self.driver.dispatch_objects(shards, timeout_ms=timeout)
+            tokens = np.concatenate([r["tokens"] for r in results], axis=0)
+            lengths = np.concatenate([r["lengths"] for r in results], axis=0)
+            gen_tokens = int(lengths.sum())
+            sp.set(tokens=gen_tokens)
         self._warm_keys.add(warm_key)
-        tokens = np.concatenate([r["tokens"] for r in results], axis=0)
-        lengths = np.concatenate([r["lengths"] for r in results], axis=0)
+        self.last_round_stats = accumulate_round_stats(
+            None, prefill_s=0.0,
+            prefill_tokens=int(np.asarray(prompt_mask).sum()), prompt_rows=b,
+            decode_s=time.perf_counter() - t0, gen_tokens=gen_tokens,
+            gen_rows=b * max(sampling.n, 1),
+        )
         logps = None
         if all(r.get("logprobs") is not None for r in results):
             logps = np.concatenate([r["logprobs"] for r in results], axis=0)

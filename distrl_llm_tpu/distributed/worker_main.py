@@ -108,20 +108,27 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
 
 
 def handler(payload: bytes) -> bytes:
+    from distrl_llm_tpu import telemetry
     from distrl_llm_tpu.rewards import reward_function
 
     op, arg = pickle.loads(payload)
+    # span per op: with tracing on (--trace / DISTRL_TRACE=1) these ship
+    # back to the driver in the RPC response and land on this worker's
+    # track in the merged trace (control_plane MSG_RESULT_TLM)
     if op == "echo":
-        return pickle.dumps(arg)
+        with telemetry.span("worker/echo"):
+            return pickle.dumps(arg)
     if op == "sleep":
         time.sleep(float(arg))
         return pickle.dumps("slept")
     if op == "rollout_rewards":
-        rewards = [
-            reward_function(answers, solutions)
-            for answers, solutions in zip(arg["answers"], arg["solution"])
-        ]
-        return pickle.dumps(rewards)
+        with telemetry.span("worker/rollout_rewards",
+                            groups=len(arg["answers"])):
+            rewards = [
+                reward_function(answers, solutions)
+                for answers, solutions in zip(arg["answers"], arg["solution"])
+            ]
+            return pickle.dumps(rewards)
     if op == "generate":
         if "engine" not in _ENGINE_STATE:
             raise RuntimeError("worker started without --serve-model")
@@ -150,12 +157,17 @@ def handler(payload: bytes) -> bytes:
             _ENGINE_STATE["engine"].eos_ids = jnp.asarray(
                 sorted(set(int(e) for e in eos_override)), jnp.int32
             )
-        result = _ENGINE_STATE["engine"].generate(
-            _ENGINE_STATE["params"], lora,
-            arg["prompt_ids"], arg["prompt_mask"],
-            SamplingConfig(**arg["sampling"]),
-            jax.random.PRNGKey(arg["rng_seed"]),
-        )
+        with telemetry.span(
+            "worker/generate", rows=int(arg["prompt_ids"].shape[0]),
+            n=int(arg["sampling"].get("n", 1)),
+        ) as sp:
+            result = _ENGINE_STATE["engine"].generate(
+                _ENGINE_STATE["params"], lora,
+                arg["prompt_ids"], arg["prompt_mask"],
+                SamplingConfig(**arg["sampling"]),
+                jax.random.PRNGKey(arg["rng_seed"]),
+            )
+            sp.set(tokens=int(result.lengths.sum()))
         return pickle.dumps({
             "tokens": result.tokens, "lengths": result.lengths,
             "logprobs": result.logprobs,
@@ -203,7 +215,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--budget-batch", type=int, default=0,
                         help="prompts per round assumed by the page-budget "
                              "math (shared prompt-page region)")
+    parser.add_argument("--trace", action="store_true",
+                        help="record telemetry spans and ship them to the "
+                             "driver in RPC responses (also enabled by "
+                             "DISTRL_TRACE=1); the driver merges them into "
+                             "its trace under this worker's track")
     args = parser.parse_args(argv)
+    if args.trace:
+        from distrl_llm_tpu import telemetry
+
+        telemetry.configure(enabled=True)
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
     if args.spec_draft and args.scheduler != "refill":

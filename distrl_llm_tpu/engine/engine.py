@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from functools import partial
 from typing import Any, NamedTuple, Sequence
 
@@ -37,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.config import SamplingConfig
 from distrl_llm_tpu.models.configs import ModelConfig
 from distrl_llm_tpu.models.transformer import (
@@ -357,6 +359,29 @@ def cached_chunk_program(cache: dict, mu, key, fn_jit, alias_bytes: int,
         return cache[key]
 
 
+def accumulate_round_stats(
+    stats: dict | None, *, prefill_s: float, prefill_tokens: int,
+    prompt_rows: int, decode_s: float, gen_tokens: int, gen_rows: int,
+) -> dict:
+    """Fold one wave's timing/token counts into a round's running stats —
+    the ``last_round_stats`` contract every engine shares. The trainer
+    snapshots this per round (like ``last_pool_stats``) and derives the
+    ``engine/prefill_tok_s`` / ``engine/decode_tok_s`` / ``engine/mfu``
+    metric series from it."""
+    if stats is None:
+        stats = {
+            "prefill_s": 0.0, "prefill_tokens": 0, "prompt_rows": 0,
+            "decode_s": 0.0, "gen_tokens": 0, "gen_rows": 0,
+        }
+    stats["prefill_s"] += prefill_s
+    stats["prefill_tokens"] += prefill_tokens
+    stats["prompt_rows"] += prompt_rows
+    stats["decode_s"] += decode_s
+    stats["gen_tokens"] += gen_tokens
+    stats["gen_rows"] += gen_rows
+    return stats
+
+
 def pool_nbytes(*trees) -> int:
     """Total bytes of the KV buffers a chunked program must alias in place
     (the denominator of compile_chunk_guarded's double-buffer check)."""
@@ -619,6 +644,9 @@ class GenerationEngine(LoraMailbox):
         self._compile_mu = threading.Lock()
         # in-flight weight-update mailbox (LoraMailbox base)
         self.last_swap_steps: list[int] = []
+        # per-round prefill/decode timing + token counts (telemetry:
+        # accumulate_round_stats); snapshotted by the trainer per round
+        self.last_round_stats: dict | None = None
 
         # n and max_steps are static (shape-determining)
         self._decode_init = jax.jit(
@@ -738,6 +766,7 @@ class GenerationEngine(LoraMailbox):
         # a new round supersedes any swap consumed during the previous one
         # (the trainer hands the freshest adapter at round entry)
         self._reset_lora_mailbox_round()
+        self.last_round_stats = None  # waves of THIS round accumulate below
         return generate_in_waves(
             self._generate_wave, self.max_concurrent_rows, params, lora,
             prompt_ids, prompt_mask, sampling, rng, self.pad_id,
@@ -763,9 +792,18 @@ class GenerationEngine(LoraMailbox):
             prompt_mask = prompt_mask[:, p - bucket:]
         prefill_fn, decode_step_fn = self._fns_for_bucket(bucket)
 
-        cache, key_mask, last_logits = prefill_fn(
-            params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
-        )
+        prefill_tokens = int(np.asarray(prompt_mask).sum())
+        t0 = time.perf_counter()
+        with telemetry.span("engine/prefill", rows=b, bucket=bucket,
+                            tokens=prefill_tokens):
+            cache, key_mask, last_logits = prefill_fn(
+                params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
+            )
+            # the block makes the prefill/decode timing split honest (the
+            # decode loop's final readback syncs its side); it only forgoes
+            # overlapping prefill device time with sub-ms host-side setup
+            jax.block_until_ready(last_logits)
+        t_prefill = time.perf_counter() - t0
         row_alive = jnp.asarray(prompt_mask).sum(axis=-1) > 0
         state = self._decode_init(
             cache, key_mask, last_logits, row_alive,
@@ -776,6 +814,12 @@ class GenerationEngine(LoraMailbox):
         top_p_impl = sampling.resolved_top_p_impl()
         lora_cell = [lora]
         steps_seen = [0]
+        # explicit enter/exit: the span must cover BOTH dispatch branches
+        # and the final device→host readback that syncs the decode
+        t1 = time.perf_counter()
+        dec_span = telemetry.span("engine/decode", rows=b * sampling.n,
+                                  bucket=bucket)
+        dec_span.__enter__()
 
         chunk_fn = (
             self._chunk_fn_for_bucket(
@@ -835,5 +879,14 @@ class GenerationEngine(LoraMailbox):
         logps = (
             np.asarray(state.logps).reshape(b, sampling.n, max_steps)
             if self.capture_logprobs else None
+        )
+        gen_tokens = int(lengths.sum())
+        dec_span.set(tokens=gen_tokens, steps=steps_seen[0])
+        dec_span.__exit__(None, None, None)
+        self.last_round_stats = accumulate_round_stats(
+            self.last_round_stats, prefill_s=t_prefill,
+            prefill_tokens=prefill_tokens, prompt_rows=b,
+            decode_s=time.perf_counter() - t1, gen_tokens=gen_tokens,
+            gen_rows=b * sampling.n,
         )
         return GenerationResult(tokens=out, lengths=lengths, logprobs=logps)

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from distrl_llm_tpu import telemetry
+
 
 class PagePool:
     """Free-list page allocator + page-table builder (host-side, numpy)."""
@@ -72,6 +74,17 @@ class PagePool:
     def used_pages(self) -> int:
         return sum(len(o) for o in self.owned)
 
+    @property
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages (scratch excluded) currently owned."""
+        return self.used_pages / max(self.n_pages - 1, 1)
+
+    def _record_occupancy(self) -> None:
+        # gauge for the MetricsSink series; while tracing is on this also
+        # emits a Chrome counter event, so Perfetto renders pool pressure
+        # as a time-series track aligned with the decode spans
+        telemetry.gauge_set("pool/occupancy", self.occupancy)
+
     def check_invariants(self) -> None:
         """free + owned must tile the pool exactly, with no page owned twice
         (test hook; O(pool) but pools are small on the host)."""
@@ -111,6 +124,7 @@ class PagePool:
         row[full:full + need] = grant
         row[full + need:] = grant[-1]
         self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+        self._record_occupancy()
         return True
 
     def ensure(self, slot: int, last_position: int) -> int:
@@ -129,6 +143,7 @@ class PagePool:
             owned.extend(grant)
             row[full + len(owned):] = owned[-1]
             self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+            self._record_occupancy()
         return max(missing - take, 0)
 
     def release(self, slot: int) -> None:
@@ -138,3 +153,4 @@ class PagePool:
         self.free.extend(reversed(self.owned[slot]))
         self.owned[slot] = []
         self.table[slot, :] = self.scratch
+        self._record_occupancy()

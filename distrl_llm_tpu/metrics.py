@@ -149,32 +149,9 @@ class TraceProfiler:
             self._active = False
 
 
-class PhaseTimer:
-    """Wall-clock phase timing matching the reference's inline time.time()
-    pairs (distributed_trainer.py:180/:202, :206/:217, :303/:343, :385/:411).
-    Usage: ``with timer("generation"): ...`` then ``timer.metrics()`` yields
-    ``timing/generation_duration`` etc."""
-
-    def __init__(self):
-        self._durations: dict[str, float] = {}
-        self._active: str | None = None
-        self._t0 = 0.0
-
-    def __call__(self, phase: str) -> "PhaseTimer":
-        self._active = phase
-        return self
-
-    def __enter__(self) -> "PhaseTimer":
-        self._t0 = time.time()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        assert self._active is not None
-        self._durations[self._active] = time.time() - self._t0
-        self._active = None
-
-    def metrics(self) -> dict[str, float]:
-        return {f"timing/{k}_duration": v for k, v in self._durations.items()}
-
-    def get(self, phase: str) -> float:
-        return self._durations.get(phase, 0.0)
+# Wall-clock phase timing matching the reference's inline time.time() pairs
+# (distributed_trainer.py:180/:202, :206/:217, :303/:343, :385/:411). ONE
+# implementation owns the timing/*_duration name contract: telemetry's
+# PhaseSpans, which additionally records each phase as a trace span (a no-op
+# while tracing is off) — kept under the historical name here.
+from distrl_llm_tpu.telemetry import PhaseSpans as PhaseTimer  # noqa: E402,F401

@@ -66,6 +66,33 @@ class ModelConfig:
             )
 
     @property
+    def matmul_param_count(self) -> int:
+        """Parameters participating in matmuls (projections + MLP + lm_head;
+        biases/norms excluded as FLOP-negligible, embedding lookups are not
+        matmuls). The 2·N term of every FLOPs-per-token estimate — the
+        single owner for bench.py and the telemetry MFU series."""
+        per_layer = (
+            self.hidden_size * self.q_dim          # q proj
+            + 2 * self.hidden_size * self.kv_dim   # k, v proj
+            + self.q_dim * self.hidden_size        # o proj
+            + 3 * self.hidden_size * self.intermediate_size  # gate, up, down
+        )
+        return self.num_layers * per_layer + self.hidden_size * self.vocab_size
+
+    def decode_flops_per_token(self, mean_kv_len: float = 0.0) -> float:
+        """Model FLOPs per decoded token: 2·(matmul params) for the dense
+        path plus the attention score/value dot-products (2 FLOPs × q_dim
+        keys-side + values-side) at the mean resident KV length."""
+        attn = 4.0 * self.num_layers * self.q_dim * mean_kv_len
+        return 2.0 * self.matmul_param_count + attn
+
+    def train_flops_per_token(self, seq_len: int) -> float:
+        """Model FLOPs per trained token: 3× the forward's cost (fwd + ~2×
+        for backward through frozen base + LoRA), causal attention at mean
+        key length ``seq_len / 2``."""
+        return 3.0 * self.decode_flops_per_token(seq_len / 2.0)
+
+    @property
     def model_type(self) -> str:
         """The HF model_type this config round-trips through
         ``from_hf_config`` as (used by HF-format snapshot export)."""

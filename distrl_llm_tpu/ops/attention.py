@@ -145,6 +145,13 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
     switch on the fused-dequant int8 path: k/v stay int8 in HBM, the k
     scale factors out of the d-contraction onto the logits, the v scale
     rides the (already f32) probs."""
+    if formulation not in ("dot", "mulred"):
+        # a typo ('mul_red', 'dot_general', …) must not silently take the
+        # dot path — inside a scan program that reintroduces the per-leaf
+        # relayout copy / OOM the flag exists to avoid (ADVICE r5)
+        raise ValueError(
+            f"formulation must be 'dot' or 'mulred', got {formulation!r}"
+        )
     quant = k_scale is not None
     assert not quant or kv_heads_axis == 1, "scales imply the [B,K,D,S] layout"
     b, sq, h, d = q.shape

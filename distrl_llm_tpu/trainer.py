@@ -34,12 +34,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.checkpoint import CheckpointManager, save_adapter_file
 from distrl_llm_tpu.config import SamplingConfig, TrainConfig
 from distrl_llm_tpu.data import DictDataset
 from distrl_llm_tpu.learner.optim import make_optimizer
 from distrl_llm_tpu.learner.train_step import make_train_step, prepare_update_batch
-from distrl_llm_tpu.metrics import MetricsSink, PhaseTimer, make_sink
+from distrl_llm_tpu.metrics import MetricsSink, make_sink
 from distrl_llm_tpu.models.lora import init_lora_params, lora_scale
 from distrl_llm_tpu.ops.quant import default_group_size, quant_bits_for, quantize_params
 from distrl_llm_tpu.parallel.mesh import RoleMeshes, build_role_meshes
@@ -252,6 +253,23 @@ class Trainer:
                 start_step=config.profile_start_step,
                 num_steps=config.profile_num_steps,
             )
+
+        # span tracing (telemetry.py): enabled here so directly-driven
+        # rounds (tests, tools) record too, not just train(); the trace is
+        # exported when the trace_steps window closes or at shutdown
+        self._trace_steps_done = 0
+        if config.trace_dir:
+            telemetry.configure(enabled=True)
+        # MFU denominator: one chip's peak FLOP/s, when the hardware is
+        # known (telemetry table / DISTRL_PEAK_FLOPS); None suppresses the
+        # engine/mfu series rather than publishing a made-up number.
+        # decode_tok_s is WHOLE-ENGINE throughput, so MFU divides it by the
+        # rollout chip count first (bench.py:learner does the same) —
+        # otherwise an 8-chip mesh reports ~8× the true utilisation
+        self._peak_flops = telemetry.device_peak_flops()
+        self._rollout_chips = (
+            int(meshes.rollout.devices.size) if meshes is not None else 1
+        )
 
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
@@ -776,12 +794,16 @@ class Trainer:
             cand["answer_tokens"] = [result.tokens[i] for i in range(b_real)]
             cand["behavior_logps"] = [result.logprobs[i] for i in range(b_real)]
             cand["gen_lengths"] = [result.lengths[i] for i in range(b_real)]
-        # snapshot pool telemetry HERE, on the thread that ran the round:
-        # with async_rollout the next round (or an eval) may overwrite the
-        # engine's shared attribute before _train_batch logs metrics
+        # snapshot pool + round telemetry HERE, on the thread that ran the
+        # round: with async_rollout the next round (or an eval) may
+        # overwrite the engine's shared attributes before _train_batch
+        # logs metrics
         pool = getattr(self.engine, "last_pool_stats", None)
         if pool:
             cand["pool_stats"] = dict(pool)
+        rstats = getattr(self.engine, "last_round_stats", None)
+        if rstats:
+            cand["round_stats"] = dict(rstats)
         return [cand]
 
     def _compute_round_rewards(self, candidates: list[dict[str, Any]]) -> None:
@@ -908,13 +930,18 @@ class Trainer:
                 self._gen_pool = None
             if self.profiler is not None:
                 self.profiler.finish()
+            # whole-run tracing (trace_steps=0) exports here; a closed
+            # trace_steps window already wrote and disabled — no-op then
+            self._export_trace()
             self.sink.finish()
             self.rewards.close()
 
     def _train_batch(self, batch: Mapping[str, Sequence[str]], episode: int,
                      gen_future=None) -> None:
         cfg = self.config
-        timer = PhaseTimer()
+        # spans + the reference's exact timing/*_duration metric names
+        # (the PhaseTimer contract, now recorded on the driver trace track)
+        timer = telemetry.PhaseSpans()
 
         with timer("generation"):
             # async_rollout hands in a future: timing/generation_duration then
@@ -1021,17 +1048,93 @@ class Trainer:
         # budgeted-pool observability (vLLM's gpu_cache_usage-style
         # telemetry): page pressure + preemption count, snapshotted by
         # _generate_round on the thread that ran THIS round (reading the
-        # engine attribute here would race async rollout / eval rounds)
+        # engine attribute here would race async rollout / eval rounds).
+        # A stat the engine didn't produce is SKIPPED, not logged as None
+        # (a null metric poisons sink aggregations — ADVICE r5).
         pool = next(
             (c["pool_stats"] for c in candidates if "pool_stats" in c), None
         )
         if pool:
-            metrics["pool/pages"] = pool.get("pool_pages")
-            metrics["pool/peak_pages_used"] = pool.get("peak_pages_used")
-            metrics["pool/preemptions"] = pool.get("preemptions")
+            for name, key in (
+                ("pool/pages", "pool_pages"),
+                ("pool/peak_pages_used", "peak_pages_used"),
+                ("pool/preemptions", "preemptions"),
+            ):
+                if pool.get(key) is not None:
+                    metrics[name] = pool[key]
+        metrics.update(self._engine_metrics(candidates))
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())
+        # registry series (pool/occupancy gauge, cp/rpc_* histograms, …)
+        # ride the same sink record
+        metrics.update(telemetry.metrics_snapshot())
         self.sink.log(metrics, step=self.total_batch_steps)
+        if cfg.trace_dir and telemetry.enabled():
+            self._trace_steps_done += 1
+            if cfg.trace_steps and self._trace_steps_done >= cfg.trace_steps:
+                # window closed: write the trace now (a crashed run past the
+                # window still has its file) and stop paying for recording
+                self._export_trace()
+                telemetry.configure(enabled=False)
+
+    def _engine_metrics(self, candidates) -> dict[str, float]:
+        """engine/prefill_tok_s, engine/decode_tok_s, engine/mfu from the
+        round stats every engine records (engine.accumulate_round_stats);
+        MFU uses the model's FLOPs/token (models/configs.py) at this
+        round's realized mean context length."""
+        stats = next(
+            (c["round_stats"] for c in candidates if "round_stats" in c), None
+        )
+        if not stats:
+            return {}
+        out: dict[str, float] = {}
+        decode_tok_s = None
+        if stats["prefill_s"] > 0 and stats["prefill_tokens"]:
+            out["engine/prefill_tok_s"] = (
+                stats["prefill_tokens"] / stats["prefill_s"]
+            )
+        if stats["decode_s"] > 0 and stats["gen_tokens"]:
+            decode_tok_s = stats["gen_tokens"] / stats["decode_s"]
+            out["engine/decode_tok_s"] = decode_tok_s
+        if (
+            decode_tok_s is not None and self._peak_flops
+            # remote rounds measure N workers' unknown chips against the
+            # local peak — no honest per-chip number exists driver-side
+            and not getattr(self.engine, "is_remote", False)
+        ):
+            mean_kv = (
+                stats["prefill_tokens"] / max(stats["prompt_rows"], 1)
+                + stats["gen_tokens"] / max(stats["gen_rows"], 1) / 2
+            )
+            out["engine/mfu"] = telemetry.mfu(
+                decode_tok_s / self._rollout_chips,
+                self.model_cfg.decode_flops_per_token(mean_kv),
+                self._peak_flops,
+            )
+        return out
+
+    def _export_trace(self) -> None:
+        """Write the Chrome-trace/Perfetto JSON to trace_dir/trace.json with
+        the metadata tools/trace_report.py needs for tok/s and MFU."""
+        cfg = self.config
+        if not cfg.trace_dir or not telemetry.enabled():
+            return
+        path = telemetry.export_chrome_trace(
+            os.path.join(cfg.trace_dir, "trace.json"),
+            metadata={
+                "model": cfg.model,
+                # static context estimate for report-side MFU: full prompt
+                # window + half the generation window
+                "decode_flops_per_token": self.model_cfg.decode_flops_per_token(
+                    cfg.max_prompt_tokens + cfg.max_new_tokens / 2
+                ),
+                "peak_flops": self._peak_flops,
+                # trace_report divides whole-engine tok/s by this before
+                # comparing against the single-chip peak
+                "chips": self._rollout_chips,
+            },
+        )
+        log.info("telemetry trace written to %s", path)
 
     # ------------------------------------------------------------------- eval
 
@@ -1040,7 +1143,7 @@ class Trainer:
         accuracy over candidates, BoN = max; same rollout path with eval
         sampling params."""
         cfg = self.config
-        timer = PhaseTimer()
+        timer = telemetry.PhaseSpans()
         accs, bons, tok_lens = [], [], []
         with timer("eval"):
             for batch in self.test_dataset.iter(cfg.batch_size):

@@ -57,6 +57,18 @@ def test_learner_len_buckets_flag():
     assert config_from_args(args).learner_len_buckets == (256, 512)
 
 
+def test_trace_flags():
+    args = build_parser().parse_args(
+        ["--trace-dir", "out/tr", "--trace-steps", "3"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.trace_dir == "out/tr"
+    assert cfg.trace_steps == 3
+    # underscore spellings stay accepted (repo flag-style consistency)
+    args = build_parser().parse_args(["--trace_dir", "out2"])
+    assert config_from_args(args).trace_dir == "out2"
+
+
 def test_invalid_learner_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["--learner", "ppo"])

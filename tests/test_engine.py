@@ -358,6 +358,29 @@ class TestScanChunk:
         np.testing.assert_array_equal(a.tokens, b.tokens)
         np.testing.assert_array_equal(a.lengths, b.lengths)
 
+    def test_chunk_matches_default_dot_host_decode(self, setup):
+        """ADVICE r5: TestScanChunk pins its host reference to mulred for
+        bit-exact dispatch comparison, which left the DEFAULT dot-formulation
+        host path untested against the chunk path at engine level. This is
+        the tolerance-based cross-formulation anchor: a default engine (dot
+        cache read) and a chunked engine (mulred cache read) greedy-decode
+        the same prompts; tokens must agree and the captured behavior
+        logprobs must match to float tolerance (the two formulations are the
+        same math in a different contraction order — see _gqa_mulred)."""
+        params, ids, mask = setup
+        kw = dict(max_prompt_tokens=P_LEN, max_new_tokens=6,
+                  eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+                  cache_dtype=jnp.float32, capture_logprobs=True)
+        host = GenerationEngine(TINY, **kw)  # default path: dot formulation
+        assert host.cache_read_formulation == "dot"
+        chunked = GenerationEngine(TINY, scan_chunk=3, **kw)
+        sc = SamplingConfig(max_tokens=6, temperature=0.0, n=1)
+        a = host.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        b = chunked.generate(params, None, ids, mask, sc, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_array_equal(a.lengths, b.lengths)
+        np.testing.assert_allclose(a.logprobs, b.logprobs, rtol=1e-4, atol=1e-5)
+
     def test_structural_swap_rebuilds_chunk_program(self, setup):
         """ADVICE r3 regression: an in-flight swap to a STRUCTURALLY
         different adapter (None-adapter round receiving its first adapter)

@@ -92,6 +92,25 @@ class TestMulredOp:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestFormulationValidation:
+    """ADVICE r5: an unrecognized formulation string must raise, not
+    silently fall back to the dot path (a typo like 'mul_red' inside a scan
+    program would reintroduce the relayout/OOM the flag avoids)."""
+
+    def test_typo_raises_on_cached(self):
+        q, k, v, mask = _decode_inputs()
+        with pytest.raises(ValueError, match="formulation"):
+            attention_cached(q, k, v, mask, formulation="mul_red")
+
+    def test_typo_raises_on_cached_quant(self):
+        q, k, v, mask = _decode_inputs()
+        k8, ks_ = quantize_kv_position(k)
+        v8, vs_ = quantize_kv_position(v)
+        with pytest.raises(ValueError, match="formulation"):
+            attention_cached_quant(q, k8, ks_, v8, vs_, mask,
+                                   formulation="dot_general")
+
+
 class TestEngineWiring:
     def _engine(self, **kw):
         return GenerationEngine(

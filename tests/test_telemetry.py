@@ -1,0 +1,373 @@
+"""Telemetry subsystem tests: span nesting, the disabled no-op fast path,
+Chrome-trace schema validity, the counters/gauges/histogram registry, MFU
+math against hand-computed FLOP counts, and the worker-blob merge across a
+real multi-process control-plane round."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.models.configs import TINY
+from distrl_llm_tpu.native.build import native_available
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Telemetry is process-global; every test starts and ends empty."""
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+
+
+def events():
+    return telemetry._STATE.events
+
+
+class TestSpans:
+    def test_nesting_records_both_and_contains(self):
+        telemetry.configure(enabled=True)
+        with telemetry.span("outer", phase="gen"):
+            with telemetry.span("inner"):
+                time.sleep(0.002)
+        by_name = {e["name"]: e for e in events()}
+        assert set(by_name) == {"outer", "inner"}
+        outer, inner = by_name["outer"], by_name["inner"]
+        # children exit first (appended first) and nest within the parent
+        assert events()[0]["name"] == "inner"
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert outer["tid"] == inner["tid"]
+        assert outer["args"] == {"phase": "gen"}
+
+    def test_disabled_is_free(self):
+        """span() off the enabled path returns ONE shared no-op object and
+        records nothing — the instrumented hot paths cost an attribute
+        read, not an allocation."""
+        assert telemetry.span("a") is telemetry.span("b", x=1)
+        with telemetry.span("a") as sp:
+            sp.set(tokens=3)
+        assert events() == []
+
+    def test_set_attaches_args_mid_span(self):
+        telemetry.configure(enabled=True)
+        with telemetry.span("decode", rows=4) as sp:
+            sp.set(tokens=17)
+        (ev,) = events()
+        assert ev["args"] == {"rows": 4, "tokens": 17}
+
+    def test_thread_awareness(self):
+        import threading
+
+        telemetry.configure(enabled=True)
+
+        def work():
+            with telemetry.span("worker-side"):
+                pass
+
+        t = threading.Thread(target=work, name="rollout-0")
+        with telemetry.span("main-side"):
+            t.start()
+            t.join()
+        tids = {e["name"]: e["tid"] for e in events()}
+        assert tids["worker-side"] != tids["main-side"]
+        assert telemetry._STATE.thread_names[tids["worker-side"]] == "rollout-0"
+
+
+class TestPhaseSpans:
+    def test_metric_name_parity_and_span(self):
+        """PhaseSpans must keep the reference's exact timing/*_duration
+        names (the PhaseTimer contract) while recording driver/* spans."""
+        telemetry.configure(enabled=True)
+        timer = telemetry.PhaseSpans()
+        with timer("generation"):
+            time.sleep(0.001)
+        with timer("update"):
+            pass
+        m = timer.metrics()
+        assert set(m) == {"timing/generation_duration",
+                          "timing/update_duration"}
+        assert m["timing/generation_duration"] > 0
+        assert timer.get("generation") == m["timing/generation_duration"]
+        assert {e["name"] for e in events()} == {"driver/generation",
+                                                 "driver/update"}
+
+    def test_works_disabled(self):
+        timer = telemetry.PhaseSpans()
+        with timer("reward"):
+            pass
+        assert "timing/reward_duration" in timer.metrics()
+        assert events() == []
+
+
+class TestChromeTraceExport:
+    def test_schema_validity(self, tmp_path):
+        telemetry.configure(enabled=True)
+        with telemetry.span("engine/prefill", tokens=32):
+            pass
+        telemetry.gauge_set("pool/occupancy", 0.5)
+        path = telemetry.export_chrome_trace(
+            str(tmp_path / "trace.json"), metadata={"model": "tiny"}
+        )
+        with open(path) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["metadata"] == {"model": "tiny"}
+        phases = {}
+        for ev in doc["traceEvents"]:
+            assert {"ph", "name", "pid", "tid"} <= set(ev), ev
+            phases.setdefault(ev["ph"], []).append(ev)
+        # one complete-span event with µs ts/dur, one counter sample, and
+        # process/thread name metadata
+        (x,) = phases["X"]
+        assert x["name"] == "engine/prefill" and x["dur"] >= 1
+        assert isinstance(x["ts"], int)
+        (c,) = phases["C"]
+        assert c["name"] == "pool/occupancy"
+        assert c["args"] == {"occupancy": 0.5}
+        meta_names = {e["name"] for e in phases["M"]}
+        assert "process_name" in meta_names
+
+    def test_export_clears_by_default(self, tmp_path):
+        telemetry.configure(enabled=True)
+        with telemetry.span("a"):
+            pass
+        telemetry.export_chrome_trace(str(tmp_path / "t.json"))
+        assert events() == []
+
+
+class TestRegistry:
+    def test_counter_reports_delta_and_resets(self):
+        telemetry.counter_add("engine/rounds")
+        telemetry.counter_add("engine/rounds", 2)
+        snap = telemetry.metrics_snapshot()
+        assert snap["engine/rounds"] == 3.0
+        assert telemetry.metrics_snapshot() == {}  # untouched since
+
+    def test_gauge_keeps_last_value(self):
+        telemetry.gauge_set("pool/occupancy", 0.25)
+        telemetry.gauge_set("pool/occupancy", 0.75)
+        assert telemetry.metrics_snapshot()["pool/occupancy"] == 0.75
+
+    def test_histogram_summary(self):
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            telemetry.hist_observe("cp/rpc_dispatch_ms", v)
+        snap = telemetry.metrics_snapshot()
+        assert snap["cp/rpc_dispatch_ms_count"] == 5
+        assert snap["cp/rpc_dispatch_ms_mean"] == pytest.approx(22.0)
+        assert snap["cp/rpc_dispatch_ms_p50"] == 3.0
+        assert snap["cp/rpc_dispatch_ms_max"] == 100.0
+
+    def test_gauge_emits_counter_event_when_tracing(self):
+        telemetry.gauge_set("pool/occupancy", 0.5)
+        assert events() == []  # disabled: metric only, no trace sample
+        telemetry.configure(enabled=True)
+        telemetry.gauge_set("pool/occupancy", 0.75)
+        (ev,) = events()
+        assert ev["ph"] == "C" and ev["args"] == {"occupancy": 0.75}
+
+
+class TestMfuMath:
+    def test_flops_per_token_hand_computed_tiny(self):
+        """TINY: hidden 64, inter 128, 2 layers, 4 heads × d16 (q_dim 64),
+        2 kv heads (kv_dim 32), vocab 256 — worked by hand:
+        per-layer matmul params = 64·64 (q) + 2·64·32 (kv) + 64·64 (o)
+        + 3·64·128 (mlp) = 36,864; + lm_head 64·256 = 16,384
+        → matmul params 90,112 → 180,224 FLOPs/token at zero context."""
+        assert TINY.matmul_param_count == 90_112
+        assert TINY.decode_flops_per_token(0) == 180_224.0
+        # attention adds 4·L·q_dim·kv = 4·2·64·10 = 5,120 at kv len 10
+        assert TINY.decode_flops_per_token(10) == 185_344.0
+        # train: 3× forward at mean key length seq/2
+        assert TINY.train_flops_per_token(20) == 3.0 * 185_344.0
+
+    def test_mfu_is_achieved_over_peak(self):
+        fpt = TINY.decode_flops_per_token(10)
+        assert telemetry.mfu(1000.0, fpt, 1e9) == pytest.approx(
+            1000.0 * 185_344.0 / 1e9
+        )
+
+    def test_peak_flops_env_override(self, monkeypatch):
+        monkeypatch.setenv("DISTRL_PEAK_FLOPS", "1.23e14")
+        assert telemetry.device_peak_flops() == 1.23e14
+
+
+class TestRemoteBlobUnit:
+    def test_drain_and_ingest_assign_worker_track(self):
+        telemetry.configure(enabled=True)
+        with telemetry.span("worker/generate", tokens=5):
+            pass
+        blob = telemetry.drain_remote_blob()
+        assert events() == []  # drained
+        assert len(blob["events"]) == 1
+        telemetry.ingest_remote(blob, track="worker 127.0.0.1:1234")
+        telemetry.ingest_remote(
+            {"events": [{"ph": "X", "name": "worker/echo", "ts": 1,
+                         "dur": 1, "tid": 9, "args": {}}], "threads": {}},
+            track="worker 127.0.0.1:9999",
+        )
+        pids = {e["pid"] for e in events()}
+        assert len(pids) == 2  # one track per worker
+
+    def test_empty_drain_is_none(self):
+        assert telemetry.drain_remote_blob() is None
+
+    def test_ingest_dropped_when_disabled(self):
+        """A traced worker feeding an untraced driver must not grow the
+        driver's event list (nothing would ever export it)."""
+        telemetry.ingest_remote(
+            {"events": [{"ph": "X", "name": "worker/echo", "ts": 1,
+                         "dur": 1, "tid": 9, "args": {}}], "threads": {}},
+            track="worker 127.0.0.1:1",
+        )
+        assert events() == []
+
+
+class TestTrainerIntegration:
+    """trace_dir wiring through the Trainer on the FakeEngine: spans record
+    under the reference timing names and one Chrome-trace JSON lands in
+    trace_dir at shutdown."""
+
+    def _trainer(self, tmp_path, **cfg_kw):
+        import jax
+
+        from distrl_llm_tpu.config import TrainConfig
+        from distrl_llm_tpu.engine.fake import FakeEngine
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.models import TINY as MTINY, init_params
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+
+        config = TrainConfig(
+            model="tiny", episodes=1, batch_size=4, num_candidates=4, topk=4,
+            train_batch_size=4, max_prompt_tokens=16, max_new_tokens=24,
+            number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
+            eval_every=0, save_every=0, metrics_backend="null", lr=1e-3,
+            max_lora_rank=4, lora_alpha=8, trace_dir=str(tmp_path),
+            **cfg_kw,
+        )
+        tok = CharTokenizer()
+        problems = [f"q {c}" for c in "abcdefgh"]
+        train = {"problem": problems,
+                 "solution": [p.strip()[-1].upper() for p in problems]}
+        sink = MemorySink()
+        trainer = Trainer(
+            train, {k: v[:4] for k, v in train.items()},
+            reward_function, config, tokenizer=tok,
+            engine=FakeEngine(tok, lambda p, j: "<answer>x</answer>",
+                              max_new_tokens=config.max_new_tokens),
+            base_params=init_params(jax.random.PRNGKey(0), MTINY),
+            model_cfg=MTINY, sink=sink,
+        )
+        return trainer, sink
+
+    def test_trace_dir_enables_and_exports(self, tmp_path):
+        trainer, sink = self._trainer(tmp_path)
+        assert telemetry.enabled()  # __init__ armed recording
+        trainer.train()
+        path = tmp_path / "trace.json"
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert {"driver/generation", "driver/reward",
+                "driver/update"} <= names
+        assert doc["metadata"]["decode_flops_per_token"] > 0
+        # metric-name parity survives the PhaseTimer → spans swap
+        steps = [m for _, m in sink.records if "loss" in m]
+        assert steps and all(
+            "timing/generation_duration" in m
+            and "timing/update_duration" in m for m in steps
+        )
+
+    def test_trace_steps_window_closes_early(self, tmp_path):
+        trainer, _ = self._trainer(tmp_path, trace_steps=1)
+        trainer.train()  # 8 problems / batch 4 = 2 steps; window = 1
+        assert (tmp_path / "trace.json").exists()
+        assert not telemetry.enabled()  # recording stopped at the window
+
+
+@pytest.mark.distributed
+@pytest.mark.skipif(not native_available(), reason="g++ not available")
+class TestWorkerBlobMerge:
+    """The cross-process acceptance piece: a traced worker subprocess ships
+    its spans back in the RPC response and the driver merges them under a
+    per-worker track."""
+
+    def test_multiprocess_round_merges_worker_spans(self, tmp_path):
+        from distrl_llm_tpu.distributed.control_plane import DriverClient
+
+        telemetry.configure(enabled=True)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distrl_llm_tpu.distributed.worker_main", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "DISTRL_TRACE": "1"},
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("PORT "), line
+            driver = DriverClient([("127.0.0.1", int(line.split()[1]))])
+            batch = {"answers": [["<answer>4</answer>", "wrong"]],
+                     "solution": [["4", "4"]]}
+            (rewards,) = driver.dispatch_objects(
+                [("rollout_rewards", batch)], timeout_ms=30_000
+            )
+            # the RPC result itself is unchanged by the piggybacked blob
+            assert np.asarray(rewards[0]).shape == (2, 2)
+            driver.shutdown()
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+        # worker spans landed under a per-worker track…
+        worker_evs = [e for e in events() if e.get("pid", 0) >= 100]
+        assert any(
+            e["name"] == "worker/rollout_rewards" for e in worker_evs
+        ), events()
+        # …the driver recorded its own dispatch span and RPC latency…
+        assert any(e["name"] == "cp/dispatch" for e in events())
+        snap = telemetry.metrics_snapshot()
+        assert snap["cp/rpc_dispatch_ms_count"] >= 1
+        # …and the export names the worker track
+        path = telemetry.export_chrome_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        track_names = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        assert any(n.startswith("worker 127.0.0.1:") for n in track_names)
+        assert "driver" in track_names
+
+    def test_untraced_worker_sends_plain_result(self):
+        """Without DISTRL_TRACE the worker must answer with the plain
+        MSG_RESULT frame (no envelope) — zero overhead on untraced runs."""
+        from distrl_llm_tpu.distributed.control_plane import DriverClient
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distrl_llm_tpu.distributed.worker_main", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "DISTRL_TRACE": "0"},
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            driver = DriverClient([("127.0.0.1", int(line.split()[1]))])
+            out = driver.dispatch_objects([("echo", 42)], timeout_ms=10_000)
+            assert out == [42]
+            driver.shutdown()
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        assert all(e.get("pid", 0) < 100 for e in events())

@@ -22,6 +22,10 @@ stage "cli_smoke" env JAX_PLATFORMS=cpu \
 stage "bench_fallback" env JAX_PLATFORMS=cpu BENCH_MODEL=tiny BENCH_PROMPTS=4 \
   BENCH_CANDIDATES=2 BENCH_MAX_PROMPT=32 BENCH_MAX_NEW=32 \
   timeout 600 python bench.py
+# telemetry acceptance gate: 2-step traced train + worker round → one
+# Chrome-trace JSON that parses and trace_report.py exits 0 on
+stage "telemetry_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/telemetry_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one
@@ -51,7 +55,8 @@ stage "suite_ops" timeout 600 python -m pytest -q \
   tests/test_ulysses.py tests/test_chunking.py tests/test_sampling.py
 stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
-  tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py
+  tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
+  tests/test_telemetry.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \

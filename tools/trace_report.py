@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""One-command diagnosis of a telemetry trace: per-phase / per-worker time
+breakdown with tok/s and MFU.
+
+Round 5's regressions (a 2.5×-slower scan-chunk silently engaged; the paged
+engine 5–6× behind dense) were only found by cross-reading bench JSONs after
+the fact. This report answers the same questions from one run's trace file
+(written by ``--trace-dir`` — see telemetry.py):
+
+    python tools/trace_report.py run_myrun/trace/trace.json
+
+Prints, per track (driver + one per worker): each span name's call count,
+total and mean wall time, and share of the track's traced span time; then
+throughput derived from the engine spans' token counts (prefill tok/s,
+decode tok/s) and MFU when the trace metadata carries the model's
+FLOPs/token and a known peak (``--peak-flops`` overrides, FLOP/s).
+
+Exit status: 0 on a parseable trace with at least one span, 1 otherwise —
+tools/run_all_checks.sh uses this as the telemetry smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_trace(path: str) -> tuple[list[dict], dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array form is also legal
+        return doc, {}
+    return doc.get("traceEvents", []), doc.get("metadata", {}) or {}
+
+
+def build_report(events: list[dict], metadata: dict,
+                 peak_flops: float | None = None) -> str:
+    tracks: dict[int, str] = {}
+    spans: dict[tuple[int, str], list[dict]] = defaultdict(list)
+    for ev in events:
+        ph = ev.get("ph")
+        pid = ev.get("pid", 0)
+        if ph == "M" and ev.get("name") == "process_name":
+            tracks[pid] = ev.get("args", {}).get("name", f"pid {pid}")
+        elif ph == "X":
+            spans[(pid, ev["name"])].append(ev)
+    if not spans:
+        raise ValueError("trace contains no span events")
+
+    lines: list[str] = []
+    by_pid: dict[int, list[tuple[str, list[dict]]]] = defaultdict(list)
+    for (pid, name), evs in spans.items():
+        by_pid[pid].append((name, evs))
+    for pid in sorted(by_pid):
+        label = tracks.get(pid, f"pid {pid}")
+        rows = []
+        for name, evs in by_pid[pid]:
+            total_us = sum(e.get("dur", 0) for e in evs)
+            rows.append((name, len(evs), total_us))
+        # per-track share uses only top-level-ish totals; nested spans
+        # double-count by design (each row is that span's own wall time)
+        track_us = max(sum(t for _, _, t in rows), 1)
+        lines.append(f"track: {label}")
+        lines.append(f"  {'span':<28} {'count':>6} {'total s':>10} "
+                     f"{'mean ms':>10} {'share':>7}")
+        for name, count, total_us in sorted(rows, key=lambda r: -r[2]):
+            lines.append(
+                f"  {name:<28} {count:>6} {total_us / 1e6:>10.3f} "
+                f"{total_us / count / 1e3:>10.2f} "
+                f"{100 * total_us / track_us:>6.1f}%"
+            )
+        lines.append("")
+
+    # throughput from engine span args (every engine records tokens= on its
+    # prefill/decode spans; worker tracks contribute their own)
+    def tok_s(span_names: tuple[str, ...]) -> float | None:
+        toks = us = 0
+        for (pid, name), evs in spans.items():
+            if name in span_names:
+                for e in evs:
+                    toks += e.get("args", {}).get("tokens", 0)
+                    us += e.get("dur", 0)
+        if toks and us:
+            return toks * 1e6 / us
+        return None
+
+    prefill = tok_s(("engine/prefill",))
+    # NOT worker/generate or engine/remote_round: those wrap the engine
+    # spans (a traced serving worker ships its engine/decode spans in the
+    # same blob), so counting them would double the tokens and mix
+    # prefill-inclusive durations into the decode rate
+    decode = tok_s(("engine/decode", "engine/refill_decode"))
+    lines.append("throughput:")
+    lines.append(f"  prefill tok/s: "
+                 f"{f'{prefill:,.0f}' if prefill else 'n/a (no token counts)'}")
+    lines.append(f"  decode  tok/s: "
+                 f"{f'{decode:,.0f}' if decode else 'n/a (no token counts)'}")
+    fpt = metadata.get("decode_flops_per_token")
+    peak = peak_flops or metadata.get("peak_flops")
+    chips = metadata.get("chips", 1) or 1
+    if decode and fpt and peak:
+        lines.append(
+            f"  decode MFU:    {100 * decode / chips * fpt / peak:.2f}%  "
+            f"(FLOPs/token {fpt / 1e9:.2f} GF, peak {peak / 1e12:.0f} TF/s"
+            f"{f', {chips} chips' if chips > 1 else ''})"
+        )
+    else:
+        lines.append(
+            "  decode MFU:    n/a (needs token counts, metadata "
+            "decode_flops_per_token, and a known peak — pass --peak-flops)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="per-phase/per-worker breakdown of a telemetry trace"
+    )
+    p.add_argument("trace", help="path to a trace.json written by --trace-dir")
+    p.add_argument("--peak-flops", type=float, default=None,
+                   help="peak FLOP/s of one chip for the MFU line "
+                        "(overrides the trace metadata)")
+    args = p.parse_args(argv)
+    try:
+        events, metadata = load_trace(args.trace)
+        report = build_report(events, metadata, peak_flops=args.peak_flops)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"trace_report: cannot report on {args.trace}: {e}",
+              file=sys.stderr)
+        return 1
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -135,6 +135,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write_adapter_file", action="store_true",
                    help="export the reference's per-step adapter artifact")
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--trace-dir", "--trace_dir", dest="trace_dir",
+                   type=str, default=None,
+                   help="span-trace capture (telemetry.py): write a Chrome-"
+                        "trace/Perfetto JSON of driver/engine/worker spans "
+                        "to this directory (trace.json); inspect with "
+                        "tools/trace_report.py or ui.perfetto.dev")
+    p.add_argument("--trace-steps", "--trace_steps", dest="trace_steps",
+                   type=int, default=0,
+                   help="trace only the first N train steps, writing the "
+                        "file when the window closes (0 = whole run, "
+                        "written at shutdown)")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
