@@ -208,6 +208,14 @@ def _train_flops_per_token(cfg, seq_len: int) -> float:
     return cfg.train_flops_per_token(seq_len)
 
 
+def _device_kind() -> str:
+    """Canonical device kind of the benching chip (autotune plan-key
+    vocabulary: "tpu_v5e", "cpu", …)."""
+    from distrl_llm_tpu.autotune import current_device_kind
+
+    return current_device_kind()
+
+
 def _paged_dispatch_choice():
     """Which paged-attention impl the probe chain actually dispatched
     ("native"/"native_folded"/"fixed"/"jaxlib"/"reference"), or None if no paged dispatch
@@ -449,23 +457,21 @@ def main() -> int:
     import jax.numpy as jnp
 
     # Driver-default production config: the plain `python bench.py` the
-    # driver runs should measure this framework's best honest TPU config
-    # (int8 fused-dequant KV + multiway top-p + chunked dispatch — every
-    # knob is recorded in the JSON line). Watcher/A-B invocations set
-    # BENCH_NO_FALLBACK=1 and configure knobs explicitly, so the defaults
-    # stay out of their way; BENCH_PRODUCTION_DEFAULTS=0/1 overrides.
+    # driver runs should measure this framework's best honest TPU config.
+    # The knobs now come from the autotune plan DB when it holds a MEASURED
+    # entry for this (device, model, geometry) — `_apply_production_defaults`
+    # below, after the geometry is parsed — with the historical hard-coded
+    # guesses (int8 KV + multiway top-p + chunk 16) only as the DB-less
+    # fallback. Round 5's headline regression was exactly such a guess
+    # (scan-chunk 16, measured 2.5× slower — VERDICT.md); with a populated
+    # DB that misconfiguration is unrepresentable. Watcher/A-B invocations
+    # set BENCH_NO_FALLBACK=1 and configure knobs explicitly, so the
+    # defaults stay out of their way; BENCH_PRODUCTION_DEFAULTS=0/1
+    # overrides.
     prod_defaults = os.environ.get(
         "BENCH_PRODUCTION_DEFAULTS",
         "0" if os.environ.get("BENCH_NO_FALLBACK") == "1" else "1",
     ) == "1"
-    if (
-        prod_defaults
-        and devices[0].platform == "tpu"
-        and os.environ.get("BENCH_MODE") != "learner"
-    ):
-        os.environ.setdefault("BENCH_SCAN_CHUNK", "16")
-        os.environ.setdefault("BENCH_KV_QUANT", "int8")
-        os.environ.setdefault("BENCH_TOP_P_IMPL", "bisect_mw")
 
     from distrl_llm_tpu.config import SamplingConfig
     from distrl_llm_tpu.engine import GenerationEngine, PagedGenerationEngine
@@ -482,6 +488,66 @@ def main() -> int:
     max_new = int(os.environ.get("BENCH_MAX_NEW", "1200"))
     lora_rank = int(os.environ.get("BENCH_LORA_RANK", "32"))
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+    if prod_defaults and devices[0].platform == "tpu":
+        from distrl_llm_tpu.autotune import resolve_plan
+
+        # a measured plan for THIS (device, model, geometry) overrides the
+        # hard-coded guesses; setdefault keeps explicit BENCH_* pins winning
+        resolved = resolve_plan(
+            model_cfg=cfg, max_prompt_tokens=max_prompt,
+            max_new_tokens=max_new, rows=n_prompts * n_cand,
+        )
+        plan_applied = False
+        if resolved.source == "db":
+            plan = resolved.plan
+            plan_engine = (
+                "paged" if plan.decode_path in ("paged", "speculative")
+                else "dense"
+            )
+            pinned_engine = os.environ.get("BENCH_ENGINE")
+            if pinned_engine is not None and (
+                (pinned_engine == "paged") != (plan_engine == "paged")
+            ):
+                # the plan's knobs were measured on a DIFFERENT decode path
+                # than the user pinned — applying its scan_chunk/top_p here
+                # would bench an unmeasured combination (the r5 trap), so
+                # the whole plan is skipped, loudly
+                print(
+                    f"bench: stored plan is for the {plan_engine} path but "
+                    f"BENCH_ENGINE={pinned_engine} is pinned — using static "
+                    "defaults",
+                    file=sys.stderr,
+                )
+            # a "speculative" winner can only be reproduced when the spec
+            # scaffolding (draft length + slot cap — NOT in the plan space)
+            # is supplied explicitly; applying its OTHER knobs to a
+            # non-speculative run would bench an unmeasured combination,
+            # so in that case too the whole plan is skipped, loudly
+            elif plan.decode_path == "speculative" and not (
+                os.environ.get("BENCH_SPEC_DRAFT")
+                and os.environ.get("BENCH_MAX_CONCURRENT")
+            ):
+                print(
+                    "bench: stored plan is speculative but BENCH_SPEC_DRAFT/"
+                    "BENCH_MAX_CONCURRENT are unset — using static defaults",
+                    file=sys.stderr,
+                )
+            else:
+                os.environ.setdefault("BENCH_SCAN_CHUNK", str(plan.scan_chunk))
+                if plan.top_p_impl:
+                    os.environ.setdefault("BENCH_TOP_P_IMPL", plan.top_p_impl)
+                if plan.decode_path in ("paged", "speculative"):
+                    os.environ.setdefault("BENCH_ENGINE", "paged")
+                    if plan.decode_path == "speculative":
+                        os.environ.setdefault("BENCH_SCHEDULER", "refill")
+                plan_applied = True
+        if not plan_applied:
+            os.environ.setdefault("BENCH_SCAN_CHUNK", "16")
+            os.environ.setdefault("BENCH_TOP_P_IMPL", "bisect_mw")
+        # kv_quant is a capacity knob, not a plan-space choice — the int8
+        # production default stays regardless of the DB
+        os.environ.setdefault("BENCH_KV_QUANT", "int8")
 
     # the CPU fallback's dot thunk has no bf16 support — use f32 off-TPU
     dtype = jnp.bfloat16 if devices[0].platform == "tpu" else jnp.float32
@@ -503,6 +569,19 @@ def main() -> int:
         else GenerationEngine
     )
     engine_kwargs = {"kv_quant": os.environ.get("BENCH_KV_QUANT", "none")}
+    # Engine-level plan resolution tracks bench's own: production-default
+    # runs let the engine consult the DB (the feature), while explicit A/B
+    # invocations (BENCH_NO_FALLBACK=1 → prod_defaults off) pin the static
+    # defaults so a populated user DB can't silently retune unpinned knobs
+    # (formulation, buckets, top-p) out from under the recorded config.
+    # BENCH_AUTOTUNE=0/1 overrides either way.
+    engine_kwargs["autotune"] = os.environ.get(
+        "BENCH_AUTOTUNE", "1" if prod_defaults else "0"
+    ) == "1"
+    # the engine's own plan resolution must hit the SAME rows-aware DB key
+    # bench's production-defaults consult used — otherwise two tune runs at
+    # different volumes could split one run's knobs across two entries
+    engine_kwargs["plan_rows"] = n_prompts * n_cand
     if os.environ.get("BENCH_SCAN_CHUNK"):
         # K decode steps fused per dispatch (dense engine / paged refill) —
         # the tunnel dispatch-overhead lever; see tools/dispatch_probe.py
@@ -679,6 +758,13 @@ def main() -> int:
         "tokens_per_slot_step": accept_rate,
         "eos_rate": eos_rate,
         "mean_gen_tokens": round(mean_new, 1),
+        # the benched geometry AND device kind, so plan ingestion
+        # (tools/autotune.py) can key this row without trusting
+        # CLI-supplied defaults or inferring hardware from peak_tflops
+        # (which defaults to 197 regardless of the actual chip)
+        "max_prompt_tokens": max_prompt,
+        "max_new_tokens": max_new,
+        "device_kind": _device_kind(),
         "bucket_used": engine.bucket_for(pmask),
         "short_fraction": round(short_fraction, 3),
         "value": round(tps_chip, 1),
@@ -688,9 +774,27 @@ def main() -> int:
         "model": name,
         "base_quant": base_quant,
         "kv_quant": engine_kwargs["kv_quant"],
-        "top_p_impl": sampling.resolved_top_p_impl(),
-        "scan_chunk": engine_kwargs.get("scan_chunk", 0),
+        "top_p_impl": sampling.resolved_top_p_impl(
+            getattr(engine, "plan_top_p_impl", None)
+        ),
+        # the engine's EFFECTIVE chunk (post plan resolution), not the
+        # requested env value — perf artifacts must be self-describing
+        "scan_chunk": getattr(engine, "scan_chunk", 0),
         "scan_chunk_active": getattr(engine, "scan_chunk_active", None),
+        # the full resolved execution plan + where it came from ("db" /
+        # "default" / "disabled"), so a regression like "scan-chunk
+        # silently engaged" is diffable from the artifact alone
+        "plan": (
+            engine.resolved_plan.plan.to_dict()
+            if getattr(engine, "resolved_plan", None) else None
+        ),
+        "plan_source": (
+            engine.resolved_plan.source
+            if getattr(engine, "resolved_plan", None) else None
+        ),
+        "cache_read_formulation": getattr(
+            engine, "cache_read_formulation", None
+        ),
         # which paged-attention impl the probe chain actually dispatched
         # (None for dense runs / before any paged dispatch)
         "paged_attn_impl": _paged_dispatch_choice(),

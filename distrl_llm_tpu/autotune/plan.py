@@ -1,0 +1,221 @@
+"""Execution-plan space: the discrete dispatch choices the engines used to
+hard-code, as one typed record.
+
+Round 5's headline regression (VERDICT.md) was a PLAN bug, not a kernel bug:
+the bench's "production default" engaged the scan-chunk lever silicon had
+measured 2.5× slower, and the paged path ran 5–6× behind dense at the benched
+geometry. Every knob in :class:`ExecutionPlan` is one of those choices — the
+things a measurement on the device, not a guess in the source, should pick
+(the system-level tuning discipline LlamaRL/RLAX apply to keep RL pipelines
+at hardware speed across geometries; PAPERS.md).
+
+Plans are keyed by ``(device kind, model-config hash, shape bucket)`` —
+``plan_key`` — because every one of these choices is hardware- and
+geometry-dependent: chunked dispatch wins over a 40 ms/step network tunnel
+and loses 2.5× on a local chip; the paged path wins when capacity binds and
+loses when the grid-step floor does.
+
+``DEFAULT_PLAN`` is deliberately identical to the engines' historical
+hard-coded defaults, so resolution against an empty DB is a byte-identical
+no-op (the acceptance contract pinned by tests/test_autotune.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from dataclasses import dataclass
+
+DECODE_PATHS = ("dense", "paged", "speculative")
+FORMULATIONS = (None, "dot", "mulred")
+
+
+@dataclass(frozen=True)
+class ExecutionPlan:
+    """One resolved set of dispatch choices for an engine + geometry.
+
+    Field defaults ARE the engines' pre-autotuner hard-coded defaults;
+    ``None``/empty means "derive exactly as the engine always has" (e.g.
+    ``cache_read_formulation=None`` → mulred iff scan_chunk, the invariant
+    engine.py documents).
+    """
+
+    # which engine class/scheduler serves decode. Engines can't change their
+    # own class, so this field is consulted by the CALLERS that pick one
+    # (bench.py; tools/autotune.py reports it) and pinned to the actual
+    # class by the engine's own resolution (honest bench records).
+    decode_path: str = "dense"
+    # K decode steps fused per dispatch via lax.scan; 0 = host loop
+    scan_chunk: int = 0
+    # decode cache-read formulation (dense engine); None derives from
+    # scan_chunk (ops/attention.py::attention_cached has the layout story)
+    cache_read_formulation: str | None = None
+    # top-p filter implementation (a key of ops.sampling.TOP_P_IMPLS); None
+    # derives from SamplingConfig.top_p_exact as always. An explicit
+    # SamplingConfig pin (top_p_impl / top_p_exact) still wins at generate().
+    top_p_impl: str | None = None
+    # prompt length buckets for the dense engine; () = the single
+    # max_prompt_tokens bucket (engine-compiled per bucket used)
+    prompt_buckets: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        if self.decode_path not in DECODE_PATHS:
+            raise ValueError(
+                f"decode_path must be one of {DECODE_PATHS}, got "
+                f"{self.decode_path!r}"
+            )
+        if not isinstance(self.scan_chunk, int) or self.scan_chunk < 0:
+            raise ValueError(
+                f"scan_chunk must be an int >= 0, got {self.scan_chunk!r}"
+            )
+        if self.cache_read_formulation not in FORMULATIONS:
+            raise ValueError(
+                f"cache_read_formulation must be one of {FORMULATIONS}, got "
+                f"{self.cache_read_formulation!r}"
+            )
+        if self.top_p_impl is not None:
+            from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
+
+            if self.top_p_impl not in TOP_P_IMPLS:
+                raise ValueError(
+                    f"top_p_impl must be one of {sorted(TOP_P_IMPLS)}, got "
+                    f"{self.top_p_impl!r}"
+                )
+        # normalize list → tuple (JSON round-trips through lists)
+        object.__setattr__(
+            self, "prompt_buckets", tuple(int(b) for b in self.prompt_buckets)
+        )
+        if any(b <= 0 for b in self.prompt_buckets):
+            raise ValueError(
+                f"prompt_buckets must be positive, got {self.prompt_buckets}"
+            )
+
+    def replace(self, **kw) -> "ExecutionPlan":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["prompt_buckets"] = list(self.prompt_buckets)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExecutionPlan":
+        """Tolerant of unknown keys (a newer writer within the same schema
+        version may add fields); missing keys take the defaults."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in dict(d).items() if k in fields})
+
+
+DEFAULT_PLAN = ExecutionPlan()
+
+#: the ExecutionPlan fields a caller may pin explicitly (resolution order:
+#: explicit user kwarg > stored plan > DEFAULT_PLAN, per field)
+TUNABLE_FIELDS = tuple(f.name for f in dataclasses.fields(ExecutionPlan))
+
+
+# ------------------------------------------------------------------ plan keys
+
+
+def model_config_hash(model_cfg) -> str:
+    """Stable short hash of a ModelConfig: same architecture → same plans,
+    regardless of which named constant or checkpoint produced it."""
+    blob = json.dumps(
+        dataclasses.asdict(model_cfg), sort_keys=True, default=str
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+# one canonical name per accelerator family: jax reports the same silicon as
+# "TPU v5e" / "TPU v5 lite" / "tpu v5 litepod" depending on runtime version,
+# and plans measured under one alias must resolve under the others
+_KIND_ALIASES = (
+    ("v6", "tpu_v6"),
+    ("v5p", "tpu_v5p"),
+    ("v5e", "tpu_v5e"),
+    ("v5 lite", "tpu_v5e"),
+    ("v5litepod", "tpu_v5e"),
+    ("v4", "tpu_v4"),
+    ("v3", "tpu_v3"),
+    ("v2", "tpu_v2"),
+)
+
+
+def canonical_device_kind(raw: str) -> str:
+    low = raw.lower()
+    for sub, canon in _KIND_ALIASES:
+        if sub in low:
+            return canon
+    return re.sub(r"[^a-z0-9]+", "_", low).strip("_") or "unknown"
+
+
+def current_device_kind() -> str:
+    """Canonical kind of this host's first accelerator ("cpu" on CPU hosts,
+    "unknown" when no backend initializes)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return dev.platform  # "cpu" / "gpu"
+        return canonical_device_kind(dev.device_kind)
+    except Exception:  # noqa: BLE001 — no backend at all
+        return "unknown"
+
+
+def rows_bucket(rows: int) -> int:
+    """Concurrent-row count bucketed to the next power of two (480 → 512):
+    plans generalize across nearby batch sizes, not across orders of
+    magnitude."""
+    if rows <= 0:
+        return 0
+    b = 1
+    while b < rows:
+        b *= 2
+    return b
+
+
+def shape_bucket(max_prompt_tokens: int, max_new_tokens: int,
+                 rows: int = 0) -> str:
+    """Geometry key component. ``rows=0`` is the any-row-count bucket —
+    engines resolve with it (batch size arrives at generate(), after the
+    plan is already baked into compiled programs); tuners that know the row
+    count write both the exact and the any-rows entry."""
+    base = f"p{max_prompt_tokens}_n{max_new_tokens}"
+    rb = rows_bucket(rows)
+    return f"{base}_r{rb}" if rb else base
+
+
+def plan_key(device_kind: str, model_hash: str, bucket: str) -> str:
+    return f"{device_kind}/{model_hash}/{bucket}"
+
+
+# ------------------------------------------------------------ candidate space
+
+
+def candidate_plans(
+    *,
+    decode_paths=("dense",),
+    scan_chunks=(0, 16),
+    formulations=(None,),
+    top_p_impls=(None,),
+) -> list[ExecutionPlan]:
+    """Enumerate a candidate space for the tuner (cartesian product, with
+    the always-meaningless combos dropped: a formulation override without a
+    dense path, a scan_chunk of 1 — scan-of-one has no fusion benefit and
+    the engines refuse to report it as chunked)."""
+    out = []
+    for path in decode_paths:
+        for chunk in scan_chunks:
+            if chunk == 1:
+                continue
+            for form in formulations:
+                if form is not None and path != "dense":
+                    continue
+                for tp in top_p_impls:
+                    out.append(ExecutionPlan(
+                        decode_path=path, scan_chunk=chunk,
+                        cache_read_formulation=form, top_p_impl=tp,
+                    ))
+    return out

@@ -41,7 +41,11 @@ class SamplingConfig:
     # resolved_top_p_impl().
     top_p_impl: str | None = None
 
-    def resolved_top_p_impl(self) -> str:
+    def resolved_top_p_impl(self, plan_default: str | None = None) -> str:
+        """Effective top-p implementation. Priority: an explicit
+        ``top_p_impl`` pin, then ``top_p_exact`` (reference semantics were
+        asked for by name), then the engine's autotuned plan default
+        (``plan_default`` — ExecutionPlan.top_p_impl), then "bisect"."""
         if self.top_p_impl:  # "" and None both mean "derive"
             from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
 
@@ -51,7 +55,14 @@ class SamplingConfig:
                     f"got {self.top_p_impl!r}"
                 )
             return self.top_p_impl
-        return "exact" if self.top_p_exact else "bisect"
+        if self.top_p_exact:
+            return "exact"
+        if plan_default:
+            # already validated: plan_default only ever carries
+            # ExecutionPlan.top_p_impl, checked against TOP_P_IMPLS at plan
+            # construction (autotune/plan.py)
+            return plan_default
+        return "bisect"
 
     def replace(self, **kw) -> "SamplingConfig":
         return dataclasses.replace(self, **kw)
@@ -206,8 +217,20 @@ class TrainConfig:
     # speed (tools/dispatch_probe.py measures it); chunking divides that
     # overhead by K. The engine compile-checks the chunked program's
     # memory_analysis and falls back to one dispatch per step if the TPU
-    # compiler double-buffered the KV cache in the scan carry. 0 = off.
-    decode_scan_chunk: int = 0
+    # compiler double-buffered the KV cache in the scan carry.
+    # None (default) = let the autotune plan DB decide (static default: off);
+    # an EXPLICIT value — including 0 — always wins over any stored plan.
+    decode_scan_chunk: int | None = None
+    # execution-plan autotuner (distrl_llm_tpu/autotune): engines resolve
+    # their dispatch choices (scan chunk, cache-read formulation, top-p
+    # impl, prompt buckets) from a persistent DB of on-device measurements
+    # instead of hard-coded guesses. Explicitly-set flags always win; with
+    # no DB entry behavior is byte-identical to the static defaults.
+    # autotune=False pins the static defaults without consulting any DB.
+    autotune: bool = True
+    # plan-DB path (tools/autotune.py writes it). None = $DISTRL_PLAN_DB or
+    # ~/.cache/distrl_llm_tpu/plan_db.json
+    plan_db: str | None = None
     # control-plane rollout workers ("host:port", ...): when set, generation
     # dispatches to these worker processes (distributed/worker_main.py) over
     # the C++ control plane instead of running on local chips — the
@@ -356,7 +379,7 @@ class TrainConfig:
                 "full_finetune cannot ship full weights to rollout_workers "
                 "(workers receive adapters only); run local rollout"
             )
-        if self.decode_scan_chunk < 0:
+        if self.decode_scan_chunk is not None and self.decode_scan_chunk < 0:
             raise ValueError(
                 f"decode_scan_chunk must be >= 0, got {self.decode_scan_chunk}"
             )

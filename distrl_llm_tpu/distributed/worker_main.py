@@ -36,7 +36,8 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  engine_impl: str = "dense", kv_quant: str = "none",
                  max_concurrent: int = 0, scheduler: str = "waves",
                  spec_draft: int = 0, gpu_usage: float = 0.0,
-                 budget_batch: int = 0) -> None:
+                 budget_batch: int = 0, scan_chunk: int | None = None,
+                 autotune: bool = True, plan_db: str | None = None) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -68,6 +69,18 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
 
     _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
     kwargs = {"kv_quant": kv_quant}  # both engines support int8 KV
+    # execution-plan autotune (distrl_llm_tpu/autotune): each worker
+    # resolves against ITS OWN host's plan DB — remote engines are
+    # configured via worker_main flags by design (config.py's
+    # rollout_workers contract), so --autotune off / --plan-db /
+    # --decode-scan-chunk are per-worker pins, same semantics as the
+    # driver's engines (explicit values, including chunk 0, always win)
+    if not autotune:
+        kwargs["autotune"] = False
+    if plan_db:
+        kwargs["plan_db"] = plan_db
+    if scan_chunk is not None:
+        kwargs["scan_chunk"] = scan_chunk
     if engine_impl == "paged":
         engine_cls = PagedGenerationEngine
         kwargs["scheduler"] = scheduler
@@ -215,6 +228,17 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--budget-batch", type=int, default=0,
                         help="prompts per round assumed by the page-budget "
                              "math (shared prompt-page region)")
+    parser.add_argument("--decode-scan-chunk", type=int, default=None,
+                        help="decode steps fused per dispatch; 0 = off; "
+                             "unset = this host's autotune plan DB decides. "
+                             "An explicit value, including 0, always wins")
+    parser.add_argument("--autotune", type=str, default="on",
+                        choices=["on", "off"],
+                        help="'off' pins the static engine defaults without "
+                             "reading this host's plan DB")
+    parser.add_argument("--plan-db", dest="plan_db", type=str, default=None,
+                        help="plan-DB path (default: $DISTRL_PLAN_DB or "
+                             "~/.cache/distrl_llm_tpu/plan_db.json)")
     parser.add_argument("--trace", action="store_true",
                         help="record telemetry spans and ship them to the "
                              "driver in RPC responses (also enabled by "
@@ -243,6 +267,8 @@ def main(argv: list[str] | None = None) -> None:
             max_concurrent=args.max_concurrent_sequences,
             scheduler=args.scheduler, spec_draft=args.spec_draft,
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
+            scan_chunk=args.decode_scan_chunk,
+            autotune=args.autotune == "on", plan_db=args.plan_db,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer

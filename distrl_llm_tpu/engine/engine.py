@@ -304,7 +304,7 @@ def scan_steps_guarded(run, state, chunk: int):
 
 
 def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
-                          *args, **kwargs):
+                          *args, fusion_bytes: int = 0, **kwargs):
     """Lower + compile a K-steps-per-dispatch program and inspect its
     ``memory_analysis`` BEFORE it ever runs: if the TPU compiler
     double-buffered the scanned carry (temp bytes on the order of the KV
@@ -318,7 +318,21 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
     test scales, legitimate scratch (attention workspaces, gathers) can
     exceed half of a kilobyte-sized cache without any double-buffering —
     the failure mode this guards against is a CACHE-sized temp, which at
-    any scale that matters is hundreds of MBs."""
+    any scale that matters is hundreds of MBs.
+
+    ``fusion_bytes`` (ADVICE r5) is the second, smaller envelope for
+    mulred-formulation programs: the per-layer ``_gqa_mulred``
+    broadcast-product temp ([B, KH, G, D, S] f32) that a backend failing
+    to fuse reduce-of-product into the cache read would materialize. That
+    temp is G× one cache layer but can sit BELOW half the total cache
+    (e.g. G=7 over 24 layers ≈ 0.29× cache), sailing under the
+    double-buffer check — so it gets its own threshold, with its own
+    64 MiB floor against tiny-scale scratch false positives.
+
+    EVERY fallback here is loud: a ``log.warning`` naming the cause plus
+    an ``engine/chunk_fallback`` telemetry counter — silently flipping
+    ``scan_chunk_active`` is exactly the trap that contaminated the
+    round-5 bench rows (VERDICT.md)."""
     try:
         compiled = fn_jit.lower(*args, **kwargs).compile()
         temp = None
@@ -334,6 +348,21 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
                 "host-dispatched steps",
                 what, temp / 2**30, alias_bytes / 2**30,
             )
+            telemetry.counter_add("engine/chunk_fallback")
+            return None
+        if (
+            temp is not None and fusion_bytes
+            and temp > 0.5 * fusion_bytes and temp > 64 * 2**20
+        ):
+            _logger.warning(
+                "%s: chunked program materializes a broadcast-product-sized "
+                "temp (%.2f GiB vs _gqa_mulred product %.2f GiB) — the "
+                "backend failed to fuse the G-expanded [B,KH,G,D,S] "
+                "multiply into the cache read; falling back to "
+                "host-dispatched steps",
+                what, temp / 2**30, fusion_bytes / 2**30,
+            )
+            telemetry.counter_add("engine/chunk_fallback")
             return None
         return compiled
     except Exception as e:  # pragma: no cover - backend-specific
@@ -342,11 +371,12 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
             "to host-dispatched steps",
             what, type(e).__name__, e,
         )
+        telemetry.counter_add("engine/chunk_fallback")
         return None
 
 
 def cached_chunk_program(cache: dict, mu, key, fn_jit, alias_bytes: int,
-                         what: str, *args, **kwargs):
+                         what: str, *args, fusion_bytes: int = 0, **kwargs):
     """Mutex-guarded memoization of ``compile_chunk_guarded`` — one shared
     implementation so every engine's chunk-program cache carries the same
     locking (concurrent generate() calls share an engine in the trainer's
@@ -354,7 +384,8 @@ def cached_chunk_program(cache: dict, mu, key, fn_jit, alias_bytes: int,
     with mu:
         if key not in cache:
             cache[key] = compile_chunk_guarded(
-                fn_jit, alias_bytes, what, *args, **kwargs
+                fn_jit, alias_bytes, what, *args,
+                fusion_bytes=fusion_bytes, **kwargs
             )
         return cache[key]
 
@@ -582,16 +613,70 @@ class GenerationEngine(LoraMailbox):
         kv_quant: str = "none",  # "int8": fused-dequant cache (paged parity)
         attn_impl: str = "reference",
         decode_chunk: int = 128,
-        scan_chunk: int = 0,  # >0: K decode steps per dispatch via lax.scan
+        # None = consult the autotune plan DB (falls back to 0, the
+        # historical default); an explicit int — including 0 — always wins
+        scan_chunk: int | None = None,
         prompt_buckets: Sequence[int] | None = None,
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
         capture_logprobs: bool = False,  # record behavior logprobs (clip_ratio)
         cache_read_formulation: str | None = None,  # None = auto by scan_chunk
+        autotune: bool = True,  # False pins the static defaults (no DB read)
+        plan_db: str | None = None,  # plan-DB path; None = env/default path
+        # expected concurrent candidate rows, for plan-key selection ONLY
+        # (batch size arrives at generate()): callers that know the round
+        # volume (bench) pass it so their own resolve and the engine's hit
+        # the SAME DB entry; 0 = the any-rows entry
+        plan_rows: int = 0,
     ):
         self.max_concurrent_rows = max_concurrent_rows
         self.capture_logprobs = capture_logprobs
-        if scan_chunk < 0:
+        if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        if cache_read_formulation not in (None, "dot", "mulred"):
+            raise ValueError(
+                "cache_read_formulation must be None/'dot'/'mulred', got "
+                f"{cache_read_formulation!r}")
+        # Execution-plan resolution (distrl_llm_tpu/autotune): explicit
+        # kwargs always win; a stored measured plan fills the rest; with no
+        # DB entry the static defaults apply byte-identically. decode_path
+        # is pinned to this class so bench/trace records stay honest.
+        from distrl_llm_tpu.autotune import resolve_plan
+
+        requested: dict[str, Any] = {"decode_path": "dense"}
+        if scan_chunk is not None:
+            requested["scan_chunk"] = scan_chunk
+        if cache_read_formulation is not None:
+            requested["cache_read_formulation"] = cache_read_formulation
+        if prompt_buckets is not None:
+            requested["prompt_buckets"] = tuple(prompt_buckets)
+        self.resolved_plan = resolve_plan(
+            model_cfg=cfg, max_prompt_tokens=max_prompt_tokens,
+            max_new_tokens=max_new_tokens, rows=plan_rows,
+            requested=requested, db_path=plan_db, enabled=autotune,
+        )
+        plan = self.resolved_plan.plan
+        scan_chunk = plan.scan_chunk
+        if prompt_buckets is None and plan.prompt_buckets:
+            # a DB plan must never crash a run (store.py's contract): a
+            # stored bucket that doesn't fit THIS engine's geometry (e.g. a
+            # cross-geometry hand-copied entry) is dropped with a warning,
+            # where the same bucket passed explicitly would raise below
+            fitting = tuple(
+                b for b in plan.prompt_buckets if 0 < b <= max_prompt_tokens
+            )
+            if fitting != plan.prompt_buckets:
+                _logger.warning(
+                    "autotune plan buckets %s exceed max_prompt_tokens=%d — "
+                    "keeping only %s (re-run tools/autotune.py for this "
+                    "geometry)",
+                    list(plan.prompt_buckets), max_prompt_tokens,
+                    list(fitting),
+                )
+            prompt_buckets = fitting or None
+        # plan-suggested top-p implementation; an explicit SamplingConfig
+        # pin (top_p_impl / top_p_exact) still wins at generate() —
+        # SamplingConfig.resolved_top_p_impl(plan_default)
+        self.plan_top_p_impl = plan.top_p_impl
         self.scan_chunk = scan_chunk
         # Chunk-configured engines read the cache via multiply+reduce in BOTH
         # the chunk program and the host-dispatched steps (tail / guard
@@ -601,12 +686,9 @@ class GenerationEngine(LoraMailbox):
         # everywhere keeps chunk-vs-host greedy decode bit-identical. The
         # explicit kwarg exists for parity tests and on-chip formulation
         # A/Bs; None picks the right one for the dispatch mode.
-        if cache_read_formulation not in (None, "dot", "mulred"):
-            raise ValueError(
-                "cache_read_formulation must be None/'dot'/'mulred', got "
-                f"{cache_read_formulation!r}")
         self.cache_read_formulation = (
-            cache_read_formulation or ("mulred" if scan_chunk else "dot"))
+            plan.cache_read_formulation
+            or ("mulred" if scan_chunk else "dot"))
         # buckets where the chunked program compiled WITHOUT double-buffering
         # the KV cache (memory_analysis guard) hold their compiled fn here;
         # buckets where it did are marked None and use the host loop
@@ -746,9 +828,21 @@ class GenerationEngine(LoraMailbox):
             cache_bytes = sum(
                 x.nbytes for x in jax.tree_util.tree_leaves(state.cache)
             )
+            fusion_bytes = 0
+            if self.cache_read_formulation == "mulred":
+                # per-layer _gqa_mulred broadcast product at this bucket's
+                # full window — the unfused-temp envelope (ADVICE r5)
+                from distrl_llm_tpu.ops.attention import mulred_broadcast_bytes
+
+                fusion_bytes = mulred_broadcast_bytes(
+                    bn, self.cfg.num_kv_heads,
+                    self.cfg.num_heads // self.cfg.num_kv_heads,
+                    self.cfg.head_dim, bucket + self.max_new_tokens,
+                )
             compiled = compile_chunk_guarded(
                 fn, cache_bytes, f"scan_chunk={self.scan_chunk} bucket={bucket}",
-                params, lora, state, rng, eos_ids=self.eos_ids,
+                params, lora, state, rng, fusion_bytes=fusion_bytes,
+                eos_ids=self.eos_ids,
                 temperature=temperature, top_p=top_p,
             )
             self._chunk_compiled[key] = compiled
@@ -811,7 +905,7 @@ class GenerationEngine(LoraMailbox):
         )
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
-        top_p_impl = sampling.resolved_top_p_impl()
+        top_p_impl = sampling.resolved_top_p_impl(self.plan_top_p_impl)
         lora_cell = [lora]
         steps_seen = [0]
         # explicit enter/exit: the span must cover BOTH dispatch branches

@@ -112,11 +112,30 @@ class ShardedPagedEngine(LoraMailbox):
         decode_chunk: int = 128,
         kv_quant: str = "none",
         prompt_buckets: Sequence[int] | None = None,  # interface parity
-        scan_chunk: int = 0,  # >1: K decode steps per dispatch via lax.scan
+        # None = consult the autotune plan DB (falls back to 0, the
+        # historical default); an explicit int — including 0 — always wins
+        scan_chunk: int | None = None,
         capture_logprobs: bool = False,
+        autotune: bool = True,  # False pins the static defaults (no DB read)
+        plan_db: str | None = None,  # plan-DB path; None = env/default path
+        plan_rows: int = 0,  # expected rows for plan-KEY selection (0 = any)
     ):
-        if scan_chunk < 0:
+        if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        # execution-plan resolution (distrl_llm_tpu/autotune): explicit
+        # kwargs win; no DB entry = the static defaults byte-identically
+        from distrl_llm_tpu.autotune import resolve_plan
+
+        requested: dict[str, Any] = {"decode_path": "paged"}
+        if scan_chunk is not None:
+            requested["scan_chunk"] = scan_chunk
+        self.resolved_plan = resolve_plan(
+            model_cfg=cfg, max_prompt_tokens=max_prompt_tokens,
+            max_new_tokens=max_new_tokens, rows=plan_rows,
+            requested=requested, db_path=plan_db, enabled=autotune,
+        )
+        scan_chunk = self.resolved_plan.plan.scan_chunk
+        self.plan_top_p_impl = self.resolved_plan.plan.top_p_impl
         if "dp" not in mesh.shape:
             raise ValueError(f"mesh needs a 'dp' axis, got {dict(mesh.shape)}")
         other = {k: v for k, v in mesh.shape.items() if k != "dp" and v > 1}
@@ -304,7 +323,7 @@ class ShardedPagedEngine(LoraMailbox):
                  np.zeros((pad_rows, p), np.int32)], axis=0
             )
         b_pad = b + pad_rows
-        top_p_impl = sampling.resolved_top_p_impl()
+        top_p_impl = sampling.resolved_top_p_impl(self.plan_top_p_impl)
         setup, step, chunk_jit, k = self._build(
             n, b_pad // self.dp, max_steps, top_p_impl
         )

@@ -188,6 +188,18 @@ def _gqa_attention(q, k, v, mask, scale, *, kv_subscript: str,
     return out.reshape(b, sq, h, d)
 
 
+def mulred_broadcast_bytes(batch_rows: int, kv_heads: int, groups: int,
+                           head_dim: int, kv_len: int) -> int:
+    """Bytes of ONE layer's unfused ``_gqa_mulred`` broadcast product — the
+    [B, KH, G, D, S] f32 temp a backend would materialize if it failed to
+    fuse reduce-of-product into the cache read. The HBM audits
+    (tools/tpu_kernel_check.py and ``compile_chunk_guarded``'s
+    ``fusion_bytes`` threshold) price temp bytes against this: a fused
+    program's scratch sits far below it, an unfused one lands on it and
+    OOMs real geometries (ADVICE r5)."""
+    return batch_rows * kv_heads * groups * head_dim * kv_len * 4
+
+
 def _gqa_mulred(q, k, v, mask, scale, *, k_scale=None, v_scale=None):
     """Sq==1 decode attention as multiply+reduce over the [B, K, D, S]
     cache — no ``dot_general`` touches the cache operands, so TPU layout

@@ -59,10 +59,12 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     KV quant, continuous batching, speculative decoding, row cap). Module
     level so the config→engine wiring is unit-testable without a checkpoint."""
     kwargs: dict[str, Any] = {"kv_quant": config.kv_cache_quant}
-    if config.decode_scan_chunk:
+    if config.decode_scan_chunk is not None:
         # every engine_impl hosts the chunked step (dense, paged wave +
         # refill, paged_sharded, and the speculative scheduler via
-        # _spec_chunk_fn — chunk counts verify rounds there)
+        # _spec_chunk_fn — chunk counts verify rounds there). An explicit
+        # value — INCLUDING 0 — must reach the engine as a pin, so a
+        # --decode_scan_chunk 0 A/B can never be retuned by a stored plan
         kwargs["scan_chunk"] = config.decode_scan_chunk
     if config.engine_impl == "paged":
         if config.continuous_batching:
@@ -78,6 +80,13 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
         # behavior-logprob capture costs a per-step vocab logsumexp plus the
         # [B, n, T] f32 transport — only pay it when the clip objective needs it
         kwargs["capture_logprobs"] = True
+    # autotune plan resolution (distrl_llm_tpu/autotune): only non-default
+    # settings are forwarded, so the kwargs stay minimal and an engine built
+    # from a default config keeps consulting the default plan-DB path
+    if not config.autotune:
+        kwargs["autotune"] = False
+    if config.plan_db:
+        kwargs["plan_db"] = config.plan_db
     return kwargs
 
 

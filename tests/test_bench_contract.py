@@ -49,12 +49,18 @@ class TestBenchContract:
         rec = run_bench(self.TINY)
         for key in ("metric", "value", "unit", "vs_baseline", "backend",
                     "scan_chunk", "scan_chunk_active", "engine",
-                    "paged_attn_impl", "total_tokens"):
+                    "paged_attn_impl", "total_tokens",
+                    "plan", "plan_source", "cache_read_formulation"):
             assert key in rec, key
         assert rec["metric"] == "rollout_tokens_per_sec_per_chip"
         assert rec["backend"] == "cpu"
         assert rec["value"] > 0
         assert "error" not in rec
+        # the resolved execution plan makes the row self-describing: the
+        # effective dispatch choices plus where they came from
+        assert rec["plan"]["decode_path"] == "dense"
+        assert rec["plan_source"] in ("db", "default", "disabled")
+        assert rec["scan_chunk"] == rec["plan"]["scan_chunk"]
 
     def test_learner_record_shape(self):
         rec = run_bench({

@@ -26,6 +26,10 @@ stage "bench_fallback" env JAX_PLATFORMS=cpu BENCH_MODEL=tiny BENCH_PROMPTS=4 \
 # Chrome-trace JSON that parses and trace_report.py exits 0 on
 stage "telemetry_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/telemetry_smoke.py
+# autotune acceptance gate: 2-candidate micro-bench → tmpdir plan-DB
+# round-trip, deterministic resolve, kwarg override, corrupt-DB fallback
+stage "autotune_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/autotune_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one

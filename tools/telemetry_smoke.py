@@ -96,6 +96,9 @@ def main() -> int:
         eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
         cache_dtype=jax.numpy.float32,
         lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+        # this gate checks telemetry, not plans: pin the static defaults so
+        # a populated user plan DB can't make the CI stage nondeterministic
+        autotune=False,
     )
     sink = MemorySink()
     trainer = Trainer(

@@ -188,6 +188,46 @@ def main() -> int:
     )
     check_paged("paged_folded_hd128_int8", 28, kq128, vq128, "native_folded")
 
+    # ---- _gqa_mulred fusion audit (ADVICE r5): the mulred decode read's
+    # [B, KH, G, D, S] broadcast product must be FUSED into the cache read —
+    # a backend that materializes the G-expanded temp costs G× one cache
+    # layer per step and OOMs real geometries before the chunk guard's
+    # cache-sized threshold would trip. Audited at the benched 0.5B decode
+    # geometry, bf16 and fused-dequant int8 alike.
+    try:
+        from functools import partial
+
+        from distrl_llm_tpu.ops.attention import (
+            attention_cached, attention_cached_quant, mulred_broadcast_bytes,
+        )
+
+        bm, hm, khm, dm, sm = 64, 14, 2, 64, 1550
+        gm = hm // khm
+        product = mulred_broadcast_bytes(bm, khm, gm, dm, sm)
+        qm = jnp.zeros((bm, 1, hm, dm), jnp.bfloat16)
+        km = jnp.zeros((bm, khm, dm, sm), jnp.bfloat16)
+        mm = jnp.ones((bm, 1, 1, sm), bool)
+
+        def audit(label, fn, *args):
+            nonlocal failures
+            mem = jax.jit(fn).lower(*args).compile().memory_analysis()
+            temp = mem.temp_size_in_bytes
+            ok = temp < 0.5 * product
+            failures += not ok
+            print(f"{'PASS' if ok else 'FAIL'} {label} B={bm} S={sm} "
+                  f"temp={temp / 1e6:.0f}MB product={product / 1e6:.0f}MB "
+                  f"(broadcast temp must fuse into the cache read)")
+
+        audit("mulred_fusion_bf16",
+              partial(attention_cached, formulation="mulred"), qm, km, km, mm)
+        k8 = jnp.zeros((bm, khm, dm, sm), jnp.int8)
+        sc = jnp.ones((bm, khm, 1, sm), jnp.float32)
+        audit("mulred_fusion_int8",
+              partial(attention_cached_quant, formulation="mulred"),
+              qm, k8, sc, k8, sc, mm)
+    except Exception as e:  # noqa: BLE001 — audit is best-effort on-chip
+        print(f"SKIP mulred_fusion ({e})")
+
     # ---- donated decode-step HBM audit (TPU only — CPU memory_analysis
     # does not model donation aliasing, so this cannot run in CI): the
     # refill/spec step programs must NOT materialize page-pool-sized temps.

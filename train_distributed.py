@@ -67,14 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["none", "int8"],
                    help="paged-engine KV cache quantization (int8 halves "
                         "cache memory + decode bandwidth)")
-    p.add_argument("--decode_scan_chunk", type=int, default=0,
+    p.add_argument("--decode_scan_chunk", type=int, default=None,
                    help="decode steps fused per dispatch via lax.scan "
                         "(all engines: dense, paged wave/refill, sharded, "
                         "and speculative) — "
                         "amortizes per-dispatch overhead on network-"
                         "tunneled PJRT clients (tools/dispatch_probe.py "
                         "measures it); auto-falls back if the compiler "
-                        "double-buffers the KV cache. 0 = off")
+                        "double-buffers the KV cache. 0 = off; unset = "
+                        "let the autotune plan DB decide (static "
+                        "default: off). An explicit value, including 0, "
+                        "always wins over any stored plan")
     p.add_argument("--full_finetune", action="store_true",
                    help="bf16 full-rank fine-tuning (no LoRA): the whole "
                         "param tree trains; requires --base_quant none")
@@ -163,6 +166,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "to RoPE float round-off). Separate from "
                         "--prompt_buckets, which only shapes the rollout "
                         "engine")
+    p.add_argument("--autotune", type=str, default="on",
+                   choices=["on", "off"],
+                   help="execution-plan autotuner (distrl_llm_tpu/autotune)"
+                        ": engines resolve dispatch choices (scan chunk, "
+                        "cache-read formulation, top-p impl, prompt "
+                        "buckets) from the persistent plan DB of on-device "
+                        "measurements (tools/autotune.py populates it). "
+                        "Explicitly-set flags always win; with no DB entry "
+                        "behavior is identical to the static defaults. "
+                        "'off' pins the static defaults without reading "
+                        "any DB")
+    p.add_argument("--plan-db", "--plan_db", dest="plan_db",
+                   type=str, default=None,
+                   help="plan-DB path for --autotune (default: "
+                        "$DISTRL_PLAN_DB or "
+                        "~/.cache/distrl_llm_tpu/plan_db.json)")
     p.add_argument("--top_p_exact", action="store_true",
                    help="exact sort-based nucleus filter (reference vLLM "
                         "semantics) instead of the fast bisection filter")
@@ -199,6 +218,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     fields["rollout_workers"] = tuple(
         w.strip() for w in str(args.rollout_workers or "").split(",") if w.strip()
     )
+    fields["autotune"] = args.autotune == "on"
     return TrainConfig(mesh=mesh, **fields)
 
 
@@ -251,6 +271,11 @@ def run_smoke(config: TrainConfig) -> None:
         max_new_tokens=config.max_new_tokens,
         eos_token_ids=[tokenizer.eos_token_id],
         pad_token_id=tokenizer.pad_token_id,
+        # honor --autotune/--plan-db in the smoke path too: "--autotune off
+        # skips the DB read entirely" must hold for every engine the CLI
+        # builds
+        autotune=config.autotune,
+        plan_db=config.plan_db,
     )
     sink = MemorySink()
     from distrl_llm_tpu.parallel.mesh import build_role_meshes
