@@ -218,13 +218,46 @@ def _device_kind() -> str:
 
 def _paged_dispatch_choice():
     """Which paged-attention impl the probe chain actually dispatched
-    ("native"/"native_folded"/"fixed"/"jaxlib"/"reference"), or None if no paged dispatch
-    ran. Distinct per-config choices are joined with '+'."""
+    ("native"/"native_folded"/"native_blocked"/"fixed"/"jaxlib"/
+    "reference"), or None if no paged dispatch ran. Distinct per-config
+    choices are joined with '+'."""
     import importlib
 
     paged_mod = importlib.import_module("distrl_llm_tpu.ops.paged")
     choices = sorted(set(paged_mod.dispatch_choices.values()))
     return "+".join(choices) if choices else None
+
+
+def _paged_kernel_ran():
+    """Plan-vocabulary spelling ("one_page"/"folded"/"blocked") of the
+    dispatched paged kernel, falling back to the raw impl name for
+    non-native dispatches — the bench record's ``paged_kernel`` field,
+    matching the ExecutionPlan field the autotuner stores."""
+    choice = _paged_dispatch_choice()
+    if choice is None:
+        return None
+    from distrl_llm_tpu.autotune import IMPL_TO_PAGED_KERNEL
+
+    base = choice.split("!")[0]
+    return IMPL_TO_PAGED_KERNEL.get(base, base)
+
+
+def _paged_grid_steps_per_call(engine, cfg, rows: int):
+    """Analytic Pallas grid-step count of one paged-attention call (one
+    layer, one decode step): WHICH kernel ran comes from the dispatch
+    record (scoped to this run — the dict is cleared before warmup), the
+    count is computed at this run's slot geometry. 0 = reference path (no
+    Pallas grid); None = no paged dispatch ran / ambiguous record."""
+    choice = _paged_dispatch_choice()
+    if choice is None or "+" in choice:
+        return None
+    from distrl_llm_tpu.ops.paged import paged_grid_steps
+
+    return paged_grid_steps(
+        choice, batch=rows, num_kv_heads=cfg.num_kv_heads,
+        pps=engine.prompt_pages + engine.private_pages,
+        pages_per_block=getattr(engine, "pages_per_block", 0) or 0,
+    )
 
 
 def _attn_fallback_fired(attn_impl: str) -> bool:
@@ -750,6 +783,27 @@ def main() -> int:
         mean_kv, hbm_gbps,
         tokens_per_slot_step=(accept_rate or 1.0) if spec_ran else 1.0,
     )
+    # grid-overhead model (BASELINE r5): paged decode's cost floor is grid
+    # steps × Mosaic's ~1 µs/grid-step. per-call count (trace-time record)
+    # × layers = grid steps per decode step; measured seconds over total
+    # grid steps = realized µs/grid-step — an UPPER bound (the quotient
+    # carries non-attention work too), but it pins which regime a row is in
+    grid_per_call = (
+        _paged_grid_steps_per_call(engine, cfg, slot_rows)
+        if os.environ.get("BENCH_ENGINE") == "paged" else None
+    )
+    # the speculative verify forward fans out one op call per draft
+    # position (plus the pending token) per layer per step
+    calls_per_step = spec_ran + 1 if spec_ran else 1
+    grid_steps_estimate = (
+        grid_per_call * cfg.num_layers * calls_per_step
+        if grid_per_call else grid_per_call
+    )
+    us_per_grid_step = None
+    if grid_steps_estimate and steps_dispatched and dt > 0:
+        us_per_grid_step = round(
+            dt * 1e6 / (grid_steps_estimate * steps_dispatched), 3
+        )
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
@@ -798,6 +852,13 @@ def main() -> int:
         # which paged-attention impl the probe chain actually dispatched
         # (None for dense runs / before any paged dispatch)
         "paged_attn_impl": _paged_dispatch_choice(),
+        # same choice in the plan-field vocabulary, plus the grid-overhead
+        # self-description (ISSUE 3): analytic grid steps per decode step
+        # across layers and the realized µs/grid-step upper bound
+        "paged_kernel": _paged_kernel_ran(),
+        "pages_per_block": getattr(engine, "pages_per_block", None),
+        "grid_steps_estimate": grid_steps_estimate,
+        "us_per_grid_step": us_per_grid_step,
         "backend": jax.devices()[0].platform,
         "completions": n_prompts * n_cand,
         "total_tokens": total_tokens,

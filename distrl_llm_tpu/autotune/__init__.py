@@ -16,6 +16,8 @@ Public surface:
 
 from distrl_llm_tpu.autotune.plan import (
     DEFAULT_PLAN,
+    IMPL_TO_PAGED_KERNEL,
+    PAGED_KERNEL_TO_IMPL,
     ExecutionPlan,
     TUNABLE_FIELDS,
     candidate_plans,
@@ -40,6 +42,8 @@ __all__ = [
     "DEFAULT_PLAN",
     "DB_ENV",
     "ENABLE_ENV",
+    "IMPL_TO_PAGED_KERNEL",
+    "PAGED_KERNEL_TO_IMPL",
     "ExecutionPlan",
     "PlanStore",
     "ResolvedPlan",

@@ -142,14 +142,23 @@ def build_engine_for_plan(
             prompt_buckets=plan.prompt_buckets or None,
             **common,
         )
+    from distrl_llm_tpu.autotune.plan import PAGED_KERNEL_TO_IMPL
+
+    paged_kw = dict(
+        # candidate paged-kernel variant rides as the engine kwargs the
+        # plan fields map to ("auto" when the candidate leaves it derived)
+        paged_impl=PAGED_KERNEL_TO_IMPL.get(plan.paged_kernel, "auto"),
+        pages_per_block=plan.pages_per_block,
+    )
     if plan.decode_path == "paged":
-        return PagedGenerationEngine(model_cfg, **common)
+        return PagedGenerationEngine(model_cfg, **paged_kw, **common)
     # speculative: refill scheduler hosts it; slots capped at the row count
     return PagedGenerationEngine(
         model_cfg,
         scheduler="refill",
         max_concurrent_rows=max(min(rows, 64), 1),
         spec_draft=spec_draft,
+        **paged_kw,
         **common,
     )
 

@@ -30,6 +30,17 @@ from dataclasses import dataclass
 
 DECODE_PATHS = ("dense", "paged", "speculative")
 FORMULATIONS = (None, "dot", "mulred")
+PAGED_KERNELS = (None, "one_page", "folded", "blocked")
+
+#: plan-field ↔ engine ``paged_impl`` spellings of the native paged-kernel
+#: variants (the engine kwarg predates the plan field; "auto"/"kernel"/
+#: "reference" have no plan spelling — they stay engine-kwarg-only)
+PAGED_KERNEL_TO_IMPL = {
+    "one_page": "native",
+    "folded": "native_folded",
+    "blocked": "native_blocked",
+}
+IMPL_TO_PAGED_KERNEL = {v: k for k, v in PAGED_KERNEL_TO_IMPL.items()}
 
 
 @dataclass(frozen=True)
@@ -59,6 +70,15 @@ class ExecutionPlan:
     # prompt length buckets for the dense engine; () = the single
     # max_prompt_tokens bucket (engine-compiled per bucket used)
     prompt_buckets: tuple[int, ...] = ()
+    # paged-attention kernel variant (paged/speculative paths); None derives
+    # exactly as the engine always has (paged_impl="auto": the probe-gated
+    # chain). "one_page"/"folded"/"blocked" pin the native kernel variants —
+    # the grid-step ladder of the r5 overhead analysis (ops/paged_native.py)
+    paged_kernel: str | None = None
+    # blocked-kernel page collapse (pages folded per grid step); 0 = the
+    # kernel default (ops.paged.DEFAULT_PAGES_PER_BLOCK). Only consumed by
+    # paged_kernel="blocked"
+    pages_per_block: int = 0
 
     def __post_init__(self):
         if self.decode_path not in DECODE_PATHS:
@@ -90,6 +110,16 @@ class ExecutionPlan:
         if any(b <= 0 for b in self.prompt_buckets):
             raise ValueError(
                 f"prompt_buckets must be positive, got {self.prompt_buckets}"
+            )
+        if self.paged_kernel not in PAGED_KERNELS:
+            raise ValueError(
+                f"paged_kernel must be one of {PAGED_KERNELS}, got "
+                f"{self.paged_kernel!r}"
+            )
+        if not isinstance(self.pages_per_block, int) or self.pages_per_block < 0:
+            raise ValueError(
+                f"pages_per_block must be an int >= 0, got "
+                f"{self.pages_per_block!r}"
             )
 
     def replace(self, **kw) -> "ExecutionPlan":
@@ -200,11 +230,14 @@ def candidate_plans(
     scan_chunks=(0, 16),
     formulations=(None,),
     top_p_impls=(None,),
+    paged_kernels=(None,),
+    pages_per_blocks=(0,),
 ) -> list[ExecutionPlan]:
     """Enumerate a candidate space for the tuner (cartesian product, with
     the always-meaningless combos dropped: a formulation override without a
     dense path, a scan_chunk of 1 — scan-of-one has no fusion benefit and
-    the engines refuse to report it as chunked)."""
+    the engines refuse to report it as chunked, a paged-kernel pin on the
+    dense path, a pages_per_block without the blocked kernel)."""
     out = []
     for path in decode_paths:
         for chunk in scan_chunks:
@@ -213,9 +246,16 @@ def candidate_plans(
             for form in formulations:
                 if form is not None and path != "dense":
                     continue
-                for tp in top_p_impls:
-                    out.append(ExecutionPlan(
-                        decode_path=path, scan_chunk=chunk,
-                        cache_read_formulation=form, top_p_impl=tp,
-                    ))
+                for pk in paged_kernels:
+                    if pk is not None and path == "dense":
+                        continue
+                    for ppb in pages_per_blocks:
+                        if ppb and pk != "blocked":
+                            continue
+                        for tp in top_p_impls:
+                            out.append(ExecutionPlan(
+                                decode_path=path, scan_chunk=chunk,
+                                cache_read_formulation=form, top_p_impl=tp,
+                                paged_kernel=pk, pages_per_block=ppb,
+                            ))
     return out

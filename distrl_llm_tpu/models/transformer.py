@@ -104,6 +104,7 @@ def _layer(
     page_indices: jax.Array | None = None,  # [B, pps]
     page_size: int = 0,
     paged_impl: str = "auto",
+    pages_per_block: int = 0,  # blocked-kernel page collapse (0 = kernel default)
     paged_verify: bool = False,  # S>1 per-row draft-block decode (spec decode)
     paged_chunked: bool = False,  # S>1 continuation (chunked) prefill
     lora_dropout: float = 0.0,
@@ -135,7 +136,7 @@ def _layer(
                 cache_v, v[:, 0], paged_lengths, page_indices, page_size)
             att = paged_attention_op(
                 q[:, 0], cache_k, cache_v, paged_lengths + 1, page_indices,
-                impl=paged_impl,
+                impl=paged_impl, pages_per_block=pages_per_block,
             )[:, None]
         elif paged_chunked:
             # continuation (chunked) prefill: S tokens extend each row's
@@ -177,6 +178,7 @@ def _layer(
                     paged_attention_op(
                         q[:, i], cache_k, cache_v, paged_lengths + i + 1,
                         page_indices, impl=paged_impl,
+                        pages_per_block=pages_per_block,
                     )
                     for i in range(s)
                 ],
@@ -272,6 +274,7 @@ def forward(
     logits_positions: jax.Array | None = None,  # [B] per-row position gather
     page_size: int = 0,  # static; paged-cache mode (ops/paged.py)
     paged_impl: str = "auto",
+    pages_per_block: int = 0,  # blocked-kernel page collapse (0 = kernel default)
     paged_verify: bool = False,  # speculative-decode draft-block verify
     paged_chunked: bool = False,  # continuation (chunked) prefill over pages
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
@@ -352,6 +355,7 @@ def forward(
         page_indices=kv_cache.get("page_indices") if paged else None,
         page_size=page_size,
         paged_impl=paged_impl,
+        pages_per_block=pages_per_block,
         paged_verify=paged_verify,
         paged_chunked=paged_chunked,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,

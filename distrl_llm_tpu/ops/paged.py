@@ -291,12 +291,71 @@ def paged_attention_reference(
 
 _kernel_fail_warned = False
 _fixed_launch_state: dict = {}
+
+#: kernel default for the blocked launch's page-axis collapse — callers
+#: passing 0 get this (kept here so plan resolution, bench records, and the
+#: analytic grid-step model all agree on what "default" means)
+DEFAULT_PAGES_PER_BLOCK = 8
+
+
+def paged_grid_steps(
+    impl: str, *, batch: int, num_kv_heads: int, pps: int,
+    pages_per_block: int = 0,
+) -> int:
+    """Analytic Pallas grid-step count of ONE paged-attention call (one
+    layer, one decode step) for ``impl``. This is the denominator of the
+    round-5 overhead model (BASELINE.md): decode at the benched geometry is
+    bound by grid steps × Mosaic's ~1 µs/grid-step floor, not by bandwidth,
+    so every engine/bench artifact records this count (ops/paged_grid_steps
+    counter, bench ``grid_steps_estimate``) to make the regime visible.
+
+    Counts per impl: "native" runs a (B, K, pps) grid; "native_folded"
+    folds kv heads into the block — (B, pps); "native_blocked" additionally
+    collapses the page axis — (B, ceil(pps / pages_per_block)); the jaxlib
+    kernels ("fixed"/"jaxlib"/"kernel") walk pages with manual DMA inside a
+    (1, B, K) grid; the jnp reference has no Pallas grid (0)."""
+    base = impl.split("!")[0]  # strip the "!transient-probe" honesty marker
+    if base == "native":
+        return batch * num_kv_heads * pps
+    if base == "native_folded":
+        return batch * pps
+    if base == "native_blocked":
+        ppb = max(1, min(pages_per_block or DEFAULT_PAGES_PER_BLOCK, pps))
+        return batch * -(-pps // ppb)
+    if base in ("fixed", "jaxlib", "kernel"):
+        return batch * num_kv_heads
+    return 0
+
+
+def dispatch_choice_key(
+    *, quantized: bool, num_kv_heads: int, num_groups: int, head_dim: int,
+    page_size: int, pps: int, pages_per_compute_block: int = 4,
+    impl: str = "auto", pages_per_block: int = 0,
+) -> tuple:
+    """The per-config key ``paged_attention_op`` records its dispatch
+    decision under ``dispatch_choices``. One function so engines can look
+    up THEIR OWN entry instead of guessing across a process-global dict
+    (several engines can trace in one process — the autotuner's candidate
+    sweep). The REQUESTED ``impl`` and ``pages_per_block`` are part of the
+    key: two same-geometry engines pinned to different kernels must not
+    share (and overwrite) one record."""
+    blocks = max(
+        (d for d in range(1, min(pages_per_compute_block, pps) + 1)
+         if pps % d == 0),
+        default=1,
+    )
+    return (impl, pages_per_block, quantized, num_kv_heads, num_groups,
+            head_dim, page_size, blocks, pps)
 # per-config record of what the auto-dispatch chain actually chose
 # ("native" | "native_folded" | "fixed" | "jaxlib" | "reference") —
 # bench records surface
 # this so a reference-fallback run cannot masquerade as a kernel
 # measurement (same honesty contract as attn_fallback / scan_chunk_active)
 dispatch_choices: dict = {}
+# NOTE on grid-step accounting: the analytic count is batch-dependent, so
+# it is never cached here — consumers read WHICH impl ran from
+# dispatch_choices (keyed per requested impl + geometry) and compute
+# paged_grid_steps() against their own live batch/ppb.
 # probe keys whose latest failure was transient (RESOURCE_EXHAUSTED etc.):
 # transient failures are never negative-cached, but the dispatch decision is
 # made at TRACE time and baked into the compiled program — a transient probe
@@ -308,26 +367,34 @@ transient_probe_keys: set = set()
 
 def _native_call(q, k_pages, v_pages, lengths, page_indices,
                  *, quantized: bool, pages_per_compute_block: int = 0,
-                 folded: bool = False, interpret: bool = False):
-    """Adapter: the probe/dispatch launch signature → our native kernel
-    (ops/paged_native.py), which takes int8 weights and compact scales as
-    separate arrays and has no compute-block knob (one page per grid step;
-    ``folded`` selects the kv-heads-in-block variant with a (B, pps)
-    grid — half the grid steps, BASELINE.md r5 grid-overhead analysis)."""
+                 folded: bool = False, blocked: bool = False,
+                 pages_per_block: int = 0, interpret: bool = False):
+    """Adapter: the probe/dispatch launch signature → our native kernels
+    (ops/paged_native.py), which take int8 weights and compact scales as
+    separate arrays. ``folded`` selects the kv-heads-in-block variant with
+    a (B, pps) grid (half the grid steps, BASELINE.md r5 grid-overhead
+    analysis); ``blocked`` the multi-page grid-collapsed variant with a
+    (B, ceil(pps / pages_per_block)) grid on top of the folding."""
     from distrl_llm_tpu.ops.paged_native import (
-        paged_attention_native, paged_attention_native_folded,
+        paged_attention_native,
+        paged_attention_native_blocked,
+        paged_attention_native_folded,
     )
 
-    kernel = paged_attention_native_folded if folded else paged_attention_native
+    kw: dict = {"interpret": interpret}
+    if blocked:
+        kernel = paged_attention_native_blocked
+        kw["pages_per_block"] = pages_per_block or DEFAULT_PAGES_PER_BLOCK
+    elif folded:
+        kernel = paged_attention_native_folded
+    else:
+        kernel = paged_attention_native
     if quantized:
         return kernel(
             q, k_pages.weight, v_pages.weight, lengths, page_indices,
-            k_scales=k_pages.scales, v_scales=v_pages.scales,
-            interpret=interpret,
+            k_scales=k_pages.scales, v_scales=v_pages.scales, **kw,
         )
-    return kernel(
-        q, k_pages, v_pages, lengths, page_indices, interpret=interpret
-    )
+    return kernel(q, k_pages, v_pages, lengths, page_indices, **kw)
 
 
 def _probe_launch(
@@ -341,6 +408,7 @@ def _probe_launch(
     kv_dtype,
     blocks: int,
     pps: int,
+    pages_per_block: int = 0,
 ) -> bool:
     """Per-config probe: compile + run a paged-attention launch at tiny
     shapes on the REAL backend. Launches are validated under the Pallas
@@ -360,7 +428,8 @@ def _probe_launch(
     DMA pattern differed from the real call's, passing where the real shape
     failed (second silicon lesson of round 3)."""
     key = (fn_name, quantized, num_kv_heads, num_groups, head_dim, page_size,
-           q_dtype, kv_dtype, blocks, pps)
+           q_dtype, kv_dtype, blocks, pps,
+           pages_per_block if fn_name == "native_blocked" else 0)
     if key not in _fixed_launch_state:
         try:
             from distrl_llm_tpu.ops.paged_int8 import (
@@ -373,6 +442,10 @@ def _probe_launch(
             elif fn_name == "native_folded":
                 fn = functools.partial(
                     _native_call, quantized=quantized, folded=True)
+            elif fn_name == "native_blocked":
+                fn = functools.partial(
+                    _native_call, quantized=quantized, blocked=True,
+                    pages_per_block=pages_per_block)
             elif fn_name == "fixed":
                 fn = paged_attention_int8 if quantized else paged_attention_gqa
             else:
@@ -431,16 +504,19 @@ def paged_attention_op(
     *,
     impl: str = "auto",
     pages_per_compute_block: int = 4,
+    pages_per_block: int = 0,
 ) -> jax.Array:
     """Dispatch: Pallas TPU kernel when available, jnp reference otherwise.
 
     ``impl``: "auto" (probe-gated kernel chain on TPU backends, reference
     elsewhere), "kernel" (force the corrected jaxlib launch), "native"
-    (force our pipeline-gather kernel, ops/paged_native.py), or
-    "reference"."""
-    use_kernel = impl in ("kernel", "native", "native_folded") or (
-        impl == "auto" and jax.default_backend() == "tpu"
-    )
+    (force our pipeline-gather kernel, ops/paged_native.py),
+    "native_folded" / "native_blocked" (its kv-folded and grid-collapsed
+    variants — ``pages_per_block`` sizes the blocked kernel's page
+    collapse; 0 = DEFAULT_PAGES_PER_BLOCK), or "reference"."""
+    use_kernel = impl in (
+        "kernel", "native", "native_folded", "native_blocked"
+    ) or (impl == "auto" and jax.default_backend() == "tpu")
     choice_key = None
     if use_kernel:
         try:
@@ -451,17 +527,20 @@ def paged_attention_op(
             # the kernel computes raw q·k (no internal scaling) and requires
             # pages_per_sequence % pages_per_compute_block == 0
             pps = page_indices.shape[1]
-            blocks = max(
-                (d for d in range(1, min(pages_per_compute_block, pps) + 1)
-                 if pps % d == 0),
-                default=1,
-            )
             scaled_q = q * (q.shape[-1] ** -0.5)
             quantized = is_quantized_pages(k_pages)
             kw = k_pages.weight if quantized else k_pages
             num_kv_heads = kw.shape[0]
             num_groups = q.shape[1] // num_kv_heads
             head_dim, page_size = kw.shape[-1], kw.shape[-2]
+            choice_key = dispatch_choice_key(
+                quantized=quantized, num_kv_heads=num_kv_heads,
+                num_groups=num_groups, head_dim=head_dim,
+                page_size=page_size, pps=pps,
+                pages_per_compute_block=pages_per_compute_block,
+                impl=impl, pages_per_block=pages_per_block,
+            )
+            blocks = choice_key[-2]
             # auto mode walks a probe-gated chain (probes run once per
             # config at the REAL kv-head count and pages-per-sequence):
             # - hd % 128 == 0: corrected jaxlib launch (proven, multi-page
@@ -472,23 +551,28 @@ def paged_attention_op(
             #   Mosaic for unaligned head_dim (round-3 silicon finding;
             #   ops/paged_native.py), which two rounds of interpreter
             #   parity could not see.
+            ppb_eff = max(
+                1, min(pages_per_block or DEFAULT_PAGES_PER_BLOCK, pps)
+            )
             probe = functools.partial(
                 _probe_launch, quantized=quantized,
                 num_kv_heads=num_kv_heads, num_groups=num_groups,
                 head_dim=head_dim, page_size=page_size,
                 q_dtype=scaled_q.dtype, kv_dtype=kw.dtype, blocks=blocks,
-                pps=pps,
+                pps=pps, pages_per_block=ppb_eff,
             )
-            # native_folded sits BEHIND the silicon-proven native until
-            # its kernel-check stanzas PASS on chip (probes run all-zero
-            # inputs, so they catch lowering rejections but not a silent
-            # miscompile — round-3 lesson); the bench A/B forces it via
-            # BENCH_PAGED_IMPL, and the chain order flips in a follow-up
-            # once the stanzas land
+            # native_folded/native_blocked sit BEHIND the silicon-proven
+            # native until their kernel-check stanzas PASS on chip (probes
+            # run all-zero inputs, so they catch lowering rejections but
+            # not a silent miscompile — round-3 lesson); the bench A/B
+            # forces them via BENCH_PAGED_IMPL, and the chain order flips
+            # in a follow-up once the stanzas land
             chain = (
-                ("native", "native_folded", "fixed", "jaxlib")
+                ("native", "native_folded", "native_blocked", "fixed",
+                 "jaxlib")
                 if head_dim % 128
-                else ("fixed", "native", "native_folded", "jaxlib")
+                else ("fixed", "native", "native_folded", "native_blocked",
+                      "jaxlib")
             )
             if impl == "kernel":  # forced: corrected launch, no probe
                 chain = ("fixed",)
@@ -496,8 +580,8 @@ def paged_attention_op(
                 chain = ("native",)
             elif impl == "native_folded":  # forced: kv-folded variant
                 chain = ("native_folded",)
-            choice_key = (quantized, num_kv_heads, num_groups, head_dim,
-                          page_size, blocks, pps)
+            elif impl == "native_blocked":  # forced: grid-collapsed variant
+                chain = ("native_blocked",)
             # sticky across calls sharing this choice_key (one trace calls
             # this op once PER LAYER): if any earlier layer's chain was
             # transiently downgraded, the compiled program mixes reference-
@@ -513,7 +597,8 @@ def paged_attention_op(
                 if len(chain) > 1 and not probe(fn_name):
                     pkey = (fn_name, quantized, num_kv_heads, num_groups,
                             head_dim, page_size, scaled_q.dtype, kw.dtype,
-                            blocks, pps)
+                            blocks, pps,
+                            ppb_eff if fn_name == "native_blocked" else 0)
                     transient_seen = transient_seen or (
                         pkey in transient_probe_keys
                     )
@@ -521,12 +606,14 @@ def paged_attention_op(
                 dispatch_choices[choice_key] = fn_name + (
                     "!transient-probe" if transient_seen else ""
                 )
-                if fn_name in ("native", "native_folded"):
+                if fn_name in ("native", "native_folded", "native_blocked"):
                     return _native_call(
                         scaled_q, k_pages, v_pages,
                         lengths.astype(jnp.int32), page_indices,
                         quantized=quantized,
                         folded=fn_name == "native_folded",
+                        blocked=fn_name == "native_blocked",
+                        pages_per_block=ppb_eff,
                     ).astype(q.dtype)
                 if fn_name == "fixed":
                     from distrl_llm_tpu.ops.paged_int8 import (
@@ -554,7 +641,7 @@ def paged_attention_op(
                 # retrace re-probes — flag it
                 dispatch_choices[choice_key] = "reference!transient-probe"
         except Exception as e:  # noqa: BLE001 — fall back with one warning
-            if impl in ("kernel", "native", "native_folded"):
+            if impl in ("kernel", "native", "native_folded", "native_blocked"):
                 raise
             # the chain recorded its pick before launching; the launch
             # failed, so what actually runs below is the reference (keep the

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.experimental.pallas.ops.tpu.paged_attention import quantization_utils
+
+from distrl_llm_tpu.ops.paged_native import CompilerParams
 from jax.experimental.pallas.ops.tpu.paged_attention.paged_attention_kernel import (
     DEFAULT_MASK_VALUE,
     paged_flash_attention_kernel_inline_seq_dim,
@@ -158,7 +160,7 @@ def _launch(
             grid=grid,
             scratch_shapes=scratch_shapes,
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         out_shape=[

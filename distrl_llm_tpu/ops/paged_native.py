@@ -48,6 +48,14 @@ from jax.experimental.pallas.ops.tpu.paged_attention.quantization_utils import (
 
 NEG_INF = -1e30
 
+# jax 0.7 renamed TPUCompilerParams → CompilerParams; support both so the
+# interpret-mode parity suite runs on either generation (the old name was
+# one of the pre-existing "Pallas interpret" CI failures — it was an API
+# drift, not an interpreter limitation)
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 
 def _paged_kernel(
     lengths_ref,  # SMEM [B] i32 (scalar prefetch)
@@ -196,7 +204,7 @@ def paged_attention_native(
                 pltpu.VMEM((groups, head_dim), jnp.float32),
             ],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         out_shape=jax.ShapeDtypeStruct(
@@ -353,7 +361,213 @@ def paged_attention_native_folded(
                 pltpu.VMEM((num_kv_heads, groups, head_dim), jnp.float32),
             ],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, num_kv_heads, groups, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables, *operands)
+    return out.reshape(batch, num_q_heads, head_dim)
+
+
+def _make_blocked_kernel(*, page_size: int, ppb: int, nblk: int,
+                         quantized: bool):
+    """Kernel body for ``paged_attention_native_blocked``: ``ppb`` pages of
+    ALL kv heads folded into one grid step (grid (B, ceil(pps/ppb)) — the
+    kv-heads folding of ``_paged_kernel_folded`` composed with a page-axis
+    collapse). The round-5 silicon numbers put the one-page kernel at
+    Mosaic's ~1 µs/grid-step floor with (B × K × pps) steps per layer
+    (BASELINE.md): the kernel is LAUNCH-bound, not bandwidth-bound, so the
+    lever is fewer grid steps moving the same bytes.
+
+    The per-page gather stays in BlockSpec ``index_map``s — one per
+    in-block page, each reading its own scalar-prefetched table slot
+    ``tabs[b, jb·ppb + i]`` — because whole-block pipelined moves are the
+    one DMA pattern this Mosaic version has proven at head_dim 64 (the
+    reason this file exists; manual in-kernel DMA is exactly what it was
+    built to avoid). The kernel body carries the online softmax across the
+    in-kernel page loop in REGISTERS, touching the m/l/acc scratch once per
+    grid step instead of once per page.
+
+    Ragged tails: pages whose positions all sit past ``length`` contribute
+    ``exp(NEG_INF − m)`` = 0 exactly (the block guard ensures the first
+    in-block page is valid, so ``m`` is finite before any fully-masked page
+    folds in — the 0/0 hazard of an all-masked softmax cannot arise), and
+    blocks entirely past the length are skipped by ``pl.when``; their DMAs
+    still run against edge-padded table slots, same as the one-page
+    kernels' past-allocation slots."""
+
+    def kernel(lengths_ref, tables_ref, q_ref, *rest):
+        k_refs = rest[0:ppb]
+        v_refs = rest[ppb:2 * ppb]
+        if quantized:
+            ks_refs = rest[2 * ppb:3 * ppb]
+            vs_refs = rest[3 * ppb:4 * ppb]
+            o_ref, m_scr, l_scr, acc_scr = rest[4 * ppb:]
+        else:
+            ks_refs = vs_refs = None
+            o_ref, m_scr, l_scr, acc_scr = rest[2 * ppb:]
+        b = pl.program_id(0)
+        jb = pl.program_id(1)
+
+        @pl.when(jb == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        length = lengths_ref[b]
+
+        @pl.when(jb * (ppb * page_size) < length)
+        def _block():
+            q = q_ref[...].astype(jnp.float32)  # [K, G, hd] (pre-scaled)
+            m = m_scr[...]  # [K, G, 1]
+            l = l_scr[...]  # noqa: E741
+            acc = acc_scr[...]  # [K, G, hd]
+            for i in range(ppb):  # static unroll: ppb block loads per step
+                k = k_refs[i][:, 0].astype(jnp.float32)  # [K, ps, hd]
+                v = v_refs[i][:, 0].astype(jnp.float32)
+                if quantized:
+                    # compact per-token scales (see _paged_kernel: 127.5,
+                    # the from_int8 contract)
+                    k = k * (ks_refs[i][:, 0] * (1.0 / MAX_INT8))
+                    v = v * (vs_refs[i][:, 0] * (1.0 / MAX_INT8))
+                s = jax.lax.dot_general(
+                    q, k, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )  # [K, G, ps]
+                pos = (jb * ppb + i) * page_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, 1, page_size), 2
+                )
+                s = jnp.where(pos < length, s, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)  # [K, G, ps]
+                l = alpha * l + jnp.sum(p, axis=2, keepdims=True)  # noqa: E741
+                acc = acc * alpha + jax.lax.dot_general(
+                    p, v, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                m = m_new
+            m_scr[...] = m
+            l_scr[...] = l
+            acc_scr[...] = acc
+
+        @pl.when(jb == nblk - 1)
+        def _emit():
+            o_ref[...] = (
+                acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_block", "interpret"),
+)
+def paged_attention_native_blocked(
+    q: jax.Array,  # [B, H, hd] — pre-scaled by hd**-0.5 (op contract)
+    k_pages: jax.Array,  # [K, P, ps, hd] bf16/f32, or int8 weight
+    v_pages: jax.Array,
+    lengths: jax.Array,  # i32 [B]
+    page_indices: jax.Array,  # i32 [B, pps]
+    k_scales: jax.Array | None = None,  # f32 [K, P, ps, 1] compact (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    page_size: int | None = None,
+    pages_per_block: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Launch for ``_make_blocked_kernel`` — same contract as
+    ``paged_attention_native`` with a (B, ceil(pps / pages_per_block))
+    grid. ``pages_per_block`` is clamped to [1, pps]; at 1 this is the
+    folded kernel bit-for-bit (same op order — pinned by tests)."""
+    batch, num_q_heads, head_dim = q.shape
+    num_kv_heads, total_pages, ps, head_dim_k = k_pages.shape
+    if page_size is None:
+        page_size = ps
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch: {head_dim_k} vs {head_dim}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"H={num_q_heads} not divisible by K={num_kv_heads}"
+        )
+    if pages_per_block < 1:
+        raise ValueError(
+            f"pages_per_block must be >= 1, got {pages_per_block}"
+        )
+    groups = num_q_heads // num_kv_heads
+    _, pps = page_indices.shape
+    quantized = k_scales is not None
+    ppb = min(pages_per_block, pps)
+    nblk = -(-pps // ppb)
+
+    tables = jnp.clip(page_indices.astype(jnp.int32), 0, total_pages - 1)
+    pad = nblk * ppb - pps
+    if pad:
+        # ragged final block: edge-pad the table so every in-block
+        # index_map slot is addressable; padded pages are fully
+        # length-masked in the kernel
+        tables = jnp.concatenate(
+            [tables, jnp.broadcast_to(tables[:, -1:], (batch, pad))], axis=1
+        )
+    q4 = q.reshape(batch, num_kv_heads, groups, head_dim)
+
+    q_spec = pl.BlockSpec(
+        (None, num_kv_heads, groups, head_dim),
+        lambda b, j, lens, tabs: (b, 0, 0, 0),
+    )
+
+    def kv_spec(i):
+        return pl.BlockSpec(
+            (num_kv_heads, 1, page_size, head_dim),
+            lambda b, j, lens, tabs, i=i: (0, tabs[b, j * ppb + i], 0, 0),
+        )
+
+    def scale_spec(i):
+        return pl.BlockSpec(
+            (num_kv_heads, 1, page_size, 1),
+            lambda b, j, lens, tabs, i=i: (0, tabs[b, j * ppb + i], 0, 0),
+        )
+
+    # the SAME pool array rides as ppb inputs, one per in-block page — each
+    # gets its own index_map gather, so the pipeline emitter still only
+    # ever moves whole [K, 1, ps, hd] blocks (never slicing the minor dims)
+    in_specs = (
+        [q_spec]
+        + [kv_spec(i) for i in range(ppb)]
+        + [kv_spec(i) for i in range(ppb)]
+    )
+    operands = [q4] + [k_pages] * ppb + [v_pages] * ppb
+    if quantized:
+        in_specs += (
+            [scale_spec(i) for i in range(ppb)]
+            + [scale_spec(i) for i in range(ppb)]
+        )
+        operands += [k_scales] * ppb + [v_scales] * ppb
+
+    out = pl.pallas_call(
+        _make_blocked_kernel(
+            page_size=page_size, ppb=ppb, nblk=nblk, quantized=quantized
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, nblk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (None, num_kv_heads, groups, head_dim),
+                lambda b, j, lens, tabs: (b, 0, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((num_kv_heads, groups, 1), jnp.float32),
+                pltpu.VMEM((num_kv_heads, groups, 1), jnp.float32),
+                pltpu.VMEM((num_kv_heads, groups, head_dim), jnp.float32),
+            ],
+        ),
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         out_shape=jax.ShapeDtypeStruct(

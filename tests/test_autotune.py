@@ -312,6 +312,89 @@ class TestEngineIntegration:
         p0 = PagedGenerationEngine(TINY, plan_db=db, scan_chunk=0, **ENGINE_KW)
         assert p0.scan_chunk == 0
 
+    def test_paged_kernel_empty_db_keeps_auto(self, tmp_path):
+        """Byte-identity pin for the ISSUE-3 fields: with no DB entry the
+        engine's paged dispatch stays exactly the historical 'auto' probe
+        chain and pages_per_block stays 0 (the kernel default)."""
+        p = PagedGenerationEngine(
+            TINY, plan_db=str(tmp_path / "no.json"), **ENGINE_KW
+        )
+        assert p.paged_impl == "auto"
+        assert p.pages_per_block == 0
+        assert p.resolved_plan.plan.paged_kernel is None
+
+    def test_paged_kernel_db_plan_applies(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="paged", paged_kernel="blocked", pages_per_block=4,
+        ))
+        store.save()
+        p = PagedGenerationEngine(TINY, plan_db=db, **ENGINE_KW)
+        assert p.paged_impl == "native_blocked"
+        assert p.pages_per_block == 4
+        assert p.resolved_plan.sources["paged_kernel"] == "db"
+
+    def test_paged_kernel_explicit_impl_beats_db(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="paged", paged_kernel="blocked", pages_per_block=4,
+        ))
+        store.save()
+        # a native-variant pin maps into the plan field and wins
+        p = PagedGenerationEngine(
+            TINY, plan_db=db, paged_impl="native", **ENGINE_KW
+        )
+        assert p.paged_impl == "native"
+        assert p.resolved_plan.sources["paged_kernel"] == "user"
+        # a plan-unrepresentable pin ("reference") must not be retuned
+        # out from under the caller either
+        r = PagedGenerationEngine(
+            TINY, plan_db=db, paged_impl="reference", **ENGINE_KW
+        )
+        assert r.paged_impl == "reference"
+        # explicit pages_per_block — including 0 — beats the stored 4
+        z = PagedGenerationEngine(
+            TINY, plan_db=db, pages_per_block=0, **ENGINE_KW
+        )
+        assert z.pages_per_block == 0
+        assert z.resolved_plan.sources["pages_per_block"] == "user"
+
+    def test_paged_kernel_field_validation(self):
+        with pytest.raises(ValueError, match="paged_kernel"):
+            ExecutionPlan(paged_kernel="bogus")
+        with pytest.raises(ValueError, match="pages_per_block"):
+            ExecutionPlan(pages_per_block=-1)
+        # round-trips through the store vocabulary
+        p = ExecutionPlan(
+            decode_path="paged", paged_kernel="blocked", pages_per_block=8
+        )
+        assert ExecutionPlan.from_dict(p.to_dict()) == p
+
+    def test_candidate_plans_prune_meaningless_kernel_combos(self):
+        from distrl_llm_tpu.autotune import candidate_plans
+
+        plans = candidate_plans(
+            decode_paths=("dense", "paged"),
+            scan_chunks=(0,),
+            paged_kernels=(None, "folded", "blocked"),
+            pages_per_blocks=(0, 4),
+        )
+        assert all(
+            p.paged_kernel is None for p in plans
+            if p.decode_path == "dense"
+        )
+        assert all(
+            p.paged_kernel == "blocked" for p in plans
+            if p.pages_per_block
+        )
+        # the paged path enumerates every kernel and the blocked sizes
+        paged = [p for p in plans if p.decode_path == "paged"]
+        assert {(p.paged_kernel, p.pages_per_block) for p in paged} == {
+            (None, 0), ("folded", 0), ("blocked", 0), ("blocked", 4),
+        }
+
     def test_generation_identical_with_and_without_empty_db(self, tmp_path):
         """The empty-DB fallback path produces byte-identical output to an
         autotune-disabled engine — the acceptance contract's first half."""

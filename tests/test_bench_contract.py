@@ -50,6 +50,8 @@ class TestBenchContract:
         for key in ("metric", "value", "unit", "vs_baseline", "backend",
                     "scan_chunk", "scan_chunk_active", "engine",
                     "paged_attn_impl", "total_tokens",
+                    "paged_kernel", "pages_per_block", "grid_steps_estimate",
+                    "us_per_grid_step",
                     "plan", "plan_source", "cache_read_formulation"):
             assert key in rec, key
         assert rec["metric"] == "rollout_tokens_per_sec_per_chip"

@@ -19,6 +19,8 @@ from distrl_llm_tpu.ops.paged import (
 )
 from distrl_llm_tpu.ops.paged_int8 import paged_attention_int8
 
+from pallas_env import pallas_env_marks
+
 
 def _setup(b, h, k, hd, ps, pps, seed=0):
     rng = np.random.default_rng(seed)
@@ -29,6 +31,25 @@ def _setup(b, h, k, hd, ps, pps, seed=0):
     lengths = jnp.asarray(rng.integers(1, pps * ps + 1, size=b), jnp.int32)
     table = jnp.asarray(make_page_table(b, pps * ps, ps))
     return q, quantize_pages(kk), quantize_pages(vv), lengths, table
+
+
+def _probe_jaxlib_inline_kernel():
+    """Trace the compact-scales launch (tiny shapes, no execution): both
+    classes here drive jaxlib's INTERNAL inline-seq-dim kernel, whose
+    signature drifts across jaxlib releases."""
+    q, kq, vq, lengths, table = _setup(1, 2, 1, 16, 8, 2)
+    jax.eval_shape(
+        lambda: paged_attention_int8(
+            q, kq, vq, lengths, table,
+            pages_per_compute_block=2, interpret=True,
+        )
+    )
+
+
+pytestmark = pallas_env_marks(
+    _probe_jaxlib_inline_kernel,
+    "jaxlib paged_flash_attention_kernel_inline_seq_dim launch",
+)
 
 
 class TestCompactScalesKernel:

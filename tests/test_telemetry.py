@@ -166,6 +166,83 @@ class TestRegistry:
         assert snap["cp/rpc_dispatch_ms_p50"] == 3.0
         assert snap["cp/rpc_dispatch_ms_max"] == 100.0
 
+    def test_paged_grid_telemetry(self):
+        """Engines surface the grid-overhead bound (ISSUE 3): total grid
+        steps = per-call count × op calls/step × layers × decode steps,
+        plus a realized µs/grid-step gauge."""
+        from distrl_llm_tpu.engine.paged_engine import _record_grid_telemetry
+
+        _record_grid_telemetry(
+            num_layers=24, steps=100, decode_s=2.304, per_call=960
+        )
+        snap = telemetry.metrics_snapshot()
+        assert snap["ops/paged_grid_steps"] == 960 * 24 * 100
+        assert snap["ops/paged_us_per_grid_step"] == pytest.approx(1.0)
+        # speculative verify fans out draft_len+1 op calls per layer/step
+        _record_grid_telemetry(
+            num_layers=24, steps=100, decode_s=2.304, per_call=960,
+            calls_per_step=5,
+        )
+        snap = telemetry.metrics_snapshot()
+        assert snap["ops/paged_grid_steps"] == 960 * 24 * 100 * 5
+        assert snap["ops/paged_us_per_grid_step"] == pytest.approx(0.2)
+
+    def test_paged_grid_telemetry_reference_path_is_silent(self):
+        from distrl_llm_tpu.engine.paged_engine import _record_grid_telemetry
+
+        _record_grid_telemetry(
+            num_layers=24, steps=100, decode_s=1.0, per_call=0
+        )
+        snap = telemetry.metrics_snapshot()
+        assert "ops/paged_grid_steps" not in snap
+
+    def test_engine_grid_lookup_is_geometry_keyed(self, monkeypatch):
+        """The engine derives the count from ITS OWN dispatch-choice record
+        (keyed by requested impl + geometry) at the LIVE row count — never
+        from another engine's entry or a stale batch (the autotuner's
+        candidate sweep runs several engines in one process, and one wave
+        engine serves varying row counts without retracing)."""
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+        from distrl_llm_tpu.models import TINY
+        from distrl_llm_tpu.ops import paged as paged_ops
+        from distrl_llm_tpu.ops.paged import dispatch_choice_key
+
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8, eos_token_ids=[1],
+            pad_token_id=0, cache_dtype=jnp.float32, page_size=8,
+        )
+        pps = eng.prompt_pages + eng.private_pages
+        own_key = dispatch_choice_key(
+            quantized=False, num_kv_heads=TINY.num_kv_heads,
+            num_groups=TINY.num_heads // TINY.num_kv_heads,
+            head_dim=TINY.head_dim, page_size=8, pps=pps,
+            impl="auto", pages_per_block=0,
+        )
+        # a same-geometry engine pinned to a DIFFERENT kernel keys apart
+        blocked_key = dispatch_choice_key(
+            quantized=False, num_kv_heads=TINY.num_kv_heads,
+            num_groups=TINY.num_heads // TINY.num_kv_heads,
+            head_dim=TINY.head_dim, page_size=8, pps=pps,
+            impl="native_blocked", pages_per_block=0,
+        )
+        assert blocked_key != own_key
+        monkeypatch.setattr(
+            paged_ops, "dispatch_choices",
+            {("stale", "other", "geometry"): "native_blocked",
+             blocked_key: "native_blocked",
+             own_key: "native"},
+        )
+        # one-page native at 8 rows: 8 × K × pps — computed at the live
+        # batch, so a later 3-row wave reports 3-row counts, no retrace
+        k = TINY.num_kv_heads
+        assert eng._grid_steps_per_call(8) == 8 * k * pps
+        assert eng._grid_steps_per_call(3) == 3 * k * pps
+        # no record yet (fresh process) → 0, telemetry stays silent
+        monkeypatch.setattr(paged_ops, "dispatch_choices", {})
+        assert eng._grid_steps_per_call(8) == 0
+
     def test_gauge_emits_counter_event_when_tracing(self):
         telemetry.gauge_set("pool/occupancy", 0.5)
         assert events() == []  # disabled: metric only, no trace sample

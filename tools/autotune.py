@@ -231,6 +231,13 @@ def cmd_measure(args) -> int:
             (None if x in ("", "auto") else x)
             for x in args.top_p_impls.split(",")
         ),
+        paged_kernels=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.paged_kernels.split(",")
+        ),
+        pages_per_blocks=tuple(
+            int(x) for x in args.pages_per_blocks.split(",")
+        ),
     )
     print(f"measuring {len(candidates)} candidate plan(s) for {args.model} "
           f"p{args.max_prompt}+n{args.max_new} × {args.prompts}·"
@@ -244,8 +251,12 @@ def cmd_measure(args) -> int:
     for r in results:
         status = f"{r.tok_s:9.1f} tok/s" if r.feasible else "INFEASIBLE"
         note = f"  [{r.note}]" if r.note else ""
+        kern = r.plan.paged_kernel or "auto"
+        if r.plan.paged_kernel == "blocked":
+            kern += f":{r.plan.pages_per_block or 'default'}"
         print(f"  {status}  path={r.plan.decode_path} "
               f"chunk={r.plan.scan_chunk} "
+              f"kernel={kern} "
               f"top_p={r.plan.top_p_impl or 'auto'}"
               f" (warmup {r.warmup_s:.2f}s, steady {r.steady_s:.3f}s)"
               f"{note}")
@@ -306,6 +317,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of scan_chunk candidates (0 = host loop)")
     m.add_argument("--top-p-impls", dest="top_p_impls", default="auto",
                    help="comma list of top-p impls ('auto' = derive)")
+    m.add_argument("--paged-kernels", dest="paged_kernels", default="auto",
+                   help="comma list from auto,one_page,folded,blocked "
+                        "('auto' = the engine's probe chain; paged/"
+                        "speculative paths only)")
+    m.add_argument("--pages-per-block", dest="pages_per_blocks", default="0",
+                   help="comma list of blocked-kernel page collapses "
+                        "(0 = kernel default; only with blocked)")
     m.add_argument("--kv-quant", dest="kv_quant", default="none",
                    choices=["none", "int8"])
     m.add_argument("--warmup", type=int, default=1)

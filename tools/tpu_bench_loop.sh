@@ -137,6 +137,13 @@ bench_scan refill_scan /tmp/bench_tpu_refill_scan.json \
 # tok/s, native): same waves config, half the Pallas grid steps
 bench paged_folded /tmp/bench_tpu_paged_folded.json \
   BENCH_ENGINE=paged BENCH_PAGED_IMPL=native_folded
+# grid-collapsed blocked kernel A/B (ISSUE 3): same waves config as
+# `paged`/`paged_folded`, page axis collapsed 8× on top of the kv fold —
+# at the r5 geometry ~13× fewer grid steps than the one-page native row.
+# The row records paged_kernel/pages_per_block/grid_steps_estimate/
+# us_per_grid_step, so the overhead regime is visible in the artifact.
+bench paged_blocked /tmp/bench_tpu_paged_blocked.json \
+  BENCH_ENGINE=paged BENCH_PAGED_IMPL=native_blocked
 run_stage kernel_check 900 bash -c \
   'python tools/tpu_kernel_check.py > /tmp/tpu_kernel_tests.log 2>&1; rc=$?;
    grep -E "PASS|FAIL" /tmp/tpu_kernel_tests.log || tail -3 /tmp/tpu_kernel_tests.log;
@@ -228,6 +235,7 @@ all_done() {
            step_anatomy learner_anatomy \
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \
+           paged_blocked \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

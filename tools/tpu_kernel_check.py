@@ -187,6 +187,33 @@ def main() -> int:
         quantize_pages(vp64.astype(jnp.float32)), "native_folded",
     )
     check_paged("paged_folded_hd128_int8", 28, kq128, vq128, "native_folded")
+    # grid-collapsed blocked kernel (ISSUE 3): pages_per_block pages of all
+    # kv heads per grid step — at this cap (pps=12, default block 8) a
+    # ragged final block, so the tail masking gets a silicon datapoint too
+    check_paged("paged_blocked_hd64_gqa14", 14, kp64, vp64, "native_blocked")
+    check_paged("paged_blocked_hd128", 28, kp128, vp128, "native_blocked")
+    check_paged(
+        "paged_blocked_hd64_int8", 14,
+        quantize_pages(kp64.astype(jnp.float32)),
+        quantize_pages(vp64.astype(jnp.float32)), "native_blocked",
+    )
+    check_paged("paged_blocked_hd128_int8", 28, kq128, vq128, "native_blocked")
+
+    # ---- grid-step budget at the r5 benched paged geometry (480 rows × 2
+    # kv × 13 pages; ×24 layers ≈ 300k one-page grid steps/decode step —
+    # the measured ~1 µs/grid-step launch bound, BASELINE.md). The blocked
+    # kernel must cut the per-layer count ≥ 8× for the A/B to escape the
+    # overhead regime.
+    from distrl_llm_tpu.ops.paged import paged_grid_steps
+
+    r5 = dict(batch=480, num_kv_heads=2, pps=13)
+    one_page = paged_grid_steps("native", **r5)
+    blocked = paged_grid_steps("native_blocked", pages_per_block=8, **r5)
+    ok = blocked * 8 <= one_page
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} blocked_grid_steps r5-geometry "
+          f"one_page={one_page} blocked={blocked} "
+          f"(x{one_page / max(blocked, 1):.1f}, need >= 8)")
 
     # ---- _gqa_mulred fusion audit (ADVICE r5): the mulred decode read's
     # [B, KH, G, D, S] broadcast product must be FUSED into the cache read —
@@ -264,21 +291,27 @@ def main() -> int:
             int(np.prod(l.shape)) * 2
             for l in jax.tree_util.tree_leaves(state_s.k_pages)
         )
-        step = jax.jit(partial(
-            _refill_decode_step, cfg=cfg_m, page_size=128, pad_id=0,
-            lora_scale=1.0, paged_impl="native", max_steps=512),
-            donate_argnames=("state",), static_argnames=("top_p_impl",))
-        mem = step.lower(
-            params_s, None, state_s, jax.random.PRNGKey(0),
-            eos_ids=jax.eval_shape(lambda: jnp.zeros((1,), jnp.int32)),
-            temperature=jax.eval_shape(lambda: jnp.zeros((), jnp.float32)),
-            top_p=jax.eval_shape(lambda: jnp.zeros((), jnp.float32)),
-        ).compile().memory_analysis()
-        temp = mem.temp_size_in_bytes
-        ok = temp < 0.5 * pool_bytes
-        failures += not ok
-        print(f"{'PASS' if ok else 'FAIL'} refill_step_hbm temp={temp/1e6:.0f}MB "
-              f"pools={pool_bytes/1e6:.0f}MB (donation must alias the pools)")
+        # audited for the proven one-page kernel AND the blocked kernel:
+        # the grid collapse must not cost pool-sized temps (HBM-audit
+        # parity — the blocked kernel's extra VMEM blocks are bounded by
+        # pages_per_block, never by the pool)
+        for impl_name in ("native", "native_blocked"):
+            step = jax.jit(partial(
+                _refill_decode_step, cfg=cfg_m, page_size=128, pad_id=0,
+                lora_scale=1.0, paged_impl=impl_name, max_steps=512),
+                donate_argnames=("state",), static_argnames=("top_p_impl",))
+            mem = step.lower(
+                params_s, None, state_s, jax.random.PRNGKey(0),
+                eos_ids=jax.eval_shape(lambda: jnp.zeros((1,), jnp.int32)),
+                temperature=jax.eval_shape(lambda: jnp.zeros((), jnp.float32)),
+                top_p=jax.eval_shape(lambda: jnp.zeros((), jnp.float32)),
+            ).compile().memory_analysis()
+            temp = mem.temp_size_in_bytes
+            ok = temp < 0.5 * pool_bytes
+            failures += not ok
+            print(f"{'PASS' if ok else 'FAIL'} refill_step_hbm[{impl_name}] "
+                  f"temp={temp/1e6:.0f}MB pools={pool_bytes/1e6:.0f}MB "
+                  f"(donation must alias the pools)")
     except Exception as e:  # noqa: BLE001 — audit is best-effort on-chip
         print(f"SKIP refill_step_hbm ({e})")
 
