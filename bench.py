@@ -849,6 +849,16 @@ def main() -> int:
         "cache_read_formulation": getattr(
             engine, "cache_read_formulation", None
         ),
+        # rollout-regime provenance, schema-shared with the trainer's
+        # train-curve JSONL records (tests/test_bench_contract.py pins both):
+        # bench drives the engine directly — one synchronous generation per
+        # timing repeat — so the mode is always "sync", the effective
+        # staleness bound 0, and nothing is ever dropped for staleness. The
+        # fields exist so bench rows and async train curves are join-able
+        # artifacts, not because bench exercises the buffer.
+        "rollout_mode": "sync",
+        "max_staleness": 0,
+        "rollout_dropped_stale": 0,
         # which paged-attention impl the probe chain actually dispatched
         # (None for dense runs / before any paged dispatch)
         "paged_attn_impl": _paged_dispatch_choice(),

@@ -17,12 +17,73 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 from typing import Any
 
 import jax
 import numpy as np
 
 Params = dict[str, Any]
+
+
+# ------------------------------------------------- rollout-buffer sidecar
+
+# The async rollout regime's in-flight state (queued Trajectory groups +
+# the producer's episode/batch cursor) is numpy/str payloads, not a jax
+# pytree, so it rides NEXT TO the Orbax snapshot as a pickle sidecar keyed
+# by the same step: a resumed run reloads the unconsumed buffer and restarts
+# the producer at its cursor instead of losing or re-generating data.
+
+def rollout_state_path(directory: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(directory), f"rollout_state_{step}.pkl"
+    )
+
+
+def save_rollout_state(directory: str, step: int, state: dict,
+                       keep: int = 3) -> str:
+    """Atomically write the rollout sidecar for ``step``; prunes sidecars
+    beyond the newest ``keep`` (mirrors the Orbax retention so orphaned
+    pickles don't accumulate)."""
+    path = rollout_state_path(directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+    os.replace(tmp, path)
+    stale = sorted(
+        (
+            p for p in os.listdir(os.path.dirname(path))
+            if p.startswith("rollout_state_") and p.endswith(".pkl")
+        ),
+        key=lambda p: int(p[len("rollout_state_"):-len(".pkl")]),
+    )[:-keep]
+    for p in stale:
+        try:
+            os.remove(os.path.join(os.path.dirname(path), p))
+        except OSError:  # a concurrent save already pruned it
+            pass
+    return path
+
+
+def load_rollout_state(directory: str, step: int) -> dict | None:
+    """Read the sidecar for ``step``; None when absent or unreadable (a
+    missing/corrupt sidecar degrades to a fresh buffer — never blocks the
+    Orbax resume itself)."""
+    path = rollout_state_path(directory, step)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception:  # noqa: BLE001 — corrupt sidecar: warn-and-fresh
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "rollout sidecar %s unreadable; resuming with an empty buffer",
+            path,
+        )
+        return None
 
 
 class CheckpointManager:

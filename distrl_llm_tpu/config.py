@@ -237,6 +237,12 @@ class TrainConfig:
     # multi-host actor fan-out (SURVEY §2b N5). The adapter ships with every
     # round; the local mesh serves the learner only.
     rollout_workers: tuple[str, ...] = ()
+    # driver-side declaration that every rollout worker was started with
+    # worker_main --capture-logprobs (its engine records behavior logprobs
+    # per token). Required for clip_ratio > 0 / rollout_mode="async" over
+    # workers — the driver cannot introspect worker engine flags, and a
+    # worker round returning no logprobs fails the first training batch.
+    workers_capture_logprobs: bool = False
     # cap on concurrent candidate rows in the rollout engine (vLLM
     # max_num_seqs; the reference tunes the same capacity knob — 256
     # concurrent sequences, train_distributed.py:34). 0 = unlimited; rounds
@@ -255,13 +261,44 @@ class TrainConfig:
     # Requires continuous_batching. 0 = off.
     spec_draft: int = 0
     spec_ngram: int = 2
-    # one-step-off-policy pipelined rollout (LlamaRL/PipelineRL-style async
-    # actor-learner overlap): batch t+1 generates on the rollout mesh WHILE
-    # the learner updates on batch t, so neither mesh idles. Rollouts sample
-    # with weights exactly one optimizer step stale (the staleness detector
-    # allows lag <= 1 instead of 0); single-update GRPO/PG tolerate this by
-    # construction (the loss's ratio is computed under the current policy).
-    # Off (default) = the reference's strictly synchronous loop.
+    # Rollout/learner coupling regime (distrl_llm_tpu/rollout):
+    #   "sync"      — the reference's strictly synchronous loop: generation
+    #                 and learning serialize; byte-identical to the pre-async
+    #                 trainer (pinned by tests/test_rollout_modes.py).
+    #   "pipelined" — one-step overlap (LlamaRL/PipelineRL-style): batch t+1
+    #                 generates WHILE the learner updates on batch t;
+    #                 rollouts sample exactly one optimizer step stale.
+    #   "async"     — fully decoupled: a RolloutService generates
+    #                 continuously into a bounded trajectory buffer and the
+    #                 learner pulls batches on its own cadence; staleness is
+    #                 bounded by max_staleness and corrected by the
+    #                 AIPO/truncated-IS objective over per-token version
+    #                 tags (requires clip_ratio > 0 for the engine-captured
+    #                 behavior logprobs the correction ratios against).
+    rollout_mode: str = "sync"
+    # staleness bound for rollout_mode="async": trajectories whose stalest
+    # token lags the learner by more than this many optimizer steps are
+    # dropped or down-weighted (staleness_policy) and the version-lag mask
+    # inside the AIPO objective enforces the same bound token-wise.
+    # sync/pipelined derive their allowed lag (0 / 1) from the mode.
+    max_staleness: int = 2
+    # trajectory-buffer capacity in task GROUPS for rollout_mode="async";
+    # 0 = auto (4 × batch_size, floor 2 × batch_size — the learner pulls
+    # batch_size groups per update, so the floor keeps a get from
+    # deadlocking against producer backpressure)
+    rollout_buffer_groups: int = 0
+    # what happens to a pulled group beyond max_staleness: "drop" (discard,
+    # counted in rollout/dropped_stale) or "downweight" (train with its
+    # update coefficients scaled by staleness_downweight^(lag − K))
+    staleness_policy: str = "drop"
+    staleness_downweight: float = 0.5
+    # AIPO truncation cap C for the async objective's per-token importance
+    # ratio min(exp(logp_cur − logp_behavior), C)
+    rollout_is_cap: float = 2.0
+    # DEPRECATED alias for --rollout_mode pipelined (the pre-rollout-service
+    # spelling): async_rollout=True with the default rollout_mode selects
+    # "pipelined"; after __post_init__ this field always reads as
+    # (rollout_mode != "sync") so existing call sites keep working.
     async_rollout: bool = False
     # in-flight weight updates (PipelineRL-style): push each optimizer
     # step's adapter into the generation round still in flight instead of
@@ -332,6 +369,47 @@ class TrainConfig:
     def __post_init__(self):
         if self.learner not in ("pg", "grpo"):
             raise ValueError(f"learner must be 'pg' or 'grpo', got {self.learner!r}")
+        if self.rollout_mode not in ("sync", "pipelined", "async"):
+            raise ValueError(
+                f"rollout_mode must be sync/pipelined/async, got "
+                f"{self.rollout_mode!r}"
+            )
+        # --async_rollout is the deprecated spelling of --rollout_mode
+        # pipelined; after normalization async_rollout reads as "any
+        # overlapped mode" (the trainer's pushed-copy/no-hybrid paths apply
+        # to pipelined AND async alike)
+        if self.async_rollout and self.rollout_mode == "sync":
+            self.rollout_mode = "pipelined"
+        self.async_rollout = self.rollout_mode != "sync"
+        if self.max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self.max_staleness}"
+            )
+        if self.staleness_policy not in ("drop", "downweight"):
+            raise ValueError(
+                f"staleness_policy must be drop/downweight, got "
+                f"{self.staleness_policy!r}"
+            )
+        if self.rollout_buffer_groups < 0:
+            raise ValueError(
+                f"rollout_buffer_groups must be >= 0, got "
+                f"{self.rollout_buffer_groups}"
+            )
+        if self.rollout_mode == "async":
+            if self.clip_ratio <= 0:
+                raise ValueError(
+                    "rollout_mode='async' requires clip_ratio > 0: the "
+                    "bounded-staleness regime trains on trajectories up to "
+                    "max_staleness optimizer steps old, and the truncated-IS "
+                    "correction consumes the engine-captured behavior "
+                    "logprobs that clip_ratio enables"
+                )
+            if self.max_staleness < 1:
+                raise ValueError(
+                    "rollout_mode='async' requires max_staleness >= 1 (0 "
+                    "would drop every trajectory the moment the learner "
+                    "steps; use rollout_mode='sync' for strict on-policy)"
+                )
         if self.base_quant not in ("none", "int8", "int4"):
             raise ValueError(f"base_quant must be none/int8/int4, got {self.base_quant!r}")
         if self.engine_impl not in ("dense", "paged", "paged_sharded"):
@@ -422,14 +500,21 @@ class TrainConfig:
                     "(worker rounds are blocking calls; full_finetune swaps "
                     "the whole param tree, not an adapter)"
                 )
-        if self.clip_ratio > 0 and self.rollout_workers:
+        if (
+            self.clip_ratio > 0 and self.rollout_workers
+            and not self.workers_capture_logprobs
+        ):
             # clip needs per-token behavior logprobs captured at generation
-            # time; worker engines are built without capture_logprobs, so a
-            # remote-rollout clip run would only fail at the first training
-            # batch — reject it up front instead
+            # time; by default worker engines are built without
+            # capture_logprobs, so a remote-rollout clip run would only fail
+            # at the first training batch — reject it up front unless the
+            # caller declares the workers were started with
+            # --capture-logprobs (worker_main)
             raise ValueError(
-                "clip_ratio > 0 requires local rollout (behavior-logprob "
-                "capture is not plumbed to rollout_workers)"
+                "clip_ratio > 0 with rollout_workers requires workers "
+                "started with --capture-logprobs AND "
+                "--workers_capture_logprobs on the driver (declares the "
+                "worker engines record behavior logprobs)"
             )
         if self.rollout_workers and (
             self.kv_cache_quant != "none" or self.engine_impl != "dense"
@@ -486,6 +571,19 @@ class TrainConfig:
     def max_seq_length(self) -> int:
         # reference: max_seq_length = prompt + new tokens (distributed_actor.py:25)
         return self.max_prompt_tokens + self.max_new_tokens
+
+    @property
+    def allowed_weight_lag(self) -> int:
+        """How many optimizer steps the rollout-resident adapter may lag the
+        learner before StaleWeightsError fires — derived from the rollout
+        regime instead of the old hard-coded ``1 if async_rollout else 0``:
+        sync serializes (0), pipelined overlaps exactly one step (1), async
+        is bounded by the staleness policy (max_staleness)."""
+        if self.rollout_mode == "sync":
+            return 0
+        if self.rollout_mode == "pipelined":
+            return 1
+        return max(self.max_staleness, 1)
 
     @property
     def run_directory(self) -> str:

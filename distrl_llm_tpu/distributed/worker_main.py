@@ -37,7 +37,8 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  max_concurrent: int = 0, scheduler: str = "waves",
                  spec_draft: int = 0, gpu_usage: float = 0.0,
                  budget_batch: int = 0, scan_chunk: int | None = None,
-                 autotune: bool = True, plan_db: str | None = None) -> None:
+                 autotune: bool = True, plan_db: str | None = None,
+                 capture_logprobs: bool = False) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -69,6 +70,13 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
 
     _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
     kwargs = {"kv_quant": kv_quant}  # both engines support int8 KV
+    if capture_logprobs:
+        # behavior-logprob capture for driver-side off-policy corrections
+        # (clip / async truncated-IS): the handler already ships
+        # result.logprobs back; the driver must be told workers record them
+        # (--workers_capture_logprobs) so its config validation admits
+        # clip_ratio > 0 over remote rollout
+        kwargs["capture_logprobs"] = True
     # execution-plan autotune (distrl_llm_tpu/autotune): each worker
     # resolves against ITS OWN host's plan DB — remote engines are
     # configured via worker_main flags by design (config.py's
@@ -239,6 +247,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--plan-db", dest="plan_db", type=str, default=None,
                         help="plan-DB path (default: $DISTRL_PLAN_DB or "
                              "~/.cache/distrl_llm_tpu/plan_db.json)")
+    parser.add_argument("--capture-logprobs", action="store_true",
+                        help="record per-token behavior logprobs during "
+                             "generation and ship them with results — "
+                             "required when the driver trains with "
+                             "--clip_ratio > 0 / --rollout_mode async over "
+                             "this worker (declare driver-side with "
+                             "--workers_capture_logprobs)")
     parser.add_argument("--trace", action="store_true",
                         help="record telemetry spans and ship them to the "
                              "driver in RPC responses (also enabled by "
@@ -269,6 +284,7 @@ def main(argv: list[str] | None = None) -> None:
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
             scan_chunk=args.decode_scan_chunk,
             autotune=args.autotune == "on", plan_db=args.plan_db,
+            capture_logprobs=args.capture_logprobs,
         )
 
     from distrl_llm_tpu.distributed.control_plane import WorkerServer

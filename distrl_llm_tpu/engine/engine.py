@@ -559,10 +559,13 @@ class LoraMailbox:
     ``_reset_lora_mailbox_round`` runs at round entry so a new round's
     trainer-passed adapter supersedes the carry."""
 
-    _pending_lora = None
+    # single-slot pending mailbox: (adapter, version) written/consumed as
+    # ONE reference so the learner thread's push can never be paired with a
+    # stale partner field by the concurrently-consuming generation thread
+    _pending: tuple | None = None
     _swapped_lora = None
 
-    def push_lora(self, lora) -> None:
+    def push_lora(self, lora, version: int | None = None) -> None:
         """In-flight weight update (PipelineRL-style): the next dispatched
         decode step onwards samples under this adapter, without waiting for
         the round to drain. Adapter shapes must match (the jitted step sees
@@ -573,16 +576,24 @@ class LoraMailbox:
         sample from the new adapter's forward over that cache. The captured
         per-token behavior logprob is the TRUE probability of that mixed
         sampling process, which is exactly what the PPO-clip ratio needs —
-        enable via ``--inflight_weight_updates`` (requires clip_ratio > 0)."""
-        self._pending_lora = lora
+        enable via ``--inflight_weight_updates`` (requires clip_ratio > 0).
+
+        ``version`` is the learner's weight_version for this adapter: the
+        consumed swap records (step, version) pairs (``last_swap_steps`` /
+        ``last_swap_versions``) so the trainer can tag every generated
+        position with the policy version that sampled it
+        (rollout/trajectory.py version tags)."""
+        self._pending = (lora, version)
 
     def _take_pending_lora(self, lora_cell: list, dispatched: int) -> None:
-        pending = self._pending_lora
+        pending = self._pending
         if pending is not None:
-            self._pending_lora = None
-            self._swapped_lora = pending
-            lora_cell[0] = pending
+            self._pending = None
+            lora, version = pending
+            self._swapped_lora = lora
+            lora_cell[0] = lora
             self.last_swap_steps.append(dispatched)
+            self.last_swap_versions.append(version)
 
     def _round_entry_lora(self, lora):
         """Adapter a wave should open with: the in-round swap if one
@@ -724,8 +735,10 @@ class GenerationEngine(LoraMailbox):
         # concurrent generate() calls (hybrid rollout: actor + learner
         # submeshes decode in parallel threads) share the compiled-fn cache
         self._compile_mu = threading.Lock()
-        # in-flight weight-update mailbox (LoraMailbox base)
+        # in-flight weight-update mailbox (LoraMailbox base): consumed-swap
+        # steps and the learner weight_version pushed with each adapter
         self.last_swap_steps: list[int] = []
+        self.last_swap_versions: list[int | None] = []
         # per-round prefill/decode timing + token counts (telemetry:
         # accumulate_round_stats); snapshotted by the trainer per round
         self.last_round_stats: dict | None = None

@@ -199,6 +199,7 @@ class ShardedPagedEngine(LoraMailbox):
         self._chunk_mu = threading.Lock()
         # in-flight weight-update mailbox (LoraMailbox base)
         self.last_swap_steps: list[int] = []
+        self.last_swap_versions: list[int | None] = []
 
     @property
     def scan_chunk_active(self) -> bool | None:

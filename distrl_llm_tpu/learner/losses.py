@@ -181,6 +181,47 @@ def grpo_clip_loss(
     return -(per_row * sample_mask).sum() / denom
 
 
+def grpo_aipo_loss(
+    logprobs: jax.Array,  # [B, T] current-policy logprobs
+    behavior_logps: jax.Array,  # [B, T] rollout-time logprobs (engine-captured)
+    answer_mask: jax.Array,  # [B, T]
+    advantages: jax.Array,  # [B]
+    sample_mask: jax.Array | None = None,
+    is_cap: float = 2.0,
+    version_lag: jax.Array | None = None,  # [B, T] optimizer-step lag per token
+    max_staleness: int = 0,
+) -> jax.Array:
+    """Truncated-importance-sampling policy gradient — the asynchronous-RL
+    objective (AIPO, LlamaRL arxiv 2505.24034 §4.2; PipelineRL trains the
+    same shape). Where ``grpo_clip_loss`` clips the surrogate around 1±ε
+    (right for near-on-policy data, one step stale at most), the async
+    regime trains on trajectories up to ``max_staleness`` optimizer steps
+    old, where ratios legitimately drift far from 1 — clipping both sides
+    there zeroes the gradient of exactly the samples that need correcting.
+    Truncated IS instead keeps the estimator unbiased-below-the-cap and
+    bounds its variance above it:
+
+        ratio_t = min(exp(logp_current − logp_behavior), C)
+        loss = −mean_rows( mean_t ratio_t · A )
+
+    ``version_lag`` keys the correction on the per-token policy-version tags
+    (rollout/trajectory.py): a trajectory that spans K in-flight weight
+    swaps carries per-token lags, and tokens whose OWN lag exceeds
+    ``max_staleness`` are masked out of the objective — the admission
+    policy's drop, enforced token-wise for mixed-version trajectories whose
+    head is fresh but whose tail predates the bound (or vice versa).
+    """
+    ratio = jnp.minimum(jnp.exp(logprobs - behavior_logps), is_cap)
+    mask = answer_mask
+    if version_lag is not None and max_staleness > 0:
+        mask = mask * (version_lag <= max_staleness).astype(mask.dtype)
+    per_row = _masked_mean_seq(ratio * advantages[:, None], mask)
+    if sample_mask is None:
+        return -per_row.mean()
+    denom = jnp.maximum(sample_mask.sum(), 1.0)
+    return -(per_row * sample_mask).sum() / denom
+
+
 def kl_to_ref(
     logprobs: jax.Array,  # [B, T] current-policy logprobs of sampled tokens
     ref_logps: jax.Array,  # [B, T] reference-policy logprobs (stop-gradient)

@@ -32,7 +32,8 @@ import numpy as np
 import optax
 
 from distrl_llm_tpu.learner.losses import (
-    answer_logprobs, grpo_clip_loss, grpo_loss, kl_to_ref, pg_loss,
+    answer_logprobs, grpo_aipo_loss, grpo_clip_loss, grpo_loss, kl_to_ref,
+    pg_loss,
 )
 from distrl_llm_tpu.models.configs import ModelConfig
 
@@ -49,6 +50,10 @@ class UpdateBatch(NamedTuple):
     # rollout-time logprobs of answer tokens [N, T] (engine-captured) — the
     # PPO-clip objective's behavior policy; None for the no-clip losses
     behavior_logps: jax.Array | None = None
+    # per-token policy-version lag [N, T] (learner version − sampling
+    # version, from the rollout trajectory tags) — the AIPO objective masks
+    # tokens beyond max_staleness; None outside the async regime
+    version_lag: jax.Array | None = None
 
 
 def _microbatch_loss(
@@ -57,6 +62,7 @@ def _microbatch_loss(
     attn_impl: str, attn_mesh=None, lora_dropout: float = 0.0,
     dropout_rng=None, logit_chunk: int = 0, train_mode: str = "lora",
     clip_ratio: float = 0.0, kl_coeff: float = 0.0,
+    off_policy: str = "clip", is_cap: float = 2.0, max_staleness: int = 0,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight.
 
@@ -79,7 +85,18 @@ def _microbatch_loss(
             lora_dropout=lora_dropout, dropout_rng=dropout_rng,
             logit_chunk=logit_chunk,
         )
-    if clip_ratio > 0.0:
+    if clip_ratio > 0.0 and off_policy == "aipo":
+        # async regime: truncated-IS correction keyed on per-token version
+        # lag (rollout/staleness.py) instead of the 1±ε clip — staleness up
+        # to K steps makes ratios drift past the clip band, where the
+        # clipped surrogate's gradient vanishes exactly on the samples that
+        # need correcting
+        loss = grpo_aipo_loss(
+            logps, mb.behavior_logps, mb.answer_mask.astype(jnp.float32),
+            mb.coeffs, mb.sample_mask, is_cap=is_cap,
+            version_lag=mb.version_lag, max_staleness=max_staleness,
+        )
+    elif clip_ratio > 0.0:
         loss = grpo_clip_loss(
             logps, mb.behavior_logps, mb.answer_mask.astype(jnp.float32),
             mb.coeffs, mb.sample_mask, clip_ratio=clip_ratio,
@@ -134,6 +151,9 @@ def make_train_step(
     train_mode: str = "lora",  # "lora" | "full" (arg0 is the whole param tree)
     clip_ratio: float = 0.0,  # >0: PPO-clip surrogate over engine logprobs
     kl_coeff: float = 0.0,  # >0: + coeff·KL(π‖frozen base); LoRA mode only
+    off_policy: str = "clip",  # "clip" (1±ε) | "aipo" (truncated IS, async)
+    is_cap: float = 2.0,  # AIPO ratio truncation C
+    max_staleness: int = 0,  # AIPO: mask tokens with version lag beyond this
 ) -> Callable:
     """Build the jitted train step.
 
@@ -147,6 +167,10 @@ def make_train_step(
         # the config layer also rejects this; guard the mechanism too — in
         # full mode there is no frozen base to serve as the reference policy
         raise ValueError("kl_coeff requires train_mode='lora' (frozen base = ref)")
+    if off_policy not in ("clip", "aipo"):
+        raise ValueError(
+            f"off_policy must be 'clip' or 'aipo', got {off_policy!r}"
+        )
     loss_fn = partial(
         _microbatch_loss,
         cfg=cfg,
@@ -161,6 +185,9 @@ def make_train_step(
         train_mode=train_mode,
         clip_ratio=clip_ratio,
         kl_coeff=kl_coeff,
+        off_policy=off_policy,
+        is_cap=is_cap,
+        max_staleness=max_staleness,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,
@@ -230,6 +257,7 @@ def prepare_update_batch(
     raw_rollout: dict | None = None,
     answer_buckets: "Sequence[int] | None" = None,
     prompt_buckets: "Sequence[int] | None" = None,
+    current_version: int | None = None,
 ) -> UpdateBatch:
     """Host-side tokenize+pad to the fixed learner shapes.
 
@@ -277,6 +305,7 @@ def prepare_update_batch(
             prompt_ids = np.asarray(prompt_ids)[:, -p_width:]
             prompt_mask = np.asarray(prompt_mask)[:, -p_width:]
     behavior_logps = None
+    version_lag = None
     if raw_rollout is not None:
         # PPO-clip path: train on the ENGINE'S token ids (retokenizing the
         # decoded text can shift token boundaries and desync the per-token
@@ -300,6 +329,17 @@ def prepare_update_batch(
             np.arange(max_new_tokens)[None, :] < lengths[:, None]
         ).astype(np.int32)
         behavior_logps = behavior
+        if current_version is not None and "version_tags" in raw_rollout:
+            # per-token optimizer-step lag from the rollout version tags
+            # (rollout/trajectory.py); padded columns get lag 0 — they are
+            # masked anyway, and a large filler value would trip the AIPO
+            # staleness mask's comparison on garbage positions
+            tags = np.asarray(raw_rollout["version_tags"], np.int32)
+            version_lag = np.zeros((n_real, max_new_tokens), np.float32)
+            version_lag[:, :width] = np.maximum(
+                current_version - tags[:, :width], 0
+            )
+            version_lag *= answer_mask
     else:
         answer_ids, answer_mask = encode_fixed(
             tokenizer, answers, max_new_tokens, side="right"
@@ -314,6 +354,8 @@ def prepare_update_batch(
             answer_mask = np.asarray(answer_mask)[:, :width]
             if behavior_logps is not None:
                 behavior_logps = behavior_logps[:, :width]
+            if version_lag is not None:
+                version_lag = version_lag[:, :width]
     n = -(-max(n_real, 1) // micro_size) * micro_size
     pad = n - n_real
 
@@ -332,6 +374,10 @@ def prepare_update_batch(
         behavior_logps=(
             jnp.asarray(pad_rows(behavior_logps))
             if behavior_logps is not None else None
+        ),
+        version_lag=(
+            jnp.asarray(pad_rows(version_lag))
+            if version_lag is not None else None
         ),
     )
     if mesh is not None:

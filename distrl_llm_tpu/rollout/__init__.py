@@ -1,0 +1,43 @@
+"""Asynchronous rollout service: versioned trajectories, a bounded
+trajectory buffer, a bounded-staleness admission policy, and the producer
+service that decouples generation from learning (``--rollout_mode async``).
+
+The reference loop is strictly synchronous — generation and learning
+serialize, so the slower side always idles the other. LlamaRL
+(arxiv 2505.24034) and Laminar (arxiv 2510.12633) put the throughput win in
+fully decoupling rollout from learning behind a trajectory buffer with a
+bounded-staleness policy and importance-weight correction; PipelineRL
+(arxiv 2509.19128) shows in-flight weight updates (our ``push_lora``) keep
+that decoupling near-on-policy. This package is that decoupling layer:
+
+* :mod:`trajectory` — the versioned Trajectory record (tokens, rewards-to-be,
+  per-token behavior logprobs, per-token policy-version tags);
+* :mod:`buffer` — bounded FIFO buffer with watermarked backpressure,
+  staleness-aware eviction, and drop accounting;
+* :mod:`staleness` — the bounded-staleness admission policy (drop or
+  down-weight beyond ``max_staleness``; telemetered);
+* :mod:`service` — the producer thread that runs generation continuously
+  (local engines via the trainer's rollout machinery; remote workers ride
+  the same path through RemoteEngine's MSG_DISPATCH/MSG_RESULT fan-out) and
+  streams completed groups into the buffer.
+"""
+
+from distrl_llm_tpu.rollout.buffer import TrajectoryBuffer
+from distrl_llm_tpu.rollout.service import RolloutService
+from distrl_llm_tpu.rollout.staleness import StalenessPolicy
+from distrl_llm_tpu.rollout.trajectory import (
+    Trajectory,
+    round_to_trajectories,
+    trajectories_to_candidates,
+    version_tags_for_round,
+)
+
+__all__ = [
+    "Trajectory",
+    "TrajectoryBuffer",
+    "RolloutService",
+    "StalenessPolicy",
+    "round_to_trajectories",
+    "trajectories_to_candidates",
+    "version_tags_for_round",
+]

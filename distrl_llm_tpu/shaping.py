@@ -73,8 +73,9 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
     in a group is the identical prompt (SURVEY §3.6.5)."""
     for cand in candidates:
         kept_answers, kept_rewards, kept_problems = [], [], []
-        kept_tokens, kept_logps, kept_lens = [], [], []
+        kept_tokens, kept_logps, kept_lens, kept_tags = [], [], [], []
         has_raw = "answer_tokens" in cand
+        has_tags = "version_tags" in cand
         for j, rewards in enumerate(cand["rewards"]):
             idx = np.argsort(rewards)[-topk:]
             kept_answers.append([cand["answers"][j][i] for i in idx])
@@ -84,6 +85,8 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
                 kept_tokens.append(np.asarray(cand["answer_tokens"][j])[idx])
                 kept_logps.append(np.asarray(cand["behavior_logps"][j])[idx])
                 kept_lens.append(np.asarray(cand["gen_lengths"][j])[idx])
+            if has_tags:  # policy-version tags stay row-aligned too
+                kept_tags.append(np.asarray(cand["version_tags"][j])[idx])
         cand["answers"] = kept_answers
         cand["rewards"] = kept_rewards
         cand["problem"] = kept_problems
@@ -91,6 +94,8 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
             cand["answer_tokens"] = kept_tokens
             cand["behavior_logps"] = kept_logps
             cand["gen_lengths"] = kept_lens
+        if has_tags:
+            cand["version_tags"] = kept_tags
 
 
 def flatten_for_update(
@@ -103,26 +108,38 @@ def flatten_for_update(
     ``raw_rollout`` (None when the engine captured no logprobs) carries the
     engine's own answer token ids and behavior logprobs row-aligned with the
     text lists — the PPO-clip objective trains on these instead of
-    retokenized text."""
+    retokenized text. When present, per-token policy-version tags
+    (rollout/trajectory.py) ride along as ``version_tags``.
+
+    ``group_weights`` on a candidate dict (the async staleness policy's
+    down-weights, one per task group) scale that group's flattened
+    coefficients — absent (every sync/pipelined round) the math is
+    untouched."""
     problems: list[str] = []
     answers: list[str] = []
     coeffs: list[float] = []
     tokens: list[np.ndarray] = []
     logps: list[np.ndarray] = []
+    tags: list[np.ndarray] = []
     lens: list[int] = []
     has_raw = all("answer_tokens" in c for c in candidates) and candidates
+    has_tags = has_raw and all("version_tags" in c for c in candidates)
     for cand in candidates:
+        gw = cand.get("group_weights")
         if learner_type == "grpo":
             for j, (a, p, r) in enumerate(
                 zip(cand["answers"], cand["problem"], cand["rewards"])
             ):
                 problems.extend(p)
                 answers.extend(a)
-                coeffs.extend(np.asarray(r).tolist())
+                w = 1.0 if gw is None else float(gw[j])
+                coeffs.extend((np.asarray(r) * w).tolist())
                 if has_raw:
                     tokens.extend(np.asarray(cand["answer_tokens"][j]))
                     logps.extend(np.asarray(cand["behavior_logps"][j]))
                     lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
+                if has_tags:
+                    tags.extend(np.asarray(cand["version_tags"][j]))
         else:
             for j, (a, p, r, b) in enumerate(
                 zip(
@@ -132,11 +149,14 @@ def flatten_for_update(
             ):
                 problems.extend(p)
                 answers.extend(a)
-                coeffs.extend((np.asarray(r) - b).tolist())
+                w = 1.0 if gw is None else float(gw[j])
+                coeffs.extend(((np.asarray(r) - b) * w).tolist())
                 if has_raw:
                     tokens.extend(np.asarray(cand["answer_tokens"][j]))
                     logps.extend(np.asarray(cand["behavior_logps"][j]))
                     lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
+                if has_tags:
+                    tags.extend(np.asarray(cand["version_tags"][j]))
     raw = None
     if has_raw and tokens:
         raw = {
@@ -144,4 +164,6 @@ def flatten_for_update(
             "behavior_logps": np.asarray(logps, dtype=np.float32),
             "lengths": np.asarray(lens, dtype=np.int32),
         }
+        if has_tags and tags:
+            raw["version_tags"] = np.asarray(tags, dtype=np.int32)
     return problems, answers, np.asarray(coeffs, dtype=np.float32), raw

@@ -206,13 +206,26 @@ def gauge_set(name: str, value: float) -> None:
         })
 
 
-def hist_observe(name: str, value: float) -> None:
+def hist_observe(name: str, value: float, *, trace_sample: bool = False) -> None:
     """Latency-style histogram; snapshot reports count/mean/p50/p90/max and
-    resets (e.g. ``cp/rpc_dispatch_ms``)."""
+    resets (e.g. ``cp/rpc_dispatch_ms``). ``trace_sample=True`` additionally
+    emits each observation as a Chrome counter event while tracing is on, so
+    distribution-over-time series (``rollout/staleness``) get a Perfetto
+    track AND tools/trace_report.py can summarize them from the trace file
+    alone — the sink histogram resets every snapshot, the trace keeps all
+    samples."""
     st = _STATE
     with st.lock:
         st.hists.setdefault(name, []).append(value)
         st.touched.add(name)
+    if trace_sample and st.enabled:
+        st.events.append({
+            "ph": "C",
+            "name": name,
+            "ts": time.time_ns() // 1000,
+            "tid": 0,
+            "args": {name.rsplit("/", 1)[-1]: value},
+        })
 
 
 def metrics_snapshot() -> dict[str, float]:

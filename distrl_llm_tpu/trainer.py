@@ -234,6 +234,20 @@ class Trainer:
             train_mode="full" if self._full else "lora",
             clip_ratio=config.clip_ratio,
             kl_coeff=config.kl_coeff,
+            # async trains on data up to max_staleness steps old — the
+            # truncated-IS objective (AIPO) with per-token version-lag
+            # masking replaces the near-on-policy 1±ε clip. The mask is
+            # DROP-mode semantics (trim the stale tokens of admitted
+            # mixed-version groups); under the downweight policy it must
+            # stay off (0) — the fade deliberately trains beyond-K tokens
+            # at reduced weight, and masking them would silently turn
+            # downweight back into drop
+            off_policy="aipo" if config.rollout_mode == "async" else "clip",
+            is_cap=config.rollout_is_cap,
+            max_staleness=(
+                config.max_staleness
+                if config.staleness_policy == "drop" else 0
+            ),
         )
 
         self.total_batch_steps = 0
@@ -475,14 +489,50 @@ class Trainer:
         self.total_samples_processed = int(restored["samples"])
         self._rng = restored["rng"]
         self.weight_version = self.total_batch_steps
+        if self.config.rollout_mode == "async":
+            from distrl_llm_tpu.checkpoint import load_rollout_state
+
+            # buffered-but-unconsumed trajectories + producer cursor;
+            # absent/corrupt sidecar degrades to a fresh buffer
+            self._resume_rollout_state = load_rollout_state(
+                self.config.checkpoint_dir, self.total_batch_steps
+            )
         log.info(
             "resumed from step %d (episode %d, batch %d)",
             self.total_batch_steps, self.episode, self.batch_in_episode,
         )
 
     def save_checkpoint(self) -> None:
-        if self.ckpt is not None:
-            self.ckpt.save(self.total_batch_steps, self._state_tree())
+        if self.ckpt is None:
+            return
+        self.ckpt.save(self.total_batch_steps, self._state_tree())
+        buffer = getattr(self, "_rollout_buffer", None)
+        if buffer is not None:
+            # async regime: the in-flight state (queued trajectories + the
+            # producer's episode/batch cursor) rides as a pickle sidecar
+            # keyed by the same step, so resume neither loses nor
+            # re-generates buffered data
+            from distrl_llm_tpu.checkpoint import save_rollout_state
+
+            service = getattr(self, "_rollout_service", None)
+            # cursor BEFORE the buffer snapshot: if the producer lands a
+            # round between the two reads, the stale cursor re-produces
+            # that batch on resume (benign duplicates); the other order
+            # could pair a pre-put snapshot with an advanced cursor and
+            # LOSE the round's tail
+            cursor = service.cursor if service is not None else None
+            policy = getattr(self, "_staleness_policy", None)
+            save_rollout_state(
+                self.config.checkpoint_dir, self.total_batch_steps, {
+                    "buffer": buffer.state_dict(),
+                    "cursor": cursor,
+                    # admission counters ride along so the cumulative
+                    # rollout_dropped_stale series never goes BACKWARDS
+                    # across a resume (dashboards join on it)
+                    "policy_dropped": policy.dropped if policy else 0,
+                    "policy_admitted": policy.admitted if policy else 0,
+                },
+            )
 
     def export_hf_snapshot(self) -> None:
         """The reference's ``save_pretrained`` artifact: an HF-format
@@ -768,20 +818,33 @@ class Trainer:
         )
         # race detector (SURVEY §5): the engine must only ever sample with the
         # adapter version the learner last published — the check the
-        # reference's filesystem bus never had. async_rollout deliberately
-        # samples one step stale (generation overlaps the update), so its
-        # allowed lag is 1; anything beyond is still a bug.
-        allowed_lag = 1 if self.config.async_rollout else 0
-        lag = self.weight_version - self._rollout_weight_version
+        # reference's filesystem bus never had. The allowed lag derives from
+        # the rollout regime (config.allowed_weight_lag): sync serializes
+        # (0), pipelined deliberately samples one step stale (1), async is
+        # bounded by the staleness policy (max_staleness); anything beyond
+        # the mode's bound is still a bug.
+        allowed_lag = self.config.allowed_weight_lag
+        # read order matters on the overlapped modes' rollout thread: the
+        # ROLLOUT-resident version is read FIRST, so a learner step landing
+        # between the two reads surfaces as a benign positive lag — the
+        # other order could read pre-step weight_version with post-push
+        # rollout version and compute lag -1, crashing a healthy run
+        rollout_version = self._rollout_weight_version
+        lag = self.weight_version - rollout_version
         if not 0 <= lag <= allowed_lag:
             # lag < 0 (rollout AHEAD of the learner) is version-bookkeeping
             # corruption — e.g. a resume that restored an older learner state
             raise StaleWeightsError(
-                f"rollout mesh holds adapter v{self._rollout_weight_version} "
-                f"but learner is at v{self.weight_version} (allowed lag "
-                f"{allowed_lag}); _push_weights() was not called after the "
-                "last optimizer step"
+                f"rollout mesh holds adapter v{rollout_version} "
+                f"but learner is at v{self.weight_version} — rollout_mode="
+                f"{self.config.rollout_mode!r} allows lag <= {allowed_lag}; "
+                "_push_weights() was not called after the last optimizer "
+                "step, or the staleness bound is misconfigured"
             )
+        # snapshot the mailbox BEFORE dispatch so this round's in-flight
+        # swaps (and the versions pushed with them) can be sliced out after
+        swaps_before = len(getattr(self.engine, "last_swap_steps", ()))
+        base_version = self._rollout_weight_version
         result = self._dispatch_rollout(prompt_ids, prompt_mask, sampling, b_real)
 
         n = sampling.n
@@ -803,6 +866,31 @@ class Trainer:
             cand["answer_tokens"] = [result.tokens[i] for i in range(b_real)]
             cand["behavior_logps"] = [result.logprobs[i] for i in range(b_real)]
             cand["gen_lengths"] = [result.lengths[i] for i in range(b_real)]
+            # per-token policy-version tags (rollout/trajectory.py): which
+            # learner weight_version sampled each position. The round opens
+            # at the rollout-resident version; every consumed in-flight swap
+            # (push_lora) advances the tag from its recorded step on. A
+            # swap pushed without a version (legacy callers) is inferred as
+            # one optimizer step past its predecessor.
+            from distrl_llm_tpu.rollout.trajectory import version_tags_for_round
+
+            steps = list(getattr(self.engine, "last_swap_steps", ()))
+            versions = list(getattr(self.engine, "last_swap_versions", ()))
+            events: list[tuple[int, int]] = []
+            inferred = base_version
+            for k, step in enumerate(steps[swaps_before:]):
+                v = (
+                    versions[swaps_before + k]
+                    if swaps_before + k < len(versions) else None
+                )
+                inferred = int(v) if v is not None else inferred + 1
+                events.append((int(step), inferred))
+            tags = version_tags_for_round(
+                n, result.tokens.shape[2], base_version, events
+            )
+            cand["version_tags"] = [tags for _ in range(b_real)]
+            cand["base_version"] = base_version
+            cand["swap_events"] = events
         # snapshot pool + round telemetry HERE, on the thread that ran the
         # round: with async_rollout the next round (or an eval) may
         # overwrite the engine's shared attributes before _train_batch
@@ -852,6 +940,12 @@ class Trainer:
             # initial eval (distributed_trainer.py:241–242)
             self.evaluate()
 
+            if cfg.rollout_mode == "async":
+                # fully decoupled regime: RolloutService + trajectory
+                # buffer + bounded-staleness learner loop
+                self._train_async()
+                return
+
             # self.episode is the next episode to START (end-of-episode saves
             # store episode+1, so a finished run resumes as a no-op).
             # ``batch_in_episode`` is the mid-episode cursor: the episode
@@ -860,7 +954,7 @@ class Trainer:
             # trained instead of re-sampling them (SURVEY §5 checkpoint).
             start_episode = self.episode
             gen_pool = None
-            if cfg.async_rollout:
+            if cfg.rollout_mode == "pipelined":
                 from concurrent.futures import ThreadPoolExecutor
 
                 gen_pool = ThreadPoolExecutor(
@@ -869,22 +963,17 @@ class Trainer:
                 self._gen_pool = gen_pool
             for episode in range(start_episode, cfg.episodes):
                 self.episode = episode
-                dataset = self.train_dataset.shuffle(seed=cfg.seed + 1000 * episode)
                 skip = self.batch_in_episode if episode == start_episode else 0
 
                 # ONE-batch lookahead iterator, streamed — the sync path must
                 # not materialize the episode (reference parity: it iterates),
                 # and the async pipeline only ever needs the next batch.
-                # async_rollout: batch t+1's generation is submitted BEFORE
+                # Pipelined mode: batch t+1's generation is submitted BEFORE
                 # batch t's update (LlamaRL/PipelineRL-style overlap), so it
                 # samples with weights one step stale while the learner mesh
                 # works; the pipeline stays within the episode (batch order
                 # and the resume cursor are unchanged).
-                stream = (
-                    (bi, b)
-                    for bi, b in enumerate(dataset.iter(cfg.batch_size))
-                    if bi >= skip
-                )
+                stream = self._episode_batch_stream(episode, skip)
                 pending = next(stream, None)
                 gen_future = None
                 if gen_pool is not None and pending is not None:
@@ -928,6 +1017,13 @@ class Trainer:
             self.save_checkpoint()
             raise
         finally:
+            service = getattr(self, "_rollout_service", None)
+            if service is not None:
+                # closes the buffer and stops after the round in flight;
+                # never joins a possibly-hung generation (EngineHangError's
+                # documented recovery is process restart)
+                service.stop()
+                self._rollout_service = None
             pool = getattr(self, "_gen_pool", None)
             if pool is not None:
                 # never join a possibly-hung generation thread (a raised
@@ -945,6 +1041,149 @@ class Trainer:
             self.sink.finish()
             self.rewards.close()
 
+    def _episode_batch_stream(self, episode: int, skip: int):
+        """One episode's (batch_index, batch) stream — the SINGLE owner of
+        the per-episode shuffle seed and resume-skip semantics. Both the
+        sync/pipelined loop and the async producer iterate this, so the
+        regimes can never disagree on which batches exist or their order."""
+        cfg = self.config
+        dataset = self.train_dataset.shuffle(seed=cfg.seed + 1000 * episode)
+        for bi, b in enumerate(dataset.iter(cfg.batch_size)):
+            if bi >= skip:
+                yield bi, b
+
+    # ------------------------------------------------------------- async RL
+
+    def _episode_batches(self, start_episode: int, start_batch: int):
+        """(episode, batch_index, batch) stream in EXACTLY the sync loop's
+        order (shared _episode_batch_stream) — the async regime changes
+        when batches train, never which ones."""
+        for episode in range(start_episode, self.config.episodes):
+            skip = start_batch if episode == start_episode else 0
+            for bi, b in self._episode_batch_stream(episode, skip):
+                yield episode, bi, b
+
+    def _train_async(self) -> None:
+        """The fully decoupled regime (``--rollout_mode async``): a
+        RolloutService thread generates continuously into a bounded
+        TrajectoryBuffer while this loop pulls ``batch_size`` task groups
+        per update on its own cadence (LlamaRL/Laminar decoupling;
+        PipelineRL-style ``push_lora`` keeps the stream near-on-policy when
+        ``inflight_weight_updates`` is on).
+
+        Staleness control is layered: the buffer evicts queued groups
+        already beyond ``max_staleness`` (cheap, before reward/update work),
+        the StalenessPolicy drops or down-weights at admission, and the
+        AIPO objective masks per-token by version lag. Every drop is
+        counted, never silent."""
+        cfg = self.config
+        from distrl_llm_tpu.rollout import (
+            RolloutService, StalenessPolicy, TrajectoryBuffer,
+            round_to_trajectories, trajectories_to_candidates,
+        )
+
+        # capacity floor 2× the per-update pull: a get_batch(batch_size)
+        # must always be satisfiable below the backpressure gate, or the
+        # learner and a gated producer would deadlock against each other
+        capacity = max(
+            cfg.rollout_buffer_groups or 4 * cfg.batch_size,
+            2 * cfg.batch_size,
+        )
+        buffer = TrajectoryBuffer(capacity)
+        policy = StalenessPolicy(
+            cfg.max_staleness, mode=cfg.staleness_policy,
+            downweight=cfg.staleness_downweight,
+        )
+        self._rollout_buffer = buffer
+        self._staleness_policy = policy
+        self._rollout_dropped_stale = 0
+
+        start_episode, start_batch = self.episode, self.batch_in_episode
+        restored = getattr(self, "_resume_rollout_state", None)
+        if restored:
+            # unconsumed trajectories + the producer cursor from the
+            # checkpoint sidecar: the run resumes without losing or
+            # re-generating in-flight data
+            buffer.load_state(restored.get("buffer", {}))
+            cursor = restored.get("cursor")
+            if cursor is not None:
+                start_episode, start_batch = int(cursor[0]), int(cursor[1])
+            policy.dropped = int(restored.get("policy_dropped", 0))
+            policy.admitted = int(restored.get("policy_admitted", 0))
+            self._rollout_dropped_stale = (
+                buffer.dropped_stale + policy.dropped
+            )
+
+        def produce(episode: int, bi: int, batch) -> list:
+            [cand] = self._generate_round(batch, cfg.train_sampling())
+            return round_to_trajectories(
+                cand,
+                base_version=cand.get(
+                    "base_version", self._rollout_weight_version
+                ),
+                swap_events=cand.get("swap_events", ()),
+                episode=episode, batch_index=bi,
+            )
+
+        service = RolloutService(
+            produce, buffer, self._episode_batches(start_episode, start_batch)
+        )
+        self._rollout_service = service
+        service.start()
+        while True:
+            timer = telemetry.PhaseSpans()
+            if cfg.staleness_policy == "drop":
+                # queued groups already beyond the bound will be rejected
+                # at admission anyway — evict them first so the buffer
+                # refills with usable data while this update runs. NOT in
+                # downweight mode: there admission trains beyond-K groups
+                # at reduced weight, so evicting them here would silently
+                # turn downweight into drop
+                buffer.evict_stale(self.weight_version, cfg.max_staleness)
+            with timer("generation"):
+                # honest accounting: the learner's BLOCKED time waiting on
+                # the buffer (decoupling hides the rest of generation)
+                groups = buffer.get_batch(cfg.batch_size)
+            service.raise_if_failed()
+            if not groups:
+                break  # producer done and buffer drained
+            kept, weights = policy.admit(groups, self.weight_version)
+            self._rollout_dropped_stale = (
+                buffer.dropped_stale + policy.dropped
+            )
+            if not kept:
+                continue
+            # (occupancy gauge: the buffer maintains rollout/buffer_occupancy
+            # itself on every mutation — no second writer here)
+            cand = trajectories_to_candidates(kept, weights)
+            episode = kept[0].episode
+            self.episode = episode
+            # conservative resume cursor: re-derived from the producer at
+            # save time (save_checkpoint stores the service cursor + buffer
+            # snapshot; these counters only feed metrics/logs here)
+            self.batch_in_episode = kept[-1].batch_index + 1
+            self._update_on_candidates(
+                [cand], episode, timer, n_samples=len(kept)
+            )
+            if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
+                # evals need exclusive engine access (engines are not
+                # re-entrant): pause at the next round boundary, resume after
+                service.pause()
+                try:
+                    self.evaluate()
+                finally:
+                    service.resume()
+            if cfg.save_every and self.total_batch_steps % cfg.save_every == 0:
+                self.save_checkpoint()
+                if cfg.export_hf_snapshots and cfg.run_name:
+                    self.export_hf_snapshot()
+        service.raise_if_failed()
+        self.episode = cfg.episodes
+        self.batch_in_episode = 0
+        self.save_checkpoint()
+        if cfg.export_hf_snapshots and cfg.run_name:
+            self.export_hf_snapshot()
+
     def _train_batch(self, batch: Mapping[str, Sequence[str]], episode: int,
                      gen_future=None) -> None:
         cfg = self.config
@@ -953,12 +1192,26 @@ class Trainer:
         timer = telemetry.PhaseSpans()
 
         with timer("generation"):
-            # async_rollout hands in a future: timing/generation_duration then
-            # honestly records the BLOCKED time (overlap hides the rest)
+            # pipelined rollout hands in a future: timing/generation_duration
+            # then honestly records the BLOCKED time (overlap hides the rest)
             if gen_future is not None:
                 candidates = gen_future.result()
             else:
                 candidates = self._generate_round(batch, cfg.train_sampling())
+        self._update_on_candidates(
+            candidates, episode, timer, n_samples=len(batch["problem"])
+        )
+
+    def _update_on_candidates(
+        self, candidates: list[dict[str, Any]], episode: int,
+        timer: "telemetry.PhaseSpans", n_samples: int,
+    ) -> None:
+        """Everything after generation: rewards, shaping, the optimizer
+        step, weight push, and the metrics record. Shared verbatim by the
+        sync/pipelined batch loop (candidates fresh from the round) and the
+        async learner loop (candidates reassembled from buffered
+        trajectories — rollout/trajectory.py)."""
+        cfg = self.config
         with timer("reward"):
             self._compute_round_rewards(candidates)
 
@@ -1009,6 +1262,13 @@ class Trainer:
                 raw_rollout=raw if cfg.clip_ratio > 0.0 else None,
                 answer_buckets=cfg.learner_len_buckets or None,
                 prompt_buckets=cfg.learner_prompt_buckets or None,
+                # async: per-token version lag (learner version − sampling
+                # version tag) feeds the AIPO staleness mask; None keeps
+                # the sync/pipelined batch pytree unchanged
+                current_version=(
+                    self.weight_version
+                    if cfg.rollout_mode == "async" else None
+                ),
             )
             # visibility: which widths this update compiled/ran at (equal
             # the max_* caps unless the learner buckets cut them)
@@ -1032,13 +1292,16 @@ class Trainer:
             # policy sampled each token
             push = getattr(self.engine, "push_lora", None)
             if push is not None:
-                push(self._lora_rollout)
+                # version rides with the adapter so the round in flight can
+                # tag every post-swap position with the policy that sampled
+                # it (rollout/trajectory.py version tags)
+                push(self._lora_rollout, version=self.weight_version)
 
         if cfg.write_adapter_file:
             self.save_adapter()
 
         self.total_batch_steps += 1
-        self.total_samples_processed += len(batch["problem"])
+        self.total_samples_processed += n_samples
         metrics = {
             "loss": loss,
             "mean_accuracy_reward": float(np.mean(stats.mean_acc)),
@@ -1049,6 +1312,16 @@ class Trainer:
             "episode": episode,
             "total_batch_steps": self.total_batch_steps,
             "total_samples_processed": self.total_samples_processed,
+            # rollout-regime provenance on every train-curve record (the
+            # bench rows carry the same three fields — artifacts from
+            # different regimes must be distinguishable from the JSONL
+            # alone): the mode, the EFFECTIVE staleness bound (0 sync /
+            # 1 pipelined / K async), and cumulative stale drops
+            "rollout_mode": cfg.rollout_mode,
+            "max_staleness": cfg.allowed_weight_lag,
+            "rollout_dropped_stale": getattr(
+                self, "_rollout_dropped_stale", 0
+            ),
         }
         if cfg.learner_len_buckets:
             metrics["learner/answer_width"] = answer_width

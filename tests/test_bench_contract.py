@@ -52,12 +52,19 @@ class TestBenchContract:
                     "paged_attn_impl", "total_tokens",
                     "paged_kernel", "pages_per_block", "grid_steps_estimate",
                     "us_per_grid_step",
-                    "plan", "plan_source", "cache_read_formulation"):
+                    "plan", "plan_source", "cache_read_formulation",
+                    "rollout_mode", "max_staleness", "rollout_dropped_stale"):
             assert key in rec, key
         assert rec["metric"] == "rollout_tokens_per_sec_per_chip"
         assert rec["backend"] == "cpu"
         assert rec["value"] > 0
         assert "error" not in rec
+        # rollout-regime fields, schema-shared with the trainer's
+        # train-curve JSONL: bench drives the engine synchronously, so the
+        # row always reads sync / bound 0 / zero drops
+        assert rec["rollout_mode"] == "sync"
+        assert rec["max_staleness"] == 0
+        assert rec["rollout_dropped_stale"] == 0
         # the resolved execution plan makes the row self-describing: the
         # effective dispatch choices plus where they came from
         assert rec["plan"]["decode_path"] == "dense"

@@ -74,6 +74,45 @@ def test_invalid_learner_rejected():
         build_parser().parse_args(["--learner", "ppo"])
 
 
+def test_rollout_mode_flags():
+    args = build_parser().parse_args(
+        ["--rollout_mode", "async", "--max_staleness", "4",
+         "--clip_ratio", "0.2", "--staleness_policy", "downweight",
+         "--rollout_buffer_groups", "64"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.rollout_mode == "async"
+    assert cfg.max_staleness == 4
+    assert cfg.staleness_policy == "downweight"
+    assert cfg.rollout_buffer_groups == 64
+    assert cfg.allowed_weight_lag == 4
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--rollout_mode", "turbo"])
+
+
+def test_async_rollout_alias_selects_pipelined():
+    # the deprecated spelling keeps working: one-step overlap
+    args = build_parser().parse_args(["--async_rollout"])
+    cfg = config_from_args(args)
+    assert cfg.rollout_mode == "pipelined"
+    assert cfg.async_rollout is True
+    # and the default is the reference's synchronous loop
+    assert config_from_args(build_parser().parse_args([])).rollout_mode == "sync"
+
+
+def test_workers_capture_logprobs_gate():
+    from distrl_llm_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="capture-logprobs"):
+        TrainConfig(model="t", clip_ratio=0.2,
+                    rollout_workers=("h:1",))
+    cfg = config_from_args(build_parser().parse_args(
+        ["--clip_ratio", "0.2", "--rollout_workers", "h:1",
+         "--workers_capture_logprobs"]
+    ))
+    assert cfg.workers_capture_logprobs
+
+
 class TestReadmeBaselineCommands:
     """The README's five BASELINE-config commands must parse into valid
     TrainConfigs — documentation that cannot rot."""

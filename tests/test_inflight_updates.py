@@ -181,6 +181,90 @@ class TestSwapSemantics:
         assert not np.array_equal(swapped.tokens, base.tokens)
 
 
+class TestMultiSwapSegments:
+    @pytest.mark.slow
+    def test_per_token_ratios_correct_across_two_version_swaps(self, setup):
+        """K>1 extension of the segment-wise teacher-forcing pin: a
+        trajectory spanning TWO in-flight weight swaps (A→B at step 0, B→C
+        at step 8) still captures, per token, the true behavior logprob of
+        the adapter that sampled it — segment 1 (pure A: prefill logits)
+        reproduces exactly under a teacher-forced A recompute; the B and C
+        segments were sampled from mixed forwards (new adapter over KV the
+        older adapters wrote), so their captured values are finite, proper
+        logprobs that genuinely diverge from any single-adapter recompute.
+        The mailbox's recorded (step, version) pairs must map onto the
+        version tags the trainer derives (rollout/trajectory.py), so the
+        learner's per-token version lag stays aligned with the ratio
+        segments."""
+        from distrl_llm_tpu.rollout.trajectory import version_tags_for_round
+
+        params, lora_a, lora_b, ids, mask = setup
+        lora_c = jax.tree_util.tree_map(lambda x: x + 0.25, lora_b)
+        eng = _dense(capture=True)
+        eng.push_lora(lora_b, version=1)  # consumed at step 0
+        fired = [False]
+        orig = eng._take_pending_lora
+
+        def hook(cell, dispatched):
+            if dispatched == 8 and not fired[0]:
+                fired[0] = True
+                eng.push_lora(lora_c, version=2)
+            orig(cell, dispatched)
+
+        eng._take_pending_lora = hook
+        res = eng.generate(
+            params, lora_a, ids, mask,
+            SamplingConfig(max_tokens=24, temperature=1.1, top_p=1.0, n=2),
+            jax.random.PRNGKey(4),
+        )
+        assert eng.last_swap_steps == [0, 8]
+        assert eng.last_swap_versions == [1, 2]
+
+        b, n, t = res.tokens.shape
+        pid = np.repeat(ids, n, axis=0)
+        pmask = np.repeat(mask, n, axis=0)
+        aid = res.tokens.reshape(b * n, t)
+        lengths = res.lengths.reshape(b * n)
+        amask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.int32)
+        got = res.logprobs.reshape(b * n, t)
+
+        # the trainer-side tag derivation matches the mailbox record:
+        # position 0 under v0 (A), 1..8 under v1 (B), >8 under v2 (C)
+        tags = version_tags_for_round(b * n, t, 0, [(0, 1), (8, 2)])
+        np.testing.assert_array_equal(tags[:, 0], 0)
+        np.testing.assert_array_equal(tags[:, 1:9], 1)
+        np.testing.assert_array_equal(tags[:, 9:], 2)
+
+        under_a = np.asarray(answer_logprobs(
+            params, TINY, jnp.asarray(pid), jnp.asarray(pmask),
+            jnp.asarray(aid), jnp.asarray(amask),
+            lora=lora_a, lora_scale=SCALE, remat=False,
+        ))
+        seg_a = (tags == 0) & amask.astype(bool)
+        seg_b = (tags == 1) & amask.astype(bool)
+        seg_c = (tags == 2) & amask.astype(bool)
+        assert seg_a.any() and seg_b.any() and seg_c.any(), (
+            "trajectory must span all three version segments"
+        )
+        # segment A: prefill-sampled, pure-A state — exact reproduction
+        np.testing.assert_allclose(
+            got[seg_a], under_a[seg_a], atol=2e-4, rtol=2e-4
+        )
+        # segments B and C: true mixed-process probabilities — finite,
+        # proper logprobs that are NOT adapter A's anymore
+        for seg in (seg_b, seg_c):
+            assert np.isfinite(got[seg]).all() and (got[seg] <= 0).all()
+            assert np.abs(got[seg] - under_a[seg]).max() > 1e-3
+        # and the C segment is not B's distribution either: recompute under
+        # B diverges where C sampled (mixed-KV caveat as above)
+        under_b = np.asarray(answer_logprobs(
+            params, TINY, jnp.asarray(pid), jnp.asarray(pmask),
+            jnp.asarray(aid), jnp.asarray(amask),
+            lora=lora_b, lora_scale=SCALE, remat=False,
+        ))
+        assert np.abs(got[seg_c] - under_b[seg_c]).max() > 1e-3
+
+
 class TestConfig:
     def test_requires_async_and_clip(self):
         with pytest.raises(ValueError, match="async_rollout"):

@@ -1,0 +1,468 @@
+"""Property tests for the async rollout subsystem's data plane
+(distrl_llm_tpu/rollout): buffer watermarks, backpressure, FIFO/staleness
+eviction order, drop accounting, version tags, the admission policy, and the
+producer service's lifecycle."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.rollout import (
+    RolloutService,
+    StalenessPolicy,
+    Trajectory,
+    TrajectoryBuffer,
+    round_to_trajectories,
+    trajectories_to_candidates,
+    version_tags_for_round,
+)
+from distrl_llm_tpu.rollout.buffer import BufferClosed
+
+
+def traj(i: int, version: int = 0, n: int = 2, t: int = 4) -> Trajectory:
+    return Trajectory(
+        problem=f"p{i}", solution=f"s{i}", answers=[f"a{j}" for j in range(n)],
+        token_lengths=[t] * n,
+        tokens=np.full((n, t), i, np.int32),
+        lengths=np.full((n,), t, np.int32),
+        behavior_logps=np.full((n, t), -1.0, np.float32),
+        version_tags=np.full((n, t), version, np.int32),
+        produced_version=version, batch_index=i,
+    )
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+
+
+class TestBufferBasics:
+    def test_fifo_order(self):
+        buf = TrajectoryBuffer(8)
+        for i in range(5):
+            buf.put(traj(i))
+        got = buf.get_batch(5)
+        assert [g.batch_index for g in got] == [0, 1, 2, 3, 4]
+        assert buf.total_put == 5 and buf.total_got == 5
+
+    def test_get_partial_after_close(self):
+        buf = TrajectoryBuffer(8)
+        buf.put(traj(0))
+        buf.close()
+        assert [g.batch_index for g in buf.get_batch(4)] == [0]
+        assert buf.get_batch(4) == []  # drained: empty forever
+        with pytest.raises(BufferClosed):
+            buf.put(traj(1))
+
+    def test_get_blocks_until_k_available(self):
+        buf = TrajectoryBuffer(8)
+        buf.put(traj(0))
+        got: list = []
+
+        def consume():
+            got.extend(buf.get_batch(2))
+
+        th = threading.Thread(target=consume)
+        th.start()
+        time.sleep(0.05)
+        assert not got  # still blocked on the second group
+        buf.put(traj(1))
+        th.join(timeout=5)
+        assert [g.batch_index for g in got] == [0, 1]
+
+    def test_timeout_returns_partial(self):
+        buf = TrajectoryBuffer(8)
+        buf.put(traj(0))
+        got = buf.get_batch(3, timeout=0.05)
+        assert len(got) == 1
+
+    def test_invalid_construction(self):
+        with pytest.raises(ValueError):
+            TrajectoryBuffer(0)
+        with pytest.raises(ValueError):
+            TrajectoryBuffer(4, high_watermark=5)
+        with pytest.raises(ValueError):
+            TrajectoryBuffer(4, high_watermark=2, low_watermark=3)
+
+
+class TestWatermarksAndBackpressure:
+    def test_put_blocks_at_high_until_low(self):
+        buf = TrajectoryBuffer(4, high_watermark=4, low_watermark=2)
+        for i in range(4):
+            buf.put(traj(i))
+        state = {"done": False}
+
+        def produce():
+            buf.put(traj(4))
+            state["done"] = True
+
+        th = threading.Thread(target=produce)
+        th.start()
+        time.sleep(0.05)
+        assert not state["done"]  # gated at the high watermark
+        assert buf.backpressure_waits == 1
+        # one get (occupancy 3) is NOT enough — hysteresis holds to low=2
+        buf.get_batch(1)
+        time.sleep(0.05)
+        assert not state["done"]
+        buf.get_batch(1)  # occupancy 2 == low watermark: gate opens
+        th.join(timeout=5)
+        assert state["done"]
+        assert len(buf) == 3
+
+    def test_nonblocking_put_drops_oldest_at_capacity(self):
+        buf = TrajectoryBuffer(3)
+        for i in range(3):
+            buf.put(traj(i))
+        buf.put(traj(3), block=False)
+        assert buf.dropped_capacity == 1
+        got = buf.get_batch(3)
+        # FIFO eviction: the OLDEST group made room
+        assert [g.batch_index for g in got] == [1, 2, 3]
+
+    def test_nonblocking_put_respects_low_high_watermark(self):
+        """With high_watermark < capacity, a gated non-blocking put must
+        evict down to the WATERMARK, not sail on to capacity — the
+        backpressure bound holds for unwilling-to-wait producers too."""
+        buf = TrajectoryBuffer(10, high_watermark=4, low_watermark=2)
+        for i in range(4):
+            buf.put(traj(i), block=False)  # reaches high: gate closes
+        buf.put(traj(4), block=False)
+        assert len(buf) == 4  # never grew past the watermark
+        assert buf.dropped_capacity == 1
+        got = buf.get_batch(4)
+        assert [g.batch_index for g in got] == [1, 2, 3, 4]
+
+    def test_close_wakes_blocked_producer(self):
+        buf = TrajectoryBuffer(2)
+        buf.put(traj(0))
+        buf.put(traj(1))
+        err: list = []
+
+        def produce():
+            try:
+                buf.put(traj(2))
+            except BufferClosed as e:
+                err.append(e)
+
+        th = threading.Thread(target=produce)
+        th.start()
+        time.sleep(0.05)
+        buf.close()
+        th.join(timeout=5)
+        assert err, "blocked put must raise BufferClosed on close"
+
+    def test_occupancy_gauge_tracks_mutations(self):
+        buf = TrajectoryBuffer(4)
+        buf.put(traj(0))
+        assert telemetry.metrics_snapshot()["rollout/buffer_occupancy"] == 1.0
+        buf.put(traj(1))
+        buf.get_batch(2)
+        assert telemetry.metrics_snapshot()["rollout/buffer_occupancy"] == 0.0
+
+
+class TestStalenessEviction:
+    def test_evicts_only_beyond_bound_keeps_order(self):
+        buf = TrajectoryBuffer(8)
+        for i, v in enumerate([0, 1, 2, 3]):
+            buf.put(traj(i, version=v))
+        # learner at v4, bound 2: versions 0 and 1 (lag 4, 3) go
+        dropped = buf.evict_stale(learner_version=4, max_staleness=2)
+        assert dropped == 2
+        assert buf.dropped_stale == 2
+        got = buf.get_batch(2)
+        assert [g.produced_version for g in got] == [2, 3]
+
+    def test_eviction_opens_backpressure_gate(self):
+        buf = TrajectoryBuffer(3, high_watermark=3, low_watermark=1)
+        for i in range(3):
+            buf.put(traj(i, version=0))
+        state = {"done": False}
+
+        def produce():
+            buf.put(traj(3, version=5))
+            state["done"] = True
+
+        th = threading.Thread(target=produce)
+        th.start()
+        time.sleep(0.05)
+        assert not state["done"]
+        buf.evict_stale(learner_version=5, max_staleness=2)  # drops all 3
+        th.join(timeout=5)
+        assert state["done"]
+
+    def test_counter_telemetry(self):
+        buf = TrajectoryBuffer(8)
+        buf.put(traj(0, version=0))
+        buf.evict_stale(learner_version=9, max_staleness=1)
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/dropped_stale"] == 1.0
+
+
+class TestDropAccounting:
+    def test_nothing_vanishes_silently(self):
+        """Conservation: total_put == total_got + drops + occupancy, under
+        interleaved puts/gets/evictions."""
+        buf = TrajectoryBuffer(6, high_watermark=6, low_watermark=3)
+        rng = np.random.default_rng(0)
+        put = 0
+        for round_ in range(20):
+            for _ in range(int(rng.integers(1, 4))):
+                buf.put(traj(put, version=put), block=False)
+                put += 1
+            if round_ % 3 == 0:
+                buf.evict_stale(put, max_staleness=2)
+            buf.get_batch(int(rng.integers(1, 3)), timeout=0.01)
+        s = buf.stats()
+        assert s["total_put"] == put
+        assert (
+            s["total_put"]
+            == s["total_got"] + s["dropped_stale"] + s["dropped_capacity"]
+            + s["occupancy"]
+        ), s
+
+    def test_state_dict_roundtrip(self):
+        buf = TrajectoryBuffer(8)
+        for i in range(3):
+            buf.put(traj(i, version=i))
+        buf.get_batch(1)
+        state = buf.state_dict()
+        buf2 = TrajectoryBuffer(8)
+        buf2.load_state(state)
+        assert len(buf2) == 2
+        assert buf2.total_put == 3 and buf2.total_got == 1
+        got = buf2.get_batch(2)
+        assert [g.batch_index for g in got] == [1, 2]
+        np.testing.assert_array_equal(got[0].tokens, traj(1).tokens)
+
+
+class TestVersionTags:
+    def test_tags_follow_swap_semantics(self):
+        """A swap recorded at step s lands on the forward of step s: the
+        token at position s was sampled pre-swap, positions > s post-swap
+        (tests/test_inflight_updates.py pin, generalized to K swaps)."""
+        tags = version_tags_for_round(2, 8, 3, [(0, 4), (4, 6)])
+        np.testing.assert_array_equal(
+            tags[0], [3, 4, 4, 4, 4, 6, 6, 6]
+        )
+        assert tags.shape == (2, 8)
+
+    @staticmethod
+    def _tagged(tags, lengths):
+        # fresh trajectory per case: the version bounds cache once (tags
+        # are immutable after construction by contract)
+        t = traj(0, version=5)
+        t.version_tags = np.asarray(tags, np.int32)
+        t.lengths = np.asarray(lengths, np.int32)
+        return t
+
+    def test_min_version_respects_lengths(self):
+        tags = [[5, 5, 3, 3], [5, 5, 5, 5]]
+        # row 0's 3s are padding at lengths [2, 4]
+        assert self._tagged(tags, [2, 4]).min_version == 5
+        # at lengths [3, 4] one 3 is a real token
+        assert self._tagged(tags, [3, 4]).min_version == 3
+
+    def test_max_version_respects_lengths(self):
+        tags = [[5, 5, 9, 9], [5, 5, 5, 5]]
+        assert self._tagged(tags, [2, 4]).max_version == 5
+        assert self._tagged(tags, [3, 4]).max_version == 9
+
+    def test_version_bounds_computed_once(self):
+        t = self._tagged([[5, 5, 3, 3]], [4])
+        assert (t.min_version, t.max_version) == (3, 5)
+        # cached: later mutation (contract violation) is NOT re-read
+        t.version_tags = np.zeros((1, 4), np.int32)
+        assert t.min_version == 3
+
+    def test_round_trip_through_candidates(self):
+        cand = {
+            "answers": [["x", "y"], ["u", "v"]],
+            "problem": [["p0", "p0"], ["p1", "p1"]],
+            "solution": [["s0", "s0"], ["s1", "s1"]],
+            "token_lengths": [[3, 2], [1, 4]],
+            "answer_tokens": [np.ones((2, 4), np.int32),
+                              2 * np.ones((2, 4), np.int32)],
+            "behavior_logps": [np.zeros((2, 4), np.float32)] * 2,
+            "gen_lengths": [np.asarray([3, 2]), np.asarray([1, 4])],
+        }
+        trajs = round_to_trajectories(
+            cand, base_version=7, swap_events=[(1, 8)], episode=2,
+            batch_index=5,
+        )
+        assert len(trajs) == 2
+        assert trajs[0].episode == 2 and trajs[0].batch_index == 5
+        np.testing.assert_array_equal(
+            trajs[0].version_tags[0], [7, 7, 8, 8]
+        )
+        back = trajectories_to_candidates(trajs, group_weights=[1.0, 0.5])
+        assert back["answers"] == cand["answers"]
+        assert back["problem"] == cand["problem"]
+        assert back["group_weights"] == [1.0, 0.5]
+        np.testing.assert_array_equal(
+            back["version_tags"][1], trajs[1].version_tags
+        )
+
+
+class TestStalenessPolicy:
+    def test_drop_mode(self):
+        pol = StalenessPolicy(2, mode="drop")
+        groups = [traj(i, version=v) for i, v in enumerate([5, 3, 1])]
+        kept, weights = pol.admit(groups, learner_version=5)
+        # lags 0, 2, 4 → the lag-4 group drops
+        assert [g.produced_version for g in kept] == [5, 3]
+        assert weights == [1.0, 1.0]
+        assert pol.dropped == 1 and pol.admitted == 2
+        assert telemetry.metrics_snapshot()["rollout/dropped_stale"] == 1.0
+
+    def test_downweight_mode(self):
+        pol = StalenessPolicy(1, mode="downweight", downweight=0.5)
+        groups = [traj(i, version=v) for i, v in enumerate([5, 4, 2])]
+        kept, weights = pol.admit(groups, learner_version=5)
+        # lags 0, 1, 3: within bound → 1.0; beyond → 0.5^(3-1)
+        assert len(kept) == 3
+        assert weights == [1.0, 1.0, 0.25]
+        assert pol.dropped == 0
+
+    def test_drop_mode_admits_mixed_version_group_with_fresh_tokens(self):
+        """A trajectory spanning in-flight swaps (stale head, fresh tail)
+        must ADMIT in drop mode — the AIPO per-token lag mask trims its
+        stale tokens inside the objective; only groups with NO token in
+        the bound drop. Weight stays 1.0 (drop mode never fades)."""
+        pol = StalenessPolicy(2, mode="drop")
+        mixed = traj(0, version=0)
+        mixed.version_tags = np.asarray(
+            [[0, 0, 5, 5], [0, 0, 5, 5]], np.int32
+        )  # head v0 (lag 5 > K), tail v5 (lag 0)
+        all_stale = traj(1, version=0)
+        kept, weights = pol.admit([mixed, all_stale], learner_version=5)
+        assert kept == [mixed]
+        assert weights == [1.0]
+        assert pol.dropped == 1
+        # the histogram still reports the admitted group's STALEST lag
+        assert telemetry.metrics_snapshot()["rollout/staleness_max"] == 5.0
+
+    def test_evict_stale_keeps_mixed_version_groups(self):
+        """Buffer eviction uses the same freshest-token predicate as
+        drop-mode admission — it must never evict a group admission would
+        have trained."""
+        buf = TrajectoryBuffer(8)
+        mixed = traj(0, version=0)
+        mixed.version_tags = np.asarray(
+            [[0, 5, 5, 5], [0, 5, 5, 5]], np.int32
+        )
+        buf.put(mixed)
+        buf.put(traj(1, version=0))  # uniformly stale
+        assert buf.evict_stale(learner_version=5, max_staleness=2) == 1
+        [survivor] = buf.get_batch(1)
+        assert survivor is mixed
+
+    def test_staleness_histogram(self):
+        pol = StalenessPolicy(3)
+        pol.admit([traj(0, version=3), traj(1, version=2)], learner_version=4)
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/staleness_count"] == 2
+        assert snap["rollout/staleness_max"] == 2.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            StalenessPolicy(-1)
+        with pytest.raises(ValueError):
+            StalenessPolicy(1, mode="discard")
+        with pytest.raises(ValueError):
+            StalenessPolicy(1, downweight=0.0)
+
+
+class TestRolloutService:
+    def _batches(self, n):
+        for i in range(n):
+            yield 0, i, {"problem": [f"p{i}"], "solution": [f"s{i}"]}
+
+    def test_produces_all_then_closes(self):
+        buf = TrajectoryBuffer(16)
+        service = RolloutService(
+            lambda e, bi, b: [traj(bi)], buf, self._batches(5)
+        ).start()
+        got = []
+        while True:
+            batch = buf.get_batch(2)
+            if not batch:
+                break
+            got.extend(batch)
+        assert [g.batch_index for g in got] == [0, 1, 2, 3, 4]
+        assert service.done and service.error is None
+        assert service.cursor == (0, 5)
+        service.raise_if_failed()
+
+    def test_error_closes_buffer_and_reraises(self):
+        buf = TrajectoryBuffer(4)
+
+        def boom(e, bi, b):
+            raise RuntimeError("engine died")
+
+        service = RolloutService(boom, buf, self._batches(3)).start()
+        assert buf.get_batch(2, timeout=5) == []  # closed by the failure
+        # the ORIGINAL exception type re-raises (the trainer's
+        # EngineHangError handling depends on it)
+        with pytest.raises(RuntimeError, match="engine died"):
+            service.raise_if_failed()
+
+    def test_pause_excludes_producer_from_engine(self):
+        """pause() returns only when no produce call is in flight, and no
+        new round starts until resume() — the eval exclusivity contract."""
+        buf = TrajectoryBuffer(64)
+        in_produce = threading.Event()
+        release = threading.Event()
+        produced = []
+
+        def produce(e, bi, b):
+            in_produce.set()
+            release.wait(timeout=10)
+            produced.append(bi)
+            return [traj(bi)]
+
+        service = RolloutService(produce, buf, self._batches(4)).start()
+        assert in_produce.wait(timeout=5)  # round 0 running
+        t0 = time.monotonic()
+        state = {"paused_at": None}
+
+        def do_pause():
+            service.pause()
+            state["paused_at"] = time.monotonic()
+
+        th = threading.Thread(target=do_pause)
+        th.start()
+        time.sleep(0.05)
+        assert state["paused_at"] is None  # blocked on the round in flight
+        release.set()
+        th.join(timeout=5)
+        assert state["paused_at"] is not None
+        n_after_pause = len(produced)
+        time.sleep(0.15)  # no new round may start while paused
+        assert len(produced) == n_after_pause
+        service.resume()
+        while len(buf.get_batch(1, timeout=1.0)) > 0 and not service.done:
+            pass
+        service.stop()
+        assert time.monotonic() - t0 < 30
+
+    def test_stop_while_backpressured(self):
+        buf = TrajectoryBuffer(1)
+        service = RolloutService(
+            lambda e, bi, b: [traj(bi)], buf, self._batches(10)
+        ).start()
+        time.sleep(0.1)  # producer fills the 1-slot buffer and blocks
+        service.stop()
+        for _ in range(20):
+            if service.done:
+                break
+            time.sleep(0.05)
+        assert service.done
+        service.raise_if_failed()  # a backpressure stop is clean

@@ -1,0 +1,362 @@
+"""Rollout-regime tests (``--rollout_mode``): the sync byte-identity pin
+against the pre-rollout-service trainer, the --async_rollout alias, the
+config-derived staleness detector, the fully-decoupled async loop (buffer +
+staleness telemetry + in-flight swaps), and buffer-state resume.
+
+The GOLDEN constants were captured from the pre-PR trainer (commit f01c394,
+"grid-collapsed paged decode") on the CPU backend with the exact
+configuration ``_run_tiny`` builds: the sync mode of the refactored trainer
+must reproduce every loss float and the final adapter checksum EXACTLY —
+rollout_mode="sync" is byte-identical to the old loop by contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.config import TrainConfig
+from distrl_llm_tpu.engine import GenerationEngine
+from distrl_llm_tpu.metrics import MemorySink
+from distrl_llm_tpu.models import TINY, init_params
+from distrl_llm_tpu.models.lora import lora_scale
+from distrl_llm_tpu.tokenizer import CharTokenizer
+from distrl_llm_tpu.trainer import StaleWeightsError, Trainer
+from tests.test_trainer import make_trainer
+
+# captured at pre-PR HEAD (see module docstring); keys are clip_ratio
+GOLDEN_LOSSES = {
+    0.0: [8.940696716308594e-08, 1.043081283569336e-07,
+          -2.980232238769531e-07, -1.1175870895385742e-07],
+    0.2: [8.940696716308594e-08, 0.0, 1.4901161193847656e-07,
+          -2.9802322387695312e-08],
+}
+GOLDEN_CHECKSUM = {0.0: 1711.84814453125, 0.2: 1712.2213134765625}
+GOLDEN_MEAN_BEHAVIOR_LOGPROB = [
+    -5.509244283040364, -5.527770360310872, -5.529414585658482,
+    -5.514086088387972,
+]
+
+
+def dense_reward(completions, solutions):
+    return np.asarray(
+        [(0.0, 0.1 + (len(c) % 5) / 10.0) for c in completions],
+        np.float32,
+    )
+
+
+def _run_tiny(**cfg_kw):
+    """The exact configuration the golden constants were captured with;
+    cfg_kw overrides select the regime under test."""
+    defaults = dict(
+        model="tiny", episodes=2, batch_size=4, num_candidates=4, topk=4,
+        train_batch_size=4, max_prompt_tokens=16, max_new_tokens=24,
+        number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
+        eval_every=0, save_every=0, metrics_backend="null", lr=1e-2,
+        max_lora_rank=4, lora_alpha=8, learner="grpo",
+    )
+    defaults.update(cfg_kw)
+    cfg = TrainConfig(**defaults)
+    tok = CharTokenizer()
+    problems = [f"q {c}" for c in "abcdefgh"]
+    train = {"problem": problems,
+             "solution": [p.strip()[-1].upper() for p in problems]}
+    test = {k: v[:4] for k, v in train.items()}
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    engine = GenerationEngine(
+        TINY, max_prompt_tokens=cfg.max_prompt_tokens,
+        max_new_tokens=cfg.max_new_tokens,
+        eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+        cache_dtype=jnp.float32,
+        lora_scale=lora_scale(cfg.max_lora_rank, cfg.lora_alpha),
+        capture_logprobs=cfg.clip_ratio > 0.0, decode_chunk=4,
+    )
+    sink = MemorySink()
+    trainer = Trainer(
+        train, test, dense_reward, cfg,
+        tokenizer=tok, engine=engine, base_params=params, model_cfg=TINY,
+        sink=sink,
+    )
+    trainer.train()
+    return trainer, sink, engine
+
+
+def _checksum(tree) -> float:
+    return float(sum(
+        np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(tree)
+    ))
+
+
+class TestSyncByteIdentity:
+    """Acceptance pin: ``--rollout_mode sync`` produces a loss sequence
+    byte-identical to the pre-PR trainer on the tiny CPU config."""
+
+    @pytest.mark.parametrize("clip", [0.0, 0.2])
+    def test_loss_sequence_and_adapter_identical_to_pre_pr(self, clip):
+        trainer, sink, _ = _run_tiny(clip_ratio=clip)
+        losses = [m["loss"] for _, m in sink.records if "loss" in m]
+        assert losses == GOLDEN_LOSSES[clip], (
+            "sync-mode loss sequence diverged from the pre-PR trainer"
+        )
+        assert _checksum(trainer.lora) == GOLDEN_CHECKSUM[clip], (
+            "sync-mode final adapter diverged from the pre-PR trainer"
+        )
+        if clip > 0.0:
+            mbl = [m["mean_behavior_logprob"]
+                   for _, m in sink.records if "loss" in m]
+            assert mbl == GOLDEN_MEAN_BEHAVIOR_LOGPROB
+
+    def test_sync_records_carry_regime_fields(self):
+        trainer, sink, _ = _run_tiny()
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert all(m["rollout_mode"] == "sync" for m in recs)
+        assert all(m["max_staleness"] == 0 for m in recs)
+        assert all(m["rollout_dropped_stale"] == 0 for m in recs)
+
+
+class TestModeAliasing:
+    def test_async_rollout_flag_selects_pipelined(self):
+        cfg = TrainConfig(model="t", async_rollout=True)
+        assert cfg.rollout_mode == "pipelined"
+        assert cfg.async_rollout is True
+        assert cfg.allowed_weight_lag == 1
+
+    def test_pipelined_reads_back_as_async_rollout(self):
+        # existing call sites branch on config.async_rollout — both
+        # overlapped modes must satisfy them
+        assert TrainConfig(model="t", rollout_mode="pipelined").async_rollout
+        assert TrainConfig(
+            model="t", rollout_mode="async", clip_ratio=0.2
+        ).async_rollout
+        assert not TrainConfig(model="t").async_rollout
+
+    def test_async_requires_clip_and_staleness(self):
+        with pytest.raises(ValueError, match="clip_ratio"):
+            TrainConfig(model="t", rollout_mode="async")
+        with pytest.raises(ValueError, match="max_staleness"):
+            TrainConfig(model="t", rollout_mode="async", clip_ratio=0.2,
+                        max_staleness=0)
+
+    def test_allowed_lag_derivation(self):
+        assert TrainConfig(model="t").allowed_weight_lag == 0
+        assert TrainConfig(
+            model="t", rollout_mode="pipelined"
+        ).allowed_weight_lag == 1
+        assert TrainConfig(
+            model="t", rollout_mode="async", clip_ratio=0.2, max_staleness=5
+        ).allowed_weight_lag == 5
+
+
+class TestStaleDetectorMessage:
+    def test_names_mode_and_bound(self):
+        trainer = make_trainer()
+        trainer.weight_version = 5
+        trainer._rollout_weight_version = 4
+        with pytest.raises(StaleWeightsError, match="rollout_mode='sync'"):
+            trainer._generate_round(
+                {"problem": ["q a"], "solution": ["A"]},
+                trainer.config.train_sampling(),
+            )
+        with pytest.raises(StaleWeightsError, match="lag <= 0"):
+            trainer._generate_round(
+                {"problem": ["q a"], "solution": ["A"]},
+                trainer.config.train_sampling(),
+            )
+
+
+class TestAsyncMode:
+    @pytest.fixture(autouse=True)
+    def clean_telemetry(self):
+        telemetry.reset()
+        telemetry.configure(enabled=False)
+        yield
+        telemetry.reset()
+        telemetry.configure(enabled=False)
+
+    def test_multi_episode_run_with_inflight_swaps(self):
+        """The acceptance run: multi-episode async training completes with
+        finite losses, the trajectory stream is version-tagged, buffer and
+        staleness telemetry are nonzero, and with inflight pushes enabled
+        the engine consumes in-flight swaps whose recorded versions match
+        learner weight versions."""
+        trainer, sink, engine = _run_tiny(
+            episodes=4, num_candidates=2, topk=2,
+            rollout_mode="async", max_staleness=3, clip_ratio=0.2,
+            inflight_weight_updates=True,
+            # capacity floor (2× batch) backpressures the producer after two
+            # rounds, forcing rounds to interleave with updates — the regime
+            # where in-flight swaps actually happen
+            rollout_buffer_groups=1,
+        )
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and all(np.isfinite(m["loss"]) for m in recs)
+        assert all(m["rollout_mode"] == "async" for m in recs)
+        assert all(m["max_staleness"] == 3 for m in recs)
+        stats = trainer._rollout_buffer.stats()
+        assert stats["total_put"] >= 8  # 4 episodes × 2 batches
+        assert (
+            stats["total_put"]
+            == stats["total_got"] + stats["dropped_stale"]
+            + stats["dropped_capacity"] + stats["occupancy"]
+        ), stats
+        # staleness histogram reached the sink on at least one step
+        assert any(
+            k.startswith("rollout/staleness") for m in recs for k in m
+        ), "no staleness telemetry in the train records"
+        assert any(
+            "rollout/buffer_occupancy" in m for m in recs
+        ), "no occupancy telemetry in the train records"
+        # in-flight swaps: recorded versions are real learner versions
+        assert len(engine.last_swap_steps) >= 2, (
+            f"expected >=2 in-flight swaps, got {engine.last_swap_steps}"
+        )
+        assert len(engine.last_swap_versions) == len(engine.last_swap_steps)
+        assert all(
+            v is not None and 0 < v <= trainer.weight_version
+            for v in engine.last_swap_versions
+        ), engine.last_swap_versions
+
+    def test_async_processes_same_batch_stream_when_nothing_drops(self):
+        """With a staleness bound large enough that nothing drops, async
+        consumes exactly the batches sync would have produced."""
+        trainer, sink, _ = _run_tiny(
+            num_candidates=2, topk=2,
+            rollout_mode="async", max_staleness=100, clip_ratio=0.2,
+        )
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert len(recs) == 4  # 2 episodes × (8 problems / batch 4)
+        assert trainer._rollout_buffer.stats()["dropped_stale"] == 0
+        assert all(m["rollout_dropped_stale"] == 0 for m in recs)
+
+    def test_downweight_policy_trains_stale_groups_instead_of_dropping(self):
+        """Regression (review finding): with --staleness_policy downweight
+        the trainer must NOT pre-evict beyond-K groups from the buffer —
+        eviction would silently turn downweight into drop. Every produced
+        group trains (at reduced weight when stale); nothing is dropped."""
+        trainer, sink, _ = _run_tiny(
+            num_candidates=2, topk=2,
+            rollout_mode="async", max_staleness=1, clip_ratio=0.2,
+            staleness_policy="downweight",
+        )
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and all(np.isfinite(m["loss"]) for m in recs)
+        stats = trainer._rollout_buffer.stats()
+        policy = trainer._staleness_policy
+        assert stats["dropped_stale"] == 0, stats
+        assert policy.dropped == 0
+        # every group handed to the learner was admitted (weighted, maybe)
+        assert policy.admitted == stats["total_got"]
+
+    def test_version_lag_masking_drops_stale_tokens_from_loss(self):
+        """The AIPO objective's version-lag mask: a microbatch whose tokens
+        all exceed max_staleness contributes zero gradient signal."""
+        from distrl_llm_tpu.learner.losses import grpo_aipo_loss
+
+        logp = jnp.asarray([[-1.0, -1.5], [-2.0, -0.5]])
+        behav = jnp.asarray([[-1.2, -1.0], [-1.0, -1.0]])
+        mask = jnp.ones((2, 2))
+        adv = jnp.asarray([1.0, -1.0])
+        fresh = grpo_aipo_loss(logp, behav, mask, adv)
+        assert np.isfinite(float(fresh)) and float(fresh) != 0.0
+        # all tokens beyond the bound → empty mask → zero loss
+        lag = jnp.full((2, 2), 7.0)
+        stale = grpo_aipo_loss(
+            logp, behav, mask, adv, version_lag=lag, max_staleness=3
+        )
+        assert float(stale) == 0.0
+        # mixed-version trajectory: only the fresh column contributes
+        lag2 = jnp.asarray([[0.0, 7.0], [0.0, 7.0]])
+        mixed = grpo_aipo_loss(
+            logp, behav, mask, adv, version_lag=lag2, max_staleness=3
+        )
+        fresh_only = grpo_aipo_loss(
+            logp[:, :1], behav[:, :1], mask[:, :1], adv
+        )
+        assert float(mixed) == pytest.approx(float(fresh_only))
+
+    def test_aipo_truncates_ratio(self):
+        from distrl_llm_tpu.learner.losses import grpo_aipo_loss
+
+        logp = jnp.asarray([[3.0]])  # exp(3-0)=20 — way past the cap
+        behav = jnp.asarray([[0.0]])
+        mask = jnp.ones((1, 1))
+        adv = jnp.asarray([1.0])
+        loss = grpo_aipo_loss(logp, behav, mask, adv, is_cap=2.0)
+        assert float(loss) == pytest.approx(-2.0)
+
+    def test_buffer_state_survives_resume(self, tmp_path):
+        """The checkpoint sidecar round-trip through the trainer: queued
+        trajectories and the producer cursor reload on resume."""
+        from distrl_llm_tpu.checkpoint import (
+            load_rollout_state, save_rollout_state,
+        )
+        from distrl_llm_tpu.rollout import Trajectory, TrajectoryBuffer
+
+        trainer, _, _ = _run_tiny(
+            num_candidates=2, topk=2,
+            rollout_mode="async", max_staleness=100, clip_ratio=0.2,
+            checkpoint_dir=str(tmp_path / "ckpt"), save_every=2,
+        )
+        step = trainer.total_batch_steps
+        # simulate a crash that left data in flight: overwrite the final
+        # sidecar with a non-empty buffer + mid-episode cursor
+        buf = TrajectoryBuffer(8)
+        buf.put(Trajectory(
+            problem="carried", solution="S", answers=["a", "b"],
+            token_lengths=[2, 2], produced_version=step,
+        ))
+        save_rollout_state(str(tmp_path / "ckpt"), step, {
+            "buffer": buf.state_dict(), "cursor": (1, 1),
+        })
+        assert load_rollout_state(str(tmp_path / "ckpt"), step) is not None
+
+        cfg2 = dict(
+            model="tiny", episodes=2, batch_size=4, num_candidates=2, topk=2,
+            train_batch_size=4, max_prompt_tokens=16, max_new_tokens=24,
+            number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
+            eval_every=0, save_every=0, metrics_backend="null", lr=1e-2,
+            max_lora_rank=4, lora_alpha=8, learner="grpo",
+            rollout_mode="async", max_staleness=100, clip_ratio=0.2,
+            checkpoint_dir=str(tmp_path / "ckpt"), resume=True,
+        )
+        cfg2 = TrainConfig(**cfg2)
+        tok = CharTokenizer()
+        problems = [f"q {c}" for c in "abcdefgh"]
+        train = {"problem": problems,
+                 "solution": [p.strip()[-1].upper() for p in problems]}
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=24,
+            eos_token_ids=[tok.eos_token_id], pad_token_id=tok.pad_token_id,
+            cache_dtype=jnp.float32, lora_scale=lora_scale(4, 8),
+            capture_logprobs=True, decode_chunk=4,
+        )
+        resumed = Trainer(
+            train, {k: v[:4] for k, v in train.items()}, dense_reward, cfg2,
+            tokenizer=tok, engine=engine,
+            base_params=init_params(jax.random.PRNGKey(0), TINY),
+            model_cfg=TINY, sink=MemorySink(),
+        )
+        assert resumed.total_batch_steps == step
+        state = resumed._resume_rollout_state
+        assert state is not None
+        assert state["cursor"] == (1, 1)
+        restored = TrajectoryBuffer(8)
+        restored.load_state(state["buffer"])
+        [t] = restored.get_batch(1)
+        assert t.problem == "carried"
+
+    def test_corrupt_sidecar_degrades_to_fresh(self, tmp_path):
+        from distrl_llm_tpu.checkpoint import (
+            load_rollout_state, rollout_state_path,
+        )
+
+        path = rollout_state_path(str(tmp_path), 3)
+        import os
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"not a pickle")
+        assert load_rollout_state(str(tmp_path), 3) is None
+        assert load_rollout_state(str(tmp_path), 99) is None

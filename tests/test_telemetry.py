@@ -251,6 +251,63 @@ class TestRegistry:
         (ev,) = events()
         assert ev["ph"] == "C" and ev["args"] == {"occupancy": 0.75}
 
+    def test_hist_trace_sample_emits_counter_event(self):
+        """hist_observe(trace_sample=True): sink histogram AND (while
+        tracing) a per-observation Chrome counter event — the staleness
+        series' contract (rollout/staleness renders as a Perfetto track and
+        trace_report summarizes it from the file alone)."""
+        telemetry.hist_observe("rollout/staleness", 1.0, trace_sample=True)
+        assert events() == []  # disabled: no trace event
+        telemetry.configure(enabled=True)
+        telemetry.hist_observe("rollout/staleness", 2.0, trace_sample=True)
+        (ev,) = events()
+        assert ev["ph"] == "C" and ev["args"] == {"staleness": 2.0}
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/staleness_count"] == 2
+
+    def test_rollout_series_schema(self):
+        """Schema pin for the async-rollout registry names (ISSUE 4): the
+        buffer's occupancy gauge + backpressure/drop counters and the
+        policy's staleness histogram land in the MetricsSink snapshot under
+        exactly these names."""
+        from distrl_llm_tpu.rollout import (
+            StalenessPolicy, Trajectory, TrajectoryBuffer,
+        )
+
+        def traj(version):
+            return Trajectory(
+                problem="p", solution="s", answers=["a"], token_lengths=[1],
+                produced_version=version,
+            )
+
+        buf = TrajectoryBuffer(2, high_watermark=2, low_watermark=1)
+        buf.put(traj(0))
+        buf.put(traj(0))
+        buf.put(traj(5), block=False)  # capacity drop
+        buf.evict_stale(learner_version=9, max_staleness=1)  # stale drops
+        kept, _ = StalenessPolicy(2).admit([traj(9), traj(1)], 9)
+        assert len(kept) == 1
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/buffer_occupancy"] == 0.0
+        assert snap["rollout/dropped_capacity"] == 1.0
+        assert snap["rollout/dropped_stale"] == 3.0  # 2 evicted + 1 admission
+        assert snap["rollout/staleness_count"] == 1.0
+
+    def test_backpressure_counter_schema(self):
+        import threading
+
+        from distrl_llm_tpu.rollout import Trajectory, TrajectoryBuffer
+
+        buf = TrajectoryBuffer(1)
+        t = Trajectory(problem="p", solution="s", answers=["a"],
+                       token_lengths=[1])
+        buf.put(t)
+        th = threading.Thread(target=lambda: buf.put(t, timeout=0.05))
+        th.start()
+        th.join(timeout=5)
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/backpressure_waits"] == 1.0
+
 
 class TestMfuMath:
     def test_flops_per_token_hand_computed_tiny(self):

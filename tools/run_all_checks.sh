@@ -35,6 +35,11 @@ stage "autotune_smoke" env JAX_PLATFORMS=cpu \
 # at the r5 geometry — catches grid-count regressions without silicon
 stage "paged_blocked_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/paged_blocked_smoke.py
+# async-rollout gate (ISSUE 4): sync/pipelined/async tiny runs through the
+# real engine — finite losses, buffer/staleness telemetry in the trace, and
+# the trace_report rollout section
+stage "rollout_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/rollout_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one
@@ -48,7 +53,8 @@ fi
 
 stage "suite_trainer" timeout 600 python -m pytest -q \
   tests/test_trainer.py tests/test_async_rollout.py tests/test_clip_objective.py \
-  tests/test_failure_and_resume.py tests/test_role_separation.py
+  tests/test_failure_and_resume.py tests/test_role_separation.py \
+  tests/test_rollout_buffer.py tests/test_rollout_modes.py
 stage "suite_engines_1" timeout 600 python -m pytest -q \
   tests/test_engine.py tests/test_paged.py
 stage "suite_engines_2" timeout 600 python -m pytest -q \
@@ -81,7 +87,8 @@ stage "suite_slow_sched" timeout 1200 python -m pytest -q -m slow \
 stage "suite_slow_learner" timeout 1200 python -m pytest -q -m slow \
   tests/test_train_step.py tests/test_losses.py tests/test_clip_objective.py \
   tests/test_full_finetune.py tests/test_quant.py tests/test_trainer.py \
-  tests/test_async_rollout.py tests/test_failure_and_resume.py
+  tests/test_async_rollout.py tests/test_failure_and_resume.py \
+  tests/test_rollout_buffer.py tests/test_rollout_modes.py
 stage "suite_slow_ops" timeout 1200 python -m pytest -q -m slow \
   tests/test_ring_attention.py tests/test_ulysses.py tests/test_sampling.py \
   tests/test_long_context.py tests/test_paged_int8_kernel.py \

@@ -35,6 +35,95 @@ def load_trace(path: str) -> tuple[list[dict], dict]:
     return doc.get("traceEvents", []), doc.get("metadata", {}) or {}
 
 
+def _union_us(intervals: list[tuple[int, int]]) -> int:
+    """Total µs covered by a set of [start, end) intervals."""
+    total = 0
+    end = None
+    for s, e in sorted(intervals):
+        if end is None or s > end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def _intersect_us(a: list[tuple[int, int]], b: list[tuple[int, int]]) -> int:
+    """Total µs where the unions of two interval sets overlap."""
+    a, b = sorted(a), sorted(b)
+    i = j = total = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def rollout_section(events: list[dict],
+                    spans: dict[tuple[int, str], list[dict]]) -> list[str]:
+    """Async-rollout diagnosis from one trace: buffer occupancy over time
+    (the ``rollout/buffer_occupancy`` counter track), a staleness-histogram
+    summary (per-sample ``rollout/staleness`` counter events), and the
+    producer-vs-learner overlap fraction — how much of the learner's update
+    time a ``rollout/produce`` span was simultaneously active, the number
+    that says whether decoupling actually bought concurrency. Empty when
+    the trace has no rollout signals (sync/pipelined runs)."""
+    occ: list[float] = []
+    stale: list[float] = []
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        args = ev.get("args", {})
+        if ev.get("name") == "rollout/buffer_occupancy":
+            occ.append(float(args.get("buffer_occupancy", 0)))
+        elif ev.get("name") == "rollout/staleness":
+            stale.append(float(args.get("staleness", 0)))
+    produce = [e for (_, n), evs in spans.items() if n == "rollout/produce"
+               for e in evs]
+    updates = [e for (_, n), evs in spans.items() if n == "driver/update"
+               for e in evs]
+    if not occ and not stale and not produce:
+        return []
+    lines = ["rollout:"]
+    if occ:
+        lines.append(
+            f"  buffer occupancy:   min {min(occ):.0f} / mean "
+            f"{sum(occ) / len(occ):.1f} / max {max(occ):.0f} groups "
+            f"({len(occ)} samples)"
+        )
+    if stale:
+        s = sorted(stale)
+        n = len(s)
+        lines.append(
+            f"  staleness (steps):  mean {sum(s) / n:.2f} / p50 "
+            f"{s[n // 2]:.0f} / p90 {s[min(int(n * 0.9), n - 1)]:.0f} / "
+            f"max {s[-1]:.0f} ({n} admitted groups)"
+        )
+    if produce and updates:
+        p_iv = [(e["ts"], e["ts"] + e.get("dur", 0)) for e in produce]
+        u_iv = [(e["ts"], e["ts"] + e.get("dur", 0)) for e in updates]
+        upd_us = _union_us(u_iv)
+        overlap = _intersect_us(p_iv, u_iv)
+        lines.append(
+            f"  producer overlap:   {100 * overlap / max(upd_us, 1):.1f}% "
+            f"of learner update time had generation in flight "
+            f"({len(produce)} rounds / {len(updates)} updates)"
+        )
+    elif produce:
+        lines.append(
+            f"  producer rounds:    {len(produce)} (no driver/update spans "
+            "in window)"
+        )
+    lines.append("")
+    return lines
+
+
 def build_report(events: list[dict], metadata: dict,
                  peak_flops: float | None = None) -> str:
     tracks: dict[int, str] = {}
@@ -85,6 +174,8 @@ def build_report(events: list[dict], metadata: dict,
         if toks and us:
             return toks * 1e6 / us
         return None
+
+    lines.extend(rollout_section(events, spans))
 
     prefill = tok_s(("engine/prefill",))
     # NOT worker/generate or engine/remote_round: those wrap the engine

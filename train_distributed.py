@@ -105,11 +105,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KL(policy || frozen base) penalty coefficient (the "
                         "GRPO paper's regularizer; LoRA mode only; 0 = "
                         "reference parity)")
+    p.add_argument("--rollout_mode", type=str, default="sync",
+                   choices=["sync", "pipelined", "async"],
+                   help="rollout/learner coupling: 'sync' = reference-parity "
+                        "serialized loop; 'pipelined' = one-step overlap "
+                        "(batch t+1 generates while batch t updates); "
+                        "'async' = fully decoupled RolloutService + bounded "
+                        "trajectory buffer with --max_staleness admission "
+                        "and truncated-IS correction (requires --clip_ratio "
+                        "> 0)")
+    p.add_argument("--max_staleness", type=int, default=2,
+                   help="async staleness bound K: trajectories whose stalest "
+                        "token lags the learner by more than K optimizer "
+                        "steps are dropped (or down-weighted, "
+                        "--staleness_policy); sync/pipelined derive their "
+                        "allowed lag (0/1) from the mode")
+    p.add_argument("--staleness_policy", type=str, default="drop",
+                   choices=["drop", "downweight"],
+                   help="what happens to a pulled trajectory beyond "
+                        "--max_staleness: discard it (counted in "
+                        "rollout/dropped_stale) or train it down-weighted "
+                        "by staleness_downweight^(lag-K)")
+    p.add_argument("--rollout_buffer_groups", type=int, default=0,
+                   help="trajectory-buffer capacity in task groups for "
+                        "--rollout_mode async (0 = auto: 4x batch_size)")
     p.add_argument("--async_rollout", action="store_true",
-                   help="pipeline generation of batch t+1 with the update on "
-                        "batch t (one-step-off-policy; LlamaRL/PipelineRL-"
-                        "style overlap). Default: reference-parity "
-                        "synchronous loop")
+                   help="DEPRECATED alias for --rollout_mode pipelined "
+                        "(one-step-off-policy LlamaRL/PipelineRL-style "
+                        "overlap)")
+    p.add_argument("--workers_capture_logprobs", action="store_true",
+                   help="declare that every --rollout_workers process was "
+                        "started with worker_main --capture-logprobs, "
+                        "enabling --clip_ratio/--rollout_mode async over "
+                        "remote workers")
     p.add_argument("--inflight_weight_updates", action="store_true",
                    help="push each optimizer step's adapter into the "
                         "generation round still in flight (PipelineRL-style; "
@@ -271,6 +299,9 @@ def run_smoke(config: TrainConfig) -> None:
         max_new_tokens=config.max_new_tokens,
         eos_token_ids=[tokenizer.eos_token_id],
         pad_token_id=tokenizer.pad_token_id,
+        # behavior-logprob capture whenever the objective needs it, so
+        # --smoke composes with --clip_ratio / --rollout_mode async
+        capture_logprobs=config.clip_ratio > 0.0,
         # honor --autotune/--plan-db in the smoke path too: "--autotune off
         # skips the DB read entirely" must hold for every engine the CLI
         # builds
