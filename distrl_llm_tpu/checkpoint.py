@@ -149,9 +149,23 @@ def save_adapter_file(
 ) -> None:
     """Write a peft-style adapter directory: adapter_model.safetensors +
     adapter_config.json. LoRA pytree layout: ``lora[key]["a"]`` [L, in, r],
-    ``lora[key]["b"]`` [L, r, out] (models/lora.py)."""
+    ``lora[key]["b"]`` [L, r, out] (models/lora.py).
+
+    ATOMIC like ``save_rollout_state``: everything is written into a
+    sibling tmp dir first — the adapter doubles as the rollout weight bus
+    in reference-parity setups, and a preemption mid-write must never
+    leave a truncated safetensors (or a tensors/config mismatch) there
+    for an engine to load. Two publication paths keep the PAIR
+    consistent: the steady state (per-step saves, unchanged config)
+    replaces only the tensors file — a single atomic rename; a changed
+    config (new rank/alpha/targets) swaps the WHOLE directory, so a
+    reader can never pair new tensors with a stale config."""
+    import shutil
+    import tempfile
+
     from safetensors.numpy import save_file
 
+    path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
     tensors: dict[str, np.ndarray] = {}
     for key, mats in lora.get("layers", lora).items():
@@ -162,7 +176,6 @@ def save_adapter_file(
             # peft stores lora_A [r, in] and lora_B [out, r]
             tensors[f"{base}.lora_A.weight"] = np.ascontiguousarray(a[layer].T)
             tensors[f"{base}.lora_B.weight"] = np.ascontiguousarray(b[layer].T)
-    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
     config = {
         "peft_type": "LORA",
         "r": rank,
@@ -172,8 +185,49 @@ def save_adapter_file(
             {v.rsplit(".", 1)[-1] for v in _PEFT_NAMES.values()}
         ),
     }
-    with open(os.path.join(path, "adapter_config.json"), "w") as f:
-        json.dump(config, f, indent=2)
+    config_text = json.dumps(config, indent=2)
+    cfg_path = os.path.join(path, "adapter_config.json")
+    try:
+        with open(cfg_path) as f:
+            config_unchanged = f.read() == config_text
+    except OSError:
+        config_unchanged = False
+    # same-parent tmp dir so every rename is a same-filesystem atomic op
+    tmp = tempfile.mkdtemp(
+        prefix=os.path.basename(path) + ".tmp",
+        dir=os.path.dirname(path) or ".",
+    )
+    try:
+        save_file(tensors, os.path.join(tmp, "adapter_model.safetensors"))
+        if config_unchanged:
+            # steady state: ONE rename publishes the new tensors against
+            # the identical existing config — the pair stays consistent
+            # through any preemption point
+            os.replace(
+                os.path.join(tmp, "adapter_model.safetensors"),
+                os.path.join(path, "adapter_model.safetensors"),
+            )
+        else:
+            with open(os.path.join(tmp, "adapter_config.json"), "w") as f:
+                f.write(config_text)
+            if not os.listdir(path):
+                # first save: rename over the empty target dir (POSIX
+                # allows renaming onto an empty directory)
+                os.replace(tmp, path)
+                tmp = None
+            else:
+                # config changed over a populated dir: swap directories so
+                # no reader can observe new tensors + old config. The only
+                # exposure is a sub-syscall ENOENT window between the two
+                # renames — strictly narrower than the old cross-file
+                # mismatch window.
+                old = path + f".old{os.getpid()}"
+                os.rename(path, old)
+                os.rename(tmp, path)
+                tmp = old
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def load_adapter_file(path: str, template: Params) -> Params:

@@ -243,6 +243,33 @@ class TrainConfig:
     # workers — the driver cannot introspect worker engine flags, and a
     # worker round returning no logprobs fails the first training batch.
     workers_capture_logprobs: bool = False
+    # --- control-plane resilience (distributed/resilience.py) -------------
+    # background reconnect loop: unhealthy rollout workers are re-dialed
+    # with seeded exponential backoff and re-admitted after a PING, so
+    # capacity recovers instead of shrinking monotonically to "no healthy
+    # workers remain". The first round after a rejoin re-warms (the fresh
+    # worker process recompiles, so the cold deadline applies again).
+    worker_rejoin: bool = True
+    # transient worker-side errors (MSG_ERROR classified by exception type:
+    # OSError / Connection* / Timeout flavors) retry on the same worker
+    # this many times with seeded exponential backoff (base rpc_backoff_s)
+    # before the shard is requeued to a different worker
+    rpc_retries: int = 2
+    rpc_backoff_s: float = 0.25
+    # poison-shard quarantine: a shard that fails on this many DISTINCT
+    # workers raises ShardFailedError naming the shard instead of grinding
+    # every worker to unhealthy
+    poison_shard_k: int = 3
+    # degrade instead of raise on a quarantined shard: the round returns
+    # the surviving groups (lost prompts are dropped by the trainer with
+    # exact conservation accounting, counted in cp/degraded_groups) rather
+    # than failing the run
+    degrade_on_poison: bool = False
+    # supervised restart budget for the async RolloutService producer: a
+    # failed produce round retries in place (seeded backoff) this many
+    # times across the run before the failure closes the buffer and
+    # surfaces (rollout/producer_restarts counts the retries)
+    producer_restarts: int = 2
     # cap on concurrent candidate rows in the rollout engine (vLLM
     # max_num_seqs; the reference tunes the same capacity knob — 256
     # concurrent sequences, train_distributed.py:34). 0 = unlimited; rounds
@@ -515,6 +542,20 @@ class TrainConfig:
                 "started with --capture-logprobs AND "
                 "--workers_capture_logprobs on the driver (declares the "
                 "worker engines record behavior logprobs)"
+            )
+        if self.rpc_retries < 0:
+            raise ValueError(f"rpc_retries must be >= 0, got {self.rpc_retries}")
+        if self.rpc_backoff_s < 0:
+            raise ValueError(
+                f"rpc_backoff_s must be >= 0, got {self.rpc_backoff_s}"
+            )
+        if self.poison_shard_k < 1:
+            raise ValueError(
+                f"poison_shard_k must be >= 1, got {self.poison_shard_k}"
+            )
+        if self.producer_restarts < 0:
+            raise ValueError(
+                f"producer_restarts must be >= 0, got {self.producer_restarts}"
             )
         if self.rollout_workers and (
             self.kv_cache_quant != "none" or self.engine_impl != "dense"

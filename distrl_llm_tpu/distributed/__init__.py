@@ -10,11 +10,21 @@ from distrl_llm_tpu.distributed.remote_engine import (
     RemoteEngine,
     connect_remote_engine,
 )
+from distrl_llm_tpu.distributed.resilience import (
+    FaultInjector,
+    RetryPolicy,
+    ShardFailedError,
+    WorkerError,
+)
 
 __all__ = [
     "DriverClient",
+    "FaultInjector",
     "RemoteEngine",
+    "RetryPolicy",
+    "ShardFailedError",
     "WorkerDeadError",
+    "WorkerError",
     "WorkerServer",
     "connect_remote_engine",
     "initialize_distributed",

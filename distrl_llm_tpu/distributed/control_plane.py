@@ -15,6 +15,25 @@ This module provides those semantics natively:
   killing the run (the reference's worker death kills the run — SURVEY §5
   failure detection).
 
+On top of those semantics sits the resilience layer (resilience.py):
+
+* **Rejoin** — a background reconnect loop re-dials unhealthy workers with
+  seeded exponential backoff and re-admits them after a PING, so capacity
+  recovers instead of monotonically shrinking to ``WorkerDeadError("no
+  healthy workers remain")``. ``rejoin_epoch`` bumps on every re-admit —
+  RemoteEngine clears its warm keys off it (the rejoined worker's engine
+  process restarted, so its XLA executables are cold again).
+* **Bounded retry of worker exceptions** — an ERROR frame is classified
+  transient-vs-fatal by exception type; transient ones retry on the same
+  worker under the policy before the shard is requeued elsewhere.
+* **Poison-shard quarantine** — a shard that fails on K distinct workers
+  (or exhausts its attempt cap) raises :class:`ShardFailedError` naming the
+  shard instead of grinding every worker to unhealthy; ``allow_partial``
+  callers get ``None`` in its slot and degrade instead.
+* **Graceful preemption** — ``WorkerServer.request_shutdown()`` (wired to
+  SIGTERM by worker_main) drains the dispatch in flight — its result is
+  still delivered — and exits the serve loop cleanly.
+
 Payloads are opaque bytes; callers pickle (the reference moves pickled Python
 objects through the object store, distributed_actor.py:289–293).
 """
@@ -31,6 +50,13 @@ from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
 from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.distributed import resilience
+from distrl_llm_tpu.distributed.resilience import (
+    RetryPolicy,
+    ShardFailedError,
+    WorkerError,
+    classify_worker_error,
+)
 from distrl_llm_tpu.native.build import build_library
 
 log = logging.getLogger(__name__)
@@ -139,21 +165,36 @@ class WorkerServer:
         if self._server_fd < 0:
             raise OSError(f"cannot listen on port {port}")
         self.port = self._lib.cp_bound_port(self._server_fd)
+        self._draining = False
+
+    def request_shutdown(self) -> None:
+        """Graceful preemption (worker_main wires SIGTERM here): finish the
+        dispatch in flight — its result is still delivered — then exit the
+        serve loop cleanly instead of dying mid-RPC. Signal-safe: only sets
+        a flag the serve loop polls at its next frame boundary."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def serve_forever(self, handler: Callable[[bytes], bytes],
                       accept_timeout_ms: int = 1000) -> None:
-        """Accept one driver connection at a time and serve until SHUTDOWN."""
+        """Accept one driver connection at a time and serve until SHUTDOWN
+        (or a ``request_shutdown`` drain)."""
         try:
             while True:
+                if self._draining:
+                    return
                 fd = self._lib.cp_accept(self._server_fd, accept_timeout_ms)
                 if fd == -1:
                     continue  # accept timeout; keep listening
                 if fd < 0:
                     raise OSError("accept failed")
-                conn = Connection(fd)
+                conn = resilience.wrap_connection(Connection(fd))
                 try:
                     if self._serve_conn(conn, handler):
-                        return  # clean shutdown
+                        return  # clean shutdown / drained
                 except WorkerDeadError:
                     log.info("driver connection dropped; re-listening")
                 finally:
@@ -165,6 +206,8 @@ class WorkerServer:
         while True:
             frame = conn.recv(timeout_ms=1000)
             if frame is None:
+                if self._draining:
+                    return True  # idle between frames: drain immediately
                 continue
             msg_type, req_id, payload = frame
             if msg_type == MSG_PING:
@@ -192,6 +235,10 @@ class WorkerServer:
                     )
             else:
                 log.warning("unexpected frame type %d", msg_type)
+            if self._draining:
+                # SIGTERM landed while this frame was being handled: the
+                # in-flight result was just delivered — now drain
+                return True
 
 
 @dataclass
@@ -199,22 +246,51 @@ class _Worker:
     address: tuple[str, int]
     conn: Connection | None
     healthy: bool = True
+    cold: bool = False  # just rejoined: its engine process recompiles
 
 
 class DriverClient:
-    """Driver-side dispatch/collect over N workers with failure handling."""
+    """Driver-side dispatch/collect over N workers with failure handling.
+
+    ``retry_policy`` governs transient-error retries, reconnect backoff,
+    and the per-call/per-round deadline budgets; ``poison_threshold`` is K,
+    the distinct-worker failure count that quarantines a shard; ``rejoin``
+    starts the background reconnect loop that re-admits recovered workers.
+    """
 
     def __init__(self, addresses: Sequence[tuple[str, int]],
-                 connect_timeout_ms: int = 10_000):
+                 connect_timeout_ms: int = 10_000, *,
+                 retry_policy: RetryPolicy | None = None,
+                 poison_threshold: int = 3,
+                 rejoin: bool = True,
+                 rejoin_poll_s: float = 0.25):
         self._lib = _Lib.get()
         self._workers: list[_Worker] = []
         self._req_id = 0
         self._id_mu = threading.Lock()  # per-worker drain threads share it
+        self._workers_mu = threading.Lock()  # health transitions
+        self._connect_timeout_ms = connect_timeout_ms
+        self.retry = retry_policy or RetryPolicy()
+        self.poison_threshold = max(int(poison_threshold), 1)
+        # bumps on every successful re-admit; RemoteEngine clears its warm
+        # keys when it changes (the rejoined worker compiles from scratch)
+        self.rejoin_epoch = 0
         for host, port in addresses:
             fd = self._lib.cp_connect(host.encode(), port, connect_timeout_ms)
             if fd < 0:
                 raise OSError(f"cannot connect to worker {host}:{port}")
-            self._workers.append(_Worker((host, port), Connection(fd)))
+            self._workers.append(
+                _Worker((host, port), resilience.wrap_connection(Connection(fd)))
+            )
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+        self._stop_rejoin = threading.Event()
+        self._rejoin_thread: threading.Thread | None = None
+        if rejoin:
+            self._rejoin_poll_s = rejoin_poll_s
+            self._rejoin_thread = threading.Thread(
+                target=self._rejoin_loop, name="cp-rejoin", daemon=True
+            )
+            self._rejoin_thread.start()
 
     @property
     def num_healthy(self) -> int:
@@ -225,37 +301,140 @@ class DriverClient:
             self._req_id += 1
             return self._req_id
 
+    def _mark_unhealthy(self, w: _Worker, conn: Connection | None = None) -> None:
+        """Close + demote a worker. ``conn`` (when given) guards against a
+        racing rejoin: only demote if the failed connection is still the
+        worker's current one."""
+        with self._workers_mu:
+            if conn is not None and w.conn is not conn:
+                return  # the rejoin loop already replaced it
+            w.healthy = False
+            if w.conn is not None:
+                w.conn.close()
+                w.conn = None
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+
+    # ---------------------------------------------------------------- rejoin
+
+    def _rejoin_loop(self) -> None:
+        """Background re-dial of unhealthy workers with the policy's seeded
+        backoff; a PING-verified connection re-admits the worker (cold: its
+        engine process likely restarted and recompiles everything)."""
+        backoff: dict[int, tuple[int, float]] = {}  # idx -> (attempt, next_t)
+        while not self._stop_rejoin.wait(self._rejoin_poll_s):
+            for k, w in enumerate(self._workers):
+                if self._stop_rejoin.is_set():
+                    break
+                if w.healthy:
+                    backoff.pop(k, None)
+                    continue
+                attempt, next_t = backoff.get(k, (0, 0.0))
+                if time.monotonic() < next_t:
+                    continue
+                if self._try_rejoin(w):
+                    backoff.pop(k, None)
+                else:
+                    backoff[k] = (
+                        attempt + 1,
+                        time.monotonic() + self.retry.backoff(attempt),
+                    )
+
+    def _try_rejoin(self, w: _Worker) -> bool:
+        host, port = w.address
+        with telemetry.span("cp/reconnect", worker=f"{host}:{port}") as sp:
+            fd = self._lib.cp_connect(
+                host.encode(), port, self._connect_timeout_ms
+            )
+            if fd < 0:
+                sp.set(ok=False)
+                return False
+            conn = resilience.wrap_connection(Connection(fd))
+            rid = self._next_id()
+            ok = False
+            try:
+                conn.send(MSG_PING, rid)
+                frame = conn.recv(timeout_ms=5000)
+                ok = (
+                    frame is not None
+                    and frame[0] == MSG_PONG
+                    and frame[1] == rid
+                )
+            except WorkerDeadError:
+                ok = False
+            if not ok:
+                conn.close()
+                sp.set(ok=False)
+                return False
+            with self._workers_mu:
+                if self._stop_rejoin.is_set():
+                    # shutdown() won the race (it may have given up joining
+                    # this thread while we were blocked in connect/PING):
+                    # admitting now would leak the fd and leave a worker
+                    # process that never receives MSG_SHUTDOWN
+                    conn.close()
+                    sp.set(ok=False)
+                    return False
+                w.conn = conn
+                w.cold = True
+                w.healthy = True
+                self.rejoin_epoch += 1
+            sp.set(ok=True)
+        telemetry.counter_add(resilience.CP_RECONNECTS)
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+        log.info("worker %s:%d rejoined (cold)", host, port)
+        return True
+
+    # ---------------------------------------------------------------- health
+
     def ping_all(self, timeout_ms: int = 5000) -> list[bool]:
-        """Health check every worker (SURVEY §5: health-checked workers).
+        """Health check every worker — one thread per worker, so a single
+        hung worker costs the sweep ONE ``timeout_ms``, not one per victim
+        (SURVEY §5: health-checked workers).
 
         A missed or mismatched PONG closes the connection: the unanswered
         PING would otherwise desync the request/response framing (a late
         PONG surfacing as some future call's reply)."""
-        out = []
-        for w in self._workers:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def ping(w: _Worker) -> bool:
+            conn = w.conn
+            if conn is None:
+                # already unhealthy — the rejoin loop owns it. Demoting here
+                # would bypass the conn-identity guard and could close a
+                # connection a concurrent rejoin JUST re-admitted.
+                return False
             ok = False
-            if w.conn is not None:
-                rid = self._next_id()
-                try:
-                    t0 = time.perf_counter()
-                    w.conn.send(MSG_PING, rid)
-                    frame = w.conn.recv(timeout_ms)
-                    ok = (
-                        frame is not None
-                        and frame[0] == MSG_PONG
-                        and frame[1] == rid
+            rid = self._next_id()
+            try:
+                t0 = time.perf_counter()
+                conn.send(MSG_PING, rid)
+                frame = conn.recv(timeout_ms)
+                ok = (
+                    frame is not None
+                    and frame[0] == MSG_PONG
+                    and frame[1] == rid
+                )
+                if ok:
+                    telemetry.hist_observe(
+                        "cp/rpc_ping_ms", (time.perf_counter() - t0) * 1e3
                     )
-                    if ok:
-                        telemetry.hist_observe(
-                            "cp/rpc_ping_ms", (time.perf_counter() - t0) * 1e3
-                        )
-                except WorkerDeadError:
-                    ok = False
-                if not ok:
-                    w.conn.close()
-                    w.conn = None
-            w.healthy = ok
-            out.append(ok)
+            except WorkerDeadError:
+                ok = False
+            if ok:
+                with self._workers_mu:
+                    if w.conn is conn:
+                        w.healthy = True
+            else:
+                self._mark_unhealthy(w, conn)
+            return ok
+
+        if not self._workers:
+            return []
+        with ThreadPoolExecutor(
+            max_workers=len(self._workers), thread_name_prefix="cp-ping"
+        ) as pool:
+            out = list(pool.map(ping, self._workers))
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
         return out
 
     def _call(self, w: _Worker, payload: bytes, timeout_ms: int) -> bytes:
@@ -276,8 +455,11 @@ class DriverClient:
         ):
             raise WorkerDeadError(f"worker {w.address} protocol violation")
         if msg_type == MSG_ERROR:
-            raise RuntimeError(
-                f"worker {w.address} raised:\n{body.decode(errors='replace')}"
+            # classified transient-vs-fatal so the caller can retry under
+            # the policy instead of aborting the round on a hiccup
+            tb = body.decode(errors="replace")
+            raise WorkerError(
+                w.address, tb, transient=classify_worker_error(tb)
             )
         if msg_type == MSG_RESULT_TLM:
             # worker-recorded spans piggybacked on the result: merge them
@@ -289,8 +471,43 @@ class DriverClient:
         )
         return body
 
+    def _call_with_retry(self, w: _Worker, payload: bytes,
+                         timeout_ms: int) -> bytes:
+        """``_call`` plus the policy's bounded transient-error retry: a
+        worker-side exception classified transient retries on the SAME
+        worker (it answered — it is alive) with seeded backoff, within the
+        per-call deadline budget. Fatal errors and transport deaths
+        propagate to the caller unchanged."""
+        host, port = w.address
+        attempt = 0
+        t0 = time.monotonic()
+        while True:
+            try:
+                return self._call(w, payload, timeout_ms)
+            except WorkerError as e:
+                if not e.transient or attempt >= self.retry.max_call_retries:
+                    raise
+                delay = self.retry.backoff(attempt)
+                budget = self.retry.call_budget_s
+                if budget is not None and (
+                    time.monotonic() - t0 + delay > budget
+                ):
+                    raise
+                attempt += 1
+                telemetry.counter_add(resilience.CP_RETRIES)
+                with telemetry.span("cp/retry", worker=f"{host}:{port}",
+                                    attempt=attempt):
+                    log.warning(
+                        "transient worker error on %s (retry %d/%d in "
+                        "%.3fs): %s", w.address, attempt,
+                        self.retry.max_call_retries, delay,
+                        e.traceback_text.strip().splitlines()[-1],
+                    )
+                    time.sleep(delay)
+
     def dispatch_round(self, shards: Sequence[bytes],
-                       timeout_ms: int = 240_000) -> list[bytes]:
+                       timeout_ms: int = 240_000,
+                       allow_partial: bool = False) -> list[bytes]:
         """Dispatch shards round-robin over healthy workers, ALL workers
         working concurrently (one thread per worker draining its queue — the
         parallel fan-out that is this plane's whole purpose; a worker's own
@@ -300,60 +517,195 @@ class DriverClient:
         ray.get(timeout=240) (distributed_trainer.py:190–200) — except a
         timeout there kills the run. Here a dead worker is marked unhealthy
         and its shards are RESUBMITTED to the remaining workers; the round
-        only fails when no healthy workers remain."""
+        only fails when no healthy workers remain.
+
+        Poison-shard quarantine: a shard that fails on ``poison_threshold``
+        DISTINCT workers (or ``retry.max_shard_attempts`` total attempts)
+        raises :class:`ShardFailedError` naming the shard — unless
+        ``allow_partial``, in which case its slot holds ``None`` and the
+        returned list stays aligned with ``shards`` so the caller can
+        degrade with exact accounting."""
         from concurrent.futures import ThreadPoolExecutor
 
         results: list[bytes | None] = [None] * len(shards)
+        # poison tracking: which DISTINCT workers failed each shard, and
+        # its total failed attempts (mutated on the main thread only)
+        shard_workers: dict[int, set] = {}
+        shard_attempts: dict[int, int] = {}
+        quarantined: set[int] = set()
         pending = list(range(len(shards)))
+        t_round = time.monotonic()
+        # the caller chose this round's deadline knowing the rejoin epoch
+        # (RemoteEngine re-checks it per round), so workers cold at ENTRY
+        # are covered — clear their flags. Workers that rejoin MID-round
+        # stay cold and sit the rest of this round out (below): their fresh
+        # engine would cold-compile past the warm deadline, read as a
+        # second death, and unjustly poison whatever shard it carried.
+        with self._workers_mu:
+            for w in self._workers:
+                w.cold = False
         while pending:
-            healthy = [w for w in self._workers if w.healthy and w.conn]
+            budget = self.retry.round_budget_s
+            if budget is not None and time.monotonic() - t_round > budget:
+                raise WorkerDeadError(
+                    f"dispatch round exceeded its {budget:.0f}s budget with "
+                    f"{len(pending)} shard(s) still pending"
+                )
+            with self._workers_mu:
+                avail = [w for w in self._workers if w.healthy and w.conn]
+                warm = [w for w in avail if not w.cold]
+            # fall back to cold workers only when they are ALL that's left
+            # (better a possible compile-time miss than failing the round)
+            healthy = warm or avail
             if not healthy:
                 raise WorkerDeadError("no healthy workers remain")
             queues: dict[int, list[int]] = {id(w): [] for w in healthy}
             for k, i in enumerate(pending):
-                queues[id(healthy[k % len(healthy)])].append(i)
+                # a requeued shard PREFERS workers it has not yet failed on:
+                # plain round-robin would re-land it on the same worker
+                # forever, so the K-distinct-workers poison signature could
+                # never accumulate and quarantine would only fire via the
+                # (much larger) attempt cap
+                failed_on = shard_workers.get(i)
+                candidates = (
+                    [w for w in healthy if w.address not in failed_on]
+                    if failed_on else healthy
+                ) or healthy
+                queues[id(candidates[k % len(candidates)])].append(i)
 
-            def drain(w: _Worker, idxs: list[int]) -> list[int]:
-                failed: list[int] = []
-                for i in idxs:
+            def drain(w: _Worker, idxs: list[int]):
+                """Returns (requeue, failures): shard indices to redistribute
+                and [(shard, kind)] failure records for poison tracking —
+                only the shard actually IN FLIGHT at a worker death is
+                recorded against it (that is the poison signature); the rest
+                of the queue just redistributes."""
+                conn = w.conn
+                requeue: list[int] = []
+                failures: list[tuple[int, str]] = []
+                host, port = w.address
+                for pos, i in enumerate(idxs):
                     try:
-                        results[i] = self._call(w, shards[i], timeout_ms)
+                        results[i] = self._call_with_retry(
+                            w, shards[i], timeout_ms
+                        )
                     except WorkerDeadError as e:
-                        log.warning("resubmitting shard %d: %s", i, e)
-                        w.healthy = False
-                        if w.conn:
-                            w.conn.close()
-                            w.conn = None
-                        failed.extend(idxs[idxs.index(i):])
+                        log.warning(
+                            "worker %s lost; resubmitting %d shard(s): %s",
+                            w.address, len(idxs) - pos, e,
+                        )
+                        self._mark_unhealthy(w, conn)
+                        failures.append((i, "dead"))
+                        requeue.extend(idxs[pos:])
+                        telemetry.counter_add(
+                            resilience.CP_RESUBMITS, len(idxs) - pos
+                        )
+                        with telemetry.span(
+                            "cp/resubmit", worker=f"{host}:{port}",
+                            count=len(idxs) - pos,
+                        ):
+                            pass
                         break
-                return failed
+                    except WorkerError as e:
+                        if not e.transient:
+                            raise  # deterministic program error: fail loudly
+                        log.warning(
+                            "shard %d exhausted transient retries on worker "
+                            "%s; requeueing", i, w.address,
+                        )
+                        failures.append((i, "exhausted"))
+                        requeue.append(i)
+                        telemetry.counter_add(resilience.CP_RESUBMITS)
+                        with telemetry.span(
+                            "cp/resubmit", worker=f"{host}:{port}", count=1,
+                        ):
+                            pass
+                return requeue, failures
 
-            pool = ThreadPoolExecutor(max_workers=len(healthy))
+            pool = ThreadPoolExecutor(
+                max_workers=len(healthy), thread_name_prefix="cp-drain"
+            )
+            outcomes: list[tuple[_Worker, list[int], list[tuple[int, str]]]] = []
+            first_exc: BaseException | None = None
             try:
                 futs = [
-                    pool.submit(drain, w, queues[id(w)])
+                    (w, pool.submit(drain, w, queues[id(w)]))
                     for w in healthy if queues[id(w)]
                 ]
-                pending = [i for f in futs for i in f.result()]
+                for w, f in futs:
+                    try:
+                        requeue, failures = f.result()
+                        outcomes.append((w, requeue, failures))
+                    except BaseException as e:  # noqa: BLE001 — surfaced below
+                        if first_exc is None:
+                            first_exc = e
             finally:
-                pool.shutdown(wait=False)
+                # a fatal error mid-pool must not leak drain threads that
+                # keep writing into ``results`` after this frame returns:
+                # cancel anything queued and JOIN the running drains before
+                # surfacing (the old wait=False teardown leaked them)
+                pool.shutdown(wait=True, cancel_futures=True)
+            if first_exc is not None:
+                raise first_exc
+            pending = []
+            for w, requeue, failures in outcomes:
+                failed_here = set()
+                for i, _kind in failures:
+                    failed_here.add(i)
+                    shard_workers.setdefault(i, set()).add(w.address)
+                    shard_attempts[i] = shard_attempts.get(i, 0) + 1
+                for i in requeue:
+                    if i in failed_here and (
+                        len(shard_workers[i]) >= self.poison_threshold
+                        or shard_attempts[i] >= self.retry.max_shard_attempts
+                    ):
+                        telemetry.counter_add(resilience.CP_POISON_SHARDS)
+                        err = ShardFailedError(
+                            i, workers=sorted(shard_workers[i]),
+                            attempts=shard_attempts[i],
+                        )
+                        if not allow_partial:
+                            raise err
+                        log.error("degrading: %s", err)
+                        quarantined.add(i)
+                    else:
+                        pending.append(i)
+        if allow_partial:
+            return [
+                None if i in quarantined else results[i]
+                for i in range(len(shards))
+            ]
         return [r for r in results if r is not None]
 
     def dispatch_objects(self, shards: Sequence[Any],
-                         timeout_ms: int = 240_000) -> list[Any]:
+                         timeout_ms: int = 240_000,
+                         allow_partial: bool = False) -> list[Any]:
         """pickle-in / pickle-out convenience over ``dispatch_round``."""
         raw = self.dispatch_round(
-            [pickle.dumps(s) for s in shards], timeout_ms
+            [pickle.dumps(s) for s in shards], timeout_ms,
+            allow_partial=allow_partial,
         )
-        return [pickle.loads(r) for r in raw]
+        return [pickle.loads(r) if r is not None else None for r in raw]
 
     def shutdown(self, timeout_ms: int = 5000) -> None:
-        for w in self._workers:
-            if w.conn is not None:
+        self._stop_rejoin.set()
+        if self._rejoin_thread is not None:
+            self._rejoin_thread.join(timeout=5)
+            self._rejoin_thread = None
+        # detach the connections under the mutex, THEN shut them down: a
+        # rejoin attempt still in flight after the join timed out either
+        # admitted before this block (its conn is in the snapshot and gets
+        # MSG_SHUTDOWN) or hits the stop-guard in _try_rejoin and closes
+        # its own connection — no fd leaks either way
+        with self._workers_mu:
+            conns = [w.conn for w in self._workers]
+            for w in self._workers:
+                w.conn = None
+                w.healthy = False
+        for conn in conns:
+            if conn is not None:
                 try:
-                    w.conn.send(MSG_SHUTDOWN, self._next_id())
-                    w.conn.recv(timeout_ms)
+                    conn.send(MSG_SHUTDOWN, self._next_id())
+                    conn.recv(timeout_ms)
                 except WorkerDeadError:
                     pass
-                w.conn.close()
-                w.conn = None
+                conn.close()

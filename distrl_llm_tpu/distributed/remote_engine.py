@@ -26,7 +26,9 @@ import numpy as np
 
 from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.distributed import resilience
 from distrl_llm_tpu.distributed.control_plane import DriverClient
+from distrl_llm_tpu.distributed.resilience import RetryPolicy, ShardFailedError
 from distrl_llm_tpu.engine.engine import GenerationResult, accumulate_round_stats
 from distrl_llm_tpu.utils.chunking import even_chunks
 
@@ -46,6 +48,7 @@ class RemoteEngine:
         cold_timeout_ms: int = 1_800_000,  # first round: worker-side XLA compile
         lora_scale: float = 1.0,
         eos_token_ids: Sequence[int] | None = None,
+        degrade_on_shard_failure: bool = False,
     ):
         self.driver = driver
         self.max_prompt_tokens = max_prompt_tokens
@@ -53,6 +56,12 @@ class RemoteEngine:
         self.timeout_ms = timeout_ms
         self.cold_timeout_ms = cold_timeout_ms
         self.lora_scale = lora_scale
+        # degrade instead of raise on a poison-shard quarantine: the round
+        # returns the surviving groups, zero-fills the lost rows, and
+        # records their indices in ``last_lost_rows`` so the trainer drops
+        # those prompts (with conservation accounting) rather than the run
+        self.degrade_on_shard_failure = degrade_on_shard_failure
+        self.last_lost_rows: list[int] = []
         # full stop-token set shipped with every shard — workers default to
         # their tokenizer's single eos, which can differ from the trainer's
         # merged set (silently changing the sampling distribution)
@@ -61,6 +70,11 @@ class RemoteEngine:
         # gets the cold-compile allowance, like trainer._call_engine's
         # per-(role, bucket, rows, n) warm keys on the local path
         self._warm_keys: set[tuple] = set()
+        # rejoin re-warm allowance: a worker that reconnected runs a FRESH
+        # engine process (everything recompiles), so a bumped rejoin_epoch
+        # invalidates every warm key and the next round gets the cold
+        # deadline again instead of a spurious hang verdict
+        self._seen_rejoin_epoch = getattr(driver, "rejoin_epoch", 0)
         # per-round timing/token counts (engine.accumulate_round_stats
         # contract): remote rounds have no local prefill/decode split, so
         # the whole RPC fan-out is accounted as decode time
@@ -104,6 +118,12 @@ class RemoteEngine:
                 },
             ))
             start += size
+        # rejoin re-warm: a reconnected worker's fresh engine process lost
+        # every compiled executable — treat all shapes as cold again
+        epoch = getattr(self.driver, "rejoin_epoch", 0)
+        if epoch != self._seen_rejoin_epoch:
+            self._seen_rejoin_epoch = epoch
+            self._warm_keys.clear()
         # a cold shard shape pays full worker-side XLA compilation — minutes,
         # not a hang; the steady-state deadline applies once this shape has
         # run before
@@ -114,7 +134,12 @@ class RemoteEngine:
         t0 = time.perf_counter()
         with telemetry.span("engine/remote_round", rows=b,
                             shards=len(sizes)) as sp:
-            results = self.driver.dispatch_objects(shards, timeout_ms=timeout)
+            results = self.driver.dispatch_objects(
+                shards, timeout_ms=timeout,
+                allow_partial=self.degrade_on_shard_failure,
+            )
+            results, lost_rows = self._fill_lost_shards(results, sizes)
+            self.last_lost_rows = lost_rows
             tokens = np.concatenate([r["tokens"] for r in results], axis=0)
             lengths = np.concatenate([r["lengths"] for r in results], axis=0)
             gen_tokens = int(lengths.sum())
@@ -131,6 +156,56 @@ class RemoteEngine:
             logps = np.concatenate([r["logprobs"] for r in results], axis=0)
         return GenerationResult(tokens=tokens, lengths=lengths, logprobs=logps)
 
+    def _fill_lost_shards(
+        self, results: list, sizes: Sequence[int]
+    ) -> tuple[list, list[int]]:
+        """Zero-fill quarantined shards (``None`` slots from an
+        ``allow_partial`` dispatch) so the reassembled arrays keep their
+        shape, and return the lost ROW indices for the trainer to drop.
+
+        Conservation contract: surviving rows + lost rows == the round's
+        row count — every prompt is accounted for, none silently vanish."""
+        if all(r is not None for r in results):
+            return list(results), []
+        survivors = [r for r in results if r is not None]
+        if not survivors:
+            raise ShardFailedError(
+                -1, message=(
+                    "every shard in the round was quarantined — no "
+                    "surviving groups to degrade to"
+                ),
+            )
+        ref = survivors[0]
+        filled: list = []
+        lost_rows: list[int] = []
+        start = 0
+        for i, size in enumerate(sizes):
+            r = results[i]
+            if r is None:
+                lost_rows.extend(range(start, start + size))
+                r = {
+                    "tokens": np.zeros(
+                        (size,) + ref["tokens"].shape[1:],
+                        dtype=ref["tokens"].dtype,
+                    ),
+                    "lengths": np.zeros(
+                        (size,) + ref["lengths"].shape[1:],
+                        dtype=ref["lengths"].dtype,
+                    ),
+                    "logprobs": (
+                        np.zeros(
+                            (size,) + ref["logprobs"].shape[1:],
+                            dtype=ref["logprobs"].dtype,
+                        )
+                        if ref.get("logprobs") is not None else None
+                    ),
+                }
+            filled.append(r)
+            start += size
+        assert sum(sizes) == start and len(lost_rows) < start
+        telemetry.counter_add(resilience.CP_DEGRADED_GROUPS, len(lost_rows))
+        return filled, lost_rows
+
 
 def connect_remote_engine(
     addresses: Sequence[tuple[str, int]],
@@ -140,13 +215,23 @@ def connect_remote_engine(
     timeout_ms: int = 240_000,
     lora_scale: float = 1.0,
     eos_token_ids: Sequence[int] | None = None,
+    retry_policy: RetryPolicy | None = None,
+    poison_threshold: int = 3,
+    rejoin: bool = True,
+    degrade_on_shard_failure: bool = False,
 ) -> RemoteEngine:
     """Connect to running workers and wrap them as an engine."""
     return RemoteEngine(
-        DriverClient(addresses),
+        DriverClient(
+            addresses,
+            retry_policy=retry_policy,
+            poison_threshold=poison_threshold,
+            rejoin=rejoin,
+        ),
         max_prompt_tokens=max_prompt_tokens,
         max_new_tokens=max_new_tokens,
         timeout_ms=timeout_ms,
         lora_scale=lora_scale,
         eos_token_ids=eos_token_ids,
+        degrade_on_shard_failure=degrade_on_shard_failure,
     )

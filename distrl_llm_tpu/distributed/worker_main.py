@@ -19,6 +19,14 @@ Request payloads are pickled ``(op, arg)`` tuples:
   the reference's shared-filesystem adapter bus, distributed_actor.py:150)
   and returns {tokens, lengths}. Requires ``--serve-model``.
 * ``("sleep", seconds)`` → "slept" (hang-injection tests)
+* ``("flaky", {"key": str, "fails": int})`` → raises a TRANSIENT
+  ConnectionError for the first ``fails`` calls sharing ``key``, then
+  succeeds — the fault used by the bounded-retry and poison-quarantine
+  tests/chaos harness (resilience.py classification).
+
+SIGTERM is graceful preemption (the preemptible-TPU contract): the serve
+loop drains the dispatch in flight — its result is still delivered — then
+exits 0, instead of dying mid-RPC and burning the driver's deadline.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ import sys
 import time
 
 _ENGINE_STATE: dict = {}
+_FLAKY_COUNTS: dict[str, int] = {}
 
 
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
@@ -142,6 +151,18 @@ def handler(payload: bytes) -> bytes:
     if op == "sleep":
         time.sleep(float(arg))
         return pickle.dumps("slept")
+    if op == "flaky":
+        key = str(arg.get("key", "k"))
+        fails = int(arg.get("fails", 1))
+        n = _FLAKY_COUNTS.get(key, 0) + 1
+        _FLAKY_COUNTS[key] = n
+        if n <= fails:
+            # ConnectionError classifies transient (resilience.py) — the
+            # driver retries under its policy instead of aborting the round
+            raise ConnectionError(
+                f"injected transient fault {n}/{fails} for {key!r}"
+            )
+        return pickle.dumps(("ok", key, n))
     if op == "rollout_rewards":
         with telemetry.span("worker/rollout_rewards",
                             groups=len(arg["answers"])):
@@ -259,7 +280,16 @@ def main(argv: list[str] | None = None) -> None:
                              "driver in RPC responses (also enabled by "
                              "DISTRL_TRACE=1); the driver merges them into "
                              "its trace under this worker's track")
+    parser.add_argument("--fault-schedule", type=str, default=None,
+                        help="deterministic fault-injection schedule for "
+                             "this worker's connections (resilience."
+                             "FaultInjector grammar, e.g. "
+                             "'seed=7;recv:3=delay:0.2'); also read from "
+                             "$DISTRL_FAULT_SCHEDULE so chaos runs can "
+                             "share one spec across processes")
     args = parser.parse_args(argv)
+    if args.fault_schedule:
+        os.environ["DISTRL_FAULT_SCHEDULE"] = args.fault_schedule
     if args.trace:
         from distrl_llm_tpu import telemetry
 
@@ -287,11 +317,28 @@ def main(argv: list[str] | None = None) -> None:
             capture_logprobs=args.capture_logprobs,
         )
 
+    import signal
+
     from distrl_llm_tpu.distributed.control_plane import WorkerServer
 
     server = WorkerServer(port=args.port)
+
+    def _drain(signum, frame):  # noqa: ARG001 — signal handler signature
+        # graceful preemption: finish (and deliver) the dispatch in flight,
+        # then exit 0 — the handler only sets a flag; the serve loop drains
+        # at its next frame boundary
+        server.request_shutdown()
+
+    signal.signal(signal.SIGTERM, _drain)
     print(f"PORT {server.port}", flush=True)
     server.serve_forever(handler)
+    if server.draining:
+        # telemetry spans recorded since the last RPC have no response left
+        # to ride home on — drop them explicitly rather than leak the list
+        from distrl_llm_tpu import telemetry
+
+        telemetry.drain_remote_blob()
+        print("DRAINED", flush=True)
 
 
 if __name__ == "__main__":

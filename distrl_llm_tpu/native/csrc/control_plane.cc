@@ -60,6 +60,7 @@ bool send_all(int fd, const char* data, int64_t len, int timeout_ms) {
     if (remaining <= 0) return false;
     struct pollfd p = {fd, POLLOUT, 0};
     int r = poll(&p, 1, static_cast<int>(remaining));
+    if (r < 0 && errno == EINTR) continue;  // signal (e.g. SIGTERM drain)
     if (r <= 0) return false;
     ssize_t n = ::send(fd, data + off, static_cast<size_t>(len - off),
                        MSG_NOSIGNAL);
@@ -81,6 +82,7 @@ bool recv_all(int fd, char* buf, int64_t len, int timeout_ms) {
     if (remaining <= 0) return false;
     struct pollfd p = {fd, POLLIN, 0};
     int r = poll(&p, 1, static_cast<int>(remaining));
+    if (r < 0 && errno == EINTR) continue;  // signal (e.g. SIGTERM drain)
     if (r <= 0) return false;
     ssize_t n = ::recv(fd, buf + off, static_cast<size_t>(len - off), 0);
     if (n <= 0) {
@@ -129,7 +131,10 @@ int64_t cp_accept(int64_t server_fd, int timeout_ms) {
   struct pollfd p = {static_cast<int>(server_fd), POLLIN, 0};
   int r = poll(&p, 1, timeout_ms);
   if (r == 0) return -1;
-  if (r < 0) return -2;
+  // EINTR reports as a timeout so the Python accept loop regains control
+  // (and runs its signal handlers — the SIGTERM drain path) instead of
+  // treating a delivered signal as a transport error.
+  if (r < 0) return errno == EINTR ? -1 : -2;
   int fd = accept(static_cast<int>(server_fd), nullptr, nullptr);
   if (fd < 0) return -2;
   int one = 1;
@@ -201,7 +206,10 @@ int cp_recv_header(int64_t fd, int* type, uint64_t* req_id, int64_t* len,
   struct pollfd p = {static_cast<int>(fd), POLLIN, 0};
   int r = poll(&p, 1, timeout_ms);
   if (r == 0) return -1;
-  if (r < 0) return -2;
+  // EINTR → timeout, not connection death: the Python serve loop must get
+  // control back to run signal handlers (SIGTERM drain) without the
+  // connection being torn down underneath the driver.
+  if (r < 0) return errno == EINTR ? -1 : -2;
   if (!recv_all(static_cast<int>(fd), reinterpret_cast<char*>(&h), sizeof(h),
                 timeout_ms))
     return -2;

@@ -17,18 +17,25 @@ the producer holds a busy lock only while generating — never while parked
 at the pause gate or blocked in ``put`` — and ``pause`` acquires it, so it
 returns the moment the engine is actually free and never mid-round.
 
-Producer exceptions are captured, the buffer is closed so the learner wakes
-and drains, and ``raise_if_failed`` re-raises driver-side — a dead producer
-must fail the run loudly, not starve it quietly.
+Producer failures run through a SUPERVISED RESTART BUDGET first: a failed
+produce round is retried in place with the retry policy's seeded backoff up
+to ``max_restarts`` times across the run (``rollout/producer_restarts``
+counts them) — transient rollout failures (a worker pool mid-rejoin, an RPC
+hiccup) no longer kill the regime. Only once the budget is exhausted is the
+exception captured, the buffer closed so the learner wakes and drains, and
+``raise_if_failed`` re-raises driver-side — a genuinely dead producer must
+still fail the run loudly, not starve it quietly.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator
 
 from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.distributed.resilience import RetryPolicy
 from distrl_llm_tpu.rollout.buffer import BufferClosed, TrajectoryBuffer
 from distrl_llm_tpu.rollout.trajectory import Trajectory
 
@@ -48,11 +55,19 @@ class RolloutService:
         batches: Iterable[tuple[int, int, dict[str, Any]]],
         *,
         name: str = "rollout-service",
+        max_restarts: int = 0,
+        retry_policy: RetryPolicy | None = None,
     ):
         self._produce = produce
         self.buffer = buffer
         self._batches: Iterator = iter(batches)
         self._name = name
+        # supervised restart budget: failed produce rounds retry in place
+        # (with seeded backoff) this many times TOTAL before the failure
+        # closes the buffer and surfaces via raise_if_failed
+        self.max_restarts = max(int(max_restarts), 0)
+        self.restarts_used = 0
+        self._retry = retry_policy or RetryPolicy()
         self._resume_gate = threading.Event()
         self._resume_gate.set()
         self._stop = False
@@ -87,11 +102,30 @@ class RolloutService:
                         return
                 if self._stop:
                     return
-                with self._busy:
-                    with telemetry.span("rollout/produce", episode=episode,
-                                        batch=bi) as sp:
-                        trajs = self._produce(episode, bi, batch)
-                        sp.set(groups=len(trajs))
+                while True:
+                    try:
+                        with self._busy:
+                            with telemetry.span(
+                                "rollout/produce", episode=episode, batch=bi
+                            ) as sp:
+                                trajs = self._produce(episode, bi, batch)
+                                sp.set(groups=len(trajs))
+                        break
+                    except BufferClosed:
+                        raise  # consumer shut down — never a restart case
+                    except BaseException as e:  # noqa: BLE001 — budgeted
+                        if self._stop or self.restarts_used >= self.max_restarts:
+                            raise
+                        self.restarts_used += 1
+                        telemetry.counter_add("rollout/producer_restarts")
+                        log.warning(
+                            "rollout producer failed on (episode %d, batch "
+                            "%d); restart %d/%d: %r", episode, bi,
+                            self.restarts_used, self.max_restarts, e,
+                        )
+                        time.sleep(
+                            self._retry.backoff(self.restarts_used - 1)
+                        )
                 self.rounds_produced += 1
                 for traj in trajs:
                     # backpressure: blocks at the buffer's high watermark

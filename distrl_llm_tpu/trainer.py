@@ -366,6 +366,8 @@ class Trainer:
             for spec in config.rollout_workers:
                 host, _, port = spec.rpartition(":")
                 addresses.append((host or "127.0.0.1", int(port)))
+            from distrl_llm_tpu.distributed.resilience import RetryPolicy
+
             engine = connect_remote_engine(
                 addresses,
                 max_prompt_tokens=config.max_prompt_tokens,
@@ -378,6 +380,16 @@ class Trainer:
                 ),
                 lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
                 eos_token_ids=eos,
+                # control-plane resilience (distributed/resilience.py):
+                # seeded per-run so retry/reconnect backoff replays
+                retry_policy=RetryPolicy(
+                    max_call_retries=config.rpc_retries,
+                    base_s=config.rpc_backoff_s,
+                    seed=config.seed,
+                ),
+                poison_threshold=config.poison_shard_k,
+                rejoin=config.worker_rejoin,
+                degrade_on_shard_failure=config.degrade_on_poison,
             )
         else:
             if config.full_finetune and not meshes.timeshared:
@@ -847,15 +859,36 @@ class Trainer:
         base_version = self._rollout_weight_version
         result = self._dispatch_rollout(prompt_ids, prompt_mask, sampling, b_real)
 
+        # degraded remote rounds (poison-shard quarantine with
+        # degrade_on_poison): the engine zero-filled the quarantined
+        # shards' rows and recorded them — DROP those prompts from the
+        # round instead of training on fabricated zeros, with exact
+        # conservation accounting (kept + lost == the real batch)
+        lost = {
+            int(r) for r in getattr(self.engine, "last_lost_rows", ()) or ()
+        }
+        kept_idx = [i for i in range(b_real) if i not in lost]
+        lost_real = b_real - len(kept_idx)
+        if lost_real:
+            if not kept_idx:
+                raise RuntimeError(
+                    "every group in the round was lost to quarantined "
+                    "shards — nothing survives to train on"
+                )
+            assert len(kept_idx) + lost_real == b_real  # conservation
+            log.warning(
+                "dropping %d/%d group(s) lost to quarantined shards",
+                lost_real, b_real,
+            )
         n = sampling.n
         answers, token_lengths = [], []
-        for i in range(b_real):
+        for i in kept_idx:
             answers.append(decode_batch(self.tokenizer, result.tokens[i], result.lengths[i]))
             token_lengths.append([int(x) for x in result.lengths[i]])
         cand: dict[str, Any] = {
             "answers": answers,
-            "problem": [[p] * n for p in problems],
-            "solution": [[s] * n for s in solutions],
+            "problem": [[problems[i]] * n for i in kept_idx],
+            "solution": [[solutions[i]] * n for i in kept_idx],
             "token_lengths": token_lengths,
         }
         # raw engine tokens + behavior logprobs (when the engine captures
@@ -863,9 +896,9 @@ class Trainer:
         # decoded text (the reference's path) can shift token boundaries and
         # corrupt per-token importance ratios
         if result.logprobs is not None:
-            cand["answer_tokens"] = [result.tokens[i] for i in range(b_real)]
-            cand["behavior_logps"] = [result.logprobs[i] for i in range(b_real)]
-            cand["gen_lengths"] = [result.lengths[i] for i in range(b_real)]
+            cand["answer_tokens"] = [result.tokens[i] for i in kept_idx]
+            cand["behavior_logps"] = [result.logprobs[i] for i in kept_idx]
+            cand["gen_lengths"] = [result.lengths[i] for i in kept_idx]
             # per-token policy-version tags (rollout/trajectory.py): which
             # learner weight_version sampled each position. The round opens
             # at the rollout-resident version; every consumed in-flight swap
@@ -888,7 +921,7 @@ class Trainer:
             tags = version_tags_for_round(
                 n, result.tokens.shape[2], base_version, events
             )
-            cand["version_tags"] = [tags for _ in range(b_real)]
+            cand["version_tags"] = [tags for _ in kept_idx]
             cand["base_version"] = base_version
             cand["swap_events"] = events
         # snapshot pool + round telemetry HERE, on the thread that ran the
@@ -1125,8 +1158,17 @@ class Trainer:
                 episode=episode, batch_index=bi,
             )
 
+        from distrl_llm_tpu.distributed.resilience import RetryPolicy
+
         service = RolloutService(
-            produce, buffer, self._episode_batches(start_episode, start_batch)
+            produce, buffer, self._episode_batches(start_episode, start_batch),
+            # supervised restart budget (seeded backoff): transient produce
+            # failures — a worker pool mid-rejoin, an RPC hiccup — retry in
+            # place instead of closing the buffer and killing the regime
+            max_restarts=cfg.producer_restarts,
+            retry_policy=RetryPolicy(
+                base_s=cfg.rpc_backoff_s, seed=cfg.seed
+            ),
         )
         self._rollout_service = service
         service.start()

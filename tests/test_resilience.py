@@ -1,0 +1,705 @@
+"""Fault-tolerant control plane (ISSUE 5): the resilience layer end to end.
+
+Acceptance coverage:
+- worker REJOIN after reconnect (kill → restart on the same port → the
+  background loop re-admits it) with the RemoteEngine re-warm allowance;
+- bounded MSG_ERROR retry with seeded backoff (transient classification);
+- poison-shard quarantine: ShardFailedError after K distinct-worker
+  failures, workers spared, allow_partial degrade aligned with shards;
+- SIGTERM graceful drain: the in-flight dispatch's result is delivered and
+  the worker exits 0;
+- seeded FaultInjector determinism: same schedule → same event sequence;
+- parallel ping_all (a hung worker stalls the sweep by ONE timeout);
+- executor teardown: a fatal error mid-pool joins the drain threads before
+  surfacing (no leaked writers into ``results``);
+- atomic save_adapter_file (a failed write leaves no truncated artifact).
+
+The sync-mode byte-identity acceptance pin (resilience defaults change
+nothing locally) is tests/test_rollout_modes.py::TestSyncByteIdentity —
+the resilience layer only touches remote dispatch and failure paths.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.distributed import resilience
+from distrl_llm_tpu.distributed.control_plane import (
+    DriverClient,
+    WorkerDeadError,
+)
+from distrl_llm_tpu.distributed.resilience import (
+    FaultInjector,
+    FaultyConnection,
+    RetryPolicy,
+    ShardFailedError,
+    WorkerError,
+    classify_worker_error,
+)
+from distrl_llm_tpu.native.build import native_available
+
+pytestmark = [pytest.mark.distributed]
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ not available"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    resilience.install(None)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    resilience.install(None)
+
+
+def spawn_worker(port: int = 0):
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distrl_llm_tpu.distributed.worker_main", "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
+def kill(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+
+
+# ------------------------------------------------------------- policy units
+
+
+class TestRetryPolicy:
+    def test_seeded_backoff_is_deterministic(self):
+        a = RetryPolicy(seed=11, jitter=0.3)
+        b = RetryPolicy(seed=11, jitter=0.3)
+        assert [a.backoff(i) for i in range(6)] == [
+            b.backoff(i) for i in range(6)
+        ]
+        c = RetryPolicy(seed=12, jitter=0.3)
+        assert [a.backoff(i) for i in range(6)] != [
+            c.backoff(i) for i in range(6)
+        ]
+
+    def test_exponential_growth_and_cap(self):
+        p = RetryPolicy(base_s=0.1, multiplier=2.0, max_backoff_s=0.5,
+                        jitter=0.0)
+        assert p.backoff(0) == pytest.approx(0.1)
+        assert p.backoff(1) == pytest.approx(0.2)
+        assert p.backoff(2) == pytest.approx(0.4)
+        assert p.backoff(3) == pytest.approx(0.5)  # capped
+        assert p.backoff(10) == pytest.approx(0.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_call_retries"):
+            RetryPolicy(max_call_retries=-1)
+        with pytest.raises(ValueError, match="max_shard_attempts"):
+            RetryPolicy(max_shard_attempts=0)
+
+
+class TestClassification:
+    def _tb(self, last_line: str) -> str:
+        return (
+            "Traceback (most recent call last):\n"
+            '  File "worker.py", line 1, in handler\n'
+            f"{last_line}\n"
+        )
+
+    @pytest.mark.parametrize("exc", [
+        "OSError: [Errno 11] Resource temporarily unavailable",
+        "ConnectionError: injected transient fault 1/2 for 'a'",
+        "ConnectionResetError: peer reset",
+        "TimeoutError: slow filesystem",
+        "BrokenPipeError: [Errno 32] Broken pipe",
+    ])
+    def test_transport_flavors_are_transient(self, exc):
+        assert classify_worker_error(self._tb(exc))
+
+    @pytest.mark.parametrize("exc", [
+        "ValueError: unknown op 'nope'",
+        "RuntimeError: worker started without --serve-model",
+        "TypeError: generate() missing argument",
+        "AssertionError",
+        "jax.errors.TracerArrayConversionError: shape mismatch",
+    ])
+    def test_program_errors_are_fatal(self, exc):
+        assert not classify_worker_error(self._tb(exc))
+
+    def test_explicit_transient_marker(self):
+        assert classify_worker_error(
+            self._tb("RuntimeError: [transient] HBM allocator still warming")
+        )
+
+    def test_worker_error_carries_classification(self):
+        e = WorkerError(("h", 1), "ValueError: x", transient=False)
+        assert isinstance(e, RuntimeError)  # legacy exception surface
+        assert not e.transient and "ValueError: x" in str(e)
+
+
+# ----------------------------------------------------------- fault injector
+
+
+class _StubConn:
+    """Records the ops that reach the 'wire'."""
+
+    fd = 7
+
+    def __init__(self):
+        self.sent, self.recvd, self.closed = [], 0, False
+
+    def send(self, msg_type, req_id, payload=b"", timeout_ms=30_000):
+        self.sent.append((msg_type, req_id))
+
+    def recv(self, timeout_ms):
+        self.recvd += 1
+        return (2, 1, b"")
+
+    def close(self):
+        self.closed = True
+
+
+class TestFaultInjector:
+    def test_same_schedule_same_event_sequence(self):
+        """The acceptance determinism pin: identical schedule + identical
+        op sequence → identical fault events, scripted AND probabilistic."""
+        spec = "seed=7;recv:2=close;send:3=drop;send:*=delay:0.0@0.4"
+        seqs = []
+        for _ in range(2):
+            fi = FaultInjector(spec)
+            [fi.decide("send") for _ in range(8)]
+            [fi.decide("recv") for _ in range(4)]
+            seqs.append(list(fi.events))
+        assert seqs[0] == seqs[1]
+        assert ("recv", 2, "close") in seqs[0]
+        assert ("send", 3, "drop") in seqs[0]
+        # a different seed re-rolls the probabilistic rules only
+        fi3 = FaultInjector("seed=8;recv:2=close;send:3=drop;"
+                            "send:*=delay:0.0@0.4")
+        [fi3.decide("send") for _ in range(8)]
+        [fi3.decide("recv") for _ in range(4)]
+        assert ("recv", 2, "close") in fi3.events
+
+    def test_parse_rejects_bad_specs(self):
+        with pytest.raises(ValueError, match="fault-schedule"):
+            FaultInjector("recv:1=explode")
+        with pytest.raises(ValueError, match="fault-schedule"):
+            FaultInjector("recv:*=drop")  # wildcard without @P
+        with pytest.raises(ValueError, match="fault-schedule"):
+            FaultInjector("recv:1=delay")  # delay without seconds
+
+    def test_faulty_connection_semantics(self):
+        fi = FaultInjector("send:1=drop;send:2=close;recv:1=error")
+        stub = _StubConn()
+        conn = FaultyConnection(stub, fi)
+        assert conn.fd == 7
+        conn.send(1, 1)  # dropped: never reaches the wire
+        assert stub.sent == []
+        with pytest.raises(WorkerDeadError, match="injected"):
+            conn.send(1, 2)  # closed
+        assert stub.closed
+        with pytest.raises(WorkerDeadError, match="injected"):
+            conn.recv(100)
+        # past the schedule everything passes through
+        conn.send(1, 3)
+        assert stub.sent == [(1, 3)]
+        assert conn.recv(100) is not None
+
+    def test_env_install_roundtrip(self, monkeypatch):
+        monkeypatch.setenv(resilience.FAULT_SCHEDULE_ENV, "send:1=drop")
+        resilience.install(None)
+        resilience._env_checked = False  # re-read the env
+        stub = _StubConn()
+        wrapped = resilience.wrap_connection(stub)
+        assert isinstance(wrapped, FaultyConnection)
+        resilience.install(None)
+        assert resilience.wrap_connection(stub) is stub
+
+
+# ------------------------------------------------------- live control plane
+
+
+@needs_native
+class TestBoundedRetry:
+    def test_transient_error_retries_then_succeeds(self):
+        proc, port = spawn_worker()
+        driver = DriverClient(
+            [("127.0.0.1", port)],
+            retry_policy=RetryPolicy(max_call_retries=3, base_s=0.01),
+            rejoin=False,
+        )
+        try:
+            [out] = driver.dispatch_objects(
+                [("flaky", {"key": "r", "fails": 2})], timeout_ms=20_000
+            )
+            assert out[0] == "ok"
+            snap = telemetry.metrics_snapshot()
+            assert snap["cp/retries"] == 2.0
+            assert driver.num_healthy == 1  # the worker was never demoted
+        finally:
+            driver.shutdown()
+            kill(proc)
+
+    def test_fatal_error_propagates_immediately(self):
+        proc, port = spawn_worker()
+        driver = DriverClient(
+            [("127.0.0.1", port)],
+            retry_policy=RetryPolicy(max_call_retries=5, base_s=0.01),
+            rejoin=False,
+        )
+        try:
+            with pytest.raises(RuntimeError, match="unknown op"):
+                driver.dispatch_objects([("nope", None)], timeout_ms=10_000)
+            assert "cp/retries" not in telemetry.metrics_snapshot()
+        finally:
+            driver.shutdown()
+            kill(proc)
+
+
+@needs_native
+class TestPoisonQuarantine:
+    def test_shard_failed_after_k_distinct_workers(self):
+        procs, addrs = [], []
+        for _ in range(2):
+            p, port = spawn_worker()
+            procs.append(p)
+            addrs.append(("127.0.0.1", port))
+        driver = DriverClient(
+            addrs,
+            retry_policy=RetryPolicy(max_call_retries=0, base_s=0.01),
+            poison_threshold=2, rejoin=False,
+        )
+        try:
+            with pytest.raises(ShardFailedError) as ei:
+                driver.dispatch_objects(
+                    [("flaky", {"key": "p", "fails": 99}),
+                     ("echo", 1), ("echo", 2)],
+                    timeout_ms=20_000,
+                )
+            err = ei.value
+            assert err.shard_index == 0
+            assert len(err.workers) == 2  # K DISTINCT workers
+            assert "shard 0" in str(err)
+            # the quarantine spared the workers — the whole point
+            assert driver.num_healthy == 2
+            assert telemetry.metrics_snapshot()["cp/poison_shards"] == 1.0
+        finally:
+            driver.shutdown()
+            for p in procs:
+                kill(p)
+
+    def test_allow_partial_returns_aligned_none(self):
+        procs, addrs = [], []
+        for _ in range(2):
+            p, port = spawn_worker()
+            procs.append(p)
+            addrs.append(("127.0.0.1", port))
+        driver = DriverClient(
+            addrs,
+            retry_policy=RetryPolicy(max_call_retries=0, base_s=0.01),
+            poison_threshold=2, rejoin=False,
+        )
+        try:
+            out = driver.dispatch_objects(
+                [("echo", 0), ("flaky", {"key": "q", "fails": 99}),
+                 ("echo", 2), ("echo", 3)],
+                timeout_ms=20_000, allow_partial=True,
+            )
+            assert out == [0, None, 2, 3]  # aligned with shards
+            assert driver.num_healthy == 2
+        finally:
+            driver.shutdown()
+            for p in procs:
+                kill(p)
+
+
+@needs_native
+class TestRejoin:
+    def test_killed_worker_rejoins_after_restart(self):
+        proc, port = spawn_worker()
+        driver = DriverClient(
+            [("127.0.0.1", port)],
+            retry_policy=RetryPolicy(base_s=0.05, max_backoff_s=0.2),
+            rejoin=True, rejoin_poll_s=0.05,
+        )
+        restarted = None
+        try:
+            assert driver.dispatch_objects([("echo", 1)], 10_000) == [1]
+            kill(proc)
+            assert driver.ping_all(timeout_ms=2000) == [False]
+            assert driver.num_healthy == 0
+            # restart ON THE SAME PORT: the reconnect loop re-dials the
+            # recorded address and re-admits after a PING
+            restarted, _ = spawn_worker(port=port)
+            deadline = time.monotonic() + 30
+            while driver.num_healthy < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert driver.num_healthy == 1, "worker never rejoined"
+            assert driver.rejoin_epoch >= 1
+            # capacity actually recovered: dispatch works again
+            assert driver.dispatch_objects([("echo", 2)], 10_000) == [2]
+            snap = telemetry.metrics_snapshot()
+            assert snap["cp/reconnects"] >= 1.0
+            assert snap["cp/healthy_workers"] == 1.0
+        finally:
+            driver.shutdown()
+            kill(proc)
+            if restarted is not None:
+                kill(restarted)
+
+    def test_remote_engine_rewarm_on_rejoin_epoch(self):
+        """The re-warm allowance: a bumped rejoin_epoch clears the remote
+        engine's warm keys, so the next round gets the cold (compile)
+        deadline instead of a spurious hang verdict."""
+        from distrl_llm_tpu.distributed.remote_engine import RemoteEngine
+
+        class FakeDriver:
+            num_healthy = 1
+            rejoin_epoch = 0
+
+        drv = FakeDriver()
+        eng = RemoteEngine(drv, max_prompt_tokens=8, max_new_tokens=4)
+        eng._warm_keys.add(((4,), 1))
+        # no epoch change → warm keys survive (steady state)
+        eng._seen_rejoin_epoch = drv.rejoin_epoch
+        drv.rejoin_epoch = 1
+        # generate()'s preamble is what clears; exercise the same logic
+        epoch = drv.rejoin_epoch
+        if epoch != eng._seen_rejoin_epoch:
+            eng._seen_rejoin_epoch = epoch
+            eng._warm_keys.clear()
+        assert eng._warm_keys == set()
+
+
+@needs_native
+class TestSigtermDrain:
+    def test_inflight_result_delivered_and_exit_zero(self):
+        proc, port = spawn_worker()
+        driver = DriverClient([("127.0.0.1", port)], rejoin=False)
+        res: dict = {}
+
+        def call():
+            try:
+                res["v"] = driver.dispatch_objects(
+                    [("sleep", 1.5)], timeout_ms=30_000
+                )
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                res["e"] = e
+
+        th = threading.Thread(target=call)
+        th.start()
+        time.sleep(0.4)  # the dispatch is in flight inside the handler
+        proc.send_signal(signal.SIGTERM)
+        th.join(timeout=30)
+        assert res.get("v") == ["slept"], res  # in-flight result DELIVERED
+        assert proc.wait(timeout=15) == 0  # graceful exit
+        out = proc.stdout.read()
+        assert "DRAINED" in out
+        driver.shutdown()
+
+    def test_idle_worker_drains_promptly(self):
+        proc, port = spawn_worker()
+        driver = DriverClient([("127.0.0.1", port)], rejoin=False)
+        assert driver.dispatch_objects([("echo", 1)], 10_000) == [1]
+        t0 = time.monotonic()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=10) == 0
+        assert time.monotonic() - t0 < 5
+        driver.shutdown()
+
+
+@needs_native
+class TestParallelPing:
+    def test_hung_workers_cost_one_timeout_not_n(self):
+        """3 'workers' that accept but never answer (raw listening sockets:
+        the kernel completes the TCP handshake, no PONG ever comes): the
+        sweep must cost ~one timeout total, not one per victim."""
+        import socket
+
+        socks, addrs = [], []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            s.listen(4)
+            socks.append(s)
+            addrs.append(("127.0.0.1", s.getsockname()[1]))
+        driver = DriverClient(addrs, rejoin=False)
+        try:
+            t0 = time.monotonic()
+            out = driver.ping_all(timeout_ms=1000)
+            elapsed = time.monotonic() - t0
+            assert out == [False, False, False]
+            # sequential would be >= 3s; parallel is ~1s (+ slack)
+            assert elapsed < 2.5, f"ping sweep took {elapsed:.1f}s"
+        finally:
+            driver.shutdown()
+            for s in socks:
+                s.close()
+
+
+@needs_native
+class TestExecutorTeardown:
+    def test_fatal_error_joins_drains_before_surfacing(self):
+        """A fatal worker error mid-pool must JOIN the sibling drain
+        threads before the exception surfaces — the old wait=False
+        teardown leaked threads that kept writing into ``results``."""
+        procs, addrs = [], []
+        for _ in range(2):
+            p, port = spawn_worker()
+            procs.append(p)
+            addrs.append(("127.0.0.1", port))
+        driver = DriverClient(addrs, rejoin=False)
+        try:
+            with pytest.raises(RuntimeError, match="unknown op"):
+                driver.dispatch_objects(
+                    [("nope", None), ("sleep", 1.0), ("echo", 1),
+                     ("echo", 2)],
+                    timeout_ms=20_000,
+                )
+            # the join happened: no drain thread is still running
+            leaked = [
+                t for t in threading.enumerate()
+                if t.name.startswith("cp-drain") and t.is_alive()
+            ]
+            assert not leaked, leaked
+        finally:
+            driver.shutdown()
+            for p in procs:
+                kill(p)
+
+
+@needs_native
+class TestDriverSideInjection:
+    def test_injected_close_triggers_resubmission(self):
+        """An installed injector faults the DRIVER's connections too: a
+        closed recv marks the worker dead and the shard resubmits to the
+        survivor — the scripted version of the SIGKILL test."""
+        procs, addrs = [], []
+        for _ in range(2):
+            p, port = spawn_worker()
+            procs.append(p)
+            addrs.append(("127.0.0.1", port))
+        # the driver's first recv (shard on worker 0) dies; everything
+        # after passes through
+        resilience.install(FaultInjector("recv:1=close"))
+        driver = DriverClient(addrs, rejoin=False)
+        try:
+            out = driver.dispatch_objects(
+                [("echo", 0), ("echo", 1)], timeout_ms=20_000
+            )
+            assert sorted(out) == [0, 1]
+            assert driver.num_healthy == 1  # the faulted conn was demoted
+            snap = telemetry.metrics_snapshot()
+            assert snap["cp/resubmits"] >= 1.0
+        finally:
+            resilience.install(None)
+            driver.shutdown()
+            for p in procs:
+                kill(p)
+
+
+# ------------------------------------------------------------- degrade path
+
+
+class TestDegradeAccounting:
+    def test_fill_lost_shards_zero_fills_and_accounts_rows(self):
+        from distrl_llm_tpu.distributed.remote_engine import RemoteEngine
+
+        class FakeDriver:
+            num_healthy = 2
+            rejoin_epoch = 0
+
+        eng = RemoteEngine(
+            FakeDriver(), max_prompt_tokens=8, max_new_tokens=4,
+            degrade_on_shard_failure=True,
+        )
+        ok = {
+            "tokens": np.ones((2, 3, 4), np.int32),
+            "lengths": np.full((2, 3), 4, np.int32),
+            "logprobs": np.full((2, 3, 4), -1.0, np.float32),
+        }
+        filled, lost = eng._fill_lost_shards([ok, None], sizes=[2, 2])
+        assert lost == [2, 3]  # the second shard's rows, exactly
+        assert filled[1]["tokens"].shape == (2, 3, 4)
+        assert filled[1]["tokens"].dtype == np.int32
+        assert int(filled[1]["lengths"].sum()) == 0
+        assert filled[1]["logprobs"].shape == (2, 3, 4)
+        assert telemetry.metrics_snapshot()["cp/degraded_groups"] == 2.0
+
+    def test_all_shards_lost_raises(self):
+        from distrl_llm_tpu.distributed.remote_engine import RemoteEngine
+
+        class FakeDriver:
+            num_healthy = 1
+            rejoin_epoch = 0
+
+        eng = RemoteEngine(
+            FakeDriver(), max_prompt_tokens=8, max_new_tokens=4,
+            degrade_on_shard_failure=True,
+        )
+        with pytest.raises(ShardFailedError, match="every shard"):
+            eng._fill_lost_shards([None, None], sizes=[2, 2])
+
+    def test_trainer_drops_lost_groups_with_conservation(self):
+        """The trainer side of degrade: groups whose rows a quarantined
+        shard lost are DROPPED from the candidate dict (never trained on
+        fabricated zeros), and kept + lost == the real batch."""
+        from distrl_llm_tpu.engine.fake import FakeEngine
+        from tests.test_trainer import make_trainer
+
+        trainer = make_trainer()
+        trainer.engine = FakeEngine(
+            trainer.tokenizer, lambda p, j: "<answer>x</answer>",
+            max_new_tokens=trainer.config.max_new_tokens,
+        )
+        trainer.engine.last_lost_rows = [1, 3]  # degrade: two groups lost
+        batch = {
+            "problem": ["q a", "q b", "q c", "q d"],
+            "solution": ["A", "B", "C", "D"],
+        }
+        [cand] = trainer._generate_round(
+            batch, trainer.config.train_sampling()
+        )
+        assert len(cand["answers"]) == 2  # kept
+        assert [p[0] for p in cand["problem"]] == ["q a", "q c"]
+        assert [s[0] for s in cand["solution"]] == ["A", "C"]
+        assert len(cand["answers"]) + 2 == 4  # conservation
+
+    def test_trainer_raises_when_every_group_lost(self):
+        from distrl_llm_tpu.engine.fake import FakeEngine
+        from tests.test_trainer import make_trainer
+
+        trainer = make_trainer()
+        trainer.engine = FakeEngine(
+            trainer.tokenizer, lambda p, j: "x",
+            max_new_tokens=trainer.config.max_new_tokens,
+        )
+        trainer.engine.last_lost_rows = [0, 1]
+        with pytest.raises(RuntimeError, match="every group"):
+            trainer._generate_round(
+                {"problem": ["q a", "q b"], "solution": ["A", "B"]},
+                trainer.config.train_sampling(),
+            )
+
+
+# ------------------------------------------------------ rollout supervision
+
+
+class TestProducerRestartBudget:
+    def _batches(self, n):
+        for i in range(n):
+            yield 0, i, {"problem": [f"p{i}"], "solution": [f"s{i}"]}
+
+    def test_transient_failures_consume_budget_then_succeed(self):
+        from distrl_llm_tpu.rollout import RolloutService, Trajectory, TrajectoryBuffer
+
+        buf = TrajectoryBuffer(16)
+        fails = {"left": 2}
+
+        def produce(e, bi, b):
+            if bi == 1 and fails["left"] > 0:
+                fails["left"] -= 1
+                raise OSError("transient rollout hiccup")
+            return [Trajectory(problem=b["problem"][0], solution="s",
+                               answers=["a"], token_lengths=[1])]
+
+        service = RolloutService(
+            produce, buf, self._batches(3), max_restarts=2,
+            retry_policy=RetryPolicy(base_s=0.01),
+        ).start()
+        got = []
+        while True:
+            batch = buf.get_batch(1, timeout=10)
+            if not batch:
+                break
+            got.extend(batch)
+        assert len(got) == 3
+        assert service.error is None and service.restarts_used == 2
+        snap = telemetry.metrics_snapshot()
+        assert snap["rollout/producer_restarts"] == 2.0
+        service.raise_if_failed()
+
+    def test_exhausted_budget_still_fails_loudly(self):
+        from distrl_llm_tpu.rollout import RolloutService, TrajectoryBuffer
+
+        buf = TrajectoryBuffer(4)
+
+        def boom(e, bi, b):
+            raise RuntimeError("engine died for real")
+
+        service = RolloutService(
+            boom, buf, self._batches(3), max_restarts=1,
+            retry_policy=RetryPolicy(base_s=0.01),
+        ).start()
+        assert buf.get_batch(1, timeout=10) == []  # closed by the failure
+        with pytest.raises(RuntimeError, match="engine died"):
+            service.raise_if_failed()
+        assert service.restarts_used == 1  # the budget WAS spent first
+
+
+# ------------------------------------------------------------ atomic export
+
+
+class TestAtomicAdapterExport:
+    def _lora(self):
+        return {"layers": {"wq": {
+            "a": np.zeros((1, 4, 2), np.float32),
+            "b": np.zeros((1, 2, 4), np.float32),
+        }}}
+
+    def test_writes_complete_artifact_and_no_tmp_leftovers(self, tmp_path):
+        from distrl_llm_tpu.checkpoint import load_adapter_file, save_adapter_file
+
+        target = tmp_path / "adapter"
+        save_adapter_file(self._lora(), str(target), rank=2, alpha=4.0)
+        assert (target / "adapter_model.safetensors").exists()
+        cfg = json.loads((target / "adapter_config.json").read_text())
+        assert cfg["r"] == 2
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp" in p]
+        assert not leftovers, leftovers
+        out = load_adapter_file(str(target), self._lora())
+        assert out["layers"]["wq"]["a"].shape == (1, 4, 2)
+
+    def test_failed_write_leaves_target_untouched(self, tmp_path, monkeypatch):
+        """A preemption mid-write (simulated: safetensors save raises after
+        creating a partial file) must not leave a truncated adapter at the
+        published path — the rollout-engine weight bus reads it."""
+        import safetensors.numpy as stn
+
+        from distrl_llm_tpu import checkpoint as ckpt
+
+        target = tmp_path / "adapter"
+        ckpt.save_adapter_file(self._lora(), str(target), rank=2, alpha=4.0)
+        before = (target / "adapter_model.safetensors").read_bytes()
+
+        real_save = stn.save_file
+
+        def partial_save(tensors, path):
+            with open(path, "wb") as f:
+                f.write(b"TRUNCATED")
+            raise OSError("preempted mid-write")
+
+        monkeypatch.setattr(stn, "save_file", partial_save)
+        with pytest.raises(OSError, match="preempted"):
+            ckpt.save_adapter_file(
+                self._lora(), str(target), rank=2, alpha=4.0
+            )
+        monkeypatch.setattr(stn, "save_file", real_save)
+        # the published artifact is byte-identical to the last good write
+        assert (target / "adapter_model.safetensors").read_bytes() == before
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp" in p]
+        assert not leftovers, leftovers

@@ -293,6 +293,40 @@ class TestRegistry:
         assert snap["rollout/dropped_stale"] == 3.0  # 2 evicted + 1 admission
         assert snap["rollout/staleness_count"] == 1.0
 
+    def test_cp_resilience_series_schema(self):
+        """Schema pin for the control-plane resilience registry names
+        (ISSUE 5): the series the DriverClient emits — and their TYPES —
+        land in the MetricsSink snapshot under exactly these names:
+        cp/healthy_workers is a GAUGE (last value wins), the rest are
+        COUNTERS (report-and-reset deltas)."""
+        from distrl_llm_tpu.distributed import resilience as r
+
+        assert r.CP_HEALTHY_GAUGE == "cp/healthy_workers"
+        assert r.CP_RECONNECTS == "cp/reconnects"
+        assert r.CP_RESUBMITS == "cp/resubmits"
+        assert r.CP_RETRIES == "cp/retries"
+        assert r.CP_POISON_SHARDS == "cp/poison_shards"
+        assert r.CP_DEGRADED_GROUPS == "cp/degraded_groups"
+        telemetry.gauge_set(r.CP_HEALTHY_GAUGE, 4)
+        telemetry.gauge_set(r.CP_HEALTHY_GAUGE, 3)  # gauge: last value
+        telemetry.counter_add(r.CP_RECONNECTS)
+        telemetry.counter_add(r.CP_RESUBMITS, 2)
+        telemetry.counter_add(r.CP_RETRIES)
+        telemetry.counter_add(r.CP_RETRIES)
+        telemetry.counter_add(r.CP_POISON_SHARDS)
+        telemetry.counter_add(r.CP_DEGRADED_GROUPS, 4)
+        snap = telemetry.metrics_snapshot()
+        assert snap["cp/healthy_workers"] == 3.0
+        assert snap["cp/reconnects"] == 1.0
+        assert snap["cp/resubmits"] == 2.0
+        assert snap["cp/retries"] == 2.0
+        assert snap["cp/poison_shards"] == 1.0
+        assert snap["cp/degraded_groups"] == 4.0
+        # counters report-and-reset: untouched series stay out of the next
+        # snapshot instead of logging zeros forever
+        snap2 = telemetry.metrics_snapshot()
+        assert "cp/reconnects" not in snap2
+
     def test_backpressure_counter_schema(self):
         import threading
 

@@ -40,6 +40,11 @@ stage "paged_blocked_smoke" env JAX_PLATFORMS=cpu \
 # the trace_report rollout section
 stage "rollout_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/rollout_smoke.py
+# fault-tolerance gate (ISSUE 5): a multi-worker training run survives a
+# seeded kill/restart of a worker mid-run — shards resubmit, the rejoin
+# loop recovers capacity, group accounting stays intact, SIGTERM drains
+stage "chaos_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/chaos_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one

@@ -65,6 +65,44 @@ def _intersect_us(a: list[tuple[int, int]], b: list[tuple[int, int]]) -> int:
     return total
 
 
+def resilience_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
+    """Per-worker fault-handling summary from the driver's resilience spans
+    (control_plane.py): reconnect attempts (``cp/reconnect``, with ok=),
+    shard resubmissions (``cp/resubmit``, count=), and transient-error
+    retries (``cp/retry``). One line per worker answers "which worker was
+    flapping and how much work moved because of it". Empty when the trace
+    has no resilience activity (healthy runs)."""
+    per: dict[str, dict[str, int]] = defaultdict(
+        lambda: {"reconnects": 0, "reconnect_ok": 0, "resubmits": 0,
+                 "retries": 0}
+    )
+    for (_pid, name), evs in spans.items():
+        if name not in ("cp/reconnect", "cp/resubmit", "cp/retry"):
+            continue
+        for e in evs:
+            args = e.get("args", {})
+            d = per[str(args.get("worker", "?"))]
+            if name == "cp/reconnect":
+                d["reconnects"] += 1
+                d["reconnect_ok"] += 1 if args.get("ok") else 0
+            elif name == "cp/resubmit":
+                d["resubmits"] += int(args.get("count", 1))
+            else:
+                d["retries"] += 1
+    if not per:
+        return []
+    lines = ["resilience:"]
+    for worker in sorted(per):
+        d = per[worker]
+        lines.append(
+            f"  {worker:<24} reconnects {d['reconnects']} "
+            f"({d['reconnect_ok']} ok) / resubmits {d['resubmits']} / "
+            f"retries {d['retries']}"
+        )
+    lines.append("")
+    return lines
+
+
 def rollout_section(events: list[dict],
                     spans: dict[tuple[int, str], list[dict]]) -> list[str]:
     """Async-rollout diagnosis from one trace: buffer occupancy over time
@@ -175,6 +213,7 @@ def build_report(events: list[dict], metadata: dict,
             return toks * 1e6 / us
         return None
 
+    lines.extend(resilience_section(spans))
     lines.extend(rollout_section(events, spans))
 
     prefill = tok_s(("engine/prefill",))

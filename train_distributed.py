@@ -149,6 +149,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "(host:port,...) to dispatch generation to; start "
                         "them with python -m "
                         "distrl_llm_tpu.distributed.worker_main --serve-model")
+    p.add_argument("--worker_rejoin", type=str, default="on",
+                   choices=["on", "off"],
+                   help="background reconnect loop for --rollout_workers: "
+                        "unhealthy workers are re-dialed with seeded "
+                        "backoff and re-admitted after a PING (capacity "
+                        "recovers instead of shrinking monotonically); "
+                        "'off' restores the pre-resilience behavior")
+    p.add_argument("--rpc_retries", type=int, default=2,
+                   help="transient worker-error retries per RPC (MSG_ERROR "
+                        "classified by exception type) before the shard is "
+                        "requeued to a different worker")
+    p.add_argument("--rpc_backoff_s", type=float, default=0.25,
+                   help="base delay of the seeded exponential backoff used "
+                        "by RPC retries, worker reconnects, and the async "
+                        "producer's supervised restarts")
+    p.add_argument("--poison_shard_k", type=int, default=3,
+                   help="poison-shard quarantine threshold: a shard that "
+                        "fails on this many DISTINCT workers raises "
+                        "ShardFailedError naming the shard instead of "
+                        "grinding every worker to unhealthy")
+    p.add_argument("--degrade_on_poison", action="store_true",
+                   help="on a quarantined shard, return the surviving "
+                        "groups (the trainer drops the lost prompts with "
+                        "conservation accounting, cp/degraded_groups) "
+                        "instead of failing the round")
+    p.add_argument("--producer_restarts", type=int, default=2,
+                   help="supervised restart budget for the async "
+                        "RolloutService producer: failed produce rounds "
+                        "retry in place this many times before the failure "
+                        "surfaces")
     p.add_argument("--dtype", type=str, default="bfloat16")
     p.add_argument("--seed", type=int, default=3407)
     p.add_argument("--no_print_samples", dest="print_samples",
@@ -247,6 +277,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         w.strip() for w in str(args.rollout_workers or "").split(",") if w.strip()
     )
     fields["autotune"] = args.autotune == "on"
+    fields["worker_rejoin"] = args.worker_rejoin == "on"
     return TrainConfig(mesh=mesh, **fields)
 
 
