@@ -220,11 +220,16 @@ def _paged_dispatch_choice():
     """Which paged-attention impl the probe chain actually dispatched
     ("native"/"native_folded"/"native_blocked"/"fixed"/"jaxlib"/
     "reference"), or None if no paged dispatch ran. Distinct per-config
-    choices are joined with '+'."""
+    choices are joined with '+'. Verify-marked records (the speculative
+    draft-block dispatch — nonzero verify_len in the key) describe a
+    DIFFERENT decision and are reported via spec_verify_impl instead."""
     import importlib
 
     paged_mod = importlib.import_module("distrl_llm_tpu.ops.paged")
-    choices = sorted(set(paged_mod.dispatch_choices.values()))
+    choices = sorted({
+        v for k, v in paged_mod.dispatch_choices.items()
+        if not paged_mod.dispatch_key_is_verify(k)
+    })
     return "+".join(choices) if choices else None
 
 
@@ -552,18 +557,19 @@ def main() -> int:
                     "defaults",
                     file=sys.stderr,
                 )
-            # a "speculative" winner can only be reproduced when the spec
-            # scaffolding (draft length + slot cap — NOT in the plan space)
-            # is supplied explicitly; applying its OTHER knobs to a
-            # non-speculative run would bench an unmeasured combination,
-            # so in that case too the whole plan is skipped, loudly
+            # a "speculative" winner is self-describing since the plan
+            # space grew spec fields (spec_draft_len/spec_drafter/
+            # spec_verify — ISSUE 6): the draft config comes from the plan
+            # itself, and only the slot cap (not a plan-space choice)
+            # defaults to the benched row count. Pre-spec-field DB entries
+            # (spec_draft_len 0) still need explicit BENCH_SPEC_DRAFT.
             elif plan.decode_path == "speculative" and not (
-                os.environ.get("BENCH_SPEC_DRAFT")
-                and os.environ.get("BENCH_MAX_CONCURRENT")
+                os.environ.get("BENCH_SPEC_DRAFT") or plan.spec_draft_len
             ):
                 print(
-                    "bench: stored plan is speculative but BENCH_SPEC_DRAFT/"
-                    "BENCH_MAX_CONCURRENT are unset — using static defaults",
+                    "bench: stored plan is speculative but carries no "
+                    "spec_draft_len and BENCH_SPEC_DRAFT is unset — using "
+                    "static defaults",
                     file=sys.stderr,
                 )
             else:
@@ -574,6 +580,22 @@ def main() -> int:
                     os.environ.setdefault("BENCH_ENGINE", "paged")
                     if plan.decode_path == "speculative":
                         os.environ.setdefault("BENCH_SCHEDULER", "refill")
+                        if plan.spec_draft_len:
+                            os.environ.setdefault(
+                                "BENCH_SPEC_DRAFT", str(plan.spec_draft_len)
+                            )
+                        if plan.spec_drafter:
+                            os.environ.setdefault(
+                                "BENCH_SPEC_DRAFTER", plan.spec_drafter
+                            )
+                        if plan.spec_verify:
+                            os.environ.setdefault(
+                                "BENCH_SPEC_VERIFY", plan.spec_verify
+                            )
+                        os.environ.setdefault(
+                            "BENCH_MAX_CONCURRENT",
+                            str(min(n_prompts * n_cand, 128)),
+                        )
                 plan_applied = True
         if not plan_applied:
             os.environ.setdefault("BENCH_SCAN_CHUNK", "16")
@@ -627,8 +649,17 @@ def main() -> int:
             # walks the probe-gated chain
             engine_kwargs["paged_impl"] = os.environ["BENCH_PAGED_IMPL"]
         if os.environ.get("BENCH_SPEC_DRAFT"):
-            # n-gram speculative decoding (needs the refill scheduler + cap)
+            # speculative decoding (needs the refill scheduler + cap)
             engine_kwargs["spec_draft"] = int(os.environ["BENCH_SPEC_DRAFT"])
+            if os.environ.get("BENCH_SPEC_DRAFTER"):
+                # "ngram" (prompt lookup) | "self" (previous-LoRA drafter)
+                engine_kwargs["spec_drafter"] = os.environ[
+                    "BENCH_SPEC_DRAFTER"]
+            if os.environ.get("BENCH_SPEC_VERIFY"):
+                # "fused" (one-sweep verify kernel) | "unrolled" (A/B)
+                engine_kwargs["spec_verify"] = os.environ["BENCH_SPEC_VERIFY"]
+            if os.environ.get("BENCH_SPEC_ADAPT") == "1":
+                engine_kwargs["spec_adapt"] = True
         if os.environ.get("BENCH_KV_PAGES"):
             # refill decode-page pool budget (--actor_gpu_usage equivalent);
             # exercises page-gated admission + preempt-by-recompute
@@ -704,6 +735,10 @@ def main() -> int:
     total_tokens = 0
     sum_steps = sum_alive = 0
     have_steps = have_alive = True
+    # engine.last_spec_stats covers ONE generate() round; steps_dispatched
+    # sums over all repeats, so the grid totals must be summed the same way
+    # or the quotient is ~repeats× off
+    sum_spec_grid = spec_grid_rounds = 0
     for i in range(repeats):
         result, dt_i = run(1 + i)
         timed.append(dt_i)
@@ -718,6 +753,12 @@ def main() -> int:
             have_alive = False
         else:
             sum_alive += result.alive_slot_steps
+        st = getattr(engine, "last_spec_stats", None)
+        if st and st.get("verify_grid_steps"):
+            sum_spec_grid += (
+                st["verify_grid_steps"] + st.get("draft_grid_steps", 0)
+            )
+            spec_grid_rounds += 1
     steps_dispatched = sum_steps if have_steps else None
     alive_slot_steps = sum_alive if have_alive else None
     dt = sum(timed)
@@ -792,13 +833,52 @@ def main() -> int:
         _paged_grid_steps_per_call(engine, cfg, slot_rows)
         if os.environ.get("BENCH_ENGINE") == "paged" else None
     )
-    # the speculative verify forward fans out one op call per draft
-    # position (plus the pending token) per layer per step
-    calls_per_step = spec_ran + 1 if spec_ran else 1
-    grid_steps_estimate = (
-        grid_per_call * cfg.num_layers * calls_per_step
-        if grid_per_call else grid_per_call
-    )
+    # speculative grid model (ISSUE 6): with the FUSED verify kernel the
+    # whole (d+1)-token verify costs ONE blocked sweep per layer per step
+    # (paged_grid_steps("native_verify")); unrolled verify pays the decode
+    # per-call count (d+1) times; the self drafter adds d plain decode
+    # calls per step either way
+    spec_stats = getattr(engine, "last_spec_stats", None) if spec_ran else None
+    spec_verify_ran = None
+    if spec_stats:
+        vbase = (spec_stats.get("verify_impl") or "").split("!")[0]
+        spec_verify_ran = (
+            "fused" if vbase == "native_verify"
+            else ("unrolled" if vbase else None)
+        )
+    if spec_ran and spec_grid_rounds == repeats and steps_dispatched:
+        # the engine accumulated the EXACT layer-scaled grid cost per
+        # dispatch (each step's own verify decision and effective draft
+        # length) — prefer it over the configured-d analytic model, which
+        # overstates after the BENCH_SPEC_ADAPT controller shrinks d.
+        # Summed per repeat above (all repeats must have contributed, else
+        # fall back to the analytic model) to match the steps_dispatched
+        # denominator's all-repeats scope.
+        grid_steps_estimate = round(sum_spec_grid / steps_dispatched)
+    elif spec_ran and grid_per_call is not None:
+        from distrl_llm_tpu.ops.paged import paged_grid_steps
+
+        if spec_verify_ran == "fused":
+            verify_per_step = paged_grid_steps(
+                "native_verify", batch=slot_rows,
+                num_kv_heads=cfg.num_kv_heads,
+                pps=engine.prompt_pages + engine.private_pages,
+                pages_per_block=getattr(engine, "pages_per_block", 0) or 0,
+            )
+        else:
+            verify_per_step = grid_per_call * (spec_ran + 1)
+        draft_per_step = (
+            grid_per_call * spec_ran
+            if getattr(engine, "spec_drafter", "ngram") == "self" else 0
+        )
+        grid_steps_estimate = (
+            (verify_per_step + draft_per_step) * cfg.num_layers
+        )
+    else:
+        grid_steps_estimate = (
+            grid_per_call * cfg.num_layers if grid_per_call
+            else grid_per_call
+        )
     us_per_grid_step = None
     if grid_steps_estimate and steps_dispatched and dt > 0:
         us_per_grid_step = round(
@@ -809,6 +889,21 @@ def main() -> int:
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
         "scheduler": scheduler_ran,
         "spec_draft": spec_ran,
+        # speculative self-description (ISSUE 6, pinned in
+        # tests/test_bench_contract.py): which drafter proposed, the
+        # realized draft-slot accept rate, mean tokens emitted per verify
+        # step (engine-accounted, last timed round), and which verify
+        # sweep actually ran ("fused" one-sweep kernel vs "unrolled")
+        "spec_drafter": (
+            getattr(engine, "spec_drafter", None) if spec_ran else None
+        ),
+        "spec_accept_rate": (
+            spec_stats.get("accept_rate") if spec_stats else None
+        ),
+        "tokens_per_verify_step": (
+            spec_stats.get("tokens_per_verify_step") if spec_stats else None
+        ),
+        "spec_verify_impl": spec_verify_ran,
         "tokens_per_slot_step": accept_rate,
         "eos_rate": eos_rate,
         "mean_gen_tokens": round(mean_new, 1),

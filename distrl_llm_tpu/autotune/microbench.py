@@ -152,15 +152,61 @@ def build_engine_for_plan(
     )
     if plan.decode_path == "paged":
         return PagedGenerationEngine(model_cfg, **paged_kw, **common)
-    # speculative: refill scheduler hosts it; slots capped at the row count
+    # speculative: refill scheduler hosts it; slots capped at the row
+    # count. The plan's spec fields ARE the candidate (draft length,
+    # drafter, verify kernel); ``spec_draft`` only backstops pre-spec-field
+    # plans (spec_draft_len 0), and 0-valued satellites fall back to the
+    # engine defaults via None
     return PagedGenerationEngine(
         model_cfg,
         scheduler="refill",
         max_concurrent_rows=max(min(rows, 64), 1),
-        spec_draft=spec_draft,
+        spec_draft=plan.spec_draft_len or spec_draft,
+        spec_ngram=plan.spec_ngram_k or None,
+        spec_drafter=plan.spec_drafter,
+        spec_verify=plan.spec_verify,
         **paged_kw,
         **common,
     )
+
+
+def _perturbed_drafter(lora, *, rel: float = 0.05, seed: int = 0):
+    """A deterministically noise-perturbed copy of ``lora`` to stand in as
+    the self-drafter's 'previous version' during a microbench.
+
+    With nothing pushed through the mailbox the self-drafter would fall
+    back to the TARGET adapter itself — q == p, acceptance ≡ 1.0, and every
+    'self' candidate would be scored at the best case it can ever achieve
+    (systematically optimistic vs the production regime, where the drafter
+    is a genuinely superseded version). A small relative perturbation
+    (``rel`` × per-leaf RMS, seeded) keeps the drafter NEAR-on-policy — the
+    regime PipelineRL argues production actually sits in — while pushing
+    the measured acceptance off the trivial upper bound. The measurement is
+    still a proxy (the real update delta is unknowable offline); bench A/B
+    on the live run remains the ground truth for drafter choice."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(lora)
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for i, leaf in enumerate(leaves):
+        leaf = jnp.asarray(leaf)
+        rms = float(
+            jnp.sqrt(jnp.mean(jnp.square(leaf.astype(jnp.float32))))
+        )
+        if rms == 0.0:
+            # zero-init leaves (LoRA B matrices) are exactly the ones whose
+            # production updates make the drafter differ — perturb them at
+            # the init-scheme's own fan scale instead of not at all
+            rms = leaf.shape[-1] ** -0.5
+        noise = jax.random.normal(
+            jax.random.fold_in(key, i), leaf.shape, jnp.float32
+        )
+        out.append(
+            (leaf.astype(jnp.float32) + rel * rms * noise).astype(leaf.dtype)
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def tune_geometry(
@@ -206,6 +252,15 @@ def tune_geometry(
             log.warning("autotune: %s infeasible: %s", plan.to_dict(), reason)
             results.append(CandidateResult(plan, False, 0.0, 0.0, 0.0, 0, reason))
             continue
+        if plan.spec_drafter == "self" and lora is None:
+            # the self-drafter IS the previous adapter version — with no
+            # adapter there is nothing to draft from (mirrors the config
+            # validation: spec_drafter='self' requires a LoRA run)
+            results.append(CandidateResult(
+                plan, False, 0.0, 0.0, 0.0, 0,
+                "spec_drafter='self' requires a LoRA adapter to measure",
+            ))
+            continue
         try:
             engine = build_engine_for_plan(
                 model_cfg, plan,
@@ -213,6 +268,13 @@ def tune_geometry(
                 max_new_tokens=max_new_tokens, rows=rows,
                 pad_id=pad_id, kv_quant=kv_quant,
             )
+            if plan.spec_drafter == "self":
+                # seed the mailbox's superseded-adapter slot: without this
+                # the drafter falls back to the target adapter (q == p,
+                # acceptance ≡ 1.0) and 'self' scores its own unreachable
+                # best case — see _perturbed_drafter
+                engine._prev_lora = _perturbed_drafter(lora)
+                engine._prev_lora_version = -1
             sampling = SamplingConfig(
                 max_tokens=max_new_tokens, temperature=1.2, top_p=0.95,
                 n=n_candidates, top_p_impl=plan.top_p_impl,

@@ -31,6 +31,11 @@ from dataclasses import dataclass
 DECODE_PATHS = ("dense", "paged", "speculative")
 FORMULATIONS = (None, "dot", "mulred")
 PAGED_KERNELS = (None, "one_page", "folded", "blocked")
+SPEC_DRAFTERS = (None, "ngram", "self")
+SPEC_VERIFIES = (None, "fused", "unrolled")
+#: draft lengths beyond this waste verify width faster than they amortize
+#: weight reads (and the engine rejects them) — plan validation mirrors it
+MAX_SPEC_DRAFT_LEN = 16
 
 #: plan-field ↔ engine ``paged_impl`` spellings of the native paged-kernel
 #: variants (the engine kwarg predates the plan field; "auto"/"kernel"/
@@ -79,6 +84,20 @@ class ExecutionPlan:
     # kernel default (ops.paged.DEFAULT_PAGES_PER_BLOCK). Only consumed by
     # paged_kernel="blocked"
     pages_per_block: int = 0
+    # ---- speculative decoding (decode_path="speculative"; engines only
+    # adopt these from the DB when they run the refill scheduler — the
+    # slot machinery that hosts speculation). 0/None = the engines'
+    # historical defaults (off / k=2 / "ngram" / "fused").
+    # draft tokens proposed per verify step
+    spec_draft_len: int = 0
+    # n-gram lookup size for the "ngram" drafter; 0 = engine default (2)
+    spec_ngram_k: int = 0
+    # draft source: "ngram" (prompt lookup) | "self" (the policy's own
+    # previous LoRA version, off the LoraMailbox swap log)
+    spec_drafter: str | None = None
+    # verify attention: "fused" (one blocked sweep for the whole draft
+    # block — ops/paged_native.py) | "unrolled" (d+1 per-position calls)
+    spec_verify: str | None = None
 
     def __post_init__(self):
         if self.decode_path not in DECODE_PATHS:
@@ -120,6 +139,28 @@ class ExecutionPlan:
             raise ValueError(
                 f"pages_per_block must be an int >= 0, got "
                 f"{self.pages_per_block!r}"
+            )
+        if (
+            not isinstance(self.spec_draft_len, int)
+            or not 0 <= self.spec_draft_len <= MAX_SPEC_DRAFT_LEN
+        ):
+            raise ValueError(
+                f"spec_draft_len must be an int in [0, {MAX_SPEC_DRAFT_LEN}],"
+                f" got {self.spec_draft_len!r}"
+            )
+        if not isinstance(self.spec_ngram_k, int) or self.spec_ngram_k < 0:
+            raise ValueError(
+                f"spec_ngram_k must be an int >= 0, got {self.spec_ngram_k!r}"
+            )
+        if self.spec_drafter not in SPEC_DRAFTERS:
+            raise ValueError(
+                f"spec_drafter must be one of {SPEC_DRAFTERS}, got "
+                f"{self.spec_drafter!r}"
+            )
+        if self.spec_verify not in SPEC_VERIFIES:
+            raise ValueError(
+                f"spec_verify must be one of {SPEC_VERIFIES}, got "
+                f"{self.spec_verify!r}"
             )
 
     def replace(self, **kw) -> "ExecutionPlan":
@@ -232,12 +273,17 @@ def candidate_plans(
     top_p_impls=(None,),
     paged_kernels=(None,),
     pages_per_blocks=(0,),
+    spec_draft_lens=(0,),
+    spec_drafters=(None,),
+    spec_verifies=(None,),
 ) -> list[ExecutionPlan]:
     """Enumerate a candidate space for the tuner (cartesian product, with
     the always-meaningless combos dropped: a formulation override without a
     dense path, a scan_chunk of 1 — scan-of-one has no fusion benefit and
     the engines refuse to report it as chunked, a paged-kernel pin on the
-    dense path, a pages_per_block without the blocked kernel)."""
+    dense path, a pages_per_block without the blocked kernel, spec knobs
+    anywhere but the speculative path — and a speculative path with no
+    draft length, which is just the paged path wearing a costume)."""
     out = []
     for path in decode_paths:
         for chunk in scan_chunks:
@@ -252,10 +298,25 @@ def candidate_plans(
                     for ppb in pages_per_blocks:
                         if ppb and pk != "blocked":
                             continue
-                        for tp in top_p_impls:
-                            out.append(ExecutionPlan(
-                                decode_path=path, scan_chunk=chunk,
-                                cache_read_formulation=form, top_p_impl=tp,
-                                paged_kernel=pk, pages_per_block=ppb,
-                            ))
+                        for sd in spec_draft_lens:
+                            if (sd > 0) != (path == "speculative"):
+                                continue
+                            for drafter in spec_drafters:
+                                if drafter is not None and not sd:
+                                    continue
+                                for sv in spec_verifies:
+                                    if sv is not None and not sd:
+                                        continue
+                                    for tp in top_p_impls:
+                                        out.append(ExecutionPlan(
+                                            decode_path=path,
+                                            scan_chunk=chunk,
+                                            cache_read_formulation=form,
+                                            top_p_impl=tp,
+                                            paged_kernel=pk,
+                                            pages_per_block=ppb,
+                                            spec_draft_len=sd,
+                                            spec_drafter=drafter,
+                                            spec_verify=sv,
+                                        ))
     return out

@@ -111,28 +111,46 @@ def resolve_plan(
                 stored = store.get(any_key)
                 if stored is not None:
                     key = any_key
-            if (
-                stored is not None
-                and "decode_path" in requested
-                and stored.decode_path != requested["decode_path"]
-            ):
-                # the stored plan was measured on a DIFFERENT decode path
-                # than the caller is pinned to (e.g. the tuner's winner was
-                # paged, this is a dense engine): its scan_chunk/top_p were
-                # never measured here, and adopting them would be exactly
-                # the unmeasured-lever regression this subsystem exists to
-                # prevent — treat the entry as a miss
-                log.debug(
-                    "autotune: %s stored plan is for decode_path=%s but the "
-                    "caller pinned %s — ignoring the entry",
-                    key, stored.decode_path, requested["decode_path"],
+            if stored is not None and "decode_path" in requested:
+                # ``decode_path`` may be a single pin or a tuple of paths
+                # the caller can actually host (a refill engine with spec
+                # unpinned hosts "paged" OR "speculative" — which one is
+                # exactly what the DB decides)
+                req_path = requested["decode_path"]
+                allowed = (
+                    (req_path,) if isinstance(req_path, str) else tuple(req_path)
                 )
-                stored = None
+                if stored.decode_path not in allowed:
+                    # the stored plan was measured on a decode path the
+                    # caller cannot host (e.g. the tuner's winner was
+                    # paged, this is a dense engine): its scan_chunk/top_p
+                    # were never measured here, and adopting them would be
+                    # exactly the unmeasured-lever regression this
+                    # subsystem exists to prevent — treat the entry as a
+                    # miss
+                    log.debug(
+                        "autotune: %s stored plan is for decode_path=%s but "
+                        "the caller pinned %s — ignoring the entry",
+                        key, stored.decode_path, req_path,
+                    )
+                    stored = None
 
         fields: dict = {}
         sources: dict[str, str] = {}
         for name in TUNABLE_FIELDS:
-            if name in requested:
+            if name == "decode_path" and not isinstance(
+                requested.get(name, ""), str
+            ):
+                # tuple form: a CONSTRAINT, not a pin — the surviving
+                # stored entry names the path that actually runs; with no
+                # entry the first element is the caller's default path
+                if stored is not None:
+                    fields[name] = stored.decode_path
+                    sources[name] = "db"
+                else:
+                    fields[name] = tuple(requested[name])[0]
+                    sources[name] = "default"
+            elif name in requested:
                 fields[name] = requested[name]
                 sources[name] = "user"
             elif stored is not None:

@@ -281,13 +281,40 @@ class TrainConfig:
     # instead of draining whole waves. Requires engine_impl="paged" and a
     # max_concurrent_sequences cap.
     continuous_batching: bool = False
-    # n-gram speculative decoding (prompt lookup) for the paged refill
-    # engine: draft spec_draft tokens from the sequence's own history and
-    # verify them in one forward; rejection sampling keeps the output
-    # distribution identical to plain decoding (exact under greedy).
-    # Requires continuous_batching. 0 = off.
-    spec_draft: int = 0
-    spec_ngram: int = 2
+    # speculative decoding for the paged refill engine: draft spec_draft
+    # tokens per step and verify them in one forward (the verify attention
+    # runs as ONE fused blocked kernel sweep — spec_verify); rejection
+    # sampling keeps the output distribution identical to plain decoding
+    # (exact under greedy). Requires continuous_batching. None = engine
+    # default (off unless a tuned plan-DB entry for this geometry says
+    # otherwise); an EXPLICIT value — INCLUDING 0 — pins the choice past
+    # any stored plan (the decode_scan_chunk convention: default ≠ pin),
+    # so --spec_draft 0 is always a real A/B control.
+    spec_draft: int | None = None
+    # lookup n-gram size for the ngram drafter. None = engine default (2)
+    # unless a tuned plan-DB entry says otherwise; an explicit value pins
+    # past any stored plan (the decode_scan_chunk convention).
+    spec_ngram: int | None = None
+    # draft source: "ngram" (prompt lookup over the row's own history) or
+    # "self" — the policy's own PREVIOUS LoRA version, sourced from the
+    # in-flight weight-update swap log (PipelineRL: recent-checkpoint
+    # weights stay near-on-policy, so the previous version is a
+    # high-acceptance draft model for free). "self" needs a LoRA run (the
+    # drafter rides the adapter mailbox; full_finetune has no adapter
+    # stream to draft from). None = engine default ("ngram") unless a
+    # tuned plan-DB entry says otherwise; an EXPLICIT value — including
+    # "ngram" itself — pins the choice past any stored plan (the
+    # decode_scan_chunk convention: default ≠ pin).
+    spec_drafter: str | None = None
+    # verify-attention kernel: "fused" (one blocked Pallas sweep for the
+    # whole draft block; probe-gated with an exact unrolled fallback) or
+    # "unrolled" (d+1 per-position dispatches — the A/B control). None =
+    # engine default ("fused") / plan-DB; explicit value pins.
+    spec_verify: str | None = None
+    # acceptance-rate-driven draft-length adaptation: shrink the effective
+    # draft length (halving, floor 1) when the accept-rate EMA says drafts
+    # are being wasted, grow it back when acceptance recovers
+    spec_adapt: bool = False
     # Rollout/learner coupling regime (distrl_llm_tpu/rollout):
     #   "sync"      — the reference's strictly synchronous loop: generation
     #                 and learning serialize; byte-identical to the pre-async
@@ -507,6 +534,67 @@ class TrainConfig:
             raise ValueError(
                 "spec_draft (speculative decoding) requires "
                 "continuous_batching (the refill scheduler hosts it)"
+            )
+        if self.spec_draft is not None and not 0 <= self.spec_draft <= 16:
+            raise ValueError(
+                f"spec_draft must be in [0, 16] (longer draft blocks waste "
+                f"verify width faster than they amortize weight reads), got "
+                f"{self.spec_draft}"
+            )
+        if self.spec_ngram is not None and self.spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {self.spec_ngram}")
+        if self.spec_drafter not in (None, "ngram", "self"):
+            raise ValueError(
+                f"spec_drafter must be 'ngram' or 'self', got "
+                f"{self.spec_drafter!r}"
+            )
+        if self.spec_verify not in (None, "fused", "unrolled"):
+            raise ValueError(
+                f"spec_verify must be 'fused' or 'unrolled', got "
+                f"{self.spec_verify!r}"
+            )
+        # the satellite knobs are dead flags unless speculation can engage:
+        # loud errors here keep this entry point consistent with
+        # worker_main's parser (which rejects the same combinations)
+        # instead of silently running plain decode
+        if not self.continuous_batching and (
+            self.spec_ngram is not None or self.spec_drafter is not None
+            or self.spec_verify is not None or self.spec_adapt
+        ):
+            raise ValueError(
+                "spec_ngram/spec_drafter/spec_verify/spec_adapt configure "
+                "speculative decoding, which requires continuous_batching "
+                "(the refill scheduler hosts it) — they would be silently "
+                "ignored"
+            )
+        if self.spec_draft == 0 and (
+            self.spec_ngram is not None or self.spec_drafter is not None
+            or self.spec_verify is not None
+        ):
+            raise ValueError(
+                "spec_ngram/spec_drafter/spec_verify with spec_draft=0: an "
+                "explicit 0 pins speculation off, so they would be "
+                "silently ignored (leave spec_draft unset to let the plan "
+                "DB decide)"
+            )
+        # spec_draft None counts: a plan-DB entry may enable speculation at
+        # engine construction, and full_finetune never grows an adapter
+        # stream, so the combination is invalid whenever speculation COULD
+        # engage (only an explicit 0 pins it off)
+        if self.spec_drafter == "self" and self.spec_draft != 0:
+            if self.full_finetune:
+                raise ValueError(
+                    "spec_drafter='self' drafts with the policy's previous "
+                    "LoRA adapter (the weight-update mailbox stream) — "
+                    "full_finetune has no adapter stream; use "
+                    "spec_drafter='ngram'"
+                )
+        if self.spec_adapt and self.spec_draft == 0:
+            # spec_draft=None stays legal here: a tuned plan-DB entry may
+            # enable speculation, and the engine re-validates post-resolution
+            raise ValueError(
+                "spec_adapt adapts the speculative draft length — set "
+                "spec_draft > 0"
             )
         if self.inflight_weight_updates:
             if not self.async_rollout:

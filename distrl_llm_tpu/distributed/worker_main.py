@@ -44,7 +44,10 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
                  engine_impl: str = "dense", kv_quant: str = "none",
                  max_concurrent: int = 0, scheduler: str = "waves",
-                 spec_draft: int = 0, gpu_usage: float = 0.0,
+                 spec_draft: int | None = None, spec_ngram: int | None = None,
+                 spec_drafter: str | None = None,
+                 spec_verify: str | None = None, spec_adapt: bool = False,
+                 gpu_usage: float = 0.0,
                  budget_batch: int = 0, scan_chunk: int | None = None,
                  autotune: bool = True, plan_db: str | None = None,
                  capture_logprobs: bool = False) -> None:
@@ -101,8 +104,20 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     if engine_impl == "paged":
         engine_cls = PagedGenerationEngine
         kwargs["scheduler"] = scheduler
-        if spec_draft:
+        # trainer-side convention (engine_kwargs_from_config): an explicit
+        # value — INCLUDING --spec-draft 0 — always wins, so a worker-side
+        # spec-off A/B control holds even when this host's plan DB stores a
+        # speculative winner; None = unpinned, engine default / plan-DB
+        if spec_draft is not None:
             kwargs["spec_draft"] = spec_draft
+        if spec_ngram is not None:
+            kwargs["spec_ngram"] = spec_ngram
+        if spec_drafter is not None:
+            kwargs["spec_drafter"] = spec_drafter
+        if spec_verify is not None:
+            kwargs["spec_verify"] = spec_verify
+        if spec_adapt:
+            kwargs["spec_adapt"] = True
         if gpu_usage > 0:
             # --actor-gpu-usage → KV page budget, same contract as the
             # trainer's local engine (engine/budget.py)
@@ -123,7 +138,12 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                 max_prompt_tokens=max_prompt_tokens,
                 max_new_tokens=max_new_tokens,
                 page_size=DEFAULT_PAGE_SIZE, kv_quant=kv_quant,
-                spec_draft=spec_draft,
+                # pool sizing sees only the EXPLICIT draft length (trainer
+                # convention): a plan-DB entry that enables speculation
+                # (spec_draft None) isn't resolved until engine
+                # construction, so its ≤d extra resident tokens/row ride
+                # the pool's refill-admission slack instead
+                spec_draft=spec_draft or 0,
             )
     else:
         engine_cls = GenerationEngine
@@ -247,9 +267,28 @@ def main(argv: list[str] | None = None) -> None:
                         choices=["waves", "refill"],
                         help="paged-engine batching: whole-prompt waves or "
                              "per-candidate slot refill (continuous batching)")
-    parser.add_argument("--spec-draft", type=int, default=0,
-                        help="n-gram speculative decoding draft length "
-                             "(requires --scheduler refill)")
+    parser.add_argument("--spec-draft", type=int, default=None,
+                        help="speculative decoding draft length (requires "
+                             "--scheduler refill); 0 pins speculation OFF "
+                             "past any stored plan; unset = this host's "
+                             "autotune plan DB decides. An explicit value, "
+                             "including 0, always wins")
+    parser.add_argument("--spec-ngram", type=int, default=None,
+                        help="n-gram size for --spec-draft (unset = engine "
+                             "default / plan-DB)")
+    parser.add_argument("--spec-drafter", choices=["ngram", "self"],
+                        default=None,
+                        help="draft source for --spec-draft: 'ngram' or "
+                             "'self' (the previous adapter off the weight-"
+                             "push stream; needs a LoRA run). Unset = "
+                             "engine default / plan-DB")
+    parser.add_argument("--spec-verify", choices=["fused", "unrolled"],
+                        default=None,
+                        help="verify-attention kernel for --spec-draft "
+                             "(unset = engine default / plan-DB)")
+    parser.add_argument("--spec-adapt", action="store_true",
+                        help="acceptance-rate-driven draft-length "
+                             "adaptation (requires --spec-draft)")
     parser.add_argument("--actor-gpu-usage", type=float, default=0.0,
                         help="HBM fraction for weights+KV (vLLM "
                              "gpu_memory_utilization); sizes the paged "
@@ -296,8 +335,31 @@ def main(argv: list[str] | None = None) -> None:
         telemetry.configure(enabled=True)
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
-    if args.spec_draft and args.scheduler != "refill":
-        parser.error("--spec-draft requires --scheduler refill")
+    if args.scheduler != "refill" and (
+        args.spec_draft or args.spec_ngram is not None
+        or args.spec_drafter is not None or args.spec_verify is not None
+        or args.spec_adapt
+    ):
+        # the satellite pins too: a non-refill engine requests the plain
+        # paged decode path, so a stored speculative plan can never engage
+        # and the flags would be guaranteed no-ops
+        parser.error(
+            "--spec-draft/--spec-ngram/--spec-drafter/--spec-verify/"
+            "--spec-adapt require --scheduler refill (the refill "
+            "scheduler hosts speculative decoding)"
+        )
+    # unset (None) stays legal with the satellite pins: this host's plan DB
+    # may enable speculation, and the engine re-validates post-resolution
+    # (config.py convention); only an EXPLICIT 0 makes them dead flags
+    if args.spec_draft == 0 and (
+        args.spec_ngram is not None or args.spec_drafter is not None
+        or args.spec_verify is not None or args.spec_adapt
+    ):
+        parser.error(
+            "--spec-ngram/--spec-drafter/--spec-verify/--spec-adapt "
+            "require --spec-draft > 0 (--spec-draft 0 pins speculation "
+            "off, so they would be silently ignored)"
+        )
     if args.scheduler == "refill" and not args.max_concurrent_sequences:
         parser.error(
             "--scheduler refill requires --max-concurrent-sequences "
@@ -311,6 +373,8 @@ def main(argv: list[str] | None = None) -> None:
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
             max_concurrent=args.max_concurrent_sequences,
             scheduler=args.scheduler, spec_draft=args.spec_draft,
+            spec_ngram=args.spec_ngram, spec_drafter=args.spec_drafter,
+            spec_verify=args.spec_verify, spec_adapt=args.spec_adapt,
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
             scan_chunk=args.decode_scan_chunk,
             autotune=args.autotune == "on", plan_db=args.plan_db,

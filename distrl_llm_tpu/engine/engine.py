@@ -564,6 +564,19 @@ class LoraMailbox:
     # stale partner field by the concurrently-consuming generation thread
     _pending: tuple | None = None
     _swapped_lora = None
+    # the adapter (and its version) the latest consumed swap SUPERSEDED —
+    # i.e. the policy's own previous LoRA version. The speculative
+    # self-drafter runs it as the draft model (PipelineRL's observation
+    # that recent-checkpoint weights stay near-on-policy makes it a
+    # high-acceptance draft source for free), and the (step, version) swap
+    # log above gives exact draft/target version bookkeeping. Retention is
+    # OPT-IN (_track_prev_lora — set by engines running the self drafter):
+    # only that drafter reads the slot, and unconditional retention would
+    # pin a whole extra adapter version in device memory for the engine's
+    # lifetime on runs that never consume it
+    _track_prev_lora = False
+    _prev_lora = None
+    _prev_lora_version: int | None = None
 
     def push_lora(self, lora, version: int | None = None) -> None:
         """In-flight weight update (PipelineRL-style): the next dispatched
@@ -590,6 +603,16 @@ class LoraMailbox:
         if pending is not None:
             self._pending = None
             lora, version = pending
+            if self._track_prev_lora:
+                # the adapter being superseded becomes "the previous
+                # version" — its own version is the last swap's (None
+                # before any swap: the round-entry adapter's version is
+                # the trainer's to know)
+                self._prev_lora = lora_cell[0]
+                self._prev_lora_version = (
+                    self.last_swap_versions[-1] if self.last_swap_versions
+                    else None
+                )
             self._swapped_lora = lora
             lora_cell[0] = lora
             self.last_swap_steps.append(dispatched)

@@ -122,7 +122,18 @@ class ShardedPagedEngine(LoraMailbox):
         autotune: bool = True,  # False pins the static defaults (no DB read)
         plan_db: str | None = None,  # plan-DB path; None = env/default path
         plan_rows: int = 0,  # expected rows for plan-KEY selection (0 = any)
+        # accepted-and-rejected so misrouted configs fail with a clear
+        # error instead of a TypeError deep in trainer wiring
+        spec_draft: int | None = None,
     ):
+        if spec_draft:
+            raise NotImplementedError(
+                "speculative decoding is a per-replica refill-scheduler "
+                "feature (PagedGenerationEngine with scheduler='refill' — "
+                "one engine per rollout replica, distributed/"
+                "remote_engine.py); ShardedPagedEngine runs the wave "
+                "scheduler over a dp-partitioned pool and does not host it"
+            )
         if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
         if pages_per_block is not None and pages_per_block < 0:

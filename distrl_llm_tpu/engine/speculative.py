@@ -97,23 +97,69 @@ def spec_accept(
     rng: jax.Array,
     probs: jax.Array,  # [R, d+1, V] — probs[:, i] judges draft[:, i]; [:, d] = bonus
     draft: jax.Array,  # [R, d]
-) -> tuple[jax.Array, jax.Array]:
-    """One-hot-proposal rejection sampling. Returns (emit [R, d+1], n_emit
-    [R]): emit[:, :n_emit] are this step's new tokens — the accepted draft
-    prefix followed by one resampled/bonus token; n_emit ∈ [1, d+1]."""
+    draft_probs: jax.Array | None = None,  # [R, d, V] full proposal dists q
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative rejection sampling. Returns (emit [R, d+1], n_emit [R],
+    n_accept [R]): emit[:, :n_emit] are this step's new tokens — the
+    accepted draft prefix followed by one resampled/bonus token; n_emit ∈
+    [1, d+1]. ``n_accept`` ∈ [0, d] is the SAMPLER's accepted prefix
+    length (n_emit − 1 before any EOS/budget truncation the caller
+    applies) — the unbiased drafter-quality measure the accept-rate
+    accounting consumes; deriving it from the post-truncation emit count
+    would under-count a final emitted token that was itself an accepted
+    draft (e.g. an accepted EOS).
+
+    Without ``draft_probs`` the proposal is treated as a POINT MASS (the
+    n-gram drafter's regime): token t_i is accepted with probability
+    p_i(t_i), and the residual zeroes exactly the drafted token — the
+    original one-hot algebra, bit-for-bit.
+
+    With ``draft_probs`` this is standard full-distribution speculative
+    sampling (the self-drafter's regime — q is the previous-version
+    policy's own sampling distribution): accept t_i with probability
+    min(1, p_i(t_i) / q_i(t_i)) — implemented as ``u · q < p`` so a
+    zero-q never divides — and resample the first rejection from the
+    residual norm(max(p_i − q_i, 0)). Both branches leave the output
+    distribution IDENTICAL to plain sampling from p (the rejection-sampling
+    identity; pinned empirically by tests/test_speculative.py). The
+    one-hot path is the q = onehot(t_i) special case: u·1 < p(t_i) and
+    max(p − onehot, 0) = p with the drafted token zeroed."""
     r, dp1, v = probs.shape
     d = dp1 - 1
     u = jax.random.uniform(jax.random.fold_in(rng, 0), (r, d))
     p_draft = jnp.take_along_axis(probs[:, :d], draft[..., None], axis=-1)[..., 0]
-    accept = u < p_draft  # [R, d]
+    if draft_probs is None:
+        accept = u < p_draft  # [R, d]
+    else:
+        q_draft = jnp.take_along_axis(
+            draft_probs, draft[..., None], axis=-1
+        )[..., 0]
+        accept = u * q_draft < p_draft  # u < p/q, division-free
     m = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)  # [R] prefix len
 
     rows = jnp.arange(r)
     final_probs = probs[rows, m]  # [R, V] — dist at the first rejected / bonus slot
     rejected = m < d
-    drop = jnp.take_along_axis(draft, jnp.minimum(m, d - 1)[:, None], axis=1)[:, 0]
-    onehot_drop = jax.nn.one_hot(drop, v, dtype=bool)
-    final_probs = jnp.where(rejected[:, None] & onehot_drop, 0.0, final_probs)
+    if draft_probs is None:
+        drop = jnp.take_along_axis(
+            draft, jnp.minimum(m, d - 1)[:, None], axis=1
+        )[:, 0]
+        onehot_drop = jax.nn.one_hot(drop, v, dtype=bool)
+        final_probs = jnp.where(
+            rejected[:, None] & onehot_drop, 0.0, final_probs
+        )
+    else:
+        q_at = draft_probs[rows, jnp.minimum(m, d - 1)]  # [R, V]
+        resid = jnp.maximum(final_probs - q_at, 0.0)
+        # p ≤ q everywhere ⇒ p == q ⇒ the residual is empty; any
+        # acceptance test would have passed, so the event has measure
+        # zero under exact arithmetic — guard the float-rounding case by
+        # falling back to p itself (still exact: p == q there)
+        resid_ok = resid.sum(axis=-1, keepdims=True) > 0
+        final_probs = jnp.where(
+            rejected[:, None], jnp.where(resid_ok, resid, final_probs),
+            final_probs,
+        )
     final_probs = final_probs / jnp.maximum(
         final_probs.sum(axis=-1, keepdims=True), 1e-20
     )
@@ -125,7 +171,10 @@ def spec_accept(
     draft_padded = jnp.pad(draft, ((0, 0), (0, 1)))
     emit = jnp.where(pos < m[:, None], draft_padded, 0)
     emit = jnp.where(pos == m[:, None], final_tok[:, None], emit)
-    return emit.astype(jnp.int32), (m + 1).astype(jnp.int32)
+    return (
+        emit.astype(jnp.int32), (m + 1).astype(jnp.int32),
+        m.astype(jnp.int32),
+    )
 
 
 class SpecRefillState(NamedTuple):
@@ -149,3 +198,18 @@ class SpecRefillState(NamedTuple):
     page_indices: jax.Array  # [R, width]
     k_pages: tuple
     v_pages: tuple
+    # acceptance accounting, carried ON DEVICE so the host pays no extra
+    # round-trips: emit_hist[n] counts the alive slot-steps that emitted
+    # exactly n tokens (n ∈ [0, d_max+1]; width is static at the CONFIGURED
+    # max draft length so the adaptive controller can shrink d without a
+    # shape change), draft_total sums alive·d_eff, accept_total sums the
+    # SAMPLER's accepted prefix lengths (spec_accept's n_accept — pre-EOS/
+    # budget truncation, so accept_rate = accept_total/draft_total is the
+    # unbiased drafter-quality measure; emit-derived counts would
+    # under-count rows whose final emitted token was an accepted draft,
+    # e.g. an accepted EOS) — together they give the accept rate,
+    # tokens/verify-step, and the emit distribution (engine/spec_*
+    # telemetry + the bench row's spec fields)
+    emit_hist: jax.Array  # [d_max+2] i32
+    draft_total: jax.Array  # [] i32
+    accept_total: jax.Array  # [] i32

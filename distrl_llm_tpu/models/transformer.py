@@ -106,6 +106,7 @@ def _layer(
     paged_impl: str = "auto",
     pages_per_block: int = 0,  # blocked-kernel page collapse (0 = kernel default)
     paged_verify: bool = False,  # S>1 per-row draft-block decode (spec decode)
+    paged_verify_impl: str = "fused",  # "fused" | "unrolled" verify sweep
     paged_chunked: bool = False,  # S>1 continuation (chunked) prefill
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,  # per-layer key (training only)
@@ -166,23 +167,22 @@ def _layer(
             # speculative-decode verify: S draft tokens extend each row's
             # sequence at its own per-row offset. QKV/MLP batch over the
             # whole block (the weight-bandwidth amortization speculative
-            # decoding buys); attention unrolls per draft position — draft
-            # position i attends over the prefix plus draft tokens ≤ i
-            # (lengths + i + 1), which is exact causality
+            # decoding buys); attention goes through paged_verify_op —
+            # draft position i attends over the prefix plus draft tokens
+            # ≤ i (lengths + i + 1, exact causality), as ONE fused blocked
+            # sweep when the hardware can (ops/paged_native.py
+            # paged_attention_native_verify) or unrolled per position
+            # (paged_verify_impl="unrolled" / non-TPU backends)
+            from distrl_llm_tpu.ops.paged import paged_verify_op
+
             cache_k = write_tokens_to_pages(
                 cache_k, k, paged_lengths, page_indices, page_size)
             cache_v = write_tokens_to_pages(
                 cache_v, v, paged_lengths, page_indices, page_size)
-            att = jnp.stack(
-                [
-                    paged_attention_op(
-                        q[:, i], cache_k, cache_v, paged_lengths + i + 1,
-                        page_indices, impl=paged_impl,
-                        pages_per_block=pages_per_block,
-                    )
-                    for i in range(s)
-                ],
-                axis=1,
+            att = paged_verify_op(
+                q, cache_k, cache_v, paged_lengths, page_indices,
+                impl=paged_impl, pages_per_block=pages_per_block,
+                verify_impl=paged_verify_impl,
             )
         else:
             # packed prefill: write the prompt pages, attend over the input
@@ -276,6 +276,7 @@ def forward(
     paged_impl: str = "auto",
     pages_per_block: int = 0,  # blocked-kernel page collapse (0 = kernel default)
     paged_verify: bool = False,  # speculative-decode draft-block verify
+    paged_verify_impl: str = "fused",  # verify sweep: "fused" | "unrolled"
     paged_chunked: bool = False,  # continuation (chunked) prefill over pages
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
@@ -357,6 +358,7 @@ def forward(
         paged_impl=paged_impl,
         pages_per_block=pages_per_block,
         paged_verify=paged_verify,
+        paged_verify_impl=paged_verify_impl,
         paged_chunked=paged_chunked,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
         cache_read_formulation=cache_read_formulation,

@@ -311,7 +311,11 @@ def paged_grid_steps(
 
     Counts per impl: "native" runs a (B, K, pps) grid; "native_folded"
     folds kv heads into the block — (B, pps); "native_blocked" additionally
-    collapses the page axis — (B, ceil(pps / pages_per_block)); the jaxlib
+    collapses the page axis — (B, ceil(pps / pages_per_block));
+    "native_verify" is the FUSED draft-block verify: the whole (d+1)-query
+    speculative verify step in ONE blocked sweep — same (B,
+    ceil(pps / pages_per_block)) count as "native_blocked", where the
+    unrolled verify paid that count (d+1) TIMES per step; the jaxlib
     kernels ("fixed"/"jaxlib"/"kernel") walk pages with manual DMA inside a
     (1, B, K) grid; the jnp reference has no Pallas grid (0)."""
     base = impl.split("!")[0]  # strip the "!transient-probe" honesty marker
@@ -319,7 +323,7 @@ def paged_grid_steps(
         return batch * num_kv_heads * pps
     if base == "native_folded":
         return batch * pps
-    if base == "native_blocked":
+    if base in ("native_blocked", "native_verify"):
         ppb = max(1, min(pages_per_block or DEFAULT_PAGES_PER_BLOCK, pps))
         return batch * -(-pps // ppb)
     if base in ("fixed", "jaxlib", "kernel"):
@@ -330,7 +334,7 @@ def paged_grid_steps(
 def dispatch_choice_key(
     *, quantized: bool, num_kv_heads: int, num_groups: int, head_dim: int,
     page_size: int, pps: int, pages_per_compute_block: int = 4,
-    impl: str = "auto", pages_per_block: int = 0,
+    impl: str = "auto", pages_per_block: int = 0, verify_len: int = 0,
 ) -> tuple:
     """The per-config key ``paged_attention_op`` records its dispatch
     decision under ``dispatch_choices``. One function so engines can look
@@ -338,14 +342,35 @@ def dispatch_choice_key(
     (several engines can trace in one process — the autotuner's candidate
     sweep). The REQUESTED ``impl`` and ``pages_per_block`` are part of the
     key: two same-geometry engines pinned to different kernels must not
-    share (and overwrite) one record."""
-    blocks = max(
+    share (and overwrite) one record. ``verify_len`` > 0 marks the
+    speculative draft-block verify dispatch (``paged_verify_op``) — its
+    decision ("native_verify" fused sweep vs "unrolled") is a different
+    choice than the single-query decode's and must not alias it."""
+    blocks = divisor_blocks(pages_per_compute_block, pps)
+    return (impl, pages_per_block, quantized, num_kv_heads, num_groups,
+            head_dim, page_size, blocks, pps, verify_len)
+
+
+def divisor_blocks(pages_per_compute_block: int, pps: int) -> int:
+    """Largest divisor of ``pps`` that fits ``pages_per_compute_block`` —
+    the per-call block count the one-page kernels launch with. Shared so
+    consumers (the fused-verify probe) derive it from the geometry instead
+    of indexing the dispatch key tuple positionally."""
+    return max(
         (d for d in range(1, min(pages_per_compute_block, pps) + 1)
          if pps % d == 0),
         default=1,
     )
-    return (impl, pages_per_block, quantized, num_kv_heads, num_groups,
-            head_dim, page_size, blocks, pps)
+
+
+def dispatch_key_is_verify(key) -> bool:
+    """True when a ``dispatch_choices`` key records a speculative
+    draft-block verify dispatch (``paged_verify_op``) rather than a
+    single-query decode. The ONLY place outside ``dispatch_choice_key``
+    allowed to know the tuple layout — consumers (bench's decode-impl
+    summary, trace filters) must call this instead of indexing, so the
+    next field appended to the key cannot silently break their filters."""
+    return isinstance(key, tuple) and len(key) >= 10 and bool(key[9])
 # per-config record of what the auto-dispatch chain actually chose
 # ("native" | "native_folded" | "fixed" | "jaxlib" | "reference") —
 # bench records surface
@@ -397,6 +422,30 @@ def _native_call(q, k_pages, v_pages, lengths, page_indices,
     return kernel(q, k_pages, v_pages, lengths, page_indices, **kw)
 
 
+def _native_verify_call(q, k_pages, v_pages, lengths, page_indices,
+                        *, quantized: bool, pages_per_block: int = 0,
+                        interpret: bool = False):
+    """Adapter for the fused draft-block verify kernel
+    (ops/paged_native.py::paged_attention_native_verify): q is the whole
+    [B, S, H, hd] draft block, pre-scaled; ``lengths`` are the RESIDENT
+    counts before the block (the kernel applies the per-query
+    ``lengths + i + 1`` causal ladder itself)."""
+    from distrl_llm_tpu.ops.paged_native import paged_attention_native_verify
+
+    kw: dict = {
+        "interpret": interpret,
+        "pages_per_block": pages_per_block or DEFAULT_PAGES_PER_BLOCK,
+    }
+    if quantized:
+        return paged_attention_native_verify(
+            q, k_pages.weight, v_pages.weight, lengths, page_indices,
+            k_scales=k_pages.scales, v_scales=v_pages.scales, **kw,
+        )
+    return paged_attention_native_verify(
+        q, k_pages, v_pages, lengths, page_indices, **kw,
+    )
+
+
 def _probe_launch(
     fn_name: str,
     quantized: bool,
@@ -409,6 +458,7 @@ def _probe_launch(
     blocks: int,
     pps: int,
     pages_per_block: int = 0,
+    verify_len: int = 0,
 ) -> bool:
     """Per-config probe: compile + run a paged-attention launch at tiny
     shapes on the REAL backend. Launches are validated under the Pallas
@@ -429,7 +479,9 @@ def _probe_launch(
     failed (second silicon lesson of round 3)."""
     key = (fn_name, quantized, num_kv_heads, num_groups, head_dim, page_size,
            q_dtype, kv_dtype, blocks, pps,
-           pages_per_block if fn_name == "native_blocked" else 0)
+           pages_per_block if fn_name in ("native_blocked", "native_verify")
+           else 0,
+           verify_len if fn_name == "native_verify" else 0)
     if key not in _fixed_launch_state:
         try:
             from distrl_llm_tpu.ops.paged_int8 import (
@@ -446,6 +498,8 @@ def _probe_launch(
                 fn = functools.partial(
                     _native_call, quantized=quantized, blocked=True,
                     pages_per_block=pages_per_block)
+            elif fn_name == "native_verify":
+                fn = None  # verify-shaped probe built below
             elif fn_name == "fixed":
                 fn = paged_attention_int8 if quantized else paged_attention_gqa
             else:
@@ -459,13 +513,34 @@ def _probe_launch(
                 kp = vp = init_quantized_pages(shape)
             else:
                 kp = vp = jnp.zeros(shape, kv_dtype)
-            out = fn(
-                jnp.zeros((b, num_kv_heads * num_groups, head_dim), q_dtype),
-                kp, vp,
-                jnp.ones((b,), jnp.int32),
-                jnp.asarray(make_page_table(b, pps * page_size, page_size)),
-                pages_per_compute_block=blocks,
-            )
+            if fn_name == "native_verify":
+                # the fused verify launch takes an S-query block per row and
+                # its own Mosaic code path (S·G query rows in the block) —
+                # probe it at the REAL draft-block length
+                out = _native_verify_call(
+                    jnp.zeros(
+                        (b, verify_len, num_kv_heads * num_groups, head_dim),
+                        q_dtype,
+                    ),
+                    kp, vp,
+                    jnp.ones((b,), jnp.int32),
+                    jnp.asarray(
+                        make_page_table(b, pps * page_size, page_size)
+                    ),
+                    quantized=quantized, pages_per_block=pages_per_block,
+                )
+            else:
+                out = fn(
+                    jnp.zeros(
+                        (b, num_kv_heads * num_groups, head_dim), q_dtype
+                    ),
+                    kp, vp,
+                    jnp.ones((b,), jnp.int32),
+                    jnp.asarray(
+                        make_page_table(b, pps * page_size, page_size)
+                    ),
+                    pages_per_compute_block=blocks,
+                )
             jax.block_until_ready(out)
             _fixed_launch_state[key] = True
             transient_probe_keys.discard(key)
@@ -664,3 +739,106 @@ def paged_attention_op(
         # paged dispatch, and the honesty field must say so
         dispatch_choices[("no-kernel-path",)] = "reference"
     return paged_attention_reference(q, k_pages, v_pages, lengths, page_indices)
+
+
+def paged_verify_reference(
+    q: jax.Array,  # [B, S, H, hd] — S-query draft block per row
+    k_pages,
+    v_pages,
+    lengths: jax.Array,  # [B] RESIDENT tokens BEFORE the draft block
+    page_indices: jax.Array,
+) -> jax.Array:
+    """Semantics reference for the draft-block verify: query position i
+    attends each row's [0, lengths + i + 1) prefix — the exact per-position
+    ladder the unrolled verify path has always dispatched. Returns
+    [B, S, H, hd]."""
+    return jnp.stack(
+        [
+            paged_attention_reference(
+                q[:, i], k_pages, v_pages, lengths + i + 1, page_indices
+            )
+            for i in range(q.shape[1])
+        ],
+        axis=1,
+    )
+
+
+def paged_verify_op(
+    q: jax.Array,  # [B, S, H, hd] — S-query draft block per row (UNscaled)
+    k_pages,
+    v_pages,
+    lengths: jax.Array,  # [B] RESIDENT tokens BEFORE the draft block
+    page_indices: jax.Array,
+    *,
+    impl: str = "auto",
+    pages_per_compute_block: int = 4,
+    pages_per_block: int = 0,
+    verify_impl: str = "fused",
+) -> jax.Array:
+    """Speculative-decode draft-block verify dispatch: the S-query
+    attention of one verify forward, in ONE fused blocked sweep when the
+    hardware can (``paged_attention_native_verify``), else unrolled into S
+    per-position ``paged_attention_op`` dispatches (the pre-fusion
+    behavior, exact to the dispatch).
+
+    ``verify_impl``: "fused" (probe-gated fused kernel on TPU for the
+    native impl family, unrolled fallback elsewhere) or "unrolled" (force
+    per-position dispatch — the A/B control and the interpreter-parity
+    anchor). The decision is recorded in ``dispatch_choices`` under the
+    verify-marked key (``dispatch_choice_key(..., verify_len=S)``):
+    "native_verify" when the fused sweep ran, "unrolled" otherwise — so
+    engines/bench can compute the verify step's TRUE grid cost
+    (``paged_grid_steps("native_verify", ...)`` × 1 call vs the per-impl
+    count × (d+1) calls) instead of guessing."""
+    b, s, h, hd = q.shape
+    if verify_impl not in ("fused", "unrolled"):
+        raise ValueError(
+            f"verify_impl must be fused/unrolled, got {verify_impl!r}"
+        )
+    quantized = is_quantized_pages(k_pages)
+    kw = k_pages.weight if quantized else k_pages
+    num_kv_heads = kw.shape[0]
+    num_groups = h // num_kv_heads
+    head_dim, page_size = kw.shape[-1], kw.shape[-2]
+    pps = page_indices.shape[1]
+    ppb_eff = max(1, min(pages_per_block or DEFAULT_PAGES_PER_BLOCK, pps))
+    choice_key = dispatch_choice_key(
+        quantized=quantized, num_kv_heads=num_kv_heads,
+        num_groups=num_groups, head_dim=head_dim, page_size=page_size,
+        pps=pps, pages_per_compute_block=pages_per_compute_block,
+        impl=impl, pages_per_block=pages_per_block, verify_len=s,
+    )
+    # the fused kernel is a native-family launch; "kernel"/"reference"
+    # pins have no fused spelling and always unroll onto their own impl
+    fused_eligible = (
+        verify_impl == "fused"
+        and impl in ("auto", "native", "native_folded", "native_blocked")
+        and jax.default_backend() == "tpu"
+    )
+    if fused_eligible:
+        scaled_q = q * (hd ** -0.5)
+        if _probe_launch(
+            "native_verify", quantized, num_kv_heads, num_groups, head_dim,
+            page_size, scaled_q.dtype, kw.dtype,
+            divisor_blocks(pages_per_compute_block, pps), pps,
+            pages_per_block=ppb_eff, verify_len=s,
+        ):
+            dispatch_choices[choice_key] = "native_verify"
+            return _native_verify_call(
+                scaled_q, k_pages, v_pages, lengths.astype(jnp.int32),
+                page_indices, quantized=quantized, pages_per_block=ppb_eff,
+            ).astype(q.dtype)
+    # unrolled: S per-position dispatches (each records its own decode
+    # dispatch choice; the verify key records that the step ran unrolled)
+    dispatch_choices[choice_key] = "unrolled"
+    return jnp.stack(
+        [
+            paged_attention_op(
+                q[:, i], k_pages, v_pages, lengths + i + 1, page_indices,
+                impl=impl, pages_per_compute_block=pages_per_compute_block,
+                pages_per_block=pages_per_block,
+            )
+            for i in range(s)
+        ],
+        axis=1,
+    )

@@ -464,6 +464,230 @@ def _make_blocked_kernel(*, page_size: int, ppb: int, nblk: int,
     return kernel
 
 
+def _make_verify_kernel(*, page_size: int, ppb: int, nblk: int, s_len: int,
+                        groups: int, quantized: bool):
+    """Kernel body for ``paged_attention_native_verify``: the blocked kernel
+    (``_make_blocked_kernel``) extended to an S-QUERY draft block per row —
+    the speculative-decode verify forward in ONE grid sweep.
+
+    Before this kernel, the verify forward unrolled attention per draft
+    position (models/transformer.py issued S separate ``paged_attention_op``
+    dispatches per step), multiplying the launch-bound grid walk by (d+1)
+    and forfeiting the amortization speculation exists to buy (the round-5
+    regime: decode cost ≈ grid steps × Mosaic's ~1 µs/grid-step floor, so S
+    sweeps cost S× even though they move the same KV bytes). Here the S
+    queries ride INSIDE the block — folded into the query-group axis as
+    [K, S·G, hd], the same trick the folded kernel plays with kv heads — so
+    the whole (d+1)-token verify costs exactly one blocked sweep:
+    grid (B, ceil(pps/ppb)).
+
+    Causality is per QUERY: draft position i (query rows i·G..(i+1)·G−1)
+    attends key positions < lengths + i + 1 — the prefix plus draft tokens
+    ≤ i, exactly the ``lengths + i + 1`` ladder the unrolled path passed
+    per dispatch. The limit is a per-row vector built from a static
+    row→position iota, so the mask is one vectorized compare, not a loop.
+
+    Numerical-safety note (why the blocked kernel's first-block-valid
+    argument still holds): every query row has at least one attendable
+    position — query i's own token sits at position lengths + i <
+    lengths + i + 1, and block 0 always covers position 0 < lengths + 1 —
+    so the running max is finite after block 0 for every row and
+    fully-masked later pages fold in as exact zeros."""
+
+    sg = s_len * groups
+
+    def kernel(lengths_ref, tables_ref, q_ref, *rest):
+        k_refs = rest[0:ppb]
+        v_refs = rest[ppb:2 * ppb]
+        if quantized:
+            ks_refs = rest[2 * ppb:3 * ppb]
+            vs_refs = rest[3 * ppb:4 * ppb]
+            o_ref, m_scr, l_scr, acc_scr = rest[4 * ppb:]
+        else:
+            ks_refs = vs_refs = None
+            o_ref, m_scr, l_scr, acc_scr = rest[2 * ppb:]
+        b = pl.program_id(0)
+        jb = pl.program_id(1)
+
+        @pl.when(jb == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        length = lengths_ref[b]
+        # per-query-row causal limit: query row r = i·G + g judges draft
+        # position i = r // G and may read positions < length + i + 1
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (1, sg, 1), 1) // groups
+        limit = length + qpos + 1  # [1, S·G, 1]
+
+        # the verify block extends the sequence by s_len tokens (their KV
+        # is already resident — written before the attention call), so
+        # blocks are live up to length + s_len, not length
+        @pl.when(jb * (ppb * page_size) < length + s_len)
+        def _block():
+            q = q_ref[...].astype(jnp.float32)  # [K, S·G, hd] (pre-scaled)
+            m = m_scr[...]  # [K, S·G, 1]
+            l = l_scr[...]  # noqa: E741
+            acc = acc_scr[...]  # [K, S·G, hd]
+            for i in range(ppb):  # static unroll: ppb block loads per step
+                k = k_refs[i][:, 0].astype(jnp.float32)  # [K, ps, hd]
+                v = v_refs[i][:, 0].astype(jnp.float32)
+                if quantized:
+                    k = k * (ks_refs[i][:, 0] * (1.0 / MAX_INT8))
+                    v = v * (vs_refs[i][:, 0] * (1.0 / MAX_INT8))
+                s = jax.lax.dot_general(
+                    q, k, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )  # [K, S·G, ps]
+                pos = (jb * ppb + i) * page_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, 1, page_size), 2
+                )
+                s = jnp.where(pos < limit, s, NEG_INF)  # [K, S·G, ps]
+                m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l = alpha * l + jnp.sum(p, axis=2, keepdims=True)  # noqa: E741
+                acc = acc * alpha + jax.lax.dot_general(
+                    p, v, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                m = m_new
+            m_scr[...] = m
+            l_scr[...] = l
+            acc_scr[...] = acc
+
+        @pl.when(jb == nblk - 1)
+        def _emit():
+            o_ref[...] = (
+                acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+            ).astype(o_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("page_size", "pages_per_block", "interpret"),
+)
+def paged_attention_native_verify(
+    q: jax.Array,  # [B, S, H, hd] — pre-scaled by hd**-0.5 (op contract)
+    k_pages: jax.Array,  # [K, P, ps, hd] bf16/f32, or int8 weight
+    v_pages: jax.Array,
+    lengths: jax.Array,  # i32 [B] — RESIDENT tokens BEFORE the draft block
+    page_indices: jax.Array,  # i32 [B, pps]
+    k_scales: jax.Array | None = None,  # f32 [K, P, ps, 1] compact (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    page_size: int | None = None,
+    pages_per_block: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Launch for ``_make_verify_kernel``: the whole S-token draft-block
+    verify in one (B, ceil(pps / pages_per_block)) sweep. The S draft
+    tokens' KV must already be resident in the pages (the verify forward
+    writes them first); query position i attends keys < lengths + i + 1.
+    Returns [B, S, H, hd]."""
+    batch, s_len, num_q_heads, head_dim = q.shape
+    num_kv_heads, total_pages, ps, head_dim_k = k_pages.shape
+    if page_size is None:
+        page_size = ps
+    if head_dim_k != head_dim:
+        raise ValueError(f"head_dim mismatch: {head_dim_k} vs {head_dim}")
+    if num_q_heads % num_kv_heads:
+        raise ValueError(
+            f"H={num_q_heads} not divisible by K={num_kv_heads}"
+        )
+    if pages_per_block < 1:
+        raise ValueError(
+            f"pages_per_block must be >= 1, got {pages_per_block}"
+        )
+    groups = num_q_heads // num_kv_heads
+    _, pps = page_indices.shape
+    quantized = k_scales is not None
+    ppb = min(pages_per_block, pps)
+    nblk = -(-pps // ppb)
+
+    tables = jnp.clip(page_indices.astype(jnp.int32), 0, total_pages - 1)
+    pad = nblk * ppb - pps
+    if pad:
+        tables = jnp.concatenate(
+            [tables, jnp.broadcast_to(tables[:, -1:], (batch, pad))], axis=1
+        )
+    # [B, S, H, hd] → [B, K, S·G, hd]: head h = kv·G + g (the reshape
+    # convention every kernel in this file uses), query row r = i·G + g
+    q4 = (
+        q.reshape(batch, s_len, num_kv_heads, groups, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(batch, num_kv_heads, s_len * groups, head_dim)
+    )
+
+    q_spec = pl.BlockSpec(
+        (None, num_kv_heads, s_len * groups, head_dim),
+        lambda b, j, lens, tabs: (b, 0, 0, 0),
+    )
+
+    def kv_spec(i):
+        return pl.BlockSpec(
+            (num_kv_heads, 1, page_size, head_dim),
+            lambda b, j, lens, tabs, i=i: (0, tabs[b, j * ppb + i], 0, 0),
+        )
+
+    def scale_spec(i):
+        return pl.BlockSpec(
+            (num_kv_heads, 1, page_size, 1),
+            lambda b, j, lens, tabs, i=i: (0, tabs[b, j * ppb + i], 0, 0),
+        )
+
+    in_specs = (
+        [q_spec]
+        + [kv_spec(i) for i in range(ppb)]
+        + [kv_spec(i) for i in range(ppb)]
+    )
+    operands = [q4] + [k_pages] * ppb + [v_pages] * ppb
+    if quantized:
+        in_specs += (
+            [scale_spec(i) for i in range(ppb)]
+            + [scale_spec(i) for i in range(ppb)]
+        )
+        operands += [k_scales] * ppb + [v_scales] * ppb
+
+    out = pl.pallas_call(
+        _make_verify_kernel(
+            page_size=page_size, ppb=ppb, nblk=nblk, s_len=s_len,
+            groups=groups, quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, nblk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (None, num_kv_heads, s_len * groups, head_dim),
+                lambda b, j, lens, tabs: (b, 0, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((num_kv_heads, s_len * groups, 1), jnp.float32),
+                pltpu.VMEM((num_kv_heads, s_len * groups, 1), jnp.float32),
+                pltpu.VMEM(
+                    (num_kv_heads, s_len * groups, head_dim), jnp.float32
+                ),
+            ],
+        ),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, num_kv_heads, s_len * groups, head_dim), q.dtype
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), tables, *operands)
+    return (
+        out.reshape(batch, num_kv_heads, s_len, groups, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(batch, s_len, num_q_heads, head_dim)
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("page_size", "pages_per_block", "interpret"),

@@ -58,7 +58,8 @@ class _State:
         self.remote_threads: dict[tuple[int, int], str] = {}
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
-        self.hists: dict[str, list[float]] = {}
+        # weighted observations: (value, count) per hist_observe call
+        self.hists: dict[str, list[tuple[float, int]]] = {}
         self.touched: set[str] = set()  # series with data since last snapshot
 
 
@@ -206,25 +207,41 @@ def gauge_set(name: str, value: float) -> None:
         })
 
 
-def hist_observe(name: str, value: float, *, trace_sample: bool = False) -> None:
+def hist_observe(name: str, value: float, *, trace_sample: bool = False,
+                 count: int = 1) -> None:
     """Latency-style histogram; snapshot reports count/mean/p50/p90/max and
     resets (e.g. ``cp/rpc_dispatch_ms``). ``trace_sample=True`` additionally
     emits each observation as a Chrome counter event while tracing is on, so
     distribution-over-time series (``rollout/staleness``) get a Perfetto
     track AND tools/trace_report.py can summarize them from the trace file
     alone — the sink histogram resets every snapshot, the trace keeps all
-    samples."""
+    samples. ``count`` records the observation that many times in one call
+    (pre-binned device-side histograms — ``engine/spec_emit_tokens`` counts
+    a whole round's emissions in d+2 buckets; one Python call per bucket,
+    not one per slot-step)."""
+    if count < 1:
+        return
     st = _STATE
     with st.lock:
-        st.hists.setdefault(name, []).append(value)
+        # weighted (value, count) pairs — a pre-binned call stays ONE
+        # entry however large its count (a spec round's histogram can
+        # cover ~10^5 slot-steps in d+2 calls); metrics_snapshot computes
+        # the summary stats from cumulative weights
+        st.hists.setdefault(name, []).append((value, count))
         st.touched.add(name)
     if trace_sample and st.enabled:
+        # carry the weight: a count>1 observation must not read as ONE
+        # sample in the trace while the sink histogram records count —
+        # trace_report's distribution summary weights by this field
+        args = {name.rsplit("/", 1)[-1]: value}
+        if count > 1:
+            args["count"] = count
         st.events.append({
             "ph": "C",
             "name": name,
             "ts": time.time_ns() // 1000,
             "tid": 0,
-            "args": {name.rsplit("/", 1)[-1]: value},
+            "args": args,
         })
 
 
@@ -242,13 +259,25 @@ def metrics_snapshot() -> dict[str, float]:
             elif name in st.gauges:
                 out[name] = st.gauges[name]
             elif name in st.hists:
-                vals = sorted(st.hists.pop(name))
-                n = len(vals)
+                # weighted (value, count) pairs; stats identical to the
+                # old expanded-list math (index into the sorted virtual
+                # expansion via cumulative counts)
+                pairs = sorted(st.hists.pop(name))
+                n = sum(c for _, c in pairs)
+
+                def at(idx: int, pairs=pairs) -> float:
+                    cum = 0
+                    for v, c in pairs:
+                        cum += c
+                        if idx < cum:
+                            return v
+                    return pairs[-1][0]
+
                 out[f"{name}_count"] = float(n)
-                out[f"{name}_mean"] = sum(vals) / n
-                out[f"{name}_p50"] = vals[n // 2]
-                out[f"{name}_p90"] = vals[min(int(n * 0.9), n - 1)]
-                out[f"{name}_max"] = vals[-1]
+                out[f"{name}_mean"] = sum(v * c for v, c in pairs) / n
+                out[f"{name}_p50"] = at(n // 2)
+                out[f"{name}_p90"] = at(min(int(n * 0.9), n - 1))
+                out[f"{name}_max"] = pairs[-1][0]
         st.touched.clear()
     return out
 

@@ -69,9 +69,21 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     if config.engine_impl == "paged":
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
-            if config.spec_draft:
+            # None = unpinned (engine default / plan-DB-resolvable); any
+            # explicit value — INCLUDING spec_draft=0 and the default
+            # spellings 'ngram'/'fused' — reaches the engine as a pin, so
+            # a --spec_draft 0 A/B can never be retuned by a stored plan
+            # (the decode_scan_chunk convention)
+            if config.spec_draft is not None:
                 kwargs["spec_draft"] = config.spec_draft
+            if config.spec_ngram is not None:
                 kwargs["spec_ngram"] = config.spec_ngram
+            if config.spec_drafter is not None:
+                kwargs["spec_drafter"] = config.spec_drafter
+            if config.spec_verify is not None:
+                kwargs["spec_verify"] = config.spec_verify
+            if config.spec_adapt:
+                kwargs["spec_adapt"] = True
     if config.max_concurrent_sequences and config.engine_impl != "paged_sharded":
         # the sharded engine admits whole dp-sharded waves; a row cap is the
         # per-replica engines' admission knob
@@ -441,8 +453,14 @@ class Trainer:
                     max_new_tokens=config.max_new_tokens,
                     page_size=DEFAULT_PAGE_SIZE,
                     kv_quant=config.kv_cache_quant,
+                    # pool sizing sees only the EXPLICIT draft length; a
+                    # plan-DB entry that enables speculation (spec_draft
+                    # None) isn't resolved until engine construction, so
+                    # its ≤d extra resident tokens/row ride the pool's
+                    # refill-admission slack instead
                     spec_draft=(
-                        config.spec_draft if config.continuous_batching else 0
+                        (config.spec_draft or 0)
+                        if config.continuous_batching else 0
                     ),
                 )
             engine = engine_cls(

@@ -677,6 +677,259 @@ class TestBenchIngest:
         assert r.plan.scan_chunk == 0  # the 10.4k fallback row won
 
 
+REFILL_KW = dict(scheduler="refill", max_concurrent_rows=4)
+
+
+class TestSpecPlanFields:
+    """Resolution pins for the ISSUE-6 spec plan fields (spec_draft_len /
+    spec_ngram_k / spec_drafter / spec_verify): explicit kwargs beat the
+    DB, an empty DB is byte-identical to the historical defaults, and a
+    stored speculative plan only engages on a refill engine."""
+
+    def test_empty_db_keeps_spec_off(self, tmp_path):
+        """Byte-identity pin: a refill engine with no DB entry keeps
+        speculation OFF with the historical satellite defaults — exactly
+        the pre-ISSUE-6 engine."""
+        p = PagedGenerationEngine(
+            TINY, plan_db=str(tmp_path / "no.json"), **REFILL_KW,
+            **ENGINE_KW,
+        )
+        assert p.spec_draft == 0
+        assert p.spec_ngram == 2
+        assert p.spec_drafter == "ngram"
+        assert p.spec_verify == "fused"
+        assert p.resolved_plan.plan.decode_path == "paged"
+
+    def test_db_spec_plan_applies_on_refill_engine(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="speculative", spec_draft_len=3, spec_ngram_k=3,
+            spec_drafter="self", spec_verify="unrolled",
+        ))
+        store.save()
+        p = PagedGenerationEngine(TINY, plan_db=db, **REFILL_KW, **ENGINE_KW)
+        assert p.spec_draft == 3
+        assert p.spec_ngram == 3
+        assert p.spec_drafter == "self"
+        assert p.spec_verify == "unrolled"
+        assert p.resolved_plan.sources["spec_draft_len"] == "db"
+        assert p.resolved_plan.plan.decode_path == "speculative"
+
+    def test_explicit_spec_kwargs_beat_db(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="speculative", spec_draft_len=3, spec_ngram_k=3,
+            spec_drafter="self", spec_verify="unrolled",
+        ))
+        store.save()
+        # explicit spec_draft=0 pins speculation OFF over a stored
+        # speculative plan (the A/B-control contract)
+        off = PagedGenerationEngine(
+            TINY, plan_db=db, spec_draft=0, **REFILL_KW, **ENGINE_KW,
+        )
+        assert off.spec_draft == 0
+        assert off.resolved_plan.plan.decode_path == "paged"
+        # explicit satellites all beat their stored values
+        pin = PagedGenerationEngine(
+            TINY, plan_db=db, spec_draft=2, spec_ngram=2,
+            spec_drafter="ngram", spec_verify="fused",
+            **REFILL_KW, **ENGINE_KW,
+        )
+        assert pin.spec_draft == 2
+        assert pin.spec_ngram == 2
+        assert pin.spec_drafter == "ngram"
+        assert pin.spec_verify == "fused"
+
+    def test_stored_dense_plan_is_miss_on_refill_engine(self, tmp_path):
+        """A refill engine with spec unpinned can host 'paged' OR
+        'speculative' stored plans — but a DENSE entry's knobs were never
+        measured on the paged path, so the whole entry must be a miss
+        (review finding: the unpinned-spec constructor used to request no
+        decode_path at all, letting a dense plan's scan_chunk/top_p leak
+        in field-by-field)."""
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="dense", scan_chunk=4, top_p_impl="exact",
+        ))
+        store.save()
+        p = PagedGenerationEngine(TINY, plan_db=db, **REFILL_KW, **ENGINE_KW)
+        assert p.resolved_plan.source == "default"
+        assert p.resolved_plan.plan.decode_path == "paged"
+        assert p.scan_chunk == 0
+        assert p.plan_top_p_impl is None
+        assert p.spec_draft == 0
+
+    def test_config_spec_draft_zero_pins_off(self):
+        """TrainConfig.spec_draft follows the decode_scan_chunk convention:
+        None (the default) stays out of the engine kwargs — plan-DB-
+        resolvable — while an explicit 0 reaches the engine as a pin, so a
+        --spec_draft 0 A/B can never be retuned into a speculative run by
+        a stored plan (review finding: the trainer used to forward only
+        truthy values, making the off-pin unreachable)."""
+        from distrl_llm_tpu.config import TrainConfig
+        from distrl_llm_tpu.trainer import engine_kwargs_from_config
+
+        base = dict(engine_impl="paged", continuous_batching=True,
+                    max_concurrent_sequences=4)
+        assert "spec_draft" not in engine_kwargs_from_config(
+            TrainConfig(**base)
+        )
+        kw = engine_kwargs_from_config(TrainConfig(spec_draft=0, **base))
+        assert kw["spec_draft"] == 0
+        # spec_ngram rides the same convention: unset stays DB-resolvable
+        # even when speculation itself came from the DB, explicit pins
+        kw = engine_kwargs_from_config(TrainConfig(spec_draft=4, **base))
+        assert kw["spec_draft"] == 4 and "spec_ngram" not in kw
+        kw = engine_kwargs_from_config(TrainConfig(spec_ngram=4, **base))
+        assert kw["spec_ngram"] == 4 and "spec_draft" not in kw
+
+    def test_stored_spec_plan_degrades_on_wave_engine(self, tmp_path):
+        """A stored speculative plan must never crash or silently reshape
+        a wave-scheduler run: the decode-path mismatch drops the entry
+        and the engine stays plain paged."""
+        db = str(tmp_path / "db.json")
+        store = PlanStore(db)
+        store.put(_key(), ExecutionPlan(
+            decode_path="speculative", spec_draft_len=4,
+        ))
+        store.save()
+        p = PagedGenerationEngine(TINY, plan_db=db, **ENGINE_KW)
+        assert p.scheduler == "waves"
+        assert p.spec_draft == 0
+        assert p.resolved_plan.plan.decode_path == "paged"
+
+    def test_candidate_plans_prune_spec_combos(self):
+        from distrl_llm_tpu.autotune import candidate_plans
+
+        plans = candidate_plans(
+            decode_paths=("paged", "speculative"),
+            scan_chunks=(0,),
+            spec_draft_lens=(0, 4),
+            spec_drafters=(None, "ngram", "self"),
+            spec_verifies=(None, "fused"),
+        )
+        # spec knobs pair only with the speculative path, and the
+        # speculative path always carries a draft length (a spec plan
+        # with d=0 is just the paged path wearing a costume)
+        assert all(
+            p.spec_draft_len > 0 for p in plans
+            if p.decode_path == "speculative"
+        )
+        assert all(
+            p.spec_draft_len == 0 and p.spec_drafter is None
+            and p.spec_verify is None
+            for p in plans if p.decode_path == "paged"
+        )
+        spec = {(p.spec_drafter, p.spec_verify) for p in plans
+                if p.decode_path == "speculative"}
+        assert spec == {(None, None), (None, "fused"), ("ngram", None),
+                        ("ngram", "fused"), ("self", None),
+                        ("self", "fused")}
+
+    def test_spec_plan_field_validation(self):
+        with pytest.raises(ValueError, match="spec_draft_len"):
+            ExecutionPlan(decode_path="speculative", spec_draft_len=17)
+        with pytest.raises(ValueError, match="spec_drafter"):
+            ExecutionPlan(spec_drafter="oracle")
+        with pytest.raises(ValueError, match="spec_verify"):
+            ExecutionPlan(spec_verify="maybe")
+        with pytest.raises(ValueError, match="spec_ngram_k"):
+            ExecutionPlan(spec_ngram_k=-1)
+
+
+class TestMicrobenchSelfDrafter:
+    """The microbench must not score spec_drafter='self' candidates in the
+    q == p regime (review finding: with nothing pushed through the mailbox
+    the drafter fell back to the target adapter — acceptance ≡ 1.0,
+    systematically optimistic vs any real superseded version)."""
+
+    def test_perturbed_drafter_differs_on_every_leaf(self):
+        import jax
+
+        from distrl_llm_tpu.autotune.microbench import _perturbed_drafter
+        from distrl_llm_tpu.models import init_lora_params
+
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        prev = _perturbed_drafter(lora)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(lora), jax.tree_util.tree_leaves(prev)
+        ):
+            # zero-init B leaves must be perturbed too — they are exactly
+            # the leaves whose production updates make the drafter differ
+            assert not jnp.array_equal(a, b)
+        # deterministic: same seed, same drafter
+        again = _perturbed_drafter(lora)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(prev), jax.tree_util.tree_leaves(again)
+        ):
+            assert jnp.array_equal(a, b)
+
+    def test_self_candidate_without_lora_is_infeasible(self):
+        import jax
+
+        from distrl_llm_tpu.autotune.microbench import tune_geometry
+        from distrl_llm_tpu.autotune.plan import ExecutionPlan
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        plan = ExecutionPlan(
+            decode_path="speculative", spec_draft_len=2, spec_drafter="self",
+        )
+        results = tune_geometry(
+            TINY, params, None, [plan],
+            n_prompts=1, n_candidates=1,
+            max_prompt_tokens=8, max_new_tokens=4,
+        )
+        assert len(results) == 1 and not results[0].feasible
+        assert "LoRA" in results[0].note
+
+    def test_self_candidate_measures_with_distinct_drafter(self):
+        """tune_geometry must seed the superseded-adapter slot with a
+        drafter that is NOT the target adapter before timing a 'self'
+        candidate (acceptance < 1 becomes reachable)."""
+        import jax
+
+        from distrl_llm_tpu.autotune import microbench
+        from distrl_llm_tpu.autotune.plan import ExecutionPlan
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        from distrl_llm_tpu.models import init_lora_params
+
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        seeded = {}
+        real_build = microbench.build_engine_for_plan
+
+        def spy_build(*a, **kw):
+            engine = real_build(*a, **kw)
+            seeded["engine"] = engine
+            return engine
+
+        plan = ExecutionPlan(
+            decode_path="speculative", spec_draft_len=2, spec_drafter="self",
+        )
+        orig = microbench.build_engine_for_plan
+        microbench.build_engine_for_plan = spy_build
+        try:
+            results = microbench.tune_geometry(
+                TINY, params, lora, [plan],
+                n_prompts=1, n_candidates=1,
+                max_prompt_tokens=8, max_new_tokens=4,
+                warmup=0, repeats=1,
+            )
+        finally:
+            microbench.build_engine_for_plan = orig
+        assert results[0].feasible, results[0].note
+        engine = seeded["engine"]
+        assert engine._prev_lora is not None
+        leaves_t = jax.tree_util.tree_leaves(lora)
+        leaves_d = jax.tree_util.tree_leaves(engine._prev_lora)
+        assert any(
+            not jnp.array_equal(a, b) for a, b in zip(leaves_t, leaves_d)
+        )
+
+
 class TestKeys:
     def test_canonical_device_kind_aliases(self):
         assert canonical_device_kind("TPU v5e") == "tpu_v5e"

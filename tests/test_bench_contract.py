@@ -53,8 +53,16 @@ class TestBenchContract:
                     "paged_kernel", "pages_per_block", "grid_steps_estimate",
                     "us_per_grid_step",
                     "plan", "plan_source", "cache_read_formulation",
-                    "rollout_mode", "max_staleness", "rollout_dropped_stale"):
+                    "rollout_mode", "max_staleness", "rollout_dropped_stale",
+                    "spec_drafter", "spec_accept_rate",
+                    "tokens_per_verify_step", "spec_verify_impl"):
             assert key in rec, key
+        # spec off: the speculative self-description fields read null, so
+        # a driver can distinguish "off" from "ran but never accepted"
+        assert rec["spec_draft"] == 0
+        assert rec["spec_drafter"] is None
+        assert rec["spec_accept_rate"] is None
+        assert rec["tokens_per_verify_step"] is None
         assert rec["metric"] == "rollout_tokens_per_sec_per_chip"
         assert rec["backend"] == "cpu"
         assert rec["value"] > 0
@@ -70,6 +78,25 @@ class TestBenchContract:
         assert rec["plan"]["decode_path"] == "dense"
         assert rec["plan_source"] in ("db", "default", "disabled")
         assert rec["scan_chunk"] == rec["plan"]["scan_chunk"]
+
+    def test_spec_record_fields(self):
+        """A speculative refill row must self-describe (ISSUE 6): which
+        drafter proposed, the realized accept rate, tokens per verify
+        step, and which verify sweep ran — the fields the A/B artifact
+        and tools/autotune.py ingestion consume."""
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "8",
+            "BENCH_SPEC_DRAFT": "3", "BENCH_SPEC_DRAFTER": "self",
+        })
+        assert "error" not in rec
+        assert rec["spec_draft"] == 3
+        assert rec["spec_drafter"] == "self"
+        assert 0.0 <= rec["spec_accept_rate"] <= 1.0
+        assert rec["tokens_per_verify_step"] >= 1.0
+        # CPU resolves the probe-gated fused kernel to its exact
+        # unrolled fallback; either spelling is a valid record, null is not
+        assert rec["spec_verify_impl"] in ("fused", "unrolled")
 
     def test_learner_record_shape(self):
         rec = run_bench({

@@ -273,3 +273,199 @@ class TestBlockedKernel:
             paged_attention_native_blocked(
                 q, kp, vp, lengths, table, pages_per_block=0, interpret=True
             )
+
+
+class TestVerifyKernel:
+    """Fused draft-block verify (ISSUE 6): the whole S-query speculative
+    verify in ONE blocked sweep — parity vs the per-position ladder
+    reference (``paged_verify_reference``), causal offsets, ragged tails,
+    int8, and the analytic grid model the engines/bench consume."""
+
+    @staticmethod
+    def _setup_verify(b, s, h, kh, hd, ps, pps, seed=0, lengths=None):
+        rng = np.random.default_rng(seed)
+        cap = pps * ps
+        kp = jnp.asarray(
+            rng.standard_normal((kh, b * pps, ps, hd)), jnp.float32)
+        vp = jnp.asarray(
+            rng.standard_normal((kh, b * pps, ps, hd)), jnp.float32)
+        q = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+        table = jnp.asarray(make_page_table(b, cap, ps))
+        if lengths is None:
+            # resident BEFORE the draft block: leave room for s tokens
+            lengths = rng.integers(1, cap - s, size=(b,))
+        lengths = jnp.asarray(lengths, jnp.int32)
+        return q, kp, vp, lengths, table
+
+    @pytest.mark.parametrize("ppb", [1, 2, 4, 8])
+    def test_r5_geometry_parity_per_query_causality(self, ppb):
+        """GQA 14q/2kv hd=64 at d=3 (verify width 4), including non-divisor
+        page tails, vs the exact lengths + i + 1 ladder the unrolled path
+        dispatches per position."""
+        from distrl_llm_tpu.ops.paged import paged_verify_reference
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        q, kp, vp, lengths, table = self._setup_verify(
+            b=3, s=4, h=14, kh=2, hd=64, ps=8, pps=5)
+        got = paged_attention_native_verify(
+            q * 64**-0.5, kp, vp, lengths, table,
+            pages_per_block=ppb, interpret=True)
+        want = paged_verify_reference(q, kp, vp, lengths, table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("s", [2, 5])
+    def test_draft_lengths_and_page_crossing(self, s):
+        """Lengths pinned right at / one below a page boundary so the draft
+        block itself crosses pages — the in-kernel causal ladder must track
+        each query's own limit, not the block guard's."""
+        from distrl_llm_tpu.ops.paged import paged_verify_reference
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        q, kp, vp, _, table = self._setup_verify(
+            b=4, s=s, h=8, kh=2, hd=32, ps=4, pps=6)
+        lengths = jnp.asarray([3, 4, 7, 15], jnp.int32)
+        got = paged_attention_native_verify(
+            q * 32**-0.5, kp, vp, lengths, table,
+            pages_per_block=2, interpret=True)
+        want = paged_verify_reference(q, kp, vp, lengths, table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def test_int8_compact_scales(self):
+        from distrl_llm_tpu.ops.paged import paged_verify_reference
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        q, kp, vp, lengths, table = self._setup_verify(
+            b=3, s=3, h=14, kh=2, hd=64, ps=8, pps=4, seed=3)
+        kq, vq = quantize_pages(kp), quantize_pages(vp)
+        got = paged_attention_native_verify(
+            q * 64**-0.5, kq.weight, vq.weight, lengths, table,
+            k_scales=kq.scales, v_scales=vq.scales,
+            pages_per_block=4, interpret=True)
+        want = paged_verify_reference(q, kq, vq, lengths, table)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def test_s1_matches_blocked_decode_at_length_plus_one(self):
+        """A 1-token 'draft block' is a decode step over length+1 keys: the
+        verify kernel must agree with the blocked decode kernel exactly
+        (same op order, same online-softmax carry)."""
+        q, kp, vp, lengths, table = self._setup_verify(
+            b=4, s=1, h=14, kh=2, hd=64, ps=8, pps=3)
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        got = paged_attention_native_verify(
+            q * 64**-0.5, kp, vp, lengths, table,
+            pages_per_block=2, interpret=True)
+        want = paged_attention_native_blocked(
+            q[:, 0] * 64**-0.5, kp, vp, lengths + 1, table,
+            pages_per_block=2, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(want))
+
+    def test_zero_length_rows_emit_finite(self):
+        """Dead refill slots verify garbage over scratch pages — outputs
+        must be finite (every query row attends at least its own draft
+        position, so the 0/0 softmax hazard cannot arise)."""
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        q, kp, vp, _, table = self._setup_verify(
+            b=3, s=4, h=4, kh=2, hd=32, ps=4, pps=4)
+        out = paged_attention_native_verify(
+            q * 32**-0.5, kp, vp, jnp.zeros((3,), jnp.int32), table,
+            pages_per_block=2, interpret=True)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_grid_step_model(self):
+        """The acceptance pin: a (d+1)-token verify step at the r5 geometry
+        costs ONE blocked sweep — B·ceil(pps/ppb) — not (d+1) sweeps."""
+        from distrl_llm_tpu.ops.paged import (
+            DEFAULT_PAGES_PER_BLOCK, paged_grid_steps,
+        )
+
+        r5 = dict(batch=480, num_kv_heads=2, pps=13)
+        fused = paged_grid_steps("native_verify", pages_per_block=8, **r5)
+        blocked = paged_grid_steps("native_blocked", pages_per_block=8, **r5)
+        assert fused == blocked == 480 * -(-13 // 8)  # ONE sweep
+        # the unrolled fan-out this PR removes paid (d+1)× per step
+        for d in (2, 4):
+            assert fused * (d + 1) == blocked * (d + 1)
+        # default block size matches the blocked kernel's
+        assert paged_grid_steps("native_verify", **r5) == paged_grid_steps(
+            "native_verify", pages_per_block=DEFAULT_PAGES_PER_BLOCK, **r5)
+
+    def test_validation(self):
+        from distrl_llm_tpu.ops.paged_native import (
+            paged_attention_native_verify,
+        )
+
+        q, kp, vp, lengths, table = self._setup_verify(
+            b=2, s=2, h=4, kh=2, hd=32, ps=4, pps=2)
+        with pytest.raises(ValueError, match="pages_per_block"):
+            paged_attention_native_verify(
+                q, kp, vp, lengths, table, pages_per_block=0, interpret=True)
+        with pytest.raises(ValueError, match="divisible"):
+            paged_attention_native_verify(
+                q[:, :, :3], kp, vp, lengths, table, interpret=True)
+
+
+class TestVerifyDispatch:
+    """paged_verify_op: the dispatch layer the transformer's verify branch
+    routes through — unrolled fallback exactness off-TPU, choice records
+    keyed apart from decode dispatches."""
+
+    def test_unrolled_matches_per_position_op(self):
+        from distrl_llm_tpu.ops.paged import (
+            paged_attention_op, paged_verify_op,
+        )
+
+        q, kp, vp, lengths, table = TestVerifyKernel._setup_verify(
+            b=3, s=3, h=14, kh=2, hd=64, ps=8, pps=4)
+        for verify_impl in ("fused", "unrolled"):
+            # off-TPU both resolve to the unrolled per-position dispatch —
+            # bit-identical to what the transformer always did
+            got = paged_verify_op(
+                q, kp, vp, lengths, table, verify_impl=verify_impl)
+            want = jnp.stack(
+                [
+                    paged_attention_op(
+                        q[:, i], kp, vp, lengths + i + 1, table)
+                    for i in range(3)
+                ],
+                axis=1,
+            )
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_choice_recorded_under_verify_key(self):
+        from distrl_llm_tpu.ops import paged as paged_mod
+
+        q, kp, vp, lengths, table = TestVerifyKernel._setup_verify(
+            b=2, s=3, h=4, kh=2, hd=32, ps=4, pps=2)
+        paged_mod.dispatch_choices.clear()
+        paged_mod.paged_verify_op(q, kp, vp, lengths, table)
+        key = paged_mod.dispatch_choice_key(
+            quantized=False, num_kv_heads=2, num_groups=2, head_dim=32,
+            page_size=4, pps=2, impl="auto", pages_per_block=0, verify_len=3)
+        assert paged_mod.dispatch_choices[key] == "unrolled"  # CPU backend
+        # verify keys never alias the single-query decode record
+        assert key[-1] == 3
+        paged_mod.dispatch_choices.clear()
+
+    def test_verify_impl_validation(self):
+        from distrl_llm_tpu.ops.paged import paged_verify_op
+
+        q, kp, vp, lengths, table = TestVerifyKernel._setup_verify(
+            b=2, s=2, h=4, kh=2, hd=32, ps=4, pps=2)
+        with pytest.raises(ValueError, match="verify_impl"):
+            paged_verify_op(
+                q, kp, vp, lengths, table, verify_impl="bogus")

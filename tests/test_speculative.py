@@ -47,6 +47,27 @@ def make_engine(max_new=12, eos=(), slots=4, **kw):
     )
 
 
+CFG12 = SamplingConfig(max_tokens=12, temperature=0.0, n=2)
+
+
+@pytest.fixture(scope="module")
+def plain12(setup):
+    """Shared plain-refill greedy baseline (12 tokens, n=2): every
+    bit-identity test compares against the SAME run instead of
+    recompiling its own plain engine."""
+    params, ids, mask = setup
+    return make_engine().generate(
+        params, None, ids, mask, CFG12, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def spec3_12(setup):
+    """Shared host-dispatched ngram d=3 spec run at the CFG12 geometry."""
+    params, ids, mask = setup
+    return make_engine(spec_draft=3).generate(
+        params, None, ids, mask, CFG12, jax.random.PRNGKey(0))
+
+
 class TestNgramProposer:
     def test_drafts_historical_continuation(self):
         # sequence: 5 6 7 8 5 6 → tail (5,6) matched at j=0 → draft 7 8 ...
@@ -100,7 +121,7 @@ class TestAcceptanceDistribution:
             draft = jnp.asarray([[draft_tok]], jnp.int32)
 
             def one(key):
-                emit, n = spec_accept(key, probs, draft)
+                emit, n, _ = spec_accept(key, probs, draft)
                 return emit[0, 0]
 
             toks = np.asarray(
@@ -113,12 +134,12 @@ class TestAcceptanceDistribution:
         v = 4
         p = np.zeros((1, 3, v), np.float32)
         p[0, :, 2] = 1.0  # greedy one-hot on token 2 at every position
-        emit, n = spec_accept(
+        emit, n, _ = spec_accept(
             jax.random.PRNGKey(0), jnp.asarray(p), jnp.asarray([[2, 2]], jnp.int32)
         )
         assert int(n[0]) == 3  # both drafts accepted + bonus
         np.testing.assert_array_equal(np.asarray(emit)[0], [2, 2, 2])
-        emit, n = spec_accept(
+        emit, n, _ = spec_accept(
             jax.random.PRNGKey(0), jnp.asarray(p), jnp.asarray([[2, 1]], jnp.int32)
         )
         assert int(n[0]) == 2  # second draft rejected → argmax emitted
@@ -131,30 +152,28 @@ class TestSpecEngine:
         3,
         pytest.param(4, marks=pytest.mark.slow),
     ])
-    def test_greedy_identical_to_plain_refill(self, setup, d):
-        params, ids, mask = setup
-        cfg = SamplingConfig(max_tokens=12, temperature=0.0, n=2)
-        plain = make_engine().generate(params, None, ids, mask, cfg, jax.random.PRNGKey(0))
-        spec = make_engine(spec_draft=d).generate(
-            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
-        np.testing.assert_array_equal(spec.tokens, plain.tokens)
-        np.testing.assert_array_equal(spec.lengths, plain.lengths)
+    def test_greedy_identical_to_plain_refill(self, setup, plain12, spec3_12, d):
+        if d == 3:
+            spec = spec3_12
+        else:
+            params, ids, mask = setup
+            spec = make_engine(spec_draft=d).generate(
+                params, None, ids, mask, CFG12, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(spec.tokens, plain12.tokens)
+        np.testing.assert_array_equal(spec.lengths, plain12.lengths)
 
-    def test_chunked_spec_parity(self, setup):
+    def test_chunked_spec_parity(self, setup, spec3_12):
         """scan_chunk over the speculative scheduler: the chunked program
         (unconditional body — scan_steps_guarded) must emit exactly what
         the host-dispatched spec loop emits, and must actually have run
         (not a guard fallback)."""
         params, ids, mask = setup
-        cfg = SamplingConfig(max_tokens=12, temperature=0.0, n=2)
-        host = make_engine(spec_draft=3).generate(
-            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
         eng = make_engine(spec_draft=3, scan_chunk=4)
-        chunked = eng.generate(params, None, ids, mask, cfg,
+        chunked = eng.generate(params, None, ids, mask, CFG12,
                                jax.random.PRNGKey(0))
         assert eng.scan_chunk_active
-        np.testing.assert_array_equal(chunked.tokens, host.tokens)
-        np.testing.assert_array_equal(chunked.lengths, host.lengths)
+        np.testing.assert_array_equal(chunked.tokens, spec3_12.tokens)
+        np.testing.assert_array_equal(chunked.lengths, spec3_12.lengths)
 
     @pytest.mark.slow
     def test_eos_truncates_within_draft_block(self, setup):
@@ -323,6 +342,29 @@ class TestSpecTrainerIntegration:
         # always rides along (the dense engine takes int8 KV too)
         assert engine_kwargs_from_config(TrainConfig()) == {"kv_quant": "none"}
 
+    def test_explicit_default_spellings_pin_past_plan_db(self):
+        """An EXPLICITLY configured spec_drafter='ngram' / spec_verify=
+        'fused' must reach the engine as a pin (the engine treats a
+        non-None kwarg as beating any stored plan), so a user can force
+        the defaults past a bad tuned plan; unset (None) stays out of the
+        kwargs and plan-DB-resolvable — the decode_scan_chunk convention
+        (review finding)."""
+        from distrl_llm_tpu.config import TrainConfig
+        from distrl_llm_tpu.trainer import engine_kwargs_from_config
+
+        base = dict(engine_impl="paged", continuous_batching=True,
+                    max_concurrent_sequences=8, spec_draft=4)
+        kw = engine_kwargs_from_config(TrainConfig(**base))
+        assert "spec_drafter" not in kw and "spec_verify" not in kw
+        kw = engine_kwargs_from_config(TrainConfig(
+            spec_drafter="ngram", spec_verify="fused", **base))
+        assert kw["spec_drafter"] == "ngram"
+        assert kw["spec_verify"] == "fused"
+        kw = engine_kwargs_from_config(TrainConfig(
+            spec_drafter="self", spec_verify="unrolled", **base))
+        assert kw["spec_drafter"] == "self"
+        assert kw["spec_verify"] == "unrolled"
+
 
 @pytest.mark.slow
 class TestSchedulerFuzz:
@@ -367,3 +409,275 @@ class TestSchedulerFuzz:
         np.testing.assert_array_equal(refill.tokens, base.tokens, err_msg=label)
         np.testing.assert_array_equal(spec.tokens, base.tokens, err_msg=label)
         np.testing.assert_array_equal(spec.lengths, base.lengths, err_msg=label)
+
+
+def _bumped_lora(base, key):
+    """A LoRA whose zero-init B matrices are perturbed so it actually
+    changes the policy (same trick as tests/test_inflight_updates.py)."""
+    leaves, treedef = jax.tree_util.tree_flatten(base)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [l + 0.5 * jax.random.normal(k, l.shape, l.dtype)
+         for l, k in zip(leaves, keys)],
+    )
+
+
+class TestFullQAcceptance:
+    """Full-distribution speculative rejection sampling (ISSUE 6): with a
+    proposal distribution q, spec_accept must leave the output
+    distribution IDENTICAL to plain sampling from the target p — and the
+    one-hot path must be exactly the q = onehot(draft) special case."""
+
+    def test_first_token_distribution_matches_target(self):
+        """Draft sampled from an ADVERSARIAL q (mass inverted vs p): the
+        first emitted token's empirical distribution must still equal p —
+        the rejection-sampling identity, pinned empirically."""
+        v = 5
+        p = np.asarray([0.4, 0.3, 0.15, 0.1, 0.05], np.float32)
+        q = np.asarray([0.05, 0.1, 0.15, 0.3, 0.4], np.float32)
+        probs = jnp.asarray(np.tile(p, (1, 2, 1)))  # [1, d+1=2, V]
+        qs = jnp.asarray(np.tile(q, (1, 1, 1)))  # [1, d=1, V]
+
+        def one(key):
+            dk, ak = jax.random.split(key)
+            draft = jax.random.categorical(
+                dk, jnp.log(qs[:, 0]), shape=(1,)
+            ).astype(jnp.int32)[:, None]
+            emit, _, _ = spec_accept(ak, probs, draft, qs)
+            return emit[0, 0]
+
+        toks = np.asarray(
+            jax.vmap(one)(jax.random.split(jax.random.PRNGKey(0), 8000))
+        )
+        emp = np.bincount(toks, minlength=v) / toks.size
+        np.testing.assert_allclose(emp, p, atol=0.02)
+
+    def test_onehot_q_bit_identical_to_onehot_path(self):
+        """q = onehot(draft) must reproduce the one-hot algebra exactly —
+        same emit, same n — for the same rng (the claim in spec_accept's
+        docstring, pinned bit-for-bit)."""
+        rng = np.random.default_rng(4)
+        r, d, v = 6, 3, 8
+        p = rng.random((r, d + 1, v)).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        draft = rng.integers(0, v, (r, d)).astype(np.int32)
+        q = jax.nn.one_hot(draft, v, dtype=jnp.float32)
+        key = jax.random.PRNGKey(11)
+        emit_oh, n_oh, m_oh = spec_accept(key, jnp.asarray(p), jnp.asarray(draft))
+        emit_q, n_q, m_q = spec_accept(key, jnp.asarray(p), jnp.asarray(draft), q)
+        np.testing.assert_array_equal(np.asarray(n_oh), np.asarray(n_q))
+        np.testing.assert_array_equal(np.asarray(emit_oh), np.asarray(emit_q))
+
+    def test_q_equals_p_accepts_every_draft(self):
+        """The self-drafter's pre-swap limit (q == p): every draft slot is
+        accepted — u·q < p holds a.s. — so n_emit == d+1 always."""
+        rng = np.random.default_rng(5)
+        r, d, v = 4, 3, 6
+        p = rng.random((r, d + 1, v)).astype(np.float32) + 0.1
+        p /= p.sum(-1, keepdims=True)
+        key = jax.random.PRNGKey(3)
+        draft = jax.vmap(
+            lambda k, row: jax.random.categorical(k, jnp.log(row[:d]))
+        )(jax.random.split(key, r), jnp.asarray(p)).astype(jnp.int32)
+        _, n, _ = spec_accept(
+            jax.random.PRNGKey(9), jnp.asarray(p), draft,
+            jnp.asarray(p[:, :d]),
+        )
+        np.testing.assert_array_equal(np.asarray(n), np.full(r, d + 1))
+
+
+class TestSelfDrafter:
+    """Online self-drafting (ISSUE 6): the policy's own previous LoRA
+    version as the draft model, with exactness independent of drafter
+    staleness and (step, version) bookkeeping off the mailbox swap log."""
+
+    @pytest.mark.parametrize("verify", ["fused", "unrolled"])
+    def test_greedy_identical_to_plain_refill(self, setup, plain12, verify):
+        """The acceptance criterion: greedy spec decode bit-identical to
+        plain refill decode for the SELF drafter, under both verify
+        dispatches (on CPU 'fused' resolves to the exact unrolled
+        fallback — the dispatch layer is what this pins)."""
+        params, ids, mask = setup
+        spec = make_engine(
+            spec_draft=3, spec_drafter="self", spec_verify=verify
+        ).generate(params, None, ids, mask, CFG12, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(spec.tokens, plain12.tokens)
+        np.testing.assert_array_equal(spec.lengths, plain12.lengths)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("verify", ["fused", "unrolled"])
+    def test_ngram_unrolled_verify_identical(self, setup, plain12, verify):
+        """And the NGRAM drafter under both verify dispatches (the fused
+        default is exercised by TestSpecEngine; this pins the A/B
+        control's exactness too)."""
+        params, ids, mask = setup
+        spec = make_engine(spec_draft=3, spec_verify=verify).generate(
+            params, None, ids, mask, CFG12, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(spec.tokens, plain12.tokens)
+
+    def test_stale_drafter_is_greedy_exact_with_swap_log_versions(self, setup):
+        """A drafter that is genuinely a DIFFERENT (previous) adapter
+        version must not change greedy output: rejection sampling is
+        exact for ANY q, so a stale drafter only costs acceptance, never
+        correctness. Round 1 consumes swap a→b(v5) (making `a` the
+        mailbox's previous version); round 2 consumes b→c(v9) and must
+        report the (drafter, target) VERSION pair off the swap log:
+        (5, 9). Round 3 (swap-free, so prefill and decode agree on the
+        target) then drafts with the genuinely superseded `b` while
+        verifying under `c` — and must match a plain refill round run
+        directly under `c`."""
+        from distrl_llm_tpu.models import init_lora_params
+        from distrl_llm_tpu.models.lora import lora_scale as _ls
+
+        params, ids, mask = setup
+        scale = _ls(4, 8.0)
+        lora_a = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        lora_b = _bumped_lora(lora_a, jax.random.PRNGKey(2))
+        lora_c = _bumped_lora(lora_a, jax.random.PRNGKey(3))
+        cfg = SamplingConfig(max_tokens=10, temperature=0.0, n=1)
+
+        eng = make_engine(max_new=10, spec_draft=3, spec_drafter="self",
+                          lora_scale=scale)
+        eng.push_lora(lora_b, version=5)
+        eng.generate(params, lora_a, ids, mask, cfg, jax.random.PRNGKey(0))
+        assert eng._prev_lora is lora_a  # superseded by the consumed swap
+
+        eng.push_lora(lora_c, version=9)  # consumed at round 2's step 0
+        eng.generate(params, lora_b, ids, mask, cfg, jax.random.PRNGKey(0))
+        st = eng.last_spec_stats
+        assert st is not None and st["drafter"] == "self"
+        assert st["drafter_version"] == 5
+        assert st["target_version"] == 9
+        assert eng._prev_lora is lora_b
+
+        spec = eng.generate(
+            params, lora_c, ids, mask, cfg, jax.random.PRNGKey(0))
+        assert eng.last_spec_stats["drafter_version"] == 5
+        plain = make_engine(max_new=10, lora_scale=scale).generate(
+            params, lora_c, ids, mask, cfg, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(spec.tokens, plain.tokens)
+        np.testing.assert_array_equal(spec.lengths, plain.lengths)
+
+    @pytest.mark.slow
+    def test_chunked_drafter_rotation_none_to_adapter(self, setup):
+        """A lora=None round under CHUNKED dispatch, two in-flight swaps:
+        the first leaves the drafter None (the target's signature change
+        triggers that rebuild), the SECOND rotates the drafter
+        None→adapter while the target's signature is unchanged — the
+        chunk program must rebuild off the drafter's signature too, not
+        hand the compiled executable a structurally new operand tree
+        (compiled programs raise on structure change instead of
+        retracing — review finding)."""
+        from distrl_llm_tpu.models import init_lora_params
+        from distrl_llm_tpu.models.lora import lora_scale as _ls
+
+        params, ids, mask = setup
+        scale = _ls(4, 8.0)
+        lora_a = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        lora_b = _bumped_lora(lora_a, jax.random.PRNGKey(2))
+        cfg = SamplingConfig(max_tokens=24, temperature=0.0, n=1)
+
+        eng = make_engine(max_new=24, spec_draft=3, spec_drafter="self",
+                          lora_scale=scale, scan_chunk=2)
+        eng.push_lora(lora_a, version=1)  # consumed at dispatch 0
+        fired = [False]
+        orig = eng._take_pending_lora
+
+        def hook(cell, dispatched):
+            if dispatched >= 1 and not fired[0]:
+                fired[0] = True
+                eng.push_lora(lora_b, version=2)
+            orig(cell, dispatched)
+
+        eng._take_pending_lora = hook
+        res = eng.generate(
+            params, None, ids, mask, cfg, jax.random.PRNGKey(0))
+        # both swaps consumed mid-round; the drafter rotated None→lora_a
+        # and the round survived the structure change
+        assert fired[0]
+        assert eng.last_swap_versions == [1, 2]
+        st = eng.last_spec_stats
+        assert st["drafter_version"] == 1
+        assert st["target_version"] == 2
+        assert np.all(np.asarray(res.lengths) > 0)
+
+
+class TestSpecAdapt:
+    def test_adaptive_draft_length_stays_greedy_exact(self, setup, plain12):
+        """The acceptance-rate controller only picks d from PAST data —
+        any d is exact, so greedy output must stay bit-identical to plain
+        decode even while the controller resizes."""
+        params, ids, mask = setup
+        eng = make_engine(spec_draft=4, spec_adapt=True)
+        res = eng.generate(params, None, ids, mask, CFG12,
+                           jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, plain12.tokens)
+        st = eng.last_spec_stats
+        assert 1 <= st["draft_len_final"] <= 4
+        assert st["draft_len_switches"] >= 0
+
+    def test_requires_spec_draft(self):
+        # an EXPLICIT spec_draft=0 with the controller on is a
+        # contradiction: hard error
+        with pytest.raises(ValueError, match="spec_adapt"):
+            make_engine(spec_adapt=True, spec_draft=0)
+        # unset spec_draft stays constructible (TrainConfig/worker_main
+        # both admit it — a tuned plan DB may enable speculation): with no
+        # stored plan it resolves to 0 and the controller goes INERT with
+        # a warning instead of crashing a command line that works on a
+        # tuned host
+        eng = make_engine(spec_adapt=True)
+        assert eng.spec_draft == 0
+        assert eng.spec_adapt is False
+
+
+class TestSpecConfigValidation:
+    """The ISSUE-6 'small fix' satellite: new-knob validation with clear
+    errors, and the sharded engine rejecting spec_draft by name."""
+
+    def test_train_config_validates_knobs(self):
+        from distrl_llm_tpu.config import TrainConfig
+
+        base = dict(continuous_batching=True, engine_impl="paged",
+                    max_concurrent_sequences=8)
+        with pytest.raises(ValueError, match="spec_drafter"):
+            TrainConfig(spec_draft=4, spec_drafter="oracle", **base)
+        with pytest.raises(ValueError, match="spec_verify"):
+            TrainConfig(spec_draft=4, spec_verify="maybe", **base)
+        with pytest.raises(ValueError, match=r"\[0, 16\]"):
+            TrainConfig(spec_draft=99, **base)
+        # spec_adapt with an EXPLICIT spec_draft=0 is a contradiction;
+        # spec_draft=None (unset) stays legal — a tuned plan-DB entry may
+        # enable speculation, and the engine re-validates post-resolution
+        with pytest.raises(ValueError, match="spec_adapt"):
+            TrainConfig(spec_adapt=True, spec_draft=0, **base)
+        TrainConfig(spec_adapt=True, **base)
+        with pytest.raises(ValueError, match="full_finetune"):
+            TrainConfig(spec_draft=4, spec_drafter="self",
+                        full_finetune=True, **base)
+        # the valid spellings construct
+        TrainConfig(spec_draft=4, spec_drafter="self", spec_verify="unrolled",
+                    spec_adapt=True, **base)
+
+    def test_engine_validates_knobs(self):
+        with pytest.raises(ValueError, match="spec_drafter"):
+            make_engine(spec_draft=3, spec_drafter="oracle")
+        with pytest.raises(ValueError, match="spec_verify"):
+            make_engine(spec_draft=3, spec_verify="maybe")
+        with pytest.raises(ValueError, match=r"\[0, 16\]"):
+            make_engine(spec_draft=17)
+
+    def test_sharded_engine_rejects_spec_by_name(self):
+        """spec_draft reaching ShardedPagedEngine must raise a
+        NotImplementedError naming the per-replica path — not a silent
+        TypeError from an unknown kwarg."""
+        from distrl_llm_tpu.engine.sharded_paged import ShardedPagedEngine
+
+        # the guard fires before any mesh work, so a placeholder mesh
+        # object is enough — the error must name the hosting path
+        with pytest.raises(NotImplementedError, match="per-replica"):
+            ShardedPagedEngine(
+                TINY, None, max_prompt_tokens=8, max_new_tokens=8,
+                eos_token_ids=[1], pad_token_id=0, spec_draft=4,
+            )

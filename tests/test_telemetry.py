@@ -342,6 +342,87 @@ class TestRegistry:
         snap = telemetry.metrics_snapshot()
         assert snap["rollout/backpressure_waits"] == 1.0
 
+    def test_hist_observe_count_prebinned(self):
+        """hist_observe(count=N) records the observation N times in ONE
+        call — the contract the engine's device-side emit histogram
+        relies on (one Python call per bucket per round, not one per
+        slot-step); count=0 is a no-op that must not touch the series."""
+        telemetry.hist_observe("engine/spec_emit_tokens", 3.0, count=4)
+        telemetry.hist_observe("engine/spec_emit_tokens", 5.0, count=1)
+        telemetry.hist_observe("engine/spec_emit_tokens", 9.0, count=0)
+        snap = telemetry.metrics_snapshot()
+        assert snap["engine/spec_emit_tokens_count"] == 5
+        assert snap["engine/spec_emit_tokens_mean"] == pytest.approx(3.4)
+        assert snap["engine/spec_emit_tokens_max"] == 5.0
+        assert telemetry.metrics_snapshot() == {}  # 0-count left no trace
+
+    def test_spec_series_schema(self):
+        """Schema pin for the speculative-decoding registry names
+        (ISSUE 6) and their TYPES: engine/spec_accept_rate is a GAUGE
+        (last round wins), engine/spec_emit_tokens a HISTOGRAM (the
+        per-step emit distribution, pre-binned device-side), and
+        engine/spec_verify_grid_steps + engine/spec_draft_resizes are
+        COUNTERS (report-and-reset deltas)."""
+        telemetry.gauge_set("engine/spec_accept_rate", 0.5)
+        telemetry.gauge_set("engine/spec_accept_rate", 0.8)
+        for n, c in enumerate([0, 3, 2, 1, 2]):  # emit 0..4 tokens/step
+            telemetry.hist_observe("engine/spec_emit_tokens", float(n),
+                                   count=c)
+        telemetry.counter_add("engine/spec_verify_grid_steps", 23040)
+        telemetry.counter_add("engine/spec_verify_grid_steps", 23040)
+        telemetry.counter_add("engine/spec_draft_resizes")
+        snap = telemetry.metrics_snapshot()
+        assert snap["engine/spec_accept_rate"] == 0.8
+        assert snap["engine/spec_emit_tokens_count"] == 8
+        assert snap["engine/spec_emit_tokens_mean"] == pytest.approx(2.25)
+        assert snap["engine/spec_verify_grid_steps"] == 46080
+        assert snap["engine/spec_draft_resizes"] == 1.0
+        # counters reset; the gauge persists only until next snapshot too
+        assert "engine/spec_verify_grid_steps" not in (
+            telemetry.metrics_snapshot()
+        )
+
+    def test_spec_round_emits_series_end_to_end(self):
+        """The engine actually emits the pinned series: one tiny
+        speculative refill round must land engine/spec_accept_rate,
+        engine/spec_emit_tokens and engine/spec_verify_grid_steps in the
+        snapshot, with the histogram's token count conserving the round's
+        generated volume (emitted = generated − admitted first tokens)."""
+        import jax
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.config import SamplingConfig
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+        from distrl_llm_tpu.models import TINY, init_params
+
+        params = init_params(jax.random.PRNGKey(7), TINY)
+        ids = np.random.default_rng(1).integers(
+            1, TINY.vocab_size, size=(2, 8)).astype(np.int32)
+        mask = np.ones((2, 8), np.int32)
+        engine = PagedGenerationEngine(
+            TINY, max_prompt_tokens=8, max_new_tokens=8,
+            eos_token_ids=[TINY.vocab_size - 1], pad_token_id=0,
+            cache_dtype=jnp.float32, page_size=8,
+            scheduler="refill", max_concurrent_rows=2, spec_draft=2,
+            autotune=False,
+        )
+        res = engine.generate(
+            params, None, ids, mask,
+            SamplingConfig(max_tokens=8, temperature=0.0, n=1),
+            jax.random.PRNGKey(0),
+        )
+        snap = telemetry.metrics_snapshot()
+        assert 0.0 <= snap["engine/spec_accept_rate"] <= 1.0
+        # CPU dispatch resolves to the jnp reference (no Pallas grid), so
+        # the grid counter stays honestly SILENT — same contract as
+        # test_paged_grid_telemetry_reference_path_is_silent; on TPU the
+        # engine emits it (asserted in tools/spec bench artifacts)
+        assert "engine/spec_verify_grid_steps" not in snap
+        emitted = snap["engine/spec_emit_tokens_count"] * snap[
+            "engine/spec_emit_tokens_mean"]
+        assert emitted == pytest.approx(
+            int(res.lengths.sum()) - res.lengths.size)
+
 
 class TestMfuMath:
     def test_flops_per_token_hand_computed_tiny(self):

@@ -91,12 +91,24 @@ def plan_from_bench_row(row: dict):
     chunk = int(row.get("scan_chunk") or 0)
     if not row.get("scan_chunk_active"):
         chunk = 0
+    spec_kw = {}
+    if path == "speculative":
+        # spec rows carry their whole configuration (ISSUE 6): the draft
+        # length, the drafter, and the verify kernel that actually ran —
+        # storing them makes the tuned plan reproducible without
+        # BENCH_SPEC_* scaffolding
+        spec_kw = {
+            "spec_draft_len": int(row.get("spec_draft") or 0),
+            "spec_drafter": row.get("spec_drafter"),
+            "spec_verify": row.get("spec_verify_impl"),
+        }
     return ExecutionPlan(
         decode_path=path,
         scan_chunk=chunk,
         # rows since this PR carry the formulation; older rows derive
         cache_read_formulation=row.get("cache_read_formulation"),
         top_p_impl=row.get("top_p_impl"),
+        **spec_kw,
     )
 
 
@@ -238,6 +250,17 @@ def cmd_measure(args) -> int:
         pages_per_blocks=tuple(
             int(x) for x in args.pages_per_blocks.split(",")
         ),
+        spec_draft_lens=tuple(
+            int(x) for x in args.spec_draft_lens.split(",")
+        ),
+        spec_drafters=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.spec_drafters.split(",")
+        ),
+        spec_verifies=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.spec_verifies.split(",")
+        ),
     )
     print(f"measuring {len(candidates)} candidate plan(s) for {args.model} "
           f"p{args.max_prompt}+n{args.max_new} × {args.prompts}·"
@@ -324,6 +347,16 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--pages-per-block", dest="pages_per_blocks", default="0",
                    help="comma list of blocked-kernel page collapses "
                         "(0 = kernel default; only with blocked)")
+    m.add_argument("--spec-draft-lens", dest="spec_draft_lens", default="0,4",
+                   help="comma list of speculative draft lengths (0 rides "
+                        "the non-speculative paths; >0 only pairs with the "
+                        "speculative path)")
+    m.add_argument("--spec-drafters", dest="spec_drafters", default="auto",
+                   help="comma list from auto,ngram,self ('auto' = engine "
+                        "default; speculative path only)")
+    m.add_argument("--spec-verifies", dest="spec_verifies", default="auto",
+                   help="comma list from auto,fused,unrolled ('auto' = "
+                        "engine default; speculative path only)")
     m.add_argument("--kv-quant", dest="kv_quant", default="none",
                    choices=["none", "int8"])
     m.add_argument("--warmup", type=int, default=1)

@@ -133,7 +133,7 @@ def main() -> int:
     d = 4
     spec_state = jax.eval_shape(partial(
         PE._spec_init, b=b, r_slots=r_slots, total=total, max_steps=T,
-        buf_width=P_ + T + d + 1, pool_pages=pool_pages,
+        buf_width=P_ + T + d + 1, pool_pages=pool_pages, hist_width=d + 2,
         prompt_pages=eng.prompt_pages, private_pages=eng.private_pages,
         pad_id=0), pool_s, pool_s)
     fn = jax.jit(

@@ -45,6 +45,12 @@ stage "rollout_smoke" env JAX_PLATFORMS=cpu \
 # loop recovers capacity, group accounting stays intact, SIGTERM drains
 stage "chaos_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/chaos_smoke.py
+# speculative-decoding gate (ISSUE 6): greedy bit-identity for both
+# drafters (ngram + previous-LoRA self-drafting), chunked dispatch, emit
+# accounting, and a traced async train through the spec engine whose
+# trace_report shows the speculative section
+stage "spec_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/spec_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one

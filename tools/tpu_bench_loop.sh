@@ -204,6 +204,25 @@ bench int8kv  /tmp/bench_tpu_int8kv.json \
 bench spec_scan /tmp/bench_tpu_spec_scan.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SCAN_CHUNK=16
+# speculative A/B triple (ISSUE 6): off vs ngram vs self on ONE refill
+# config, fused verify (the production path), plus an unrolled-verify
+# control — each row records spec_drafter / spec_accept_rate /
+# tokens_per_verify_step / spec_verify_impl, so the artifact shows both
+# the acceptance win (tokens/step > 1) and the fused-kernel grid win
+# (grid_steps_estimate: one blocked sweep vs d+1). refill_scan above is
+# the spec-off control (identical env minus BENCH_SPEC_*).
+bench spec_ngram_fused /tmp/bench_tpu_spec_ngram_fused.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SPEC_DRAFTER=ngram \
+  BENCH_SPEC_VERIFY=fused BENCH_SCAN_CHUNK=16
+bench spec_self_fused /tmp/bench_tpu_spec_self_fused.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SPEC_DRAFTER=self \
+  BENCH_SPEC_VERIFY=fused BENCH_SCAN_CHUNK=16
+bench spec_unrolled /tmp/bench_tpu_spec_unrolled.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SPEC_VERIFY=unrolled \
+  BENCH_SCAN_CHUNK=16
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
@@ -231,6 +250,7 @@ all_done() {
   for n in prep_7b_params kernel_check chunk_check \
            dense_scan dense_scan_int8 dense_scan64 refill_scan \
            qwen7b_bf16kv qwen7b_int4 learner_7b budget int8kv spec_scan \
+           spec_ngram_fused spec_self_fused spec_unrolled \
            paged_folded \
            step_anatomy learner_anatomy \
            mem_envelope train_curve \

@@ -199,6 +199,57 @@ def main() -> int:
     )
     check_paged("paged_blocked_hd128_int8", 28, kq128, vq128, "native_blocked")
 
+    # ---- fused draft-block verify kernel (ISSUE 6): the whole S-query
+    # speculative verify in ONE blocked sweep, vs the per-position ladder
+    # reference. Ragged lengths land mid-page (the `lengths` draw above),
+    # so the per-query causal offsets (lengths + i + 1) cross page
+    # boundaries inside the block — the tail case that interpreter parity
+    # alone proved for the blocked kernel but silicon must confirm here.
+    from distrl_llm_tpu.ops.paged import paged_verify_reference
+    from distrl_llm_tpu.ops.paged_native import paged_attention_native_verify
+
+    def check_verify(label, h_, kp, vp, s_):
+        nonlocal failures
+        try:
+            quant = hasattr(kp, "weight")
+            d_ = kp.weight.shape[-1] if quant else kp.shape[-1]
+            qx = jnp.asarray(rng.normal(size=(nb, s_, h_, d_)), jnp.bfloat16)
+            # op contract: the draft block's KV is RESIDENT, so a row's
+            # lengths + s_ never exceeds its page capacity (the engine
+            # sizes private pages for d — tests/test_speculative.py's
+            # near-budget case); clamp the shared ragged draw to match
+            lv = jnp.minimum(lengths, cap - s_)
+            kw = dict(pages_per_block=8)
+            if quant:
+                got = paged_attention_native_verify(
+                    qx * d_ ** -0.5, kp.weight, vp.weight, lv, table,
+                    k_scales=kp.scales, v_scales=vp.scales, **kw)
+            else:
+                got = paged_attention_native_verify(
+                    qx * d_ ** -0.5, kp, vp, lv, table, **kw)
+            want = paged_verify_reference(qx, kp, vp, lv, table)
+            err = np.abs(
+                np.asarray(got.astype(jnp.float32))
+                - np.asarray(want.astype(jnp.float32))
+            ).max()
+            ok = err < 3e-2
+            failures += not ok
+            print(f"{'PASS' if ok else 'FAIL'} {label} d={s_ - 1} cap={cap} "
+                  f"max_err={err:.4f}")
+        except Exception as e:  # noqa: BLE001 — record, count, continue
+            failures += 1
+            print(f"FAIL {label} ({type(e).__name__}: {str(e)[:160]})")
+
+    # d ∈ {2, 4} (verify width d+1), bf16 and int8-compact, both model
+    # classes — the exact variants the production spec path dispatches
+    kq64 = quantize_pages(kp64.astype(jnp.float32))
+    vq64 = quantize_pages(vp64.astype(jnp.float32))
+    check_verify("paged_verify_hd64_gqa14_d2", 14, kp64, vp64, 3)
+    check_verify("paged_verify_hd64_gqa14_d4", 14, kp64, vp64, 5)
+    check_verify("paged_verify_hd64_int8_d4", 14, kq64, vq64, 5)
+    check_verify("paged_verify_hd128_d2", 28, kp128, vp128, 3)
+    check_verify("paged_verify_hd128_int8_d4", 28, kq128, vq128, 5)
+
     # ---- grid-step budget at the r5 benched paged geometry (480 rows × 2
     # kv × 13 pages; ×24 layers ≈ 300k one-page grid steps/decode step —
     # the measured ~1 µs/grid-step launch bound, BASELINE.md). The blocked
@@ -214,6 +265,22 @@ def main() -> int:
     print(f"{'PASS' if ok else 'FAIL'} blocked_grid_steps r5-geometry "
           f"one_page={one_page} blocked={blocked} "
           f"(x{one_page / max(blocked, 1):.1f}, need >= 8)")
+
+    # ---- fused-verify grid budget (ISSUE 6 acceptance): a (d+1)-token
+    # verify step at the r5 geometry must cost exactly ONE blocked sweep —
+    # B · ceil(pps/ppb) — not (d+1) sweeps (the unrolled fan-out this PR
+    # removes); asserted against the analytic model the engines/bench use.
+    d_spec = 4
+    fused_verify = paged_grid_steps(
+        "native_verify", pages_per_block=8, **r5)
+    unrolled_verify = blocked * (d_spec + 1)
+    ok = fused_verify == blocked and fused_verify * (d_spec + 1) == (
+        unrolled_verify
+    )
+    failures += not ok
+    print(f"{'PASS' if ok else 'FAIL'} verify_grid_steps r5-geometry "
+          f"fused={fused_verify} (one sweep) vs unrolled d=4: "
+          f"{unrolled_verify} (x{unrolled_verify / max(fused_verify, 1):.1f})")
 
     # ---- _gqa_mulred fusion audit (ADVICE r5): the mulred decode read's
     # [B, KH, G, D, S] broadcast product must be FUSED into the cache read —

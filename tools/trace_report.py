@@ -121,7 +121,13 @@ def rollout_section(events: list[dict],
         if ev.get("name") == "rollout/buffer_occupancy":
             occ.append(float(args.get("buffer_occupancy", 0)))
         elif ev.get("name") == "rollout/staleness":
-            stale.append(float(args.get("staleness", 0)))
+            # hist_observe(count=) carries the observation weight in the
+            # event args; a weighted sample must count that many times or
+            # the trace summary disagrees with metrics_snapshot
+            stale.extend(
+                [float(args.get("staleness", 0))]
+                * int(args.get("count", 1))
+            )
     produce = [e for (_, n), evs in spans.items() if n == "rollout/produce"
                for e in evs]
     updates = [e for (_, n), evs in spans.items() if n == "driver/update"
@@ -158,6 +164,50 @@ def rollout_section(events: list[dict],
             f"  producer rounds:    {len(produce)} (no driver/update spans "
             "in window)"
         )
+    lines.append("")
+    return lines
+
+
+def spec_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
+    """Speculative-decoding diagnosis from one trace: every spec-mode
+    refill round stamps its decode span with ``spec_drafter`` /
+    ``spec_accept_rate`` / ``tokens_per_verify_step``, so the report can
+    show the realized speculation without a bench run — the mean accepted
+    draft prefix per verify step, tokens emitted per step (the speculation
+    multiplier on step rate), and the drafter mix across rounds (a run
+    that swaps --spec_drafter mid-experiment shows both). Empty when no
+    spec round traced."""
+    rounds = [
+        e for (_, n), evs in spans.items()
+        if n == "engine/refill_decode" for e in evs
+        if e.get("args", {}).get("spec_drafter")
+    ]
+    if not rounds:
+        return []
+    rates = [float(e["args"].get("spec_accept_rate", 0)) for e in rounds]
+    tps = [float(e["args"].get("tokens_per_verify_step", 0)) for e in rounds]
+    mix: dict[str, int] = {}
+    for e in rounds:
+        drafter = str(e["args"]["spec_drafter"])
+        mix[drafter] = mix.get(drafter, 0) + 1
+    lines = ["speculative:"]
+    lines.append(
+        f"  accept rate:        mean {sum(rates) / len(rates):.3f} / min "
+        f"{min(rates):.3f} / max {max(rates):.3f} ({len(rounds)} rounds)"
+    )
+    # tokens_per_verify_step is the EMITTED count — EOS/budget truncation
+    # can cut an accepted draft run short, so label it as emitted drafts,
+    # not "accepted" (the accept-rate line above is the sampler-true
+    # acceptance off accept_total)
+    lines.append(
+        f"  tokens/verify step: mean {sum(tps) / len(tps):.2f} "
+        f"(emitted drafts {sum(tps) / len(tps) - 1:.2f} + 1 "
+        "resample/bonus; post EOS/budget truncation)"
+    )
+    lines.append(
+        "  drafter mix:        "
+        + ", ".join(f"{k} ×{v}" for k, v in sorted(mix.items()))
+    )
     lines.append("")
     return lines
 
@@ -215,6 +265,7 @@ def build_report(events: list[dict], metadata: dict,
 
     lines.extend(resilience_section(spans))
     lines.extend(rollout_section(events, spans))
+    lines.extend(spec_section(spans))
 
     prefill = tok_s(("engine/prefill",))
     # NOT worker/generate or engine/remote_round: those wrap the engine

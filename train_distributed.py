@@ -90,14 +90,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequences rows decoding, admit a pending candidate "
                         "whenever a slot's occupant hits EOS (vLLM continuous "
                         "batching) instead of draining whole waves")
-    p.add_argument("--spec_draft", type=int, default=0,
-                   help="n-gram speculative decoding: draft this many tokens "
-                        "per step from the sequence's own history (prompt "
-                        "lookup) and verify in one forward; distribution-"
+    p.add_argument("--spec_draft", type=int, default=None,
+                   help="speculative decoding: draft this many tokens per "
+                        "step and verify in one forward; distribution-"
                         "identical to plain decoding. Requires "
-                        "--continuous_batching. 0 = off")
-    p.add_argument("--spec_ngram", type=int, default=2,
-                   help="lookup n-gram size for --spec_draft")
+                        "--continuous_batching. Passing the flag — "
+                        "INCLUDING 0 (off) — pins the choice past any "
+                        "stored autotune plan; omitting it leaves the plan "
+                        "DB in charge (default off)")
+    p.add_argument("--spec_ngram", type=int, default=None,
+                   help="lookup n-gram size for --spec_draft (passing the "
+                        "flag pins past any stored autotune plan; unset = "
+                        "engine default / plan DB)")
+    p.add_argument("--spec_drafter", choices=["ngram", "self"],
+                   default=None,
+                   help="draft source for --spec_draft: 'ngram' (prompt "
+                        "lookup) or 'self' (the policy's own previous LoRA "
+                        "version off the weight-update swap log — "
+                        "near-on-policy, high acceptance; needs a LoRA run). "
+                        "Passing the flag — even 'ngram' — pins the choice "
+                        "past any stored autotune plan; omitting it leaves "
+                        "the plan DB in charge")
+    p.add_argument("--spec_verify", choices=["fused", "unrolled"],
+                   default=None,
+                   help="verify-attention kernel: 'fused' = the whole draft "
+                        "block in ONE blocked Pallas sweep (probe-gated, "
+                        "exact unrolled fallback); 'unrolled' = d+1 "
+                        "per-position dispatches (A/B control). Passing the "
+                        "flag pins past any stored autotune plan")
+    p.add_argument("--spec_adapt", action="store_true",
+                   help="acceptance-rate-driven draft-length adaptation: "
+                        "shrink the effective draft length when the accept-"
+                        "rate EMA says drafts are wasted, regrow on recovery")
     p.add_argument("--clip_ratio", type=float, default=0.0,
                    help="PPO-clip epsilon over engine-captured behavior "
                         "logprobs (0 = reference-parity no-clip objective)")
