@@ -265,6 +265,39 @@ def _paged_grid_steps_per_call(engine, cfg, rows: int):
     )
 
 
+def _hbm_peak_bytes():
+    """Device HBM peak watermark (ISSUE 8), or None on backends without
+    memory stats (CPU fallback rows stay honest nulls)."""
+    from distrl_llm_tpu import obs
+
+    stats = obs.hbm_stats()
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+    return int(peak) if peak else None
+
+
+def _recompile_count() -> int:
+    """Compiles BEYOND the first per (fn × shape signature) key since the
+    run-scoped tracker reset — 0 in a healthy run; anything else is a
+    silent retrace storm the wall-clock numbers quietly paid for."""
+    from distrl_llm_tpu import obs
+
+    return obs.retrace_total()
+
+
+def _fleet_tok_s():
+    """Fleet-aggregate tok/s gauge when a control-plane fleet published
+    one in this process (obs.FleetAggregator). Today NO bench mode builds
+    a control plane, so every row records null — the field is the schema
+    slot the ROADMAP-item-4 fleet bench rows will fill (and the trainer's
+    train-curve records already can, via the shared registry), kept here
+    so the two artifact families stay join-able."""
+    from distrl_llm_tpu import obs, telemetry
+
+    return telemetry.observe_snapshot()["gauges"].get(obs.FLEET_TOK_S)
+
+
 def _attn_fallback_fired(attn_impl: str) -> bool:
     """True when attention() fell back to the XLA reference path during the
     (traced) first step — a "flash" record with this flag set measured
@@ -342,6 +375,9 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
     # actually ran (round-3 learner record: step_seconds 0.0, "MFU" 503x —
     # physically impossible). float(loss) cannot return early: the scalar's
     # bytes depend on the whole step chain.
+    import importlib
+
+    importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
     t0 = time.perf_counter()
     lora, opt_state, loss = step(lora, opt_state, params, batch)
     float(loss)
@@ -385,6 +421,10 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         "devices_visible": jax.device_count(),
         "train_flops_per_token_gflop": round(flops / 1e9, 6),
         "loss": loss_val,
+        # measured-attribution fields (ISSUE 8), shared with the rollout
+        # record: device HBM watermark and shape-keyed retrace count
+        "hbm_peak_bytes": _hbm_peak_bytes(),
+        "recompile_count": _recompile_count(),
     }
     if mfu > 0.6:
         # >60% MFU on a fwd+bwd step means the timing is broken, not that
@@ -726,6 +766,9 @@ def main() -> int:
     import importlib
 
     importlib.import_module("distrl_llm_tpu.ops.paged").dispatch_choices.clear()
+    # scope the obs compile/retrace tracker to this run the same way: the
+    # recompile_count field must describe THIS config's programs only
+    importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
     # BENCH_REPEATS > 1 (the pinned fallback sets 3): sum tokens over N
     # timed runs so sub-second CPU measurements aren't dominated by
@@ -988,6 +1031,16 @@ def main() -> int:
         "pct_of_roofline": round(100.0 * tps_chip / roofline, 2) if roofline else None,
         "hbm_gbps_assumed": hbm_gbps,
         "pool_stats": getattr(engine, "last_pool_stats", None),
+        # measured-attribution fields (ISSUE 8, pinned in
+        # tests/test_bench_contract.py): device HBM watermark (null on
+        # backends without memory stats), shape-keyed retrace count since
+        # the pre-warmup tracker reset (0 = no silent retrace storm), and
+        # the fleet-aggregate tok/s gauge when a control-plane fleet
+        # published one (null on single-process rows — bench drives the
+        # engine directly)
+        "hbm_peak_bytes": _hbm_peak_bytes(),
+        "recompile_count": _recompile_count(),
+        "fleet_tok_s": _fleet_tok_s(),
         "baseline_note": "baseline 1500 tok/s/GPU derived from reference's ~2h/100-step "
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "
                          "model is recorded in 'model'",

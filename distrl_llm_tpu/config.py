@@ -412,6 +412,26 @@ class TrainConfig:
     # at shutdown. Orthogonal to profile_dir (device-level XLA traces).
     trace_dir: str | None = None
     trace_steps: int = 0
+    # --- continuous observability (distrl_llm_tpu/obs.py, ISSUE 8) --------
+    # Live metrics endpoint: serve the cumulative telemetry registry over
+    # HTTP (Prometheus text at /metrics, JSON at /metrics.json) from the
+    # driver process. With remote rollout workers the endpoint additionally
+    # publishes fleet/* series aggregated from the per-worker snapshots
+    # piggybacked on control-plane results. None = off; 0 = auto-assign a
+    # port (read it from the startup log).
+    metrics_port: int | None = None
+    # Anomaly sentinel: deterministic triggers per train step (NaN/Inf
+    # loss, reward collapse, staleness blowup, tok/s regression vs a
+    # running EMA, HBM watermark breach); each fires at most once and dumps
+    # the flight-recorder ring into an incident directory. Requires
+    # flight_recorder_dir (the evidence has to land somewhere).
+    sentinel: bool = False
+    # Incident bundle output directory: arming it keeps a bounded
+    # in-memory ring of recent step records (obs_ring_size) that sentinel
+    # triggers dump as incident_step<N>_<trigger>/ with the metric ring,
+    # telemetry span tail, and config/plan snapshot.
+    flight_recorder_dir: str | None = None
+    obs_ring_size: int = 256
     # Hang detector on generation rounds — parity with the reference's
     # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
     # default: a first rollout legitimately spends minutes in XLA compilation;
@@ -521,6 +541,22 @@ class TrainConfig:
             )
         if self.trace_steps and not self.trace_dir:
             raise ValueError("trace_steps requires trace_dir")
+        if self.metrics_port is not None and not (
+            0 <= self.metrics_port <= 65535
+        ):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535] (0 = auto-assign), "
+                f"got {self.metrics_port}"
+            )
+        if self.sentinel and not self.flight_recorder_dir:
+            raise ValueError(
+                "sentinel requires flight_recorder_dir — a trigger's whole "
+                "point is the incident bundle it dumps there"
+            )
+        if self.obs_ring_size < 1:
+            raise ValueError(
+                f"obs_ring_size must be >= 1, got {self.obs_ring_size}"
+            )
         # decode_scan_chunk covers every engine_impl and scheduler (dense,
         # paged wave + refill + speculative, paged_sharded)
         if self.continuous_batching and (

@@ -68,8 +68,10 @@ MSG_PONG = 4
 MSG_SHUTDOWN = 5
 MSG_ERROR = 6
 # RESULT with a telemetry blob piggybacked: payload is
-# pickle((blob, result_bytes)). Workers send it only when they actually
-# recorded spans (DISTRL_TRACE / --trace), so untraced runs keep the plain
+# pickle((blob, result_bytes)). Workers send it when they recorded spans
+# (DISTRL_TRACE / --trace) AND/OR have obs export armed (--metrics-port /
+# DISTRL_OBS=1 — the blob then carries a "metrics" registry snapshot for
+# the driver's fleet aggregator); runs with neither keep the plain
 # MSG_RESULT frame and zero overhead.
 MSG_RESULT_TLM = 7
 
@@ -220,8 +222,17 @@ class WorkerServer:
                     result = handler(payload)
                     # spans the handler recorded ride home on the response
                     # (the worker has no trace file of its own; the driver
-                    # merges them under a per-worker track)
+                    # merges them under a per-worker track). With obs
+                    # export armed (--metrics-port / DISTRL_OBS=1) the
+                    # worker's cumulative registry snapshot rides the same
+                    # envelope — the driver's fleet aggregator feeds on it.
                     blob = telemetry.drain_remote_blob()
+                    obs_snap = telemetry.export_obs_blob()
+                    if obs_snap is not None:
+                        blob = dict(blob) if blob else {
+                            "events": [], "threads": {},
+                        }
+                        blob["metrics"] = obs_snap
                     if blob is not None:
                         conn.send(
                             MSG_RESULT_TLM, req_id,
@@ -295,6 +306,20 @@ class DriverClient:
     @property
     def num_healthy(self) -> int:
         return sum(w.healthy for w in self._workers)
+
+    def worker_states(self) -> list[dict]:
+        """Point-in-time health view for the observability plane
+        (obs.FleetAggregator): one dict per configured worker, under the
+        same mutex health transitions take."""
+        with self._workers_mu:
+            return [
+                {
+                    "address": f"{w.address[0]}:{w.address[1]}",
+                    "healthy": bool(w.healthy),
+                    "cold": bool(w.cold),
+                }
+                for w in self._workers
+            ]
 
     def _next_id(self) -> int:
         with self._id_mu:
@@ -381,6 +406,7 @@ class DriverClient:
             sp.set(ok=True)
         telemetry.counter_add(resilience.CP_RECONNECTS)
         telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+        telemetry.gauge_set(resilience.CP_REJOIN_EPOCH, self.rejoin_epoch)
         log.info("worker %s:%d rejoined (cold)", host, port)
         return True
 

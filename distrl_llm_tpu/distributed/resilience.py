@@ -48,6 +48,7 @@ CP_RESUBMITS = "cp/resubmits"
 CP_RETRIES = "cp/retries"
 CP_POISON_SHARDS = "cp/poison_shards"
 CP_DEGRADED_GROUPS = "cp/degraded_groups"
+CP_REJOIN_EPOCH = "cp/rejoin_epoch"  # gauge: bumps per re-admit
 
 FAULT_SCHEDULE_ENV = "DISTRL_FAULT_SCHEDULE"
 

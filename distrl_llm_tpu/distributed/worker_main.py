@@ -319,6 +319,14 @@ def main(argv: list[str] | None = None) -> None:
                              "driver in RPC responses (also enabled by "
                              "DISTRL_TRACE=1); the driver merges them into "
                              "its trace under this worker's track")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve this worker's live metrics endpoint "
+                             "(Prometheus at /metrics, JSON at "
+                             "/metrics.json) on this port (0 = auto; the "
+                             "bound port prints as 'METRICS <n>'), and "
+                             "piggyback the registry snapshot on RPC "
+                             "results for the driver's fleet aggregator "
+                             "(snapshot-only export also via DISTRL_OBS=1)")
     parser.add_argument("--fault-schedule", type=str, default=None,
                         help="deterministic fault-injection schedule for "
                              "this worker's connections (resilience."
@@ -387,6 +395,17 @@ def main(argv: list[str] | None = None) -> None:
 
     server = WorkerServer(port=args.port)
 
+    metrics_server = None
+    if args.metrics_port is not None:
+        from distrl_llm_tpu import telemetry
+        from distrl_llm_tpu.obs import MetricsServer
+
+        # the endpoint serves this worker's cumulative registry; export
+        # additionally piggybacks it on every RPC result so the driver's
+        # fleet aggregator sees workers without scraping them
+        telemetry.configure_obs(export=True)
+        metrics_server = MetricsServer(args.metrics_port)
+
     def _drain(signum, frame):  # noqa: ARG001 — signal handler signature
         # graceful preemption: finish (and deliver) the dispatch in flight,
         # then exit 0 — the handler only sets a flag; the serve loop drains
@@ -395,7 +414,11 @@ def main(argv: list[str] | None = None) -> None:
 
     signal.signal(signal.SIGTERM, _drain)
     print(f"PORT {server.port}", flush=True)
+    if metrics_server is not None:
+        print(f"METRICS {metrics_server.port}", flush=True)
     server.serve_forever(handler)
+    if metrics_server is not None:
+        metrics_server.close()
     if server.draining:
         # telemetry spans recorded since the last RPC have no response left
         # to ride home on — drop them explicitly rather than leak the list

@@ -35,6 +35,7 @@ round-2 verdict asked to build or refute.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Sequence
 
@@ -46,9 +47,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distrl_llm_tpu.config import SamplingConfig
 import threading
 
+from distrl_llm_tpu import obs
 from distrl_llm_tpu.engine.engine import (
     GenerationResult,
     LoraMailbox,
+    accumulate_round_stats,
     cached_chunk_program,
     lora_signature,
     make_swap_aware_chunk_step,
@@ -255,6 +258,7 @@ class ShardedPagedEngine(LoraMailbox):
         key = (n, b_local, max_steps, top_p_impl)
         if key in self._built:
             return self._built[key]
+        obs.note_compile("sharded_paged/build", key)
         mesh = self.mesh
         sspec = self._state_specs()
 
@@ -343,6 +347,7 @@ class ShardedPagedEngine(LoraMailbox):
             raise ValueError(
                 f"prompts must be padded to {self.max_prompt_tokens}, got {p}"
             )
+        t_round = time.perf_counter()
         max_steps = min(sampling.max_tokens, self.max_new_tokens)
         n = max(sampling.n, 1)
         # pad the prompt batch to a dp multiple; padding rows have all-zero
@@ -424,4 +429,20 @@ class ShardedPagedEngine(LoraMailbox):
             np.asarray(state.logps).reshape(b_pad, n, max_steps)[:b]
             if self.capture_logprobs else None
         )
+        # round stats (engine.accumulate_round_stats contract, new here):
+        # the sharded path previously published no throughput at all —
+        # like RemoteEngine, the whole round is accounted as decode time
+        # (prefill runs inside the same jitted setup; no honest split).
+        # whole_round flags the coarse accounting so the trainer skips
+        # engine/mfu on it — a prefill/compile-inclusive "decode" rate
+        # against the chip peak would be a misleadingly low MFU (remote
+        # rounds are excluded for the same reason via is_remote)
+        self.last_round_stats = accumulate_round_stats(
+            None, prefill_s=0.0,
+            prefill_tokens=int(np.asarray(prompt_mask)[:b].sum()),
+            prompt_rows=b,
+            decode_s=time.perf_counter() - t_round,
+            gen_tokens=int(lengths.sum()), gen_rows=b * n,
+        )
+        self.last_round_stats["whole_round"] = True
         return GenerationResult(tokens=out, lengths=lengths, logprobs=logps)

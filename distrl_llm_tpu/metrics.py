@@ -122,31 +122,75 @@ class TraceProfiler:
     The reference has wall-clock phase timers only (SURVEY §5 tracing); this
     adds real device traces: call ``step_begin(step)`` before each train step
     and ``finish()`` at shutdown. Traces land in ``profile_dir`` in
-    TensorBoard format (``tensorboard --logdir <profile_dir>``)."""
+    TensorBoard format (``tensorboard --logdir <profile_dir>``).
+
+    ``stop``/``finish`` are idempotent and captures never overlap
+    (ISSUE 8): a sentinel-triggered ``request_capture`` window while the
+    configured step window is active (or vice versa) is a counted no-op —
+    ``jax.profiler.start_trace`` raises on a second concurrent trace, and a
+    mid-run incident must never take the training process down with it."""
 
     def __init__(self, profile_dir: str, start_step: int = 2, num_steps: int = 3):
         self.profile_dir = profile_dir
         self.start_step = start_step
         self.stop_step = start_step + num_steps
         self._active = False
+        self._stop_at = self.stop_step
+        self._pending = 0  # requested (sentinel) capture length, in steps
+        self.captures_skipped = 0
 
-    def step_begin(self, step: int) -> None:
+    def request_capture(self, num_steps: int = 2) -> bool:
+        """Ask for a capture window starting at the next ``step_begin``
+        (the sentinel's hook). Refused — returning False and counting —
+        when a capture is already active or pending, so triggered windows
+        cannot collide with the configured step window."""
+        if self._active or self._pending:
+            self.captures_skipped += 1
+            return False
+        self._pending = max(int(num_steps), 1)
+        return True
+
+    def _start(self) -> bool:
         import jax
 
-        if not self._active and self.start_step <= step < self.stop_step:
+        try:
             os.makedirs(self.profile_dir, exist_ok=True)
             jax.profiler.start_trace(self.profile_dir)
-            self._active = True
-        elif self._active and step >= self.stop_step:
+        except Exception:  # noqa: BLE001 — e.g. a trace some other owner
+            # (an outer harness) already has running: skip, don't crash
+            self.captures_skipped += 1
+            return False
+        self._active = True
+        return True
+
+    def step_begin(self, step: int) -> None:
+        if self._active and step >= self._stop_at:
+            self.stop()
+        if self._active:
+            return
+        if self._pending:
+            if self._start():
+                self._stop_at = step + self._pending
+            self._pending = 0
+        elif self.start_step <= step < self.stop_step:
+            if self._start():
+                self._stop_at = self.stop_step
+
+    def stop(self) -> None:
+        """Stop the capture in flight; safe to call repeatedly or with no
+        capture active."""
+        if not self._active:
+            return
+        self._active = False
+        import jax
+
+        try:
             jax.profiler.stop_trace()
-            self._active = False
+        except Exception:  # noqa: BLE001 — already stopped elsewhere
+            pass
 
     def finish(self) -> None:
-        if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
+        self.stop()
 
 
 # Wall-clock phase timing matching the reference's inline time.time() pairs

@@ -61,6 +61,19 @@ class _State:
         # weighted observations: (value, count) per hist_observe call
         self.hists: dict[str, list[tuple[float, int]]] = {}
         self.touched: set[str] = set()  # series with data since last snapshot
+        # --- continuous-observability state (ISSUE 8) -------------------
+        # cumulative counter totals: metrics_snapshot pops the per-step
+        # delta above, but a live scrape endpoint (obs.py) needs monotonic
+        # totals (Prometheus counter semantics) — kept here, never reset
+        self.counters_total: dict[str, float] = {}
+        # cumulative histogram summaries: [count, weighted sum, max]
+        self.hist_totals: dict[str, list[float]] = {}
+        # obs export: when on, workers piggyback a registry snapshot on
+        # control-plane results (the way span blobs already ride home)
+        self.obs_export = os.environ.get("DISTRL_OBS", "0") == "1"
+        # driver-side fleet table: track label -> last piggybacked worker
+        # registry snapshot (+ receive timestamp), fed by ingest_remote
+        self.remote_metrics: dict[str, dict] = {}
 
 
 _STATE = _State()
@@ -78,8 +91,21 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Drop all recorded telemetry and re-read the env enable (tests)."""
-    global _STATE
+    global _STATE, _PHASE_HOOK
     _STATE = _State()
+    _PHASE_HOOK = None
+
+
+# phase-boundary hook (obs.py registers its HBM sampler here): one global
+# read on the disabled path, so PhaseSpans stays free when obs is off
+_PHASE_HOOK = None
+
+
+def set_phase_hook(fn) -> None:
+    """Install ``fn(phase_name)`` to run at every PhaseSpans exit (None
+    uninstalls). obs.enable() uses this to sample HBM at span boundaries."""
+    global _PHASE_HOOK
+    _PHASE_HOOK = fn
 
 
 # --------------------------------------------------------------------- spans
@@ -169,6 +195,8 @@ class PhaseSpans:
         assert self._active is not None
         self._durations[self._active] = (time.time_ns() - self._t0) / 1e9
         self._span.__exit__(*exc)
+        if _PHASE_HOOK is not None:
+            _PHASE_HOOK(self._active)
         self._active = None
 
     def metrics(self) -> dict[str, float]:
@@ -183,10 +211,12 @@ class PhaseSpans:
 
 def counter_add(name: str, value: float = 1.0) -> None:
     """Monotonic per-step counter; ``metrics_snapshot`` reports and resets
-    the delta since the last snapshot."""
+    the delta since the last snapshot. ``counters_total`` keeps the
+    monotonic running total for the live scrape endpoint (obs.py)."""
     st = _STATE
     with st.lock:
         st.counters[name] = st.counters.get(name, 0.0) + value
+        st.counters_total[name] = st.counters_total.get(name, 0.0) + value
         st.touched.add(name)
 
 
@@ -228,6 +258,10 @@ def hist_observe(name: str, value: float, *, trace_sample: bool = False,
         # cover ~10^5 slot-steps in d+2 calls); metrics_snapshot computes
         # the summary stats from cumulative weights
         st.hists.setdefault(name, []).append((value, count))
+        tot = st.hist_totals.setdefault(name, [0.0, 0.0, value])
+        tot[0] += count
+        tot[1] += value * count
+        tot[2] = max(tot[2], value)
         st.touched.add(name)
     if trace_sample and st.enabled:
         # carry the weight: a count>1 observation must not read as ONE
@@ -282,6 +316,65 @@ def metrics_snapshot() -> dict[str, float]:
     return out
 
 
+# ------------------------------------------- continuous observability (obs)
+
+
+def observe_snapshot() -> dict[str, Any]:
+    """Non-destructive registry view for the live metrics endpoint
+    (distrl_llm_tpu/obs.py): cumulative counter totals (Prometheus counter
+    semantics — monotonic, never reset), last gauge values, and cumulative
+    histogram summaries. Unlike ``metrics_snapshot`` this never consumes
+    anything, so scraping and the MetricsSink feed cannot fight."""
+    st = _STATE
+    with st.lock:
+        return {
+            "counters": dict(st.counters_total),
+            "gauges": dict(st.gauges),
+            "hists": {
+                name: {"count": t[0], "sum": t[1], "max": t[2]}
+                for name, t in st.hist_totals.items()
+            },
+        }
+
+
+def configure_obs(export: bool) -> None:
+    """Enable/disable the worker-side obs piggyback: when on, every
+    control-plane RESULT ships ``observe_snapshot()`` home alongside any
+    span blob (worker_main --metrics-port / DISTRL_OBS=1)."""
+    _STATE.obs_export = export
+
+
+def export_obs_blob() -> dict | None:
+    """The registry snapshot a worker piggybacks on its RPC response, or
+    None when obs export is off (untraced+unobserved runs keep the plain
+    MSG_RESULT frame). Carries the process pid: the driver-side fleet
+    aggregator detects a worker RESTART by pid change — exact, where
+    counter-regression alone misses an incarnation that regenerated past
+    its predecessor's count within one refresh gap."""
+    if not _STATE.obs_export:
+        return None
+    snap = observe_snapshot()
+    snap["pid"] = os.getpid()
+    return snap
+
+
+def remote_metrics() -> dict[str, dict]:
+    """Driver-side fleet table: the last piggybacked registry snapshot per
+    worker track (plus its ``_ts`` receive time) — the raw input of
+    obs.FleetAggregator."""
+    st = _STATE
+    with st.lock:
+        return {k: dict(v) for k, v in st.remote_metrics.items()}
+
+
+def recent_events(n: int = 512) -> list[dict]:
+    """Copy of the newest ``n`` recorded trace events (the span tail a
+    flight-recorder incident bundles). Empty while tracing is off."""
+    st = _STATE
+    with st.lock:
+        return [dict(e) for e in st.events[-n:]]
+
+
 # -------------------------------------------------- cross-process propagation
 
 
@@ -306,10 +399,21 @@ def ingest_remote(blob: Mapping[str, Any], track: str) -> None:
     Dropped when this process is not tracing: a traced worker feeding an
     untraced driver (or one whose trace_steps window already closed and
     exported) would otherwise grow the event list unboundedly with blobs
-    nothing will ever export."""
-    if not blob or not _STATE.enabled:
+    nothing will ever export. A piggybacked registry snapshot
+    (``blob["metrics"]``, obs export) is stored in the fleet table FIRST —
+    fleet aggregation works with tracing off (it is bounded: one entry per
+    worker track, overwritten in place)."""
+    if not blob:
         return
     st = _STATE
+    metrics = blob.get("metrics")
+    if metrics is not None:
+        with st.lock:
+            st.remote_metrics[track] = {"_ts": time.time(), **metrics}
+    if not st.enabled:
+        return
+    if not blob.get("events") and not blob.get("threads"):
+        return  # metrics-only blob: no empty trace track to register
     with st.lock:
         pid = st.remote_tracks.setdefault(
             track, _REMOTE_PID0 + len(st.remote_tracks)

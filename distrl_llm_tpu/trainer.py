@@ -27,6 +27,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Mapping, Sequence
 
@@ -34,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distrl_llm_tpu import telemetry
+from distrl_llm_tpu import obs as obs_mod, telemetry
 from distrl_llm_tpu.checkpoint import CheckpointManager, save_adapter_file
 from distrl_llm_tpu.config import SamplingConfig, TrainConfig
 from distrl_llm_tpu.data import DictDataset
@@ -305,6 +306,40 @@ class Trainer:
         self._rollout_chips = (
             int(meshes.rollout.devices.size) if meshes is not None else 1
         )
+
+        # continuous observability plane (distrl_llm_tpu/obs.py, ISSUE 8):
+        # live metrics endpoint + fleet aggregation (remote rollout), HBM
+        # sampling at phase boundaries, and the anomaly sentinel / flight
+        # recorder. None unless a flag armed it — the step loop then pays
+        # exactly one attribute check.
+        self.obs: Any = None
+        if (
+            config.metrics_port is not None
+            or config.sentinel
+            or config.flight_recorder_dir
+        ):
+            self.obs = obs_mod.ObsPlane(
+                metrics_port=config.metrics_port,
+                sentinel=config.sentinel,
+                flight_recorder_dir=config.flight_recorder_dir,
+                ring_size=config.obs_ring_size,
+                # fleet aggregation needs the control plane: local-engine
+                # runs expose their own registry, nothing to aggregate
+                driver=(
+                    getattr(engine, "driver", None)
+                    if getattr(engine, "is_remote", False) else None
+                ),
+                profiler=self.profiler,
+                staleness_limit=(
+                    config.max_staleness
+                    if config.rollout_mode == "async" else None
+                ),
+                config_snapshot=config.to_flat_dict(),
+                plan_provider=lambda: (
+                    self.engine.resolved_plan.plan.to_dict()
+                    if getattr(self.engine, "resolved_plan", None) else None
+                ),
+            )
 
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
@@ -1089,8 +1124,21 @@ class Trainer:
             # whole-run tracing (trace_steps=0) exports here; a closed
             # trace_steps window already wrote and disabled — no-op then
             self._export_trace()
+            # the obs plane deliberately OUTLIVES train(): a fleet
+            # operator scrapes the endpoint while rejoins/drains settle
+            # after the loop ends — close_obs() (or process exit; the
+            # server thread is a daemon) tears it down
             self.sink.finish()
             self.rewards.close()
+
+    def close_obs(self) -> None:
+        """Tear down the observability plane (endpoint + phase hook).
+        Separate from train()'s cleanup: the endpoint stays scrapeable
+        after the loop ends so post-run fleet state (late rejoins, drains)
+        is observable; callers that own the trainer call this last."""
+        if self.obs is not None:
+            self.obs.close()
+            self.obs = None
 
     def _episode_batch_stream(self, episode: int, skip: int):
         """One episode's (batch_index, batch) stream — the SINGLE owner of
@@ -1191,6 +1239,10 @@ class Trainer:
         self._rollout_service = service
         service.start()
         while True:
+            if self.profiler is not None:
+                # the async loop gets the same step-window (and sentinel-
+                # requested) capture hooks as the sync/pipelined loop
+                self.profiler.step_begin(self.total_batch_steps + 1)
             timer = telemetry.PhaseSpans()
             if cfg.staleness_policy == "drop":
                 # queued groups already beyond the bound will be rejected
@@ -1343,6 +1395,7 @@ class Trainer:
             )
             loss = float(loss)
         self.weight_version += 1
+        t_sync0 = time.perf_counter()
         self._push_weights()
         if cfg.inflight_weight_updates:
             # PipelineRL-style: hand the fresh adapter to the generation
@@ -1356,6 +1409,13 @@ class Trainer:
                 # tag every post-swap position with the policy that sampled
                 # it (rollout/trajectory.py version tags)
                 push(self._lora_rollout, version=self.weight_version)
+        if self.obs is not None:
+            # weight-sync latency (learner→rollout push; the in-engine
+            # push→swap half is the engine/swap_latency_ms histogram)
+            telemetry.gauge_set(
+                obs_mod.OBS_WEIGHT_SYNC_MS,
+                (time.perf_counter() - t_sync0) * 1e3,
+            )
 
         if cfg.write_adapter_file:
             self.save_adapter()
@@ -1407,10 +1467,28 @@ class Trainer:
         metrics.update(self._engine_metrics(candidates))
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())
+        if self.obs is not None:
+            # learner idle fraction: the share of this step the learner
+            # spent BLOCKED on data (generation phase = wait time in the
+            # pipelined/async regimes) — the signal RLAX's fleet loop
+            # steers on. Published before the snapshot merge below so it
+            # rides the same sink record.
+            phase_total = sum(
+                timer.get(p) for p in ("generation", "reward", "update")
+            )
+            if phase_total > 0:
+                telemetry.gauge_set(
+                    obs_mod.OBS_LEARNER_IDLE,
+                    timer.get("generation") / phase_total,
+                )
         # registry series (pool/occupancy gauge, cp/rpc_* histograms, …)
         # ride the same sink record
         metrics.update(telemetry.metrics_snapshot())
         self.sink.log(metrics, step=self.total_batch_steps)
+        if self.obs is not None:
+            # ring record + sentinel pass + fleet refresh — the per-step
+            # entry point of the observability plane
+            self.obs.on_step(self.total_batch_steps, metrics)
         if cfg.trace_dir and telemetry.enabled():
             self._trace_steps_done += 1
             if cfg.trace_steps and self._trace_steps_done >= cfg.trace_steps:
@@ -1443,6 +1521,9 @@ class Trainer:
             # remote rounds measure N workers' unknown chips against the
             # local peak — no honest per-chip number exists driver-side
             and not getattr(self.engine, "is_remote", False)
+            # whole-round stats (sharded engine) fold prefill + compile
+            # into decode_s: honest throughput, but not an MFU numerator
+            and not stats.get("whole_round")
         ):
             mean_kv = (
                 stats["prefill_tokens"] / max(stats["prompt_rows"], 1)
@@ -1474,6 +1555,12 @@ class Trainer:
                 # trace_report divides whole-engine tok/s by this before
                 # comparing against the single-chip peak
                 "chips": self._rollout_chips,
+                # measured attribution (ISSUE 8): XLA cost_analysis of the
+                # explicitly-compiled step programs + per-phase HBM
+                # watermarks — the roofline section's inputs (both empty
+                # on runs that recorded neither)
+                "costs": obs_mod.costs(),
+                "phase_hbm": obs_mod.phase_hbm(),
             },
         )
         log.info("telemetry trace written to %s", path)

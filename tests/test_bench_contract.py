@@ -55,8 +55,16 @@ class TestBenchContract:
                     "plan", "plan_source", "cache_read_formulation",
                     "rollout_mode", "max_staleness", "rollout_dropped_stale",
                     "spec_drafter", "spec_accept_rate",
-                    "tokens_per_verify_step", "spec_verify_impl"):
+                    "tokens_per_verify_step", "spec_verify_impl",
+                    "hbm_peak_bytes", "recompile_count", "fleet_tok_s"):
             assert key in rec, key
+        # measured-attribution fields (ISSUE 8): CPU has no memory stats
+        # (honest null, never a fabricated number), a healthy single-config
+        # run retraces nothing, and bench drives the engine directly — no
+        # control-plane fleet ever publishes a tok/s gauge here
+        assert rec["hbm_peak_bytes"] is None
+        assert rec["recompile_count"] == 0
+        assert rec["fleet_tok_s"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0
@@ -107,7 +115,8 @@ class TestBenchContract:
         })
         assert rec["metric"] == "learner_tokens_per_sec_per_chip"
         for key in ("step_seconds", "mfu", "attn_impl", "attn_fallback",
-                    "base_quant", "loss"):
+                    "base_quant", "loss",
+                    "hbm_peak_bytes", "recompile_count"):
             assert key in rec, key
         assert "error" not in rec
 

@@ -342,6 +342,114 @@ class TestRegistry:
         snap = telemetry.metrics_snapshot()
         assert snap["rollout/backpressure_waits"] == 1.0
 
+    def test_observe_snapshot_is_cumulative_and_nondestructive(self):
+        """The live-endpoint view (ISSUE 8): counters report monotonic
+        totals that survive metrics_snapshot's report-and-reset, gauges
+        their last value, histograms cumulative count/sum/max — and
+        reading it never consumes anything."""
+        telemetry.counter_add("obs/gen_tokens", 10)
+        telemetry.gauge_set("pool/occupancy", 0.5)
+        telemetry.hist_observe("cp/rpc_dispatch_ms", 2.0)
+        telemetry.hist_observe("cp/rpc_dispatch_ms", 4.0, count=3)
+        snap = telemetry.observe_snapshot()
+        assert snap["counters"]["obs/gen_tokens"] == 10.0
+        assert snap["gauges"]["pool/occupancy"] == 0.5
+        assert snap["hists"]["cp/rpc_dispatch_ms"] == {
+            "count": 4.0, "sum": 14.0, "max": 4.0,
+        }
+        # the sink feed still reports-and-resets its delta…
+        assert telemetry.metrics_snapshot()["obs/gen_tokens"] == 10.0
+        telemetry.counter_add("obs/gen_tokens", 5)
+        assert telemetry.metrics_snapshot()["obs/gen_tokens"] == 5.0
+        # …while the cumulative view keeps the running total
+        assert telemetry.observe_snapshot()["counters"][
+            "obs/gen_tokens"] == 15.0
+
+    def test_obs_series_schema(self):
+        """Schema pin for the observability-plane registry names
+        (ISSUE 8) and their TYPES: obs/gen_tokens, obs/compiles,
+        obs/retraces, obs/incidents are COUNTERS; obs/hbm_live_bytes,
+        obs/hbm_peak_bytes, obs/learner_idle_frac, obs/weight_sync_ms are
+        GAUGES; engine/swap_latency_ms is a HISTOGRAM."""
+        from distrl_llm_tpu import obs
+
+        assert obs.OBS_GEN_TOKENS == "obs/gen_tokens"
+        assert obs.OBS_HBM_LIVE == "obs/hbm_live_bytes"
+        assert obs.OBS_HBM_PEAK == "obs/hbm_peak_bytes"
+        assert obs.OBS_COMPILES == "obs/compiles"
+        assert obs.OBS_RETRACES == "obs/retraces"
+        assert obs.OBS_LEARNER_IDLE == "obs/learner_idle_frac"
+        assert obs.OBS_WEIGHT_SYNC_MS == "obs/weight_sync_ms"
+        assert obs.OBS_INCIDENTS == "obs/incidents"
+        assert obs.SWAP_LATENCY_MS == "engine/swap_latency_ms"
+        telemetry.counter_add(obs.OBS_GEN_TOKENS, 100)
+        telemetry.counter_add(obs.OBS_COMPILES)
+        telemetry.counter_add(obs.OBS_RETRACES)
+        telemetry.counter_add(obs.OBS_INCIDENTS)
+        telemetry.gauge_set(obs.OBS_HBM_LIVE, 10.0)
+        telemetry.gauge_set(obs.OBS_HBM_PEAK, 20.0)
+        telemetry.gauge_set(obs.OBS_LEARNER_IDLE, 0.25)
+        telemetry.gauge_set(obs.OBS_LEARNER_IDLE, 0.5)  # gauge: last wins
+        telemetry.gauge_set(obs.OBS_WEIGHT_SYNC_MS, 1.5)
+        telemetry.hist_observe(obs.SWAP_LATENCY_MS, 3.0)
+        snap = telemetry.metrics_snapshot()
+        assert snap["obs/gen_tokens"] == 100.0
+        assert snap["obs/compiles"] == 1.0
+        assert snap["obs/retraces"] == 1.0
+        assert snap["obs/incidents"] == 1.0
+        assert snap["obs/hbm_live_bytes"] == 10.0
+        assert snap["obs/hbm_peak_bytes"] == 20.0
+        assert snap["obs/learner_idle_frac"] == 0.5
+        assert snap["obs/weight_sync_ms"] == 1.5
+        assert snap["engine/swap_latency_ms_count"] == 1.0
+        # counters report-and-reset
+        assert "obs/gen_tokens" not in telemetry.metrics_snapshot()
+
+    def test_fleet_series_schema(self):
+        """Schema pin for the fleet-aggregation names (ISSUE 8): all
+        GAUGES (the aggregator republishes the fold on every refresh), plus
+        cp/rejoin_epoch, the gauge the control plane bumps per re-admit."""
+        from distrl_llm_tpu import obs
+        from distrl_llm_tpu.distributed import resilience as r
+
+        assert obs.FLEET_TOK_S == "fleet/tok_s"
+        assert obs.FLEET_GEN_TOKENS == "fleet/gen_tokens_total"
+        assert obs.FLEET_WORKERS_HEALTHY == "fleet/workers_healthy"
+        assert obs.FLEET_WORKERS_TOTAL == "fleet/workers_total"
+        assert obs.FLEET_REJOIN_EPOCH == "fleet/rejoin_epoch"
+        assert r.CP_REJOIN_EPOCH == "cp/rejoin_epoch"
+        telemetry.gauge_set(obs.FLEET_TOK_S, 1200.0)
+        telemetry.gauge_set(obs.FLEET_GEN_TOKENS, 4000.0)
+        telemetry.gauge_set(obs.FLEET_WORKERS_HEALTHY, 2)
+        telemetry.gauge_set(obs.FLEET_WORKERS_TOTAL, 2)
+        telemetry.gauge_set(obs.FLEET_REJOIN_EPOCH, 1)
+        telemetry.gauge_set(r.CP_REJOIN_EPOCH, 1)
+        snap = telemetry.metrics_snapshot()
+        assert snap["fleet/tok_s"] == 1200.0
+        assert snap["fleet/gen_tokens_total"] == 4000.0
+        assert snap["fleet/workers_healthy"] == 2.0
+        assert snap["fleet/workers_total"] == 2.0
+        assert snap["fleet/rejoin_epoch"] == 1.0
+        assert snap["cp/rejoin_epoch"] == 1.0
+
+    def test_ingest_remote_stores_metrics_without_tracing(self):
+        """The obs piggyback must work on untraced drivers: the snapshot
+        lands in the fleet table while the event list stays empty (nothing
+        would ever export it)."""
+        telemetry.ingest_remote(
+            {"events": [{"ph": "X", "name": "worker/echo", "ts": 1,
+                         "dur": 1, "tid": 9, "args": {}}],
+             "threads": {},
+             "metrics": {"counters": {"obs/gen_tokens": 64.0},
+                         "gauges": {}, "hists": {}}},
+            track="worker 127.0.0.1:7001",
+        )
+        assert events() == []  # untraced: span events dropped
+        table = telemetry.remote_metrics()
+        assert table["worker 127.0.0.1:7001"]["counters"][
+            "obs/gen_tokens"] == 64.0
+        assert "_ts" in table["worker 127.0.0.1:7001"]
+
     def test_hist_observe_count_prebinned(self):
         """hist_observe(count=N) records the observation N times in ONE
         call — the contract the engine's device-side emit histogram

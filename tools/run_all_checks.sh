@@ -51,6 +51,12 @@ stage "chaos_smoke" env JAX_PLATFORMS=cpu \
 # trace_report shows the speculative section
 stage "spec_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/spec_smoke.py
+# observability gate (ISSUE 8): 2-worker tiny run — scrape both worker
+# endpoints and the driver's fleet endpoint mid-run (fleet/* series
+# present, per-worker token counters flowing), inject a seeded NaN,
+# assert exactly one incident bundle with the expected manifest
+stage "obs_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/obs_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one
@@ -82,7 +88,7 @@ stage "suite_ops" timeout 600 python -m pytest -q \
 stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
   tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
-  tests/test_telemetry.py
+  tests/test_telemetry.py tests/test_obs.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \

@@ -212,6 +212,62 @@ def spec_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
     return lines
 
 
+def roofline_section(spans: dict[tuple[int, str], list[dict]],
+                     metadata: dict, decode_tok_s: float | None,
+                     peak_flops: float | None) -> list[str]:
+    """Measured roofline/MFU attribution (ISSUE 8), from the obs plane's
+    signals in the trace metadata: per-phase wall time + HBM high-watermark
+    (``phase_hbm``, sampled from jax.Device.memory_stats at span
+    boundaries) and the XLA ``cost_analysis`` FLOPs/bytes of every
+    explicitly-compiled step program (``costs``) with the arithmetic
+    intensity that says which side of the roofline it sits on. Empty when
+    the run recorded neither (obs unarmed) — old traces are unchanged."""
+    costs = metadata.get("costs") or {}
+    phase_hbm = metadata.get("phase_hbm") or {}
+    if not costs and not phase_hbm:
+        return []
+    lines = ["roofline (measured):"]
+    phase_us: dict[str, int] = {}
+    for (_pid, name), evs in spans.items():
+        if name.startswith("driver/"):
+            phase_us[name[7:]] = phase_us.get(name[7:], 0) + sum(
+                e.get("dur", 0) for e in evs
+            )
+    if phase_us:
+        total_us = max(sum(phase_us.values()), 1)
+        lines.append(
+            f"  {'phase':<14} {'time s':>8} {'share':>7} {'hbm peak':>10}"
+        )
+        for phase, us in sorted(phase_us.items(), key=lambda kv: -kv[1]):
+            hbm = phase_hbm.get(phase, {}).get("peak_max")
+            hbm_s = f"{hbm / 2**30:.2f} GiB" if hbm else "n/a"
+            lines.append(
+                f"  {phase:<14} {us / 1e6:>8.3f} "
+                f"{100 * us / total_us:>6.1f}% {hbm_s:>10}"
+            )
+    fpt = metadata.get("decode_flops_per_token")
+    if decode_tok_s and fpt and peak_flops:
+        chips = metadata.get("chips", 1) or 1
+        achieved = decode_tok_s / chips * fpt
+        lines.append(
+            f"  decode: {decode_tok_s:,.0f} tok/s × {fpt / 1e9:.3f} GF/tok "
+            f"= {achieved / 1e12:.4f} TF/s/chip achieved "
+            f"({100 * achieved / peak_flops:.2f}% of peak)"
+        )
+    if costs:
+        lines.append("  compiled step programs (XLA cost_analysis):")
+        for what, c in sorted(costs.items()):
+            flops = c.get("flops", 0.0)
+            byts = c.get("bytes_accessed", 0.0)
+            ai = f"{flops / byts:.2f} FLOP/B" if byts else "n/a"
+            lines.append(
+                f"    {what}: {flops / 1e9:.3f} GFLOP, "
+                f"{byts / 2**30:.3f} GiB accessed, intensity {ai}"
+            )
+    lines.append("")
+    return lines
+
+
 def build_report(events: list[dict], metadata: dict,
                  peak_flops: float | None = None) -> str:
     tracks: dict[int, str] = {}
@@ -273,6 +329,10 @@ def build_report(events: list[dict], metadata: dict,
     # same blob), so counting them would double the tokens and mix
     # prefill-inclusive durations into the decode rate
     decode = tok_s(("engine/decode", "engine/refill_decode"))
+    lines.extend(roofline_section(
+        spans, metadata, decode,
+        peak_flops or metadata.get("peak_flops"),
+    ))
     lines.append("throughput:")
     lines.append(f"  prefill tok/s: "
                  f"{f'{prefill:,.0f}' if prefill else 'n/a (no token counts)'}")
@@ -307,9 +367,15 @@ def main(argv: list[str] | None = None) -> int:
     try:
         events, metadata = load_trace(args.trace)
         report = build_report(events, metadata, peak_flops=args.peak_flops)
-    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
-        print(f"trace_report: cannot report on {args.trace}: {e}",
-              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — a truncated or still-being-
+        # written trace (partial JSON, malformed events, wrong types) must
+        # exit 1 with ONE line, never a raw traceback: this script gates
+        # run_all_checks and gets pointed at live trace files
+        print(
+            f"trace_report: cannot report on {args.trace}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
         return 1
     print(report)
     return 0

@@ -231,6 +231,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="trace only the first N train steps, writing the "
                         "file when the window closes (0 = whole run, "
                         "written at shutdown)")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve the live metrics endpoint (Prometheus at "
+                        "/metrics, JSON at /metrics.json) on this port; "
+                        "with --rollout_workers it also publishes fleet/* "
+                        "series aggregated from worker snapshots. 0 = "
+                        "auto-assign; omit = off")
+    p.add_argument("--sentinel", action="store_true",
+                   help="anomaly sentinel: deterministic per-step triggers "
+                        "(NaN/Inf loss, reward collapse, staleness blowup, "
+                        "tok/s regression vs EMA, HBM watermark breach) "
+                        "dump the flight-recorder ring as an incident "
+                        "bundle; requires --flight_recorder_dir")
+    p.add_argument("--flight_recorder_dir", type=str, default=None,
+                   help="keep a bounded ring of recent step records and "
+                        "write sentinel incident bundles "
+                        "(incident_step<N>_<trigger>/) here")
+    p.add_argument("--obs_ring_size", type=int, default=256,
+                   help="flight-recorder ring capacity in step records")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
