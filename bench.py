@@ -1041,6 +1041,18 @@ def main() -> int:
         "hbm_peak_bytes": _hbm_peak_bytes(),
         "recompile_count": _recompile_count(),
         "fleet_tok_s": _fleet_tok_s(),
+        # weight-bus provenance (ISSUE 9, pinned in
+        # tests/test_bench_contract.py): which learner→worker weight
+        # transport a fleet row ran under ("dispatch" | "broadcast"), the
+        # bytes one adapter update put on the wire, and the learner-push →
+        # last-worker-ack latency. Bench drives a LOCAL engine — no
+        # control-plane weight transport is exercised — so these are the
+        # reserved null slots the dispatch-vs-broadcast A/B
+        # (tools/weight_bus_smoke.py --bench, staged by tpu_bench_loop.sh)
+        # and future fleet rows populate
+        "weight_bus": None,
+        "weight_bytes_per_update": None,
+        "weight_sync_ms": None,
         "baseline_note": "baseline 1500 tok/s/GPU derived from reference's ~2h/100-step "
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "
                          "model is recorded in 'model'",

@@ -243,6 +243,16 @@ class TrainConfig:
     # workers — the driver cannot introspect worker engine flags, and a
     # worker round returning no logprobs fails the first training batch.
     workers_capture_logprobs: bool = False
+    # learner→worker weight transport for rollout_workers (ISSUE 9):
+    # "broadcast" (default) ships each optimizer step's adapter ONCE per
+    # version over an out-of-band MSG_WEIGHTS push — delta-encoded against
+    # the worker's last acked version, full-tensor on first contact or
+    # checksum mismatch — and MSG_DISPATCH payloads carry only a
+    # {weight_version} reference resolved from the worker's 2-slot adapter
+    # cache. "dispatch" is the legacy fallback: the full LoRA pytree rides
+    # in every dispatch payload (N workers × every round). Broadcast is
+    # what makes inflight_weight_updates possible over remote workers.
+    weight_bus: str = "broadcast"
     # --- control-plane resilience (distributed/resilience.py) -------------
     # background reconnect loop: unhealthy rollout workers are re-dialed
     # with seeded exponential backoff and re-admitted after a PING, so
@@ -632,6 +642,11 @@ class TrainConfig:
                 "spec_adapt adapts the speculative draft length — set "
                 "spec_draft > 0"
             )
+        if self.weight_bus not in ("broadcast", "dispatch"):
+            raise ValueError(
+                f"weight_bus must be 'broadcast' or 'dispatch', got "
+                f"{self.weight_bus!r}"
+            )
         if self.inflight_weight_updates:
             if not self.async_rollout:
                 raise ValueError(
@@ -645,11 +660,25 @@ class TrainConfig:
                     "clip objective is the correction that consumes their "
                     "captured behavior logprobs"
                 )
-            if self.rollout_workers or self.full_finetune:
+            if self.full_finetune:
                 raise ValueError(
-                    "inflight_weight_updates requires local LoRA rollout "
-                    "(worker rounds are blocking calls; full_finetune swaps "
-                    "the whole param tree, not an adapter)"
+                    "inflight_weight_updates requires a LoRA run "
+                    "(full_finetune swaps the whole param tree, not an "
+                    "adapter)"
+                )
+            if self.rollout_workers and self.weight_bus != "broadcast":
+                # the silent-no-op fix (ISSUE 9): this combination used to
+                # pretend to work while never updating worker weights
+                # mid-round — the engine lacked a real push_lora. The
+                # broadcast bus provides one; anything else is an error,
+                # never a silent regression (the trainer additionally
+                # rejects any engine without push_lora at construction).
+                raise ValueError(
+                    "inflight_weight_updates over rollout_workers requires "
+                    "weight_bus='broadcast' (the versioned weight bus is "
+                    "what delivers mid-round adapters to workers; "
+                    "'dispatch' ships weights only at round entry and "
+                    "would silently never swap)"
                 )
         if (
             self.clip_ratio > 0 and self.rollout_workers

@@ -16,13 +16,23 @@ from distrl_llm_tpu.distributed.resilience import (
     ShardFailedError,
     WorkerError,
 )
+from distrl_llm_tpu.distributed.weight_bus import (
+    AdapterCache,
+    WeightBus,
+    WeightChecksumError,
+    WeightVersionError,
+)
 
 __all__ = [
+    "AdapterCache",
     "DriverClient",
     "FaultInjector",
     "RemoteEngine",
     "RetryPolicy",
     "ShardFailedError",
+    "WeightBus",
+    "WeightChecksumError",
+    "WeightVersionError",
     "WorkerDeadError",
     "WorkerError",
     "WorkerServer",

@@ -74,6 +74,14 @@ MSG_ERROR = 6
 # the driver's fleet aggregator); runs with neither keep the plain
 # MSG_RESULT frame and zero overhead.
 MSG_RESULT_TLM = 7
+# out-of-band weight push (weight_bus.py, ISSUE 9): the driver's WeightBus
+# ships one versioned adapter update per frame — delta-encoded against the
+# worker's last acked version — on its OWN connection, so the push lands
+# (and swaps in-flight via the engine's LoraMailbox) while the worker's
+# dispatch thread is deep inside a generation round. The worker replies
+# MSG_RESULT with pickle({"version", "checksum"}) as the ack, or MSG_ERROR
+# (checksum mismatch / unknown base → the sender falls back to full-tensor).
+MSG_WEIGHTS = 8
 
 
 class WorkerDeadError(RuntimeError):
@@ -159,7 +167,13 @@ class Connection:
 
 class WorkerServer:
     """Worker-side serve loop. ``handler(payload: bytes) -> bytes`` runs per
-    DISPATCH; exceptions travel back as ERROR frames with the traceback."""
+    DISPATCH; exceptions travel back as ERROR frames with the traceback.
+
+    Connections are served CONCURRENTLY (one thread each): the driver's
+    dispatch channel and its out-of-band weight bus (MSG_WEIGHTS →
+    ``weights_handler``) coexist, so a weight push lands — and swaps
+    in-flight through the engine mailbox — while a generation dispatch is
+    still running on the other connection (ISSUE 9)."""
 
     def __init__(self, port: int = 0):
         self._lib = _Lib.get()
@@ -168,6 +182,10 @@ class WorkerServer:
             raise OSError(f"cannot listen on port {port}")
         self.port = self._lib.cp_bound_port(self._server_fd)
         self._draining = False
+        self._stopped = False
+        # MSG_WEIGHTS frames route here (worker_main installs the weight-bus
+        # handler when it serves a model); absent → ERROR reply
+        self.weights_handler: Callable[[bytes], bytes] | None = None
 
     def request_shutdown(self) -> None:
         """Graceful preemption (worker_main wires SIGTERM here): finish the
@@ -182,11 +200,12 @@ class WorkerServer:
 
     def serve_forever(self, handler: Callable[[bytes], bytes],
                       accept_timeout_ms: int = 1000) -> None:
-        """Accept one driver connection at a time and serve until SHUTDOWN
-        (or a ``request_shutdown`` drain)."""
+        """Accept driver connections (one thread per connection) and serve
+        until SHUTDOWN (or a ``request_shutdown`` drain)."""
+        threads: list[threading.Thread] = []
         try:
             while True:
-                if self._draining:
+                if self._draining or self._stopped:
                     return
                 fd = self._lib.cp_accept(self._server_fd, accept_timeout_ms)
                 if fd == -1:
@@ -194,21 +213,40 @@ class WorkerServer:
                 if fd < 0:
                     raise OSError("accept failed")
                 conn = resilience.wrap_connection(Connection(fd))
-                try:
-                    if self._serve_conn(conn, handler):
-                        return  # clean shutdown / drained
-                except WorkerDeadError:
-                    log.info("driver connection dropped; re-listening")
-                finally:
-                    conn.close()
+                t = threading.Thread(
+                    target=self._conn_loop, args=(conn, handler),
+                    name="cp-serve", daemon=True,
+                )
+                threads.append(t)
+                t.start()
+                threads = [t for t in threads if t.is_alive()]
         finally:
             self._lib.cp_close(self._server_fd)
+            # stop flag BEFORE the joins: on the accept-failure exit path
+            # (OSError above) neither drain nor stop is set yet, and
+            # without it a healthy connection thread would serve forever —
+            # wedging this join and swallowing the exception
+            self._stopped = True
+            # in-flight frames still deliver their results before the
+            # process moves on (the SIGTERM drain contract) — the old
+            # single-connection loop blocked in the handler the same way;
+            # idle siblings notice the stop flag within one 1s recv timeout
+            for t in threads:
+                t.join()
+
+    def _conn_loop(self, conn: Connection, handler) -> None:
+        try:
+            self._serve_conn(conn, handler)
+        except WorkerDeadError:
+            log.info("driver connection dropped; re-listening")
+        finally:
+            conn.close()
 
     def _serve_conn(self, conn: Connection, handler) -> bool:
         while True:
             frame = conn.recv(timeout_ms=1000)
             if frame is None:
-                if self._draining:
+                if self._draining or self._stopped:
                     return True  # idle between frames: drain immediately
                 continue
             msg_type, req_id, payload = frame
@@ -216,6 +254,9 @@ class WorkerServer:
                 conn.send(MSG_PONG, req_id)
             elif msg_type == MSG_SHUTDOWN:
                 conn.send(MSG_PONG, req_id)
+                # stop the accept loop and every sibling connection thread
+                # (each notices at its next 1s recv timeout)
+                self._stopped = True
                 return True
             elif msg_type == MSG_DISPATCH:
                 try:
@@ -244,11 +285,28 @@ class WorkerServer:
                     conn.send(
                         MSG_ERROR, req_id, traceback.format_exc().encode()
                     )
+            elif msg_type == MSG_WEIGHTS:
+                # weight-bus push (ISSUE 9): runs on THIS connection's
+                # thread, concurrent with any dispatch in flight — the
+                # whole point of the out-of-band channel
+                try:
+                    wh = self.weights_handler
+                    if wh is None:
+                        raise RuntimeError(
+                            "worker has no weight-bus handler (started "
+                            "without --serve-model)"
+                        )
+                    conn.send(MSG_RESULT, req_id, wh(payload))
+                except Exception:  # noqa: BLE001 — shipped to the driver
+                    conn.send(
+                        MSG_ERROR, req_id, traceback.format_exc().encode()
+                    )
             else:
                 log.warning("unexpected frame type %d", msg_type)
-            if self._draining:
-                # SIGTERM landed while this frame was being handled: the
-                # in-flight result was just delivered — now drain
+            if self._draining or self._stopped:
+                # SIGTERM (or a sibling connection's MSG_SHUTDOWN) landed
+                # while this frame was being handled: the in-flight result
+                # was just delivered — now drain
                 return True
 
 
@@ -286,6 +344,20 @@ class DriverClient:
         # bumps on every successful re-admit; RemoteEngine clears its warm
         # keys when it changes (the rejoined worker compiles from scratch)
         self.rejoin_epoch = 0
+        # weight-bus hooks (weight_bus.py, ISSUE 9). rejoin_hook(address)
+        # runs after a PING-verified reconnect and BEFORE re-admission —
+        # the bus resyncs the cold worker with a full-tensor push; False
+        # fails this rejoin attempt (retried under the policy backoff).
+        # transient_hook(worker, error) runs before each same-worker retry
+        # of a transient MSG_ERROR — the bus re-pushes a version the worker
+        # reported unknown (one bounded re-request, not a poisoned shard).
+        self.rejoin_hook: Callable[[tuple[str, int]], bool] | None = None
+        self.transient_hook: (
+            Callable[["_Worker", WorkerError], None] | None
+        ) = None
+        # shutdown() runs these before closing connections (the weight bus
+        # parks its sender thread and channels here)
+        self.shutdown_hooks: list[Callable[[], None]] = []
         for host, port in addresses:
             fd = self._lib.cp_connect(host.encode(), port, connect_timeout_ms)
             if fd < 0:
@@ -306,6 +378,12 @@ class DriverClient:
     @property
     def num_healthy(self) -> int:
         return sum(w.healthy for w in self._workers)
+
+    @property
+    def addresses(self) -> list[tuple[str, int]]:
+        """Configured worker addresses, in construction order (the weight
+        bus dials its out-of-band channels against the same set)."""
+        return [w.address for w in self._workers]
 
     def worker_states(self) -> list[dict]:
         """Point-in-time health view for the observability plane
@@ -390,6 +468,24 @@ class DriverClient:
                 conn.close()
                 sp.set(ok=False)
                 return False
+            hook = self.rejoin_hook
+            if hook is not None:
+                # weight-bus resync (ISSUE 9): the restarted worker's
+                # engine process lost its adapter cache — push the current
+                # version full-tensor BEFORE re-admission, so the first
+                # post-rejoin dispatch never names a version it lacks
+                try:
+                    synced = bool(hook(w.address))
+                except Exception:  # noqa: BLE001 — a failed resync fails
+                    # this attempt; the backoff loop retries
+                    log.warning(
+                        "rejoin hook failed for %s", w.address, exc_info=True
+                    )
+                    synced = False
+                if not synced:
+                    conn.close()
+                    sp.set(ok=False)
+                    return False
             with self._workers_mu:
                 if self._stop_rejoin.is_set():
                     # shutdown() won the race (it may have given up joining
@@ -469,6 +565,9 @@ class DriverClient:
         with telemetry.span("cp/dispatch", worker=f"{host}:{port}",
                             bytes=len(payload)):
             t0 = time.perf_counter()
+            # frame-size accounting (ISSUE 9): the dispatch-vs-broadcast
+            # payload win is asserted from this counter
+            telemetry.counter_add(resilience.CP_DISPATCH_BYTES, len(payload))
             w.conn.send(MSG_DISPATCH, rid, payload)
             frame = w.conn.recv(timeout_ms)
         if frame is None:
@@ -521,6 +620,20 @@ class DriverClient:
                     raise
                 attempt += 1
                 telemetry.counter_add(resilience.CP_RETRIES)
+                hook = self.transient_hook
+                if hook is not None:
+                    # weight-bus re-request (ISSUE 9): an unknown-version
+                    # error gets its version re-pushed full-tensor before
+                    # the retry, so the bounded retry can actually succeed
+                    try:
+                        hook(w, e)
+                    except Exception:  # noqa: BLE001 — the retry itself
+                        # is the recovery path; a hook failure only means
+                        # the retry may fail the same way
+                        log.warning(
+                            "transient-error hook failed for %s", w.address,
+                            exc_info=True,
+                        )
                 with telemetry.span("cp/retry", worker=f"{host}:{port}",
                                     attempt=attempt):
                     log.warning(
@@ -713,6 +826,11 @@ class DriverClient:
         return [pickle.loads(r) if r is not None else None for r in raw]
 
     def shutdown(self, timeout_ms: int = 5000) -> None:
+        for hook in self.shutdown_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                log.warning("shutdown hook failed", exc_info=True)
         self._stop_rejoin.set()
         if self._rejoin_thread is not None:
             self._rejoin_thread.join(timeout=5)

@@ -5,11 +5,22 @@ dispatches batch chunks to Ray actor processes, each running its own GPU
 engine (distributed_trainer.py:187–200). This adapter implements the exact
 engine surface the Trainer drives (``generate(params, lora, prompt_ids,
 prompt_mask, sampling, rng) -> GenerationResult``) by splitting the batch
-with the reference's ``even_chunks`` math, shipping each shard — WITH the
-current LoRA adapter as arrays, the over-the-wire weight sync replacing the
-shared-filesystem bus (distributed_actor.py:150) — to a worker process, and
-reassembling the results in order. Worker failure triggers the control
-plane's shard resubmission, not a run abort.
+with the reference's ``even_chunks`` math, shipping each shard to a worker
+process, and reassembling the results in order. Worker failure triggers the
+control plane's shard resubmission, not a run abort.
+
+Weight transport (ISSUE 9) is selectable:
+
+* ``weight_bus="dispatch"`` (legacy): the full LoRA pytree rides inside
+  every shard payload — the shared-filesystem adapter bus
+  (distributed_actor.py:150) re-expressed as weights-in-the-request.
+* ``weight_bus="broadcast"``: a real ``push_lora(lora, version=)`` hands
+  the adapter to a :class:`~.weight_bus.WeightBus` sender thread ONCE per
+  learner version (delta-encoded, out-of-band MSG_WEIGHTS), dispatches
+  carry only ``{"weight_version": v}``, and workers resolve it from their
+  versioned adapter cache — mid-round pushes swap in-flight through the
+  worker engine's LoraMailbox, and the per-round swap events ship back so
+  the trainer's trajectory version tags stay truthful.
 
 ``params`` is intentionally ignored: each worker holds its own resident base
 model, exactly like a Ray actor holds its own GPU copy.
@@ -18,6 +29,7 @@ model, exactly like a Ray actor holds its own GPU copy.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Sequence
 
@@ -31,6 +43,8 @@ from distrl_llm_tpu.distributed.control_plane import DriverClient
 from distrl_llm_tpu.distributed.resilience import RetryPolicy, ShardFailedError
 from distrl_llm_tpu.engine.engine import GenerationResult, accumulate_round_stats
 from distrl_llm_tpu.utils.chunking import even_chunks
+
+log = logging.getLogger(__name__)
 
 
 class RemoteEngine:
@@ -49,6 +63,7 @@ class RemoteEngine:
         lora_scale: float = 1.0,
         eos_token_ids: Sequence[int] | None = None,
         degrade_on_shard_failure: bool = False,
+        weight_bus: str = "dispatch",
     ):
         self.driver = driver
         self.max_prompt_tokens = max_prompt_tokens
@@ -79,6 +94,135 @@ class RemoteEngine:
         # contract): remote rounds have no local prefill/decode split, so
         # the whole RPC fan-out is accounted as decode time
         self.last_round_stats: dict | None = None
+        # --- versioned weight bus (ISSUE 9) ----------------------------
+        if weight_bus not in ("dispatch", "broadcast"):
+            raise ValueError(
+                f"weight_bus must be 'dispatch' or 'broadcast', got "
+                f"{weight_bus!r}"
+            )
+        self.weight_bus_mode = weight_bus
+        self.bus = None
+        # the LoraMailbox swap-log surface the trainer's trajectory
+        # version tags read (engine-lifetime append-only lists, same as
+        # the local engines): worker-recorded per-round swap events are
+        # merged in after each round
+        self.last_swap_steps: list[int] = []
+        self.last_swap_versions: list[int | None] = []
+        # in-flight pushes need the broadcast channel: the trainer's
+        # validation keys off this capability flag
+        self.supports_inflight_push = weight_bus == "broadcast"
+        # the latest push as ONE tuple reference (lora, lora_np, version) —
+        # the LoraMailbox single-slot discipline: cross-thread readers
+        # (generate on the rollout thread, the rejoin/transient hooks)
+        # snapshot it once and can never pair an old tree with a new
+        # version
+        self._bus_state: tuple | None = None
+        self._auto_version = -1      # raw callers that never name versions
+        # True once any caller named a version explicitly: the learner owns
+        # the version sequence from then on, and generate must not
+        # auto-push a tree that merely LOOKS new (a racing learner push
+        # would otherwise get its predecessor re-broadcast as "newer")
+        self._versioned_pushes = False
+        self._round_state: tuple | None = None  # re-request source
+        if weight_bus == "broadcast":
+            from distrl_llm_tpu.distributed.weight_bus import WeightBus
+
+            self.bus = WeightBus(
+                driver.addresses, retry_policy=driver.retry,
+            )
+            driver.rejoin_hook = self._rejoin_resync
+            driver.transient_hook = self._transient_resync
+            driver.shutdown_hooks.append(self.bus.close)
+
+    # ------------------------------------------------------------ weight bus
+
+    def push_lora(self, lora, version: int | None = None) -> None:
+        """Broadcast one adapter version to every worker, asynchronously
+        (the learner never blocks on the wire — the bus sender thread owns
+        the fan-out). Workers feed it into their engine's LoraMailbox, so a
+        round in flight swaps mid-generation, PipelineRL-style; the next
+        dispatched round references it as ``{"weight_version": version}``.
+
+        Idempotent per (tree identity, version): the trainer's
+        ``_push_weights`` and its in-flight push block may both name the
+        same update."""
+        if self.bus is None:
+            raise RuntimeError(
+                "push_lora requires weight_bus='broadcast' — this "
+                "RemoteEngine ships adapters inside dispatch payloads "
+                "(weight_bus='dispatch') and cannot update a round in flight"
+            )
+        if lora is None:
+            raise ValueError("push_lora needs an adapter tree, got None")
+        if version is None:
+            self._auto_version += 1
+            version = self._auto_version
+        else:
+            self._auto_version = max(self._auto_version, int(version))
+            self._versioned_pushes = True
+        state = self._bus_state
+        if state is not None and lora is state[0] and int(version) == state[2]:
+            return  # already pushed (trainer pushes once per step twice)
+        # host copy NOW, on the caller's thread: in sync mode the learner's
+        # next train step DONATES these buffers — the sender thread must
+        # never read device arrays whose lifetime the learner controls
+        lora_np = jax.tree_util.tree_map(np.asarray, lora)
+        # ONE assignment: readers snapshot the whole (tree, np, version)
+        self._bus_state = (lora, lora_np, int(version))
+        self.bus.push(lora_np, int(version))
+
+    @property
+    def _bus_lora_np(self):
+        state = self._bus_state
+        return state[1] if state is not None else None
+
+    @property
+    def _bus_version(self) -> int | None:
+        state = self._bus_state
+        return state[2] if state is not None else None
+
+    def _rejoin_resync(self, address) -> bool:
+        """DriverClient rejoin hook: full-tensor resync of the current
+        version BEFORE the recovered worker is re-admitted (its fresh
+        engine process lost the adapter cache)."""
+        state = self._bus_state  # one snapshot: tree and version pair up
+        if state is None:
+            return True  # nothing ever pushed — nothing to resync
+        return self.bus.sync_worker(tuple(address), state[1], state[2])
+
+    def _transient_resync(self, worker, error) -> None:
+        """DriverClient transient-retry hook: a worker that reported an
+        unknown weight version gets THIS round's version re-pushed
+        full-tensor (one bounded re-request instead of a poisoned shard)."""
+        if "WeightVersionError" not in getattr(error, "traceback_text", ""):
+            return
+        state = self._round_state
+        if state is None:
+            return
+        telemetry.counter_add(resilience.CP_WEIGHT_REREQUESTS)
+        self.bus.sync_worker(tuple(worker.address), state[1], state[2])
+
+    def _merge_swap_events(self, results: list) -> None:
+        """Fold the workers' per-round swap logs into this engine's
+        lifetime swap lists (the surface trainer._generate_round slices per
+        round). Shards see the same broadcast at slightly different decode
+        steps; per version the MAX step is kept — the conservative merge
+        (tokens are tagged no NEWER than any shard actually sampled them,
+        so the staleness bound can only over-, never under-trigger)."""
+        merged: dict[int, int] = {}
+        for r in results:
+            if not r:
+                continue
+            for step, version in zip(
+                r.get("swap_steps") or (), r.get("swap_versions") or ()
+            ):
+                if version is None:
+                    continue
+                v = int(version)
+                merged[v] = max(merged.get(v, -1), int(step))
+        for v in sorted(merged, key=lambda v: (merged[v], v)):
+            self.last_swap_steps.append(merged[v])
+            self.last_swap_versions.append(v)
 
     def generate(
         self,
@@ -94,9 +238,36 @@ class RemoteEngine:
             raise ValueError(f"prompts must be padded to {self.max_prompt_tokens}, got {p}")
         n_workers = max(self.driver.num_healthy, 1)
         sizes = even_chunks(b, min(n_workers, b))
-        lora_np = (
-            jax.tree_util.tree_map(np.asarray, lora) if lora is not None else None
-        )
+        lora_np = None
+        weight_version = None
+        if lora is not None and self.bus is not None:
+            # broadcast mode: the adapter travels ONCE per version on the
+            # out-of-band bus; a tree the caller never pushed (raw engine
+            # users, who never name versions) is pushed here with an
+            # auto-assigned version. The dispatch payload then carries only
+            # the version reference.
+            state = self._bus_state  # one snapshot (tree, np, version)
+            if state is None or (
+                lora is not state[0] and not self._versioned_pushes
+            ):
+                self.push_lora(lora)
+                state = self._bus_state
+            elif lora is not state[0]:
+                # explicit-version regime (the trainer owns the sequence)
+                # and the caller's tree is not the newest push: a learner
+                # push raced this round's entry. Auto-pushing the older
+                # tree would re-broadcast STALE weights under a fresh
+                # version number — dispatch the newest pushed version
+                # instead (equivalent to the in-flight swap landing at
+                # step 0; worker-side tags stay truthful).
+                log.info(
+                    "generate() entered with a superseded adapter tree; "
+                    "dispatching the newest pushed version v%d", state[2],
+                )
+            weight_version = state[2]
+            self._round_state = state
+        elif lora is not None:
+            lora_np = jax.tree_util.tree_map(np.asarray, lora)
         # per-shard rng seeds derived from the round key so candidates differ
         # across shards and rounds but replay deterministically
         seeds = np.asarray(
@@ -112,6 +283,7 @@ class RemoteEngine:
                     "prompt_mask": np.asarray(prompt_mask[start : start + size]),
                     "sampling": dataclasses.asdict(sampling),
                     "lora": lora_np,
+                    "weight_version": weight_version,
                     "lora_scale": self.lora_scale,
                     "eos_token_ids": self.eos_token_ids,
                     "rng_seed": int(seeds[i]),
@@ -138,6 +310,10 @@ class RemoteEngine:
                 shards, timeout_ms=timeout,
                 allow_partial=self.degrade_on_shard_failure,
             )
+            # worker-recorded in-flight swap events (broadcast bus) fold
+            # into the engine-lifetime swap log BEFORE zero-filling — a
+            # quarantined shard contributes no events
+            self._merge_swap_events(results)
             results, lost_rows = self._fill_lost_shards(results, sizes)
             self.last_lost_rows = lost_rows
             tokens = np.concatenate([r["tokens"] for r in results], axis=0)
@@ -219,8 +395,14 @@ def connect_remote_engine(
     poison_threshold: int = 3,
     rejoin: bool = True,
     degrade_on_shard_failure: bool = False,
+    weight_bus: str = "dispatch",
 ) -> RemoteEngine:
-    """Connect to running workers and wrap them as an engine."""
+    """Connect to running workers and wrap them as an engine.
+
+    ``weight_bus="broadcast"`` turns on the versioned weight bus (ISSUE 9):
+    adapters broadcast once per version out-of-band and dispatch payloads
+    carry only a version reference. The raw-API default stays "dispatch"
+    (config-driven runs default to broadcast via TrainConfig.weight_bus)."""
     return RemoteEngine(
         DriverClient(
             addresses,
@@ -234,4 +416,5 @@ def connect_remote_engine(
         lora_scale=lora_scale,
         eos_token_ids=eos_token_ids,
         degrade_on_shard_failure=degrade_on_shard_failure,
+        weight_bus=weight_bus,
     )

@@ -26,6 +26,10 @@ Telemetry series contract (names pinned by tests/test_telemetry.py):
 ``cp/healthy_workers`` (gauge), ``cp/reconnects``, ``cp/resubmits``,
 ``cp/retries``, ``cp/poison_shards``, ``cp/degraded_groups`` (counters),
 plus ``cp/reconnect`` / ``cp/retry`` / ``cp/resubmit`` spans while tracing.
+The weight bus (weight_bus.py, ISSUE 9) adds ``cp/dispatch_bytes``,
+``cp/weight_bytes_sent``, ``cp/weight_pushes``, ``cp/weight_full_syncs``,
+``cp/weight_rerequests`` (counters), ``cp/weight_broadcast_ms`` (histogram:
+learner push → last worker ack per version), and ``cp/weight_push`` spans.
 """
 
 from __future__ import annotations
@@ -49,6 +53,13 @@ CP_RETRIES = "cp/retries"
 CP_POISON_SHARDS = "cp/poison_shards"
 CP_DEGRADED_GROUPS = "cp/degraded_groups"
 CP_REJOIN_EPOCH = "cp/rejoin_epoch"  # gauge: bumps per re-admit
+# ---- weight bus (weight_bus.py, ISSUE 9) ----
+CP_DISPATCH_BYTES = "cp/dispatch_bytes"        # counter: MSG_DISPATCH payload bytes
+CP_WEIGHT_BYTES = "cp/weight_bytes_sent"       # counter: MSG_WEIGHTS payload bytes
+CP_WEIGHT_PUSHES = "cp/weight_pushes"          # counter: per-worker weight pushes
+CP_WEIGHT_FULL_SYNCS = "cp/weight_full_syncs"  # counter: full-tensor (non-delta) sends
+CP_WEIGHT_REREQUESTS = "cp/weight_rerequests"  # counter: unknown-version re-pushes
+CP_WEIGHT_BROADCAST_MS = "cp/weight_broadcast_ms"  # hist: push → last worker ack
 
 FAULT_SCHEDULE_ENV = "DISTRL_FAULT_SCHEDULE"
 

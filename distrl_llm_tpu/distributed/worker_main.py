@@ -14,10 +14,21 @@ Request payloads are pickled ``(op, arg)`` tuples:
   This is the driver-side hot loop #2 moved ONTO workers — host-parallel
   reward computation across processes (SURVEY §3.6.10).
 * ``("generate", shard)`` — a rollout shard: the worker runs its OWN
-  generation engine over ``prompt_ids``/``prompt_mask`` with the shipped
-  LoRA adapter (weight sync over the wire — the multi-host replacement for
-  the reference's shared-filesystem adapter bus, distributed_actor.py:150)
-  and returns {tokens, lengths}. Requires ``--serve-model``.
+  generation engine over ``prompt_ids``/``prompt_mask`` with either the
+  shipped LoRA adapter (``"lora"`` — legacy weight-in-the-request,
+  distributed_actor.py:150) or a ``"weight_version"`` reference resolved
+  from the versioned adapter cache the weight bus fills (ISSUE 9), and
+  returns {tokens, lengths} plus the round's in-flight swap events.
+  Requires ``--serve-model``.
+* MSG_WEIGHTS frames (not an op — they arrive on their own connection,
+  concurrent with a dispatch in flight) carry one versioned adapter update
+  from the driver's WeightBus: decoded (delta against the last acked
+  version, checksum-verified), cached, and fed into the engine's
+  LoraMailbox for a true mid-round swap.
+* ``("weights_debug", arg)`` — adapter-cache introspection for tests and
+  the smoke gates: held versions + per-version checksums; ``{"corrupt":
+  v}`` flips one byte of a cached leaf (the checksum-mismatch fallback
+  drill).
 * ``("sleep", seconds)`` → "slept" (hang-injection tests)
 * ``("flaky", {"key": str, "fails": int})`` → raises a TRANSIENT
   ConnectionError for the first ``fails`` calls sharing ``key``, then
@@ -44,6 +55,7 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
                  engine_impl: str = "dense", kv_quant: str = "none",
                  max_concurrent: int = 0, scheduler: str = "waves",
+                 decode_chunk: int | None = None,
                  spec_draft: int | None = None, spec_ngram: int | None = None,
                  spec_drafter: str | None = None,
                  spec_verify: str | None = None, spec_adapt: bool = False,
@@ -101,6 +113,13 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         kwargs["plan_db"] = plan_db
     if scan_chunk is not None:
         kwargs["scan_chunk"] = scan_chunk
+    if decode_chunk is not None:
+        # dispatch granularity = in-flight swap granularity: the engine
+        # polls its weight-update mailbox between decode dispatches, so a
+        # smaller chunk tightens how quickly a MSG_WEIGHTS push lands
+        # mid-round (the engine default of 128 makes short rounds one
+        # dispatch — pushes would only land at round boundaries)
+        kwargs["decode_chunk"] = decode_chunk
     if engine_impl == "paged":
         engine_cls = PagedGenerationEngine
         kwargs["scheduler"] = scheduler
@@ -155,6 +174,62 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         lora_scale=_ENGINE_STATE["lora_scale"], **kwargs,
     )
     _ENGINE_STATE["params"] = params
+    # versioned adapter cache (weight_bus.py, ISSUE 9): filled by MSG_WEIGHTS
+    # pushes, read by version-referencing dispatches. 2 slots — current +
+    # superseded, the remote twin of the LoraMailbox's self-drafter slot
+    from distrl_llm_tpu.distributed.weight_bus import AdapterCache
+
+    _ENGINE_STATE["adapter_cache"] = AdapterCache()
+
+
+def weights_handler(payload: bytes) -> bytes:
+    """MSG_WEIGHTS frames (the driver's WeightBus): decode one versioned
+    adapter update — delta against the cached base when the payload names
+    one, checksum-verified either way — store it in the 2-slot cache, and
+    feed it into the engine's LoraMailbox so a generation round in flight
+    swaps at its next decode dispatch (the PipelineRL in-flight semantics,
+    now over the wire). Runs on its OWN connection thread, concurrent with
+    the dispatch handler."""
+    from distrl_llm_tpu import telemetry
+    from distrl_llm_tpu.distributed.weight_bus import (
+        WeightVersionError, decode_update,
+    )
+
+    cache = _ENGINE_STATE.get("adapter_cache")
+    if cache is None:
+        raise RuntimeError(
+            "worker started without --serve-model: no adapter cache to "
+            "receive weight pushes"
+        )
+    msg = pickle.loads(payload)
+    base_version = msg.get("base_version")
+    prev = cache.get(base_version) if base_version is not None else None
+    if base_version is not None and prev is None:
+        raise WeightVersionError(
+            f"delta update v{msg.get('version')} names base v{base_version} "
+            f"which this worker does not hold (cache: {cache.versions()}) — "
+            "WeightVersionError: send full"
+        )
+    with telemetry.span("worker/weights", version=int(msg.get("version", -1)),
+                        delta=bool(base_version is not None)):
+        version, tree = decode_update(msg, prev)  # checksum-verified
+        engine = _ENGINE_STATE.get("engine")
+        if engine is not None:
+            import jax.numpy as jnp
+            import jax
+
+            # in-flight swap: the round currently running (if any) consumes
+            # this at its next decode dispatch; between rounds, the stale-
+            # pending guard at generate entry clears it. Mailbox BEFORE
+            # cache: the cache is the gate a version-naming dispatch waits
+            # on, so ordering guarantees the pending entry is visible to
+            # that dispatch's entry guard — a put-first order would let the
+            # dispatch start and then replay this push as a phantom swap
+            engine.push_lora(
+                jax.tree_util.tree_map(jnp.asarray, tree), version=version
+            )
+        cache.put(version, tree)
+    return pickle.dumps({"version": version, "checksum": msg["checksum"]})
 
 
 def handler(payload: bytes) -> bytes:
@@ -191,6 +266,29 @@ def handler(payload: bytes) -> bytes:
                 for answers, solutions in zip(arg["answers"], arg["solution"])
             ]
             return pickle.dumps(rewards)
+    if op == "weights_debug":
+        from distrl_llm_tpu.distributed.weight_bus import checksum_tree
+
+        cache = _ENGINE_STATE.get("adapter_cache")
+        if cache is None:
+            raise RuntimeError("worker started without --serve-model")
+        arg = arg or {}
+        if arg.get("corrupt") is not None:
+            import jax
+
+            v = int(arg["corrupt"])
+            tree = cache.get(v)
+            if tree is None:
+                raise ValueError(f"no cached adapter v{v} to corrupt")
+            leaf = jax.tree_util.tree_leaves(tree)[0]
+            leaf.reshape(-1).view("uint8")[0] ^= 0xFF  # flip one byte in place
+        return pickle.dumps({
+            "versions": cache.versions(),
+            "current": cache.current_version,
+            "checksums": {
+                v: checksum_tree(cache.get(v)) for v in cache.versions()
+            },
+        })
     if op == "generate":
         if "engine" not in _ENGINE_STATE:
             raise RuntimeError("worker started without --serve-model")
@@ -199,9 +297,28 @@ def handler(payload: bytes) -> bytes:
 
         from distrl_llm_tpu.config import SamplingConfig
 
+        engine = _ENGINE_STATE["engine"]
         lora = arg["lora"]
-        if lora is not None:
+        weight_version = arg.get("weight_version")
+        if lora is None and weight_version is not None:
+            # broadcast bus (ISSUE 9): resolve the named version from the
+            # adapter cache, waiting out the benign race where the dispatch
+            # outran its broadcast; a genuine miss raises the transient
+            # WeightVersionError the driver's re-request hook answers
+            from distrl_llm_tpu.distributed import weight_bus as wb
+
+            tree = _ENGINE_STATE["adapter_cache"].wait_for(
+                int(weight_version), timeout_s=wb.resolve_wait_s()
+            )
+            lora = jax.tree_util.tree_map(jnp.asarray, tree)
+            # a pending mailbox entry at or below the version this round
+            # opens with would replay as a spurious step-0 swap — discard
+            # it atomically (a strictly newer push racing in stays: it is
+            # a real in-flight update this round should consume)
+            engine.discard_pending_at_or_below(int(weight_version))
+        elif lora is not None:
             lora = jax.tree_util.tree_map(jnp.asarray, lora)
+        if lora is not None:
             # the adapter is only meaningful at the trainer's alpha/rank
             # scale — a mismatch means sampling a DIFFERENT policy than the
             # learner optimizes; fail loudly instead (review r2)
@@ -216,14 +333,18 @@ def handler(payload: bytes) -> bytes:
         if eos_override:
             # the trainer's merged stop-token set wins over the worker's
             # single tokenizer eos (same compiled fns — eos ids are traced)
-            _ENGINE_STATE["engine"].eos_ids = jnp.asarray(
+            engine.eos_ids = jnp.asarray(
                 sorted(set(int(e) for e in eos_override)), jnp.int32
             )
+        # snapshot the mailbox swap log so THIS round's in-flight swaps
+        # (weight-bus pushes landing mid-generation) ship back with the
+        # result — the driver merges them into its trajectory version tags
+        swaps_before = len(getattr(engine, "last_swap_steps", ()))
         with telemetry.span(
             "worker/generate", rows=int(arg["prompt_ids"].shape[0]),
             n=int(arg["sampling"].get("n", 1)),
         ) as sp:
-            result = _ENGINE_STATE["engine"].generate(
+            result = engine.generate(
                 _ENGINE_STATE["params"], lora,
                 arg["prompt_ids"], arg["prompt_mask"],
                 SamplingConfig(**arg["sampling"]),
@@ -233,6 +354,13 @@ def handler(payload: bytes) -> bytes:
         return pickle.dumps({
             "tokens": result.tokens, "lengths": result.lengths,
             "logprobs": result.logprobs,
+            "entry_version": weight_version,
+            "swap_steps": list(
+                getattr(engine, "last_swap_steps", ())
+            )[swaps_before:],
+            "swap_versions": list(
+                getattr(engine, "last_swap_versions", ())
+            )[swaps_before:],
         })
     raise ValueError(f"unknown op {op!r}")
 
@@ -296,6 +424,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--budget-batch", type=int, default=0,
                         help="prompts per round assumed by the page-budget "
                              "math (shared prompt-page region)")
+    parser.add_argument("--decode-chunk", type=int, default=None,
+                        help="decode steps per engine dispatch (unset = "
+                             "engine default 128). The mailbox consuming "
+                             "weight-bus pushes is polled between "
+                             "dispatches, so this bounds in-flight swap "
+                             "latency: a push can land mid-round at most "
+                             "this many decode steps late")
     parser.add_argument("--decode-scan-chunk", type=int, default=None,
                         help="decode steps fused per dispatch; 0 = off; "
                              "unset = this host's autotune plan DB decides. "
@@ -341,6 +476,8 @@ def main(argv: list[str] | None = None) -> None:
         from distrl_llm_tpu import telemetry
 
         telemetry.configure(enabled=True)
+    if args.decode_chunk is not None and args.decode_chunk < 1:
+        parser.error("--decode-chunk must be >= 1")
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
     if args.scheduler != "refill" and (
@@ -380,7 +517,8 @@ def main(argv: list[str] | None = None) -> None:
             args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
             max_concurrent=args.max_concurrent_sequences,
-            scheduler=args.scheduler, spec_draft=args.spec_draft,
+            scheduler=args.scheduler, decode_chunk=args.decode_chunk,
+            spec_draft=args.spec_draft,
             spec_ngram=args.spec_ngram, spec_drafter=args.spec_drafter,
             spec_verify=args.spec_verify, spec_adapt=args.spec_adapt,
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
@@ -394,6 +532,12 @@ def main(argv: list[str] | None = None) -> None:
     from distrl_llm_tpu.distributed.control_plane import WorkerServer
 
     server = WorkerServer(port=args.port)
+    if args.serve_model:
+        # weight-bus receiver (ISSUE 9): MSG_WEIGHTS frames arrive on their
+        # own connection and fill the versioned adapter cache — concurrent
+        # with any generate dispatch, which is what makes mid-round swaps
+        # possible over the control plane
+        server.weights_handler = weights_handler
 
     metrics_server = None
     if args.metrics_port is not None:

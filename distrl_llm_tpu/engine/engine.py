@@ -604,6 +604,16 @@ class LoraMailbox:
     _prev_lora = None
     _prev_lora_version: int | None = None
 
+    def _pending_mu(self) -> threading.Lock:
+        # lazily per-instance (the mixin has no __init__); dict.setdefault
+        # is atomic under the GIL, so two racing first-callers agree
+        mu = self.__dict__.get("_pending_mu_lock")
+        if mu is None:
+            mu = self.__dict__.setdefault(
+                "_pending_mu_lock", threading.Lock()
+            )
+        return mu
+
     def push_lora(self, lora, version: int | None = None) -> None:
         """In-flight weight update (PipelineRL-style): the next dispatched
         decode step onwards samples under this adapter, without waiting for
@@ -624,13 +634,31 @@ class LoraMailbox:
         (rollout/trajectory.py version tags)."""
         # push time rides in the same single-slot tuple (one reference —
         # the consuming thread can never pair it with a stale partner
-        # field); the consume observes push→swap latency from it
-        self._pending = (lora, version, time.perf_counter())
+        # field); the consume observes push→swap latency from it. The lock
+        # orders the slot against discard_pending_at_or_below, which must
+        # never clobber a newer push that lands mid-check.
+        with self._pending_mu():
+            self._pending = (lora, version, time.perf_counter())
+
+    def discard_pending_at_or_below(self, version: int) -> None:
+        """Drop a pending swap whose version is already covered by the
+        adapter a round is about to open with (remote workers: the weight
+        bus pushes every update into the mailbox so MID-round swaps work;
+        the entry push would otherwise replay as a phantom step-0 swap).
+        Atomic with ``push_lora``: a strictly newer push landing
+        concurrently survives."""
+        with self._pending_mu():
+            pending = self._pending
+            if (
+                pending is not None and pending[1] is not None
+                and int(pending[1]) <= int(version)
+            ):
+                self._pending = None
 
     def _take_pending_lora(self, lora_cell: list, dispatched: int) -> None:
-        pending = self._pending
+        with self._pending_mu():
+            pending, self._pending = self._pending, None
         if pending is not None:
-            self._pending = None
             lora, version, pushed_t = pending
             # weight-sync observability (ISSUE 8): how long the learner's
             # push sat in the mailbox before a decode dispatch consumed it

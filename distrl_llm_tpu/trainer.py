@@ -173,6 +173,26 @@ class Trainer:
         self.rewards = reward_computer
         self._reward_fn = reward_function
 
+        # the silent-no-op fix (ISSUE 9): inflight_weight_updates with an
+        # engine that cannot actually swap mid-round used to pretend to
+        # work (the push was a getattr that quietly found nothing). Any
+        # engine that still lacks a real push_lora is rejected HERE, so
+        # the combination can never silently regress again. Local engines
+        # inherit push_lora from LoraMailbox; RemoteEngine advertises
+        # supports_inflight_push only in broadcast-bus mode.
+        if config.inflight_weight_updates:
+            push = getattr(engine, "push_lora", None)
+            if not callable(push) or not getattr(
+                engine, "supports_inflight_push", callable(push)
+            ):
+                raise ValueError(
+                    "inflight_weight_updates requires an engine with a real "
+                    f"push_lora (in-flight weight-update mailbox); "
+                    f"{type(engine).__name__} cannot swap a round in flight "
+                    "— use a local engine or a RemoteEngine with "
+                    "weight_bus='broadcast'"
+                )
+
         # chunk-composition validation parity (distributed_trainer.py:34–36)
         assert config.number_of_learners > 0, "Need at least one learner"
         chunk_sizes(
@@ -437,6 +457,10 @@ class Trainer:
                 poison_threshold=config.poison_shard_k,
                 rejoin=config.worker_rejoin,
                 degrade_on_shard_failure=config.degrade_on_poison,
+                # versioned weight bus (ISSUE 9): broadcast = one delta
+                # push per optimizer step, dispatches carry only a
+                # version reference; dispatch = legacy weights-in-request
+                weight_bus=config.weight_bus,
             )
         else:
             if config.full_finetune and not meshes.timeshared:
@@ -681,9 +705,16 @@ class Trainer:
             # otherwise alias the donated arrays → "buffer deleted" crashes)
             pushed = jax.tree_util.tree_map(jnp.copy, pushed)
         if getattr(self.engine, "is_remote", False):
-            # remote rollout: the adapter ships over the wire with each
-            # round — no local rollout-mesh copy to refresh
+            # remote rollout: the adapter ships over the wire — either once
+            # per version on the broadcast bus (below) or inside each
+            # round's dispatch payloads — no local rollout-mesh copy
             self._lora_rollout = pushed
+            if getattr(self.engine, "bus", None) is not None:
+                # versioned weight bus (ISSUE 9): ONE asynchronous push per
+                # optimizer step; subsequent dispatches reference it as
+                # {weight_version} and mid-round swaps ride the same push
+                # when inflight_weight_updates is on
+                self.engine.push_lora(pushed, version=self.weight_version)
         elif self.meshes is not None and not self.meshes.timeshared:
             from distrl_llm_tpu.parallel.partition import shard_tree
 
@@ -1400,18 +1431,27 @@ class Trainer:
         if cfg.inflight_weight_updates:
             # PipelineRL-style: hand the fresh adapter to the generation
             # round still in flight on the rollout thread — engines swap at
-            # their next decode dispatch (push_lora mailbox); the captured
-            # behavior logprobs keep the clip objective honest about which
-            # policy sampled each token
+            # their next decode dispatch (push_lora mailbox, or the remote
+            # weight bus's MSG_WEIGHTS broadcast); the captured behavior
+            # logprobs keep the clip objective honest about which policy
+            # sampled each token. The version rides with the adapter so the
+            # round in flight can tag every post-swap position with the
+            # policy that sampled it (rollout/trajectory.py version tags).
             push = getattr(self.engine, "push_lora", None)
-            if push is not None:
-                # version rides with the adapter so the round in flight can
-                # tag every post-swap position with the policy that sampled
-                # it (rollout/trajectory.py version tags)
-                push(self._lora_rollout, version=self.weight_version)
-        if self.obs is not None:
+            if push is None:
+                # construction-time validation rejects such engines; a
+                # swapped-in engine must fail the same way, never no-op
+                raise RuntimeError(
+                    "inflight_weight_updates is on but the engine has no "
+                    "push_lora — mid-round weight updates would silently "
+                    "never happen"
+                )
+            push(self._lora_rollout, version=self.weight_version)
+        if self.obs is not None and getattr(self.engine, "bus", None) is None:
             # weight-sync latency (learner→rollout push; the in-engine
-            # push→swap half is the engine/swap_latency_ms histogram)
+            # push→swap half is the engine/swap_latency_ms histogram).
+            # Broadcast-bus engines skip this: the bus sets the gauge from
+            # push → LAST WORKER ACK, the honest end-to-end number
             telemetry.gauge_set(
                 obs_mod.OBS_WEIGHT_SYNC_MS,
                 (time.perf_counter() - t_sync0) * 1e3,

@@ -56,7 +56,9 @@ class TestBenchContract:
                     "rollout_mode", "max_staleness", "rollout_dropped_stale",
                     "spec_drafter", "spec_accept_rate",
                     "tokens_per_verify_step", "spec_verify_impl",
-                    "hbm_peak_bytes", "recompile_count", "fleet_tok_s"):
+                    "hbm_peak_bytes", "recompile_count", "fleet_tok_s",
+                    "weight_bus", "weight_bytes_per_update",
+                    "weight_sync_ms"):
             assert key in rec, key
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
         # (honest null, never a fabricated number), a healthy single-config
@@ -65,6 +67,12 @@ class TestBenchContract:
         assert rec["hbm_peak_bytes"] is None
         assert rec["recompile_count"] == 0
         assert rec["fleet_tok_s"] is None
+        # weight-bus fields (ISSUE 9): bench drives a local engine, so the
+        # transport provenance reads null — "no weight bus ran", distinct
+        # from a fleet row's "dispatch"/"broadcast"
+        assert rec["weight_bus"] is None
+        assert rec["weight_bytes_per_update"] is None
+        assert rec["weight_sync_ms"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0

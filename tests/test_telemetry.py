@@ -327,6 +327,34 @@ class TestRegistry:
         snap2 = telemetry.metrics_snapshot()
         assert "cp/reconnects" not in snap2
 
+    def test_weight_bus_series_schema(self):
+        """Schema pin for the weight-bus registry names (ISSUE 9): byte
+        and push COUNTERS, plus the push→last-ack broadcast latency
+        HISTOGRAM (summary-stat keys in the snapshot)."""
+        from distrl_llm_tpu.distributed import resilience as r
+
+        assert r.CP_DISPATCH_BYTES == "cp/dispatch_bytes"
+        assert r.CP_WEIGHT_BYTES == "cp/weight_bytes_sent"
+        assert r.CP_WEIGHT_PUSHES == "cp/weight_pushes"
+        assert r.CP_WEIGHT_FULL_SYNCS == "cp/weight_full_syncs"
+        assert r.CP_WEIGHT_REREQUESTS == "cp/weight_rerequests"
+        assert r.CP_WEIGHT_BROADCAST_MS == "cp/weight_broadcast_ms"
+        telemetry.counter_add(r.CP_DISPATCH_BYTES, 1000)
+        telemetry.counter_add(r.CP_WEIGHT_BYTES, 2048)
+        telemetry.counter_add(r.CP_WEIGHT_PUSHES, 2)
+        telemetry.counter_add(r.CP_WEIGHT_FULL_SYNCS)
+        telemetry.counter_add(r.CP_WEIGHT_REREQUESTS)
+        telemetry.hist_observe(r.CP_WEIGHT_BROADCAST_MS, 5.0)
+        telemetry.hist_observe(r.CP_WEIGHT_BROADCAST_MS, 15.0)
+        snap = telemetry.metrics_snapshot()
+        assert snap["cp/dispatch_bytes"] == 1000.0
+        assert snap["cp/weight_bytes_sent"] == 2048.0
+        assert snap["cp/weight_pushes"] == 2.0
+        assert snap["cp/weight_full_syncs"] == 1.0
+        assert snap["cp/weight_rerequests"] == 1.0
+        assert snap["cp/weight_broadcast_ms_count"] == 2
+        assert snap["cp/weight_broadcast_ms_mean"] == 10.0
+
     def test_backpressure_counter_schema(self):
         import threading
 

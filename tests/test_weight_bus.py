@@ -1,0 +1,647 @@
+"""Versioned weight-broadcast bus (ISSUE 9).
+
+Unit tier: the wire codec's bit-exactness contract (delta encode→decode ≡
+original for fp32 and bf16 trees, whatever mode the encoder picks), the
+checksum guard, and the worker-side 2-slot AdapterCache. Integration tier
+(real 2-worker control plane, slow): broadcast-vs-dispatch bit-identity with
+frame-size accounting (the dispatch payload win), mid-round in-flight swaps
+over the wire, rejoin full-resync, the unknown-version bounded re-request,
+and the checksum-mismatch full-tensor fallback.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.config import SamplingConfig, TrainConfig
+from distrl_llm_tpu.distributed import connect_remote_engine
+from distrl_llm_tpu.distributed import weight_bus as wb
+from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+from distrl_llm_tpu.models.lora import lora_scale
+from distrl_llm_tpu.native.build import native_available
+
+pytestmark = [pytest.mark.distributed]
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ not available"
+)
+
+P_LEN, MAX_NEW = 8, 6
+SCALE = lora_scale(4, 8.0)
+
+
+# ------------------------------------------------------------------- codec
+
+
+def _tree(seed: int, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return {
+        "q": {"A": rng.standard_normal((4, 8)).astype(dtype),
+              "B": rng.standard_normal((8, 4)).astype(dtype)},
+        "v": rng.standard_normal((16,)).astype(dtype),
+    }
+
+
+def _assert_bit_identical(got, want):
+    g = jax.tree_util.tree_leaves(got)
+    w = jax.tree_util.tree_leaves(want)
+    assert len(g) == len(w)
+    for a, b in zip(g, w):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+class TestCodec:
+    def test_full_roundtrip_rebuilds_structure(self):
+        new = _tree(0)
+        payload = pickle.loads(wb.serialize_update(wb.encode_update(new, 7)))
+        version, dec = wb.decode_update(payload)
+        assert version == 7
+        _assert_bit_identical(dec, new)
+        assert sorted(dec) == ["q", "v"] and sorted(dec["q"]) == ["A", "B"]
+
+    def test_bf16_delta_chosen_when_exact_and_smaller(self):
+        prev = _tree(1)
+        # +0.5 / +0.25 are bf16-exact deltas whose application is f32-exact
+        new = jax.tree_util.tree_map(lambda x: x + 0.5, prev)
+        payload = wb.encode_update(new, 2, prev, 1)
+        assert {r["mode"] for r in payload["leaves"]} == {"delta_bf16"}
+        assert payload["base_version"] == 1
+        # the whole point: 2 bytes/elem on the wire instead of 4
+        full = wb.encode_update(new, 2)
+        assert (
+            sum(len(r["data"]) for r in payload["leaves"])
+            < sum(len(r["data"]) for r in full["leaves"])
+        )
+        _, dec = wb.decode_update(payload, prev)
+        _assert_bit_identical(dec, new)
+
+    def test_f32_delta_exact_fallback(self):
+        # prev = zeros: the f32 delta IS the new tensor (exact), while the
+        # bf16 candidate rounds random mantissas and fails verification
+        prev = jax.tree_util.tree_map(np.zeros_like, _tree(2))
+        new = _tree(3)
+        payload = wb.encode_update(new, 5, prev, 4)
+        assert {r["mode"] for r in payload["leaves"]} == {"delta_f32"}
+        _, dec = wb.decode_update(payload, prev)
+        _assert_bit_identical(dec, new)
+
+    def test_inexact_delta_degrades_to_full_still_bit_exact(self):
+        # wildly different magnitudes: neither delta reconstructs exactly,
+        # so the encoder must choose full — bit-exactness is the invariant,
+        # the mode is just the cheapest way to keep it
+        prev = jax.tree_util.tree_map(lambda x: x * 1e30, _tree(4))
+        new = _tree(5)
+        payload = wb.encode_update(new, 6, prev, 5)
+        for rec in payload["leaves"]:
+            _ = rec["mode"]  # any mode is legal...
+        _, dec = wb.decode_update(payload, prev)
+        _assert_bit_identical(dec, new)  # ...this is not negotiable
+
+    def test_bf16_dtype_tree_roundtrip(self):
+        import ml_dtypes
+
+        prev = _tree(6, dtype=ml_dtypes.bfloat16)
+        new = jax.tree_util.tree_map(
+            lambda x: (x.astype(np.float32) + 0.25).astype(ml_dtypes.bfloat16),
+            prev,
+        )
+        payload = wb.encode_update(new, 3, prev, 2)
+        _, dec = wb.decode_update(payload, prev)
+        _assert_bit_identical(dec, new)
+
+    def test_checksum_mismatch_on_wrong_base(self):
+        prev = _tree(7)
+        new = jax.tree_util.tree_map(lambda x: x + 0.5, prev)
+        payload = wb.encode_update(new, 2, prev, 1)
+        wrong_base = _tree(8)
+        with pytest.raises(wb.WeightChecksumError):
+            wb.decode_update(payload, wrong_base)
+
+    def test_checksum_mismatch_on_corrupt_leaf(self):
+        new = _tree(9)
+        payload = wb.encode_update(new, 1)
+        data = bytearray(payload["leaves"][0]["data"])
+        data[0] ^= 0xFF
+        payload["leaves"][0]["data"] = bytes(data)
+        with pytest.raises(wb.WeightChecksumError):
+            wb.decode_update(payload)
+
+    def test_delta_against_absent_base_raises_version_error(self):
+        prev = _tree(10)
+        new = jax.tree_util.tree_map(lambda x: x + 0.5, prev)
+        payload = wb.encode_update(new, 2, prev, 1)
+        with pytest.raises(wb.WeightVersionError, match="does not hold"):
+            wb.decode_update(payload, None)
+
+    def test_structure_drift_encodes_full(self):
+        prev = {"a": np.ones((2,), np.float32)}
+        new = {"b": np.ones((2,), np.float32)}
+        payload = wb.encode_update(new, 2, prev, 1)
+        assert payload["base_version"] is None  # wholesale full push
+        _, dec = wb.decode_update(payload)
+        assert sorted(dec) == ["b"]
+
+
+# ------------------------------------------------------------------- cache
+
+
+class TestAdapterCache:
+    def test_hit_miss_and_two_slot_eviction(self):
+        c = wb.AdapterCache()
+        t1, t2, t3 = _tree(1), _tree(2), _tree(3)
+        c.put(1, t1)
+        assert c.get(1) is t1 and c.get(2) is None
+        c.put(2, t2)
+        assert c.versions() == [1, 2]  # current + superseded
+        c.put(3, t3)
+        assert c.versions() == [2, 3]  # oldest evicted
+        assert c.current_version == 3
+        assert c.previous() == (2, t2)  # the self-drafter's remote slot
+
+    def test_out_of_order_resync_keeps_delivered_version(self):
+        # a requeued shard naming an OLD version the driver re-pushed must
+        # find it in the cache — the resync cannot evict itself
+        c = wb.AdapterCache()
+        c.put(6, _tree(6))
+        c.put(7, _tree(7))
+        old = _tree(5)
+        c.put(5, old)
+        assert c.get(5) is old
+        assert c.current_version == 7
+
+    def test_wait_for_resolves_cross_thread(self):
+        c = wb.AdapterCache()
+        tree = _tree(4)
+        threading.Timer(0.05, lambda: c.put(9, tree)).start()
+        assert c.wait_for(9, timeout_s=5.0) is tree
+
+    def test_wait_for_timeout_is_transient_version_error(self):
+        c = wb.AdapterCache()
+        with pytest.raises(wb.WeightVersionError, match="unknown weight"):
+            c.wait_for(42, timeout_s=0.05)
+        try:
+            c.wait_for(42, timeout_s=0.01)
+        except wb.WeightVersionError as e:
+            from distrl_llm_tpu.distributed.resilience import (
+                classify_worker_error,
+            )
+
+            # the marker is what routes the dispatch-path surfacing into
+            # the bounded same-worker retry + re-request hook
+            assert classify_worker_error(str(e))
+
+
+# ------------------------------------------------------------ config layer
+
+
+class TestConfigAndEngineValidation:
+    def _base(self, **kw):
+        return dict(
+            model="tiny", max_prompt_tokens=16, max_new_tokens=16,
+            number_of_actors=1, number_of_learners=1, learner_chunk_size=0,
+            metrics_backend="null", **kw,
+        )
+
+    def test_weight_bus_value_validated(self):
+        with pytest.raises(ValueError, match="weight_bus"):
+            TrainConfig(**self._base(weight_bus="carrier-pigeon"))
+        assert TrainConfig(**self._base()).weight_bus == "broadcast"
+        assert TrainConfig(
+            **self._base(weight_bus="dispatch")
+        ).weight_bus == "dispatch"
+
+    def test_inflight_over_workers_requires_broadcast(self):
+        # the silent-no-op fix: this combination used to "work" while never
+        # updating worker weights mid-round
+        with pytest.raises(ValueError, match="broadcast"):
+            TrainConfig(**self._base(
+                inflight_weight_updates=True, async_rollout=True,
+                clip_ratio=0.2, rollout_workers=("127.0.0.1:1",),
+                workers_capture_logprobs=True, weight_bus="dispatch",
+            ))
+        cfg = TrainConfig(**self._base(
+            inflight_weight_updates=True, async_rollout=True,
+            clip_ratio=0.2, rollout_workers=("127.0.0.1:1",),
+            workers_capture_logprobs=True,
+        ))
+        assert cfg.weight_bus == "broadcast"
+
+    def test_trainer_rejects_engine_without_push_lora(self):
+        from tests.test_trainer import make_trainer
+
+        with pytest.raises(ValueError, match="push_lora"):
+            make_trainer(
+                inflight_weight_updates=True, async_rollout=True,
+                clip_ratio=0.2,
+            )
+
+    def test_dispatch_mode_remote_engine_cannot_push(self):
+        from distrl_llm_tpu.distributed.remote_engine import RemoteEngine
+
+        class FakeDriver:
+            num_healthy = 1
+            rejoin_epoch = 0
+
+        eng = RemoteEngine(FakeDriver(), max_prompt_tokens=8, max_new_tokens=4)
+        assert eng.supports_inflight_push is False
+        with pytest.raises(RuntimeError, match="broadcast"):
+            eng.push_lora({"a": np.ones(2, np.float32)}, version=1)
+
+
+# ------------------------------------------------- real control-plane tier
+
+
+def spawn_worker(port: int = 0, extra_env: dict | None = None,
+                 capture_logprobs: bool = False, max_new: int = MAX_NEW,
+                 decode_chunk: int | None = None):
+    argv = [
+        sys.executable, "-m", "distrl_llm_tpu.distributed.worker_main",
+        "--port", str(port), "--serve-model", "tiny",
+        "--max-prompt-tokens", str(P_LEN), "--max-new-tokens", str(max_new),
+        "--seed", "7", "--lora-rank", "4", "--lora-alpha", "8",
+    ]
+    if capture_logprobs:
+        argv.append("--capture-logprobs")
+    if decode_chunk is not None:
+        argv += ["--decode-chunk", str(decode_chunk)]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})},
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
+@pytest.fixture
+def workers():
+    procs, addrs = [], []
+    for _ in range(2):
+        p, port = spawn_worker()
+        procs.append(p)
+        addrs.append(("127.0.0.1", port))
+    yield procs, addrs
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, TINY.vocab_size, size=(4, P_LEN)).astype(np.int32)
+    mask = np.ones((4, P_LEN), np.int32)
+    return ids, mask
+
+
+def _connect(addrs, mode="broadcast", **kw):
+    return connect_remote_engine(
+        addrs, max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW,
+        timeout_ms=120_000, lora_scale=SCALE, weight_bus=mode, **kw,
+    )
+
+
+GREEDY = SamplingConfig(max_tokens=MAX_NEW, temperature=0.0, top_p=1.0, n=1)
+
+
+@needs_native
+class TestBusPushPlane:
+    def test_push_ack_delta_and_cache_slots(self, workers):
+        """Fast plane-level check (no generation, no XLA compile): a full
+        first-contact push, a delta follow-up, acked bookkeeping, and the
+        worker-side 2-slot cache with checksums matching the driver's."""
+        _, addrs = workers
+        eng = _connect(addrs)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        eng.push_lora(lora, version=0)
+        assert eng.bus.flush(timeout_s=60), "v0 broadcast never acked"
+        lora1 = jax.tree_util.tree_map(lambda x: x + 0.5, lora)
+        eng.push_lora(lora1, version=1)
+        assert eng.bus.flush(timeout_s=60), "v1 broadcast never acked"
+        assert [eng.bus.acked_version(a) for a in addrs] == [1, 1]
+        assert eng.bus.last_acked_version == 1
+        want = {
+            0: wb.checksum_tree(
+                jax.tree_util.tree_map(np.asarray, lora)
+            ),
+            1: wb.checksum_tree(
+                jax.tree_util.tree_map(np.asarray, lora1)
+            ),
+        }
+        for dbg in eng.driver.dispatch_objects(
+            [("weights_debug", {}), ("weights_debug", {})], 60_000
+        ):
+            assert dbg["versions"] == [0, 1]
+            assert dbg["current"] == 1
+            assert dbg["checksums"] == want  # bit-identical across the wire
+        # a third version evicts the oldest slot
+        eng.push_lora(
+            jax.tree_util.tree_map(lambda x: x + 0.25, lora1), version=2
+        )
+        assert eng.bus.flush(timeout_s=60)
+        dbg = eng.driver.dispatch_objects([("weights_debug", {})], 60_000)[0]
+        assert dbg["versions"] == [1, 2]
+        eng.driver.shutdown()
+
+    def test_checksum_mismatch_falls_back_to_full(self, workers):
+        """A worker whose cached base rotted (one flipped byte) rejects the
+        next delta with WeightChecksumError; the sender clears its acked
+        state and lands the version with a full-tensor push — convergence,
+        never a silently-wrong adapter."""
+        _, addrs = workers
+        eng = _connect(addrs[:1])
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        eng.push_lora(lora, version=0)
+        assert eng.bus.flush(timeout_s=60)
+        telemetry.metrics_snapshot()  # reset deltas
+        eng.driver.dispatch_objects([("weights_debug", {"corrupt": 0})], 60_000)
+        lora1 = jax.tree_util.tree_map(lambda x: x + 0.5, lora)
+        eng.push_lora(lora1, version=1)
+        assert eng.bus.flush(timeout_s=60), "fallback push never converged"
+        dbg = eng.driver.dispatch_objects([("weights_debug", {})], 60_000)[0]
+        assert dbg["current"] == 1
+        assert dbg["checksums"][1] == wb.checksum_tree(
+            jax.tree_util.tree_map(np.asarray, lora1)
+        )
+        snap = telemetry.metrics_snapshot()
+        assert snap.get("cp/weight_full_syncs", 0) >= 1
+        eng.driver.shutdown()
+
+
+@needs_native
+class TestBroadcastGeneration:
+    @pytest.mark.slow
+    def test_broadcast_matches_dispatch_and_sheds_payload_bytes(
+        self, workers, batch
+    ):
+        """The acceptance pin: identical tokens through either transport,
+        and steady-state MSG_DISPATCH payloads shed at least the serialized
+        adapter size per round once the bus carries the weights."""
+        _, addrs = workers
+        ids, mask = batch
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        lora_np = jax.tree_util.tree_map(np.asarray, lora)
+        adapter_bytes = len(pickle.dumps(lora_np))
+
+        disp = _connect(addrs, mode="dispatch")
+        bc = _connect(addrs, mode="broadcast")
+        # warm both paths (compile + first-contact push), then meter
+        want = disp.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(0))
+        got = bc.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_array_equal(got.lengths, want.lengths)
+
+        telemetry.metrics_snapshot()  # reset counter deltas
+        disp.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(1))
+        dispatch_bytes = telemetry.metrics_snapshot()["cp/dispatch_bytes"]
+        bc.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(1))
+        broadcast_bytes = telemetry.metrics_snapshot()["cp/dispatch_bytes"]
+        # ≥ the serialized adapter per round: both rounds split into 2
+        # shards, each of which used to carry the full adapter
+        assert dispatch_bytes - broadcast_bytes >= adapter_bytes, (
+            dispatch_bytes, broadcast_bytes, adapter_bytes,
+        )
+        disp.driver.shutdown()
+
+    @pytest.mark.slow
+    def test_remote_inflight_swap_mid_round(self, workers, batch):
+        """The PipelineRL contract over the wire: a push landing while the
+        round is in flight swaps the workers' adapters mid-generation; the
+        workers' swap logs ship back, merge into the engine-lifetime lists,
+        and the derived trajectory version tags span both policies."""
+        from distrl_llm_tpu.rollout.trajectory import version_tags_for_round
+
+        _, addrs = workers
+        ids, mask = batch
+        lora_a = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        lora_b = jax.tree_util.tree_map(lambda x: x + 0.5, lora_a)
+
+        bc = _connect(addrs)
+        bc.push_lora(lora_a, version=0)
+        # baseline (pure A) — also pays the XLA compile, so the NEXT
+        # round's duration is decode-only... still long enough on CPU for
+        # a localhost push to land mid-round, but use a fresh engine pair
+        # per-push below to keep the compile window available
+        base = bc.generate(None, lora_a, ids, mask, GREEDY, jax.random.PRNGKey(3))
+
+        done = threading.Event()
+        out = {}
+
+        def run():
+            out["res"] = bc.generate(
+                None, lora_a, ids, mask, GREEDY, jax.random.PRNGKey(3)
+            )
+            done.set()
+
+        swaps_before = len(bc.last_swap_steps)
+        t = threading.Thread(target=run)
+        t.start()
+        # push B immediately: the round is dispatching (or about to) — the
+        # bus lands it on the workers' weights threads, whose engines
+        # consume it at their next decode dispatch
+        bc.push_lora(lora_b, version=1)
+        t.join(timeout=300)
+        assert done.is_set(), "round never completed"
+
+        events = list(zip(
+            bc.last_swap_steps[swaps_before:],
+            bc.last_swap_versions[swaps_before:],
+        ))
+        if events:
+            # the swap genuinely landed mid-round: tags must cover v1 from
+            # the recorded step on, and the tokens diverge from pure A
+            assert all(v == 1 for _, v in events)
+            tags = version_tags_for_round(4, MAX_NEW, 0, events)
+            assert (tags == 1).any()
+            step = events[0][0]
+            if step + 1 < MAX_NEW:
+                assert not np.array_equal(out["res"].tokens, base.tokens)
+        # either way the NEXT round runs under v1 everywhere
+        nxt = bc.generate(None, lora_b, ids, mask, GREEDY, jax.random.PRNGKey(3))
+        disp = _connect(addrs, mode="dispatch")
+        want_b = disp.generate(
+            None, lora_b, ids, mask, GREEDY, jax.random.PRNGKey(3)
+        )
+        np.testing.assert_array_equal(nxt.tokens, want_b.tokens)
+        disp.driver.shutdown()
+
+    @pytest.mark.slow
+    def test_unknown_version_triggers_bounded_rerequest(self, batch):
+        """A dispatch naming a version the worker never received (its wait
+        times out) surfaces as a transient WeightVersionError; the driver's
+        hook re-pushes that exact version full-tensor and the bounded
+        same-worker retry completes the round — no poisoned shard."""
+        ids, mask = batch
+        proc, port = spawn_worker(extra_env={"DISTRL_WEIGHT_WAIT_S": "1"})
+        try:
+            addrs = [("127.0.0.1", port)]
+            bc = _connect(addrs)
+            lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+            bc.push_lora(lora, version=0)
+            assert bc.bus.flush(timeout_s=60)
+            # fabricate the failure: the driver believes v7 was broadcast
+            # (bus-state bookkeeping says so) but the worker never saw it
+            lora7 = jax.tree_util.tree_map(lambda x: x + 0.5, lora)
+            bc._bus_state = (
+                lora7, jax.tree_util.tree_map(np.asarray, lora7), 7,
+            )
+            telemetry.metrics_snapshot()  # reset deltas
+            got = bc.generate(None, lora7, ids, mask, GREEDY, jax.random.PRNGKey(0))
+            assert got.tokens.shape == (4, 1, MAX_NEW)
+            snap = telemetry.metrics_snapshot()
+            assert snap.get("cp/weight_rerequests", 0) >= 1
+            dbg = bc.driver.dispatch_objects([("weights_debug", {})], 60_000)[0]
+            assert 7 in dbg["versions"]
+            bc.driver.shutdown()
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+    @pytest.mark.slow
+    def test_rejoin_resyncs_full_before_readmission(self, workers, batch):
+        """A killed worker restarts cold (empty adapter cache); the rejoin
+        hook pushes the current version full-tensor BEFORE re-admission, so
+        the first post-rejoin round resolves its version immediately."""
+        procs, addrs = workers
+        ids, mask = batch
+        bc = _connect(addrs)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        want = bc.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(0))
+        v = bc._bus_version
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        assert bc.driver.ping_all() == [False, True]
+        procs[0] = spawn_worker(port=addrs[0][1])[0]
+        deadline = time.time() + 120
+        while bc.driver.num_healthy < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert bc.driver.num_healthy == 2, "worker never rejoined"
+        # the hook ran before re-admission: the fresh worker already holds
+        # the current version, bit-identical
+        dbg = bc.driver.dispatch_objects(
+            [("weights_debug", {}), ("weights_debug", {})], 60_000
+        )
+        for d in dbg:
+            assert v in d["versions"], (v, d)
+            assert d["checksums"][v] == wb.checksum_tree(bc._bus_lora_np)
+        got = bc.generate(None, lora, ids, mask, GREEDY, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        bc.driver.shutdown()
+
+
+@needs_native
+class TestRemoteTrainerOverBus:
+    @pytest.mark.slow
+    def test_sync_train_round_broadcasts_once_per_version(self, workers):
+        """A real trainer round over the broadcast bus: the step's push is
+        the only adapter transport (dispatches reference it), loss finite,
+        and the workers ack the learner's weight_version."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+        from tests.test_trainer import make_config, make_datasets
+
+        _, addrs = workers
+        cfg = make_config(max_prompt_tokens=P_LEN, max_new_tokens=MAX_NEW)
+        tok = CharTokenizer()
+        train, test = make_datasets()
+        base = init_params(jax.random.PRNGKey(7), TINY)
+        engine = _connect(addrs)
+        sink = MemorySink()
+        trainer = Trainer(
+            train, test, reward_function, cfg,
+            tokenizer=tok, engine=engine, base_params=base, model_cfg=TINY,
+            sink=sink,
+        )
+        # construction pushed v0 (the _push_weights in __init__)
+        assert engine.bus.flush(timeout_s=120)
+        assert engine.bus.last_acked_version == 0
+        batch = {"problem": train["problem"][:4],
+                 "solution": train["solution"][:4]}
+        trainer._train_batch(batch, episode=0)
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and np.isfinite(recs[-1]["loss"])
+        assert trainer.weight_version == 1
+        assert engine.bus.flush(timeout_s=120)
+        assert engine.bus.last_acked_version == 1
+        engine.driver.shutdown()
+
+    @pytest.mark.slow
+    def test_async_training_swaps_inflight_over_workers(self):
+        """The fixed silent no-op, end to end: remote rollout with
+        inflight_weight_updates genuinely updates worker weights mid-round
+        — worker swap logs flow back through the bus-aware engine, and the
+        trainer's trajectory version tags record more than one policy
+        version (mirrors test_inflight_updates'
+        test_async_training_pushes_inflight over a real 2-worker plane)."""
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.rewards import reward_function
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+
+        procs, addrs = [], []
+        for _ in range(2):
+            # long rounds (24 tokens) at 2-step dispatch granularity: the
+            # mailbox is polled ~12× per round, so a push overlapping ANY
+            # part of a round lands mid-round instead of at a boundary
+            p, port = spawn_worker(
+                capture_logprobs=True, max_new=24, decode_chunk=2
+            )
+            procs.append(p)
+            addrs.append(("127.0.0.1", port))
+        try:
+            tok = CharTokenizer()
+            cfg = TrainConfig(
+                model="tiny", episodes=2, batch_size=4, num_candidates=2,
+                topk=2, train_batch_size=4, max_prompt_tokens=P_LEN,
+                max_new_tokens=24, number_of_actors=1,
+                number_of_learners=1, learner_chunk_size=0,
+                metrics_backend="null", max_lora_rank=4, lora_alpha=8.0,
+                learner="grpo", clip_ratio=0.2, async_rollout=True,
+                inflight_weight_updates=True, eval_every=0,
+                workers_capture_logprobs=True,
+            )
+            base = init_params(jax.random.PRNGKey(7), TINY)
+            engine = connect_remote_engine(
+                addrs, max_prompt_tokens=P_LEN, max_new_tokens=24,
+                timeout_ms=120_000, lora_scale=SCALE,
+                weight_bus="broadcast",
+            )
+            train = {"problem": ["q a", "q b", "q c", "q d",
+                                 "q e", "q f", "q g", "q h"],
+                     "solution": ["A", "B", "C", "D", "E", "F", "G", "H"]}
+            sink = MemorySink()
+            trainer = Trainer(
+                train, dict(train), reward_function, cfg,
+                tokenizer=tok, engine=engine, base_params=base,
+                model_cfg=TINY, sink=sink,
+            )
+            trainer.train()
+            recs = [m for _, m in sink.records if "loss" in m]
+            assert recs and all(np.isfinite(m["loss"]) for m in recs)
+            # ≥ 1 genuine swap landed inside a worker round: the workers'
+            # mailboxes consumed a mid-round push and said so
+            assert engine.last_swap_steps, "no remote in-flight swap happened"
+            versions = [v for v in engine.last_swap_versions if v is not None]
+            assert versions and max(versions) >= 1
+            engine.driver.shutdown()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)

@@ -57,6 +57,12 @@ stage "spec_smoke" env JAX_PLATFORMS=cpu \
 # assert exactly one incident bundle with the expected manifest
 stage "obs_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/obs_smoke.py
+# weight-bus gate (ISSUE 9): broadcast-bus tiny train byte-identical to the
+# dispatch-transport golden (losses + adapter), per-dispatch payload shed
+# >= the serialized adapter, and a seeded mid-run worker kill/rejoin whose
+# full-resync converges both version caches bit-identically
+stage "weight_bus_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/weight_bus_smoke.py
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one
@@ -88,7 +94,7 @@ stage "suite_ops" timeout 600 python -m pytest -q \
 stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
   tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
-  tests/test_telemetry.py tests/test_obs.py
+  tests/test_telemetry.py tests/test_obs.py tests/test_weight_bus.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \
@@ -113,7 +119,7 @@ stage "suite_slow_ops" timeout 1200 python -m pytest -q -m slow \
 stage "suite_slow_io" timeout 1200 python -m pytest -q -m slow \
   tests/test_from_pretrained.py tests/test_real_checkpoint.py \
   tests/test_remote_engine.py tests/test_control_plane.py \
-  tests/test_model_golden.py
+  tests/test_model_golden.py tests/test_weight_bus.py
 
 echo "done: $fails failure(s)"
 exit $((fails > 0))

@@ -237,6 +237,17 @@ bench dense_int8_mw /tmp/bench_tpu_dense_int8_mw.json BENCH_KV_QUANT=int8 BENCH_
 bench waves_eos /tmp/bench_tpu_waves_eos.json \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128
 bench dense_eos /tmp/bench_tpu_dense_eos.json BENCH_EOS_RATE=0.002
+# weight-bus dispatch-vs-broadcast A/B (ISSUE 9): the 2-worker smoke runs
+# BOTH transports over real control-plane frames and writes the measured
+# payload shed + bytes/version + push→last-ack latency as one JSON record
+# (byte-identity of losses is asserted inside) — the payload win lands in
+# the next BENCH round's artifact set
+run_stage weight_bus_ab 1200 bash -c \
+  'python tools/weight_bus_smoke.py \
+     --report-json /tmp/weight_bus_ab.json \
+     > /tmp/weight_bus_ab.log 2>&1; rc=$?;
+   tail -3 /tmp/weight_bus_ab.log; cat /tmp/weight_bus_ab.json 2>/dev/null;
+   echo; exit $rc'
 run_stage dispatch_probe 300 bash -c \
   'python tools/dispatch_probe.py 64 > /tmp/dispatch_probe.log 2>&1; rc=$?;
    cat /tmp/dispatch_probe.log; exit $rc'
@@ -255,7 +266,7 @@ all_done() {
            step_anatomy learner_anatomy \
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \
-           paged_blocked \
+           paged_blocked weight_bus_ab \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

@@ -103,6 +103,47 @@ def resilience_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
     return lines
 
 
+def weight_bus_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
+    """Versioned weight-bus summary (ISSUE 9) from the driver's
+    ``cp/weight_push`` spans (one per worker per version, args: worker=,
+    version=, bytes=, mode=delta|full; dur = push→ack): total bytes and
+    bytes/version, the delta-vs-full ratio (how often the codec actually
+    saved wire), and per-worker push counts with mean ack latency. Empty
+    when the run never broadcast (dispatch-mode or local rollout)."""
+    pushes = [
+        e for (_pid, name), evs in spans.items()
+        if name == "cp/weight_push" for e in evs
+    ]
+    if not pushes:
+        return []
+    versions = {int(e.get("args", {}).get("version", -1)) for e in pushes}
+    total_bytes = sum(int(e.get("args", {}).get("bytes", 0)) for e in pushes)
+    delta = sum(
+        1 for e in pushes if e.get("args", {}).get("mode") == "delta"
+    )
+    full = len(pushes) - delta
+    per: dict[str, list[dict]] = defaultdict(list)
+    for e in pushes:
+        per[str(e.get("args", {}).get("worker", "?"))].append(e)
+    lines = ["weight bus:"]
+    lines.append(
+        f"  versions pushed:    {len(versions)} ({len(pushes)} worker-"
+        f"pushes: delta ×{delta} / full ×{full}), "
+        f"{total_bytes / 2**20:.2f} MiB total "
+        f"({total_bytes / max(len(versions), 1) / 2**20:.2f} MiB/version)"
+    )
+    for worker in sorted(per):
+        evs = per[worker]
+        wbytes = sum(int(e.get("args", {}).get("bytes", 0)) for e in evs)
+        ack_ms = sum(e.get("dur", 0) for e in evs) / len(evs) / 1e3
+        lines.append(
+            f"  {worker:<24} pushes {len(evs)} / "
+            f"{wbytes / 2**20:.2f} MiB / mean ack {ack_ms:.1f} ms"
+        )
+    lines.append("")
+    return lines
+
+
 def rollout_section(events: list[dict],
                     spans: dict[tuple[int, str], list[dict]]) -> list[str]:
     """Async-rollout diagnosis from one trace: buffer occupancy over time
@@ -320,6 +361,7 @@ def build_report(events: list[dict], metadata: dict,
         return None
 
     lines.extend(resilience_section(spans))
+    lines.extend(weight_bus_section(spans))
     lines.extend(rollout_section(events, spans))
     lines.extend(spec_section(spans))
 

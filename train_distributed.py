@@ -173,6 +173,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(host:port,...) to dispatch generation to; start "
                         "them with python -m "
                         "distrl_llm_tpu.distributed.worker_main --serve-model")
+    p.add_argument("--weight_bus", type=str, default="broadcast",
+                   choices=["broadcast", "dispatch"],
+                   help="learner→worker weight transport for "
+                        "--rollout_workers: 'broadcast' ships each "
+                        "optimizer step's adapter once per version over an "
+                        "out-of-band delta-encoded push (dispatches carry "
+                        "only a version reference; enables "
+                        "--inflight_weight_updates over workers); "
+                        "'dispatch' is the legacy full-adapter-per-payload "
+                        "fallback")
     p.add_argument("--worker_rejoin", type=str, default="on",
                    choices=["on", "off"],
                    help="background reconnect loop for --rollout_workers: "
