@@ -287,15 +287,71 @@ def _recompile_count() -> int:
 
 
 def _fleet_tok_s():
-    """Fleet-aggregate tok/s gauge when a control-plane fleet published
-    one in this process (obs.FleetAggregator). Today NO bench mode builds
-    a control plane, so every row records null — the field is the schema
-    slot the ROADMAP-item-4 fleet bench rows will fill (and the trainer's
-    train-curve records already can, via the shared registry), kept here
-    so the two artifact families stay join-able."""
+    """Fleet-aggregate tok/s gauge when a control-plane fleet published one
+    in this process (obs.FleetAggregator). Local rows record null (bench
+    drives the engine directly — no fleet exists); BENCH_WORKERS=N rows
+    (ISSUE 10 satellite: the reserved slot PR 8 left schema-only) run the
+    SAME rollout volume through N control-plane worker processes and fold
+    the FleetAggregator's deltas in, so the gauge is populated from the
+    workers' piggybacked obs/gen_tokens counters."""
     from distrl_llm_tpu import obs, telemetry
 
     return telemetry.observe_snapshot()["gauges"].get(obs.FLEET_TOK_S)
+
+
+def _spawn_fleet(n: int, serve_model: str, max_prompt: int, max_new: int,
+                 lora_rank: int, eos_ids, timeout_ms: int):
+    """BENCH_WORKERS mode: N control-plane worker processes (obs export on,
+    so their registry snapshots piggyback on results and feed the driver's
+    FleetAggregator) wrapped as a RemoteEngine. Returns (engine, aggregator,
+    procs); an atexit hook SIGKILLs leaked workers so an aborted bench
+    never strands children."""
+    import atexit
+    import signal
+    import subprocess
+
+    from distrl_llm_tpu.distributed import connect_remote_engine
+    from distrl_llm_tpu.models.lora import lora_scale
+    from distrl_llm_tpu.obs import FleetAggregator
+
+    procs, addrs = [], []
+
+    def _reap():
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+
+    # registered BEFORE the first spawn (closing over the filling list): a
+    # worker that fails or hangs at startup must not strand its
+    # already-started siblings past the bench process
+    atexit.register(_reap)
+    for _ in range(n):
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "distrl_llm_tpu.distributed.worker_main",
+                "--port", "0", "--serve-model", serve_model,
+                "--max-prompt-tokens", str(max_prompt),
+                "--max-new-tokens", str(max_new),
+                "--seed", "0", "--lora-rank", str(lora_rank),
+                "--lora-alpha", "16",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env={**os.environ, "DISTRL_OBS": "1"},
+        )
+        procs.append(proc)  # in the reaper's sight before any wait
+        line = proc.stdout.readline().strip()
+        if not line.startswith("PORT "):
+            raise RuntimeError(f"bench worker failed to start: {line!r}")
+        addrs.append(("127.0.0.1", int(line.split()[1])))
+    engine = connect_remote_engine(
+        addrs, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
+        timeout_ms=timeout_ms,
+        lora_scale=lora_scale(lora_rank, 16.0),
+        eos_token_ids=[int(e) for e in eos_ids],
+        weight_bus=os.environ.get("BENCH_WEIGHT_BUS", "dispatch"),
+    )
+    return engine, FleetAggregator(engine.driver), procs
 
 
 def _attn_fallback_fired(attn_impl: str) -> bool:
@@ -723,18 +779,47 @@ def main() -> int:
         eos_ids = eos_rng.choice(cfg.vocab_size, size=n_eos, replace=False).tolist()
     else:
         eos_ids = [151645 % cfg.vocab_size]
-    engine = engine_cls(
-        cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
-        eos_token_ids=eos_ids, pad_token_id=151643 % cfg.vocab_size,
-        prompt_buckets=buckets or None,
-        **engine_kwargs,
-    )
+    # BENCH_WORKERS=N (ISSUE 10 satellite): run the same rollout volume
+    # through N control-plane worker processes instead of a local engine —
+    # the fleet row that finally populates the reserved fleet_tok_s slot
+    # (and the weight-bus provenance fields) from real FleetAggregator
+    # deltas. Workers serve their own engines, so the local engine-plan
+    # introspection fields honestly read null on these rows.
+    fleet_n = int(os.environ.get("BENCH_WORKERS", "0"))
+    fleet_agg = None
+    fleet_procs: list = []
+    if fleet_n > 0:
+        serve_model = os.environ.get(
+            "BENCH_WORKER_MODEL", name if name == "tiny" else ""
+        )
+        if not serve_model:
+            _emit({
+                "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+                "unit": "tok/s/chip", "vs_baseline": 0.0,
+                "error": "BENCH_WORKERS needs BENCH_WORKER_MODEL (a local "
+                         "checkpoint path, or 'tiny') for non-tiny models",
+                "backend": jax.devices()[0].platform,
+            })
+            return 1
+        engine, fleet_agg, fleet_procs = _spawn_fleet(
+            fleet_n, serve_model, max_prompt, max_new, lora_rank, eos_ids,
+            timeout_ms=int(os.environ.get("BENCH_RPC_TIMEOUT_MS", "240000")),
+        )
+    else:
+        engine = engine_cls(
+            cfg, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
+            eos_token_ids=eos_ids, pad_token_id=151643 % cfg.vocab_size,
+            prompt_buckets=buckets or None,
+            **engine_kwargs,
+        )
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, min(cfg.vocab_size, 50000), size=(n_prompts, max_prompt)).astype(np.int32)
     pmask = np.ones_like(prompts)
     n_short = int(round(n_prompts * min(max(short_fraction, 0.0), 1.0)))
     pmask[:n_short, : max_prompt // 2] = 0
-    prompts[:n_short, : max_prompt // 2] = engine.pad_id
+    prompts[:n_short, : max_prompt // 2] = getattr(
+        engine, "pad_id", 151643 % cfg.vocab_size
+    )
     top_p_impl = os.environ.get("BENCH_TOP_P_IMPL")  # e.g. "bisect_mw"
     if top_p_impl:
         from distrl_llm_tpu.ops.sampling import TOP_P_IMPLS
@@ -770,6 +855,11 @@ def main() -> int:
     # recompile_count field must describe THIS config's programs only
     importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
+    if fleet_agg is not None:
+        # first refresh sets the per-worker (ts, gen_tokens) marks off the
+        # warmup round's piggybacked snapshots; the post-timing refresh
+        # then yields an honest tokens/s delta over the timed window
+        fleet_agg.refresh(force=True)
     # BENCH_REPEATS > 1 (the pinned fallback sets 3): sum tokens over N
     # timed runs so sub-second CPU measurements aren't dominated by
     # single-run jitter
@@ -804,6 +894,10 @@ def main() -> int:
             spec_grid_rounds += 1
     steps_dispatched = sum_steps if have_steps else None
     alive_slot_steps = sum_alive if have_alive else None
+    if fleet_agg is not None:
+        # fold the timed window's per-worker token deltas into the fleet/*
+        # gauges — _fleet_tok_s() below reads the published aggregate
+        fleet_agg.refresh(force=True)
     dt = sum(timed)
     tps = total_tokens / dt
     n_chips = max(jax.device_count(), 1)
@@ -821,7 +915,7 @@ def main() -> int:
     # when the row cap is exceeded (otherwise generate() falls through to a
     # single wave) — recording the requested value would let an A/B
     # comparison attribute wave-mode throughput to "refill"
-    if os.environ.get("BENCH_ENGINE") == "paged":
+    if os.environ.get("BENCH_ENGINE") == "paged" and not fleet_n:
         # read the dispatch decision off the ENGINE (same condition as
         # PagedGenerationEngine.generate) so the record can't drift from it
         engaged = (
@@ -847,7 +941,7 @@ def main() -> int:
         accept_rate = round(total_tokens / alive_slot_steps, 3)
     elif steps_dispatched:
         slots = min(
-            engine.max_concurrent_rows or n_prompts * n_cand,
+            getattr(engine, "max_concurrent_rows", 0) or n_prompts * n_cand,
             n_prompts * n_cand,
         )
         accept_rate = round(
@@ -858,7 +952,8 @@ def main() -> int:
     # pct_of_roofline stays a step-rate comparison
     hbm_gbps = float(os.environ.get("BENCH_HBM_GBPS", "819"))
     slot_rows = min(
-        engine.max_concurrent_rows or n_prompts * n_cand, n_prompts * n_cand
+        getattr(engine, "max_concurrent_rows", 0) or n_prompts * n_cand,
+        n_prompts * n_cand,
     )
     from distrl_llm_tpu.engine.budget import tree_bytes
 
@@ -874,7 +969,8 @@ def main() -> int:
     # carries non-attention work too), but it pins which regime a row is in
     grid_per_call = (
         _paged_grid_steps_per_call(engine, cfg, slot_rows)
-        if os.environ.get("BENCH_ENGINE") == "paged" else None
+        if os.environ.get("BENCH_ENGINE") == "paged" and not fleet_n
+        else None
     )
     # speculative grid model (ISSUE 6): with the FUSED verify kernel the
     # whole (d+1)-token verify costs ONE blocked sweep per layer per step
@@ -957,7 +1053,11 @@ def main() -> int:
         "max_prompt_tokens": max_prompt,
         "max_new_tokens": max_new,
         "device_kind": _device_kind(),
-        "bucket_used": engine.bucket_for(pmask),
+        # fleet rows: workers bucket their own shards — no local bucket
+        "bucket_used": (
+            engine.bucket_for(pmask) if hasattr(engine, "bucket_for")
+            else None
+        ),
         "short_fraction": round(short_fraction, 3),
         "value": round(tps_chip, 1),
         "unit": "tok/s/chip",
@@ -1035,24 +1135,34 @@ def main() -> int:
         # tests/test_bench_contract.py): device HBM watermark (null on
         # backends without memory stats), shape-keyed retrace count since
         # the pre-warmup tracker reset (0 = no silent retrace storm), and
-        # the fleet-aggregate tok/s gauge when a control-plane fleet
-        # published one (null on single-process rows — bench drives the
-        # engine directly)
+        # the fleet-aggregate tok/s gauge — null on single-process rows
+        # (bench drives the engine directly), POPULATED on BENCH_WORKERS
+        # rows from the FleetAggregator's per-worker token deltas over the
+        # timed window (ISSUE 10 satellite: the slot PR 8 reserved)
         "hbm_peak_bytes": _hbm_peak_bytes(),
         "recompile_count": _recompile_count(),
         "fleet_tok_s": _fleet_tok_s(),
+        "fleet_workers": fleet_n,
         # weight-bus provenance (ISSUE 9, pinned in
         # tests/test_bench_contract.py): which learner→worker weight
-        # transport a fleet row ran under ("dispatch" | "broadcast"), the
-        # bytes one adapter update put on the wire, and the learner-push →
-        # last-worker-ack latency. Bench drives a LOCAL engine — no
-        # control-plane weight transport is exercised — so these are the
-        # reserved null slots the dispatch-vs-broadcast A/B
-        # (tools/weight_bus_smoke.py --bench, staged by tpu_bench_loop.sh)
-        # and future fleet rows populate
-        "weight_bus": None,
-        "weight_bytes_per_update": None,
-        "weight_sync_ms": None,
+        # transport the row ran under ("dispatch" | "broadcast"; null =
+        # local engine, no control-plane transport exercised), the bytes
+        # one adapter update put on the wire, and the learner-push →
+        # last-worker-ack latency (broadcast rows only — dispatch re-ships
+        # the adapter per payload, there is no per-version push to time)
+        "weight_bus": (
+            getattr(engine, "weight_bus_mode", None) if fleet_n else None
+        ),
+        "weight_bytes_per_update": (
+            engine.bus.last_broadcast_bytes
+            if fleet_n and getattr(engine, "bus", None) is not None
+            else None
+        ),
+        "weight_sync_ms": (
+            engine.bus.last_broadcast_ms
+            if fleet_n and getattr(engine, "bus", None) is not None
+            else None
+        ),
         "baseline_note": "baseline 1500 tok/s/GPU derived from reference's ~2h/100-step "
                          "Qwen2.5-7B-4bit runs on RTX 4090s (BASELINE.md); this run's "
                          "model is recorded in 'model'",
@@ -1068,6 +1178,20 @@ def main() -> int:
         )
         record["vs_baseline"] = 0.0
     _emit(record)
+    if fleet_procs:
+        # graceful fleet teardown (the atexit hook only covers aborts);
+        # the record is already emitted — a worker slow to drain must not
+        # turn a valid measurement into a nonzero exit
+        import signal as _signal
+        import subprocess as _subprocess
+
+        engine.driver.shutdown()
+        for p in fleet_procs:
+            try:
+                p.wait(timeout=15)
+            except _subprocess.TimeoutExpired:
+                p.send_signal(_signal.SIGKILL)
+                p.wait(timeout=5)
     return 0
 
 

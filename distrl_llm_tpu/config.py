@@ -442,6 +442,23 @@ class TrainConfig:
     # telemetry span tail, and config/plan snapshot.
     flight_recorder_dir: str | None = None
     obs_ring_size: int = 256
+    # --- trajectory lineage ledger (distrl_llm_tpu/lineage.py, ISSUE 10) --
+    # Follow every sampled group from prompt through the buffer into the
+    # optimizer step that consumed it and out as a broadcast weight version:
+    # per-group LineageRecords (sampling worker + causal dispatch_id, weight
+    # versions, buffer passage, staleness verdict, consuming step) plus the
+    # derived lag histograms (lineage/sample_to_learn_ms,
+    # lineage/learn_to_act_ms, lineage/policy_lag_ms) on the registry /
+    # metrics endpoint. Async-mode only (the sync loop has no buffer or
+    # staleness machinery to trace). One attribute check per hook site when
+    # off. lineage_dir set alone implies lineage=True.
+    lineage: bool = False
+    # per-run JSONL output (lineage_dir/lineage.jsonl, streamed as records
+    # close; tools/lineage_report.py reads it). None = ring only.
+    lineage_dir: str | None = None
+    # bounded ring of OPEN records; overflow is counted
+    # (lineage/ring_evictions), never silent
+    lineage_ring: int = 1024
     # Hang detector on generation rounds — parity with the reference's
     # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
     # default: a first rollout legitimately spends minutes in XLA compilation;
@@ -566,6 +583,20 @@ class TrainConfig:
         if self.obs_ring_size < 1:
             raise ValueError(
                 f"obs_ring_size must be >= 1, got {self.obs_ring_size}"
+            )
+        if self.lineage_dir and not self.lineage:
+            # an output directory is an unambiguous ask — arm the ledger
+            self.lineage = True
+        if self.lineage_ring < 1:
+            raise ValueError(
+                f"lineage_ring must be >= 1, got {self.lineage_ring}"
+            )
+        if self.lineage and self.rollout_mode != "async":
+            raise ValueError(
+                "lineage requires rollout_mode='async' — the ledger traces "
+                "the buffer passage, staleness verdict, and decoupled "
+                "consumption that only exist in the async regime (sync/"
+                "pipelined rounds are consumed by construction)"
             )
         # decode_scan_chunk covers every engine_impl and scheduler (dense,
         # paged wave + refill + speculative, paged_sharded)

@@ -82,6 +82,14 @@ MSG_RESULT_TLM = 7
 # MSG_RESULT with pickle({"version", "checksum"}) as the ack, or MSG_ERROR
 # (checksum mismatch / unknown base → the sender falls back to full-tensor).
 MSG_WEIGHTS = 8
+# DISPATCH with a causal trace context (ISSUE 10): payload is
+# pickle((ctx, payload)) where ctx carries (trace_id, dispatch_id) from
+# telemetry.next_dispatch_context(). The worker binds it for the handler's
+# duration, so every span it records — and ships home via MSG_RESULT_TLM —
+# names the driver dispatch that caused it, and the merged Perfetto trace
+# renders one causally linked timeline per round. Only sent while the
+# driver is TRACING; untraced runs keep the plain MSG_DISPATCH frame.
+MSG_DISPATCH_CTX = 9
 
 
 class WorkerDeadError(RuntimeError):
@@ -258,8 +266,15 @@ class WorkerServer:
                 # (each notices at its next 1s recv timeout)
                 self._stopped = True
                 return True
-            elif msg_type == MSG_DISPATCH:
+            elif msg_type in (MSG_DISPATCH, MSG_DISPATCH_CTX):
+                ctx = None
                 try:
+                    if msg_type == MSG_DISPATCH_CTX:
+                        # causal trace context (ISSUE 10): bound for the
+                        # handler's duration so every span it records names
+                        # the originating driver dispatch
+                        ctx, payload = pickle.loads(payload)
+                        telemetry.bind_trace_context(ctx)
                     result = handler(payload)
                     # spans the handler recorded ride home on the response
                     # (the worker has no trace file of its own; the driver
@@ -285,6 +300,9 @@ class WorkerServer:
                     conn.send(
                         MSG_ERROR, req_id, traceback.format_exc().encode()
                     )
+                finally:
+                    if ctx is not None:
+                        telemetry.unbind_trace_context()
             elif msg_type == MSG_WEIGHTS:
                 # weight-bus push (ISSUE 9): runs on THIS connection's
                 # thread, concurrent with any dispatch in flight — the
@@ -358,6 +376,11 @@ class DriverClient:
         # shutdown() runs these before closing connections (the weight bus
         # parks its sender thread and channels here)
         self.shutdown_hooks: list[Callable[[], None]] = []
+        # per-shard dispatch metadata of the LAST dispatch_round, aligned
+        # with its shards ({worker, dispatch_id} per slot; None for a slot
+        # that never completed) — RemoteEngine folds it into lineage
+        # records (ISSUE 10). Written once per round on the calling thread.
+        self.last_dispatch_meta: list[dict | None] = []
         for host, port in addresses:
             fd = self._lib.cp_connect(host.encode(), port, connect_timeout_ms)
             if fd < 0:
@@ -559,16 +582,35 @@ class DriverClient:
         telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
         return out
 
-    def _call(self, w: _Worker, payload: bytes, timeout_ms: int) -> bytes:
+    def _call(self, w: _Worker, payload: bytes,
+              timeout_ms: int) -> tuple[bytes, dict]:
+        """One dispatch RPC. Returns (result bytes, dispatch meta) — the
+        meta names the worker and the causal ``dispatch_id`` stamped on the
+        frame (telemetry.next_dispatch_context), the handle the lineage
+        ledger records per sampled group (ISSUE 10)."""
         rid = self._next_id()
         host, port = w.address
+        # dispatch id: always allocated (a counter bump) so lineage works
+        # with tracing off; the ctx ENVELOPE only ships while tracing is on
+        ctx = telemetry.next_dispatch_context()
+        meta = {"worker": f"{host}:{port}",
+                "dispatch_id": ctx["dispatch_id"]}
         with telemetry.span("cp/dispatch", worker=f"{host}:{port}",
-                            bytes=len(payload)):
+                            bytes=len(payload),
+                            dispatch_id=ctx["dispatch_id"],
+                            trace_id=ctx["trace_id"]):
             t0 = time.perf_counter()
             # frame-size accounting (ISSUE 9): the dispatch-vs-broadcast
-            # payload win is asserted from this counter
+            # payload win is asserted from this counter (the inner payload;
+            # the ~100-byte traced-run ctx envelope is not dispatch data)
             telemetry.counter_add(resilience.CP_DISPATCH_BYTES, len(payload))
-            w.conn.send(MSG_DISPATCH, rid, payload)
+            if telemetry.enabled():
+                telemetry.emit_flow_start(ctx["dispatch_id"])
+                w.conn.send(
+                    MSG_DISPATCH_CTX, rid, pickle.dumps((ctx, payload))
+                )
+            else:
+                w.conn.send(MSG_DISPATCH, rid, payload)
             frame = w.conn.recv(timeout_ms)
         if frame is None:
             raise WorkerDeadError(
@@ -594,10 +636,10 @@ class DriverClient:
         telemetry.hist_observe(
             "cp/rpc_dispatch_ms", (time.perf_counter() - t0) * 1e3
         )
-        return body
+        return body, meta
 
     def _call_with_retry(self, w: _Worker, payload: bytes,
-                         timeout_ms: int) -> bytes:
+                         timeout_ms: int) -> tuple[bytes, dict]:
         """``_call`` plus the policy's bounded transient-error retry: a
         worker-side exception classified transient retries on the SAME
         worker (it answered — it is alive) with seeded backoff, within the
@@ -667,6 +709,9 @@ class DriverClient:
         from concurrent.futures import ThreadPoolExecutor
 
         results: list[bytes | None] = [None] * len(shards)
+        # dispatch meta per shard slot (worker + causal dispatch_id of the
+        # call that SUCCEEDED), published as last_dispatch_meta at exit
+        meta: list[dict | None] = [None] * len(shards)
         # poison tracking: which DISTINCT workers failed each shard, and
         # its total failed attempts (mutated on the main thread only)
         shard_workers: dict[int, set] = {}
@@ -724,7 +769,7 @@ class DriverClient:
                 host, port = w.address
                 for pos, i in enumerate(idxs):
                     try:
-                        results[i] = self._call_with_retry(
+                        results[i], meta[i] = self._call_with_retry(
                             w, shards[i], timeout_ms
                         )
                     except WorkerDeadError as e:
@@ -808,6 +853,7 @@ class DriverClient:
                         quarantined.add(i)
                     else:
                         pending.append(i)
+        self.last_dispatch_meta = meta
         if allow_partial:
             return [
                 None if i in quarantined else results[i]

@@ -94,6 +94,12 @@ class RemoteEngine:
         # contract): remote rounds have no local prefill/decode split, so
         # the whole RPC fan-out is accounted as decode time
         self.last_round_stats: dict | None = None
+        # per-shard sampling provenance of the LAST round (ISSUE 10): one
+        # {rows: (start, end), worker, dispatch_id} per shard, from the
+        # DriverClient's dispatch meta — the lineage ledger maps each
+        # trajectory group's prompt row back to the worker + causal
+        # dispatch that sampled it
+        self.last_shard_meta: list[dict] = []
         # --- versioned weight bus (ISSUE 9) ----------------------------
         if weight_bus not in ("dispatch", "broadcast"):
             raise ValueError(
@@ -310,6 +316,20 @@ class RemoteEngine:
                 shards, timeout_ms=timeout,
                 allow_partial=self.degrade_on_shard_failure,
             )
+            # sampling provenance per shard (lineage, ISSUE 10): the
+            # DriverClient recorded which worker answered each shard and
+            # the causal dispatch_id stamped on that frame
+            dmeta = getattr(self.driver, "last_dispatch_meta", None) or []
+            self.last_shard_meta = []
+            row0 = 0
+            for i, size in enumerate(sizes):
+                m = dmeta[i] if i < len(dmeta) else None
+                self.last_shard_meta.append({
+                    "rows": (row0, row0 + size),
+                    "worker": m.get("worker") if m else None,
+                    "dispatch_id": m.get("dispatch_id") if m else None,
+                })
+                row0 += size
             # worker-recorded in-flight swap events (broadcast bus) fold
             # into the engine-lifetime swap log BEFORE zero-filling — a
             # quarantined shard contributes no events

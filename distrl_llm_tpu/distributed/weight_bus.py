@@ -391,6 +391,18 @@ class WeightBus:
         # workers), for the bench/smoke artifacts
         self.last_broadcast_bytes = 0
         self.last_broadcast_ms: float | None = None
+        # per-worker ack latency of the most recent broadcast ("host:port"
+        # -> ms, acked workers only) — the lineage ledger's broadcast leg
+        self.last_ack_ms: dict[str, float] = {}
+        # on_broadcast(version, total_ms, acks_ms, complete) runs after
+        # every broadcast attempt on the sender thread (exceptions
+        # swallowed), and again — complete=True — when a rejoin/re-request
+        # resync finishes a broadcast a death interrupted: the lineage
+        # ledger closes its policy-lag loop only on complete=True, so the
+        # all-workers-acked metric never lies about a partial push
+        self.on_broadcast: (
+            Callable[[int, float | None, dict, bool], None] | None
+        ) = None
         self._sender = threading.Thread(
             target=self._sender_loop, name="cp-weight-bus", daemon=True
         )
@@ -486,19 +498,26 @@ class WeightBus:
         t0 = time.perf_counter()
         total = 0
         oks: list[bool] = []
+        acks: dict[str, float] = {}
+
+        def timed_push(a):
+            tw = time.perf_counter()
+            ok, nbytes = self._push_worker(a, tree_np, version)
+            return a, ok, nbytes, (time.perf_counter() - tw) * 1e3
+
         with ThreadPoolExecutor(
             max_workers=max(len(self._addresses), 1),
             thread_name_prefix="cp-weight-push",
         ) as pool:
-            futs = [
-                pool.submit(self._push_worker, a, tree_np, version)
-                for a in self._addresses
-            ]
+            futs = [pool.submit(timed_push, a) for a in self._addresses]
             for f in futs:
-                ok, nbytes = f.result()
+                a, ok, nbytes, ack_ms = f.result()
                 oks.append(ok)
                 total += nbytes
+                if ok:
+                    acks[f"{a[0]}:{a[1]}"] = ack_ms
         self.last_broadcast_bytes = total
+        self.last_ack_ms = acks
         ms = (time.perf_counter() - t0) * 1e3
         self.last_broadcast_ms = ms
         telemetry.hist_observe(resilience.CP_WEIGHT_BROADCAST_MS, ms)
@@ -512,6 +531,17 @@ class WeightBus:
             self.last_acked_version = int(version)
         else:
             self._refresh_acked()
+        self._notify_broadcast(version, ms, acks, bool(oks) and all(oks))
+
+    def _notify_broadcast(self, version: int, ms: float | None,
+                          acks: dict, complete: bool) -> None:
+        hook = self.on_broadcast
+        if hook is not None:
+            try:
+                hook(int(version), ms, dict(acks), complete)
+            except Exception:  # noqa: BLE001 — lineage bookkeeping must
+                # never take the sender thread down with it
+                log.warning("on_broadcast hook failed", exc_info=True)
 
     def _push_worker(
         self, address: tuple, tree_np, version: int,
@@ -538,6 +568,13 @@ class WeightBus:
                     prev_tree=base[1] if base else None,
                     base_version=base[0] if base else None,
                 )
+                # causal trace context (ISSUE 10): while tracing, the push
+                # frame names the driver span that caused it, so the
+                # worker's worker/weights span links back across tracks
+                ctx = None
+                if telemetry.enabled():
+                    ctx = telemetry.next_dispatch_context()
+                    payload["trace_ctx"] = ctx
                 frame = serialize_update(payload)
                 mode = "delta" if payload["base_version"] is not None else "full"
                 rid = self._next_id()
@@ -545,7 +582,10 @@ class WeightBus:
                     with telemetry.span(
                         WEIGHT_PUSH_SPAN, worker=f"{host}:{port}",
                         version=int(version), bytes=len(frame), mode=mode,
+                        **({"dispatch_id": ctx["dispatch_id"]} if ctx else {}),
                     ):
+                        if ctx is not None:
+                            telemetry.emit_flow_start(ctx["dispatch_id"])
                         conn = self._channel(tuple(address))
                         conn.send(
                             MSG_WEIGHTS, rid, frame,
@@ -659,6 +699,11 @@ class WeightBus:
         )
         if ok:
             self._refresh_acked()
+            if self.last_acked_version == int(version):
+                # this resync completed a broadcast a death interrupted:
+                # EVERY worker now holds the version — tell the ledger so
+                # the policy-lag loop closes at the true all-acked time
+                self._notify_broadcast(int(version), None, {}, True)
             with self._done:
                 self._done.notify_all()
         return ok

@@ -210,25 +210,38 @@ def weights_handler(payload: bytes) -> bytes:
             f"which this worker does not hold (cache: {cache.versions()}) — "
             "WeightVersionError: send full"
         )
-    with telemetry.span("worker/weights", version=int(msg.get("version", -1)),
-                        delta=bool(base_version is not None)):
-        version, tree = decode_update(msg, prev)  # checksum-verified
-        engine = _ENGINE_STATE.get("engine")
-        if engine is not None:
-            import jax.numpy as jnp
-            import jax
+    # causal trace context (ISSUE 10): a traced driver stamps its push
+    # frames, so this worker's weights span links back to the originating
+    # cp/weight_push span in the merged timeline
+    ctx = msg.get("trace_ctx")
+    if ctx is not None:
+        telemetry.bind_trace_context(ctx)
+    try:
+        with telemetry.span(
+            "worker/weights", version=int(msg.get("version", -1)),
+            delta=bool(base_version is not None),
+        ):
+            version, tree = decode_update(msg, prev)  # checksum-verified
+            engine = _ENGINE_STATE.get("engine")
+            if engine is not None:
+                import jax.numpy as jnp
+                import jax
 
-            # in-flight swap: the round currently running (if any) consumes
-            # this at its next decode dispatch; between rounds, the stale-
-            # pending guard at generate entry clears it. Mailbox BEFORE
-            # cache: the cache is the gate a version-naming dispatch waits
-            # on, so ordering guarantees the pending entry is visible to
-            # that dispatch's entry guard — a put-first order would let the
-            # dispatch start and then replay this push as a phantom swap
-            engine.push_lora(
-                jax.tree_util.tree_map(jnp.asarray, tree), version=version
-            )
-        cache.put(version, tree)
+                # in-flight swap: the round currently running (if any)
+                # consumes this at its next decode dispatch; between
+                # rounds, the stale-pending guard at generate entry clears
+                # it. Mailbox BEFORE cache: the cache is the gate a
+                # version-naming dispatch waits on, so ordering guarantees
+                # the pending entry is visible to that dispatch's entry
+                # guard — a put-first order would let the dispatch start
+                # and then replay this push as a phantom swap
+                engine.push_lora(
+                    jax.tree_util.tree_map(jnp.asarray, tree), version=version
+                )
+            cache.put(version, tree)
+    finally:
+        if ctx is not None:
+            telemetry.unbind_trace_context()
     return pickle.dumps({"version": version, "checksum": msg["checksum"]})
 
 

@@ -1,0 +1,449 @@
+"""Trajectory lineage ledger: the causal record that follows one sampled
+group from prompt to parameter update and back out as a broadcast weight
+version (ISSUE 10).
+
+The async stack decoupled generation from learning (PR 4) and ships weights
+over a versioned broadcast bus (PR 9), which makes *policy lag* — how stale
+the behavior policy is relative to the learner, and how long a sampled token
+takes to influence the next weight version — the system's central quantity
+(the metric PipelineRL optimizes with in-flight updates and LlamaRL's AIPO
+correction depends on). The staleness histogram answers "how stale", in
+optimizer steps; nothing answered "where did the time go" or "which
+trajectories trained step N". This module does, with one bounded ring of
+:class:`LineageRecord` entries:
+
+* **Per-group lineage** — prompt/group identity, the sampling worker and
+  causal ``dispatch_id`` (the same id the trace-context propagation stamps
+  on the driver's ``cp/dispatch`` span), the round's base weight version and
+  per-token version bounds (PR 4's swap log), spec drafter/target versions
+  when the worker self-drafts (PR 6), buffer enqueue/dequeue times, the
+  staleness verdict and group weight at admission, and finally the optimizer
+  step that consumed the group plus the weight version it produced.
+* **Per-version weight lineage** — push time, per-worker broadcast-ack
+  latency (PR 9's bus), and the first time any round sampled under the
+  version (measured at that round's completion — an upper bound on when the
+  first token actually decoded under it).
+* **Derived lag histograms** (published through the PR 8 endpoint like every
+  registry series, and as Perfetto counter tracks while tracing):
+  ``lineage/sample_to_learn_ms`` (group sampled → optimizer step consumed
+  it), ``lineage/learn_to_act_ms`` (version pushed → first round sampled
+  under it), and ``lineage/policy_lag_ms`` (group sampled → the version its
+  update produced reached every worker — the full loop).
+
+Cost contract: the ledger only exists when ``--lineage`` armed it; every
+hook site in the hot path is one attribute check when it is None. Closed
+records stream to ``<lineage_dir>/lineage.jsonl`` as they close (one JSON
+object per line, ``kind: "group" | "weights"``) so a crashed run keeps its
+lineage; ``tools/lineage_report.py`` answers "which trajectories trained
+step N and how stale were they" from that file alone.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import asdict, dataclass, field
+from typing import Any, Sequence
+
+from distrl_llm_tpu import telemetry
+
+log = logging.getLogger(__name__)
+
+# ------------------------------------------------------------- series names
+# (schema-pinned in tests/test_lineage.py)
+
+SAMPLE_TO_LEARN_MS = "lineage/sample_to_learn_ms"  # hist: sampled → consumed
+LEARN_TO_ACT_MS = "lineage/learn_to_act_ms"        # hist: pushed → sampled
+POLICY_LAG_MS = "lineage/policy_lag_ms"            # hist: full loop
+LINEAGE_CLOSED = "lineage/records_closed"          # counter
+LINEAGE_OPEN = "lineage/records_open"              # gauge: ring occupancy
+LINEAGE_RING_EVICTIONS = "lineage/ring_evictions"  # counter: unclosed drops
+
+
+@dataclass
+class LineageRecord:
+    """One task group's causal record through the loop. Times are wall-clock
+    ``time.time()`` seconds (shared with the trace's time_ns clock on a
+    host); ``None`` means the stage has not happened (yet)."""
+
+    uid: int
+    episode: int
+    batch_index: int
+    group_index: int
+    problem: str  # truncated preview — identity, not payload
+    n: int
+    # sampling provenance
+    worker: str | None = None          # "host:port" or None (local engine)
+    dispatch_id: int | None = None     # causal id of the generate dispatch
+    base_version: int = 0              # weight version at round entry
+    min_version: int = 0               # oldest version any real token saw
+    max_version: int = 0               # newest version any real token saw
+    swap_events: list = field(default_factory=list)  # [(step, version), ...]
+    spec_drafter_version: int | None = None  # PR 6 self-drafter, when known
+    spec_target_version: int | None = None
+    sampled_ts: float | None = None
+    # buffer passage
+    enqueue_ts: float | None = None
+    dequeue_ts: float | None = None
+    # admission
+    staleness_lag: int | None = None   # stalest-token lag at admission
+    verdict: str | None = None         # admitted | dropped_stale | evicted_*
+    group_weight: float | None = None
+    learner_version_at_admission: int | None = None
+    # consumption
+    consumed_step: int | None = None   # optimizer step this group trained
+    produced_version: int | None = None  # the version that step produced
+    consumed_ts: float | None = None
+    # derived latencies (ms)
+    sample_to_learn_ms: float | None = None
+    policy_lag_ms: float | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d = asdict(self)
+        d["kind"] = "group"
+        return d
+
+
+class LineageLedger:
+    """Bounded per-group lineage ring + per-version weight lineage.
+
+    Thread-safe (producer thread, learner thread, and the weight-bus sender
+    all write); every method is a no-op-cheap dict/deque operation under one
+    lock. ``ring_size`` bounds open records — a record evicted before it
+    closes is counted (``lineage/ring_evictions``), never silent.
+    """
+
+    def __init__(self, ring_size: int = 1024, out_dir: str | None = None):
+        if ring_size < 1:
+            raise ValueError(f"ring_size must be >= 1, got {ring_size}")
+        self.ring_size = int(ring_size)
+        self.out_dir = out_dir
+        self._mu = threading.Lock()
+        self._ring: OrderedDict[int, LineageRecord] = OrderedDict()
+        self._uid = 0
+        self._file = None  # lazily opened <out_dir>/lineage.jsonl
+        # weight-version lineage: version -> {push_ts, ack_ms, acked_ts,
+        # first_sample_ts, learn_to_act_ms, written}
+        self._versions: dict[int, dict[str, Any]] = {}
+        # versions whose policy-lag loop is still open: version ->
+        # [(uid, sampled_ts), ...] (resolved at push / broadcast ack)
+        self._await_act: dict[int, list[tuple[int, float]]] = {}
+        # True when the engine broadcasts over a weight bus: the policy-lag
+        # loop then closes at the LAST WORKER ACK, not at the local push
+        self.expect_acks = False
+        # run totals for reports / smoke assertions
+        self.closed_groups = 0
+        self.admitted = 0
+        self.dropped = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def _write(self, doc: dict[str, Any]) -> None:
+        """Stream one closed record to the JSONL file (lock held)."""
+        if self.out_dir is None:
+            return
+        if self._file is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._file = open(
+                os.path.join(self.out_dir, "lineage.jsonl"), "a"
+            )
+        self._file.write(json.dumps(doc, default=str) + "\n")
+        self._file.flush()
+
+    def _gauge_open_locked(self) -> None:
+        telemetry.gauge_set(LINEAGE_OPEN, float(len(self._ring)))
+
+    def _close_locked(self, rec: LineageRecord) -> None:
+        self._ring.pop(rec.uid, None)
+        self.closed_groups += 1
+        telemetry.counter_add(LINEAGE_CLOSED)
+        self._gauge_open_locked()
+        self._write(rec.to_dict())
+
+    # ------------------------------------------------------------- sampling
+
+    def on_group_sampled(
+        self, traj, *, worker: str | None = None,
+        dispatch_id: int | None = None, ts: float | None = None,
+        spec_drafter_version: int | None = None,
+        spec_target_version: int | None = None,
+    ) -> int:
+        """Open one record for a freshly sampled Trajectory group; stamps
+        ``traj.meta['lineage_uid']`` so the buffer/admission hooks can find
+        it without threading the ledger through their signatures."""
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            self._uid += 1
+            uid = self._uid
+            rec = LineageRecord(
+                uid=uid,
+                episode=int(getattr(traj, "episode", 0)),
+                batch_index=int(getattr(traj, "batch_index", 0)),
+                group_index=uid,
+                problem=str(getattr(traj, "problem", ""))[:80],
+                n=int(getattr(traj, "n", 0)),
+                worker=worker,
+                dispatch_id=dispatch_id,
+                base_version=int(getattr(traj, "produced_version", 0)),
+                min_version=int(traj.min_version),
+                max_version=int(traj.max_version),
+                spec_drafter_version=spec_drafter_version,
+                spec_target_version=spec_target_version,
+                sampled_ts=ts,
+            )
+            self._ring[uid] = rec
+            while len(self._ring) > self.ring_size:
+                # oldest open record falls off the ring — counted, and its
+                # partial lineage still lands in the JSONL
+                _, old = self._ring.popitem(last=False)
+                old.verdict = old.verdict or "evicted_ring"
+                telemetry.counter_add(LINEAGE_RING_EVICTIONS)
+                self._write(old.to_dict())
+            self._gauge_open_locked()
+        traj.meta["lineage_uid"] = uid
+        return uid
+
+    @staticmethod
+    def uid_of(traj) -> int | None:
+        return getattr(traj, "meta", {}).get("lineage_uid")
+
+    def _rec(self, traj_or_uid) -> LineageRecord | None:
+        uid = (
+            traj_or_uid if isinstance(traj_or_uid, int)
+            else self.uid_of(traj_or_uid)
+        )
+        if uid is None:
+            return None
+        return self._ring.get(uid)
+
+    def note_swap_events(self, traj_or_uid, events: Sequence) -> None:
+        with self._mu:
+            rec = self._rec(traj_or_uid)
+            if rec is not None:
+                rec.swap_events = [
+                    (int(s), int(v)) for s, v in events
+                ]
+
+    # --------------------------------------------------------------- buffer
+
+    def on_enqueue(self, traj_or_uid, ts: float | None = None) -> None:
+        with self._mu:
+            rec = self._rec(traj_or_uid)
+            if rec is not None:
+                rec.enqueue_ts = time.time() if ts is None else ts
+
+    def on_dequeue(self, traj_or_uid, ts: float | None = None) -> None:
+        with self._mu:
+            rec = self._rec(traj_or_uid)
+            if rec is not None:
+                rec.dequeue_ts = time.time() if ts is None else ts
+
+    # ------------------------------------------------------------ admission
+
+    def on_admission(
+        self, traj_or_uid, *, learner_version: int, lag: int,
+        verdict: str, weight: float | None = None,
+    ) -> None:
+        """Record the staleness verdict. A terminal verdict (anything but
+        "admitted") closes the record — the group will never train."""
+        with self._mu:
+            rec = self._rec(traj_or_uid)
+            if rec is None:
+                return
+            rec.staleness_lag = int(lag)
+            rec.verdict = verdict
+            rec.group_weight = weight
+            rec.learner_version_at_admission = int(learner_version)
+            if verdict != "admitted":
+                self.dropped += 1
+                self._close_locked(rec)
+            else:
+                self.admitted += 1
+
+    def on_dropped(self, traj_or_uid, reason: str) -> None:
+        """Terminal drop outside admission (buffer staleness eviction)."""
+        with self._mu:
+            rec = self._rec(traj_or_uid)
+            if rec is None:
+                return
+            rec.verdict = reason
+            self.dropped += 1
+            self._close_locked(rec)
+
+    # ---------------------------------------------------------- consumption
+
+    def on_consumed(
+        self, trajs_or_uids: Sequence, *, step: int, produced_version: int,
+        ts: float | None = None,
+    ) -> None:
+        """One optimizer step consumed these groups and produced
+        ``produced_version``. Closes each record (sample→learn measured
+        here); the policy-lag loop stays pending until that version reaches
+        the workers (``on_push`` locally / ``on_broadcast_complete`` over
+        the bus)."""
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            pend = self._await_act.setdefault(int(produced_version), [])
+            for t in trajs_or_uids:
+                rec = self._rec(t)
+                if rec is None:
+                    continue
+                rec.consumed_step = int(step)
+                rec.produced_version = int(produced_version)
+                rec.consumed_ts = ts
+                if rec.sampled_ts is not None:
+                    rec.sample_to_learn_ms = (ts - rec.sampled_ts) * 1e3
+                    telemetry.hist_observe(
+                        SAMPLE_TO_LEARN_MS, rec.sample_to_learn_ms,
+                        trace_sample=True,
+                    )
+                    pend.append((rec.uid, rec.sampled_ts))
+                self._close_locked(rec)
+            # the produced version may already have reached the workers
+            # (push/ack race ahead of this bookkeeping call): resolve the
+            # policy-lag loop retroactively from the recorded timestamps
+            e = self._versions.get(int(produced_version))
+            if e:
+                if self.expect_acks and e.get("acked_ts") is not None:
+                    self._resolve_act_locked(
+                        int(produced_version), e["acked_ts"]
+                    )
+                elif not self.expect_acks and e.get("push_ts") is not None:
+                    self._resolve_act_locked(
+                        int(produced_version), max(e["push_ts"], ts)
+                    )
+
+    # --------------------------------------------------------------- weights
+
+    def _version_entry_locked(self, version: int) -> dict[str, Any]:
+        e = self._versions.setdefault(int(version), {})
+        if len(self._versions) > 4 * self.ring_size:
+            # bound the version table the same way as the ring (a run can
+            # produce one version per step forever); closed entries first
+            for v in sorted(self._versions):
+                if len(self._versions) <= 4 * self.ring_size:
+                    break
+                if v != int(version):
+                    self._flush_version_locked(v)
+                    self._versions.pop(v, None)
+        return e
+
+    def _flush_version_locked(self, version: int) -> None:
+        e = self._versions.get(version)
+        if not e or e.get("written"):
+            return
+        e["written"] = True
+        self._write({
+            "kind": "weights", "version": int(version),
+            "push_ts": e.get("push_ts"),
+            "broadcast_ms": e.get("broadcast_ms"),
+            "ack_ms": e.get("ack_ms"),
+            "learn_to_act_ms": e.get("learn_to_act_ms"),
+        })
+
+    def on_push(self, version: int, ts: float | None = None) -> None:
+        """The learner published ``version`` (local device push or bus
+        enqueue). Without a bus this also closes pending policy-lag loops —
+        the pushed tree IS on the rollout mesh when this returns."""
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            e = self._version_entry_locked(version)
+            e.setdefault("push_ts", ts)
+            if not self.expect_acks:
+                self._resolve_act_locked(version, ts)
+
+    def on_broadcast_complete(
+        self, version: int, total_ms: float | None,
+        acks_ms: dict[str, float], complete: bool = True,
+        ts: float | None = None,
+    ) -> None:
+        """The weight bus attempted a broadcast of ``version`` (per-worker
+        ack latencies from PR 9's push spans). The policy-lag loop closes
+        ONLY when ``complete`` — every worker acked, whether by the
+        broadcast itself or a later rejoin resync (the bus re-notifies
+        then); a partial push must not understate the all-workers-acked
+        metric exactly when a fault occurred."""
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            e = self._version_entry_locked(version)
+            if total_ms is not None:
+                e["broadcast_ms"] = float(total_ms)
+            if acks_ms:
+                merged = dict(e.get("ack_ms") or {})
+                merged.update(
+                    {str(k): float(v) for k, v in acks_ms.items()}
+                )
+                e["ack_ms"] = merged
+            if complete:
+                e["acked_ts"] = ts
+                self._resolve_act_locked(version, ts)
+
+    def _resolve_act_locked(self, version: int, ts: float) -> None:
+        """Close the policy-lag loop for ``version`` AND every older
+        pending version: version k+1 contains k's update, so once k+1 has
+        reached every worker the older loops are genuinely closed too —
+        and a version superseded in the bus's single-slot mailbox (never
+        broadcast itself) would otherwise pend forever."""
+        for v in [v for v in self._await_act if v <= int(version)]:
+            for uid, sampled_ts in self._await_act.pop(v, ()):
+                lag_ms = (ts - sampled_ts) * 1e3
+                telemetry.hist_observe(
+                    POLICY_LAG_MS, lag_ms, trace_sample=True
+                )
+
+    def note_first_sample(self, version: int | None,
+                          ts: float | None = None) -> None:
+        """A completed round sampled under ``version`` for the first time:
+        learn-to-act = push → here. Measured at round COMPLETION, so it is
+        an upper bound on when the first token actually decoded under the
+        new version (the engines log swap steps, not wall times)."""
+        if version is None:
+            return
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            e = self._versions.get(int(version))
+            if e is None or "push_ts" not in e or "first_sample_ts" in e:
+                return
+            e["first_sample_ts"] = ts
+            e["learn_to_act_ms"] = (ts - e["push_ts"]) * 1e3
+            telemetry.hist_observe(
+                LEARN_TO_ACT_MS, e["learn_to_act_ms"], trace_sample=True
+            )
+            self._flush_version_locked(int(version))
+
+    # ---------------------------------------------------------------- export
+
+    def export_jsonl(self, path: str) -> str:
+        """Dump every OPEN record (closed ones already streamed) plus the
+        version table to ``path``; returns the path."""
+        with self._mu:
+            docs = [r.to_dict() for r in self._ring.values()]
+            docs += [
+                {
+                    "kind": "weights", "version": v,
+                    "push_ts": e.get("push_ts"),
+                    "broadcast_ms": e.get("broadcast_ms"),
+                    "ack_ms": e.get("ack_ms"),
+                    "learn_to_act_ms": e.get("learn_to_act_ms"),
+                }
+                for v, e in sorted(self._versions.items())
+                if not e.get("written")
+            ]
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for doc in docs:
+                f.write(json.dumps(doc, default=str) + "\n")
+        return path
+
+    def close(self) -> None:
+        """Flush unwritten weight-version lines and close the stream."""
+        with self._mu:
+            for v in sorted(self._versions):
+                self._flush_version_locked(v)
+            if self._file is not None:
+                self._file.close()
+                self._file = None

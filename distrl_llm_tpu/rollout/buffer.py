@@ -50,6 +50,7 @@ class TrajectoryBuffer:
         *,
         high_watermark: int | None = None,
         low_watermark: int | None = None,
+        ledger=None,
     ):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
@@ -71,6 +72,11 @@ class TrajectoryBuffer:
                 f"low_watermark must be in (0, high_watermark="
                 f"{self.high_watermark}], got {self.low_watermark}"
             )
+        # lineage ledger (distrl_llm_tpu/lineage.py, ISSUE 10): when armed,
+        # enqueue/dequeue/eviction stamp the group's LineageRecord — the
+        # buffer-passage leg of the policy-lag measurement. None (the
+        # default) keeps every hook site one attribute check.
+        self._ledger = ledger
         self._q: deque[Trajectory] = deque()
         self._mu = threading.Lock()
         self._not_empty = threading.Condition(self._mu)
@@ -131,10 +137,14 @@ class TrajectoryBuffer:
             # (with the default high == capacity the two limits coincide)
             limit = self.high_watermark if self._gated else self.capacity
             while len(self._q) >= limit:
-                self._q.popleft()
+                evicted = self._q.popleft()
                 self.dropped_capacity += 1
                 telemetry.counter_add("rollout/dropped_capacity")
+                if self._ledger is not None:
+                    self._ledger.on_dropped(evicted, "evicted_capacity")
             self._q.append(traj)
+            if self._ledger is not None:
+                self._ledger.on_enqueue(traj)
             self.total_put += 1
             if len(self._q) >= self.high_watermark:
                 self._gated = True
@@ -178,6 +188,9 @@ class TrajectoryBuffer:
                 self._not_empty.wait(remaining)
             out = [self._q.popleft() for _ in range(min(k, len(self._q)))]
             self.total_got += len(out)
+            if self._ledger is not None:
+                for traj in out:
+                    self._ledger.on_dequeue(traj)
             self._maybe_open_gate_locked()
             self._occupancy_gauge_locked()
             return out
@@ -199,6 +212,8 @@ class TrajectoryBuffer:
                 if lag > max_staleness:
                     dropped += 1
                     telemetry.counter_add("rollout/dropped_stale")
+                    if self._ledger is not None:
+                        self._ledger.on_dropped(traj, "evicted_stale")
                 else:
                     kept.append(traj)
             self._q = kept

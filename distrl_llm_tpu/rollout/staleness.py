@@ -41,7 +41,7 @@ class StalenessPolicy:
     """Admission policy over pulled trajectory groups."""
 
     def __init__(self, max_staleness: int, *, mode: str = "drop",
-                 downweight: float = 0.5):
+                 downweight: float = 0.5, ledger=None):
         if max_staleness < 0:
             raise ValueError(
                 f"max_staleness must be >= 0, got {max_staleness}"
@@ -57,6 +57,9 @@ class StalenessPolicy:
         self.max_staleness = max_staleness
         self.mode = mode
         self.downweight = downweight
+        # lineage ledger (ISSUE 10): when armed, every admission decision —
+        # lag, verdict, group weight — lands on the group's LineageRecord
+        self._ledger = ledger
         self.dropped = 0  # cumulative, run-total
         self.admitted = 0
 
@@ -96,14 +99,25 @@ class StalenessPolicy:
             ):
                 self.dropped += 1
                 telemetry.counter_add("rollout/dropped_stale")
+                if self._ledger is not None:
+                    self._ledger.on_admission(
+                        traj, learner_version=learner_version, lag=lag,
+                        verdict="dropped_stale",
+                    )
                 continue
             telemetry.hist_observe("rollout/staleness", float(lag),
                                    trace_sample=True)
             self.admitted += 1
             kept.append(traj)
-            weights.append(
+            weight = (
                 self.downweight ** (lag - self.max_staleness)
                 if self.mode == "downweight" and lag > self.max_staleness
                 else 1.0
             )
+            weights.append(weight)
+            if self._ledger is not None:
+                self._ledger.on_admission(
+                    traj, learner_version=learner_version, lag=lag,
+                    verdict="admitted", weight=weight,
+                )
         return kept, weights

@@ -74,6 +74,17 @@ class _State:
         # driver-side fleet table: track label -> last piggybacked worker
         # registry snapshot (+ receive timestamp), fed by ingest_remote
         self.remote_metrics: dict[str, dict] = {}
+        # --- causal trace context (ISSUE 10) ----------------------------
+        # one trace id per process run: driver dispatch/weight frames carry
+        # it (with a per-frame dispatch id) so worker-side spans attach to
+        # the driver dispatch that caused them instead of floating free
+        self.trace_id = f"{os.getpid():x}-{time.time_ns() & 0xFFFFFFFFFF:x}"
+        self.dispatch_seq = 0
+        # base track -> pid of the FIRST incarnation seen: a restarted
+        # worker (new pid) gets a DISTINCT trace track instead of aliasing
+        # onto its predecessor's timeline (the killed-and-restarted merge
+        # bug trace_report used to inherit)
+        self.remote_incarnations: dict[str, Any] = {}
 
 
 _STATE = _State()
@@ -94,11 +105,19 @@ def reset() -> None:
     global _STATE, _PHASE_HOOK
     _STATE = _State()
     _PHASE_HOOK = None
+    _TLS.ctx = None  # a bound trace context must not leak across resets
 
 
 # phase-boundary hook (obs.py registers its HBM sampler here): one global
 # read on the disabled path, so PhaseSpans stays free when obs is off
 _PHASE_HOOK = None
+
+# inbound trace context bound per HANDLER THREAD (worker side): spans
+# recorded while a context is bound carry (trace_id, dispatch_id) args and
+# the first one emits the flow-finish event that renders the driver→worker
+# arrow in Perfetto. Thread-local, so the dispatch connection and the
+# weight-bus connection can each serve a causally distinct frame at once.
+_TLS = threading.local()
 
 
 def set_phase_hook(fn) -> None:
@@ -106,6 +125,54 @@ def set_phase_hook(fn) -> None:
     uninstalls). obs.enable() uses this to sample HBM at span boundaries."""
     global _PHASE_HOOK
     _PHASE_HOOK = fn
+
+
+# ----------------------------------------------------- causal trace context
+
+
+def next_dispatch_context() -> dict:
+    """Allocate the ``(trace_id, dispatch_id)`` pair stamped on one outbound
+    driver frame (a generation dispatch or a weight push). Always available
+    — a locked counter increment — so lineage bookkeeping works with
+    tracing off; the wire envelope itself only ships while tracing is on
+    (control_plane MSG_DISPATCH_CTX / the weight payload's trace_ctx)."""
+    st = _STATE
+    with st.lock:
+        st.dispatch_seq += 1
+        return {"trace_id": st.trace_id, "dispatch_id": st.dispatch_seq}
+
+
+def bind_trace_context(ctx: Mapping[str, Any] | None) -> None:
+    """Bind an inbound frame's trace context to THIS thread: spans recorded
+    until :func:`unbind_trace_context` carry its (trace_id, dispatch_id)
+    and the first one emits the Perfetto flow-finish event linking back to
+    the originating driver dispatch span."""
+    _TLS.ctx = dict(ctx) if ctx else None
+
+
+def unbind_trace_context() -> None:
+    _TLS.ctx = None
+
+
+def current_trace_context() -> dict | None:
+    return getattr(_TLS, "ctx", None)
+
+
+def emit_flow_start(dispatch_id: int) -> None:
+    """Driver-side flow-origin event: emitted INSIDE the ``cp/dispatch`` /
+    ``cp/weight_push`` span so Perfetto anchors the arrow to that slice;
+    the worker's first context-bound span emits the matching finish."""
+    st = _STATE
+    if not st.enabled:
+        return
+    st.events.append({
+        "ph": "s",
+        "cat": "dispatch",
+        "name": "dispatch",
+        "id": int(dispatch_id),
+        "ts": time.time_ns() // 1000,
+        "tid": threading.get_ident(),
+    })
 
 
 # --------------------------------------------------------------------- spans
@@ -147,13 +214,30 @@ class _Span:
         st = _STATE
         if ident not in st.thread_names:
             st.thread_names[ident] = threading.current_thread().name
+        args = self.args
+        ctx = getattr(_TLS, "ctx", None)
+        if ctx is not None:
+            # inbound trace context (ISSUE 10): every span recorded while a
+            # dispatch frame is being handled names the driver dispatch
+            # that caused it — the merged trace becomes one causal timeline
+            args = {**args, "trace_id": ctx.get("trace_id"),
+                    "dispatch_id": ctx.get("dispatch_id")}
+            if not ctx.get("_flow_done"):
+                # flow-finish INSIDE this span's interval so Perfetto binds
+                # the driver→worker arrow to it (bp="e" = enclosing slice)
+                ctx["_flow_done"] = True
+                st.events.append({
+                    "ph": "f", "bp": "e", "cat": "dispatch",
+                    "name": "dispatch", "id": int(ctx.get("dispatch_id", 0)),
+                    "ts": self._t0 // 1000 + 1, "tid": ident,
+                })
         st.events.append({
             "ph": "X",
             "name": self.name,
             "ts": self._t0 // 1000,  # Chrome trace timestamps are µs
             "dur": max((t1 - self._t0) // 1000, 1),
             "tid": ident,
-            "args": self.args,
+            "args": args,
         })
 
     def set(self, **args) -> None:
@@ -388,7 +472,10 @@ def drain_remote_blob() -> dict | None:
             return None
         events, st.events = st.events, []
         threads = dict(st.thread_names)
-    return {"events": events, "threads": threads}
+    # the recording process's pid rides along: the driver keys trace tracks
+    # by (worker, pid), so a killed-and-restarted worker's two incarnations
+    # render as DISTINCT tracks instead of one aliased timeline
+    return {"events": events, "threads": threads, "pid": os.getpid()}
 
 
 def ingest_remote(blob: Mapping[str, Any], track: str) -> None:
@@ -414,9 +501,19 @@ def ingest_remote(blob: Mapping[str, Any], track: str) -> None:
         return
     if not blob.get("events") and not blob.get("threads"):
         return  # metrics-only blob: no empty trace track to register
+    # incarnation-keyed tracks (ISSUE 10): the first pid seen for a worker
+    # keeps the plain label (healthy runs are unchanged); a RESTARTED
+    # worker's new pid gets its own track, so two incarnations never merge
+    # into one timeline (the aliasing bug trace_report inherited)
+    worker_pid = blob.get("pid")
     with st.lock:
+        first_pid = st.remote_incarnations.setdefault(track, worker_pid)
+        label = (
+            track if worker_pid is None or worker_pid == first_pid
+            else f"{track} (pid {worker_pid})"
+        )
         pid = st.remote_tracks.setdefault(
-            track, _REMOTE_PID0 + len(st.remote_tracks)
+            label, _REMOTE_PID0 + len(st.remote_tracks)
         )
         for tid, name in blob.get("threads", {}).items():
             st.remote_threads[(pid, int(tid))] = name

@@ -361,6 +361,25 @@ class Trainer:
                 ),
             )
 
+        # trajectory lineage ledger (distrl_llm_tpu/lineage.py, ISSUE 10):
+        # per-group causal records (sampling worker + dispatch_id → buffer
+        # → staleness verdict → consuming optimizer step → produced weight
+        # version) and the derived policy-lag histograms. None unless
+        # --lineage armed it; every hook below is one attribute check.
+        self.lineage: Any = None
+        if config.lineage:
+            from distrl_llm_tpu.lineage import LineageLedger
+
+            self.lineage = LineageLedger(
+                ring_size=config.lineage_ring, out_dir=config.lineage_dir
+            )
+            bus = getattr(engine, "bus", None)
+            if bus is not None:
+                # the policy-lag loop closes at the LAST WORKER ACK of the
+                # produced version (PR 9's broadcast), not the local push
+                self.lineage.expect_acks = True
+                bus.on_broadcast = self.lineage.on_broadcast_complete
+
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
             self.ckpt = CheckpointManager(config.checkpoint_dir)
@@ -722,6 +741,12 @@ class Trainer:
         else:
             self._lora_rollout = pushed
         self._rollout_weight_version = self.weight_version
+        if self.lineage is not None:
+            # weight-version lineage: push time opens the learn-to-act
+            # window; with a broadcast bus the policy-lag loop stays open
+            # until on_broadcast_complete (the bus hook), locally it closes
+            # here — the pushed tree IS resident when this returns
+            self.lineage.on_push(self.weight_version)
 
     # ---------------------------------------------------------------- rollout
 
@@ -1008,6 +1033,16 @@ class Trainer:
             cand["version_tags"] = [tags for _ in kept_idx]
             cand["base_version"] = base_version
             cand["swap_events"] = events
+            if self.lineage is not None:
+                # learn-to-act: this round sampled under its entry version
+                # and every in-flight swap it consumed — the first round to
+                # do so closes each version's push→act window (measured at
+                # round completion: an upper bound, the engines log swap
+                # steps, not wall times)
+                now = time.time()
+                self.lineage.note_first_sample(base_version, now)
+                for _step, v in events:
+                    self.lineage.note_first_sample(v, now)
         # snapshot pool + round telemetry HERE, on the thread that ran the
         # round: with async_rollout the next round (or an eval) may
         # overwrite the engine's shared attributes before _train_batch
@@ -1018,6 +1053,23 @@ class Trainer:
         rstats = getattr(self.engine, "last_round_stats", None)
         if rstats:
             cand["round_stats"] = dict(rstats)
+        if self.lineage is not None:
+            # sampling provenance per KEPT group: which worker + causal
+            # dispatch_id sampled each prompt row (RemoteEngine records the
+            # shard→row map; local engines have no dispatch, meta is None)
+            cand["sampled_ts"] = time.time()
+            shard_meta = getattr(self.engine, "last_shard_meta", None)
+            row_meta: list[dict | None] = []
+            for i in kept_idx:
+                m = None
+                for sm in shard_meta or ():
+                    lo, hi = sm["rows"]
+                    if lo <= i < hi:
+                        m = {"worker": sm["worker"],
+                             "dispatch_id": sm["dispatch_id"]}
+                        break
+                row_meta.append(m)
+            cand["row_meta"] = row_meta
         return [cand]
 
     def _compute_round_rewards(self, candidates: list[dict[str, Any]]) -> None:
@@ -1155,6 +1207,10 @@ class Trainer:
             # whole-run tracing (trace_steps=0) exports here; a closed
             # trace_steps window already wrote and disabled — no-op then
             self._export_trace()
+            if self.lineage is not None:
+                # flush unwritten weight-version lines and close the JSONL
+                # stream; the ring (open records) stays queryable
+                self.lineage.close()
             # the obs plane deliberately OUTLIVES train(): a fleet
             # operator scrapes the endpoint while rejoins/drains settle
             # after the loop ends — close_obs() (or process exit; the
@@ -1219,10 +1275,10 @@ class Trainer:
             cfg.rollout_buffer_groups or 4 * cfg.batch_size,
             2 * cfg.batch_size,
         )
-        buffer = TrajectoryBuffer(capacity)
+        buffer = TrajectoryBuffer(capacity, ledger=self.lineage)
         policy = StalenessPolicy(
             cfg.max_staleness, mode=cfg.staleness_policy,
-            downweight=cfg.staleness_downweight,
+            downweight=cfg.staleness_downweight, ledger=self.lineage,
         )
         self._rollout_buffer = buffer
         self._staleness_policy = policy
@@ -1246,7 +1302,7 @@ class Trainer:
 
         def produce(episode: int, bi: int, batch) -> list:
             [cand] = self._generate_round(batch, cfg.train_sampling())
-            return round_to_trajectories(
+            trajs = round_to_trajectories(
                 cand,
                 base_version=cand.get(
                     "base_version", self._rollout_weight_version
@@ -1254,6 +1310,24 @@ class Trainer:
                 swap_events=cand.get("swap_events", ()),
                 episode=episode, batch_index=bi,
             )
+            if self.lineage is not None:
+                # open one LineageRecord per group: sampling worker +
+                # causal dispatch_id (remote rounds), weight-version
+                # bounds, and the round-completion timestamp
+                row_meta = cand.get("row_meta") or []
+                ts = cand.get("sampled_ts")
+                for j, traj in enumerate(trajs):
+                    m = row_meta[j] if j < len(row_meta) else None
+                    self.lineage.on_group_sampled(
+                        traj,
+                        worker=m.get("worker") if m else None,
+                        dispatch_id=m.get("dispatch_id") if m else None,
+                        ts=ts,
+                    )
+                    events = cand.get("swap_events")
+                    if events:
+                        self.lineage.note_swap_events(traj, events)
+            return trajs
 
         from distrl_llm_tpu.distributed.resilience import RetryPolicy
 
@@ -1308,6 +1382,15 @@ class Trainer:
             self._update_on_candidates(
                 [cand], episode, timer, n_samples=len(kept)
             )
+            if self.lineage is not None:
+                # the optimizer step that consumed these groups and the
+                # weight version it produced (both just advanced inside
+                # _update_on_candidates) — closes each record and opens
+                # the produced version's policy-lag window
+                self.lineage.on_consumed(
+                    kept, step=self.total_batch_steps,
+                    produced_version=self.weight_version,
+                )
             if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
                 # evals need exclusive engine access (engines are not
                 # re-entrant): pause at the next round boundary, resume after

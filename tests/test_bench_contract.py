@@ -57,7 +57,7 @@ class TestBenchContract:
                     "spec_drafter", "spec_accept_rate",
                     "tokens_per_verify_step", "spec_verify_impl",
                     "hbm_peak_bytes", "recompile_count", "fleet_tok_s",
-                    "weight_bus", "weight_bytes_per_update",
+                    "fleet_workers", "weight_bus", "weight_bytes_per_update",
                     "weight_sync_ms"):
             assert key in rec, key
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
@@ -94,6 +94,25 @@ class TestBenchContract:
         assert rec["plan"]["decode_path"] == "dense"
         assert rec["plan_source"] in ("db", "default", "disabled")
         assert rec["scan_chunk"] == rec["plan"]["scan_chunk"]
+
+    def test_fleet_record_fields(self):
+        """A BENCH_WORKERS row must populate the reserved fleet slot
+        (ISSUE 10 satellite): the same rollout volume through 2 control-
+        plane workers yields a FleetAggregator-derived fleet_tok_s, the
+        worker count, and the weight-transport provenance — while the
+        local-engine introspection fields honestly read null (workers run
+        their own engines)."""
+        rec = run_bench({**self.TINY, "BENCH_WORKERS": "2"})
+        assert "error" not in rec
+        assert rec["fleet_workers"] == 2
+        # the aggregate derives from the workers' piggybacked monotonic
+        # obs/gen_tokens counters over the timed window — a real rate
+        assert rec["fleet_tok_s"] is not None and rec["fleet_tok_s"] > 0
+        assert rec["weight_bus"] == "dispatch"  # the raw-API default
+        assert rec["weight_bytes_per_update"] is None  # dispatch re-ships
+        assert rec["weight_sync_ms"] is None
+        assert rec["value"] > 0
+        assert rec["bucket_used"] is None  # workers bucket their own shards
 
     def test_spec_record_fields(self):
         """A speculative refill row must self-describe (ISSUE 6): which

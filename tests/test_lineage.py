@@ -1,0 +1,430 @@
+"""Lineage ledger + causal trace-context propagation (ISSUE 10).
+
+Three layers:
+
+* ledger unit tests — the LineageRecord lifecycle (sampled → buffer →
+  admission → consumed), derived lag histograms, ring bounding, JSONL
+  streaming, and the policy-lag loop under both local-push and
+  broadcast-ack closure;
+* trace-context unit tests — dispatch-id allocation, worker-side span
+  tagging + flow events under a bound context, and incarnation-keyed
+  remote tracks (the killed-and-restarted worker aliasing fix);
+* a chaos-style integration test — a real 2-worker control plane, SIGKILL
+  → same-port restart → rejoin mid-run, asserting every worker span in the
+  merged trace still resolves to a live driver dispatch parent, no
+  dispatch_id is orphaned, and the two incarnations land on distinct
+  tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.lineage import (
+    LEARN_TO_ACT_MS,
+    LINEAGE_CLOSED,
+    POLICY_LAG_MS,
+    SAMPLE_TO_LEARN_MS,
+    LineageLedger,
+)
+from distrl_llm_tpu.rollout.buffer import TrajectoryBuffer
+from distrl_llm_tpu.rollout.staleness import StalenessPolicy
+from distrl_llm_tpu.rollout.trajectory import Trajectory
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def make_traj(version: int = 0, episode: int = 0, bi: int = 0) -> Trajectory:
+    return Trajectory(
+        problem="what is 1+1?", solution="2", answers=["2", "3"],
+        token_lengths=[1, 1], produced_version=version,
+        episode=episode, batch_index=bi,
+    )
+
+
+class TestLedgerLifecycle:
+    def test_full_loop_closes_and_measures(self, tmp_path):
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        traj = make_traj(version=3)
+        uid = led.on_group_sampled(
+            traj, worker="127.0.0.1:9", dispatch_id=42, ts=100.0
+        )
+        assert traj.meta["lineage_uid"] == uid
+        led.on_enqueue(traj, ts=100.5)
+        led.on_dequeue(traj, ts=101.0)
+        led.on_admission(
+            traj, learner_version=4, lag=1, verdict="admitted", weight=1.0
+        )
+        led.on_push(5, ts=102.5)
+        led.on_consumed([traj], step=7, produced_version=5, ts=102.0)
+        led.close()
+        lines = [json.loads(l) for l in open(tmp_path / "lineage.jsonl")]
+        groups = [l for l in lines if l["kind"] == "group"]
+        assert len(groups) == 1
+        g = groups[0]
+        assert g["worker"] == "127.0.0.1:9" and g["dispatch_id"] == 42
+        assert g["base_version"] == 3 and g["verdict"] == "admitted"
+        assert g["consumed_step"] == 7 and g["produced_version"] == 5
+        assert g["sample_to_learn_ms"] == pytest.approx(2000.0)
+        snap = telemetry.observe_snapshot()
+        assert snap["hists"][SAMPLE_TO_LEARN_MS]["count"] == 1
+        assert snap["counters"][LINEAGE_CLOSED] == 1
+        # local path (expect_acks False): the policy-lag loop closed from
+        # the recorded push time of the produced version
+        assert snap["hists"][POLICY_LAG_MS]["count"] == 1
+        assert snap["hists"][POLICY_LAG_MS]["sum"] == pytest.approx(2500.0)
+
+    def test_dropped_record_is_terminal(self, tmp_path):
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        traj = make_traj()
+        led.on_group_sampled(traj, ts=1.0)
+        led.on_admission(
+            traj, learner_version=9, lag=9, verdict="dropped_stale"
+        )
+        led.close()
+        lines = [json.loads(l) for l in open(tmp_path / "lineage.jsonl")]
+        assert lines[0]["verdict"] == "dropped_stale"
+        assert lines[0]["consumed_step"] is None
+        assert led.dropped == 1 and led.closed_groups == 1
+        # no latency histogram for a group that never trained
+        assert SAMPLE_TO_LEARN_MS not in telemetry.observe_snapshot()["hists"]
+
+    def test_broadcast_ack_closes_policy_lag(self):
+        led = LineageLedger(ring_size=8)
+        led.expect_acks = True
+        traj = make_traj()
+        led.on_group_sampled(traj, ts=10.0)
+        led.on_push(1, ts=11.0)  # bus enqueue: must NOT close the loop
+        led.on_consumed([traj], step=1, produced_version=1, ts=11.0)
+        assert POLICY_LAG_MS not in telemetry.observe_snapshot()["hists"]
+        led.on_broadcast_complete(1, 250.0, {"127.0.0.1:9": 250.0}, ts=11.5)
+        h = telemetry.observe_snapshot()["hists"][POLICY_LAG_MS]
+        assert h["count"] == 1 and h["sum"] == pytest.approx(1500.0)
+
+    def test_ack_before_consumed_resolves_retroactively(self):
+        led = LineageLedger(ring_size=8)
+        led.expect_acks = True
+        traj = make_traj()
+        led.on_group_sampled(traj, ts=10.0)
+        led.on_push(1, ts=11.0)
+        # the bus sender raced ahead of the learner's bookkeeping call
+        led.on_broadcast_complete(1, 100.0, {}, ts=11.2)
+        led.on_consumed([traj], step=1, produced_version=1, ts=11.1)
+        h = telemetry.observe_snapshot()["hists"][POLICY_LAG_MS]
+        assert h["count"] == 1 and h["sum"] == pytest.approx(1200.0)
+
+    def test_partial_broadcast_does_not_close_policy_lag(self):
+        """A push that failed on some worker must NOT close the
+        all-workers-acked loop; the rejoin resync's complete=True
+        re-notification does, at the true all-acked time."""
+        led = LineageLedger(ring_size=8)
+        led.expect_acks = True
+        traj = make_traj()
+        led.on_group_sampled(traj, ts=10.0)
+        led.on_push(1, ts=11.0)
+        led.on_consumed([traj], step=1, produced_version=1, ts=11.0)
+        led.on_broadcast_complete(
+            1, 80.0, {"w:1": 80.0}, ts=11.1, complete=False
+        )
+        assert POLICY_LAG_MS not in telemetry.observe_snapshot()["hists"]
+        # the dead worker rejoined and resynced — the bus re-notifies
+        led.on_broadcast_complete(1, None, {"w:2": 3.0}, ts=14.0)
+        h = telemetry.observe_snapshot()["hists"][POLICY_LAG_MS]
+        assert h["count"] == 1 and h["sum"] == pytest.approx(4000.0)
+        # both attempts' acks merged; the attempt's broadcast_ms kept
+        e = led._versions[1]
+        assert e["ack_ms"] == {"w:1": 80.0, "w:2": 3.0}
+        assert e["broadcast_ms"] == 80.0
+
+    def test_superseded_version_resolved_by_newer_ack(self):
+        """The bus's single-slot mailbox can supersede an unsent push; the
+        NEXT version's all-acked event closes the older pending loops too
+        (v(k+1) contains v(k)'s update) instead of leaking them."""
+        led = LineageLedger(ring_size=8)
+        led.expect_acks = True
+        t1, t2 = make_traj(), make_traj()
+        led.on_group_sampled(t1, ts=10.0)
+        led.on_group_sampled(t2, ts=20.0)
+        led.on_push(1, ts=11.0)
+        led.on_consumed([t1], step=1, produced_version=1, ts=11.0)
+        led.on_push(2, ts=21.0)  # v1's broadcast was superseded, never acked
+        led.on_consumed([t2], step=2, produced_version=2, ts=21.0)
+        led.on_broadcast_complete(2, 50.0, {"w:1": 50.0}, ts=22.0)
+        h = telemetry.observe_snapshot()["hists"][POLICY_LAG_MS]
+        assert h["count"] == 2  # both loops closed at v2's ack
+        assert h["sum"] == pytest.approx((22.0 - 10.0 + 22.0 - 20.0) * 1e3)
+        assert not led._await_act  # nothing leaks
+
+    def test_learn_to_act_first_sample_only(self):
+        led = LineageLedger(ring_size=8)
+        led.on_push(2, ts=50.0)
+        led.note_first_sample(2, ts=50.4)
+        led.note_first_sample(2, ts=99.0)  # later rounds don't re-measure
+        h = telemetry.observe_snapshot()["hists"][LEARN_TO_ACT_MS]
+        assert h["count"] == 1 and h["sum"] == pytest.approx(400.0)
+        # a version never pushed measures nothing
+        led.note_first_sample(7, ts=51.0)
+        assert (
+            telemetry.observe_snapshot()["hists"][LEARN_TO_ACT_MS]["count"]
+            == 1
+        )
+
+    def test_ring_bounds_open_records(self, tmp_path):
+        led = LineageLedger(ring_size=2, out_dir=str(tmp_path))
+        trajs = [make_traj() for _ in range(4)]
+        for t in trajs:
+            led.on_group_sampled(t, ts=1.0)
+        # two oldest fell off the ring, counted and streamed as evicted
+        snap = telemetry.observe_snapshot()
+        assert snap["counters"]["lineage/ring_evictions"] == 2
+        assert snap["gauges"]["lineage/records_open"] == 2.0
+        led.close()
+        lines = [json.loads(l) for l in open(tmp_path / "lineage.jsonl")]
+        assert [l["verdict"] for l in lines if l["kind"] == "group"] == [
+            "evicted_ring", "evicted_ring",
+        ]
+
+    def test_weights_lines_stream_on_close(self, tmp_path):
+        led = LineageLedger(ring_size=4, out_dir=str(tmp_path))
+        led.on_push(0, ts=1.0)
+        led.on_broadcast_complete(0, 12.0, {"w:1": 12.0}, ts=1.1)
+        led.close()
+        lines = [json.loads(l) for l in open(tmp_path / "lineage.jsonl")]
+        w = [l for l in lines if l["kind"] == "weights"]
+        assert len(w) == 1 and w[0]["version"] == 0
+        assert w[0]["broadcast_ms"] == 12.0 and w[0]["ack_ms"] == {"w:1": 12.0}
+
+
+class TestRolloutHooks:
+    def test_buffer_stamps_passage_and_evictions(self):
+        led = LineageLedger(ring_size=16)
+        buf = TrajectoryBuffer(4, ledger=led)
+        trajs = [make_traj(version=0) for _ in range(3)]
+        for t in trajs:
+            led.on_group_sampled(t)
+            buf.put(t)
+        got = buf.get_batch(2, timeout=1)
+        assert len(got) == 2
+        for t in got:
+            rec = led._ring[t.meta["lineage_uid"]]
+            assert rec.enqueue_ts is not None and rec.dequeue_ts is not None
+            assert rec.enqueue_ts <= rec.dequeue_ts
+        # staleness eviction closes the record terminally
+        buf.evict_stale(learner_version=99, max_staleness=1)
+        assert led.dropped == 1
+
+    def test_staleness_policy_records_verdicts(self):
+        led = LineageLedger(ring_size=16)
+        policy = StalenessPolicy(1, mode="drop", ledger=led)
+        fresh, stale = make_traj(version=5), make_traj(version=0)
+        led.on_group_sampled(fresh)
+        led.on_group_sampled(stale)
+        kept, weights = policy.admit([fresh, stale], learner_version=5)
+        assert kept == [fresh] and weights == [1.0]
+        assert led.admitted == 1 and led.dropped == 1
+        rec = led._ring[fresh.meta["lineage_uid"]]
+        assert rec.verdict == "admitted" and rec.staleness_lag == 0
+
+    def test_unledgered_buffer_is_untouched(self):
+        # default construction: no ledger, no meta stamping, no cost
+        buf = TrajectoryBuffer(4)
+        t = make_traj()
+        buf.put(t)
+        assert "lineage_uid" not in t.meta
+
+
+class TestTraceContext:
+    def test_dispatch_ids_monotonic_and_trace_stable(self):
+        a, b = telemetry.next_dispatch_context(), telemetry.next_dispatch_context()
+        assert b["dispatch_id"] == a["dispatch_id"] + 1
+        assert a["trace_id"] == b["trace_id"]
+
+    def test_bound_context_tags_spans_and_emits_flow(self):
+        telemetry.configure(enabled=True)
+        telemetry.bind_trace_context({"trace_id": "t1", "dispatch_id": 9})
+        try:
+            with telemetry.span("worker/echo"):
+                pass
+            with telemetry.span("worker/other"):
+                pass
+        finally:
+            telemetry.unbind_trace_context()
+        with telemetry.span("driver/unbound"):
+            pass
+        blob = telemetry.drain_remote_blob()
+        spans = {e["name"]: e for e in blob["events"] if e["ph"] == "X"}
+        assert spans["worker/echo"]["args"]["dispatch_id"] == 9
+        assert spans["worker/other"]["args"]["dispatch_id"] == 9
+        assert "dispatch_id" not in spans["driver/unbound"]["args"]
+        # exactly ONE flow-finish per bound context, inside the first span
+        flows = [e for e in blob["events"] if e["ph"] == "f"]
+        assert len(flows) == 1 and flows[0]["id"] == 9
+        assert flows[0]["bp"] == "e" and flows[0]["cat"] == "dispatch"
+        assert blob["pid"] == os.getpid()
+
+    def test_disabled_records_nothing_under_context(self):
+        telemetry.bind_trace_context({"trace_id": "t", "dispatch_id": 1})
+        try:
+            with telemetry.span("worker/echo"):
+                pass
+        finally:
+            telemetry.unbind_trace_context()
+        assert telemetry.drain_remote_blob() is None
+
+    def test_restarted_worker_gets_distinct_track(self, tmp_path):
+        telemetry.configure(enabled=True)
+        ev = {"ph": "X", "name": "worker/echo", "ts": 1, "dur": 1, "tid": 1,
+              "args": {}}
+        telemetry.ingest_remote(
+            {"events": [dict(ev)], "threads": {}, "pid": 111},
+            track="worker 127.0.0.1:7",
+        )
+        telemetry.ingest_remote(  # same pid: same track (healthy worker)
+            {"events": [dict(ev)], "threads": {}, "pid": 111},
+            track="worker 127.0.0.1:7",
+        )
+        telemetry.ingest_remote(  # restarted incarnation: NEW track
+            {"events": [dict(ev)], "threads": {}, "pid": 222},
+            track="worker 127.0.0.1:7",
+        )
+        path = telemetry.export_chrome_trace(str(tmp_path / "t.json"))
+        evs = json.load(open(path))["traceEvents"]
+        names = sorted(
+            e["args"]["name"] for e in evs
+            if e["ph"] == "M" and e["name"] == "process_name"
+            and e["args"]["name"].startswith("worker")
+        )
+        assert names == [
+            "worker 127.0.0.1:7", "worker 127.0.0.1:7 (pid 222)",
+        ]
+        by_pid: dict[int, int] = {}
+        for e in evs:
+            if e["ph"] == "X":
+                by_pid[e["pid"]] = by_pid.get(e["pid"], 0) + 1
+        assert sorted(by_pid.values()) == [1, 2]  # 2 first-pid, 1 restarted
+
+    def test_legacy_blob_without_pid_keeps_plain_track(self, tmp_path):
+        telemetry.configure(enabled=True)
+        telemetry.ingest_remote(
+            {"events": [{"ph": "X", "name": "w", "ts": 1, "dur": 1,
+                         "tid": 1, "args": {}}], "threads": {}},
+            track="worker 127.0.0.1:8",
+        )
+        path = telemetry.export_chrome_trace(str(tmp_path / "t.json"))
+        evs = json.load(open(path))["traceEvents"]
+        assert any(
+            e["ph"] == "M" and e["name"] == "process_name"
+            and e["args"]["name"] == "worker 127.0.0.1:8" for e in evs
+        )
+
+
+# ---------------------------------------------------------------- chaos test
+
+
+def spawn_worker(port: int = 0):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "distrl_llm_tpu.distributed.worker_main",
+            "--port", str(port), "--trace",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), f"worker failed to start: {line!r}"
+    return proc, int(line.split()[1])
+
+
+class TestTraceContextUnderFaults:
+    def test_chaos_kill_rejoin_no_orphaned_dispatch(self, tmp_path):
+        """SIGKILL → same-port restart → rejoin mid-run: every worker span
+        in the merged trace still resolves to a live driver dispatch
+        parent, no dispatch_id is orphaned, and the killed worker's two
+        incarnations land on distinct tracks (ISSUE 10 satellite)."""
+        from distrl_llm_tpu.distributed.control_plane import DriverClient
+        from distrl_llm_tpu.distributed.resilience import RetryPolicy
+
+        telemetry.configure(enabled=True)
+        procs, ports = [], []
+        for _ in range(2):
+            p, port = spawn_worker()
+            procs.append(p)
+            ports.append(port)
+        client = DriverClient(
+            [("127.0.0.1", p) for p in ports],
+            retry_policy=RetryPolicy(base_s=0.05, seed=0),
+            rejoin_poll_s=0.05,
+        )
+        try:
+            out = client.dispatch_objects([("echo", i) for i in range(6)])
+            assert sorted(out) == list(range(6))
+            # kill worker 0 mid-run; the next round's shards resubmit to
+            # the survivor
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait(timeout=10)
+            out = client.dispatch_objects(
+                [("echo", 10 + i) for i in range(4)]
+            )
+            assert sorted(out) == [10, 11, 12, 13]
+            # restart ON THE SAME PORT; the rejoin loop re-admits it
+            procs[0] = spawn_worker(port=ports[0])[0]
+            deadline = time.time() + 30
+            while client.num_healthy < 2 and time.time() < deadline:
+                time.sleep(0.05)
+            assert client.num_healthy == 2, "rejoin never re-admitted"
+            out = client.dispatch_objects(
+                [("echo", 20 + i) for i in range(6)]
+            )
+            assert sorted(out) == list(range(20, 26))
+        finally:
+            client.shutdown()
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+
+        path = telemetry.export_chrome_trace(str(tmp_path / "trace.json"))
+        evs = json.load(open(path))["traceEvents"]
+        tracks = {e["pid"]: e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+        worker_pids = {p for p, n in tracks.items() if n.startswith("worker")}
+        # the killed worker's two incarnations are DISTINCT tracks: 2
+        # workers + 1 restarted incarnation = 3 worker tracks
+        assert len(worker_pids) == 3, tracks
+        killed = f"worker 127.0.0.1:{ports[0]}"
+        incarnations = [n for n in tracks.values()
+                        if n.split(" (pid", 1)[0] == killed]
+        assert len(incarnations) == 2, tracks
+        # every worker span resolves to a live driver dispatch parent
+        driver_ids = {
+            e["args"]["dispatch_id"] for e in evs
+            if e.get("ph") == "X" and e.get("pid", 1) not in worker_pids
+            and e["name"] == "cp/dispatch"
+            and "dispatch_id" in e.get("args", {})
+        }
+        wspans = [e for e in evs if e.get("ph") == "X"
+                  and e.get("pid") in worker_pids]
+        assert wspans, "no worker spans in the merged trace"
+        for e in wspans:
+            did = e.get("args", {}).get("dispatch_id")
+            assert did is not None, f"span without context: {e}"
+            assert did in driver_ids, f"orphaned dispatch_id: {e}"
+        # the driver recorded MORE dispatches than the workers answered
+        # (the killed worker's in-flight dispatch died with it) — but the
+        # reverse direction holds exactly: no worker span is parentless
+        assert len(driver_ids) >= len(
+            {e["args"]["dispatch_id"] for e in wspans}
+        )

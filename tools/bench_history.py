@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Fold the per-round bench artifacts (BENCH_r*.json) into one trajectory
+table and flag per-metric regressions — the bench history, finally
+machine-readable (ISSUE 10 satellite).
+
+Each BENCH_r<N>.json is a driver wrapper ``{"n", "cmd", "rc", "tail"}``
+whose ``tail`` holds the bench process's output; the LAST parseable JSON
+object line carrying a ``"metric"`` key is the bench record (bench.py's
+one-line stdout contract). This script:
+
+* prints one row per round: value (tok/s/chip), vs_baseline, MFU,
+  %-of-roofline, backend, engine, and whether the round errored;
+* compares each COMPARABLE consecutive pair (same metric name, same
+  backend, both rc==0 and error-free — a CPU-fallback round is reported
+  but never scored against a TPU round) and flags any >10% drop in the
+  headline ``value``;
+* exits 1 when a regression is flagged (or no artifact parses), 0
+  otherwise. ``tools/run_all_checks.sh`` runs it WARN-ONLY: cross-round
+  rows come from different silicon windows, so a flag warns rather than
+  failing the battery; the TPU bench loop can gate on it directly.
+
+    python tools/bench_history.py [--glob 'BENCH_r*.json'] [--drop 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def extract_record(path: str) -> tuple[dict | None, int]:
+    """(bench record, wrapper rc) from one artifact; record None when no
+    line of the tail parses as a bench record."""
+    with open(path) as f:
+        doc = json.load(f)
+    rc = int(doc.get("rc", 1))
+    record = None
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            record = cand  # last one wins (bench emits exactly one)
+    return record, rc
+
+
+def round_index(path: str) -> int:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def comparable(a: dict, b: dict) -> bool:
+    """Two rounds are scoreable only when they measured the same thing on
+    the same backend with no degradation in either."""
+    return (
+        a.get("metric") == b.get("metric")
+        and a.get("backend") == b.get("backend")
+        and "error" not in a and "error" not in b
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="bench-artifact trajectory table + regression flags"
+    )
+    p.add_argument("--glob", default="BENCH_r*.json",
+                   help="artifact pattern, relative to the repo root")
+    p.add_argument("--drop", type=float, default=0.10,
+                   help="fractional tok/s drop that flags a regression")
+    args = p.parse_args(argv)
+
+    paths = sorted(
+        glob.glob(os.path.join(REPO, args.glob)), key=round_index
+    )
+    if not paths:
+        print(f"bench_history: no artifacts match {args.glob!r}",
+              file=sys.stderr)
+        return 1
+
+    rows: list[tuple[int, dict | None, int]] = []
+    for path in paths:
+        try:
+            record, rc = extract_record(path)
+        except (OSError, ValueError) as e:
+            print(f"bench_history: unreadable {path}: {e}", file=sys.stderr)
+            record, rc = None, 1
+        rows.append((round_index(path), record, rc))
+
+    print(f"{'round':>5} {'value':>10} {'vs_base':>8} {'mfu':>8} "
+          f"{'%roof':>6} {'backend':>8} {'engine':>7}  note")
+    parsed = 0
+    for n, rec, rc in rows:
+        if rec is None:
+            print(f"{n:>5} {'-':>10} {'-':>8} {'-':>8} {'-':>6} {'-':>8} "
+                  f"{'-':>7}  no record (rc={rc})")
+            continue
+        parsed += 1
+        note = "ERROR: " + str(rec["error"])[:40] if "error" in rec else ""
+        roof = rec.get("pct_of_roofline")
+        print(
+            f"{n:>5} {rec.get('value', 0):>10,.1f} "
+            f"{rec.get('vs_baseline', 0):>8.3f} "
+            f"{rec.get('mfu', 0) or 0:>8.4f} "
+            f"{f'{roof:.1f}' if roof is not None else '-':>6} "
+            f"{str(rec.get('backend', '?')):>8} "
+            f"{str(rec.get('engine', '?')):>7}  {note}"
+        )
+
+    # ---- regression scan over comparable consecutive pairs --------------
+    flags: list[str] = []
+    prev: tuple[int, dict] | None = None
+    for n, rec, rc in rows:
+        if rec is None or rc != 0 or "error" in rec:
+            continue  # keeps prev: a broken round never becomes a baseline
+        if prev is not None and comparable(prev[1], rec):
+            old, new = float(prev[1].get("value", 0)), float(
+                rec.get("value", 0)
+            )
+            if old > 0 and new < (1.0 - args.drop) * old:
+                flags.append(
+                    f"r{prev[0]}→r{n}: value {old:,.1f} → {new:,.1f} "
+                    f"tok/s/chip ({100 * (new / old - 1):+.1f}%, "
+                    f"flag threshold -{100 * args.drop:.0f}%)"
+                )
+        prev = (n, rec)
+
+    if flags:
+        print()
+        for f in flags:
+            print(f"REGRESSION {f}")
+        return 1
+    if parsed == 0:
+        print("bench_history: no artifact contained a bench record",
+              file=sys.stderr)
+        return 1
+    print(f"\nok: {parsed}/{len(rows)} rounds parsed, no regression "
+          f"beyond {100 * args.drop:.0f}% between comparable rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

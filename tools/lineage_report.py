@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""One-command diagnosis of a lineage JSONL (ISSUE 10): which trajectories
+trained each optimizer step, how stale they were, and where the loop's time
+went — from the ledger file alone, no live process needed.
+
+    python tools/lineage_report.py run_myrun/lineage.jsonl
+    python tools/lineage_report.py run_myrun/lineage.jsonl --step 7
+
+The file is what ``--lineage_dir`` streams (``distrl_llm_tpu/lineage.py``):
+one JSON object per line, ``kind: "group"`` for per-trajectory records and
+``kind: "weights"`` for per-version push/broadcast records.
+
+Default output: per-step consumption table (groups, worker spread, staleness
+lag, sample→learn), verdict totals, the three lag distributions, and the
+per-version learn→act / broadcast-ack summary. With ``--step N`` it answers
+the incident question directly — which groups trained step N, sampled where,
+under which versions, and how stale.
+
+Exit status: 0 on a parseable file with at least one group record, 1
+otherwise — tools/run_all_checks.sh gates on it via lineage_smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load(path: str) -> tuple[list[dict], list[dict]]:
+    groups, weights = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if doc.get("kind") == "group":
+                groups.append(doc)
+            elif doc.get("kind") == "weights":
+                weights.append(doc)
+    return groups, weights
+
+
+def _dist(vals: list[float]) -> str:
+    s = sorted(vals)
+    n = len(s)
+    return (
+        f"mean {sum(s) / n:,.1f} / p50 {s[n // 2]:,.1f} / "
+        f"p90 {s[min(int(n * 0.9), n - 1)]:,.1f} / max {s[-1]:,.1f}"
+    )
+
+
+def step_detail(groups: list[dict], step: int) -> list[str]:
+    """Which trajectories trained step N and how stale were they."""
+    rows = [g for g in groups if g.get("consumed_step") == step]
+    lines = [f"step {step}: {len(rows)} trajectory group(s)"]
+    if not rows:
+        lines.append("  (no group record names this step)")
+        return lines
+    lines.append(
+        f"  {'uid':>5} {'ep/batch':>9} {'worker':<22} {'dispatch':>8} "
+        f"{'versions':>9} {'lag':>4} {'s→learn ms':>11} {'verdict':<10}"
+    )
+    for g in sorted(rows, key=lambda g: g.get("uid", 0)):
+        vmin, vmax = g.get("min_version", 0), g.get("max_version", 0)
+        vspan = f"v{vmin}" if vmin == vmax else f"v{vmin}-{vmax}"
+        stl = g.get("sample_to_learn_ms")
+        stl_s = f"{stl:,.1f}" if stl is not None else "n/a"
+        lines.append(
+            f"  {g.get('uid', '?'):>5} "
+            f"{g.get('episode', 0)}/{g.get('batch_index', 0):<7} "
+            f"{str(g.get('worker') or 'local'):<22} "
+            f"{str(g.get('dispatch_id') or '-'):>8} {vspan:>9} "
+            f"{str(g.get('staleness_lag', '?')):>4} "
+            f"{stl_s:>11} {str(g.get('verdict') or '?'):<10}"
+        )
+    produced = {g.get("produced_version") for g in rows}
+    lines.append(f"  produced weight version(s): {sorted(produced)}")
+    return lines
+
+
+def build_report(groups: list[dict], weights: list[dict],
+                 step: int | None) -> str:
+    if not groups:
+        raise ValueError("no group records in the lineage file")
+    lines: list[str] = []
+    if step is not None:
+        lines.extend(step_detail(groups, step))
+        return "\n".join(lines)
+
+    # ---- per-step consumption table
+    by_step: dict[int, list[dict]] = defaultdict(list)
+    verdicts: dict[str, int] = defaultdict(int)
+    for g in groups:
+        verdicts[str(g.get("verdict"))] += 1
+        if g.get("consumed_step") is not None:
+            by_step[int(g["consumed_step"])].append(g)
+    lines.append("consumption:")
+    lines.append(
+        f"  {'step':>5} {'groups':>7} {'workers':>8} {'lag p50/max':>12} "
+        f"{'s→learn ms p50':>15}"
+    )
+    for step_n in sorted(by_step):
+        rows = by_step[step_n]
+        lags = sorted(
+            int(g["staleness_lag"]) for g in rows
+            if g.get("staleness_lag") is not None
+        )
+        stl = sorted(
+            float(g["sample_to_learn_ms"]) for g in rows
+            if g.get("sample_to_learn_ms") is not None
+        )
+        nw = len({g.get("worker") for g in rows})
+        lag_s = (
+            f"{lags[len(lags) // 2]}/{lags[-1]}" if lags else "n/a"
+        )
+        stl_s = f"{stl[len(stl) // 2]:,.1f}" if stl else "n/a"
+        lines.append(
+            f"  {step_n:>5} {len(rows):>7} {nw:>8} {lag_s:>12} {stl_s:>15}"
+        )
+    lines.append("")
+
+    lines.append("verdicts:")
+    for v, n in sorted(verdicts.items()):
+        lines.append(f"  {v:<18} {n}")
+    lines.append("")
+
+    # ---- lag distributions
+    stl = [
+        float(g["sample_to_learn_ms"]) for g in groups
+        if g.get("sample_to_learn_ms") is not None
+    ]
+    lags = [
+        float(g["staleness_lag"]) for g in groups
+        if g.get("staleness_lag") is not None
+    ]
+    lta = [
+        float(w["learn_to_act_ms"]) for w in weights
+        if w.get("learn_to_act_ms") is not None
+    ]
+    lines.append("lags:")
+    if lags:
+        lines.append(f"  staleness (steps):  {_dist(lags)}")
+    if stl:
+        lines.append(f"  sample→learn (ms):  {_dist(stl)}")
+    if lta:
+        lines.append(f"  learn→act (ms):     {_dist(lta)}")
+    lines.append("")
+
+    # ---- per-version weight lineage
+    if weights:
+        lines.append("weight versions:")
+        lines.append(
+            f"  {'version':>8} {'broadcast ms':>13} {'workers acked':>14} "
+            f"{'learn→act ms':>13}"
+        )
+        for w in sorted(weights, key=lambda w: w.get("version", -1)):
+            acks = w.get("ack_ms") or {}
+            bc = w.get("broadcast_ms")
+            lta_v = w.get("learn_to_act_ms")
+            lines.append(
+                f"  {w.get('version', '?'):>8} "
+                f"{f'{bc:,.1f}' if bc is not None else 'n/a':>13} "
+                f"{len(acks):>14} "
+                f"{f'{lta_v:,.1f}' if lta_v is not None else 'n/a':>13}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="which trajectories trained step N, and how stale"
+    )
+    p.add_argument("lineage", help="path to a lineage.jsonl (--lineage_dir)")
+    p.add_argument("--step", type=int, default=None,
+                   help="detail one optimizer step instead of the summary")
+    args = p.parse_args(argv)
+    try:
+        groups, weights = load(args.lineage)
+        report = build_report(groups, weights, args.step)
+    except Exception as e:  # noqa: BLE001 — a truncated or still-being-
+        # written ledger must exit 1 with one line, never a raw traceback
+        print(
+            f"lineage_report: cannot report on {args.lineage}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,6 +63,24 @@ stage "obs_smoke" env JAX_PLATFORMS=cpu \
 # full-resync converges both version caches bit-identically
 stage "weight_bus_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/weight_bus_smoke.py
+# lineage gate (ISSUE 10): 2-worker async run over the broadcast bus —
+# every trained group's lineage record closes (sampled version <= consumed
+# step's version, worker + dispatch provenance), learn-to-act measured for
+# >= 1 in-flight swap, every worker span in the merged trace resolves to
+# its driver dispatch, and the lag histograms reconcile with the existing
+# rollout/staleness + obs/weight_sync_ms series
+stage "lineage_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/lineage_smoke.py
+# bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
+# one table and flag >10% per-metric tok/s regressions — machine-readable
+# bench history, but cross-round rows come from different silicon windows,
+# so a flag warns instead of failing the battery
+echo "=== bench_history (warn-only)"
+if timeout 120 python tools/bench_history.py; then
+  echo "PASS bench_history"
+else
+  echo "WARN bench_history (regression flagged or artifacts unreadable; non-gating)"
+fi
 
 if [ "${1:-}" = "--quick" ]; then
   # representative post-tiering mix: budget accounting + config + one
@@ -94,7 +112,8 @@ stage "suite_ops" timeout 600 python -m pytest -q \
 stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
   tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
-  tests/test_telemetry.py tests/test_obs.py tests/test_weight_bus.py
+  tests/test_telemetry.py tests/test_obs.py tests/test_weight_bus.py \
+  tests/test_lineage.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \

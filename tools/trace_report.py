@@ -209,6 +209,108 @@ def rollout_section(events: list[dict],
     return lines
 
 
+def _dist_lines(label: str, vals: list[float], unit: str = "ms") -> str:
+    s = sorted(vals)
+    n = len(s)
+    return (
+        f"  {label:<19} mean {sum(s) / n:,.1f} / p50 {s[n // 2]:,.1f} / "
+        f"p90 {s[min(int(n * 0.9), n - 1)]:,.1f} / max {s[-1]:,.1f} {unit} "
+        f"({n} samples)"
+    )
+
+
+def policy_lag_section(events: list[dict]) -> list[str]:
+    """Policy-lag distributions (ISSUE 10) from the lineage ledger's traced
+    histogram samples (``lineage/*`` counter events, one per observation):
+    sample→learn (group sampled → optimizer step consumed it), learn→act
+    (version pushed → first round sampled under it), and the end-to-end
+    loop (group sampled → the version its update produced reached every
+    worker). Empty when the run never armed --lineage."""
+    series: dict[str, list[float]] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if ev.get("ph") != "C" or not name.startswith("lineage/"):
+            continue
+        args = ev.get("args", {})
+        key = name.rsplit("/", 1)[-1]
+        series.setdefault(name, []).extend(
+            [float(args.get(key, 0))] * int(args.get("count", 1))
+        )
+    if not series:
+        return []
+    lines = ["policy lag:"]
+    for name, label in (
+        ("lineage/sample_to_learn_ms", "sample→learn:"),
+        ("lineage/learn_to_act_ms", "learn→act:"),
+        ("lineage/policy_lag_ms", "end-to-end:"),
+    ):
+        if series.get(name):
+            lines.append(_dist_lines(label, series[name]))
+    lines.append("")
+    return lines
+
+
+def lineage_section(events: list[dict],
+                    spans: dict[tuple[int, str], list[dict]],
+                    tracks: dict[int, str]) -> list[str]:
+    """Causal-link audit (ISSUE 10): with trace-context propagation on,
+    every worker-side span recorded while handling a driver frame carries
+    the originating ``dispatch_id``; this section counts linked vs orphaned
+    worker spans (an orphan names a dispatch the driver never recorded —
+    a propagation bug) and lists restarted-worker incarnations (distinct
+    ``(worker, pid)`` tracks). Empty when no worker span carries trace
+    context (local rollout, or workers/driver untraced)."""
+    worker_pids = {
+        pid for pid, name in tracks.items() if name.startswith("worker")
+    }
+    driver_ids: set[int] = set()
+    for (pid, name), evs in spans.items():
+        if pid in worker_pids or name not in (
+            "cp/dispatch", "cp/weight_push"
+        ):
+            continue
+        for e in evs:
+            did = e.get("args", {}).get("dispatch_id")
+            if did is not None:
+                driver_ids.add(int(did))
+    linked = orphaned = unlinked = 0
+    for (pid, _name), evs in spans.items():
+        if pid not in worker_pids:
+            continue
+        for e in evs:
+            did = e.get("args", {}).get("dispatch_id")
+            if did is None:
+                unlinked += 1
+            elif int(did) in driver_ids:
+                linked += 1
+            else:
+                orphaned += 1
+    if not linked and not orphaned:
+        return []
+    lines = ["lineage:"]
+    lines.append(
+        f"  trace links:        {linked} worker spans resolve to "
+        f"{len(driver_ids)} driver dispatches / {orphaned} orphaned / "
+        f"{unlinked} without context (pre-dispatch startup)"
+    )
+    # restarted incarnations: two tracks for one worker address ("worker
+    # host:port" + "worker host:port (pid N)") mean a kill/restart was
+    # correctly split instead of aliased onto one timeline
+    by_addr: dict[str, int] = {}
+    for name in tracks.values():
+        if name.startswith("worker"):
+            addr = name.split(" (pid", 1)[0]
+            by_addr[addr] = by_addr.get(addr, 0) + 1
+    for addr, count in sorted(by_addr.items()):
+        if count > 1:
+            lines.append(
+                f"  incarnations:       {addr} ×{count} tracks "
+                "(restart detected)"
+            )
+    lines.append("")
+    return lines
+
+
 def spec_section(spans: dict[tuple[int, str], list[dict]]) -> list[str]:
     """Speculative-decoding diagnosis from one trace: every spec-mode
     refill round stamps its decode span with ``spec_drafter`` /
@@ -363,6 +465,8 @@ def build_report(events: list[dict], metadata: dict,
     lines.extend(resilience_section(spans))
     lines.extend(weight_bus_section(spans))
     lines.extend(rollout_section(events, spans))
+    lines.extend(policy_lag_section(events))
+    lines.extend(lineage_section(events, spans, tracks))
     lines.extend(spec_section(spans))
 
     prefill = tok_s(("engine/prefill",))

@@ -259,6 +259,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "(incident_step<N>_<trigger>/) here")
     p.add_argument("--obs_ring_size", type=int, default=256,
                    help="flight-recorder ring capacity in step records")
+    p.add_argument("--lineage", action="store_true",
+                   help="trajectory lineage ledger (ISSUE 10): follow every "
+                        "sampled group from prompt through the buffer into "
+                        "the optimizer step that consumed it and out as a "
+                        "broadcast weight version, publishing "
+                        "lineage/sample_to_learn_ms, lineage/learn_to_act_ms "
+                        "and lineage/policy_lag_ms histograms; requires "
+                        "--rollout_mode async")
+    p.add_argument("--lineage_dir", type=str, default=None,
+                   help="write closed lineage records to "
+                        "<dir>/lineage.jsonl as they close (implies "
+                        "--lineage); inspect with tools/lineage_report.py")
+    p.add_argument("--lineage_ring", type=int, default=1024,
+                   help="bounded ring of OPEN lineage records; overflow is "
+                        "counted in lineage/ring_evictions, never silent")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
