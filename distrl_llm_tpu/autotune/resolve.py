@@ -35,6 +35,13 @@ from distrl_llm_tpu.autotune.store import PlanStore, autotune_enabled, default_d
 
 log = logging.getLogger(__name__)
 
+# resolution-outcome counters (one owner each; three distinct outcomes so
+# an operator can tell a DB miss from autotune being disabled)
+AUTOTUNE_PLAN_RESOLVED = "autotune/plan_resolved"
+AUTOTUNE_PLAN_DB_HIT = "autotune/plan_db_hit"
+AUTOTUNE_PLAN_DEFAULT = "autotune/plan_default"
+AUTOTUNE_PLAN_DISABLED = "autotune/plan_disabled"
+
 
 class ResolvedPlan(NamedTuple):
     plan: ExecutionPlan
@@ -165,14 +172,14 @@ def resolve_plan(
             "db" if stored is not None
             else ("default" if consult else "disabled")
         )
-        telemetry.counter_add("autotune/plan_resolved")
+        telemetry.counter_add(AUTOTUNE_PLAN_RESOLVED)
         # three distinct outcomes, three counters: an operator triaging
         # "why didn't my tuned plan apply" must be able to tell a DB miss
         # (re-tune) from autotune being disabled (flip the switch)
         telemetry.counter_add(
-            "autotune/plan_db_hit" if stored is not None
-            else ("autotune/plan_default" if consult
-                  else "autotune/plan_disabled")
+            AUTOTUNE_PLAN_DB_HIT if stored is not None
+            else (AUTOTUNE_PLAN_DEFAULT if consult
+                  else AUTOTUNE_PLAN_DISABLED)
         )
         sp.set(source=source, decode_path=plan.decode_path,
                scan_chunk=plan.scan_chunk,

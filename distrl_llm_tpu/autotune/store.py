@@ -32,6 +32,10 @@ log = logging.getLogger(__name__)
 
 SCHEMA_VERSION = 1
 
+# corrupt/missing-DB fallback counter (one owner; pinned by the autotune
+# smoke as the "never crash a run" evidence)
+AUTOTUNE_DB_RESET = "autotune/db_reset"
+
 DB_ENV = "DISTRL_PLAN_DB"
 ENABLE_ENV = "DISTRL_AUTOTUNE"
 
@@ -73,7 +77,7 @@ class PlanStore:
                 "re-run tools/autotune.py to repopulate",
                 self.path, type(e).__name__, e,
             )
-            telemetry.counter_add("autotune/db_reset")
+            telemetry.counter_add(AUTOTUNE_DB_RESET)
             return self
         if not isinstance(doc, dict) or doc.get("schema_version") != SCHEMA_VERSION:
             log.warning(
@@ -83,7 +87,7 @@ class PlanStore:
                 doc.get("schema_version") if isinstance(doc, dict) else None,
                 SCHEMA_VERSION,
             )
-            telemetry.counter_add("autotune/db_reset")
+            telemetry.counter_add(AUTOTUNE_DB_RESET)
             return self
         entries = doc.get("entries")
         if isinstance(entries, dict):
@@ -107,7 +111,7 @@ class PlanStore:
                 "plan DB entry %s is invalid (%s) — ignoring it; re-run "
                 "tools/autotune.py to repopulate", key, e,
             )
-            telemetry.counter_add("autotune/db_reset")
+            telemetry.counter_add(AUTOTUNE_DB_RESET)
             return None
 
     def put(self, key: str, plan: ExecutionPlan,

@@ -137,7 +137,10 @@ class TrainConfig:
     number_of_learners: int = 1
     learner: str = "pg"  # {"pg", "grpo"}
     max_lora_rank: int = 32
-    lora_alpha: int = 16
+    # float (16 == 16.0 keeps reference-dict parity): lora_scale is
+    # alpha/rank float math and worker_main --lora-alpha is float — an
+    # int-only driver could not express an alpha the workers accept
+    lora_alpha: float = 16.0
     lora_dropout: float = 0.0
     topk: int = 16
     # HBM fraction for weights+KV (vLLM gpu_memory_utilization contract,

@@ -142,6 +142,13 @@ class Connection:
     def send(self, msg_type: int, req_id: int, payload: bytes = b"",
              timeout_ms: int = 30_000) -> None:
         with self._send_mu:
+            # the send mutex must span the whole native write: cp_send
+            # frames header+payload in one call, and two threads
+            # interleaving partial writes on one fd would corrupt the
+            # framing (the worker serves dispatch and weight-bus frames
+            # from separate threads over separate connections precisely so
+            # this lock is uncontended in steady state)
+            # graftcheck: disable=GC102 -- frame atomicity: one writer per fd for the whole native send
             rc = self._lib.cp_send(
                 self.fd, msg_type, req_id, payload, len(payload), timeout_ms
             )
@@ -561,7 +568,8 @@ class DriverClient:
                 )
                 if ok:
                     telemetry.hist_observe(
-                        "cp/rpc_ping_ms", (time.perf_counter() - t0) * 1e3
+                        resilience.CP_RPC_PING_MS,
+                        (time.perf_counter() - t0) * 1e3,
                     )
             except WorkerDeadError:
                 ok = False
@@ -634,7 +642,7 @@ class DriverClient:
             blob, body = pickle.loads(body)
             telemetry.ingest_remote(blob, track=f"worker {host}:{port}")
         telemetry.hist_observe(
-            "cp/rpc_dispatch_ms", (time.perf_counter() - t0) * 1e3
+            resilience.CP_RPC_DISPATCH_MS, (time.perf_counter() - t0) * 1e3
         )
         return body, meta
 

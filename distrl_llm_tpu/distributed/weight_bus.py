@@ -380,8 +380,13 @@ class WeightBus:
         self._req_id = 0
         self._id_mu = threading.Lock()
         # single-slot pending mailbox (LoraMailbox discipline): one tuple
-        # reference, written by push / consumed whole by the sender thread
+        # reference, written by push / consumed whole by the sender thread.
+        # The swap-out below runs under _pending_mu — an UNLOCKED consume
+        # (read slot, store None) would silently drop a push() landing
+        # between its read and its store (graftcheck GC103, same fix as
+        # LoraMailbox._pending_mu)
         self._pending: tuple | None = None
+        self._pending_mu = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._done = threading.Condition()
@@ -446,8 +451,9 @@ class WeightBus:
     def push(self, tree_np, version: int) -> None:
         """Enqueue (tree, version) for asynchronous broadcast. Non-blocking;
         supersedes any unsent push (double-buffered single slot)."""
-        self._pending = (tree_np, int(version))
-        self.last_pushed_version = int(version)
+        with self._pending_mu:
+            self._pending = (tree_np, int(version))
+            self.last_pushed_version = int(version)
         self._wake.set()
 
     def _drained(self) -> bool:
@@ -480,7 +486,8 @@ class WeightBus:
             self._wake.wait(timeout=0.1)
             if self._stop.is_set():
                 return
-            pending, self._pending = self._pending, None
+            with self._pending_mu:
+                pending, self._pending = self._pending, None
             self._wake.clear()
             if pending is None:
                 continue
@@ -587,6 +594,12 @@ class WeightBus:
                         if ctx is not None:
                             telemetry.emit_flow_start(ctx["dispatch_id"])
                         conn = self._channel(tuple(address))
+                        # the per-worker channel lock is MEANT to pin the
+                        # wire for the whole push+ack exchange: only the
+                        # sender thread and a rejoin/re-request resync ever
+                        # contend, and interleaving their frames would
+                        # corrupt the request/response pairing
+                        # graftcheck: disable=GC102 -- channel serialization: push+ack must be one uninterleaved exchange
                         conn.send(
                             MSG_WEIGHTS, rid, frame,
                             timeout_ms=self._ack_timeout_ms,
@@ -600,6 +613,7 @@ class WeightBus:
                             telemetry.counter_add(
                                 resilience.CP_WEIGHT_FULL_SYNCS
                             )
+                        # graftcheck: disable=GC102 -- same exchange: the ack belongs to the frame just sent on this channel
                         frame_back = conn.recv(self._ack_timeout_ms)
                         if frame_back is None:
                             raise WorkerDeadError(
@@ -655,6 +669,11 @@ class WeightBus:
                             version, host, port, e,
                         )
                         break
+                    # backoff INSIDE the channel lock on purpose: a resync
+                    # (sync_worker) slipping in mid-retry would race the
+                    # re-dial for the same worker's wire; nothing else
+                    # contends on this per-address lock
+                    # graftcheck: disable=GC102 -- per-worker retry backoff; the lock scope IS the retry exchange
                     time.sleep(self.retry.backoff(attempt))
                 except OSError as e:  # connect failure
                     if attempt >= self.retry.max_call_retries:
@@ -663,6 +682,7 @@ class WeightBus:
                             host, port, e,
                         )
                         break
+                    # graftcheck: disable=GC102 -- per-worker retry backoff; the lock scope IS the retry exchange
                     time.sleep(self.retry.backoff(attempt))
         # the worker is unreachable: clear acked so the eventual rejoin
         # resync starts from a full tensor

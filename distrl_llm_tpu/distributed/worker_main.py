@@ -390,11 +390,20 @@ def main(argv: list[str] | None = None) -> None:
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
+    # default None (no engine) vs the driver's reference-parity Qwen
+    # default: a worker must never silently download/load a 7B checkpoint
+    # just because the flag was omitted
+    # graftcheck: disable=GC402 -- worker default None = serve no model; the driver's model default is reference parity
     parser.add_argument("--serve-model", type=str, default=None,
                         help='"tiny" (random-init test model) or a local HF '
                              "checkpoint path; enables the generate op")
     parser.add_argument("--max-prompt-tokens", type=int, default=350)
     parser.add_argument("--max-new-tokens", type=int, default=1200)
+    # seed 0 vs driver 3407: this seeds the TINY test model's random
+    # init (every worker with the same seed holds identical weights); the
+    # driver's 3407 is the reference's dataset-split/training seed — they
+    # are different knobs that happen to share a name
+    # graftcheck: disable=GC402 -- worker seed inits the tiny test model, not the training run
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--lora-rank", type=int, default=32)
     parser.add_argument("--lora-alpha", type=float, default=16.0)
@@ -404,6 +413,10 @@ def main(argv: list[str] | None = None) -> None:
                         choices=["none", "int8"])
     parser.add_argument("--max-concurrent-sequences", type=int, default=0,
                         help="decode row cap (vLLM max_num_seqs); 0 = unlimited")
+    # driver-side spelling is --continuous_batching (a bool that maps to
+    # refill); the worker exposes the scheduler enum directly because it
+    # also hosts the waves/refill A/B harnesses
+    # graftcheck: disable=GC401 -- driver expresses this as --continuous_batching (bool -> refill)
     parser.add_argument("--scheduler", type=str, default="waves",
                         choices=["waves", "refill"],
                         help="paged-engine batching: whole-prompt waves or "
@@ -430,13 +443,25 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--spec-adapt", action="store_true",
                         help="acceptance-rate-driven draft-length "
                              "adaptation (requires --spec-draft)")
+    # default 0.0 (worst-case page pool) vs the driver's reference-parity
+    # 0.91: an unconfigured worker must size for the worst case rather
+    # than assume it owns 91% of an unknown chip's HBM
+    # graftcheck: disable=GC402 -- worker defaults to the conservative worst-case pool; 0.91 is driver-side reference parity
     parser.add_argument("--actor-gpu-usage", type=float, default=0.0,
                         help="HBM fraction for weights+KV (vLLM "
                              "gpu_memory_utilization); sizes the paged "
                              "engine's KV page pool. 0 = worst-case pool")
+    # worker-only: the driver derives prompts-per-round from
+    # batch_size x num_candidates; a remote worker cannot see that config
+    # and must be told explicitly (config.py rollout_workers contract)
+    # graftcheck: disable=GC401 -- driver derives this from batch_size x num_candidates
     parser.add_argument("--budget-batch", type=int, default=0,
                         help="prompts per round assumed by the page-budget "
                              "math (shared prompt-page region)")
+    # worker-only: bounds THIS worker's in-flight swap latency; the
+    # driver's local engines keep the engine default (remote engines are
+    # configured via worker_main flags by design — see _init_engine)
+    # graftcheck: disable=GC401 -- per-worker swap-latency pin; local engines use the engine default
     parser.add_argument("--decode-chunk", type=int, default=None,
                         help="decode steps per engine dispatch (unset = "
                              "engine default 128). The mailbox consuming "

@@ -50,6 +50,10 @@ Params = dict[str, Any]
 
 _logger = logging.getLogger(__name__)
 
+# chunked-dispatch fallback counter (one owner; every engine's chunk cache
+# funnels through compile_chunk_guarded here)
+ENGINE_CHUNK_FALLBACK = "engine/chunk_fallback"
+
 
 class GenerationResult(NamedTuple):
     tokens: np.ndarray  # [B, n, T] int32, pad-filled after EOS
@@ -353,7 +357,7 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
                 "host-dispatched steps",
                 what, temp / 2**30, alias_bytes / 2**30,
             )
-            telemetry.counter_add("engine/chunk_fallback")
+            telemetry.counter_add(ENGINE_CHUNK_FALLBACK)
             return None
         if (
             temp is not None and fusion_bytes
@@ -367,7 +371,7 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
                 "host-dispatched steps",
                 what, temp / 2**30, fusion_bytes / 2**30,
             )
-            telemetry.counter_add("engine/chunk_fallback")
+            telemetry.counter_add(ENGINE_CHUNK_FALLBACK)
             return None
         # measured roofline input (ISSUE 8): the XLA-reported FLOPs/bytes
         # of the accepted program, surfaced on the obs endpoint and in the
@@ -380,7 +384,7 @@ def compile_chunk_guarded(fn_jit, alias_bytes: int, what: str,
             "to host-dispatched steps",
             what, type(e).__name__, e,
         )
-        telemetry.counter_add("engine/chunk_fallback")
+        telemetry.counter_add(ENGINE_CHUNK_FALLBACK)
         return None
 
 
@@ -532,12 +536,15 @@ def run_nondivisor_tail(mailbox, lora_cell: list, steps_seen: list,
     consume pending adapters before each step, advancing ``steps_seen``.
     ``run_step(lora, state) -> state`` — the same closure shape
     ``make_swap_aware_chunk_step`` takes."""
+    # graftcheck: hot-region decode-tail
+    # graftcheck: disable=GC301 -- one blocking all-done read per WAVE at tail entry, not per decode step
     if not rem or bool(np.asarray(state.done).all()):
         return state
     for _ in range(rem):
         mailbox._take_pending_lora(lora_cell, steps_seen[0])
         steps_seen[0] += 1
         state = run_step(lora_cell[0], state)
+    # graftcheck: end-hot-region
     return state
 
 
@@ -557,6 +564,7 @@ def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
     check = max(1, min(decode_chunk, 16))
     snapshots: deque = deque()
     steps_done = 0
+    # graftcheck: hot-region decode
     while steps_done < max_steps:
         state = step_fn(state)
         steps_done += 1
@@ -569,11 +577,16 @@ def run_decode_loop(step_fn, state, max_steps: int, decode_chunk: int):
             snapshots.append(snap)
             stop = False
             while len(snapshots) > 1:
+                # delayed read of an ASYNC-copied snapshot: a newer copy is
+                # already in flight, so this waits on a transfer that
+                # finished ~check steps ago, never on the current step
+                # graftcheck: disable=GC301 -- reads a finished async copy >=1 check-intervals old
                 if bool(np.asarray(snapshots.popleft()).all()):
                     stop = True
                     break
             if stop:
                 break
+    # graftcheck: end-hot-region
     return state
 
 

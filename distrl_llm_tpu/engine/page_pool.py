@@ -29,6 +29,10 @@ import numpy as np
 
 from distrl_llm_tpu import telemetry
 
+# pool-occupancy gauge (one owner; trainer merges it per step, traced runs
+# render it as a Perfetto counter track)
+POOL_OCCUPANCY = "pool/occupancy"
+
 
 class PagePool:
     """Free-list page allocator + page-table builder (host-side, numpy)."""
@@ -83,7 +87,7 @@ class PagePool:
         # gauge for the MetricsSink series; while tracing is on this also
         # emits a Chrome counter event, so Perfetto renders pool pressure
         # as a time-series track aligned with the decode spans
-        telemetry.gauge_set("pool/occupancy", self.occupancy)
+        telemetry.gauge_set(POOL_OCCUPANCY, self.occupancy)
 
     def check_invariants(self) -> None:
         """free + owned must tile the pool exactly, with no page owned twice

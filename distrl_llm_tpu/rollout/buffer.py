@@ -37,6 +37,15 @@ from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.rollout.trajectory import Trajectory
 
 
+# telemetry series owned by the buffer (one defining owner per name —
+# graftcheck GC2xx; staleness.py imports ROLLOUT_DROPPED_STALE rather than
+# re-spelling it)
+ROLLOUT_BUFFER_OCCUPANCY = "rollout/buffer_occupancy"    # gauge
+ROLLOUT_BACKPRESSURE_WAITS = "rollout/backpressure_waits"  # counter
+ROLLOUT_DROPPED_CAPACITY = "rollout/dropped_capacity"    # counter
+ROLLOUT_DROPPED_STALE = "rollout/dropped_stale"          # counter
+
+
 class BufferClosed(RuntimeError):
     """put() after close() — the producer outlived the consumer."""
 
@@ -120,7 +129,7 @@ class TrajectoryBuffer:
                     if not waited:
                         waited = True
                         self.backpressure_waits += 1
-                        telemetry.counter_add("rollout/backpressure_waits")
+                        telemetry.counter_add(ROLLOUT_BACKPRESSURE_WAITS)
                     remaining = None
                     if deadline is not None:
                         import time
@@ -139,7 +148,7 @@ class TrajectoryBuffer:
             while len(self._q) >= limit:
                 evicted = self._q.popleft()
                 self.dropped_capacity += 1
-                telemetry.counter_add("rollout/dropped_capacity")
+                telemetry.counter_add(ROLLOUT_DROPPED_CAPACITY)
                 if self._ledger is not None:
                     self._ledger.on_dropped(evicted, "evicted_capacity")
             self._q.append(traj)
@@ -211,7 +220,7 @@ class TrajectoryBuffer:
                 lag = learner_version - traj.max_version
                 if lag > max_staleness:
                     dropped += 1
-                    telemetry.counter_add("rollout/dropped_stale")
+                    telemetry.counter_add(ROLLOUT_DROPPED_STALE)
                     if self._ledger is not None:
                         self._ledger.on_dropped(traj, "evicted_stale")
                 else:
@@ -250,7 +259,7 @@ class TrajectoryBuffer:
             self._drained.notify_all()
 
     def _occupancy_gauge_locked(self) -> None:
-        telemetry.gauge_set("rollout/buffer_occupancy", float(len(self._q)))
+        telemetry.gauge_set(ROLLOUT_BUFFER_OCCUPANCY, float(len(self._q)))
 
     # ----------------------------------------------------------- checkpoint
 

@@ -44,6 +44,9 @@ log = logging.getLogger(__name__)
 # produce(episode, batch_index, batch) -> completed trajectory groups
 ProduceFn = Callable[[int, int, dict[str, Any]], "list[Trajectory]"]
 
+# supervised-restart counter (one owner; the chaos smoke pins it)
+ROLLOUT_PRODUCER_RESTARTS = "rollout/producer_restarts"
+
 
 class RolloutService:
     """Continuous generation producer over an episode/batch stream."""
@@ -117,7 +120,7 @@ class RolloutService:
                         if self._stop or self.restarts_used >= self.max_restarts:
                             raise
                         self.restarts_used += 1
-                        telemetry.counter_add("rollout/producer_restarts")
+                        telemetry.counter_add(ROLLOUT_PRODUCER_RESTARTS)
                         log.warning(
                             "rollout producer failed on (episode %d, batch "
                             "%d); restart %d/%d: %r", episode, bi,

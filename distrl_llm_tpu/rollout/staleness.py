@@ -34,7 +34,14 @@ from __future__ import annotations
 from typing import Sequence
 
 from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.rollout.buffer import ROLLOUT_DROPPED_STALE
 from distrl_llm_tpu.rollout.trajectory import Trajectory
+
+# the admitted-group stalest-token-lag histogram (traced runs also get a
+# Perfetto counter track; tools/trace_report.py's rollout section and the
+# lineage reconciliation both read this exact name). Single owner here —
+# admission is the one place a group's realized lag is decided.
+ROLLOUT_STALENESS = "rollout/staleness"
 
 
 class StalenessPolicy:
@@ -98,14 +105,14 @@ class StalenessPolicy:
                 > self.max_staleness
             ):
                 self.dropped += 1
-                telemetry.counter_add("rollout/dropped_stale")
+                telemetry.counter_add(ROLLOUT_DROPPED_STALE)
                 if self._ledger is not None:
                     self._ledger.on_admission(
                         traj, learner_version=learner_version, lag=lag,
                         verdict="dropped_stale",
                     )
                 continue
-            telemetry.hist_observe("rollout/staleness", float(lag),
+            telemetry.hist_observe(ROLLOUT_STALENESS, float(lag),
                                    trace_sample=True)
             self.admitted += 1
             kept.append(traj)

@@ -1,0 +1,51 @@
+"""graftcheck: project-native static analysis for the distrl_llm_tpu tree.
+
+PRs 4-10 turned a single-threaded loop into a concurrent system — producer
+threads, the weight-bus sender, rejoin and metrics-server threads — and the
+post-review hardening logs show the same bug classes recurring by hand:
+torn reads, "one owner per series name" telemetry drift, and
+``worker_main`` vs ``train_distributed`` flag-parity gaps. graftcheck turns
+those review invariants into machine-checked rules (stdlib ``ast`` only, no
+new dependencies), run as a blocking stage in ``tools/run_all_checks.sh``:
+
+* **GC1xx — concurrency / lock discipline** (rules/locks.py): per-class
+  lock-acquisition graph over ``distributed/``, ``rollout/``, ``engine/``
+  and ``obs.py``; flags acquisition-order cycles (GC101), locks held across
+  blocking calls — socket send/recv, ``Thread.join``, ``time.sleep``,
+  native transport calls (GC102) — and unguarded read-modify-write of
+  attributes shared across thread entry points (GC103; single-reference
+  "single-slot tuple" publications are the documented exemption).
+* **GC2xx — telemetry schema** (rules/telemetry_schema.py): every series
+  name at a ``counter_add``/``gauge_set``/``hist_observe`` emit site must
+  be a module-level constant (GC201) with exactly one defining owner
+  (GC202); series the pinned consumers (``tests/test_telemetry.py``,
+  ``tools/trace_report.py``) reference must resolve against the emitted
+  universe (GC203) so a renamed series can never silently empty a report
+  section.
+* **GC3xx — host-sync lint** (rules/host_sync.py): inside the annotated
+  ``# graftcheck: hot-region <name>`` decode/refill/spec loops of
+  ``engine/``, flag host-synchronizing calls (``.item()``,
+  ``np.asarray``, ``jax.device_get``, ``.tolist()``) — each surviving one
+  must carry an inline suppression stating why it does not stall the
+  device (GC301).
+* **GC4xx — CLI parity** (rules/cli_parity.py): engine-facing worker_main
+  flags must exist driver-side (GC401) and shared flags must agree on
+  default, type and choices (GC402) — the bug class behind the PR 6/PR 9
+  post-review flag fixes.
+* **GC5xx — wire protocol** (rules/wire_protocol.py): ``MSG_*`` frame
+  constants unique (GC501) and each one handled somewhere in
+  ``WorkerServer`` (GC502).
+
+Inline suppression: ``# graftcheck: disable=GC102 -- <reason>`` on the
+flagged line or the line directly above. The checked-in baseline
+(``tools/graftcheck/baseline.json``, ``--update-baseline``) grandfathers
+findings so the gate starts at zero; it ships empty — every finding the
+first full run surfaced was fixed or suppressed-with-reason in the same PR.
+
+Run: ``python -m tools.graftcheck`` (exit 0 = clean). ``--dump-locks``
+prints the acquisition graph; ``--list-rules`` the rule ids.
+"""
+
+from tools.graftcheck.core import Finding, Project, run_project  # noqa: F401
+
+GRAFTCHECK_VERSION = "1.0"

@@ -1,0 +1,7 @@
+"""``python -m tools.graftcheck`` — the CI entry point."""
+
+import sys
+
+from tools.graftcheck.cli import main
+
+sys.exit(main())

@@ -1,0 +1,134 @@
+"""graftcheck CLI: one line per finding, baseline workflow, lock-graph dump.
+
+Usage (from the repo root — this is the blocking CI stage in
+``tools/run_all_checks.sh``):
+
+    python -m tools.graftcheck                 # gate: exit 0 = clean
+    python -m tools.graftcheck --update-baseline
+    python -m tools.graftcheck --dump-locks
+    python -m tools.graftcheck --list-rules
+    python -m tools.graftcheck --rules locks,wire_protocol
+
+Output format is ``file:line: RULEID message`` — grep/editor friendly, one
+finding per line. Exit status: 0 when every finding is inline-suppressed
+or baselined, 1 otherwise, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.graftcheck.core import (
+    Project,
+    load_baseline,
+    load_project,
+    run_project,
+    save_baseline,
+    split_baselined,
+)
+from tools.graftcheck.rules import RULE_IDS, RULES
+from tools.graftcheck.rules.locks import lock_graph
+from tools.graftcheck.rules.telemetry_schema import CONSUMER_FILES
+
+DEFAULT_BASELINE = os.path.join("tools", "graftcheck", "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="project-native static analysis for distrl_llm_tpu",
+    )
+    p.add_argument("--root", default=".",
+                   help="repo root to analyze (default: cwd)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule families to run (default: "
+                        f"all of {', '.join(sorted(RULES))})")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of grandfathered findings "
+                        "(relative to --root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write all current unsuppressed findings to the "
+                        "baseline file and exit 0")
+    p.add_argument("--dump-locks", action="store_true",
+                   help="print the lock-acquisition graph (nodes, edges, "
+                        "thread entry points) and exit")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule ids and one-line descriptions")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings only, no summary line")
+    return p
+
+
+def _dump_locks(project: Project) -> None:
+    graph = lock_graph(project)
+    print("# lock-acquisition graph")
+    print(f"# {len(graph.nodes)} locks, {len(graph.edges)} ordered "
+          f"acquisitions, {len(graph.entries)} classes with thread entry "
+          "points")
+    for owner, entries in sorted(graph.entries.items()):
+        print(f"threads {owner}: {', '.join(sorted(entries))}")
+    for node in sorted(graph.nodes):
+        marker = " (reentrant)" if node in graph.reentrant else ""
+        print(f"lock {node}{marker}")
+    for (a, b), (rel, line) in sorted(graph.edges.items()):
+        print(f"edge {a} -> {b}  [{rel}:{line}]")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, desc in sorted(RULE_IDS.items()):
+            print(f"{rid}  {desc}")
+        return 0
+    root = os.path.abspath(args.root)
+    project = load_project(root, extra_rel=CONSUMER_FILES)
+    for err in project.errors:
+        print(f"graftcheck: warning: {err}", file=sys.stderr)
+    if args.dump_locks:
+        _dump_locks(project)
+        return 0
+    rules = RULES
+    if args.rules:
+        if args.update_baseline:
+            # a partial-rules baseline write would silently DELETE every
+            # other family's grandfathered entries; the baseline is always
+            # regenerated from a full run
+            print("graftcheck: --update-baseline requires a full run "
+                  "(drop --rules)", file=sys.stderr)
+            return 2
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"graftcheck: unknown rule families: "
+                  f"{', '.join(sorted(unknown))} (have: "
+                  f"{', '.join(sorted(RULES))})", file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in RULES.items() if k in wanted}
+
+    findings, suppressed = run_project(project, rules)
+    baseline_path = os.path.join(root, args.baseline)
+    if args.update_baseline:
+        save_baseline(baseline_path, findings, project)
+        print(f"graftcheck: baseline updated with {len(findings)} "
+              f"finding(s) at {args.baseline}")
+        return 0
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    fresh, grandfathered = split_baselined(findings, baseline, project)
+    for f in fresh:
+        print(f.render())
+    if not args.quiet:
+        print(
+            f"graftcheck: {len(fresh)} finding(s), "
+            f"{len(grandfathered)} baselined, {suppressed} suppressed "
+            f"inline, {len(project.files)} files, "
+            f"{len(rules)} rule familie(s)"
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""graftcheck rule families. Each module exposes ``check(project) ->
+list[Finding]``; the registry here is what the CLI and tests iterate."""
+
+from tools.graftcheck.rules import (
+    cli_parity,
+    host_sync,
+    locks,
+    telemetry_schema,
+    wire_protocol,
+)
+
+RULES = {
+    "locks": locks.check,
+    "telemetry_schema": telemetry_schema.check,
+    "host_sync": host_sync.check,
+    "cli_parity": cli_parity.check,
+    "wire_protocol": wire_protocol.check,
+}
+
+RULE_IDS = {
+    "GC101": "lock-acquisition-order cycle",
+    "GC102": "lock held across a blocking call",
+    "GC103": "unguarded read-modify-write of a cross-thread attribute",
+    "GC201": "literal series name at a telemetry emit site",
+    "GC202": "telemetry series constant with more than one owner",
+    "GC203": "consumer references a series no emit site owns",
+    "GC301": "host-synchronizing call inside an annotated hot region",
+    "GC302": "engine package lost its hot-region annotations",
+    "GC401": "engine-facing worker flag missing from the driver CLI",
+    "GC402": "shared CLI flag disagrees on default/type/choices",
+    "GC501": "duplicate MSG_* wire frame value",
+    "GC502": "MSG_* frame constant unhandled by WorkerServer",
+}

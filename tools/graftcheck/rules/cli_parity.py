@@ -1,0 +1,187 @@
+"""GC4xx — CLI parity between the driver and worker entry points.
+
+``train_distributed.py`` and ``worker_main.py`` configure the SAME engine
+from two processes, and the repo's post-review history (PR 6's spec-flag
+pins, PR 9's weight-bus flag fixes) is a log of the two parsers drifting:
+a knob added driver-side but not worker-side, or added to both with
+different defaults — so the fleet silently samples under a different
+configuration than the driver assumes. Two rules:
+
+* **GC401** — every engine-facing worker flag (one whose ``args.X`` value
+  feeds ``_init_engine``) must have a driver-side counterpart, directly by
+  dest or through the documented alias table (``--serve-model``/
+  ``--model``, ``--lora-rank``/``--max_lora_rank``, …). Intentionally
+  worker-only knobs carry inline suppressions stating why the driver
+  derives the value instead.
+* **GC402** — flags present in BOTH parsers must agree on default, type,
+  choices and action. Intentional divergences (the worker's conservative
+  ``--actor-gpu-usage 0.0`` worst-case pool default) are suppressed with
+  the reason, which is exactly the review note that used to live only in
+  PR threads.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.graftcheck.core import Finding, Project, SourceFile, dotted_name
+
+DRIVER_FILE = "train_distributed.py"
+WORKER_FILE = "distrl_llm_tpu/distributed/worker_main.py"
+
+# driver dest -> worker dest for flags that are the same knob under two
+# spellings (one entry per historically-paired flag; additions here should
+# be rare and reviewed)
+ALIASES = {
+    "model": "serve_model",
+    "max_lora_rank": "lora_rank",
+    "kv_cache_quant": "kv_quant",
+    "workers_capture_logprobs": "capture_logprobs",
+}
+
+
+@dataclass
+class Arg:
+    dest: str
+    line: int
+    options: tuple[str, ...]
+    default: object = None
+    has_default: bool = False
+    type_name: str | None = None
+    choices: tuple | None = None
+    action: str | None = None
+
+
+def _literal(node: ast.expr) -> tuple[object, bool]:
+    try:
+        return ast.literal_eval(node), True
+    except (ValueError, SyntaxError):
+        return None, False
+
+
+def _parse_args(sf: SourceFile) -> dict[str, Arg]:
+    out: dict[str, Arg] = {}
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            continue
+        options = tuple(
+            a.value for a in node.args
+            if isinstance(a, ast.Constant) and isinstance(a.value, str)
+        )
+        if not options:
+            continue
+        arg = Arg(dest="", line=node.lineno, options=options)
+        for kw in node.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                arg.dest = str(kw.value.value)
+            elif kw.arg == "default":
+                arg.default, ok = _literal(kw.value)
+                arg.has_default = ok
+            elif kw.arg == "type":
+                arg.type_name = dotted_name(kw.value)
+            elif kw.arg == "choices":
+                val, ok = _literal(kw.value)
+                if ok and isinstance(val, (list, tuple)):
+                    arg.choices = tuple(val)
+            elif kw.arg == "action" and isinstance(kw.value, ast.Constant):
+                arg.action = str(kw.value.value)
+        if not arg.dest:
+            longs = [o for o in options if o.startswith("--")]
+            base = longs[0] if longs else options[0]
+            arg.dest = base.lstrip("-").replace("-", "_")
+        if arg.action in ("store_true", "store_false") and not arg.has_default:
+            arg.default = arg.action == "store_false"
+            arg.has_default = True
+        out[arg.dest] = arg
+    return out
+
+
+def _engine_facing_dests(sf: SourceFile) -> set[str]:
+    """Worker dests whose values flow into ``_init_engine`` — the flags
+    that shape the worker's engine and therefore must be expressible
+    driver-side too."""
+    dests: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) == "_init_engine"):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "args"):
+                dests.add(sub.attr)
+    return dests
+
+
+def check(project: Project) -> list[Finding]:
+    driver_sf = project.get(DRIVER_FILE)
+    worker_sf = project.get(WORKER_FILE)
+    if driver_sf is None or worker_sf is None:
+        return []
+    driver = _parse_args(driver_sf)
+    worker = _parse_args(worker_sf)
+    worker_to_driver = {w: d for d, w in ALIASES.items()}
+    findings: list[Finding] = []
+
+    # GC401: engine-facing worker flags need a driver counterpart
+    engine_dests = _engine_facing_dests(worker_sf)
+    for dest in sorted(engine_dests):
+        if dest not in worker:
+            continue  # derived expression, not a flag
+        driver_dest = worker_to_driver.get(dest, dest)
+        if driver_dest in driver:
+            continue
+        findings.append(Finding(
+            worker_sf.rel, worker[dest].line, "GC401",
+            f"engine-facing worker flag --{dest.replace('_', '-')} has no "
+            f"driver-side counterpart in {DRIVER_FILE} (checked dest "
+            f"'{driver_dest}') — a fleet knob the driver cannot express "
+            "is how sampling and training configs drift apart",
+        ))
+
+    # GC402: shared flags must agree on default/type/choices/action
+    for driver_dest, d in sorted(driver.items()):
+        worker_dest = ALIASES.get(driver_dest, driver_dest)
+        w = worker.get(worker_dest)
+        if w is None:
+            continue
+        diffs: list[str] = []
+        if d.has_default and w.has_default and d.default != w.default:
+            diffs.append(
+                f"default {d.default!r} (driver) vs {w.default!r} (worker)"
+            )
+        # an omitted type= is argparse's str (or a bool flag under
+        # store_true/false) — comparing EFFECTIVE types catches the
+        # "type forgotten on one side" drift too
+        def _eff_type(a: Arg) -> str:
+            if a.type_name is not None:
+                return a.type_name.rsplit(".", 1)[-1]
+            if a.action in ("store_true", "store_false"):
+                return "flag"
+            return "str"
+
+        if _eff_type(d) != _eff_type(w):
+            diffs.append(
+                f"type {_eff_type(d)} (driver) vs {_eff_type(w)} (worker)"
+            )
+        if d.choices is not None and w.choices is not None \
+                and tuple(d.choices) != tuple(w.choices):
+            diffs.append(
+                f"choices {list(d.choices)} (driver) vs "
+                f"{list(w.choices)} (worker)"
+            )
+        if d.action != w.action:
+            diffs.append(
+                f"action {d.action!r} (driver) vs {w.action!r} (worker)"
+            )
+        if diffs:
+            findings.append(Finding(
+                worker_sf.rel, w.line, "GC402",
+                f"shared flag '{driver_dest}' disagrees between the entry "
+                f"points: {'; '.join(diffs)} — align them or suppress "
+                "with the reason the divergence is intentional",
+            ))
+    return findings

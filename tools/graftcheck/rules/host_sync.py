@@ -1,0 +1,166 @@
+"""GC3xx — host-sync lint for the engine hot loops.
+
+Decode is launch-bound: the throughput ceiling of the rollout engines is
+set by how few host round-trips each decoded token costs, and one stray
+``np.asarray``/``.item()``/``float()`` in the decode loop re-serializes
+the device on every iteration (the class of regression
+tools/dispatch_probe.py exists to measure). The loops that must stay
+clean are *annotated in the source*:
+
+    # graftcheck: hot-region decode
+    while steps_done < max_steps:
+        ...
+    # graftcheck: end-hot-region
+
+Inside a region every host-synchronizing call is flagged (**GC301**):
+``.item()``, ``.tolist()``, ``np.asarray``/``np.array``/``np.copy``,
+``jax.device_get`` — plus ``float()``/``int()``/``bool()`` applied to a
+*device-tainted* value. Taint is intraprocedural and deliberately simple:
+the conventional ``state`` carry is tainted, as is any local assigned
+from an expression touching ``state.*``/``jnp.*`` or another tainted
+name; assigning through ``np.asarray``/``np.array``/``np.copy`` CLEARS
+taint (the conversion is the host boundary, and is itself flagged). This
+catches ``acc = float(atot_now)`` on a ``jnp.copy(state.draft_total)``
+without flagging ``int(seq_h[i])`` on an already-host snapshot.
+
+Intentional syncs — the delayed read of an async-copied done-snapshot,
+the opt-in spec-adapt boundary read — carry an inline
+``# graftcheck: disable=GC301 -- <why this does not stall>`` suppression,
+which doubles as the documentation reviewers previously re-derived per PR.
+
+**GC302** fires when ``engine/`` contains no annotated region at all: the
+lint must fail loudly if a refactor drops the markers, not silently pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import Finding, Project, SourceFile, dotted_name
+
+SCOPE_DIR = "distrl_llm_tpu/engine"
+
+_SYNC_DOTTED = {
+    "np.asarray", "np.array", "np.copy",
+    "numpy.asarray", "numpy.array", "numpy.copy",
+    "jax.device_get",
+}
+_SYNC_ATTRS = {"item", "tolist"}
+_HOST_CASTS = {"float", "int", "bool"}
+# outermost calls that move a value to the HOST — they clear taint on the
+# assigned name (the call itself is the flagged sync)
+_HOST_CONVERSIONS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "jax.device_get"}
+
+
+def _mentions_device(expr: ast.AST, tainted: set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Attribute):
+            dotted = dotted_name(n)
+            if dotted and (dotted.startswith("jnp.")
+                           or dotted.startswith("state.")):
+                return True
+    return False
+
+
+def _taint_locals(fn: ast.AST) -> set[str]:
+    """Names plausibly bound to device arrays within ``fn``. The carry
+    convention seeds it: ``state`` is always device."""
+    tainted: set[str] = {"state"}
+    for _ in range(2):  # tiny fixpoint: chains are short
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if (isinstance(value, ast.Call)
+                    and dotted_name(value.func) in _HOST_CONVERSIONS):
+                continue  # host boundary: the target is a host array
+            if not _mentions_device(value, tainted):
+                continue
+            for target in node.targets:
+                elts = (target.elts if isinstance(target, ast.Tuple)
+                        else [target])
+                for t in elts:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+    return tainted
+
+
+def _function_index(sf: SourceFile) -> list[ast.AST]:
+    return [n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    engine_files = project.in_dir(SCOPE_DIR)
+    total_regions = 0
+    for sf in engine_files:
+        if not sf.regions:
+            continue
+        total_regions += len(sf.regions)
+        # taint is per enclosing function; compute lazily per function
+        taint_cache: dict[int, set[str]] = {}
+        functions = _function_index(sf)
+
+        def taint_for(line: int) -> set[str]:
+            best = None
+            for fn in functions:
+                end = getattr(fn, "end_lineno", fn.lineno)
+                if fn.lineno <= line <= end:
+                    if best is None or fn.lineno > best.lineno:
+                        best = fn  # innermost enclosing function
+            if best is None:
+                return {"state"}
+            if id(best) not in taint_cache:
+                taint_cache[id(best)] = _taint_locals(best)
+            return taint_cache[id(best)]
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            region = sf.region_at(node.lineno)
+            if region is None:
+                continue
+            func = node.func
+            desc = None
+            dotted = dotted_name(func)
+            if dotted in _SYNC_DOTTED:
+                desc = dotted
+            elif (isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_ATTRS):
+                recv = dotted_name(func.value) or "<expr>"
+                desc = f"{recv}.{func.attr}"
+            elif (isinstance(func, ast.Name) and func.id in _HOST_CASTS
+                    and node.args):
+                arg = node.args[0]
+                # bool(np.asarray(x).all()) etc. flag on the INNER
+                # conversion only — one sync, one finding
+                inner_host = any(
+                    isinstance(n, ast.Call)
+                    and dotted_name(n.func) in _HOST_CONVERSIONS
+                    for n in ast.walk(arg)
+                )
+                if not inner_host and _mentions_device(
+                        arg, taint_for(node.lineno)):
+                    desc = f"{func.id}(<device value>)"
+            if desc is None:
+                continue
+            findings.append(Finding(
+                sf.rel, node.lineno, "GC301",
+                f"host-synchronizing call {desc}() inside hot region "
+                f"'{region.name}' — each one serializes the device per "
+                "loop iteration; move it out, batch it at a boundary, or "
+                "suppress with the reason it cannot stall",
+            ))
+    if engine_files and total_regions == 0:
+        anchor = min(engine_files, key=lambda s: s.rel)
+        findings.append(Finding(
+            anchor.rel, 1, "GC302",
+            "no '# graftcheck: hot-region' annotations found anywhere in "
+            f"{SCOPE_DIR}/ — the decode/refill/spec loops must stay "
+            "annotated or the host-sync lint checks nothing",
+        ))
+    return findings

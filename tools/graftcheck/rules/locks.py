@@ -1,0 +1,658 @@
+"""GC1xx — concurrency / lock-discipline rules.
+
+Builds a per-class (plus module-level) lock-acquisition graph across the
+concurrent core — ``distrl_llm_tpu/distributed/``, ``rollout/``,
+``engine/`` and ``obs.py`` — and checks three invariants reviewers have
+been re-deriving by hand since the async refactors:
+
+* **GC101** — inconsistent acquisition ordering: a cycle in the
+  acquisition graph (lock B taken while A is held somewhere, A taken
+  while B is held somewhere else) is a latent deadlock; so is re-entering
+  a non-reentrant ``threading.Lock`` while it is already held.
+  Acquisition edges are collected interprocedurally within a class: a
+  same-class method call made while holding a lock contributes the
+  callee's (transitive) acquisitions.
+* **GC102** — a lock held across a blocking call: socket/transport
+  send/recv (including the native ``cp_*`` C entry points),
+  ``time.sleep``, ``Thread.join``, ``Future.result``, ``Event.wait`` and
+  device syncs (``block_until_ready``/``device_get``). A
+  ``Condition.wait`` on the *held* condition (which releases it) is the
+  one exempt wait; conditions constructed over a shared lock
+  (``Condition(self._mu)``) are aliased to it, so the buffer's
+  ``self._drained.wait()`` under ``self._mu`` stays clean.
+* **GC103** — an attribute written read-modify-write (``+=``,
+  ``self.x = f(self.x)``) from more than one thread entry point without a
+  guarding lock. Single-reference stores (``self._pending = (a, b)`` /
+  ``= None`` / ``= name``) are the documented single-slot-tuple
+  publication pattern and are exempt — the GIL makes one store atomic;
+  it is the read-modify-write that tears.
+
+``lock_graph(project)`` exposes the graph for ``--dump-locks`` and the
+coverage test (the graph must span the control-plane, weight-bus, rollout
+service and obs threads).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.graftcheck.core import Finding, Project, SourceFile, dotted_name
+
+SCOPE_DIRS = (
+    "distrl_llm_tpu/distributed",
+    "distrl_llm_tpu/rollout",
+    "distrl_llm_tpu/engine",
+)
+SCOPE_FILES = ("distrl_llm_tpu/obs.py", "distrl_llm_tpu/telemetry.py")
+
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+_CONDITION_CTORS = {"Condition"}
+
+# attribute calls that block the calling thread (project-native transport
+# entry points included — graftcheck is allowed to know this codebase)
+_BLOCKING_ATTRS = {
+    "recv", "send", "sendall", "connect", "accept", "result",
+    "cp_send", "cp_recv_header", "cp_recv_payload", "cp_connect",
+    "cp_accept", "block_until_ready", "communicate",
+}
+_BLOCKING_DOTTED = {"time.sleep", "jax.device_get"}
+
+
+def _ctor_kind(value: ast.AST) -> str | None:
+    """'lock' / 'condition' / 'thread' when ``value`` is a
+    ``threading.X(...)`` (or bare ``X(...)``) constructor call."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    base = name.rsplit(".", 1)[-1]
+    if base in _LOCK_CTORS:
+        return "lock"
+    if base in _CONDITION_CTORS:
+        return "condition"
+    if base == "Thread":
+        return "thread"
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@dataclass
+class ClassLocks:
+    """Lock/thread inventory of one class."""
+
+    module: str
+    name: str
+    locks: dict[str, str] = field(default_factory=dict)  # attr -> kind
+    # Condition(self._mu) aliases the condition attr onto the shared lock
+    canon: dict[str, str] = field(default_factory=dict)
+    thread_attrs: set[str] = field(default_factory=set)
+    entries: set[str] = field(default_factory=set)  # thread-entry methods
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    reentrant: set[str] = field(default_factory=set)  # RLock attrs
+
+    def canonical(self, attr: str) -> str:
+        seen = set()
+        while attr in self.canon and attr not in seen:
+            seen.add(attr)
+            attr = self.canon[attr]
+        return attr
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{self.canonical(attr)}"
+
+
+def _collect_class(sf: SourceFile, cls: ast.ClassDef) -> ClassLocks:
+    info = ClassLocks(module=sf.rel, name=cls.name)
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[node.name] = node
+        elif isinstance(node, ast.AnnAssign):
+            ann = dotted_name(node.annotation)
+            if ann and ann.rsplit(".", 1)[-1] in (_LOCK_CTORS
+                                                  | _CONDITION_CTORS):
+                if isinstance(node.target, ast.Name):
+                    info.locks[node.target.id] = "lock"
+    for node in ast.walk(cls):
+        # self.X = threading.Lock() / Condition(...) / Thread(...),
+        # including container fills (self._mu_by_key[k] = Lock())
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            kind = _ctor_kind(node.value)
+            if kind is None:
+                continue
+            target = node.targets[0]
+            attr = _self_attr(target)
+            if attr is None and isinstance(target, ast.Subscript):
+                attr = _self_attr(target.value)
+            if attr is None:
+                continue
+            if kind == "thread":
+                info.thread_attrs.add(attr)
+                continue
+            info.locks[attr] = kind
+            call = node.value
+            fname = dotted_name(call.func) or ""
+            if fname.rsplit(".", 1)[-1] == "RLock":
+                info.reentrant.add(attr)
+            if kind == "condition" and call.args:
+                root = _self_attr(call.args[0])
+                if root is not None:
+                    info.canon[attr] = root
+        # thread entry points: threading.Thread(target=self.M, ...)
+        if isinstance(node, ast.Call) and _ctor_kind(node) == "thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    m = _self_attr(kw.value)
+                    if m is not None:
+                        info.entries.add(m)
+    # .setdefault(..., Lock()) fills on a dict attr register the dict as a
+    # lock family too (WeightBus._chan_mu)
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and len(node.args) >= 2
+                and _ctor_kind(node.args[1]) == "lock"):
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                info.locks[attr] = "lock"
+    return info
+
+
+def _module_locks(sf: SourceFile) -> dict[str, str]:
+    """Module-level ``NAME = threading.Lock()`` → name -> kind."""
+    out: dict[str, str] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            kind = _ctor_kind(node.value)
+            if kind in ("lock", "condition") and isinstance(
+                    node.targets[0], ast.Name):
+                out[node.targets[0].id] = kind
+    return out
+
+
+@dataclass
+class _MethodFacts:
+    """Per-method analysis output."""
+
+    acquires: set[str] = field(default_factory=set)
+    # (held lock id, acquired lock id, line) acquisition-order edges
+    edges: list[tuple[str, str, int]] = field(default_factory=list)
+    # self-method calls made while holding: (heldset, callee, line)
+    held_calls: list[tuple[frozenset, str, int]] = field(
+        default_factory=list)
+    # blocking call made while holding: (lock id, description, line)
+    blocking: list[tuple[str, str, int]] = field(default_factory=list)
+    # attr -> list of (rmw: bool, guarded: bool, line)
+    writes: dict[str, list[tuple[bool, bool, int]]] = field(
+        default_factory=dict)
+
+
+def _reads_attr(expr: ast.AST, attr: str) -> bool:
+    return any(
+        _self_attr(n) == attr and isinstance(n.ctx, ast.Load)
+        for n in ast.walk(expr) if isinstance(n, ast.Attribute)
+    )
+
+
+class _MethodVisitor:
+    """Walks one method body tracking the stack of held locks through
+    ``with`` statements. Nested function definitions are analyzed with an
+    EMPTY held stack (they run later, on whatever thread calls them)."""
+
+    def __init__(self, info: ClassLocks, module_locks: dict[str, str],
+                 mod_prefix: str):
+        self.info = info
+        self.module_locks = module_locks
+        self.mod_prefix = mod_prefix
+        self.facts = _MethodFacts()
+        # local names bound to a lock (mu = self._chan_mu.setdefault(...))
+        self.local_locks: dict[str, str] = {}
+        # local names bound to Thread objects (for .join detection)
+        self.local_threads: set[str] = set()
+
+    # ---------------------------------------------------- lock resolution
+
+    def _resolve_lock(self, expr: ast.AST) -> tuple[str, str] | None:
+        """(lock id, kind) when ``expr`` denotes a known lock."""
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.info.locks:
+            kind = self.info.locks[self.info.canonical(attr)] if (
+                self.info.canonical(attr) in self.info.locks
+            ) else self.info.locks[attr]
+            return self.info.lock_id(attr), kind
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                lock = self.local_locks[expr.id]
+                return lock, "lock"
+            if expr.id in self.module_locks:
+                return (f"{self.mod_prefix}.{expr.id}",
+                        self.module_locks[expr.id])
+        # self._locks[key] style container access
+        if isinstance(expr, ast.Subscript):
+            attr = _self_attr(expr.value)
+            if attr is not None and attr in self.info.locks:
+                return self.info.lock_id(attr), self.info.locks[attr]
+        return None
+
+    def _lock_in_expr(self, expr: ast.AST) -> str | None:
+        """A lock id mentioned ANYWHERE in ``expr`` (tracks
+        ``mu = self._chan_mu.setdefault(addr, Lock())``)."""
+        for n in ast.walk(expr):
+            got = self._resolve_lock(n)
+            if got is not None:
+                return got[0]
+        return None
+
+    # ----------------------------------------------------------- walking
+
+    def run(self, fn: ast.FunctionDef) -> _MethodFacts:
+        self._stmts(fn.body, held=[])
+        return self.facts
+
+    def _stmts(self, body: list[ast.stmt], held: list[str]) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: list[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # runs later, on its own thread — fresh held stack; facts
+            # accumulate into the same method record (conservative)
+            self._stmts(stmt.body, held=[])
+            return
+        if isinstance(stmt, ast.With):
+            entered: list[str] = []
+            for item in stmt.items:
+                got = self._resolve_lock(item.context_expr)
+                if got is None:
+                    self._expr(item.context_expr, held)
+                    continue
+                lock, _kind = got
+                self._note_acquire(lock, held, stmt.lineno)
+                entered.append(lock)
+            self._stmts(stmt.body, held + entered)
+            return
+        if isinstance(stmt, ast.Assign):
+            # remember lock-valued locals BEFORE scanning the expression
+            if (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                lock = self._lock_in_expr(stmt.value)
+                if lock is not None:
+                    self.local_locks[stmt.targets[0].id] = lock
+                if _ctor_kind(stmt.value) == "thread":
+                    self.local_threads.add(stmt.targets[0].id)
+            self._record_write(stmt, held)
+            self._expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._record_write(stmt, held)
+            self._expr(stmt.value, held)
+            return
+        # generic statement: visit nested statement lists with the same
+        # held stack, expressions for calls — including bodies hanging off
+        # non-stmt nodes (except handlers, match cases)
+        self._generic_fields(stmt, held)
+
+    def _generic_fields(self, node: ast.AST, held: list[str]) -> None:
+        for _fname, value in ast.iter_fields(node):
+            items = value if isinstance(value, list) else [value]
+            for v in items:
+                if isinstance(v, ast.stmt):
+                    self._stmt(v, held)
+                elif isinstance(v, ast.expr):
+                    self._expr(v, held)
+                elif isinstance(v, ast.AST):
+                    self._generic_fields(v, held)
+
+    def _record_write(self, stmt: ast.stmt, held: list[str]) -> None:
+        guarded = bool(held)
+        if isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            if attr is not None:
+                self.facts.writes.setdefault(attr, []).append(
+                    (True, guarded, stmt.lineno))
+            return
+        assert isinstance(stmt, ast.Assign)
+        targets: list[ast.expr] = []
+        for t in stmt.targets:
+            targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            rmw = _reads_attr(stmt.value, attr)
+            self.facts.writes.setdefault(attr, []).append(
+                (rmw, guarded, stmt.lineno))
+
+    # ------------------------------------------------------------- calls
+
+    def _expr(self, expr: ast.expr, held: list[str]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._call(node, held)
+
+    def _call(self, call: ast.Call, held: list[str]) -> None:
+        func = call.func
+        # lock.acquire() — an acquisition event for the ordering graph
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            got = self._resolve_lock(func.value)
+            if got is not None:
+                self._note_acquire(got[0], held, call.lineno)
+                return
+        # same-class method call while holding → interprocedural edges
+        if held and isinstance(func, ast.Attribute):
+            m = _self_attr(func)
+            if m is not None and m in self.info.methods:
+                self.facts.held_calls.append(
+                    (frozenset(held), m, call.lineno))
+        if held:
+            desc = self._blocking_desc(call, held)
+            if desc is not None:
+                for lock in held:
+                    self.facts.blocking.append((lock, desc, call.lineno))
+
+    def _blocking_desc(self, call: ast.Call,
+                       held: list[str]) -> str | None:
+        dotted = dotted_name(call.func)
+        if dotted in _BLOCKING_DOTTED:
+            return dotted
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr in ("wait", "wait_for"):
+            got = self._resolve_lock(call.func.value)
+            if got is not None and got[0] in held:
+                return None  # Condition.wait on the held lock: releases it
+            if got is not None or attr == "wait":
+                # a wait on some OTHER lock/event while holding this one
+                recv = dotted_name(call.func.value) or "<expr>"
+                return f"{recv}.{attr}"
+            return None
+        if attr == "join":
+            recv_attr = _self_attr(call.func.value)
+            if recv_attr is not None and recv_attr in self.info.thread_attrs:
+                return f"self.{recv_attr}.join"
+            if (isinstance(call.func.value, ast.Name)
+                    and call.func.value.id in self.local_threads):
+                return f"{call.func.value.id}.join"
+            return None
+        if attr in _BLOCKING_ATTRS:
+            recv = dotted_name(call.func.value) or "<expr>"
+            return f"{recv}.{attr}"
+        return None
+
+    def _note_acquire(self, lock: str, held: list[str],
+                      line: int) -> None:
+        self.facts.acquires.add(lock)
+        for h in held:
+            self.facts.edges.append((h, lock, line))
+
+
+# --------------------------------------------------------------- the graph
+
+
+@dataclass
+class LockGraph:
+    nodes: set[str] = field(default_factory=set)
+    # (a, b) -> (file, line) of one site acquiring b while holding a
+    edges: dict[tuple[str, str], tuple[str, int]] = field(
+        default_factory=dict)
+    reentrant: set[str] = field(default_factory=set)
+    blocking: list[tuple[str, str, str, int]] = field(
+        default_factory=list)  # (lock, desc, file, line)
+    rmw: list[tuple[str, str, str, int]] = field(
+        default_factory=list)  # (class.attr, why, file, line)
+    entries: dict[str, set[str]] = field(default_factory=dict)
+
+
+def _scoped(project: Project) -> list[SourceFile]:
+    out = list(project.in_dir(*SCOPE_DIRS))
+    for rel in SCOPE_FILES:
+        sf = project.get(rel)
+        if sf is not None and sf not in out:
+            out.append(sf)
+    return out
+
+
+def lock_graph(project: Project) -> LockGraph:
+    graph = LockGraph()
+    for sf in _scoped(project):
+        mod_prefix = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+        mlocks = _module_locks(sf)
+        for name in mlocks:
+            graph.nodes.add(f"{mod_prefix}.{name}")
+        # module-level functions see module locks only
+        classes = [n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.ClassDef)]
+        class_method_ids = {
+            id(m) for cls in classes for m in ast.walk(cls)
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for cls in classes:
+            info = _collect_class(sf, cls)
+            if info.entries:
+                graph.entries[f"{sf.rel}::{cls.name}"] = set(info.entries)
+            for attr in info.locks:
+                graph.nodes.add(info.lock_id(attr))
+            for attr in info.reentrant:
+                graph.reentrant.add(info.lock_id(attr))
+            facts: dict[str, _MethodFacts] = {}
+            for mname, fn in info.methods.items():
+                visitor = _MethodVisitor(info, mlocks, mod_prefix)
+                facts[mname] = visitor.run(fn)
+            # transitive same-class acquisitions (fixpoint over self-calls)
+            trans: dict[str, set[str]] = {
+                m: set(f.acquires) for m, f in facts.items()
+            }
+            changed = True
+            while changed:
+                changed = False
+                for mname, f in facts.items():
+                    for _held, callee, _line in f.held_calls:
+                        extra = trans.get(callee, set()) - trans[mname]
+                        if extra:
+                            trans[mname] |= extra
+                            changed = True
+            for mname, f in facts.items():
+                for a, b, line in f.edges:
+                    graph.edges.setdefault((a, b), (sf.rel, line))
+                for heldset, callee, line in f.held_calls:
+                    for acquired in trans.get(callee, set()):
+                        for h in heldset:
+                            graph.edges.setdefault(
+                                (h, acquired), (sf.rel, line))
+                for lock, desc, line in f.blocking:
+                    graph.blocking.append((lock, desc, sf.rel, line))
+            _shared_rmw(graph, sf, cls.name, info, facts)
+        # module-level functions (not methods): edges between module locks
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(fn) in class_method_ids:
+                continue
+            dummy = ClassLocks(module=sf.rel, name=mod_prefix)
+            visitor = _MethodVisitor(dummy, mlocks, mod_prefix)
+            f = visitor.run(fn)
+            for a, b, line in f.edges:
+                graph.edges.setdefault((a, b), (sf.rel, line))
+            for lock, desc, line in f.blocking:
+                graph.blocking.append((lock, desc, sf.rel, line))
+    graph.nodes.update(a for a, _ in graph.edges)
+    graph.nodes.update(b for _, b in graph.edges)
+    return graph
+
+
+def _shared_rmw(graph: LockGraph, sf: SourceFile, cls_name: str,
+                info: ClassLocks, facts: dict[str, _MethodFacts]) -> None:
+    """GC103 evidence: read-modify-write of an attribute written from
+    more than one thread entry point, unguarded."""
+    if not info.entries:
+        return
+    # reachability over the same-class call graph, per entry root
+    callees: dict[str, set[str]] = {
+        m: {c for _h, c, _l in f.held_calls} for m, f in facts.items()
+    }
+    # held_calls only records calls made WHILE HOLDING; for reachability we
+    # need all self-calls — recollect cheaply
+    for mname, fn in info.methods.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                m = _self_attr(node.func)
+                if m is not None and m in info.methods:
+                    callees.setdefault(mname, set()).add(m)
+
+    def reach(root: str) -> set[str]:
+        seen, stack = set(), [root]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(callees.get(cur, ()))
+        return seen
+
+    side: dict[str, frozenset] = {}
+    entry_reach = {e: reach(e) for e in info.entries}
+    for mname in facts:
+        roots = {e for e, r in entry_reach.items() if mname in r}
+        side[mname] = frozenset(roots) if roots else frozenset({"external"})
+    # attr -> set of sides that write it. Constructor writes are excluded:
+    # __init__ happens-before Thread.start(), so an attribute initialized
+    # there and then touched by exactly one thread side is not shared.
+    _CTORS = {"__init__", "__post_init__", "__new__"}
+    writers: dict[str, set[frozenset]] = {}
+    for mname, f in facts.items():
+        if mname in _CTORS:
+            continue
+        for attr in f.writes:
+            writers.setdefault(attr, set()).add(side[mname])
+    for mname, f in facts.items():
+        if mname in _CTORS:
+            continue
+        for attr, ws in f.writes.items():
+            if attr in info.locks or attr in info.thread_attrs:
+                continue
+            if len(writers.get(attr, set())) < 2:
+                continue  # single thread side: no cross-thread race
+            for rmw, guarded, line in ws:
+                if rmw and not guarded:
+                    graph.rmw.append((
+                        f"{cls_name}.{attr}",
+                        f"read-modify-write in {cls_name}.{mname} without "
+                        f"a lock, but {cls_name}.{attr} is written from "
+                        "more than one thread entry point",
+                        sf.rel, line,
+                    ))
+
+
+# ---------------------------------------------------------------- findings
+
+
+def _cycles(graph: LockGraph) -> list[list[str]]:
+    """Strongly connected components of size > 1, plus non-reentrant
+    self-loops, in deterministic order."""
+    nodes = sorted(graph.nodes)
+    adj: dict[str, list[str]] = {n: [] for n in nodes}
+    for (a, b) in graph.edges:
+        if a in adj:
+            adj[a].append(b)
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the engine files are deep; recursion limits)
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            neighbors = adj.get(node, [])
+            while pi < len(neighbors):
+                w = neighbors[pi]
+                pi += 1
+                work[-1] = (node, pi)
+                if w not in index:
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for n in nodes:
+        if n not in index:
+            strongconnect(n)
+    for (a, b) in sorted(graph.edges):
+        if a == b and a not in graph.reentrant:
+            sccs.append([a])
+    return sccs
+
+
+def check(project: Project) -> list[Finding]:
+    graph = lock_graph(project)
+    findings: list[Finding] = []
+    for scc in _cycles(graph):
+        if len(scc) == 1:
+            a = scc[0]
+            file, line = graph.edges[(a, a)]
+            findings.append(Finding(
+                file, line, "GC101",
+                f"non-reentrant lock {a} re-acquired while already held "
+                "(self-deadlock)",
+            ))
+            continue
+        # anchor the report at one edge inside the cycle
+        anchor = None
+        for (a, b), site in sorted(graph.edges.items()):
+            if a in scc and b in scc:
+                anchor = site
+                break
+        file, line = anchor if anchor else ("", 0)
+        findings.append(Finding(
+            file, line, "GC101",
+            "lock-acquisition-order cycle between "
+            + " <-> ".join(scc)
+            + " (latent deadlock: different threads can take them in "
+            "opposite orders)",
+        ))
+    for lock, desc, file, line in graph.blocking:
+        findings.append(Finding(
+            file, line, "GC102",
+            f"{lock} held across blocking call {desc}() — every other "
+            "thread contending for it stalls for the full call",
+        ))
+    for attr, why, file, line in graph.rmw:
+        findings.append(Finding(file, line, "GC103", why))
+    return findings

@@ -1,0 +1,225 @@
+"""GC2xx — telemetry schema rules.
+
+The telemetry registry's only schema is convention: series are "family/name"
+strings, and the repo's review history shows the drift this invites — the
+same series emitted from two modules, a renamed series silently emptying a
+trace_report section, a literal at the emit site diverging from the pinned
+test. Three rules make the convention machine-checked:
+
+* **GC201** — every series name passed to ``counter_add`` / ``gauge_set`` /
+  ``hist_observe`` must be a module-level constant reference (Name or
+  ``module.CONST`` attribute), not a string literal. Derived series built
+  as f-strings are fine when the *prefix* is a constant reference
+  (``f"{OBS_HBM_PEAK}/{phase}"``).
+* **GC202** — one owner per series: a series value defined as a
+  module-level UPPERCASE constant in more than one module (or twice in
+  one) is exactly the "two owners drift apart" failure mode; every module
+  but the first owner gets the finding.
+* **GC203** — the pinned consumers (``tests/test_telemetry.py``,
+  ``tools/trace_report.py``) must only reference series the instrumented
+  tree actually emits: constants, emit-site literals (until GC201 drives
+  them out), span names, or a derived-series prefix. A consumer string in
+  an emitted family that matches nothing is a report section that will
+  render empty forever.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftcheck.core import (
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+    module_constants,
+)
+
+EMIT_FNS = {"counter_add", "gauge_set", "hist_observe"}
+SPAN_FNS = {"span"}
+
+SERIES_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)+$")
+# registry summary suffixes metrics_snapshot derives from histograms
+_HIST_SUFFIXES = ("_count", "_mean", "_p50", "_p90", "_max")
+
+CONSUMER_FILES = ("tests/test_telemetry.py", "tools/trace_report.py")
+
+INSTRUMENTED_PREFIX = "distrl_llm_tpu/"
+
+
+def _is_series(value: object) -> bool:
+    return isinstance(value, str) and bool(SERIES_RE.match(value))
+
+
+def _instrumented(project: Project) -> list[SourceFile]:
+    return project.in_dir("distrl_llm_tpu")
+
+
+class _Registry:
+    """Everything known about series names across the instrumented tree."""
+
+    def __init__(self) -> None:
+        # value -> [(module rel, const name, line)]
+        self.owners: dict[str, list[tuple[str, str, int]]] = {}
+        # (module basename, CONST) -> value, for resolving mod.CONST refs
+        self.by_ref: dict[tuple[str, str], str] = {}
+        self.emitted: set[str] = set()       # resolved emit-site names
+        self.span_names: set[str] = set()
+        self.prefixes: set[str] = set()      # derived-series prefixes
+
+    def known(self, name: str) -> bool:
+        for suffix in _HIST_SUFFIXES:
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        if (name in self.emitted or name in self.span_names
+                or name in self.owners):
+            return True
+        return any(
+            name.startswith(p.rstrip("/") + "/") for p in self.prefixes
+        )
+
+    def families(self) -> set[str]:
+        fams = set()
+        for pool in (self.emitted, self.span_names, set(self.owners),
+                     self.prefixes):
+            for name in pool:
+                fams.add(name.split("/", 1)[0])
+        return fams
+
+
+def _collect_owners(project: Project, reg: _Registry) -> None:
+    for sf in _instrumented(project):
+        basename = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+        for name, (value, line) in module_constants(sf).items():
+            if not name.isupper() or not _is_series(value):
+                continue
+            reg.owners.setdefault(value, []).append((sf.rel, name, line))
+            reg.by_ref[(basename, name)] = value
+
+
+def _resolve_ref(sf: SourceFile, reg: _Registry,
+                 node: ast.expr) -> str | None:
+    """Constant value behind a Name / module.CONST reference, if known."""
+    if isinstance(node, ast.Name):
+        basename = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+        got = reg.by_ref.get((basename, node.id))
+        if got is not None:
+            return got
+        # from-imported constant: any scanned module owning that name
+        for (_mod, cname), value in reg.by_ref.items():
+            if cname == node.id:
+                return value
+        return None
+    dotted = dotted_name(node)
+    if dotted is not None and "." in dotted:
+        mod, cname = dotted.rsplit(".", 1)
+        return reg.by_ref.get((mod.rsplit(".", 1)[-1], cname))
+    return None
+
+
+def _first_arg(call: ast.Call) -> ast.expr | None:
+    return call.args[0] if call.args else None
+
+
+def _emit_calls(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if name in EMIT_FNS:
+            yield "emit", node
+        elif name in SPAN_FNS:
+            yield "span", node
+
+
+def check(project: Project) -> list[Finding]:
+    reg = _Registry()
+    _collect_owners(project, reg)
+    findings: list[Finding] = []
+
+    # pass 1: emit/span sites across the instrumented tree
+    for sf in _instrumented(project):
+        for kind, call in _emit_calls(sf):
+            arg = _first_arg(call)
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if kind == "span":
+                    reg.span_names.add(arg.value)
+                elif _is_series(arg.value):
+                    reg.emitted.add(arg.value)
+                    findings.append(Finding(
+                        sf.rel, call.lineno, "GC201",
+                        f'literal series name "{arg.value}" at the emit '
+                        "site — hoist it to a module-level constant with "
+                        "exactly one owner so consumers and tests can pin "
+                        "the name",
+                    ))
+                continue
+            if isinstance(arg, ast.JoinedStr) and arg.values:
+                head = arg.values[0]
+                if isinstance(head, ast.FormattedValue):
+                    prefix = _resolve_ref(sf, reg, head.value)
+                    if prefix is not None:
+                        reg.prefixes.add(prefix)
+                    continue
+                if (isinstance(head, ast.Constant)
+                        and isinstance(head.value, str)):
+                    if kind == "span":
+                        reg.prefixes.add(head.value.rstrip("/"))
+                    else:
+                        findings.append(Finding(
+                            sf.rel, call.lineno, "GC201",
+                            "derived series name starts with a string "
+                            f'literal "{head.value}" — start the f-string '
+                            "with a constant reference instead",
+                        ))
+                continue
+            resolved = _resolve_ref(sf, reg, arg)
+            if resolved is not None:
+                (reg.span_names if kind == "span"
+                 else reg.emitted).add(resolved)
+
+    # pass 2: one owner per series value
+    for value, defs in sorted(reg.owners.items()):
+        if len(defs) < 2:
+            continue
+        first = defs[0]
+        for rel, name, line in defs[1:]:
+            findings.append(Finding(
+                rel, line, "GC202",
+                f'series "{value}" already owned by {first[1]} in '
+                f"{first[0]}:{first[2]} — import that constant instead of "
+                f"re-defining it as {name}",
+            ))
+
+    # pass 3: pinned consumers must reference known series
+    families = reg.families()
+    for rel in CONSUMER_FILES:
+        sf = project.get(rel)
+        if sf is None:
+            continue
+        seen: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and _is_series(node.value)):
+                continue
+            name = node.value
+            if name in seen:
+                continue
+            seen.add(name)
+            if name.split("/", 1)[0] not in families:
+                continue  # not a registry family (timing/…, file paths)
+            if not reg.known(name):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "GC203",
+                    f'consumer references series "{name}" but no emit '
+                    "site, constant owner, or span in distrl_llm_tpu/ "
+                    "produces it — this section/pin can only ever be "
+                    "empty",
+                ))
+    return findings

@@ -1,0 +1,83 @@
+"""GC5xx — wire-protocol frame registry rules.
+
+The control plane's framing is a hand-rolled protocol: integer ``MSG_*``
+constants in ``control_plane.py``, matched by value in
+``WorkerServer._serve_conn``. Adding a frame type is a three-site edit
+(constant, sender, handler) with nothing enforcing the third — a frame
+that reaches a worker without a handler branch lands in the
+"unexpected frame type" log line and the sender times out. Two rules:
+
+* **GC501** — ``MSG_*`` values must be unique: two constants sharing a
+  value makes every match on the second silently handle the first.
+* **GC502** — every ``MSG_*`` constant must be referenced somewhere in
+  the ``WorkerServer`` class body (matched in the serve loop or sent as a
+  reply). An orphaned constant is either dead protocol or — worse — a
+  frame the driver sends that workers drop on the floor.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import Finding, Project
+
+PROTOCOL_FILE = "distrl_llm_tpu/distributed/control_plane.py"
+SERVER_CLASS = "WorkerServer"
+
+
+def _msg_constants(tree: ast.Module) -> dict[str, tuple[int, int]]:
+    """Module-level MSG_* = <int> constants: name -> (value, line)."""
+    out: dict[str, tuple[int, int]] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if not name.startswith("MSG_"):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+                node.value.value, int):
+            out[name] = (node.value.value, node.lineno)
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    sf = project.get(PROTOCOL_FILE)
+    if sf is None:
+        return []
+    consts = _msg_constants(sf.tree)
+    findings: list[Finding] = []
+
+    by_value: dict[int, str] = {}
+    for name, (value, line) in consts.items():
+        first = by_value.get(value)
+        if first is not None:
+            findings.append(Finding(
+                sf.rel, line, "GC501",
+                f"{name} = {value} collides with {first} — every match on "
+                f"{name} silently handles {first}'s frames",
+            ))
+        else:
+            by_value[value] = name
+
+    server = next(
+        (n for n in ast.walk(sf.tree)
+         if isinstance(n, ast.ClassDef) and n.name == SERVER_CLASS),
+        None,
+    )
+    if server is None:
+        return findings
+    referenced = {
+        n.id for n in ast.walk(server)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+    for name, (_value, line) in sorted(consts.items()):
+        if name not in referenced:
+            findings.append(Finding(
+                sf.rel, line, "GC502",
+                f"{name} is never referenced in {SERVER_CLASS} — a frame "
+                "type with no worker-side handling is dead protocol or a "
+                "silent drop; wire a branch in _serve_conn (or a reply "
+                "site) before shipping the constant",
+            ))
+    return findings

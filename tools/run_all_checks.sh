@@ -15,6 +15,13 @@ stage() {
   if "$@"; then echo "PASS $name"; else echo "FAIL $name"; fails=$((fails+1)); fi
 }
 
+# static-analysis gate (ISSUE 11): project-native AST lint — lock
+# discipline, telemetry schema, host-sync, CLI parity, wire protocol —
+# blocking, zero unsuppressed findings (suppress inline with
+# `# graftcheck: disable=GCxxx -- reason`, or grandfather deliberately via
+# `python -m tools.graftcheck --update-baseline`). Runs first: it needs no
+# devices and fails in seconds.
+stage "graftcheck" timeout 120 python -m tools.graftcheck
 stage "dryrun_multichip" timeout 300 python __graft_entry__.py
 stage "cli_smoke" env JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \

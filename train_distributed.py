@@ -43,7 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--number_of_learners", type=int, default=1)
     p.add_argument("--learner", type=str, default="pg", choices=["pg", "grpo"])
     p.add_argument("--max_lora_rank", type=int, default=32)
-    p.add_argument("--lora_alpha", type=int, default=16)
+    # float, matching worker_main --lora-alpha: lora_scale = alpha/rank is
+    # float math, and an int-typed driver could not express an alpha the
+    # workers accept (graftcheck GC402 caught the divergence)
+    p.add_argument("--lora_alpha", type=float, default=16.0)
     p.add_argument("--lora_dropout", type=float, default=0.0)
     p.add_argument("--topk", type=int, default=16)
     p.add_argument("--actor_gpu_usage", type=float, default=0.91)
