@@ -760,6 +760,17 @@ def main() -> int:
             # refill decode-page pool budget (--actor_gpu_usage equivalent);
             # exercises page-gated admission + preempt-by-recompute
             engine_kwargs["max_kv_pages"] = int(os.environ["BENCH_KV_PAGES"])
+        if os.environ.get("BENCH_PREFIX_SHARING") == "1":
+            # copy-on-write prompt-prefix sharing (ISSUE 12): a group's
+            # candidates alias one refcounted prompt page chain
+            engine_kwargs["prefix_sharing"] = True
+        if os.environ.get("BENCH_CONT_ADMISSION"):
+            # continuous admission A/B (ISSUE 12): 1 = lazy per-group
+            # prefill + pooled chains, 0 = pin the fixed-batch control
+            # past any stored plan (unset leaves the plan DB in charge)
+            engine_kwargs["continuous_admission"] = (
+                os.environ["BENCH_CONT_ADMISSION"] == "1"
+            )
     if os.environ.get("BENCH_MAX_CONCURRENT"):
         engine_kwargs["max_concurrent_rows"] = int(os.environ["BENCH_MAX_CONCURRENT"])
     # BENCH_EOS_RATE: approximate per-step stop probability. Random-init
@@ -924,6 +935,9 @@ def main() -> int:
             and (
                 n_prompts * n_cand > engine.max_concurrent_rows
                 or engine.spec_draft
+                # prefix sharing (and continuous admission, which implies
+                # it) pins the refill path even for small batches
+                or engine.prefix_sharing
             )
         )
         scheduler_ran = "refill" if engaged else "waves"
@@ -1131,6 +1145,29 @@ def main() -> int:
         "pct_of_roofline": round(100.0 * tps_chip / roofline, 2) if roofline else None,
         "hbm_gbps_assumed": hbm_gbps,
         "pool_stats": getattr(engine, "last_pool_stats", None),
+        # continuous-batching self-description (ISSUE 12, pinned in
+        # tests/test_bench_contract.py): which admission regime the round
+        # actually ran ("waves" | "refill" | "refill_shared" |
+        # "continuous"; null = dense/fleet rows), the fraction of
+        # admissions served by a SHARED refcounted prompt prefix and of
+        # in-use pages physically shared (last timed round's pool — both
+        # null when the refill pool never ran or sharing is off), and the
+        # fraction of slot-steps spent idle (the drain-tail/backfill
+        # number the continuous A/B moves; derived from the same
+        # alive_slot_steps counter, all repeats)
+        "cb_mode": getattr(engine, "last_cb_mode", None),
+        "prefill_shared_frac": (
+            (getattr(engine, "last_pool_stats", None) or {})
+            .get("prefill_shared_frac")
+        ),
+        "pages_shared_frac": (
+            (getattr(engine, "last_pool_stats", None) or {})
+            .get("pages_shared_frac")
+        ),
+        "slot_idle_frac": (
+            round(1.0 - alive_slot_steps / (steps_dispatched * slot_rows), 4)
+            if alive_slot_steps and steps_dispatched else None
+        ),
         # measured-attribution fields (ISSUE 8, pinned in
         # tests/test_bench_contract.py): device HBM watermark (null on
         # backends without memory stats), shape-keyed retrace count since

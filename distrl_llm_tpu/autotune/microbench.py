@@ -150,7 +150,18 @@ def build_engine_for_plan(
         paged_impl=PAGED_KERNEL_TO_IMPL.get(plan.paged_kernel, "auto"),
         pages_per_block=plan.pages_per_block,
     )
+    if plan.cb_mode is not None:
+        # the admission-regime candidate pins continuous admission on or
+        # off ("batch" measures the fixed-batch control); it needs the
+        # refill scheduler — the slot machinery that hosts both prefix
+        # sharing and the lazy group-admission queue
+        paged_kw["continuous_admission"] = plan.cb_mode == "continuous"
     if plan.decode_path == "paged":
+        if plan.cb_mode is not None:
+            paged_kw.update(
+                scheduler="refill",
+                max_concurrent_rows=max(min(rows, 64), 1),
+            )
         return PagedGenerationEngine(model_cfg, **paged_kw, **common)
     # speculative: refill scheduler hosts it; slots capped at the row
     # count. The plan's spec fields ARE the candidate (draft length,

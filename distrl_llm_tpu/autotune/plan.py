@@ -33,6 +33,11 @@ FORMULATIONS = (None, "dot", "mulred")
 PAGED_KERNELS = (None, "one_page", "folded", "blocked")
 SPEC_DRAFTERS = (None, "ngram", "self")
 SPEC_VERIFIES = (None, "fused", "unrolled")
+#: continuous-batching admission regimes for the paged refill scheduler
+#: (ISSUE 12): "continuous" = prefix-shared prompt chains + lazy per-group
+#: prefill feeding freed slots; "batch" = the fixed-episode-batch pin;
+#: None = the engine default (fixed batches)
+CB_MODES = (None, "batch", "continuous")
 #: draft lengths beyond this waste verify width faster than they amortize
 #: weight reads (and the engine rejects them) — plan validation mirrors it
 MAX_SPEC_DRAFT_LEN = 16
@@ -98,6 +103,13 @@ class ExecutionPlan:
     # verify attention: "fused" (one blocked sweep for the whole draft
     # block — ops/paged_native.py) | "unrolled" (d+1 per-position calls)
     spec_verify: str | None = None
+    # continuous-batching admission (refill scheduler only): "continuous"
+    # turns on prefix-shared prompt chains + the lazy per-group admission
+    # queue (paged_engine's continuous_admission kwarg); "batch" pins the
+    # fixed-episode-batch regime; None = engine default (fixed). Engines
+    # that can't host it (wave scheduler, no row cap) drop a stored
+    # "continuous" entry with a warning, same policy as the spec fields.
+    cb_mode: str | None = None
 
     def __post_init__(self):
         if self.decode_path not in DECODE_PATHS:
@@ -161,6 +173,10 @@ class ExecutionPlan:
             raise ValueError(
                 f"spec_verify must be one of {SPEC_VERIFIES}, got "
                 f"{self.spec_verify!r}"
+            )
+        if self.cb_mode not in CB_MODES:
+            raise ValueError(
+                f"cb_mode must be one of {CB_MODES}, got {self.cb_mode!r}"
             )
 
     def replace(self, **kw) -> "ExecutionPlan":
@@ -276,14 +292,16 @@ def candidate_plans(
     spec_draft_lens=(0,),
     spec_drafters=(None,),
     spec_verifies=(None,),
+    cb_modes=(None,),
 ) -> list[ExecutionPlan]:
     """Enumerate a candidate space for the tuner (cartesian product, with
     the always-meaningless combos dropped: a formulation override without a
     dense path, a scan_chunk of 1 — scan-of-one has no fusion benefit and
     the engines refuse to report it as chunked, a paged-kernel pin on the
     dense path, a pages_per_block without the blocked kernel, spec knobs
-    anywhere but the speculative path — and a speculative path with no
-    draft length, which is just the paged path wearing a costume)."""
+    anywhere but the speculative path, a cb_mode on the dense path — the
+    admission scheduler is paged-refill machinery — and a speculative path
+    with no draft length, which is just the paged path wearing a costume)."""
     out = []
     for path in decode_paths:
         for chunk in scan_chunks:
@@ -307,16 +325,20 @@ def candidate_plans(
                                 for sv in spec_verifies:
                                     if sv is not None and not sd:
                                         continue
-                                    for tp in top_p_impls:
-                                        out.append(ExecutionPlan(
-                                            decode_path=path,
-                                            scan_chunk=chunk,
-                                            cache_read_formulation=form,
-                                            top_p_impl=tp,
-                                            paged_kernel=pk,
-                                            pages_per_block=ppb,
-                                            spec_draft_len=sd,
-                                            spec_drafter=drafter,
-                                            spec_verify=sv,
-                                        ))
+                                    for cb in cb_modes:
+                                        if cb is not None and path == "dense":
+                                            continue
+                                        for tp in top_p_impls:
+                                            out.append(ExecutionPlan(
+                                                decode_path=path,
+                                                scan_chunk=chunk,
+                                                cache_read_formulation=form,
+                                                top_p_impl=tp,
+                                                paged_kernel=pk,
+                                                pages_per_block=ppb,
+                                                spec_draft_len=sd,
+                                                spec_drafter=drafter,
+                                                spec_verify=sv,
+                                                cb_mode=cb,
+                                            ))
     return out

@@ -294,6 +294,25 @@ class TrainConfig:
     # instead of draining whole waves. Requires engine_impl="paged" and a
     # max_concurrent_sequences cap.
     continuous_batching: bool = False
+    # copy-on-write prompt-prefix sharing (ISSUE 12): a group's N rollouts
+    # alias ONE refcounted prompt page chain (vLLM prefix caching) instead
+    # of each holding a private copy — the partial tail page splits
+    # copy-on-write at first decode write, prompt KV is resident ~once per
+    # group, and finished groups' prompt pages recycle into decode
+    # capacity. Greedy outputs are bit-identical to the unshared engine
+    # (pinned in tests/test_prefix_sharing.py). Requires
+    # continuous_batching (the refill scheduler's slot machinery).
+    prefix_sharing: bool = False
+    # serving-grade continuous admission (ISSUE 12): replace the
+    # fixed-episode-batch prefill with a group request queue — each
+    # prompt prefills lazily into pool-allocated chain pages as freed
+    # slots and page budget allow, so short completions backfill
+    # immediately instead of idling until the batch drains. Implies
+    # prefix_sharing (chains are pool-allocated); requires
+    # continuous_batching. Leaving BOTH flags unset keeps the engine
+    # plan-DB-resolvable (a stored cb_mode="continuous" entry may enable
+    # it; empty DB = historical fixed batches, byte-identical).
+    continuous_admission: bool = False
     # speculative decoding for the paged refill engine: draft spec_draft
     # tokens per step and verify them in one forward (the verify attention
     # runs as ONE fused blocked kernel sweep — spec_verify); rejection
@@ -614,6 +633,18 @@ class TrainConfig:
             raise ValueError(
                 "spec_draft (speculative decoding) requires "
                 "continuous_batching (the refill scheduler hosts it)"
+            )
+        # dead-flag policy (mirrors the spec satellite knobs): prefix
+        # sharing and continuous admission live on the refill scheduler —
+        # without continuous_batching they would silently never engage
+        if (self.prefix_sharing or self.continuous_admission) and (
+            not self.continuous_batching
+        ):
+            raise ValueError(
+                "prefix_sharing/continuous_admission run on the refill "
+                "scheduler — set continuous_batching (and a "
+                "max_concurrent_sequences cap); they would be silently "
+                "ignored otherwise"
             )
         if self.spec_draft is not None and not 0 <= self.spec_draft <= 16:
             raise ValueError(

@@ -59,6 +59,8 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  spec_draft: int | None = None, spec_ngram: int | None = None,
                  spec_drafter: str | None = None,
                  spec_verify: str | None = None, spec_adapt: bool = False,
+                 prefix_sharing: bool = False,
+                 continuous_admission: bool = False,
                  gpu_usage: float = 0.0,
                  budget_batch: int = 0, scan_chunk: int | None = None,
                  autotune: bool = True, plan_db: str | None = None,
@@ -137,6 +139,13 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
             kwargs["spec_verify"] = spec_verify
         if spec_adapt:
             kwargs["spec_adapt"] = True
+        # forwarded only when set (trainer convention): an unset worker
+        # stays plan-DB-resolvable at the engine (cb_mode field) and the
+        # empty-DB default remains the historical fixed batches
+        if prefix_sharing:
+            kwargs["prefix_sharing"] = True
+        if continuous_admission:
+            kwargs["continuous_admission"] = True
         if gpu_usage > 0:
             # --actor-gpu-usage → KV page budget, same contract as the
             # trainer's local engine (engine/budget.py)
@@ -163,6 +172,10 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                 # construction, so its ≤d extra resident tokens/row ride
                 # the pool's refill-admission slack instead
                 spec_draft=spec_draft or 0,
+                # same convention: only the explicit flag reshapes the
+                # pool math (chains move into the pool); a plan-DB-enabled
+                # continuous run surfaces as the engine's pool-floor error
+                continuous=continuous_admission,
             )
     else:
         engine_cls = GenerationEngine
@@ -443,6 +456,17 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--spec-adapt", action="store_true",
                         help="acceptance-rate-driven draft-length "
                              "adaptation (requires --spec-draft)")
+    parser.add_argument("--prefix-sharing", action="store_true",
+                        help="copy-on-write prompt-prefix sharing: a "
+                             "group's candidates alias one refcounted "
+                             "prompt page chain (requires --scheduler "
+                             "refill); greedy-bit-identical to unshared")
+    parser.add_argument("--continuous-admission", action="store_true",
+                        help="lazy per-group prefill feeding freed slots "
+                             "from a request queue instead of the fixed "
+                             "episode batch; implies --prefix-sharing "
+                             "(requires --scheduler refill). Unset leaves "
+                             "this host's autotune plan DB in charge")
     # default 0.0 (worst-case page pool) vs the driver's reference-parity
     # 0.91: an unconfigured worker must size for the worst case rather
     # than assume it owns 91% of an unknown chip's HBM
@@ -543,6 +567,15 @@ def main(argv: list[str] | None = None) -> None:
             "require --spec-draft > 0 (--spec-draft 0 pins speculation "
             "off, so they would be silently ignored)"
         )
+    if args.scheduler != "refill" and (
+        args.prefix_sharing or args.continuous_admission
+    ):
+        # same dead-flag policy as the spec satellites: the refill
+        # scheduler hosts the prefix-sharing pool and admission queue
+        parser.error(
+            "--prefix-sharing/--continuous-admission require --scheduler "
+            "refill (the refill scheduler hosts the shared page pool)"
+        )
     if args.scheduler == "refill" and not args.max_concurrent_sequences:
         parser.error(
             "--scheduler refill requires --max-concurrent-sequences "
@@ -559,6 +592,8 @@ def main(argv: list[str] | None = None) -> None:
             spec_draft=args.spec_draft,
             spec_ngram=args.spec_ngram, spec_drafter=args.spec_drafter,
             spec_verify=args.spec_verify, spec_adapt=args.spec_adapt,
+            prefix_sharing=args.prefix_sharing,
+            continuous_admission=args.continuous_admission,
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
             scan_chunk=args.decode_scan_chunk,
             autotune=args.autotune == "on", plan_db=args.plan_db,

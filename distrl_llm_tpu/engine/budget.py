@@ -72,28 +72,32 @@ def kv_pool_pages(
     kv_quant: str = "none",
     spec_draft: int = 0,
     hbm_bytes: int | None = None,
+    continuous: bool = False,
 ) -> int:
     """Pages available to the refill decode pool under ``gpu_usage``.
 
     Subtracts, in order: the (1 - usage) exclusion the knob demands, the
     activation reserve, resident weights, and the SHARED prompt page region
     (batch_prompts × prompt_pages — prefill owns those regardless of the
-    pool). Clamped below at the single-sequence minimum the engine requires,
-    so a too-small budget degrades to serial decoding instead of refusing to
+    pool). With ``continuous`` (ISSUE 12 continuous admission) prompt
+    chains are allocated FROM the pool, so the static region subtraction
+    drops — those bytes become pool capacity — and the single-sequence
+    floor carries one prompt chain. Clamped below at that minimum, so a
+    too-small budget degrades to serial decoding instead of refusing to
     run (with a warning naming the shortfall)."""
     from distrl_llm_tpu.ops.paged import pages_per_seq
 
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     pb = page_bytes(model_cfg, page_size, kv_quant)
     prompt_pages = pages_per_seq(max_prompt_tokens, page_size)
-    shared_bytes = batch_prompts * prompt_pages * pb
+    shared_bytes = 0 if continuous else batch_prompts * prompt_pages * pb
     budget = int(
         hbm * (gpu_usage - ACTIVATION_RESERVE) - param_bytes - shared_bytes
     )
     pool = budget // pb if budget > 0 else 0
     private_pages = 1 + pages_per_seq(max_new_tokens + max(spec_draft, 0),
                                       page_size)
-    floor = 1 + private_pages
+    floor = 1 + private_pages + (prompt_pages if continuous else 0)
     if pool < floor:
         log.warning(
             "actor_gpu_usage=%.2f leaves %d KV pages (< single-sequence "

@@ -12,7 +12,9 @@ plain Python against a free list.
 
 Layout contract (shared with paged_engine):
 * shared prompt pages occupy ids [0, b·prompt_pages) — written once by
-  prefill, never owned by the pool;
+  prefill. In the legacy layout they are a static region the pool never
+  tracks; with ``prefix_sharing`` they are REGISTERED as refcounted prefix
+  chains (below) and return to the free list when their group finishes;
 * pool pages occupy [first_page, first_page + n_pages); page ``first_page``
   is the SCRATCH page: every dead slot's table row points all columns at it,
   so dead slots' garbage decode writes land somewhere harmless that no live
@@ -21,6 +23,20 @@ Layout contract (shared with paged_engine):
   then its owned pages (partial prompt page first), trailing columns clamped
   to the last owned page (the attention gather reads the whole width; clamped
   columns are beyond every read window).
+
+Prefix sharing (ISSUE 12 — vLLM's copy-on-write prefix caching, host-side):
+a prompt's page chain is registered once per GROUP (``register_prefix`` /
+``alloc_prefix``) with a refcount HOLD; every admitted candidate ALIASES the
+chain's full pages (refcount++ each) instead of pointing at an untracked
+static region, and the partial tail page — the one decode extends in place —
+is attached copy-on-write: the first write into it (``note_write``, or the
+``first_write`` hint ``admit`` receives, since the scheduler knows the first
+decode write is imminent) SPLITS it into a freshly granted private page with
+the device copy queued for the caller to fuse into its next admit dispatch.
+``release`` only decrements; a page returns to the free list when its
+refcount hits zero — so a group's prompt KV is resident ~once instead of
+once per candidate, and finished groups' prompt pages recycle into decode
+capacity.
 """
 
 from __future__ import annotations
@@ -30,8 +46,15 @@ import numpy as np
 from distrl_llm_tpu import telemetry
 
 # pool-occupancy gauge (one owner; trainer merges it per step, traced runs
-# render it as a Perfetto counter track)
+# render it as a Perfetto counter track). Occupancy counts each PHYSICAL
+# page once however many owners reference it (refcount-aware — the per-slot
+# sum would over-report under sharing and read > 1.0).
 POOL_OCCUPANCY = "pool/occupancy"
+# fraction of in-use pages currently referenced by >= 2 owners (prefix
+# sharing live); 0.0 on unshared pools
+POOL_SHARED_FRAC = "pool/shared_frac"
+# copy-on-write tail-page splits (one device page-copy each)
+POOL_COW_SPLITS = "pool/cow_splits"
 
 
 class PagePool:
@@ -46,6 +69,7 @@ class PagePool:
         width: int,  # table columns (prompt_pages + private_pages)
         page_size: int,
         prompt_pages: int,
+        prefix_sharing: bool = False,  # refcounted CoW prefix chains
     ):
         if n_pages < 2:
             raise ValueError(f"pool needs >= 2 pages (scratch + 1), got {n_pages}")
@@ -53,6 +77,7 @@ class PagePool:
         self.page_size = page_size
         self.prompt_pages = prompt_pages
         self.n_pages = n_pages
+        self.prefix_sharing = prefix_sharing
         # LIFO free list: recently-released pages are re-granted first (their
         # tiles are warm in whatever cache level still holds them)
         self.free: list[int] = list(
@@ -63,6 +88,28 @@ class PagePool:
         self.table = np.full((r_slots, width), self.scratch, np.int32)
         self.peak_pages_used = 0
         self.preemptions = 0
+        # ---- prefix-sharing state (all empty / inert when sharing is off)
+        # per-page refcount for SHARED pages only (chain holds + slot
+        # aliases); privately owned pages are implicitly refcount 1
+        self.ref: dict[int, int] = {}
+        # prompt -> (chain page ids, full-page count). len(chain) > full
+        # means the last entry is the pristine partial TAIL page.
+        self.chains: dict[int, tuple[list[int], int]] = {}
+        # per-slot aliased full-prefix pages (leading table columns)
+        self.shared: list[list[int]] = [[] for _ in range(r_slots)]
+        # per-slot tail page still attached copy-on-write (pre-split)
+        self.tail_shared: list[int | None] = [None] * r_slots
+        # per-slot queued (src -> owned[slot][0]) CoW copy from the last
+        # admit/split; the engine drains it into the admit dispatch
+        self.copy_src: list[int | None] = [None] * r_slots
+        # pages outside [first_page, first_page + n_pages) the pool has
+        # adopted (a static prompt region registered/reclaimed into it)
+        self.adopted: set[int] = set()
+        # stats the bench/telemetry satellites read
+        self.cow_splits = 0
+        self.peak_shared_pages = 0
+        self.prefix_admissions = 0
+        self.total_admissions = 0
         # opt-in per-boundary self-check (tests; DISTRL_POOL_CHECK=1)
         import os
 
@@ -71,32 +118,83 @@ class PagePool:
     # -- accounting --------------------------------------------------------
 
     @property
+    def universe_pages(self) -> int:
+        """Allocatable physical pages (scratch excluded, adoptions included)."""
+        return self.n_pages - 1 + len(self.adopted)
+
+    @property
     def free_pages(self) -> int:
         return len(self.free)
 
     @property
     def used_pages(self) -> int:
-        return sum(len(o) for o in self.owned)
+        """Physical pages in use, each counted ONCE however many owners
+        reference it (refcount-aware: equals the per-slot sum on unshared
+        pools, where ownership is disjoint by construction)."""
+        return self.universe_pages - len(self.free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Distinct pages currently referenced by >= 2 owners (a chain hold
+        counts as an owner — a held chain page aliased by one slot is
+        physically one page serving two futures)."""
+        return sum(1 for c in self.ref.values() if c >= 2)
 
     @property
     def occupancy(self) -> float:
         """Fraction of allocatable pages (scratch excluded) currently owned."""
-        return self.used_pages / max(self.n_pages - 1, 1)
+        return self.used_pages / max(self.universe_pages, 1)
 
     def _record_occupancy(self) -> None:
         # gauge for the MetricsSink series; while tracing is on this also
         # emits a Chrome counter event, so Perfetto renders pool pressure
         # as a time-series track aligned with the decode spans
         telemetry.gauge_set(POOL_OCCUPANCY, self.occupancy)
+        if self.prefix_sharing:
+            telemetry.gauge_set(
+                POOL_SHARED_FRAC, self.shared_pages / max(self.used_pages, 1)
+            )
+
+    def _note_peaks(self) -> None:
+        self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+        if self.prefix_sharing:
+            self.peak_shared_pages = max(
+                self.peak_shared_pages, self.shared_pages
+            )
 
     def check_invariants(self) -> None:
-        """free + owned must tile the pool exactly, with no page owned twice
-        (test hook; O(pool) but pools are small on the host)."""
-        all_pages = sorted(self.free + [p for o in self.owned for p in o])
-        expected = list(range(self.scratch + 1, self.scratch + self.n_pages))
-        assert all_pages == expected, (
-            f"pool accounting broken: {len(all_pages)} tracked vs "
-            f"{len(expected)} expected"
+        """free + private owned + shared/held must tile the pool exactly —
+        each physical page tracked exactly once — and every refcount must
+        equal its recomputed owner count (test hook; O(pool) but pools are
+        small on the host)."""
+        expected = sorted(
+            set(range(self.scratch + 1, self.scratch + self.n_pages))
+            | self.adopted
+        )
+        private = [p for o in self.owned for p in o]
+        tracked = sorted(self.free + private + list(self.ref))
+        assert tracked == expected, (
+            f"pool accounting broken: {len(tracked)} tracked vs "
+            f"{len(expected)} expected (free={len(self.free)}, "
+            f"private={len(private)}, shared={len(self.ref)})"
+        )
+        # refcount conservation: recompute every shared page's owner count
+        # from the chains + per-slot alias lists and compare
+        recount: dict[int, int] = {}
+        for pages, _full in self.chains.values():
+            for p in pages:
+                recount[p] = recount.get(p, 0) + 1
+        for slot_pages in self.shared:
+            for p in slot_pages:
+                recount[p] = recount.get(p, 0) + 1
+        for p in self.tail_shared:
+            if p is not None:
+                recount[p] = recount.get(p, 0) + 1
+        assert recount == self.ref, (
+            f"refcount drift: recomputed {recount} vs tracked {self.ref}"
+        )
+        assert all(c >= 1 for c in self.ref.values()), (
+            f"zero-ref residue in shared table: {self.ref}"
         )
 
     # -- sizing helpers ----------------------------------------------------
@@ -106,36 +204,250 @@ class PagePool:
         ``last_position`` (positions below full·ps live in shared pages)."""
         return max(last_position // self.page_size - int(self.full[slot]) + 1, 1)
 
+    # -- prefix chains (prefix_sharing only) -------------------------------
+
+    def register_prefix(self, prompt_idx: int, pages: list[int],
+                        full_count: int) -> None:
+        """Adopt ``pages`` as prompt ``prompt_idx``'s prefix chain with a
+        refcount HOLD: ``full_count`` leading full pages (never written
+        again) plus, when ``len(pages) > full_count``, the pristine partial
+        tail page. Pages outside the pool range (a static prefill region)
+        are adopted into the pool's universe — they return to the free list
+        when the chain drops and every alias releases."""
+        assert self.prefix_sharing, "register_prefix needs prefix_sharing"
+        assert prompt_idx not in self.chains, f"prompt {prompt_idx} re-registered"
+        assert len(pages) in (full_count, full_count + 1), (
+            f"chain length {len(pages)} vs full_count {full_count}"
+        )
+        for p in pages:
+            if not (self.scratch < p < self.scratch + self.n_pages):
+                self.adopted.add(p)
+            self.ref[p] = self.ref.get(p, 0) + 1
+        self.chains[prompt_idx] = (list(pages), full_count)
+        self._note_peaks()
+
+    def alloc_prefix(self, prompt_idx: int, n_chain: int,
+                     full_count: int) -> list[int] | None:
+        """Allocate a prompt's prefix chain FROM the pool (continuous
+        admission: prefill writes into pool pages) and register it. None —
+        and no state change — when the free list can't cover it."""
+        assert self.prefix_sharing, "alloc_prefix needs prefix_sharing"
+        if n_chain > len(self.free):
+            return None
+        pages = [self.free.pop() for _ in range(n_chain)]
+        self.register_prefix(prompt_idx, pages, full_count)
+        self._record_occupancy()
+        return pages
+
+    def reclaim(self, pages: list[int]) -> None:
+        """Feed unused static-region pages (a dead prompt's region, the
+        slack beyond a short prompt's real chain) into the free list as
+        decode capacity."""
+        assert self.prefix_sharing, "reclaim needs prefix_sharing"
+        for p in pages:
+            assert p not in self.ref and p not in self.free, f"page {p} live"
+            if not (self.scratch < p < self.scratch + self.n_pages):
+                self.adopted.add(p)
+            self.free.append(p)
+
+    def drop_prefix(self, prompt_idx: int) -> None:
+        """Release the group hold: every chain page loses one reference and
+        frees when (and only when) no admitted slot still aliases it."""
+        pages, _full = self.chains.pop(prompt_idx)
+        for p in pages:
+            self._deref(p)
+        self._record_occupancy()
+
+    def _deref(self, page: int) -> None:
+        c = self.ref[page] - 1
+        if c:
+            self.ref[page] = c
+        else:
+            del self.ref[page]
+            self.free.append(page)
+
     # -- transitions -------------------------------------------------------
 
     def admit(
-        self, slot: int, prompt_idx: int, real_len: int, last_position: int
+        self, slot: int, prompt_idx: int, real_len: int, last_position: int,
+        donor: int | None = None, first_write: int | None = None,
     ) -> bool:
         """Claim pages for an admission covering writes through
         ``last_position``; build the slot's table row. False (and no state
-        change) when the free list can't cover it."""
+        change) when the free list can't cover it.
+
+        With a registered prefix chain (or a ``donor`` slot of the same
+        prompt), the chain's full pages are ALIASED (refcount++) instead of
+        granted, and the partial tail page is attached copy-on-write: when
+        ``first_write`` (the scheduler's imminent first decode write —
+        always ``real_len`` in the engine) lands inside it, the split runs
+        as part of admission (``copy_src[slot]`` carries the device copy
+        source for the caller's admit dispatch); otherwise the tail stays
+        shared until ``note_write`` — except a tail sourced from a donor's
+        PRIVATE page (its chain already dropped), which always splits
+        immediately: that page is mutable and owned-tracked, never
+        refcount-attachable. ``donor`` aliases that slot's prefix
+        columns — by construction the same physical pages as the chain's —
+        and exists so a group sibling can donate even in pools driven
+        without a chain ledger (property tests)."""
         assert not self.owned[slot], f"slot {slot} admitted while owning pages"
+        assert not self.shared[slot] and self.tail_shared[slot] is None
         full = real_len // self.page_size
         self.full[slot] = full
         need = self.pages_to_cover(slot, last_position)
-        if need > len(self.free):
+        self.copy_src[slot] = None
+
+        prefix: list[int] | None = None
+        tail_page: int | None = None
+        # a tail sourced from a donor's PRIVATE page is copy-only: it is
+        # mutable (the donor's decode extends it) and already tracked as
+        # owned, so attaching it refcount-shared would double-track one
+        # physical page as both private and shared
+        tail_is_private = False
+        if self.prefix_sharing:
+            chain = self.chains.get(prompt_idx)
+            if chain is not None:
+                pages, full_count = chain
+                assert full_count == full, (
+                    f"chain full_count {full_count} vs real_len-derived {full}"
+                )
+                prefix = pages[:full]
+                tail_page = pages[full] if len(pages) > full else None
+            elif donor is not None:
+                # donor-slot aliasing without a ledger: the donor's prefix
+                # columns ARE the prompt's chain; its first private page
+                # holds a pristine copy of the prompt tail (the donor only
+                # ever wrote positions >= real_len, beyond every read
+                # window a fresh candidate can reach before overwriting)
+                assert int(self.full[donor]) == full, (
+                    f"donor slot {donor} full {int(self.full[donor])} vs {full}"
+                )
+                prefix = list(self.shared[donor])
+                if self.tail_shared[donor] is not None:
+                    tail_page = self.tail_shared[donor]
+                elif real_len % self.page_size:
+                    tail_page = self.owned[donor][0]
+                    tail_is_private = True
+        if prefix is None:
+            # unshared: the historical path, bit-for-bit (the static prompt
+            # region holds the prefix; the partial-page copy source is
+            # derived device-side by _admit_tables)
+            if need > len(self.free):
+                return False
+            grant = [self.free.pop() for _ in range(need)]
+            self.owned[slot] = grant
+            row = self.table[slot]
+            row[:] = self.scratch
+            row[:full] = prompt_idx * self.prompt_pages + np.arange(full)
+            row[full:full + need] = grant
+            row[full + need:] = grant[-1]
+            self.total_admissions += 1
+            self._note_peaks()
+            self._record_occupancy()
+            return True
+
+        split_now = tail_page is not None and (
+            # the scheduler's imminent first write lands in the tail block
+            (first_write is not None
+             and first_write // self.page_size == full)
+            # …or the source is donor-private (copy-only — see above)
+            or tail_is_private
+        )
+        # private demand: every covered block, minus the tail block while it
+        # stays attached shared (a split consumes the page the tail block
+        # would have, so the split case needs exactly the unshared count)
+        priv_need = need - (1 if (tail_page is not None and not split_now) else 0)
+        if priv_need > len(self.free):
             return False
-        grant = [self.free.pop() for _ in range(need)]
+        grant = [self.free.pop() for _ in range(priv_need)]
         self.owned[slot] = grant
+        self.shared[slot] = list(prefix)
+        for p in prefix:
+            self.ref[p] = self.ref.get(p, 0) + 1
         row = self.table[slot]
         row[:] = self.scratch
-        row[:full] = prompt_idx * self.prompt_pages + np.arange(full)
-        row[full:full + need] = grant
-        row[full + need:] = grant[-1]
-        self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+        row[:full] = prefix
+        if tail_page is not None and not split_now:
+            self.ref[tail_page] = self.ref.get(tail_page, 0) + 1
+            self.tail_shared[slot] = tail_page
+            row[full] = tail_page
+            row[full + 1:full + 1 + priv_need] = grant
+            row[full + 1 + priv_need:] = grant[-1] if grant else tail_page
+        else:
+            if split_now:
+                # copy-on-write: the first decode write lands in the
+                # prompt's partial tail page — split it into the slot's
+                # first private page, the device copy riding the caller's
+                # admit dispatch (no refcount churn: alias + immediate
+                # split nets out to a plain grant + copy)
+                self.cow_splits += 1
+                telemetry.counter_add(POOL_COW_SPLITS)
+                self.copy_src[slot] = tail_page
+            row[full:full + priv_need] = grant
+            row[full + priv_need:] = grant[-1] if grant else (
+                prefix[-1] if prefix else self.scratch
+            )
+        self.total_admissions += 1
+        self.prefix_admissions += 1
+        self._note_peaks()
         self._record_occupancy()
         return True
+
+    def note_write(self, slot: int, position: int) -> tuple[int, int] | None:
+        """Copy-on-write hook: a write at ``position`` is about to land in
+        the slot's pages. Writes into privately owned pages are free; a
+        write into the shared tail page SPLITS it — a fresh page is granted,
+        the (src, dst) device copy is returned (and queued on
+        ``copy_src[slot]``), and the shared page loses this slot's
+        reference. Writes below the shared full-prefix region are a
+        contract violation (full pages are immutable by construction).
+        Returns None when no split was needed; raises when the free list
+        cannot back a required split (callers gate admission on capacity)."""
+        block = position // self.page_size
+        full = int(self.full[slot])
+        assert block >= full, (
+            f"write at {position} lands in slot {slot}'s immutable shared "
+            f"full-prefix region (block {block} < full {full})"
+        )
+        tail = self.tail_shared[slot]
+        if tail is None or block != full:
+            return None
+        if not self.free:
+            raise RuntimeError(
+                f"CoW split for slot {slot} needs a free page and the pool "
+                f"is dry — admission must reserve split capacity"
+            )
+        new = self.free.pop()
+        self.cow_splits += 1
+        telemetry.counter_add(POOL_COW_SPLITS)
+        self.copy_src[slot] = tail
+        self.tail_shared[slot] = None
+        self._deref(tail)
+        self.owned[slot].insert(0, new)
+        row = self.table[slot]
+        row[full] = new
+        # re-clamp trailing columns (they may have clamped onto the tail)
+        used = full + len(self.owned[slot])
+        row[used:] = self.owned[slot][-1]
+        self._note_peaks()
+        self._record_occupancy()
+        return (tail, new)
+
+    def take_copy(self, slot: int) -> int | None:
+        """Drain the slot's queued CoW copy source (the caller fuses the
+        src -> owned[slot][0] page copy into its admit dispatch)."""
+        src = self.copy_src[slot]
+        self.copy_src[slot] = None
+        return src
 
     def ensure(self, slot: int, last_position: int) -> int:
         """Grow the slot's grant to cover writes through ``last_position``.
         Returns the number of pages still MISSING (0 = fully granted)."""
         owned = self.owned[slot]
         assert owned, f"ensure() on unowned slot {slot}"
+        assert self.tail_shared[slot] is None, (
+            f"ensure() on slot {slot} with an unsplit shared tail"
+        )
         need = self.pages_to_cover(slot, last_position)
         missing = need - len(owned)
         take = min(max(missing, 0), len(self.free))
@@ -146,15 +458,24 @@ class PagePool:
             row[full + len(owned):full + len(owned) + take] = grant
             owned.extend(grant)
             row[full + len(owned):] = owned[-1]
-            self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+            self._note_peaks()
             self._record_occupancy()
         return max(missing - take, 0)
 
     def release(self, slot: int) -> None:
-        """Return the slot's pages to the free list; redirect its table row
-        to scratch (the slot's post-mortem garbage writes must not land in
+        """Return the slot's private pages to the free list and drop its
+        shared references (a shared page frees only when its refcount hits
+        zero — the CoW release discipline); redirect its table row to
+        scratch (the slot's post-mortem garbage writes must not land in
         pages another slot may be granted)."""
         self.free.extend(reversed(self.owned[slot]))
         self.owned[slot] = []
+        for p in self.shared[slot]:
+            self._deref(p)
+        self.shared[slot] = []
+        if self.tail_shared[slot] is not None:
+            self._deref(self.tail_shared[slot])
+            self.tail_shared[slot] = None
+        self.copy_src[slot] = None
         self.table[slot, :] = self.scratch
         self._record_occupancy()

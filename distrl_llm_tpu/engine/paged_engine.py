@@ -74,6 +74,12 @@ ENGINE_SPEC_DRAFT_RESIZES = "engine/spec_draft_resizes"    # counter
 ENGINE_SPEC_ACCEPT_RATE = "engine/spec_accept_rate"        # gauge
 ENGINE_SPEC_EMIT_TOKENS = "engine/spec_emit_tokens"        # hist (binned)
 ENGINE_SPEC_VERIFY_GRID_STEPS = "engine/spec_verify_grid_steps"  # counter
+# continuous-batching admission accounting (ISSUE 12): candidates admitted
+# into freed slots AFTER the round's first dispatch (the backfill the fixed
+# episode batch never gets), and lazy per-group prompt prefills run by the
+# continuous-admission scheduler
+ENGINE_BACKFILL_ADMITS = "engine/backfill_admits"          # counter
+ENGINE_CONT_PREFILLS = "engine/cont_prefills"              # counter
 
 Params = dict[str, Any]
 
@@ -245,6 +251,33 @@ def _page_table_rows(prompt_of, full, priv0, *, prompt_pages: int,
     )
 
 
+def _cont_adopt(state, k_tiles, v_tiles, dst_idx, logits_buf, logits_row, g):
+    """Adopt one lazily-prefilled group's prompt KV into the live pool
+    arrays and publish its sampling logits (continuous admission).
+
+    ``dst_idx`` [prompt_pages] is the pool-allocated chain padded with the
+    scratch page — the tiles beyond the prompt's real chain carry prefill's
+    pad-position garbage and land on scratch, which takes garbage writes by
+    contract (duplicate scratch destinations are fine: whichever write wins
+    is equally garbage). Quantized pools place weight + scales alike, so
+    the (int8, scale) pairing survives adoption."""
+    from distrl_llm_tpu.ops.paged import is_quantized_pages
+
+    def place(pages, tiles):
+        if is_quantized_pages(pages):
+            return type(pages)(
+                weight=pages.weight.at[:, dst_idx].set(tiles.weight),
+                scales=pages.scales.at[:, dst_idx].set(tiles.scales),
+            )
+        return pages.at[:, dst_idx].set(tiles)
+
+    state = state._replace(
+        k_pages=tuple(place(p, t) for p, t in zip(state.k_pages, k_tiles)),
+        v_pages=tuple(place(p, t) for p, t in zip(state.v_pages, v_tiles)),
+    )
+    return state, logits_buf.at[g].set(logits_row)
+
+
 def _paged_fanout(prompt_k, prompt_v, last_logits, real_len, row_alive,
                   *, n: int, b: int, prompt_pages: int, private_pages: int,
                   page_size: int, max_steps: int):
@@ -368,7 +401,8 @@ def _paged_decode_chunk(params, lora, state: _PagedDecodeState, rng,
 
 def _refill_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
                  max_steps: int, vocab: int, pool_pages: int,
-                 prompt_pages: int, private_pages: int, pad_id: int):
+                 prompt_pages: int, private_pages: int, pad_id: int,
+                 shared_pages: int | None = None):
     """Empty R-slot decode state over the shared prompt pool: every slot is
     born dead; ``_refill_admit`` assigns occupants (including the first R).
 
@@ -379,8 +413,13 @@ def _refill_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
     0): decode steps run for dead slots too, and their garbage KV writes
     must land somewhere no live row ever reads — an all-zero table would
     alias physical page 0, a SHARED prefill page, and corrupt prompt 0's KV
-    for every candidate (caught in review)."""
-    total_shared = b * prompt_pages
+    for every candidate (caught in review).
+
+    ``shared_pages`` overrides the static prompt-region size (None = the
+    historical ``b·prompt_pages``): continuous admission passes 0 — prompt
+    chains are pool-allocated, ``prompt_k``/``prompt_v`` arrive as 0-page
+    tiles, and the scratch page is physical page 0."""
+    total_shared = b * prompt_pages if shared_pages is None else shared_pages
     width = prompt_pages + private_pages
 
     return _RefillState(
@@ -401,34 +440,43 @@ def _refill_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
 
 
 def _admit_tables(state, new_cand, admit_mask, real_len, dst_partial,
-                  *, n: int, b: int, prompt_pages: int, page_size: int):
+                  *, n: int, b: int, prompt_pages: int, page_size: int,
+                  src_partial=None, copy_mask=None):
     """The admit work shared by the plain and speculative refill schedulers:
     merge slot assignments and build the partial-page recopy (the last,
     partial prompt page is extended in place by decode, so each admitted
     slot needs a private copy at the host-chosen ``dst_partial`` page).
     Page-TABLE rows are host-authored (engine/page_pool.py) and shipped via
     ``state._replace`` — the device no longer computes them.
-    Returns (cand, live_new, prompt_of, recopy)."""
+
+    With ``src_partial``/``copy_mask`` the HOST authored the copy plan too
+    (prefix sharing: the pool's copy-on-write splits name the pristine
+    chain-tail source per slot, and page-aligned prompts need no copy at
+    all); without them the source derives from the static prompt region
+    exactly as it always has. Returns (cand, live_new, prompt_of, recopy)."""
     s = state
     total = b * n
 
     cand = jnp.where(admit_mask, new_cand, s.cand)
     live_new = new_cand < total
     prompt_of = jnp.clip(cand // n, 0, b - 1)
-    full = real_len[prompt_of] // page_size  # [R] shared full pages
-    src_partial = prompt_of * prompt_pages + jnp.minimum(full, prompt_pages - 1)
+    if src_partial is None:
+        full = real_len[prompt_of] // page_size  # [R] shared full pages
+        src = prompt_of * prompt_pages + jnp.minimum(full, prompt_pages - 1)
+        keep = admit_mask & live_new
+    else:
+        src = src_partial
+        keep = copy_mask
 
     def recopy(pages):
-        return _copy_pages(
-            pages, src_partial, dst_partial, keep_mask=admit_mask & live_new
-        )
+        return _copy_pages(pages, src, dst_partial, keep_mask=keep)
 
     return cand, live_new, prompt_of, recopy
 
 
 def _refill_admit(state: _RefillState, new_cand, admit_mask, last_logits,
-                  real_len, dst_partial, *, n: int, b: int, prompt_pages: int,
-                  page_size: int):
+                  real_len, dst_partial, src_partial=None, copy_mask=None,
+                  *, n: int, b: int, prompt_pages: int, page_size: int):
     """Assign candidates to slots (vLLM's scheduler admitting waiting
     sequences into freed slots, static-shape edition). All shapes are
     static; which slots refill is data."""
@@ -436,6 +484,7 @@ def _refill_admit(state: _RefillState, new_cand, admit_mask, last_logits,
     cand, live_new, prompt_of, recopy = _admit_tables(
         s, new_cand, admit_mask, real_len, dst_partial, n=n, b=b,
         prompt_pages=prompt_pages, page_size=page_size,
+        src_partial=src_partial, copy_mask=copy_mask,
     )
 
     return _RefillState(
@@ -682,7 +731,8 @@ def _spec_decode_chunk(params, lora, state, rng, drafter_lora=None,
 def _spec_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
                max_steps: int, buf_width: int, pool_pages: int,
                hist_width: int,
-               prompt_pages: int, private_pages: int, pad_id: int):
+               prompt_pages: int, private_pages: int, pad_id: int,
+               shared_pages: int | None = None):
     """Empty R-slot speculative decode state (engine/speculative.py)."""
     from distrl_llm_tpu.engine.speculative import SpecRefillState
 
@@ -690,6 +740,7 @@ def _spec_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
         prompt_k, prompt_v, b=b, r_slots=r_slots, total=total,
         max_steps=max_steps, vocab=1, pool_pages=pool_pages,
         prompt_pages=prompt_pages, private_pages=private_pages, pad_id=pad_id,
+        shared_pages=shared_pages,
     )
     return SpecRefillState(
         step=base.step, alive_steps=base.alive_steps,
@@ -709,6 +760,7 @@ def _spec_init(prompt_k, prompt_v, *, b: int, r_slots: int, total: int,
 
 def _spec_admit(state, new_cand, admit_mask, last_logits, real_len,
                 packed_ids, rng, temperature, top_p, dst_partial,
+                src_partial=None, copy_mask=None,
                 *, n: int, b: int, prompt_pages: int, page_size: int,
                 eos_ids, top_p_impl: str = "bisect",
                 capture_logprobs: bool = False):
@@ -725,6 +777,7 @@ def _spec_admit(state, new_cand, admit_mask, last_logits, real_len,
     cand, live_new, prompt_of, recopy = _admit_tables(
         s, new_cand, admit_mask, real_len, dst_partial, n=n, b=b,
         prompt_pages=prompt_pages, page_size=page_size,
+        src_partial=src_partial, copy_mask=copy_mask,
     )
 
     # first token per admitted slot, from the prompt's last-position logits
@@ -970,6 +1023,20 @@ class PagedGenerationEngine(LoraMailbox):
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
         max_kv_pages: int = 0,  # refill decode-page pool size; 0 = worst-case
         scheduler: str = "waves",  # "waves" | "refill" (continuous batching)
+        # prefix sharing (ISSUE 12): a group's N candidates ALIAS one
+        # refcounted prompt-prefix page chain (copy-on-write tail split)
+        # instead of each keeping a private partial-page copy against a
+        # never-freed static region; finished groups' prompt pages recycle
+        # into decode capacity. Refill scheduler only.
+        prefix_sharing: bool = False,
+        # continuous admission (ISSUE 12): replace the fixed-episode-batch
+        # prefill with a group request queue — each group's prompt is
+        # prefilled lazily into pool-allocated chain pages when freed slots
+        # and page budget admit it, so short completions backfill
+        # immediately. Implies prefix_sharing. None = consult the autotune
+        # plan DB (cb_mode field; empty DB = off); an explicit bool —
+        # including False — always wins (the decode_scan_chunk convention).
+        continuous_admission: bool | None = None,
         # speculative decoding (engine/speculative.py). None = consult the
         # autotune plan DB (spec_draft_len/spec_ngram_k/spec_drafter/
         # spec_verify plan fields; empty DB falls back to the historical
@@ -1038,6 +1105,12 @@ class PagedGenerationEngine(LoraMailbox):
             requested["scan_chunk"] = scan_chunk
         if pages_per_block is not None:
             requested["pages_per_block"] = pages_per_block
+        if continuous_admission is not None:
+            # explicit bool pins the admission regime past any stored plan
+            # (False is a real A/B control, not "unset")
+            requested["cb_mode"] = (
+                "continuous" if continuous_admission else "batch"
+            )
         # the paged_kernel plan field and the paged_impl kwarg name the same
         # choice: any explicit non-"auto" kwarg wins over the DB ("kernel"/
         # "reference" have no plan spelling, so they pin the field to None —
@@ -1147,6 +1220,50 @@ class PagedGenerationEngine(LoraMailbox):
         # slot; leave retention off otherwise (it pins an extra adapter
         # version in device memory for the engine's lifetime)
         self._track_prev_lora = bool(spec_draft) and self.spec_drafter == "self"
+        # ---- continuous-batching admission + prefix sharing (ISSUE 12)
+        cb_explicit = continuous_admission is not None
+        cont = (
+            continuous_admission if cb_explicit
+            else plan.cb_mode == "continuous"
+        )
+        if cont and (scheduler != "refill" or not max_concurrent_rows):
+            if cb_explicit:
+                raise ValueError(
+                    "continuous_admission runs on the refill scheduler — "
+                    "set scheduler='refill' and max_concurrent_rows"
+                )
+            # a stored plan must never crash or silently reshape a run the
+            # engine can't host (the spec-plan scheduler-mismatch policy)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "autotune: stored plan wants continuous admission "
+                "(cb_mode='continuous') but this engine runs the %s "
+                "scheduler — ignoring the plan's cb_mode", scheduler,
+            )
+            cont = False
+        if cont:
+            # continuous admission allocates prompt chains from the
+            # refcounted pool — it IS prefix sharing plus lazy prefill
+            prefix_sharing = True
+        if prefix_sharing and (scheduler != "refill" or not max_concurrent_rows):
+            raise ValueError(
+                "prefix_sharing shares prompt-prefix pages across the "
+                "refill scheduler's slots — set scheduler='refill' and "
+                "max_concurrent_rows"
+            )
+        self.prefix_sharing = bool(prefix_sharing)
+        self.continuous_admission = bool(cont)
+        # the scheduler self-description bench/telemetry record (the wave
+        # path reports "waves" regardless; generate() stamps last_cb_mode
+        # with what each round actually ran)
+        self.cb_mode = (
+            "waves" if scheduler == "waves" else (
+                "continuous" if cont
+                else ("refill_shared" if prefix_sharing else "refill")
+            )
+        )
+        self.last_cb_mode: str | None = None
         # honesty: the record in resolved_plan must describe what this
         # engine actually is (generate() routes on spec_draft/scheduler,
         # not on the plan record), including when the decode_path came
@@ -1158,6 +1275,12 @@ class PagedGenerationEngine(LoraMailbox):
                 spec_ngram_k=spec_ngram if spec_draft else 0,
                 spec_drafter=self.spec_drafter if spec_draft else None,
                 spec_verify=self.spec_verify if spec_draft else None,
+                # what actually runs: a degraded stored "continuous" plan
+                # records "batch", an explicit pin keeps its spelling
+                cb_mode=(
+                    "continuous" if cont
+                    else ("batch" if plan.cb_mode is not None else None)
+                ),
             )
         )
         self.scheduler = scheduler
@@ -1185,11 +1308,19 @@ class PagedGenerationEngine(LoraMailbox):
         # can never stall and preemption never fires); a smaller budget makes
         # KV HBM scale with REALIZED lengths, with admission gated on free
         # pages and preempt-by-recompute under pressure
-        if max_kv_pages and max_kv_pages < 1 + self.private_pages:
+        # continuous admission allocates prompt chains FROM the pool, so the
+        # single-sequence floor additionally carries one prompt chain
+        pool_floor = 1 + self.private_pages + (
+            self.prompt_pages if self.continuous_admission else 0
+        )
+        if max_kv_pages and max_kv_pages < pool_floor:
             raise ValueError(
                 f"max_kv_pages={max_kv_pages} cannot fit one sequence "
-                f"(need >= {1 + self.private_pages}: scratch + "
-                f"{self.private_pages} private pages)"
+                f"(need >= {pool_floor}: scratch + "
+                f"{self.private_pages} private pages"
+                + (f" + {self.prompt_pages} prompt-chain pages for "
+                   f"continuous admission)" if self.continuous_admission
+                   else ")")
             )
         self.max_kv_pages = max_kv_pages
         self.last_pool_stats: dict | None = None
@@ -1209,6 +1340,9 @@ class PagedGenerationEngine(LoraMailbox):
         self.decode_chunk = decode_chunk
         self.paged_impl = paged_impl
         self.prompt_buckets = [max_prompt_tokens]
+        # continuous admission builds per-layer 0-page tiles in this dtype
+        # and reuses the jitted prefill at [1, P]
+        self.cache_dtype = cache_dtype
 
         self._prefill = jax.jit(
             partial(
@@ -1242,7 +1376,11 @@ class PagedGenerationEngine(LoraMailbox):
             ),
             static_argnames=(
                 "b", "r_slots", "total", "max_steps", "vocab", "pool_pages",
+                "shared_pages",
             ),
+        )
+        self._cont_adopt = jax.jit(
+            _cont_adopt, donate_argnames=("state", "logits_buf"),
         )
         self._refill_admit = jax.jit(
             partial(
@@ -1283,7 +1421,7 @@ class PagedGenerationEngine(LoraMailbox):
             ),
             static_argnames=(
                 "b", "r_slots", "total", "max_steps", "buf_width",
-                "pool_pages", "hist_width",
+                "pool_pages", "hist_width", "shared_pages",
             ),
         )
         self._spec_admit = jax.jit(
@@ -1488,14 +1626,18 @@ class PagedGenerationEngine(LoraMailbox):
         if (
             self.scheduler == "refill"
             and self.max_concurrent_rows
-            # spec decode lives on the refill path — a configured speculative
-            # engine must not silently fall back to plain waves on a small
-            # batch (review finding)
-            and (total > self.max_concurrent_rows or self.spec_draft)
+            # spec decode and prefix sharing live on the refill path — a
+            # configured speculative or prefix-sharing engine must not
+            # silently fall back to plain waves on a small batch (review
+            # finding; continuous_admission implies prefix_sharing)
+            and (total > self.max_concurrent_rows or self.spec_draft
+                 or self.prefix_sharing)
         ):
+            self.last_cb_mode = self.cb_mode
             return self._generate_refill(
                 params, lora, prompt_ids, prompt_mask, sampling, rng
             )
+        self.last_cb_mode = "waves"
         return generate_in_waves(
             self._generate_wave, self.max_concurrent_rows, params, lora,
             prompt_ids, prompt_mask, sampling, rng, self.pad_id,
@@ -1528,20 +1670,49 @@ class PagedGenerationEngine(LoraMailbox):
         total = b * n
         # small batches (spec routing) need no more slots than candidates
         r_slots = min(self.max_concurrent_rows, total)
+        sharing = self.prefix_sharing
+        continuous = self.continuous_admission
 
-        prefill_tokens = int(np.asarray(prompt_mask).sum())
-        t0 = time.perf_counter()
-        with telemetry.span("engine/prefill", rows=b, tokens=prefill_tokens):
-            prompt_k, prompt_v, last_logits, real_len = self._prefill(
-                params, lora, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask)
-            )
-            jax.block_until_ready(last_logits)
-        t_prefill = time.perf_counter() - t0
+        real_len_h = np.asarray(prompt_mask).sum(axis=-1).astype(np.int64)
+        row_alive = real_len_h > 0
+        ps = self.page_size
+        prefill_tokens = int(real_len_h.sum())
+        if continuous:
+            # lazy per-group prefill (continuous admission): the pool
+            # arrays start with ZERO prompt pages — each group's prompt KV
+            # is prefilled at [1, P] and adopted into pool-allocated chain
+            # pages when the request queue admits it mid-round
+            t_prefill = 0.0
+            shape0 = (self.cfg.num_kv_heads, 0, ps, self.cfg.head_dim)
+            if self.kv_quant == "int8":
+                from distrl_llm_tpu.ops.paged import init_quantized_pages
+
+                def _empty():
+                    return init_quantized_pages(shape0)
+            else:
+                def _empty():
+                    return jnp.zeros(shape0, self.cache_dtype)
+            prompt_k = tuple(_empty() for _ in range(self.cfg.num_layers))
+            prompt_v = tuple(_empty() for _ in range(self.cfg.num_layers))
+            # per-group sampling logits, scatter-published by each adopt
+            # (the admit paths index it by prompt id exactly as they index
+            # the monolithic prefill's batched logits)
+            last_logits = jnp.zeros((b, self.cfg.vocab_size), jnp.float32)
+            real_len = jnp.asarray(real_len_h.astype(np.int32))
+        else:
+            t0 = time.perf_counter()
+            with telemetry.span("engine/prefill", rows=b,
+                                tokens=prefill_tokens):
+                prompt_k, prompt_v, last_logits, real_len = self._prefill(
+                    params, lora, jnp.asarray(prompt_ids),
+                    jnp.asarray(prompt_mask)
+                )
+                jax.block_until_ready(last_logits)
+            t_prefill = time.perf_counter() - t0
         t_decode0 = time.perf_counter()
         dec_span = telemetry.span("engine/refill_decode", slots=r_slots,
                                   candidates=total)
         dec_span.__enter__()
-        row_alive = np.asarray(prompt_mask).sum(axis=-1) > 0
 
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
@@ -1552,19 +1723,45 @@ class PagedGenerationEngine(LoraMailbox):
 
         total_shared = b * self.prompt_pages
         width = self.prompt_pages + self.private_pages
-        worst_pool = 1 + r_slots * self.private_pages
+        if continuous:
+            # prompt chains live IN the pool: worst case = scratch + every
+            # slot's private region + a chain per concurrently-active group
+            # (slots span at most min(b, r_slots) groups) + one prefetched
+            worst_pool = (
+                1 + r_slots * self.private_pages
+                + min(b, r_slots + 1) * self.prompt_pages
+            )
+            shared_static = 0
+        else:
+            worst_pool = 1 + r_slots * self.private_pages
+            shared_static = total_shared
         pool_pages = (
             min(self.max_kv_pages, worst_pool) if self.max_kv_pages
             else worst_pool
         )
         budgeted = pool_pages < worst_pool
         pool = PagePool(
-            first_page=total_shared, n_pages=pool_pages, r_slots=r_slots,
+            first_page=shared_static, n_pages=pool_pages, r_slots=r_slots,
             width=width, page_size=self.page_size,
-            prompt_pages=self.prompt_pages,
+            prompt_pages=self.prompt_pages, prefix_sharing=sharing,
         )
-        real_len_h = np.asarray(prompt_mask).sum(axis=-1).astype(np.int64)
-        ps = self.page_size
+        if sharing and not continuous:
+            # adopt the monolithic prefill's static region as refcounted
+            # prefix chains: ceil(rl/ps) live pages per prompt (full pages
+            # + the pristine partial tail) held until the group finishes,
+            # with each prompt's slack — and dead padding rows' whole
+            # regions — reclaimed into the free list as decode capacity
+            for g in range(b):
+                base = g * self.prompt_pages
+                region = list(range(base, base + self.prompt_pages))
+                if not row_alive[g]:
+                    pool.reclaim(region)
+                    continue
+                n_chain = max(-(-int(real_len_h[g]) // ps), 1)
+                pool.register_prefix(
+                    g, region[:n_chain], int(real_len_h[g]) // ps
+                )
+                pool.reclaim(region[n_chain:])
         # snapshot cadence: never longer than a short decode's whole run
         check = max(1, min(self.decode_chunk, 16, max_steps))
         # grant horizon: a slot's write frontier can advance for up to
@@ -1573,6 +1770,23 @@ class PagedGenerationEngine(LoraMailbox):
         lag_tokens = 3 * check
         # in-flight weight updates read the adapter through this cell
         lora_cell = [lora]
+        # sampling-logits cell: continuous admission republishes it per
+        # group adopt; the admit closures read it at call time
+        logits_cell = [last_logits]
+
+        def _admit_extras(src_partial, copy_mask):
+            """Host-authored partial-page copy plan (prefix sharing): per-
+            slot CoW sources + which admitted slots copy at all (page-
+            aligned prompts skip the copy). Empty on unshared engines so
+            the historical admit trace is untouched."""
+            if not sharing:
+                return ()
+            sp = (
+                np.full(r_slots, pool.scratch, np.int32)
+                if src_partial is None else src_partial
+            )
+            cm = np.zeros(r_slots, bool) if copy_mask is None else copy_mask
+            return (jnp.asarray(sp), jnp.asarray(cm))
 
         if self.spec_draft:
             # speculative mode: slots carry a pending token + sequence
@@ -1590,6 +1804,7 @@ class PagedGenerationEngine(LoraMailbox):
                 prompt_k, prompt_v, b=b, r_slots=r_slots, total=total,
                 max_steps=max_steps, buf_width=buf_width,
                 pool_pages=pool_pages, hist_width=d + 2,
+                shared_pages=shared_static,
             )
             admit_seq = iter(range(1 << 30))
             # the self-drafter runs the policy's own PREVIOUS adapter
@@ -1624,12 +1839,14 @@ class PagedGenerationEngine(LoraMailbox):
                     top_p_impl=top_p_impl,
                 )
 
-            def admit(s, new_cand, admit_mask, dst_partial):
+            def admit(s, new_cand, admit_mask, dst_partial,
+                      src_partial=None, copy_mask=None):
                 return self._spec_admit(
                     s, jnp.asarray(new_cand), jnp.asarray(admit_mask),
-                    last_logits, real_len, packed_ids,
+                    logits_cell[0], real_len, packed_ids,
                     jax.random.fold_in(rng, 100_000 + next(admit_seq)),
                     temperature, top_p, jnp.asarray(dst_partial),
+                    *_admit_extras(src_partial, copy_mask),
                     n=n, b=b, eos_ids=self.eos_ids,
                     top_p_impl=top_p_impl,
                 )
@@ -1645,7 +1862,7 @@ class PagedGenerationEngine(LoraMailbox):
             state = self._refill_init(
                 prompt_k, prompt_v, b=b, r_slots=r_slots, total=total,
                 max_steps=max_steps, vocab=self.cfg.vocab_size,
-                pool_pages=pool_pages,
+                pool_pages=pool_pages, shared_pages=shared_static,
             )
 
             def step(s):
@@ -1655,10 +1872,12 @@ class PagedGenerationEngine(LoraMailbox):
                     top_p_impl=top_p_impl,
                 )
 
-            def admit(s, new_cand, admit_mask, dst_partial):
+            def admit(s, new_cand, admit_mask, dst_partial,
+                      src_partial=None, copy_mask=None):
                 return self._refill_admit(
                     s, jnp.asarray(new_cand), jnp.asarray(admit_mask),
-                    last_logits, real_len, jnp.asarray(dst_partial), n=n, b=b,
+                    logits_cell[0], real_len, jnp.asarray(dst_partial),
+                    *_admit_extras(src_partial, copy_mask), n=n, b=b,
                 )
 
             def admit_last_pos(rl: int, plen: int) -> int:
@@ -1727,15 +1946,108 @@ class PagedGenerationEngine(LoraMailbox):
         # pad tokens / zero length, same as wave mode's born-done rows.
         # Pending entries are candidate ids, or (cand, prefix, prefix_len)
         # for preempted candidates awaiting recompute.
-        pending = deque(c for c in range(total) if row_alive[c // n])
+        if continuous:
+            # the request queue holds GROUPS awaiting their lazy prefill;
+            # the candidate queue fills as admit_group() runs them
+            pending: deque = deque()
+            group_queue: deque = deque(g for g in range(b) if row_alive[g])
+        else:
+            pending = deque(c for c in range(total) if row_alive[c // n])
+            group_queue = deque()
         finished = np.array([not row_alive[c // n] for c in range(total)])
+        # per-group unfinished-candidate counts: a group's prefix-chain
+        # hold drops only when its LAST candidate finishes (a preempted
+        # candidate must still find the pristine chain on resume)
+        group_left = np.array(
+            [n if row_alive[g] else 0 for g in range(b)]
+        )
+        groups_prefilled = 0
+        backfill_admits = 0
+        dispatched = 0
         host_cand = np.full(r_slots, total, np.int64)  # device `cand` mirror
         epoch = np.zeros(r_slots, np.int64)
 
+        def mark_finished(c: int) -> None:
+            if finished[c]:
+                return
+            finished[c] = True
+            if sharing:
+                g = c // n
+                group_left[g] -= 1
+                if group_left[g] == 0 and g in pool.chains:
+                    # refcount hold drops; the chain pages free as the last
+                    # slot references release (CoW release discipline)
+                    pool.drop_prefix(g)
+
+        # graftcheck: hot-region cont-admission
+        def admit_group(g: int) -> bool:
+            """Lazily prefill group ``g``'s prompt into pool-allocated
+            chain pages ([1, P] reuse of the jitted prefill — bit-identical
+            per row to the batched pass), adopt the tiles + logits into the
+            live pool arrays, and enqueue the group's candidates."""
+            nonlocal state, groups_prefilled, t_prefill
+            rl = int(real_len_h[g])
+            n_chain = max(-(-rl // ps), 1)
+            chain = pool.alloc_prefix(g, n_chain, rl // ps)
+            if chain is None:
+                return False
+            t0 = time.perf_counter()
+            with telemetry.span("engine/prefill", rows=1, tokens=rl):
+                k_t, v_t, logits_g, _rl = self._prefill(
+                    params, lora_cell[0], prompt_ids_j[g:g + 1],
+                    prompt_mask_j[g:g + 1],
+                )
+            dst = np.full(self.prompt_pages, pool.scratch, np.int32)
+            dst[:n_chain] = chain
+            state, logits_cell[0] = self._cont_adopt(
+                state, k_t, v_t, jnp.asarray(dst), logits_cell[0],
+                logits_g[0], jnp.asarray(g, jnp.int32),
+            )
+            # block before stopping the timer (the monolithic prefill
+            # path's convention): under async dispatch the device-side
+            # prefill would otherwise serialize into the decode stream and
+            # be misattributed to decode_s. The measurement is an UPPER
+            # bound — the wait can also absorb the drain of decode chunks
+            # already queued — but decode absorbing prefill would bias the
+            # fixed-vs-continuous A/B in the new mode's favor
+            jax.block_until_ready(logits_cell[0])
+            t_prefill += time.perf_counter() - t0
+            groups_prefilled += 1
+            telemetry.counter_add(ENGINE_CONT_PREFILLS)
+            pending.extend(range(g * n, (g + 1) * n))
+            return True
+
+        def admit_groups() -> None:
+            """Admission-ahead: keep the candidate queue stocked while the
+            pool can afford the head group's chain AND a full private
+            region on top (never starve a running slot's grants), capped at
+            one prefetched chain beyond the slots' worst-case group spread
+            (the worst_pool sizing above)."""
+            while (
+                group_queue
+                and len(pending) < r_slots
+                and len(pool.chains) < r_slots + 1
+            ):
+                g = group_queue[0]
+                n_chain = max(-(-int(real_len_h[g]) // ps), 1)
+                if pool.free_pages < n_chain + self.private_pages:
+                    break
+                if not admit_group(g):
+                    break
+                group_queue.popleft()
+        # graftcheck: end-hot-region
+
+        if continuous:
+            prompt_ids_j = jnp.asarray(prompt_ids)
+            prompt_mask_j = jnp.asarray(prompt_mask)
+
         def fill_idle(s, idle_slots):
+            nonlocal backfill_admits
             new_cand = np.full(r_slots, total, np.int32)
             admit_mask = np.zeros(r_slots, bool)
             dst_partial = np.full(r_slots, pool.scratch, np.int32)
+            src_partial = np.full(r_slots, pool.scratch, np.int32)
+            copy_mask = np.zeros(r_slots, bool)
             resumes = []
             for s_i in idle_slots:
                 if not pending:
@@ -1748,19 +2060,41 @@ class PagedGenerationEngine(LoraMailbox):
                 rl = int(real_len_h[pr])
                 # admission gated on FREE PAGES (vLLM's can_allocate); the
                 # queue is FIFO — a head-of-line candidate that doesn't fit
-                # blocks the rest rather than being starved by skip-ahead
-                if not pool.admit(int(s_i), pr, rl, admit_last_pos(rl, plen)):
+                # blocks the rest rather than being starved by skip-ahead.
+                # Under prefix sharing the slot ALIASES its group's chain
+                # (refcount++) and first_write=rl names the imminent first
+                # decode write, so the pool's copy-on-write split of the
+                # partial tail page runs as part of this admission — the
+                # engine registers chains rather than passing donor slots
+                # because a pending candidate must outlive its siblings
+                # (the chain hold persists until the group finishes)
+                if not pool.admit(
+                    int(s_i), pr, rl, admit_last_pos(rl, plen),
+                    first_write=rl if sharing else None,
+                ):
                     break
                 pending.popleft()
                 new_cand[s_i] = c
                 admit_mask[s_i] = True
                 dst_partial[s_i] = pool.owned[int(s_i)][0]
+                if sharing:
+                    src = pool.take_copy(int(s_i))
+                    if src is not None:
+                        src_partial[s_i] = src
+                        copy_mask[s_i] = True
                 if plen:
                     resumes.append((int(s_i), prefix, plen, rl, c // n, logp0))
             if admit_mask.any():
-                s = admit(s, new_cand, admit_mask, dst_partial)
+                s = admit(s, new_cand, admit_mask, dst_partial,
+                          src_partial, copy_mask)
                 host_cand[admit_mask] = new_cand[admit_mask]
                 epoch[admit_mask] += 1
+                if dispatched:
+                    # mid-round backfill: the admissions a fixed episode
+                    # batch would have left idle
+                    k_admit = int(admit_mask.sum())
+                    backfill_admits += k_admit
+                    telemetry.counter_add(ENGINE_BACKFILL_ADMITS, k_admit)
                 # admitted slots' table rows must reach the device before
                 # their first decode step (and before any resume fixup)
                 s = s._replace(page_indices=jnp.asarray(pool.table))
@@ -1800,7 +2134,7 @@ class PagedGenerationEngine(LoraMailbox):
             # blocking read of the slot's CURRENT truth (the snapshot lags):
             # preemption is rare, the sync is the cost of exactness
             if bool(np.asarray(state.done[s_i])):
-                finished[c] = True  # finished while we deliberated
+                mark_finished(c)  # finished while we deliberated
             else:
                 plen = int(np.asarray(state.lengths_buf[c]))
                 if plen:
@@ -1825,20 +2159,23 @@ class PagedGenerationEngine(LoraMailbox):
             host_cand[s_i] = total
             epoch[s_i] += 1
 
+        if continuous:
+            admit_groups()
         state = fill_idle(state, range(r_slots))
 
         snapshots: deque = deque()
-        dispatched = 0
         # each slot serves ≤ ceil(total/R) occupants × max_steps, plus up to
         # 2·check admission lag per handoff (the async snapshot pipeline); a
         # budgeted pool can additionally serialize candidates (admission
         # stalls) and recompute preempted prefixes, so its backstop is the
-        # fully-serial bound
+        # fully-serial bound — continuous admission takes the serial bound
+        # too (its chains gate admission like a budget does)
         occupancies = -(-total // r_slots)
         budget = (max_steps + 2 * check) * (
-            2 * (total + 2) if budgeted else occupancies + 2
+            2 * (total + 2) if (budgeted or continuous) else occupancies + 2
         )
         since_host = 0
+        stalled_boundaries = 0
         # speculative accounting for the grid-cost artifacts, accumulated
         # PER DISPATCH in per-layer units so a round that mixes dispatch
         # regimes stays exact (the adaptive controller can resize d
@@ -2028,10 +2365,12 @@ class PagedGenerationEngine(LoraMailbox):
             ]
             for s_i in idle:
                 c = snap_cand[s_i]
-                if c < total:
-                    finished[c] = True
-                if pool.owned[s_i]:
+                if pool.owned[s_i] or pool.shared[s_i]:
                     pool.release(s_i)  # frees pages + redirects to scratch
+                if c < total:
+                    # after the slot release so a completed group's chain
+                    # pages free the moment the hold drops
+                    mark_finished(int(c))
                 host_cand[s_i] = total
             table_dirty = bool(idle)
             if budgeted:
@@ -2068,6 +2407,12 @@ class PagedGenerationEngine(LoraMailbox):
                         if victim == s_i:
                             break
                     table_dirty = True
+            boundary_marks = pool.total_admissions + groups_prefilled
+            if continuous and group_queue:
+                # freed pages (released slots, dropped chains) may now fit
+                # the next queued group's prefill — the backfill that
+                # replaces the fixed episode batch
+                admit_groups()
             if pending:
                 state = fill_idle(state, [s for s in idle if host_cand[s] >= total])
                 table_dirty = True
@@ -2075,6 +2420,30 @@ class PagedGenerationEngine(LoraMailbox):
                 state = state._replace(page_indices=jnp.asarray(pool.table))
             if pool.self_check:
                 pool.check_invariants()
+            if continuous:
+                # wedge detector: every slot dead, work still queued, and
+                # this boundary neither prefilled nor admitted — decode
+                # steps can free nothing, so give the one-boundary snapshot
+                # lag a few rounds of grace and then name the stall instead
+                # of silently spinning the step budget down
+                if (
+                    pool.total_admissions + groups_prefilled == boundary_marks
+                    and (pending or group_queue)
+                    and all(host_cand[v] >= total for v in range(r_slots))
+                ):
+                    stalled_boundaries += 1
+                    if stalled_boundaries > 4:
+                        raise RuntimeError(
+                            f"continuous admission wedged: "
+                            f"{int(finished.sum())}/{total} finished, "
+                            f"{len(pending)} pending candidates + "
+                            f"{len(group_queue)} queued groups, no live "
+                            f"slot, and the pool ({pool.free_pages} free / "
+                            f"{pool.universe_pages}) cannot admit the head "
+                            f"— the page budget cannot make progress"
+                        )
+                else:
+                    stalled_boundaries = 0
         # graftcheck: end-hot-region
 
         # final blocking read closes the snapshot lag on the last occupants
@@ -2083,12 +2452,35 @@ class PagedGenerationEngine(LoraMailbox):
             c = host_cand[s_i]
             if c < total:
                 finished[c] = True
+        alive_h = int(np.asarray(state.alive_steps))
         self.last_pool_stats = {
             "pool_pages": pool_pages,
             "worst_case_pages": worst_pool,
             "peak_pages_used": pool.peak_pages_used,
             "preemptions": pool.preemptions,
             "budgeted": budgeted,
+            # continuous-batching self-description (ISSUE 12, read by bench
+            # rollout rows + tools/cb_smoke.py): which admission regime ran,
+            # how much of the prompt segment was physically shared, and how
+            # much mid-round backfill the fixed batch would have idled away
+            "cb_mode": self.cb_mode,
+            "cow_splits": pool.cow_splits,
+            "pages_shared_frac": (
+                round(pool.peak_shared_pages
+                      / max(pool.peak_pages_used, 1), 4)
+                if sharing else None
+            ),
+            "prefill_shared_frac": (
+                round(pool.prefix_admissions
+                      / max(pool.total_admissions, 1), 4)
+                if sharing else None
+            ),
+            "backfill_admissions": backfill_admits,
+            "groups_prefilled": groups_prefilled if continuous else None,
+            "slot_idle_frac": (
+                round(1.0 - alive_h / (r_slots * dispatched), 4)
+                if dispatched else None
+            ),
         }
         if not finished.all():
             missing = int((~finished).sum())
@@ -2173,6 +2565,10 @@ class PagedGenerationEngine(LoraMailbox):
                      ))
         dec_span.__exit__(None, None, None)
         decode_s = time.perf_counter() - t_decode0
+        if continuous:
+            # lazy group prefills ran inside the decode loop; decode
+            # throughput must not absorb their time
+            decode_s = max(decode_s - t_prefill, 1e-9)
         if self.spec_draft:
             # aggregate attention grid steps (verify + drafter) — computed
             # directly, since the fused verify sweep and the drafter's
@@ -2193,7 +2589,7 @@ class PagedGenerationEngine(LoraMailbox):
         )
         return GenerationResult(
             tokens=out, lengths=lengths, steps_dispatched=dispatched,
-            alive_slot_steps=int(state.alive_steps),
+            alive_slot_steps=alive_h,
             logprobs=logps,
         )
 

@@ -70,6 +70,14 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     if config.engine_impl == "paged":
         if config.continuous_batching:
             kwargs["scheduler"] = "refill"
+            # prefix sharing / continuous admission (ISSUE 12): forwarded
+            # only when set, so an unset config stays plan-DB-resolvable
+            # at the engine (continuous_admission None = consult cb_mode)
+            # and the empty-DB default remains byte-identical fixed batches
+            if config.prefix_sharing:
+                kwargs["prefix_sharing"] = True
+            if config.continuous_admission:
+                kwargs["continuous_admission"] = True
             # None = unpinned (engine default / plan-DB-resolvable); any
             # explicit value — INCLUDING spec_draft=0 and the default
             # spellings 'ngram'/'fused' — reaches the engine as a pin, so
@@ -540,6 +548,13 @@ class Trainer:
                         (config.spec_draft or 0)
                         if config.continuous_batching else 0
                     ),
+                    # continuous admission allocates prompt chains FROM the
+                    # pool (no static region to subtract); only the
+                    # EXPLICIT config flag is visible here — a plan-DB
+                    # entry resolving continuous at engine construction
+                    # surfaces as the engine's pool-floor error, naming
+                    # the pin to set
+                    continuous=config.continuous_admission,
                 )
             engine = engine_cls(
                 model_cfg,

@@ -58,7 +58,9 @@ class TestBenchContract:
                     "tokens_per_verify_step", "spec_verify_impl",
                     "hbm_peak_bytes", "recompile_count", "fleet_tok_s",
                     "fleet_workers", "weight_bus", "weight_bytes_per_update",
-                    "weight_sync_ms"):
+                    "weight_sync_ms",
+                    "cb_mode", "prefill_shared_frac", "pages_shared_frac",
+                    "slot_idle_frac"):
             assert key in rec, key
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
         # (honest null, never a fabricated number), a healthy single-config
@@ -73,6 +75,12 @@ class TestBenchContract:
         assert rec["weight_bus"] is None
         assert rec["weight_bytes_per_update"] is None
         assert rec["weight_sync_ms"] is None
+        # continuous-batching fields (ISSUE 12): the dense engine has no
+        # admission scheduler or shared pool — every slot honestly null
+        assert rec["cb_mode"] is None
+        assert rec["prefill_shared_frac"] is None
+        assert rec["pages_shared_frac"] is None
+        assert rec["slot_idle_frac"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0
@@ -132,6 +140,43 @@ class TestBenchContract:
         # CPU resolves the probe-gated fused kernel to its exact
         # unrolled fallback; either spelling is a valid record, null is not
         assert rec["spec_verify_impl"] in ("fused", "unrolled")
+
+    def test_cb_record_fields(self):
+        """A shared-prefix continuous-admission row must self-describe
+        (ISSUE 12): the admission regime that ran, genuinely shared pages
+        (the prompt-KV capacity win), shared-prefix admissions, and the
+        slot-idle fraction the backfill A/B moves."""
+        # prompts must span >= 1 FULL page (max_prompt > the 128-token
+        # default page size) or there is no full-prefix chain to alias —
+        # only the CoW tail, which every candidate splits
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_MAX_PROMPT": "256", "BENCH_MAX_NEW": "16",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+            "BENCH_CONT_ADMISSION": "1",
+        })
+        assert "error" not in rec
+        assert rec["cb_mode"] == "continuous"
+        assert rec["scheduler"] == "refill"
+        assert rec["pages_shared_frac"] > 0
+        assert 0.0 < rec["prefill_shared_frac"] <= 1.0
+        assert 0.0 <= rec["slot_idle_frac"] < 1.0
+        assert rec["plan"]["cb_mode"] == "continuous"
+        assert rec["value"] > 0
+
+    def test_cb_fixed_control_fields(self):
+        """The fixed-batch refill control reads cb_mode='refill' with the
+        sharing fields null — distinguishable from a shared row by the
+        artifact alone."""
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+        })
+        assert "error" not in rec
+        assert rec["cb_mode"] == "refill"
+        assert rec["prefill_shared_frac"] is None
+        assert rec["pages_shared_frac"] is None
+        assert rec["slot_idle_frac"] is not None
 
     def test_learner_record_shape(self):
         rec = run_bench({

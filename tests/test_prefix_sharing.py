@@ -1,0 +1,542 @@
+"""Copy-on-write prefix-shared KV pages + continuous admission (ISSUE 12).
+
+The two contracts this PR exists for, both pinned here:
+
+* **Exactness** — greedy decode with prefix sharing and/or continuous
+  admission enabled is bit-identical to the unshared fixed-batch refill
+  engine, through every composition: plain refill, speculative decoding,
+  budgeted pools with preemption, and the lazy per-group prefill (whose
+  [1, P] reuse of the jitted prefill must match the batched pass bitwise).
+* **Conservation** — the refcounted pool never leaks or double-frees a
+  page under any interleaving of donor-aliased admits, copy-on-write
+  splits, releases, and chain drops (property-style fuzz with
+  ``check_invariants`` recomputing every refcount from scratch).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.config import SamplingConfig, TrainConfig
+from distrl_llm_tpu.engine.page_pool import PagePool
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+from distrl_llm_tpu.models import TINY, init_params
+
+PAGE = 8
+
+
+def _make_engine(max_new=24, rows=4, pool=0, spec=0, **kw):
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=16, max_new_tokens=max_new,
+        eos_token_ids=[1], pad_token_id=0, page_size=PAGE,
+        max_concurrent_rows=rows, scheduler="refill",
+        max_kv_pages=pool, spec_draft=spec, decode_chunk=4,
+        autotune=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+
+
+def _prompts(b=6, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, TINY.vocab_size, size=(b, 16)).astype(np.int32)
+    mask = np.ones((b, 16), np.int32)
+    # ragged real lengths >= PAGE, so every prompt has >= 1 FULL page to
+    # alias (rl in [8, 16]) and full/partial splits vary per row
+    for i in range(b):
+        pad = rng.integers(0, 9)
+        ids[i, :pad] = 0
+        mask[i, :pad] = 0
+    return ids, mask
+
+
+def _greedy(max_tokens=24, n=2):
+    return SamplingConfig(max_tokens=max_tokens, temperature=0.0, top_p=1.0, n=n)
+
+
+def _shared_pool(n_pages=24, r_slots=4, ps=PAGE):
+    return PagePool(first_page=0, n_pages=n_pages, r_slots=r_slots, width=6,
+                    page_size=ps, prompt_pages=2, prefix_sharing=True)
+
+
+class TestPagePoolCoW:
+    def test_alias_and_split_refcounts(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)  # rl=12: 1 full page + tail
+        assert chain is not None
+        assert pool.ref == {chain[0]: 1, chain[1]: 1}  # the group hold
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        # the full page is aliased (hold + slot), the tail split into a
+        # private page with the device copy queued from the PRISTINE tail
+        assert pool.ref[chain[0]] == 2
+        assert pool.ref[chain[1]] == 1  # hold only — slot took a copy
+        assert pool.cow_splits == 1
+        assert pool.take_copy(0) == chain[1]
+        assert pool.take_copy(0) is None  # drained exactly once
+        assert pool.table[0, 0] == chain[0]
+        assert pool.table[0, 1] == pool.owned[0][0]
+        pool.check_invariants()
+
+    def test_donor_admit_aliases_full_prefix(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        pool.drop_prefix(0)  # ledger gone: forces the donor path
+        pool.check_invariants()
+        assert pool.admit(1, 0, real_len=12, last_position=20, donor=0,
+                          first_write=12)
+        # donor's full-prefix page aliased (the ISSUE's donor semantics);
+        # tail copied from the donor's first private page (pristine below
+        # real_len — the donor only ever wrote positions >= real_len)
+        assert pool.shared[1] == [chain[0]]
+        assert pool.ref[chain[0]] == 2
+        assert pool.take_copy(1) == pool.owned[0][0]
+        pool.check_invariants()
+
+    def test_donor_private_tail_always_splits(self):
+        """Review regression: a deferred (no first_write) donor admit whose
+        tail source is the donor's PRIVATE page must split immediately —
+        attaching a mutable owned page refcount-shared would double-track
+        it (invariant break, then double-grant after the donor releases)."""
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        pool.take_copy(0)
+        pool.drop_prefix(0)  # donor's tail copy is now the only tail source
+        assert pool.admit(1, 0, real_len=12, last_position=20, donor=0)
+        assert pool.tail_shared[1] is None  # never attached shared
+        assert pool.take_copy(1) == pool.owned[0][0]
+        assert pool.owned[1][0] not in pool.owned[0]
+        pool.check_invariants()
+        pool.release(0)
+        pool.check_invariants()  # no double-tracked page survives the donor
+        pool.release(1)
+        pool.check_invariants()
+        assert pool.free_pages == pool.universe_pages
+
+    def test_deferred_tail_split_via_note_write(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)
+        # no first_write: the tail page stays attached copy-on-write
+        assert pool.admit(0, 0, real_len=12, last_position=12)
+        assert pool.tail_shared[0] == chain[1]
+        assert pool.ref[chain[1]] == 2
+        assert pool.table[0, 1] == chain[1]
+        pool.check_invariants()
+        # the write triggers the split
+        op = pool.note_write(0, 12)
+        assert op is not None and op[0] == chain[1]
+        assert pool.tail_shared[0] is None
+        assert pool.ref[chain[1]] == 1  # back to hold-only
+        assert pool.table[0, 1] == op[1] == pool.owned[0][0]
+        assert pool.cow_splits == 1
+        # a second write in an owned page is free
+        assert pool.note_write(0, 13) is None
+        pool.check_invariants()
+
+    def test_write_into_full_prefix_is_contract_violation(self):
+        pool = _shared_pool()
+        pool.alloc_prefix(0, 2, 1)
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        with pytest.raises(AssertionError, match="immutable"):
+            pool.note_write(0, 3)
+
+    def test_release_frees_only_at_refcount_zero(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)
+        for s in (0, 1, 2):
+            assert pool.admit(s, 0, real_len=12, last_position=20,
+                              first_write=12)
+        assert pool.ref[chain[0]] == 4  # hold + 3 slots
+        free0 = pool.free_pages
+        pool.release(0)
+        assert chain[0] in pool.ref and pool.ref[chain[0]] == 3
+        pool.release(1)
+        pool.release(2)
+        assert pool.ref[chain[0]] == 1  # hold keeps it resident
+        pool.drop_prefix(0)
+        assert chain[0] not in pool.ref and chain[0] in pool.free
+        pool.check_invariants()
+        assert pool.free_pages == pool.universe_pages
+        assert pool.free_pages > free0
+
+    def test_drop_before_release_keeps_aliased_pages_alive(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 1)
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        pool.drop_prefix(0)
+        # the tail freed with the hold, the aliased full page survives
+        assert chain[1] in pool.free
+        assert pool.ref[chain[0]] == 1
+        pool.check_invariants()
+        pool.release(0)
+        assert chain[0] in pool.free
+        pool.check_invariants()
+
+    def test_aligned_prompt_needs_no_copy(self):
+        pool = _shared_pool()
+        chain = pool.alloc_prefix(0, 2, 2)  # rl=16: 2 full pages, no tail
+        assert len(chain) == 2
+        assert pool.admit(0, 0, real_len=16, last_position=20, first_write=16)
+        assert pool.cow_splits == 0
+        assert pool.take_copy(0) is None
+        assert pool.shared[0] == chain
+        pool.check_invariants()
+
+    def test_refcount_aware_occupancy_counts_shared_once(self):
+        pool = _shared_pool(n_pages=16)
+        pool.alloc_prefix(0, 2, 2)
+        for s in range(4):
+            assert pool.admit(s, 0, real_len=16, last_position=16,
+                              first_write=16)
+        # per-slot accounting would count the 2 chain pages 4x each (2
+        # shared * 4 slots + 4 private = 12 of 15); physically it is
+        # 2 shared + 4 private = 6
+        assert pool.used_pages == 6
+        assert pool.shared_pages == 2
+        assert 0 < pool.occupancy < 12 / 15
+        pool.check_invariants()
+
+    def test_monolithic_region_adoption_and_reclaim(self):
+        # static-region style: prompt pages live below first_page
+        pool = PagePool(first_page=4, n_pages=8, r_slots=2, width=6,
+                        page_size=PAGE, prompt_pages=2, prefix_sharing=True)
+        pool.register_prefix(0, [0, 1], 1)
+        pool.reclaim([2, 3])  # prompt 1 is dead padding
+        assert pool.universe_pages == 7 + 4
+        assert pool.admit(0, 0, real_len=12, last_position=20, first_write=12)
+        assert pool.table[0, 0] == 0
+        pool.check_invariants()
+        pool.release(0)
+        pool.drop_prefix(0)
+        pool.check_invariants()
+        assert sorted(pool.free) == [0, 1, 2, 3] + list(range(5, 12))
+
+    def test_unshared_pool_unchanged(self):
+        # prefix_sharing off: the historical accounting, bit-for-bit
+        pool = PagePool(first_page=10, n_pages=8, r_slots=2, width=6,
+                        page_size=PAGE, prompt_pages=2)
+        assert pool.admit(0, prompt_idx=1, real_len=12, last_position=20)
+        assert pool.table[0, 0] == 1 * 2  # static-region formula
+        assert pool.used_pages == 2 and pool.shared_pages == 0
+        pool.check_invariants()
+
+
+class TestCoWPropertyFuzz:
+    def test_random_admit_write_release_sequences_conserve_pages(self):
+        """Property-style: random interleavings of chain alloc/drop, donor
+        and ledger admits, CoW writes, and releases — after every op the
+        recomputed refcounts must match and free+owned+shared must tile
+        the pool; at the end, releasing everything returns every page."""
+        rng = np.random.default_rng(1234)
+        for trial in range(20):
+            r_slots = int(rng.integers(2, 6))
+            n_pages = int(rng.integers(16, 40))
+            pool = PagePool(first_page=0, n_pages=n_pages, r_slots=r_slots,
+                            width=8, page_size=PAGE, prompt_pages=3,
+                            prefix_sharing=True)
+            occupants: dict[int, tuple[int, int]] = {}  # slot -> (prompt, rl)
+            live_chains: dict[int, int] = {}  # prompt -> real_len
+            next_prompt = 0
+            for _ in range(60):
+                op = rng.integers(0, 5)
+                if op == 0 and len(live_chains) < 6:
+                    rl = int(rng.integers(PAGE, 3 * PAGE + 1))
+                    n_chain = -(-rl // PAGE)
+                    if pool.alloc_prefix(next_prompt, n_chain,
+                                         rl // PAGE) is not None:
+                        live_chains[next_prompt] = rl
+                        next_prompt += 1
+                elif op == 1 and live_chains:
+                    free_slots = [s for s in range(r_slots)
+                                  if s not in occupants]
+                    if free_slots:
+                        s = free_slots[0]
+                        g = int(rng.choice(list(live_chains)))
+                        rl = live_chains[g]
+                        last = int(rng.integers(rl, rl + 2 * PAGE))
+                        # alternate donor-slot vs ledger admits, and
+                        # immediate vs deferred CoW splits
+                        donors = [v for v, (pg, _) in occupants.items()
+                                  if pg == g]
+                        donor = donors[0] if donors and rng.integers(2) else None
+                        fw = rl if rng.integers(2) else None
+                        if pool.admit(s, g, rl, last, donor=donor,
+                                      first_write=fw):
+                            pool.take_copy(s)
+                            occupants[s] = (g, rl)
+                elif op == 2 and occupants:
+                    s = int(rng.choice(list(occupants)))
+                    _g, rl = occupants[s]
+                    try:
+                        pool.note_write(s, int(rng.integers(rl, rl + PAGE)))
+                    except RuntimeError:
+                        pass  # dry pool may refuse a split — legal
+                elif op == 3 and occupants:
+                    s = int(rng.choice(list(occupants)))
+                    pool.release(s)
+                    del occupants[s]
+                elif op == 4 and live_chains:
+                    g = int(rng.choice(list(live_chains)))
+                    pool.drop_prefix(g)
+                    del live_chains[g]
+                pool.check_invariants()
+            for s in list(occupants):
+                pool.release(s)
+                pool.check_invariants()
+            for g in list(live_chains):
+                pool.drop_prefix(g)
+                pool.check_invariants()
+            assert pool.free_pages == pool.universe_pages, (
+                f"trial {trial}: leaked "
+                f"{pool.universe_pages - pool.free_pages} page(s)"
+            )
+            assert not pool.ref, f"trial {trial}: refcount residue {pool.ref}"
+
+    def test_ensure_refuses_unsplit_tail(self):
+        pool = _shared_pool()
+        pool.alloc_prefix(0, 2, 1)
+        # deferred split: tail attached shared, one private decode page
+        assert pool.admit(0, 0, real_len=12, last_position=20)
+        with pytest.raises(AssertionError, match="unsplit shared tail"):
+            pool.ensure(0, 30)
+
+
+class TestSharedGreedyIdentity:
+    def test_prefix_sharing_matches_unshared(self, tiny_params, monkeypatch):
+        """The acceptance pin: shared-prefix refill, greedy, bit-identical
+        to the unshared engine — with the per-boundary pool self-check on
+        and genuine sharing (pages_shared_frac > 0)."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts(b=5)
+        sampling = _greedy(max_tokens=16, n=2)
+        ref = _make_engine(max_new=16).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(1))
+        eng = _make_engine(max_new=16, prefix_sharing=True)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+        stats = eng.last_pool_stats
+        assert stats["cb_mode"] == "refill_shared"
+        assert stats["pages_shared_frac"] > 0
+        assert stats["prefill_shared_frac"] == 1.0
+        assert stats["cow_splits"] > 0
+        assert stats["backfill_admissions"] > 0  # 10 candidates, 4 slots
+
+    def test_continuous_admission_matches_unshared(self, tiny_params,
+                                                   monkeypatch):
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts(b=5, seed=3)
+        sampling = _greedy(max_tokens=16, n=2)
+        ref = _make_engine(max_new=16).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(2))
+        eng = _make_engine(max_new=16, continuous_admission=True)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+        stats = eng.last_pool_stats
+        assert stats["cb_mode"] == "continuous"
+        assert stats["groups_prefilled"] == 5  # once per group, not per slot
+        assert stats["pages_shared_frac"] > 0
+
+    def test_single_row_prefill_is_bit_identical_to_batched(self, tiny_params):
+        """The load-bearing numeric assumption under continuous admission:
+        the jitted prefill at [1, P] produces bitwise the same KV tiles and
+        logits as the batched [B, P] pass (row-independent ops on the CPU
+        contract)."""
+        ids, mask = _prompts(b=4, seed=7)
+        eng = _make_engine()
+        kb, vb, logb, rlb = eng._prefill(
+            tiny_params, None, jnp.asarray(ids), jnp.asarray(mask))
+        for i in range(4):
+            k1, v1, log1, _ = eng._prefill(
+                tiny_params, None, jnp.asarray(ids[i:i + 1]),
+                jnp.asarray(mask[i:i + 1]))
+            np.testing.assert_array_equal(np.asarray(log1[0]),
+                                          np.asarray(logb[i]))
+            pp = eng.prompt_pages
+            for layer in range(TINY.num_layers):
+                np.testing.assert_array_equal(
+                    np.asarray(k1[layer]),
+                    np.asarray(kb[layer][:, i * pp:(i + 1) * pp]),
+                )
+
+    @pytest.mark.slow
+    def test_spec_compositions_match_unshared(self, tiny_params, monkeypatch):
+        """Speculative decoding over shared prefixes: the verify/draft
+        loops, CoW admits, and (for continuous) lazy group prefill compose
+        without perturbing greedy outputs."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts(b=4, seed=9)
+        sampling = _greedy(max_tokens=16, n=2)
+        ref = _make_engine(max_new=16, spec=2).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(8))
+        for kw in ({"prefix_sharing": True}, {"continuous_admission": True}):
+            eng = _make_engine(max_new=16, spec=2, **kw)
+            res = eng.generate(
+                tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(8))
+            np.testing.assert_array_equal(res.tokens, ref.tokens, err_msg=str(kw))
+            assert eng.last_pool_stats["pages_shared_frac"] > 0
+
+    @pytest.mark.slow
+    def test_budgeted_shared_pools_match_worst_case(self, tiny_params,
+                                                    monkeypatch):
+        """Tight pools under sharing: preempt-by-recompute must re-admit
+        through the still-held chain (the hold outlives the evicted slot's
+        releases) and stay bit-identical."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts(b=4, seed=5)
+        sampling = _greedy(max_tokens=24, n=2)
+        ref = _make_engine(max_new=24).generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+        eng = _make_engine(max_new=24, prefix_sharing=True, pool=9)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+        assert eng.last_pool_stats["preemptions"] > 0
+        # continuous under a budget: floor = 1 + private(1+3) + chain(2)
+        for pool_pages in (11, 7):
+            eng = _make_engine(max_new=24, continuous_admission=True,
+                               pool=pool_pages)
+            res = eng.generate(
+                tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(4))
+            np.testing.assert_array_equal(res.tokens, ref.tokens,
+                                          err_msg=str(pool_pages))
+            assert eng.last_pool_stats["peak_pages_used"] <= pool_pages - 1
+
+    @pytest.mark.slow
+    def test_sampling_logprobs_survive_shared_admission(self, tiny_params):
+        """Under temperature sampling the outputs legitimately differ from
+        the fixed-batch engine (admission timing feeds the rng), but every
+        returned behavior logprob must still equal the learner's
+        teacher-forced recompute on the returned tokens — the cross-stack
+        consistency that catches a corrupted shared prefix."""
+        from distrl_llm_tpu.learner.losses import answer_logprobs
+
+        ids, mask = _prompts(b=4, seed=11)
+        sampling = SamplingConfig(max_tokens=16, temperature=1.0, top_p=1.0,
+                                  n=2)
+        eng = _make_engine(max_new=16, continuous_admission=True,
+                           capture_logprobs=True)
+        res = eng.generate(
+            tiny_params, None, ids, mask, sampling, jax.random.PRNGKey(10))
+        b, n, t = res.tokens.shape
+        pid = np.repeat(ids, n, axis=0)
+        pmask = np.repeat(mask, n, axis=0)
+        aid = res.tokens.reshape(b * n, t)
+        lengths = res.lengths.reshape(b * n)
+        amask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.int32)
+        recomputed = np.asarray(answer_logprobs(
+            tiny_params, TINY, jnp.asarray(pid), jnp.asarray(pmask),
+            jnp.asarray(aid), jnp.asarray(amask), remat=False,
+        ))
+        got = res.logprobs.reshape(b * n, t)
+        real = amask.astype(bool)
+        np.testing.assert_allclose(got[real], recomputed[real],
+                                   atol=3e-3, rtol=3e-3)
+
+
+class TestValidationAndPlan:
+    def test_flags_require_refill_scheduler(self):
+        with pytest.raises(ValueError, match="prefix_sharing"):
+            PagedGenerationEngine(
+                TINY, max_prompt_tokens=16, max_new_tokens=8,
+                eos_token_ids=[1], pad_token_id=0, prefix_sharing=True,
+                autotune=False,
+            )
+        with pytest.raises(ValueError, match="continuous_admission"):
+            PagedGenerationEngine(
+                TINY, max_prompt_tokens=16, max_new_tokens=8,
+                eos_token_ids=[1], pad_token_id=0, continuous_admission=True,
+                autotune=False,
+            )
+
+    def test_continuous_pool_floor_includes_chain(self):
+        with pytest.raises(ValueError, match="prompt-chain"):
+            _make_engine(max_new=24, continuous_admission=True, pool=6)
+        # the same pool is legal without the chain requirement
+        assert _make_engine(max_new=24, pool=6) is not None
+
+    def test_config_rejects_dead_flags(self):
+        kw = dict(
+            model="tiny", episodes=1, batch_size=2, num_candidates=2,
+            topk=2, train_batch_size=2, max_prompt_tokens=16,
+            max_new_tokens=8, number_of_actors=1, number_of_learners=1,
+            metrics_backend="null", engine_impl="paged",
+            max_concurrent_sequences=4,
+        )
+        with pytest.raises(ValueError, match="refill scheduler"):
+            TrainConfig(prefix_sharing=True, **kw)
+        with pytest.raises(ValueError, match="refill scheduler"):
+            TrainConfig(continuous_admission=True, **kw)
+        cfg = TrainConfig(continuous_batching=True, prefix_sharing=True,
+                          continuous_admission=True, **kw)
+        from distrl_llm_tpu.trainer import engine_kwargs_from_config
+
+        kwargs = engine_kwargs_from_config(cfg)
+        assert kwargs["prefix_sharing"] is True
+        assert kwargs["continuous_admission"] is True
+        # unset flags stay ABSENT (plan-DB-resolvable at the engine)
+        kwargs = engine_kwargs_from_config(
+            TrainConfig(continuous_batching=True, **kw))
+        assert "prefix_sharing" not in kwargs
+        assert "continuous_admission" not in kwargs
+
+    def test_plan_db_enables_continuous_and_pins_beat_it(self, tmp_path):
+        """A stored cb_mode='continuous' entry engages on an unpinned
+        refill engine; an explicit continuous_admission=False pins the
+        fixed regime past it; a wave engine drops it with a warning."""
+        from distrl_llm_tpu.autotune import (
+            ExecutionPlan, PlanStore, current_device_kind,
+            model_config_hash, plan_key, shape_bucket,
+        )
+
+        db = str(tmp_path / "plans.json")
+        store = PlanStore(db)
+        key = plan_key(current_device_kind(), model_config_hash(TINY),
+                       shape_bucket(16, 8, 0))
+        store.put(key, ExecutionPlan(decode_path="paged",
+                                     cb_mode="continuous"))
+        store.save()
+        common = dict(
+            max_prompt_tokens=16, max_new_tokens=8, eos_token_ids=[1],
+            pad_token_id=0, page_size=PAGE, plan_db=db,
+        )
+        eng = PagedGenerationEngine(
+            TINY, scheduler="refill", max_concurrent_rows=4, **common)
+        assert eng.continuous_admission and eng.prefix_sharing
+        assert eng.resolved_plan.plan.cb_mode == "continuous"
+        pinned = PagedGenerationEngine(
+            TINY, scheduler="refill", max_concurrent_rows=4,
+            continuous_admission=False, **common)
+        assert not pinned.continuous_admission and not pinned.prefix_sharing
+        assert pinned.resolved_plan.plan.cb_mode == "batch"
+        waves = PagedGenerationEngine(TINY, **common)  # warns, never raises
+        assert not waves.continuous_admission
+        assert waves.cb_mode == "waves"
+
+    def test_empty_db_defaults_off(self, tmp_path):
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8, eos_token_ids=[1],
+            pad_token_id=0, page_size=PAGE, scheduler="refill",
+            max_concurrent_rows=4, plan_db=str(tmp_path / "empty.json"),
+        )
+        assert not eng.prefix_sharing and not eng.continuous_admission
+        assert eng.cb_mode == "refill"
+        assert eng.resolved_plan.plan.cb_mode is None
+
+    def test_worker_parser_rejects_dead_flags(self, capsys):
+        from distrl_llm_tpu.distributed import worker_main
+
+        # parser.error fires during arg validation, before any socket or
+        # engine work — the dead-flag policy shared with TrainConfig
+        with pytest.raises(SystemExit):
+            worker_main.main(["--prefix-sharing"])
+        assert "--scheduler refill" in capsys.readouterr().err

@@ -261,6 +261,10 @@ def cmd_measure(args) -> int:
             (None if x in ("", "auto") else x)
             for x in args.spec_verifies.split(",")
         ),
+        cb_modes=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.cb_modes.split(",")
+        ),
     )
     print(f"measuring {len(candidates)} candidate plan(s) for {args.model} "
           f"p{args.max_prompt}+n{args.max_new} × {args.prompts}·"
@@ -354,6 +358,12 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--spec-drafters", dest="spec_drafters", default="auto",
                    help="comma list from auto,ngram,self ('auto' = engine "
                         "default; speculative path only)")
+    m.add_argument("--cb-modes", dest="cb_modes", default="auto",
+                   help="comma list of continuous-batching admission "
+                        "candidates: auto (engine default — fixed "
+                        "batches), batch, continuous (prefix-shared "
+                        "chains + lazy per-group admission; paged/"
+                        "speculative paths only)")
     m.add_argument("--spec-verifies", dest="spec_verifies", default="auto",
                    help="comma list from auto,fused,unrolled ('auto' = "
                         "engine default; speculative path only)")

@@ -58,6 +58,14 @@ stage "chaos_smoke" env JAX_PLATFORMS=cpu \
 # trace_report shows the speculative section
 stage "spec_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/spec_smoke.py
+# continuous-batching gate (ISSUE 12): grouped prompts through the
+# prefix-sharing and continuous-admission engines — byte-identical greedy
+# outputs vs the unshared fixed-batch golden, genuinely shared prompt
+# pages (pages_shared_frac > 0), >= 1 mid-round backfill admission,
+# once-per-group prefill, budgeted-pool preemption parity, and the
+# speculative composition
+stage "cb_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/cb_smoke.py
 # observability gate (ISSUE 8): 2-worker tiny run — scrape both worker
 # endpoints and the driver's fleet endpoint mid-run (fleet/* series
 # present, per-worker token counters flowing), inject a seeded NaN,
@@ -109,7 +117,7 @@ stage "suite_engines_2" timeout 600 python -m pytest -q \
   tests/test_speculative.py tests/test_sharded_paged.py
 stage "suite_engines_3" timeout 600 python -m pytest -q \
   tests/test_paged_budget.py tests/test_inflight_updates.py \
-  tests/test_paged_int8_kernel.py
+  tests/test_paged_int8_kernel.py tests/test_prefix_sharing.py
 stage "suite_learner" timeout 600 python -m pytest -q \
   tests/test_train_step.py tests/test_losses.py tests/test_model_golden.py \
   tests/test_lora.py tests/test_optim.py tests/test_quant.py tests/test_sharding.py
@@ -132,7 +140,8 @@ stage "suite_slow_engines" timeout 1200 python -m pytest -q -m slow \
   tests/test_engine.py tests/test_paged.py tests/test_sharded_paged.py \
   tests/test_inflight_updates.py
 stage "suite_slow_sched" timeout 1200 python -m pytest -q -m slow \
-  tests/test_speculative.py tests/test_paged_budget.py
+  tests/test_speculative.py tests/test_paged_budget.py \
+  tests/test_prefix_sharing.py
 stage "suite_slow_learner" timeout 1200 python -m pytest -q -m slow \
   tests/test_train_step.py tests/test_losses.py tests/test_clip_objective.py \
   tests/test_full_finetune.py tests/test_quant.py tests/test_trainer.py \

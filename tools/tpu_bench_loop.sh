@@ -223,6 +223,22 @@ bench spec_unrolled /tmp/bench_tpu_spec_unrolled.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SPEC_DRAFT=4 BENCH_SPEC_VERIFY=unrolled \
   BENCH_SCAN_CHUNK=16
+# continuous-batching A/B (ISSUE 12): shared-prefix + continuous admission
+# vs fixed episode batches on ONE refill config. refill_scan above is the
+# fixed-batch control (identical env minus BENCH_PREFIX_SHARING /
+# BENCH_CONT_ADMISSION; BENCH_CONT_ADMISSION=0 on the middle arm pins the
+# fixed regime past any stored plan while sharing is on). Each row records
+# cb_mode / prefill_shared_frac / pages_shared_frac / slot_idle_frac, so
+# the artifact shows both the prompt-KV capacity win (pages_shared_frac)
+# and the backfill win (slot_idle_frac drop at BENCH_EOS_RATE's ragged
+# lengths).
+bench cb_prefix /tmp/bench_tpu_cb_prefix.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_PREFIX_SHARING=1 BENCH_CONT_ADMISSION=0 \
+  BENCH_SCAN_CHUNK=16
+bench cb_continuous /tmp/bench_tpu_cb_continuous.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
@@ -267,6 +283,7 @@ all_done() {
            mem_envelope train_curve \
            dense dense_int8_mw waves_eos dense_eos \
            paged_blocked weight_bus_ab \
+           cb_prefix cb_continuous \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

@@ -93,6 +93,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequences rows decoding, admit a pending candidate "
                         "whenever a slot's occupant hits EOS (vLLM continuous "
                         "batching) instead of draining whole waves")
+    p.add_argument("--prefix_sharing", action="store_true",
+                   help="copy-on-write prompt-prefix sharing: a group's N "
+                        "rollouts alias ONE refcounted prompt page chain "
+                        "(vLLM prefix caching) instead of holding private "
+                        "copies — prompt KV is resident ~once per group and "
+                        "finished groups' pages recycle into decode "
+                        "capacity. Requires --continuous_batching; greedy "
+                        "outputs are bit-identical to the unshared engine")
+    p.add_argument("--continuous_admission", action="store_true",
+                   help="serving-grade admission: replace the fixed-episode-"
+                        "batch prefill with a group request queue — each "
+                        "prompt prefills lazily into pool-allocated chain "
+                        "pages as freed slots and page budget allow, so "
+                        "short completions backfill immediately. Implies "
+                        "--prefix_sharing; requires --continuous_batching")
     p.add_argument("--spec_draft", type=int, default=None,
                    help="speculative decoding: draft this many tokens per "
                         "step and verify in one forward; distribution-"
