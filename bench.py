@@ -286,6 +286,22 @@ def _recompile_count() -> int:
     return obs.retrace_total()
 
 
+def _serving_pct(ledger, metric: str, q: float):
+    """Rounded serving-latency percentile for a bench row, or None without
+    a ledger / without samples (dense/fixed/fleet rows)."""
+    if ledger is None:
+        return None
+    v = ledger.percentile(metric, q)
+    return round(v, 3) if v is not None else None
+
+
+def _serving_stall_frac(ledger):
+    if ledger is None:
+        return None
+    v = ledger.stall_frac()
+    return round(v, 4) if v is not None else None
+
+
 def _fleet_tok_s():
     """Fleet-aggregate tok/s gauge when a control-plane fleet published one
     in this process (obs.FleetAggregator). Local rows record null (bench
@@ -866,6 +882,17 @@ def main() -> int:
     # recompile_count field must describe THIS config's programs only
     importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
+    # serving observability over the TIMED rounds only (ISSUE 13): arm a
+    # ledger on continuous-admission engines AFTER warmup so the recorded
+    # TTFT/queue-wait percentiles describe steady-state serving, not the
+    # compile-inflated warmup round. Fixed-batch and dense rows keep the
+    # fields null (the cb A/B's contract, pinned in test_bench_contract).
+    serving_ledger = None
+    if getattr(engine, "continuous_admission", False):
+        from distrl_llm_tpu.serving_obs import ServingLedger
+
+        serving_ledger = ServingLedger(ring_size=4096)
+        engine.serving_ledger = serving_ledger
     if fleet_agg is not None:
         # first refresh sets the per-worker (ts, gen_tokens) marks off the
         # warmup round's piggybacked snapshots; the post-timing refresh
@@ -1168,6 +1195,20 @@ def main() -> int:
             round(1.0 - alive_slot_steps / (steps_dispatched * slot_rows), 4)
             if alive_slot_steps and steps_dispatched else None
         ),
+        # request-level serving latencies (ISSUE 13, pinned in
+        # tests/test_bench_contract.py): TTFT / queue-wait percentiles and
+        # the attributed admission-stall fraction over the TIMED rounds,
+        # from a ServingLedger armed post-warmup on continuous-admission
+        # engines — null on dense/fixed-batch/fleet rows (no ledger). The
+        # stall fraction is slot_idle_frac's EXPLANATION: declined
+        # admission passes over all passes, with per-reason counts in the
+        # registry (serving/admission_stalls/*)
+        "ttft_p50_ms": _serving_pct(serving_ledger, "ttft_ms", 50),
+        "ttft_p99_ms": _serving_pct(serving_ledger, "ttft_ms", 99),
+        "queue_wait_p50_ms": _serving_pct(
+            serving_ledger, "queue_wait_ms", 50
+        ),
+        "admission_stall_frac": _serving_stall_frac(serving_ledger),
         # measured-attribution fields (ISSUE 8, pinned in
         # tests/test_bench_contract.py): device HBM watermark (null on
         # backends without memory stats), shape-keyed retrace count since

@@ -481,6 +481,28 @@ class TrainConfig:
     # bounded ring of OPEN records; overflow is counted
     # (lineage/ring_evictions), never silent
     lineage_ring: int = 1024
+    # --- serving observability (distrl_llm_tpu/serving_obs.py, ISSUE 13) --
+    # Request-level serving ledger over the continuous-batching engine:
+    # per-group lifecycle events (enqueue → admit → prefill done → first
+    # token → finish) recorded at the refill loop's host chunk boundaries,
+    # yielding serving/ttft_ms, serving/tpot_ms, serving/queue_wait_ms,
+    # serving/e2e_ms histograms plus the admission audit
+    # (serving/admission_stalls/<reason>). Requires engine_impl='paged' +
+    # continuous_batching (the instrumented loops); over rollout_workers
+    # the ledger is armed worker-side (worker_main --serving-obs) and the
+    # driver folds the fleet view. One attribute check per hook when off.
+    serving_obs: bool = False
+    # per-run JSONL (serving_dir/serving.jsonl, streamed as records close;
+    # tools/serving_report.py reads it). Implies serving_obs.
+    serving_dir: str | None = None
+    # bounded ring of OPEN serving records; overflow counted
+    # (serving/ring_evictions), never silent
+    serving_ring: int = 1024
+    # SLO gates (ISSUE 13): arm the sentinel's ttft_blowup /
+    # queue_wait_blowup triggers — the step's worst observed latency above
+    # the limit dumps a flight-recorder bundle. Require --sentinel.
+    slo_ttft_ms: float | None = None
+    slo_queue_wait_ms: float | None = None
     # Hang detector on generation rounds — parity with the reference's
     # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
     # default: a first rollout legitimately spends minutes in XLA compilation;
@@ -620,6 +642,53 @@ class TrainConfig:
                 "consumption that only exist in the async regime (sync/"
                 "pipelined rounds are consumed by construction)"
             )
+        if self.serving_dir and not self.serving_obs:
+            # an output directory is an unambiguous ask — arm the ledger
+            self.serving_obs = True
+        if self.serving_ring < 1:
+            raise ValueError(
+                f"serving_ring must be >= 1, got {self.serving_ring}"
+            )
+        for slo_name in ("slo_ttft_ms", "slo_queue_wait_ms"):
+            slo = getattr(self, slo_name)
+            if slo is not None and slo <= 0:
+                raise ValueError(f"{slo_name} must be > 0, got {slo}")
+        if (
+            (self.slo_ttft_ms is not None
+             or self.slo_queue_wait_ms is not None)
+            and not self.sentinel
+        ):
+            raise ValueError(
+                "slo_ttft_ms/slo_queue_wait_ms arm sentinel triggers "
+                "(ttft_blowup / queue_wait_blowup) — set --sentinel (and "
+                "--flight_recorder_dir) or drop the SLO flags"
+            )
+        if (
+            (self.slo_ttft_ms is not None
+             or self.slo_queue_wait_ms is not None)
+            and not self.rollout_workers and not self.serving_obs
+        ):
+            # a local-engine SLO gate without the ledger could never fire
+            # (nothing produces serving/*_max) — an SLO is an unambiguous
+            # ask, arm the measurement; fleet runs instead read the
+            # worker-fed fleet/serving_* gauges
+            self.serving_obs = True
+        if self.serving_obs:
+            # dead-flag policy (the prefix_sharing precedent): the ledger
+            # instruments the refill/continuous loops only
+            if self.rollout_workers:
+                raise ValueError(
+                    "serving_obs over rollout_workers is armed WORKER-side "
+                    "(worker_main --serving-obs; the driver folds the "
+                    "fleet serving view from the obs blobs) — the driver "
+                    "has no local refill engine to instrument"
+                )
+            if self.engine_impl != "paged" or not self.continuous_batching:
+                raise ValueError(
+                    "serving_obs instruments the paged engine's refill/"
+                    "continuous loops — requires engine_impl='paged' and "
+                    "continuous_batching"
+                )
         # decode_scan_chunk covers every engine_impl and scheduler (dense,
         # paged wave + refill + speculative, paged_sharded)
         if self.continuous_batching and (

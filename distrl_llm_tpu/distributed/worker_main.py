@@ -64,7 +64,9 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  gpu_usage: float = 0.0,
                  budget_batch: int = 0, scan_chunk: int | None = None,
                  autotune: bool = True, plan_db: str | None = None,
-                 capture_logprobs: bool = False) -> None:
+                 capture_logprobs: bool = False,
+                 serving_obs: bool = False, serving_dir: str | None = None,
+                 serving_ring: int = 1024) -> None:
     """Build this worker's rollout engine. "tiny" → deterministic random-init
     TINY model (tests/smoke; every worker with the same seed holds identical
     weights); anything else is a local HF checkpoint path."""
@@ -186,6 +188,16 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         eos_token_ids=eos, pad_token_id=pad, cache_dtype=cache_dtype,
         lora_scale=_ENGINE_STATE["lora_scale"], **kwargs,
     )
+    if serving_obs:
+        # request-level serving ledger (ISSUE 13): this worker's refill
+        # loops record per-group lifecycle + admission audit; the
+        # serving/* registry series ride the obs blobs home so the driver
+        # folds a fleet serving view (main() closes it at drain)
+        from distrl_llm_tpu.serving_obs import ServingLedger
+
+        ledger = ServingLedger(ring_size=serving_ring, out_dir=serving_dir)
+        _ENGINE_STATE["engine"].serving_ledger = ledger
+        _ENGINE_STATE["serving_ledger"] = ledger
     _ENGINE_STATE["params"] = params
     # versioned adapter cache (weight_bus.py, ISSUE 9): filled by MSG_WEIGHTS
     # pushes, read by version-referencing dispatches. 2 slots — current +
@@ -467,6 +479,23 @@ def main(argv: list[str] | None = None) -> None:
                              "episode batch; implies --prefix-sharing "
                              "(requires --scheduler refill). Unset leaves "
                              "this host's autotune plan DB in charge")
+    parser.add_argument("--serving-obs", dest="serving_obs",
+                        action="store_true",
+                        help="request-level serving ledger (ISSUE 13): "
+                             "per-group lifecycle + admission audit from "
+                             "the refill loops; the serving/* series ride "
+                             "this worker's obs blobs into the driver's "
+                             "fleet fold (requires --scheduler refill)")
+    parser.add_argument("--serving-dir", dest="serving_dir", type=str,
+                        default=None,
+                        help="stream closed serving records to "
+                             "<dir>/serving.jsonl on THIS worker's "
+                             "filesystem (implies --serving-obs); inspect "
+                             "with tools/serving_report.py")
+    parser.add_argument("--serving-ring", dest="serving_ring", type=int,
+                        default=1024,
+                        help="bounded ring of OPEN serving records; "
+                             "overflow counted in serving/ring_evictions")
     # default 0.0 (worst-case page pool) vs the driver's reference-parity
     # 0.91: an unconfigured worker must size for the worst case rather
     # than assume it owns 91% of an unknown chip's HBM
@@ -581,6 +610,15 @@ def main(argv: list[str] | None = None) -> None:
             "--scheduler refill requires --max-concurrent-sequences "
             "(the decode slot count)"
         )
+    if args.serving_dir and not args.serving_obs:
+        args.serving_obs = True  # an output directory is an unambiguous ask
+    if args.serving_obs and args.scheduler != "refill":
+        # dead-flag policy (the prefix-sharing precedent): the serving
+        # ledger instruments the refill/continuous loops only
+        parser.error(
+            "--serving-obs/--serving-dir require --scheduler refill "
+            "(the refill scheduler hosts the instrumented admission loop)"
+        )
 
     if args.serve_model:
         _init_engine(
@@ -598,6 +636,8 @@ def main(argv: list[str] | None = None) -> None:
             scan_chunk=args.decode_scan_chunk,
             autotune=args.autotune == "on", plan_db=args.plan_db,
             capture_logprobs=args.capture_logprobs,
+            serving_obs=args.serving_obs, serving_dir=args.serving_dir,
+            serving_ring=args.serving_ring,
         )
 
     import signal
@@ -636,6 +676,11 @@ def main(argv: list[str] | None = None) -> None:
     server.serve_forever(handler)
     if metrics_server is not None:
         metrics_server.close()
+    serving_ledger = _ENGINE_STATE.get("serving_ledger")
+    if serving_ledger is not None:
+        # flush open records + the stall/occupancy summary line so a
+        # drained worker's serving.jsonl is report-complete
+        serving_ledger.close()
     if server.draining:
         # telemetry spans recorded since the last RPC have no response left
         # to ride home on — drop them explicitly rather than leak the list

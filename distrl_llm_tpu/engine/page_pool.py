@@ -433,6 +433,20 @@ class PagePool:
         self._record_occupancy()
         return (tail, new)
 
+    def slot_alias_info(self, slot: int) -> dict[str, int | bool]:
+        """Chain-alias facts for one slot's CURRENT admission, as the
+        serving ledger records them (ISSUE 13): how many full prefix pages
+        the slot aliases, whether its tail page is still attached
+        copy-on-write, and whether a CoW copy is queued for the caller's
+        admit dispatch. Read-only — a reporting view, not a transition.
+        Read it BETWEEN ``admit`` and ``take_copy``: draining the copy
+        source resets ``cow_queued``."""
+        return {
+            "shared_pages": len(self.shared[slot]),
+            "tail_shared": self.tail_shared[slot] is not None,
+            "cow_queued": self.copy_src[slot] is not None,
+        }
+
     def take_copy(self, slot: int) -> int | None:
         """Drain the slot's queued CoW copy source (the caller fuses the
         src -> owned[slot][0] page copy into its admit dispatch)."""

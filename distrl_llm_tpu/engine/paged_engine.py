@@ -1324,6 +1324,15 @@ class PagedGenerationEngine(LoraMailbox):
             )
         self.max_kv_pages = max_kv_pages
         self.last_pool_stats: dict | None = None
+        # request-level serving observability (ISSUE 13): when an owner
+        # (trainer --serving_obs, worker --serving-obs, bench cb rows)
+        # attaches a serving_obs.ServingLedger here, the refill/spec/
+        # continuous loops emit per-group lifecycle events and the
+        # admission audit at host chunk boundaries. None = every hook site
+        # is one attribute check — the telemetry-off fast path and the
+        # byte-identity pins are untouched (the ledger observes, never
+        # schedules)
+        self.serving_ledger: Any = None
         # per-round speculative stats (refill spec rounds only): drafter,
         # realized accept rate, tokens/verify-step, emit histogram, verify
         # kernel choice + grid steps, draft/target version bookkeeping
@@ -1672,6 +1681,13 @@ class PagedGenerationEngine(LoraMailbox):
         r_slots = min(self.max_concurrent_rows, total)
         sharing = self.prefix_sharing
         continuous = self.continuous_admission
+        # serving observability (ISSUE 13): one attribute read per round
+        # when unarmed; armed, the loop emits per-group lifecycle events
+        # and the admission audit at its existing host boundaries — the
+        # ledger observes, it never changes a scheduling decision
+        sl = self.serving_ledger
+        suid: dict[int, int] = {}  # group -> serving-record uid
+        t_enqueue = time.time()
 
         real_len_h = np.asarray(prompt_mask).sum(axis=-1).astype(np.int64)
         row_alive = real_len_h > 0
@@ -1961,8 +1977,24 @@ class PagedGenerationEngine(LoraMailbox):
         group_left = np.array(
             [n if row_alive[g] else 0 for g in range(b)]
         )
+        if sl is not None:
+            # open one serving record per live group as it enters the
+            # request queue (enqueue = round entry); the monolithic-
+            # prefill path has every group's prompt KV resident before
+            # any admission, so prefill-done lands here too
+            for g in range(b):
+                if row_alive[g]:
+                    suid[g] = sl.on_enqueue(
+                        g, n=n, prompt_tokens=int(real_len_h[g]),
+                        ts=t_enqueue,
+                    )
+            if not continuous:
+                for uid_g in suid.values():
+                    sl.on_prefill_done(uid_g)
         groups_prefilled = 0
         backfill_admits = 0
+        boundary_admits = 0  # admissions (slots + prefills) this host pass
+        fill_declined: str | None = None  # fill_idle's head-of-line decline
         dispatched = 0
         host_cand = np.full(r_slots, total, np.int64)  # device `cand` mirror
         epoch = np.zeros(r_slots, np.int64)
@@ -1971,6 +2003,8 @@ class PagedGenerationEngine(LoraMailbox):
             if finished[c]:
                 return
             finished[c] = True
+            if sl is not None:
+                sl.on_finish(suid.get(c // n), c)
             if sharing:
                 g = c // n
                 group_left[g] -= 1
@@ -1985,7 +2019,7 @@ class PagedGenerationEngine(LoraMailbox):
             chain pages ([1, P] reuse of the jitted prefill — bit-identical
             per row to the batched pass), adopt the tiles + logits into the
             live pool arrays, and enqueue the group's candidates."""
-            nonlocal state, groups_prefilled, t_prefill
+            nonlocal state, groups_prefilled, t_prefill, boundary_admits
             rl = int(real_len_h[g])
             n_chain = max(-(-rl // ps), 1)
             chain = pool.alloc_prefix(g, n_chain, rl // ps)
@@ -2013,28 +2047,34 @@ class PagedGenerationEngine(LoraMailbox):
             jax.block_until_ready(logits_cell[0])
             t_prefill += time.perf_counter() - t0
             groups_prefilled += 1
+            boundary_admits += 1
             telemetry.counter_add(ENGINE_CONT_PREFILLS)
+            if sl is not None:
+                sl.on_prefill_done(suid.get(g))
             pending.extend(range(g * n, (g + 1) * n))
             return True
 
-        def admit_groups() -> None:
+        def admit_groups() -> str | None:
             """Admission-ahead: keep the candidate queue stocked while the
             pool can afford the head group's chain AND a full private
             region on top (never starve a running slot's grants), capped at
             one prefetched chain beyond the slots' worst-case group spread
-            (the worst_pool sizing above)."""
-            while (
-                group_queue
-                and len(pending) < r_slots
-                and len(pool.chains) < r_slots + 1
-            ):
+            (the worst_pool sizing above). Returns the head group's decline
+            reason when the queue is left waiting (the admission audit's
+            attribution, ISSUE 13), None when the queue drained."""
+            while group_queue:
+                if len(pending) >= r_slots:
+                    return "no_slots"
+                if len(pool.chains) >= r_slots + 1:
+                    return "chain_cap"
                 g = group_queue[0]
                 n_chain = max(-(-int(real_len_h[g]) // ps), 1)
                 if pool.free_pages < n_chain + self.private_pages:
-                    break
+                    return "no_pages"
                 if not admit_group(g):
-                    break
+                    return "no_pages"
                 group_queue.popleft()
+            return None
         # graftcheck: end-hot-region
 
         if continuous:
@@ -2042,7 +2082,7 @@ class PagedGenerationEngine(LoraMailbox):
             prompt_mask_j = jnp.asarray(prompt_mask)
 
         def fill_idle(s, idle_slots):
-            nonlocal backfill_admits
+            nonlocal backfill_admits, boundary_admits, fill_declined
             new_cand = np.full(r_slots, total, np.int32)
             admit_mask = np.zeros(r_slots, bool)
             dst_partial = np.full(r_slots, pool.scratch, np.int32)
@@ -2072,11 +2112,25 @@ class PagedGenerationEngine(LoraMailbox):
                     int(s_i), pr, rl, admit_last_pos(rl, plen),
                     first_write=rl if sharing else None,
                 ):
+                    fill_declined = "no_pages"
                     break
                 pending.popleft()
+                boundary_admits += 1
                 new_cand[s_i] = c
                 admit_mask[s_i] = True
                 dst_partial[s_i] = pool.owned[int(s_i)][0]
+                if sl is not None:
+                    # admission event with the pool's chain-alias facts:
+                    # how much of the prompt this slot aliases and whether
+                    # a CoW tail split rides this admit dispatch — read
+                    # BEFORE take_copy drains the queued copy source
+                    alias = pool.slot_alias_info(int(s_i))
+                    sl.on_admit(
+                        suid.get(pr), cand=c, slot=int(s_i),
+                        shared_pages=int(alias["shared_pages"]),
+                        cow=bool(alias["cow_queued"]),
+                        backfill=dispatched > 0, resumed=bool(plen),
+                    )
                 if sharing:
                     src = pool.take_copy(int(s_i))
                     if src is not None:
@@ -2150,6 +2204,8 @@ class PagedGenerationEngine(LoraMailbox):
                 else:
                     pending.appendleft(c)
                 pool.preemptions += 1
+                if sl is not None:
+                    sl.on_preempt(suid.get(c // n), c)
             pool.release(s_i)
             kill_cand = np.full(r_slots, total, np.int32)
             kill_mask = np.zeros(r_slots, bool)
@@ -2159,9 +2215,42 @@ class PagedGenerationEngine(LoraMailbox):
             host_cand[s_i] = total
             epoch[s_i] += 1
 
-        if continuous:
-            admit_groups()
+        def serving_boundary(group_decline: str | None, had_idle: bool,
+                             wedged: bool = False) -> None:
+            """One admission-audit + occupancy sample per admission pass
+            (ISSUE 13; only called with the ledger armed). A pass that
+            admitted nothing while work waited is attributed to exactly
+            one stall reason — the smoke asserts the reason counts sum to
+            the declined passes, so an unattributable decline surfaces as
+            a failure, not a silent gap."""
+            nonlocal boundary_admits, fill_declined
+            waiting = len(pending) + n * len(group_queue)
+            reason = None
+            if waiting and not boundary_admits:
+                if wedged:
+                    reason = "budget_wedge"
+                elif group_decline is not None:
+                    reason = group_decline
+                elif fill_declined is not None and had_idle:
+                    reason = fill_declined
+                else:
+                    # every slot is busy (or the pass offered no idle
+                    # slot): the queue waits on decode progress
+                    reason = "no_slots"
+            sl.on_boundary(
+                live_slots=int((host_cand < total).sum()),
+                queue_depth=waiting,
+                free_pages=pool.free_pages,
+                admitted=boundary_admits,
+                reason=reason,
+            )
+            boundary_admits = 0
+            fill_declined = None
+
+        group_decline = admit_groups() if continuous else None
         state = fill_idle(state, range(r_slots))
+        if sl is not None:
+            serving_boundary(group_decline, had_idle=True)
 
         snapshots: deque = deque()
         # each slot serves ≤ ceil(total/R) occupants × max_steps, plus up to
@@ -2357,6 +2446,19 @@ class PagedGenerationEngine(LoraMailbox):
             done_h = np.asarray(done_snap)
             # graftcheck: disable=GC301 -- same delayed snapshot as the line above
             seq_h = np.asarray(seq_snap)
+            if sl is not None:
+                # first-token detection off the same boundary snapshot: a
+                # slot whose resident length moved past its occupant's
+                # prompt has generated (boundary-granular — the loop's own
+                # cadence, no extra device sync; a candidate that finished
+                # between boundaries backfills at finish)
+                for s_i in range(r_slots):
+                    c_s = int(snap_cand[s_i])
+                    if (
+                        c_s < total and snap_epoch[s_i] == epoch[s_i]
+                        and int(seq_h[s_i]) > int(real_len_h[c_s // n])
+                    ):
+                        sl.on_first_token(suid.get(c_s // n))
             # a done flag is only believed if the slot hasn't been refilled
             # since the snapshot was dispatched (done is monotone per epoch)
             idle = [
@@ -2408,18 +2510,21 @@ class PagedGenerationEngine(LoraMailbox):
                             break
                     table_dirty = True
             boundary_marks = pool.total_admissions + groups_prefilled
+            group_decline = None
             if continuous and group_queue:
                 # freed pages (released slots, dropped chains) may now fit
                 # the next queued group's prefill — the backfill that
                 # replaces the fixed episode batch
-                admit_groups()
+                group_decline = admit_groups()
+            idle_free = [s for s in idle if host_cand[s] >= total]
             if pending:
-                state = fill_idle(state, [s for s in idle if host_cand[s] >= total])
+                state = fill_idle(state, idle_free)
                 table_dirty = True
             if table_dirty:
                 state = state._replace(page_indices=jnp.asarray(pool.table))
             if pool.self_check:
                 pool.check_invariants()
+            wedged = False
             if continuous:
                 # wedge detector: every slot dead, work still queued, and
                 # this boundary neither prefilled nor admitted — decode
@@ -2432,6 +2537,7 @@ class PagedGenerationEngine(LoraMailbox):
                     and all(host_cand[v] >= total for v in range(r_slots))
                 ):
                     stalled_boundaries += 1
+                    wedged = True
                     if stalled_boundaries > 4:
                         raise RuntimeError(
                             f"continuous admission wedged: "
@@ -2444,14 +2550,21 @@ class PagedGenerationEngine(LoraMailbox):
                         )
                 else:
                     stalled_boundaries = 0
+            if sl is not None:
+                serving_boundary(
+                    group_decline, had_idle=len(idle_free) > 0,
+                    wedged=wedged,
+                )
         # graftcheck: end-hot-region
 
         # final blocking read closes the snapshot lag on the last occupants
+        # (mark_finished, not a bare flag write: the serving ledger's
+        # finish events and the sharing chain drops stay exactly-once)
         done_h = np.asarray(state.done)
         for s_i in np.nonzero(done_h)[0]:
             c = host_cand[s_i]
             if c < total:
-                finished[c] = True
+                mark_finished(int(c))
         alive_h = int(np.asarray(state.alive_steps))
         self.last_pool_stats = {
             "pool_pages": pool_pages,
@@ -2490,6 +2603,11 @@ class PagedGenerationEngine(LoraMailbox):
             )
         out = np.asarray(state.out).reshape(b, n, max_steps)
         lengths = np.asarray(state.lengths_buf).reshape(b, n)
+        if sl is not None:
+            # realized token counts close each serving record (TPOT needs
+            # them); the closed records stream to the JSONL here
+            for g, uid_g in suid.items():
+                sl.note_tokens(uid_g, int(lengths[g].sum()))
         logps = (
             np.asarray(state.logps_buf).reshape(b, n, max_steps)
             if self.capture_logprobs else None

@@ -52,6 +52,13 @@ from collections import deque
 from typing import Any, Callable, Mapping
 
 from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.serving_obs import (
+    FLEET_SERVING_QUEUE_WAIT_MAX_MS,
+    FLEET_SERVING_TTFT_MAX_MS,
+    SERVING_QUEUE_WAIT_MS,
+    SERVING_TTFT_MS,
+    fold_fleet_serving,
+)
 
 log = logging.getLogger(__name__)
 
@@ -253,9 +260,16 @@ def _prom_num(v: float) -> str:
 def prometheus_text(snapshot: Mapping[str, Any] | None = None,
                     fleet: Mapping[str, Any] | None = None) -> str:
     """Prometheus text exposition of the cumulative registry: counters as
-    counters, gauges as gauges, histograms as ``_count``/``_sum`` counters
-    plus a ``_max`` gauge. Fleet per-worker detail (when provided) rides as
-    labeled ``distrl_fleet_worker_*`` series; the fleet SCALARS are already
+    counters, gauges as gauges, histograms as REAL Prometheus histogram
+    types — cumulative ``_bucket{le=...}`` lines over the shared
+    ``telemetry.HIST_BUCKET_BOUNDS`` ladder plus ``_sum``/``_count``, so
+    ``histogram_quantile()`` computes ``serving/ttft_ms`` percentiles from
+    a standard scrape (ISSUE 13 satellite; summary-stat-only exposition
+    made latency SLOs unscrapable) — plus the ``_max`` gauge the summary
+    always carried. A snapshot without bucket data (older worker blobs,
+    synthetic test snapshots) degrades to the ``+Inf`` bucket alone.
+    Fleet per-worker detail (when provided) rides as labeled
+    ``distrl_fleet_worker_*`` series; the fleet SCALARS are already
     registry gauges (FleetAggregator publishes them), so they are not
     duplicated here."""
     snap = snapshot if snapshot is not None else telemetry.observe_snapshot()
@@ -270,9 +284,17 @@ def prometheus_text(snapshot: Mapping[str, Any] | None = None,
         lines.append(f"{m} {_prom_num(v)}")
     for name, h in sorted(snap.get("hists", {}).items()):
         m = _prom_name(name)
-        lines.append(f"# TYPE {m}_count counter")
-        lines.append(f"{m}_count {_prom_num(h.get('count', 0.0))}")
-        lines.append(f"# TYPE {m}_sum counter")
+        count = h.get("count", 0.0)
+        lines.append(f"# TYPE {m} histogram")
+        buckets = h.get("buckets") or ()
+        cum = 0.0
+        for le, c in zip(telemetry.HIST_BUCKET_BOUNDS, buckets):
+            cum += c
+            lines.append(
+                f'{m}_bucket{{le="{_prom_num(le)}"}} {_prom_num(cum)}'
+            )
+        lines.append(f'{m}_bucket{{le="+Inf"}} {_prom_num(count)}')
+        lines.append(f"{m}_count {_prom_num(count)}")
         lines.append(f"{m}_sum {_prom_num(h.get('sum', 0.0))}")
         lines.append(f"# TYPE {m}_max gauge")
         lines.append(f"{m}_max {_prom_num(h.get('max', 0.0))}")
@@ -491,6 +513,11 @@ class FleetAggregator:
                 per_worker[self._addr(track)] = {
                     "gen_tokens": cumulative, "ts": ts,
                 }
+            # fleet-wide serving view (ISSUE 13): fold the workers'
+            # serving/* histogram summaries and admission-stall counters
+            # into fleet gauges + the endpoint's serving section (None —
+            # and no gauges — until some worker served a request)
+            serving = fold_fleet_serving(remote)
             fleet = {
                 "ts": now,
                 "rejoin_epoch": epoch,
@@ -502,6 +529,7 @@ class FleetAggregator:
                 "tok_s": round(rate, 3),
                 "gen_tokens_total": total_tokens,
                 "worker_metrics": per_worker,
+                "serving": serving,
             }
             telemetry.gauge_set(FLEET_TOK_S, fleet["tok_s"])
             telemetry.gauge_set(FLEET_GEN_TOKENS, total_tokens)
@@ -611,10 +639,17 @@ class Sentinel:
       of its running EMA after ``warmup_steps`` observations.
     * ``hbm_breach`` — device peak bytes above ``hbm_frac`` of
       ``bytes_limit`` (when the backend reports one).
+    * ``ttft_blowup`` / ``queue_wait_blowup`` — the step's worst observed
+      ``serving/ttft_ms`` / ``serving/queue_wait_ms`` (local registry max,
+      or the fleet-folded worker max) above the configured SLO
+      (``slo_ttft_ms`` / ``slo_queue_wait_ms``; None = trigger unarmed).
 
     ``DISTRL_SENTINEL_INJECT="nan_loss:3"`` deterministically injects a
     NaN loss at step 3 — the seeded fault the obs smoke/tests use to prove
-    exactly one incident bundle appears.
+    exactly one incident bundle appears; ``ttft_blowup:<step>`` /
+    ``queue_wait_blowup:<step>`` inject an SLO breach the same way (legal
+    only with the matching SLO armed — injecting an unarmed trigger would
+    make a CI gate built on it pass vacuously).
     """
 
     def __init__(self, recorder: FlightRecorder | None, profiler=None, *,
@@ -622,6 +657,8 @@ class Sentinel:
                  tok_ema_alpha: float = 0.3, hbm_frac: float = 0.95,
                  collapse_steps: int = 3,
                  staleness_limit: float | None = None,
+                 slo_ttft_ms: float | None = None,
+                 slo_queue_wait_ms: float | None = None,
                  capture_steps: int = 2):
         self.recorder = recorder
         self.profiler = profiler
@@ -631,6 +668,8 @@ class Sentinel:
         self.hbm_frac = hbm_frac
         self.collapse_steps = collapse_steps
         self.staleness_limit = staleness_limit
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_queue_wait_ms = slo_queue_wait_ms
         self.capture_steps = capture_steps
         self.fired: set[str] = set()
         self._tok_ema: float | None = None
@@ -646,13 +685,25 @@ class Sentinel:
                 # only triggers with an implemented injection are legal —
                 # accepting (say) hbm_breach:3 here and never firing would
                 # make a CI gate built on it pass vacuously
-                if trig not in ("nan_loss", "tok_s_regression"):
+                if trig not in ("nan_loss", "tok_s_regression",
+                                "ttft_blowup", "queue_wait_blowup"):
                     raise ValueError(trig)
+                # same vacuous-gate guard for the SLO triggers: without
+                # the matching SLO there is no threshold to breach
+                if trig == "ttft_blowup" and slo_ttft_ms is None:
+                    raise ValueError("ttft_blowup needs slo_ttft_ms")
+                if (trig == "queue_wait_blowup"
+                        and slo_queue_wait_ms is None):
+                    raise ValueError(
+                        "queue_wait_blowup needs slo_queue_wait_ms"
+                    )
                 self._inject = (trig, int(at))
             except ValueError:
                 log.warning(
                     "ignoring DISTRL_SENTINEL_INJECT=%r (expected "
-                    "'nan_loss:<step>' or 'tok_s_regression:<step>')",
+                    "'nan_loss:<step>', 'tok_s_regression:<step>', "
+                    "'ttft_blowup:<step>' or 'queue_wait_blowup:<step>', "
+                    "the SLO triggers only with their slo_* limit armed)",
                     spec,
                 )
 
@@ -690,6 +741,13 @@ class Sentinel:
                 m["loss"] = float("nan")
             elif trig == "tok_s_regression":
                 m["engine/decode_tok_s"] = 0.0
+            elif trig == "ttft_blowup":
+                # parse-time guard ensures slo_ttft_ms is armed
+                m[SERVING_TTFT_MS + "_max"] = 1000.0 * self.slo_ttft_ms
+            elif trig == "queue_wait_blowup":
+                m[SERVING_QUEUE_WAIT_MS + "_max"] = (
+                    1000.0 * self.slo_queue_wait_ms
+                )
         fired: list[str] = []
 
         def fire(trigger: str, **extra) -> None:
@@ -746,6 +804,25 @@ class Sentinel:
                     )
                 a = self.tok_ema_alpha
                 self._tok_ema = a * tok + (1 - a) * self._tok_ema
+        # --- serving SLO breaches (ISSUE 13): the step's worst observed
+        # latency — the local registry's per-step hist max (the trainer
+        # merges metrics_snapshot into the step record) or the fleet-folded
+        # worker max gauge, whichever the run produces
+        for trigger, slo, keys in (
+            ("ttft_blowup", self.slo_ttft_ms,
+             (SERVING_TTFT_MS + "_max", FLEET_SERVING_TTFT_MAX_MS)),
+            ("queue_wait_blowup", self.slo_queue_wait_ms,
+             (SERVING_QUEUE_WAIT_MS + "_max",
+              FLEET_SERVING_QUEUE_WAIT_MAX_MS)),
+        ):
+            if slo is None:
+                continue
+            observed = [float(m[k]) for k in keys if m.get(k) is not None]
+            if observed and max(observed) > slo:
+                fire(
+                    trigger,
+                    observed_ms=round(max(observed), 3), slo_ms=slo,
+                )
         # --- HBM watermark breach
         stats = hbm_stats()
         if stats and stats.get("bytes_limit"):
@@ -776,6 +853,8 @@ class ObsPlane:
                  ring_size: int = 256,
                  driver=None, profiler=None,
                  staleness_limit: float | None = None,
+                 slo_ttft_ms: float | None = None,
+                 slo_queue_wait_ms: float | None = None,
                  config_snapshot: Mapping[str, Any] | None = None,
                  plan_provider: Callable[[], Mapping[str, Any] | None] | None = None):
         self.fleet = FleetAggregator(driver) if driver is not None else None
@@ -792,7 +871,9 @@ class ObsPlane:
         )
         self.sentinel = (
             Sentinel(
-                self.recorder, profiler, staleness_limit=staleness_limit
+                self.recorder, profiler, staleness_limit=staleness_limit,
+                slo_ttft_ms=slo_ttft_ms,
+                slo_queue_wait_ms=slo_queue_wait_ms,
             )
             if sentinel else None
         )

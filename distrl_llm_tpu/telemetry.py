@@ -33,6 +33,7 @@ tok/s and MFU from the same file.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
@@ -41,6 +42,19 @@ from typing import Any, Mapping
 
 _DRIVER_PID = 1  # local-process track; remote tracks are assigned from 100
 _REMOTE_PID0 = 100
+
+# Fixed histogram bucket ladder (upper bounds, inclusive — Prometheus `le`
+# semantics) shared by every registry histogram: log-spaced to cover
+# sub-ms RPC latencies through minute-scale e2e serving latencies, plus
+# the small-integer histograms (rollout/staleness, spec emit counts) in
+# the bottom rungs. Cumulative per-bucket counts ride observe_snapshot()
+# so the obs endpoint can expose REAL Prometheus histogram types with
+# `_bucket{le=...}` lines — scrapable percentiles via histogram_quantile —
+# instead of summary stats only (ISSUE 13 satellite).
+HIST_BUCKET_BOUNDS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
 
 
 class _State:
@@ -68,6 +82,10 @@ class _State:
         self.counters_total: dict[str, float] = {}
         # cumulative histogram summaries: [count, weighted sum, max]
         self.hist_totals: dict[str, list[float]] = {}
+        # cumulative per-bucket counts aligned to HIST_BUCKET_BOUNDS, one
+        # trailing overflow slot (> last bound); never reset — the live
+        # endpoint renders them as Prometheus histogram buckets
+        self.hist_buckets: dict[str, list[float]] = {}
         # obs export: when on, workers piggyback a registry snapshot on
         # control-plane results (the way span blobs already ride home)
         self.obs_export = os.environ.get("DISTRL_OBS", "0") == "1"
@@ -346,6 +364,13 @@ def hist_observe(name: str, value: float, *, trace_sample: bool = False,
         tot[0] += count
         tot[1] += value * count
         tot[2] = max(tot[2], value)
+        buckets = st.hist_buckets.get(name)
+        if buckets is None:
+            buckets = st.hist_buckets[name] = (
+                [0.0] * (len(HIST_BUCKET_BOUNDS) + 1)
+            )
+        # bisect_left: first bound >= value, i.e. the inclusive `le` bucket
+        buckets[bisect.bisect_left(HIST_BUCKET_BOUNDS, value)] += count
         st.touched.add(name)
     if trace_sample and st.enabled:
         # carry the weight: a count>1 observation must not read as ONE
@@ -415,7 +440,12 @@ def observe_snapshot() -> dict[str, Any]:
             "counters": dict(st.counters_total),
             "gauges": dict(st.gauges),
             "hists": {
-                name: {"count": t[0], "sum": t[1], "max": t[2]}
+                name: {
+                    "count": t[0], "sum": t[1], "max": t[2],
+                    # per-bucket counts aligned to HIST_BUCKET_BOUNDS +
+                    # one overflow slot (cumulated at exposition time)
+                    "buckets": list(st.hist_buckets.get(name, ())),
+                }
                 for name, t in st.hist_totals.items()
             },
         }

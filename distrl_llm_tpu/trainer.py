@@ -362,6 +362,10 @@ class Trainer:
                     config.max_staleness
                     if config.rollout_mode == "async" else None
                 ),
+                # serving SLO gates (ISSUE 13): arm the ttft_blowup /
+                # queue_wait_blowup sentinel triggers
+                slo_ttft_ms=config.slo_ttft_ms,
+                slo_queue_wait_ms=config.slo_queue_wait_ms,
                 config_snapshot=config.to_flat_dict(),
                 plan_provider=lambda: (
                     self.engine.resolved_plan.plan.to_dict()
@@ -387,6 +391,29 @@ class Trainer:
                 # produced version (PR 9's broadcast), not the local push
                 self.lineage.expect_acks = True
                 bus.on_broadcast = self.lineage.on_broadcast_complete
+
+        # request-level serving ledger (distrl_llm_tpu/serving_obs.py,
+        # ISSUE 13): per-group lifecycle + admission audit recorded by the
+        # paged engine's refill/continuous loops. None unless
+        # --serving_obs armed it; the engine then pays one attribute
+        # check per hook site. Config validation guarantees a local paged
+        # continuous-batching engine here (fleet runs arm worker-side).
+        self.serving: Any = None
+        if config.serving_obs:
+            from distrl_llm_tpu.serving_obs import ServingLedger
+
+            self.serving = ServingLedger(
+                ring_size=config.serving_ring, out_dir=config.serving_dir
+            )
+            if hasattr(engine, "serving_ledger"):
+                engine.serving_ledger = self.serving
+            else:
+                log.warning(
+                    "serving_obs armed but engine %s has no "
+                    "serving_ledger hook — nothing will be recorded "
+                    "(remote fleets arm worker_main --serving-obs)",
+                    type(engine).__name__,
+                )
 
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
@@ -1226,6 +1253,10 @@ class Trainer:
                 # flush unwritten weight-version lines and close the JSONL
                 # stream; the ring (open records) stays queryable
                 self.lineage.close()
+            if self.serving is not None:
+                # stream any open serving records plus the stall/occupancy
+                # summary line, so serving.jsonl is report-complete
+                self.serving.close()
             # the obs plane deliberately OUTLIVES train(): a fleet
             # operator scrapes the endpoint while rejoins/drains settle
             # after the loop ends — close_obs() (or process exit; the

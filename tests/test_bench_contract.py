@@ -60,7 +60,9 @@ class TestBenchContract:
                     "fleet_workers", "weight_bus", "weight_bytes_per_update",
                     "weight_sync_ms",
                     "cb_mode", "prefill_shared_frac", "pages_shared_frac",
-                    "slot_idle_frac"):
+                    "slot_idle_frac",
+                    "ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
+                    "admission_stall_frac"):
             assert key in rec, key
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
         # (honest null, never a fabricated number), a healthy single-config
@@ -81,6 +83,12 @@ class TestBenchContract:
         assert rec["prefill_shared_frac"] is None
         assert rec["pages_shared_frac"] is None
         assert rec["slot_idle_frac"] is None
+        # serving-latency fields (ISSUE 13): no ledger without continuous
+        # admission — dense rows read null, never a fabricated latency
+        assert rec["ttft_p50_ms"] is None
+        assert rec["ttft_p99_ms"] is None
+        assert rec["queue_wait_p50_ms"] is None
+        assert rec["admission_stall_frac"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0
@@ -163,6 +171,14 @@ class TestBenchContract:
         assert 0.0 <= rec["slot_idle_frac"] < 1.0
         assert rec["plan"]["cb_mode"] == "continuous"
         assert rec["value"] > 0
+        # request-level serving latencies (ISSUE 13): a post-warmup
+        # ServingLedger records the TIMED rounds, so cb rows carry real
+        # percentiles and the attributed stall fraction
+        assert rec["ttft_p50_ms"] is not None and rec["ttft_p50_ms"] > 0
+        assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
+        assert rec["queue_wait_p50_ms"] is not None
+        assert rec["queue_wait_p50_ms"] >= 0
+        assert 0.0 <= rec["admission_stall_frac"] <= 1.0
 
     def test_cb_fixed_control_fields(self):
         """The fixed-batch refill control reads cb_mode='refill' with the
@@ -177,6 +193,13 @@ class TestBenchContract:
         assert rec["prefill_shared_frac"] is None
         assert rec["pages_shared_frac"] is None
         assert rec["slot_idle_frac"] is not None
+        # fixed-batch control: no continuous admission, no serving ledger
+        # — the serving fields read null (the cb A/B distinguishes the
+        # arms from the artifact alone)
+        assert rec["ttft_p50_ms"] is None
+        assert rec["ttft_p99_ms"] is None
+        assert rec["queue_wait_p50_ms"] is None
+        assert rec["admission_stall_frac"] is None
 
     def test_learner_record_shape(self):
         rec = run_bench({

@@ -285,6 +285,27 @@ class TestTelemetryRules:
         (f,) = findings
         assert "fam/renamed_away" in f.message
 
+    def test_summary_suffix_named_constant_resolves_exactly(self, tmp_path):
+        """A constant whose VALUE itself ends in a histogram-summary
+        suffix (a fleet gauge like fam/latency_ms_mean) must resolve by
+        exact name — stripping '_mean' before the owner lookup used to
+        orphan it (ISSUE 13: the fleet/serving_* gauges)."""
+        project = make_project(tmp_path, {
+            "distrl_llm_tpu/one.py": """
+                from distrl_llm_tpu import telemetry
+
+                FAM_MEAN = "fam/latency_ms_mean"
+
+                def emit():
+                    telemetry.gauge_set(FAM_MEAN, 1.0)
+            """,
+            "tools/trace_report.py": """
+                NAMES = ["fam/latency_ms_mean"]
+            """,
+        })
+        findings, _ = run_rules(project, "telemetry_schema")
+        assert findings == []
+
     def test_derived_fstring_prefix_is_clean(self, tmp_path):
         project = make_project(tmp_path, {
             "distrl_llm_tpu/one.py": """

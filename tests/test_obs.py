@@ -45,11 +45,38 @@ class TestPrometheusExposition:
         assert "distrl_obs_gen_tokens 128.0" in text
         assert "# TYPE distrl_pool_occupancy gauge" in text
         assert "distrl_pool_occupancy 0.5" in text
-        # histograms expose _count/_sum counters + a _max gauge
+        # histograms are REAL Prometheus histogram types (ISSUE 13): one
+        # TYPE line for the family, _bucket/_count/_sum samples, plus the
+        # _max gauge the summary always carried. A snapshot without
+        # bucket data degrades to the +Inf bucket alone.
+        assert "# TYPE distrl_cp_rpc_dispatch_ms histogram" in text
+        assert 'distrl_cp_rpc_dispatch_ms_bucket{le="+Inf"} 3.0' in text
         assert "distrl_cp_rpc_dispatch_ms_count 3.0" in text
         assert "distrl_cp_rpc_dispatch_ms_sum 9.0" in text
+        assert "# TYPE distrl_cp_rpc_dispatch_ms_max gauge" in text
         assert "distrl_cp_rpc_dispatch_ms_max 5.0" in text
         assert text.endswith("\n")
+
+    def test_histogram_buckets_cumulative_le(self):
+        """Real registry observations render as CUMULATIVE bucket counts
+        over telemetry.HIST_BUCKET_BOUNDS with inclusive-le semantics —
+        the exact exposition histogram_quantile() consumes (ISSUE 13:
+        serving/ttft_ms percentiles must be scrapable by standard
+        tooling, not summary stats only)."""
+        from distrl_llm_tpu.serving_obs import SERVING_TTFT_MS
+
+        for v in (0.5, 3.0, 3.0, 40.0, 99.0, 70000.0):
+            telemetry.hist_observe(SERVING_TTFT_MS, v)
+        text = obs.prometheus_text()
+        # le="0.5" is inclusive: the 0.5 observation lands IN it
+        assert 'distrl_serving_ttft_ms_bucket{le="0.5"} 1.0' in text
+        assert 'distrl_serving_ttft_ms_bucket{le="5.0"} 3.0' in text
+        assert 'distrl_serving_ttft_ms_bucket{le="50.0"} 4.0' in text
+        assert 'distrl_serving_ttft_ms_bucket{le="100.0"} 5.0' in text
+        # the 70000 observation overflows the ladder: only +Inf holds it
+        assert 'distrl_serving_ttft_ms_bucket{le="60000.0"} 5.0' in text
+        assert 'distrl_serving_ttft_ms_bucket{le="+Inf"} 6.0' in text
+        assert "distrl_serving_ttft_ms_count 6.0" in text
 
     def test_name_sanitization(self):
         text = obs.prometheus_text({

@@ -383,9 +383,11 @@ class TestRegistry:
         snap = telemetry.observe_snapshot()
         assert snap["counters"]["obs/gen_tokens"] == 10.0
         assert snap["gauges"]["pool/occupancy"] == 0.5
-        assert snap["hists"]["cp/rpc_dispatch_ms"] == {
-            "count": 4.0, "sum": 14.0, "max": 4.0,
-        }
+        h = snap["hists"]["cp/rpc_dispatch_ms"]
+        assert (h["count"], h["sum"], h["max"]) == (4.0, 14.0, 4.0)
+        # + the cumulative bucket counts (ISSUE 13) — 2.0 in le=2.5,
+        # 4.0×3 in le=5.0
+        assert sum(h["buckets"]) == 4.0
         # the sink feed still reports-and-resets its delta…
         assert telemetry.metrics_snapshot()["obs/gen_tokens"] == 10.0
         telemetry.counter_add("obs/gen_tokens", 5)
@@ -478,6 +480,87 @@ class TestRegistry:
         assert table["worker 127.0.0.1:7001"]["counters"][
             "obs/gen_tokens"] == 64.0
         assert "_ts" in table["worker 127.0.0.1:7001"]
+
+    def test_serving_series_schema(self):
+        """Schema pin for the serving-observability registry names
+        (ISSUE 13) and their TYPES: serving/ttft_ms, serving/tpot_ms,
+        serving/queue_wait_ms, serving/e2e_ms are HISTOGRAMS;
+        serving/live_slots, serving/queue_depth, serving/free_pages are
+        GAUGES (one sample per admission pass, Perfetto counter tracks);
+        serving/admission_passes, serving/declined_passes,
+        serving/records_closed, serving/ring_evictions and the per-reason
+        serving/admission_stalls/<reason> derivations are COUNTERS. The
+        fleet fold republishes fleet/serving_* GAUGES."""
+        from distrl_llm_tpu import serving_obs as so
+
+        assert so.SERVING_TTFT_MS == "serving/ttft_ms"
+        assert so.SERVING_TPOT_MS == "serving/tpot_ms"
+        assert so.SERVING_QUEUE_WAIT_MS == "serving/queue_wait_ms"
+        assert so.SERVING_E2E_MS == "serving/e2e_ms"
+        assert so.SERVING_ADMISSION_STALLS == "serving/admission_stalls"
+        assert so.SERVING_DECLINED_PASSES == "serving/declined_passes"
+        assert so.SERVING_ADMISSION_PASSES == "serving/admission_passes"
+        assert so.SERVING_LIVE_SLOTS == "serving/live_slots"
+        assert so.SERVING_QUEUE_DEPTH == "serving/queue_depth"
+        assert so.SERVING_FREE_PAGES == "serving/free_pages"
+        assert so.SERVING_RECORDS_CLOSED == "serving/records_closed"
+        assert so.SERVING_RING_EVICTIONS == "serving/ring_evictions"
+        assert so.FLEET_SERVING_TTFT_MEAN_MS == "fleet/serving_ttft_ms_mean"
+        assert so.FLEET_SERVING_TTFT_MAX_MS == "fleet/serving_ttft_ms_max"
+        assert (so.FLEET_SERVING_QUEUE_WAIT_MEAN_MS
+                == "fleet/serving_queue_wait_ms_mean")
+        assert (so.FLEET_SERVING_QUEUE_WAIT_MAX_MS
+                == "fleet/serving_queue_wait_ms_max")
+        assert so.FLEET_SERVING_STALLS == "fleet/serving_admission_stalls"
+        assert so.STALL_REASONS == (
+            "no_slots", "no_pages", "chain_cap", "budget_wedge"
+        )
+        for name in (so.SERVING_TTFT_MS, so.SERVING_TPOT_MS,
+                     so.SERVING_QUEUE_WAIT_MS, so.SERVING_E2E_MS):
+            telemetry.hist_observe(name, 5.0)
+        telemetry.gauge_set(so.SERVING_LIVE_SLOTS, 3.0)
+        telemetry.gauge_set(so.SERVING_QUEUE_DEPTH, 2.0)
+        telemetry.gauge_set(so.SERVING_FREE_PAGES, 7.0)
+        telemetry.counter_add(so.SERVING_ADMISSION_PASSES)
+        telemetry.counter_add(so.SERVING_DECLINED_PASSES)
+        telemetry.counter_add(so.SERVING_RECORDS_CLOSED)
+        telemetry.counter_add(so.SERVING_RING_EVICTIONS)
+        telemetry.counter_add(f"{so.SERVING_ADMISSION_STALLS}/no_pages")
+        snap = telemetry.metrics_snapshot()
+        assert snap["serving/ttft_ms_count"] == 1.0
+        assert snap["serving/tpot_ms_count"] == 1.0
+        assert snap["serving/queue_wait_ms_count"] == 1.0
+        assert snap["serving/e2e_ms_count"] == 1.0
+        assert snap["serving/live_slots"] == 3.0
+        assert snap["serving/queue_depth"] == 2.0
+        assert snap["serving/free_pages"] == 7.0
+        assert snap["serving/admission_passes"] == 1.0
+        assert snap["serving/declined_passes"] == 1.0
+        assert snap["serving/records_closed"] == 1.0
+        assert snap["serving/ring_evictions"] == 1.0
+        assert snap["serving/admission_stalls/no_pages"] == 1.0
+
+    def test_observe_snapshot_carries_hist_buckets(self):
+        """Cumulative per-bucket counts ride observe_snapshot (the obs
+        endpoint's and the worker blob's feed), aligned to
+        HIST_BUCKET_BOUNDS with one trailing overflow slot; the
+        metrics_snapshot (report-and-reset sink feed) is untouched."""
+        from distrl_llm_tpu.serving_obs import SERVING_QUEUE_WAIT_MS
+
+        telemetry.hist_observe(SERVING_QUEUE_WAIT_MS, 3.0, count=2)
+        telemetry.hist_observe(SERVING_QUEUE_WAIT_MS, 99999.0)
+        snap = telemetry.observe_snapshot()
+        h = snap["hists"][SERVING_QUEUE_WAIT_MS]
+        buckets = h["buckets"]
+        assert len(buckets) == len(telemetry.HIST_BUCKET_BOUNDS) + 1
+        # 3.0 lands in the le=5.0 bucket (index of first bound >= value)
+        assert buckets[telemetry.HIST_BUCKET_BOUNDS.index(5.0)] == 2.0
+        assert buckets[-1] == 1.0  # overflow slot (> last bound)
+        assert sum(buckets) == h["count"] == 3.0
+        # sink feed unchanged: summary stats only, then reset
+        sink = telemetry.metrics_snapshot()
+        assert sink["serving/queue_wait_ms_count"] == 3.0
+        assert not any(k.endswith("_buckets") for k in sink)
 
     def test_hist_observe_count_prebinned(self):
         """hist_observe(count=N) records the observation N times in ONE

@@ -69,6 +69,30 @@ def comparable(a: dict, b: dict) -> bool:
     )
 
 
+# latency-typed names (*_ms, *_p99_ms, queue_wait_p50_ms, …): LOWER is
+# better — a 10% TTFT *improvement* must not read as a value drop, and a
+# 10% TTFT increase IS the regression (ISSUE 13 satellite)
+_LATENCY_RE = re.compile(r"(_ms$|_ms_|_p\d+_ms$|_p\d+$)")
+
+# per-row latency fields scanned between comparable consecutive rounds
+# (bench rollout rows, ISSUE 13; null on non-cb rows — skipped then)
+LATENCY_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms")
+
+
+def lower_is_better(metric: str) -> bool:
+    return bool(_LATENCY_RE.search(str(metric)))
+
+
+def regressed(metric: str, old: float, new: float, drop: float) -> bool:
+    """Direction-aware scoring: throughput-typed metrics flag a >drop
+    fractional DECREASE, latency-typed metrics a >drop INCREASE."""
+    if old <= 0:
+        return False
+    if lower_is_better(metric):
+        return new > (1.0 + drop) * old
+    return new < (1.0 - drop) * old
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="bench-artifact trajectory table + regression flags"
@@ -123,15 +147,30 @@ def main(argv: list[str] | None = None) -> int:
         if rec is None or rc != 0 or "error" in rec:
             continue  # keeps prev: a broken round never becomes a baseline
         if prev is not None and comparable(prev[1], rec):
+            metric = str(rec.get("metric", "value"))
             old, new = float(prev[1].get("value", 0)), float(
                 rec.get("value", 0)
             )
-            if old > 0 and new < (1.0 - args.drop) * old:
+            if regressed(metric, old, new, args.drop):
+                direction = "+" if lower_is_better(metric) else "-"
                 flags.append(
                     f"r{prev[0]}→r{n}: value {old:,.1f} → {new:,.1f} "
-                    f"tok/s/chip ({100 * (new / old - 1):+.1f}%, "
-                    f"flag threshold -{100 * args.drop:.0f}%)"
+                    f"({100 * (new / old - 1):+.1f}%, flag threshold "
+                    f"{direction}{100 * args.drop:.0f}% for {metric})"
                 )
+            # serving-latency fields (cb rows): lower-is-better by type,
+            # scanned only when BOTH rounds produced them
+            for field in LATENCY_FIELDS:
+                ov, nv = prev[1].get(field), rec.get(field)
+                if ov is None or nv is None:
+                    continue
+                if regressed(field, float(ov), float(nv), args.drop):
+                    flags.append(
+                        f"r{prev[0]}→r{n}: {field} {float(ov):,.1f} → "
+                        f"{float(nv):,.1f} ms "
+                        f"({100 * (float(nv) / float(ov) - 1):+.1f}%, "
+                        f"flag threshold +{100 * args.drop:.0f}%)"
+                    )
         prev = (n, rec)
 
     if flags:

@@ -69,15 +69,21 @@ class _Registry:
         self.prefixes: set[str] = set()      # derived-series prefixes
 
     def known(self, name: str) -> bool:
+        # exact match FIRST: a gauge constant can legitimately be NAMED
+        # with a summary-suffix spelling (fleet/serving_ttft_ms_mean) —
+        # stripping before the owner lookup would orphan it
+        candidates = [name]
         for suffix in _HIST_SUFFIXES:
             if name.endswith(suffix):
-                name = name[: -len(suffix)]
+                candidates.append(name[: -len(suffix)])
                 break
-        if (name in self.emitted or name in self.span_names
-                or name in self.owners):
-            return True
+        for cand in candidates:
+            if (cand in self.emitted or cand in self.span_names
+                    or cand in self.owners):
+                return True
         return any(
-            name.startswith(p.rstrip("/") + "/") for p in self.prefixes
+            cand.startswith(p.rstrip("/") + "/")
+            for cand in candidates for p in self.prefixes
         )
 
     def families(self) -> set[str]:

@@ -5,6 +5,8 @@ went — from the ledger file alone, no live process needed.
 
     python tools/lineage_report.py run_myrun/lineage.jsonl
     python tools/lineage_report.py run_myrun/lineage.jsonl --step 7
+    python tools/lineage_report.py run_myrun/lineage.jsonl --step 7 \\
+        --serving run_myrun/serving.jsonl
 
 The file is what ``--lineage_dir`` streams (``distrl_llm_tpu/lineage.py``):
 one JSON object per line, ``kind: "group"`` for per-trajectory records and
@@ -15,6 +17,14 @@ lag, sample→learn), verdict totals, the three lag distributions, and the
 per-version learn→act / broadcast-ack summary. With ``--step N`` it answers
 the incident question directly — which groups trained step N, sampled where,
 under which versions, and how stale.
+
+``--serving <serving.jsonl>`` (ISSUE 13) joins the serving ledger's
+request-level latencies onto the policy-lag rows: both ledgers stamp the
+SAME ``(trace_id, dispatch_id)`` the trace-context propagation allocates
+(one id path, no second counter), so each ``--step`` row gains the
+TTFT/queue-wait of the dispatch that sampled it (mean over the dispatch's
+groups — the serving ledger records engine-side group indices, the
+lineage ledger driver-side ones; the dispatch is the shared causal key).
 
 Exit status: 0 on a parseable file with at least one group record, 1
 otherwise — tools/run_all_checks.sh gates on it via lineage_smoke.
@@ -52,16 +62,56 @@ def _dist(vals: list[float]) -> str:
     )
 
 
-def step_detail(groups: list[dict], step: int) -> list[str]:
-    """Which trajectories trained step N and how stale were they."""
+def load_serving(path: str) -> dict[int, list[dict]]:
+    """Serving-ledger group records keyed by dispatch_id (the shared
+    causal id both ledgers stamp from the trace context)."""
+    by_dispatch: dict[int, list[dict]] = defaultdict(list)
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if doc.get("kind") == "group" and doc.get("dispatch_id") is not None:
+                by_dispatch[int(doc["dispatch_id"])].append(doc)
+    return by_dispatch
+
+
+def _serving_cols(g: dict,
+                  serving: dict[int, list[dict]] | None) -> str:
+    """The joined serving-latency columns for one lineage row: mean
+    TTFT/queue-wait of the serving records sharing its dispatch_id."""
+    if serving is None:
+        return ""
+    did = g.get("dispatch_id")
+    recs = serving.get(int(did)) if did is not None else None
+    if not recs:
+        return f" {'n/a':>9} {'n/a':>9}"
+    ttft = [r["ttft_ms"] for r in recs if r.get("ttft_ms") is not None]
+    qw = [
+        r["queue_wait_ms"] for r in recs
+        if r.get("queue_wait_ms") is not None
+    ]
+    t = f"{sum(ttft) / len(ttft):,.1f}" if ttft else "n/a"
+    q = f"{sum(qw) / len(qw):,.1f}" if qw else "n/a"
+    return f" {t:>9} {q:>9}"
+
+
+def step_detail(groups: list[dict], step: int,
+                serving: dict[int, list[dict]] | None = None) -> list[str]:
+    """Which trajectories trained step N and how stale were they (plus,
+    with --serving, the request-level latency of their sampling
+    dispatch)."""
     rows = [g for g in groups if g.get("consumed_step") == step]
     lines = [f"step {step}: {len(rows)} trajectory group(s)"]
     if not rows:
         lines.append("  (no group record names this step)")
         return lines
+    extra = f" {'ttft ms':>9} {'qwait ms':>9}" if serving is not None else ""
     lines.append(
         f"  {'uid':>5} {'ep/batch':>9} {'worker':<22} {'dispatch':>8} "
         f"{'versions':>9} {'lag':>4} {'s→learn ms':>11} {'verdict':<10}"
+        + extra
     )
     for g in sorted(rows, key=lambda g: g.get("uid", 0)):
         vmin, vmax = g.get("min_version", 0), g.get("max_version", 0)
@@ -75,6 +125,7 @@ def step_detail(groups: list[dict], step: int) -> list[str]:
             f"{str(g.get('dispatch_id') or '-'):>8} {vspan:>9} "
             f"{str(g.get('staleness_lag', '?')):>4} "
             f"{stl_s:>11} {str(g.get('verdict') or '?'):<10}"
+            + _serving_cols(g, serving)
         )
     produced = {g.get("produced_version") for g in rows}
     lines.append(f"  produced weight version(s): {sorted(produced)}")
@@ -82,12 +133,13 @@ def step_detail(groups: list[dict], step: int) -> list[str]:
 
 
 def build_report(groups: list[dict], weights: list[dict],
-                 step: int | None) -> str:
+                 step: int | None,
+                 serving: dict[int, list[dict]] | None = None) -> str:
     if not groups:
         raise ValueError("no group records in the lineage file")
     lines: list[str] = []
     if step is not None:
-        lines.extend(step_detail(groups, step))
+        lines.extend(step_detail(groups, step, serving))
         return "\n".join(lines)
 
     # ---- per-step consumption table
@@ -176,10 +228,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("lineage", help="path to a lineage.jsonl (--lineage_dir)")
     p.add_argument("--step", type=int, default=None,
                    help="detail one optimizer step instead of the summary")
+    p.add_argument("--serving", type=str, default=None,
+                   help="a serving.jsonl (--serving_dir / worker "
+                        "--serving-dir): join request-level TTFT and "
+                        "queue-wait onto each --step row by the shared "
+                        "dispatch_id")
     args = p.parse_args(argv)
     try:
         groups, weights = load(args.lineage)
-        report = build_report(groups, weights, args.step)
+        serving = load_serving(args.serving) if args.serving else None
+        report = build_report(groups, weights, args.step, serving)
     except Exception as e:  # noqa: BLE001 — a truncated or still-being-
         # written ledger must exit 1 with one line, never a raw traceback
         print(

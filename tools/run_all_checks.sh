@@ -66,6 +66,15 @@ stage "spec_smoke" env JAX_PLATFORMS=cpu \
 # speculative composition
 stage "cb_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/cb_smoke.py
+# serving-observability gate (ISSUE 13): a continuous-admission run with
+# the serving ledger armed — byte-identical outputs, complete monotone
+# per-group lifecycles (enqueue <= admit <= first_token <= finish), >= 1
+# backfill with nonzero queue-wait, stall-reason counts summing to the
+# declined-admission passes, scrapable Prometheus histogram buckets, and
+# a seeded DISTRL_SENTINEL_INJECT=ttft_blowup producing exactly one
+# flight-recorder bundle
+stage "serving_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/serving_smoke.py
 # observability gate (ISSUE 8): 2-worker tiny run — scrape both worker
 # endpoints and the driver's fleet endpoint mid-run (fleet/* series
 # present, per-worker token counters flowing), inject a seeded NaN,

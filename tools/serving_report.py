@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""One-command diagnosis of a serving JSONL (ISSUE 13): the request-level
+latency structure of a continuous-batching run — percentiles, attributed
+admission stalls, and the occupancy timeline — from the ledger file alone,
+no live process needed.
+
+    python tools/serving_report.py run_myrun/serving.jsonl
+
+The file is what ``--serving_dir`` / ``worker_main --serving-dir`` streams
+(``distrl_llm_tpu/serving_obs.py``): one JSON object per line,
+``kind: "group"`` per closed group lifecycle and one ``kind: "summary"``
+line (written at close) with the stall breakdown and occupancy summary.
+
+Default output: a p50/p90/p99/max table per latency metric (TTFT, queue
+wait, TPOT, e2e), the admission-stall reason breakdown vs declined passes,
+and the occupancy timeline summary. Sections render only when their data
+exists (the empty-when-absent pattern — a run that never stalled shows no
+stall table).
+
+Exit status: 0 on a parseable file with at least one group record, 1
+otherwise — tools/run_all_checks.sh gates on it via serving_smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+METRICS = (
+    ("ttft_ms", "ttft"),
+    ("queue_wait_ms", "queue_wait"),
+    ("tpot_ms", "tpot"),
+    ("e2e_ms", "e2e"),
+)
+
+
+def load(path: str) -> tuple[list[dict], dict | None]:
+    groups: list[dict] = []
+    summary: dict | None = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if doc.get("kind") == "group":
+                groups.append(doc)
+            elif doc.get("kind") == "summary":
+                summary = doc  # last one wins (close() writes exactly one)
+    return groups, summary
+
+
+def _pct(vals: list[float], q: float) -> float:
+    s = sorted(vals)
+    return s[min(int(len(s) * q / 100.0), len(s) - 1)]
+
+
+def build_report(groups: list[dict], summary: dict | None) -> str:
+    if not groups:
+        raise ValueError("no group records in the serving file")
+    lines: list[str] = []
+
+    closed = [g for g in groups if g.get("finish_ts") is not None]
+    partial = len(groups) - len(closed)
+    backfilled = sum(1 for g in groups if g.get("backfilled"))
+    preempted = sum(1 for g in groups if g.get("preemptions", 0) > 0)
+    resumed = sum(1 for g in groups if g.get("resumes", 0) > 0)
+    tokens = sum(g.get("gen_tokens") or 0 for g in groups)
+    lines.append(
+        f"groups: {len(groups)} recorded ({len(closed)} complete"
+        + (f", {partial} partial" if partial else "")
+        + f"), {backfilled} backfilled, {preempted} preempted, "
+        f"{resumed} resumed, {tokens} tokens"
+    )
+    shared = [
+        a.get("shared_pages", 0) for g in groups for a in g.get("admits", ())
+    ]
+    cow = sum(1 for g in groups for a in g.get("admits", ()) if a.get("cow"))
+    if shared:
+        lines.append(
+            f"admissions: {len(shared)} slot admits, "
+            f"{sum(1 for s in shared if s > 0)} aliased a prefix chain, "
+            f"{cow} rode a CoW tail split"
+        )
+    lines.append("")
+
+    # ---- latency percentile table
+    table: list[tuple[str, list[float]]] = []
+    for key, label in METRICS:
+        vals = [float(g[key]) for g in groups if g.get(key) is not None]
+        if vals:
+            table.append((label, vals))
+    if table:
+        lines.append("latency (ms):")
+        lines.append(
+            f"  {'metric':<12} {'count':>6} {'p50':>10} {'p90':>10} "
+            f"{'p99':>10} {'max':>10}"
+        )
+        for label, vals in table:
+            lines.append(
+                f"  {label:<12} {len(vals):>6} {_pct(vals, 50):>10,.2f} "
+                f"{_pct(vals, 90):>10,.2f} {_pct(vals, 99):>10,.2f} "
+                f"{max(vals):>10,.2f}"
+            )
+        lines.append("")
+
+    # ---- admission audit
+    if summary is not None:
+        declined = int(summary.get("declined_passes", 0))
+        passes = int(summary.get("admission_passes", 0))
+        stalls = {
+            k: int(v) for k, v in (summary.get("stalls") or {}).items() if v
+        }
+        if passes:
+            frac = declined / passes
+            lines.append(
+                f"admission: {declined} declined of {passes} passes "
+                f"(stall frac {frac:.3f})"
+            )
+            for reason, count in sorted(
+                stalls.items(), key=lambda kv: -kv[1]
+            ):
+                lines.append(f"  {reason:<14} {count}")
+            attributed = sum(stalls.values())
+            if attributed != declined:
+                # an unattributed decline is an engine bug the smoke pins;
+                # the report surfaces it rather than papering over
+                lines.append(
+                    f"  WARNING: {declined - attributed} declined pass(es) "
+                    f"carry no reason"
+                )
+            lines.append("")
+
+        occ = summary.get("occupancy")
+        if occ:
+            lines.append(
+                f"occupancy: live slots mean {occ.get('live_slots_mean')} / "
+                f"max {occ.get('live_slots_max')}, queue depth mean "
+                f"{occ.get('queue_depth_mean')} / max "
+                f"{occ.get('queue_depth_max')}, free pages min "
+                f"{occ.get('free_pages_min')} "
+                f"({occ.get('samples')} samples over {occ.get('span_s')}s)"
+            )
+
+    return "\n".join(lines).rstrip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="request-level serving latency + admission-stall report"
+    )
+    p.add_argument("serving", help="path to a serving.jsonl (--serving_dir)")
+    args = p.parse_args(argv)
+    try:
+        groups, summary = load(args.serving)
+        report = build_report(groups, summary)
+    except Exception as e:  # noqa: BLE001 — a truncated or still-being-
+        # written ledger must exit 1 with one line, never a raw traceback
+        print(
+            f"serving_report: cannot report on {args.serving}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -231,7 +231,13 @@ bench spec_unrolled /tmp/bench_tpu_spec_unrolled.json 1200 \
 # cb_mode / prefill_shared_frac / pages_shared_frac / slot_idle_frac, so
 # the artifact shows both the prompt-KV capacity win (pages_shared_frac)
 # and the backfill win (slot_idle_frac drop at BENCH_EOS_RATE's ragged
-# lengths).
+# lengths). The continuous arm additionally records the request-level
+# serving latencies (ISSUE 13: ttft_p50_ms / ttft_p99_ms /
+# queue_wait_p50_ms from a post-warmup ServingLedger) and
+# admission_stall_frac — the ATTRIBUTION of slot_idle_frac (declined
+# admission passes by reason) — so the A/B explains its idle time, not
+# just measures it; bench_history scores these latency fields
+# lower-is-better across rounds.
 bench cb_prefix /tmp/bench_tpu_cb_prefix.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_PREFIX_SHARING=1 BENCH_CONT_ADMISSION=0 \

@@ -250,6 +250,54 @@ def policy_lag_section(events: list[dict]) -> list[str]:
     return lines
 
 
+def serving_section(events: list[dict]) -> list[str]:
+    """Request-level serving view (ISSUE 13) from the serving ledger's
+    traced samples: latency distributions (``serving/ttft_ms`` /
+    ``serving/queue_wait_ms`` / ``serving/tpot_ms`` / ``serving/e2e_ms``
+    counter events, one per closed group) and the occupancy tracks
+    (``serving/live_slots`` / ``serving/queue_depth`` /
+    ``serving/free_pages`` gauges, one sample per admission pass). Empty
+    when the run never armed --serving_obs."""
+    hists: dict[str, list[float]] = {}
+    gauges: dict[str, list[float]] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if ev.get("ph") != "C" or not name.startswith("serving/"):
+            continue
+        args = ev.get("args", {})
+        key = name.rsplit("/", 1)[-1]
+        if name in ("serving/live_slots", "serving/queue_depth",
+                    "serving/free_pages"):
+            gauges.setdefault(name, []).append(float(args.get(key, 0)))
+        else:
+            hists.setdefault(name, []).extend(
+                [float(args.get(key, 0))] * int(args.get("count", 1))
+            )
+    if not hists and not gauges:
+        return []
+    lines = ["serving:"]
+    for name, label in (
+        ("serving/ttft_ms", "ttft:"),
+        ("serving/queue_wait_ms", "queue wait:"),
+        ("serving/tpot_ms", "tpot:"),
+        ("serving/e2e_ms", "e2e:"),
+    ):
+        if hists.get(name):
+            lines.append(_dist_lines(label, hists[name]))
+    live = gauges.get("serving/live_slots")
+    if live:
+        queue = gauges.get("serving/queue_depth") or [0.0]
+        free = gauges.get("serving/free_pages") or [0.0]
+        lines.append(
+            f"  occupancy:          live slots mean "
+            f"{sum(live) / len(live):,.1f} / max {max(live):,.0f}, queue "
+            f"depth max {max(queue):,.0f}, free pages min {min(free):,.0f} "
+            f"({len(live)} admission passes)"
+        )
+    lines.append("")
+    return lines
+
+
 def lineage_section(events: list[dict],
                     spans: dict[tuple[int, str], list[dict]],
                     tracks: dict[int, str]) -> list[str]:
@@ -466,6 +514,7 @@ def build_report(events: list[dict], metadata: dict,
     lines.extend(weight_bus_section(spans))
     lines.extend(rollout_section(events, spans))
     lines.extend(policy_lag_section(events))
+    lines.extend(serving_section(events))
     lines.extend(lineage_section(events, spans, tracks))
     lines.extend(spec_section(spans))
 

@@ -292,6 +292,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lineage_ring", type=int, default=1024,
                    help="bounded ring of OPEN lineage records; overflow is "
                         "counted in lineage/ring_evictions, never silent")
+    p.add_argument("--serving_obs", action="store_true",
+                   help="request-level serving ledger (ISSUE 13): per-group "
+                        "lifecycle events from the continuous-batching "
+                        "engine (enqueue/admit/first token/finish) yielding "
+                        "serving/ttft_ms, serving/tpot_ms, "
+                        "serving/queue_wait_ms and serving/e2e_ms "
+                        "histograms plus attributed admission stalls; "
+                        "requires --engine_impl paged + "
+                        "--continuous_batching (workers arm their own via "
+                        "worker_main --serving-obs)")
+    p.add_argument("--serving_dir", type=str, default=None,
+                   help="stream closed serving records to "
+                        "<dir>/serving.jsonl (implies --serving_obs); "
+                        "inspect with tools/serving_report.py")
+    p.add_argument("--serving_ring", type=int, default=1024,
+                   help="bounded ring of OPEN serving records; overflow is "
+                        "counted in serving/ring_evictions, never silent")
+    p.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="time-to-first-token SLO: arms the sentinel's "
+                        "ttft_blowup trigger (a step whose worst observed "
+                        "TTFT exceeds this dumps a flight-recorder "
+                        "bundle); requires --sentinel")
+    p.add_argument("--slo_queue_wait_ms", type=float, default=None,
+                   help="queue-wait SLO: arms the sentinel's "
+                        "queue_wait_blowup trigger; requires --sentinel")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
