@@ -893,6 +893,24 @@ def main() -> int:
 
         serving_ledger = ServingLedger(ring_size=4096)
         engine.serving_ledger = serving_ledger
+    # BENCH_CONTROL_FRAC (ISSUE 14): pin a governor-shrunk admission
+    # fraction on the timed rounds — the static twin of an HBM-governor
+    # shrink, so an A/B against the unpinned row quantifies a controller
+    # run's throughput cost. Attached AFTER warmup (the control fields
+    # describe the timed window); rows without it keep the fields null.
+    control_limits = None
+    frac_env = os.environ.get("BENCH_CONTROL_FRAC")
+    if frac_env and getattr(engine, "continuous_admission", False):
+        from distrl_llm_tpu.control import ControlLimits
+
+        control_limits = ControlLimits()
+        control_limits.set_admission_frac(float(frac_env))
+        engine.control_limits = control_limits
+    from distrl_llm_tpu import telemetry as _tlm
+
+    control_actions0 = _tlm.observe_snapshot()["counters"].get(
+        "control/actions", 0.0
+    )
     if fleet_agg is not None:
         # first refresh sets the per-worker (ts, gen_tokens) marks off the
         # warmup round's piggybacked snapshots; the post-timing refresh
@@ -1209,6 +1227,24 @@ def main() -> int:
             serving_ledger, "queue_wait_ms", 50
         ),
         "admission_stall_frac": _serving_stall_frac(serving_ledger),
+        # self-healing-runtime provenance (ISSUE 14, pinned in
+        # tests/test_bench_contract.py): dynamic control actuations over
+        # the timed window and groups the shedder deferred — null unless a
+        # ControlLimits was attached (BENCH_CONTROL_FRAC pins the static
+        # governor-shrunk A/B arm; a pinned arm honestly records 0
+        # actions, it is the shrunk CAP whose throughput cost the A/B
+        # measures). Train-curve records carry the same story via the
+        # control/* registry series.
+        "control_actions": (
+            _tlm.observe_snapshot()["counters"].get(
+                "control/actions", 0.0
+            ) - control_actions0
+            if control_limits is not None else None
+        ),
+        "shed_groups": (
+            (getattr(engine, "last_pool_stats", None) or {})
+            .get("shed_groups")
+        ),
         # measured-attribution fields (ISSUE 8, pinned in
         # tests/test_bench_contract.py): device HBM watermark (null on
         # backends without memory stats), shape-keyed retrace count since

@@ -503,6 +503,52 @@ class TrainConfig:
     # the limit dumps a flight-recorder bundle. Require --sentinel.
     slo_ttft_ms: float | None = None
     slo_queue_wait_ms: float | None = None
+    # --- self-healing runtime (distrl_llm_tpu/control/, ISSUE 14) ---------
+    # Closed-loop governors that ACT on the observability plane: bounded,
+    # hysteretic, cooldown-guarded actuations with a global per-run budget.
+    # --control arms every controller the run's shape supports (silently
+    # skipping inapplicable ones); the per-controller flags arm exactly one
+    # and LOUDLY reject a run shape that cannot host it (dead-flag policy).
+    # All default OFF; a run with controllers off is byte-identical to one
+    # without the subsystem (pinned).
+    control: bool = False
+    # HBM governor: shrinks the continuous-admission chain cap under
+    # watermark pressure / hbm_breach, regrows after a sustained-headroom
+    # dwell. Requires a LOCAL paged engine with continuous_admission
+    # (fleet runs arm it worker-side: worker_main --control-hbm).
+    control_hbm: bool = False
+    # SLO load-shedder: throttles admit_groups (decline reason "shed")
+    # while serving TTFT/queue-wait breach the PR 13 SLOs. Requires
+    # continuous_admission + at least one slo_* limit; worker-side over
+    # rollout_workers (worker_main --control-shed).
+    control_shed: bool = False
+    # staleness governor: adapts the EFFECTIVE max_staleness and buffer
+    # high watermark from the live lineage/policy_lag_ms distribution
+    # (async mode only; drop/downweight semantics preserved — only the
+    # bound moves, never past the configured max_staleness). Requires
+    # lineage (the signal's producer).
+    control_staleness: bool = False
+    # worker-health actor: converts a per-worker tok/s regression into
+    # proactive quarantine + rejoin-probe (the PR 5 machinery). Requires
+    # rollout_workers + worker_rejoin.
+    control_worker_health: bool = False
+    # nan-loss rollback: restore the last-good (adapter, opt state,
+    # version) snapshot and skip the poisoned step instead of training on
+    # NaNs from there on. Applicable to every run shape.
+    control_nan_rollback: bool = False
+    # global actuation budget per run: once spent, every knob freezes at
+    # its current (clamped) value — a runaway controller is bounded by
+    # construction
+    control_budget: int = 64
+    # minimum steps between two actions of one governor
+    control_cooldown_steps: int = 2
+    # consecutive healthy observations required before a governor regrows
+    # a previously shrunk knob (the sustained-headroom dwell)
+    control_dwell_steps: int = 3
+    # staleness governor setpoint: policy-lag p90 above this shrinks the
+    # effective staleness bound / buffer watermark; sustained p90 under
+    # half of it regrows them
+    control_lag_ms: float = 5000.0
     # Hang detector on generation rounds — parity with the reference's
     # ray.get(timeout=240) (distributed_trainer.py:200). 0 disables (the
     # default: a first rollout legitimately spends minutes in XLA compilation;
@@ -894,6 +940,94 @@ class TrainConfig:
             number_of_actors=self.number_of_actors,
             number_of_learners=self.number_of_learners,
         )
+        # --- self-healing runtime (ISSUE 14): per-controller dead-flag
+        # policy — an EXPLICIT per-controller flag on a run shape that
+        # cannot host the controller is a loud error; the --control master
+        # arms only the applicable subset (armed_controllers()).
+        if self.control_budget < 1:
+            raise ValueError(
+                f"control_budget must be >= 1, got {self.control_budget}"
+            )
+        if self.control_cooldown_steps < 0:
+            raise ValueError(
+                f"control_cooldown_steps must be >= 0, got "
+                f"{self.control_cooldown_steps}"
+            )
+        if self.control_dwell_steps < 1:
+            raise ValueError(
+                f"control_dwell_steps must be >= 1, got "
+                f"{self.control_dwell_steps}"
+            )
+        if self.control_lag_ms <= 0:
+            raise ValueError(
+                f"control_lag_ms must be > 0, got {self.control_lag_ms}"
+            )
+        if self.control_hbm and not self._hbm_controller_applicable():
+            raise ValueError(
+                "control_hbm shrinks the continuous-admission chain cap — "
+                "requires a LOCAL engine_impl='paged' with "
+                "continuous_admission (fleet runs arm it worker-side: "
+                "worker_main --control-hbm)"
+            )
+        if self.control_shed and not self._shed_controller_applicable():
+            raise ValueError(
+                "control_shed throttles continuous admission against an "
+                "SLO — requires continuous_admission plus slo_ttft_ms or "
+                "slo_queue_wait_ms, on a local engine (fleet runs arm it "
+                "worker-side: worker_main --control-shed)"
+            )
+        if self.control_staleness and not self.lineage:
+            raise ValueError(
+                "control_staleness steers on the lineage/policy_lag_ms "
+                "distribution — requires --lineage (async mode), which "
+                "produces that signal"
+            )
+        if self.control_worker_health and not (
+            self.rollout_workers and self.worker_rejoin
+        ):
+            raise ValueError(
+                "control_worker_health quarantines regressing workers and "
+                "relies on the rejoin loop to re-admit them — requires "
+                "rollout_workers with worker_rejoin"
+            )
+
+    def _hbm_controller_applicable(self) -> bool:
+        return bool(
+            self.engine_impl == "paged"
+            and self.continuous_admission
+            and not self.rollout_workers
+        )
+
+    def _shed_controller_applicable(self) -> bool:
+        return bool(
+            self._hbm_controller_applicable()
+            and (self.slo_ttft_ms is not None
+                 or self.slo_queue_wait_ms is not None)
+        )
+
+    def armed_controllers(self) -> tuple[str, ...]:
+        """Which ISSUE 14 controllers this run arms: the explicit
+        per-controller flags, plus — under the --control master — every
+        controller the run's shape supports. Explicit flags on unsupported
+        shapes already raised in __post_init__."""
+        armed: list[str] = []
+        if self.control_hbm or (
+            self.control and self._hbm_controller_applicable()
+        ):
+            armed.append("hbm")
+        if self.control_shed or (
+            self.control and self._shed_controller_applicable()
+        ):
+            armed.append("shed")
+        if self.control_staleness or (self.control and self.lineage):
+            armed.append("staleness")
+        if self.control_worker_health or (
+            self.control and self.rollout_workers and self.worker_rejoin
+        ):
+            armed.append("worker_health")
+        if self.control_nan_rollback or self.control:
+            armed.append("nan_rollback")
+        return tuple(armed)
 
     @property
     def max_seq_length(self) -> int:

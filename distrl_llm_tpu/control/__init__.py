@@ -1,0 +1,74 @@
+"""Closed-loop control subsystem (ISSUE 14): bounded governors that act on
+the observability plane.
+
+PRs 8/10/13 made the system *measure* everything — HBM watermarks, policy
+lag, TTFT/queue-wait SLOs, per-worker throughput — but every control knob
+stayed a static CLI flag and the Sentinel could only dump a flight-recorder
+bundle and keep going. This package closes the loops:
+
+* :mod:`~distrl_llm_tpu.control.governor` — the framework: bounded
+  actuators (hard min/max clamps), hysteretic deadband governors with
+  per-governor cooldowns and a sustained-headroom regrow dwell, a global
+  per-run actuation budget, and the :class:`ControlLimits` handle the paged
+  engine's admission loop consults (one attribute check when absent).
+* :mod:`~distrl_llm_tpu.control.controllers` — the five concrete
+  controllers: HBM admission governor, SLO load-shedder, staleness
+  governor, worker-health actor, and the nan-loss rollback.
+
+Everything defaults OFF behind ``--control`` / per-controller flags; a run
+with controllers off is byte-identical to one without this package (the
+engine hook is ``control_limits is None``). Every actuation is bounded,
+counted (``control/*`` series), recorded in the flight-recorder ring, and
+stamped as a Perfetto instant — the chaos gates in tests/test_control.py
+and tools/control_smoke.py prove each loop converges and never oscillates.
+"""
+
+from distrl_llm_tpu.control.governor import (
+    CONTROL_ACTIONS,
+    CONTROL_BUDGET_EXHAUSTED,
+    CONTROL_COOLDOWN_SKIPS,
+    CONTROL_NAN_ROLLBACKS,
+    CONTROL_SHED_ACTIVE,
+    CONTROL_SHED_GROUPS,
+    CONTROL_TRIGGER_ESCALATIONS,
+    CONTROL_VALUE,
+    BoundedActuator,
+    ControlAction,
+    ControlLimits,
+    ControlRuntime,
+    Governor,
+)
+from distrl_llm_tpu.control.controllers import (
+    HbmGovernor,
+    NanRollbackController,
+    SloShedGovernor,
+    StalenessGovernor,
+    WorkerHealthGovernor,
+    attach_staleness,
+    build_runtime,
+    injected_nan_step,
+)
+
+__all__ = [
+    "CONTROL_ACTIONS",
+    "CONTROL_BUDGET_EXHAUSTED",
+    "CONTROL_COOLDOWN_SKIPS",
+    "CONTROL_NAN_ROLLBACKS",
+    "CONTROL_SHED_ACTIVE",
+    "CONTROL_SHED_GROUPS",
+    "CONTROL_TRIGGER_ESCALATIONS",
+    "CONTROL_VALUE",
+    "BoundedActuator",
+    "ControlAction",
+    "ControlLimits",
+    "ControlRuntime",
+    "Governor",
+    "HbmGovernor",
+    "NanRollbackController",
+    "SloShedGovernor",
+    "StalenessGovernor",
+    "WorkerHealthGovernor",
+    "attach_staleness",
+    "build_runtime",
+    "injected_nan_step",
+]

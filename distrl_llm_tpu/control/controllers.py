@@ -1,0 +1,675 @@
+"""The five concrete controllers of the self-healing runtime (ISSUE 14).
+
+Each one closes a loop the observability plane already measures:
+
+========================  =============================  ====================
+signal                    actuator                       bounds
+========================  =============================  ====================
+HBM peak / limit          continuous-admission chain     admission_frac in
+(obs.hbm_stats, the       cap scale (ControlLimits.      [0.1, 1.0]; shrink
+DISTRL_OBS_FAKE_HBM       admission_frac)                x0.5, regrow +0.25
+hook in tests)                                           after the dwell
+serving TTFT/queue-wait   admit_groups shed gate         shed bounded by
+vs the PR 13 SLOs         (ControlLimits.shed; the       shed_max_steps,
+                          engine declines with the       release after the
+                          "shed" reason)                 recovery dwell
+lineage/policy_lag_ms     effective max_staleness +      K in [1, configured
+p90 vs the lag target     buffer high watermark          K]; watermark >=
+                                                         2x batch pull
+per-worker tok/s vs its   DriverClient.quarantine_       never below
+own EMA                   worker (PR 5 rejoin loop       min_healthy; per-
+                          probes + re-admits)            worker cooldown
+non-finite loss           restore last-good (adapter,    max_rollbacks per
+                          opt state, version) snapshot   run
+========================  =============================  ====================
+
+Every controller rides the governor framework's cooldown/budget/clamp
+discipline and is chaos-gated in tests/test_control.py (seeded breach →
+bounded actuation → signal back inside the deadband → no oscillation
+across the dwell window) plus tools/control_smoke.py end-to-end.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Mapping
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.control.governor import (
+    CONTROL_BUDGET_EXHAUSTED,
+    CONTROL_NAN_ROLLBACKS,
+    CONTROL_SHED_ACTIVE,
+    BoundedActuator,
+    ControlAction,
+    ControlLimits,
+    ControlRuntime,
+    Governor,
+    cooldown_ok,
+)
+
+log = logging.getLogger(__name__)
+
+# seeded chaos injection for the nan-loss rollback gate (the sentinel's
+# DISTRL_SENTINEL_INJECT fakes the *metric*; the rollback controller acts
+# on the *actual* loss, so its gate needs the loss itself poisoned): the
+# trainer reads this once and overrides the realized loss with NaN at the
+# named step — tools/control_smoke.py's rollback gate drives it
+CONTROL_INJECT_NAN_ENV = "DISTRL_CONTROL_INJECT_NAN"
+
+
+def injected_nan_step() -> int | None:
+    """Step at which the chaos harness poisons the realized loss, or None."""
+    spec = os.environ.get(CONTROL_INJECT_NAN_ENV)
+    if not spec:
+        return None
+    try:
+        return int(spec)
+    except ValueError:
+        log.warning(
+            "ignoring %s=%r (expected an integer step)",
+            CONTROL_INJECT_NAN_ENV, spec,
+        )
+        return None
+
+
+# ------------------------------------------------------------ HBM governor
+
+
+class HbmGovernor(Governor):
+    """Shrinks the continuous-admission chain cap under HBM pressure.
+
+    Signal: device ``bytes_in_use / bytes_limit`` from
+    :func:`obs.hbm_stats` (honors the ``DISTRL_OBS_FAKE_HBM`` test hook);
+    None on backends without memory stats — the governor is then inert.
+    LIVE bytes, not ``peak_bytes_in_use``: the peak is a lifetime
+    high-watermark that never resets, so steering on it would turn one
+    recovered spike (an XLA compile workspace, say) into a permanent
+    one-way ratchet — shrink forever, regrow never. The sentinel's
+    ``hbm_breach`` keeps the peak (an incident HAPPENED is exactly its
+    semantics); this governor needs the signal that tracks recovery.
+    Deadband defaults sit BELOW the sentinel's 0.95 threshold, so the
+    governor degrades gracefully before the incident trigger would fire;
+    the ``hbm_breach`` escalation is the immediate shrink when it fires
+    anyway."""
+
+    def __init__(self, limits: ControlLimits, *, high: float = 0.85,
+                 low: float = 0.70, min_frac: float = 0.1,
+                 cooldown_steps: int = 2, dwell_steps: int = 3,
+                 stats_fn: Callable[[], Mapping[str, float] | None] | None = None):
+        self.limits = limits
+        if stats_fn is None:
+            from distrl_llm_tpu import obs as obs_mod
+
+            stats_fn = obs_mod.hbm_stats
+        self._stats_fn = stats_fn
+        super().__init__(
+            "hbm",
+            actuators=[BoundedActuator(
+                name="admission_frac", value=1.0,
+                min_value=float(min_frac), max_value=1.0,
+                apply=limits.set_admission_frac,
+                shrink=lambda v: v * 0.5,
+                regrow=lambda v: v + 0.25,
+            )],
+            high=high, low=low,
+            cooldown_steps=cooldown_steps, dwell_steps=dwell_steps,
+        )
+
+    def read(self, step: int, metrics: Mapping[str, Any]) -> float | None:
+        stats = self._stats_fn()
+        if not stats or not stats.get("bytes_limit"):
+            return None
+        live = stats.get("bytes_in_use")
+        if live is None:
+            # fall back only when the KEY is absent (an honest 0.0 live
+            # reading must not resurrect the never-regrowing peak):
+            # backends exposing only the peak get a conservative signal
+            # rather than a blind governor
+            live = stats.get("peak_bytes_in_use", 0.0)
+        return float(live) / float(stats["bytes_limit"])
+
+
+# ---------------------------------------------------------- SLO load-shed
+
+
+class SloShedGovernor:
+    """Throttles ``admit_groups`` when serving latency breaches the PR 13
+    SLOs: while shed is engaged the continuous-admission loop declines new
+    group admissions with the ``shed`` reason (candidates of already-
+    admitted groups keep filling slots, so the engine drains rather than
+    starves).
+
+    Signal: the step's worst observed ``serving/ttft_ms`` /
+    ``serving/queue_wait_ms`` (the per-step registry hist max, or the
+    fleet-folded worker max — the same keys the sentinel's SLO triggers
+    read), normalized by its SLO. Engage above 1.0; release after the
+    signal stays under ``release_frac`` for ``dwell_steps`` consecutive
+    steps, or unconditionally after ``shed_max_steps`` (shed is a bounded
+    action, never a permanent starvation mode). A step with no latency
+    observation counts as healthy while shed (no new admissions means no
+    new samples — that IS the recovery)."""
+
+    ESCALATE_KIND = "engage"
+
+    def __init__(self, limits: ControlLimits, *,
+                 slo_ttft_ms: float | None = None,
+                 slo_queue_wait_ms: float | None = None,
+                 release_frac: float = 0.7, cooldown_steps: int = 2,
+                 dwell_steps: int = 2, shed_max_steps: int = 8):
+        if slo_ttft_ms is None and slo_queue_wait_ms is None:
+            raise ValueError(
+                "SloShedGovernor needs at least one SLO "
+                "(slo_ttft_ms / slo_queue_wait_ms) to steer on"
+            )
+        if not 0.0 < release_frac <= 1.0:
+            raise ValueError(
+                f"release_frac must be in (0, 1], got {release_frac}"
+            )
+        if shed_max_steps < 1:
+            raise ValueError(
+                f"shed_max_steps must be >= 1, got {shed_max_steps}"
+            )
+        self.name = "slo_shed"
+        self.limits = limits
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_queue_wait_ms = slo_queue_wait_ms
+        self.release_frac = float(release_frac)
+        self.cooldown_steps = int(cooldown_steps)
+        self.dwell_steps = int(dwell_steps)
+        self.shed_max_steps = int(shed_max_steps)
+        self.shed = False
+        self._shed_since: int | None = None
+        self._ok_run = 0
+        self._last_action_step: int | None = None
+        self.last_signal: float | None = None
+        telemetry.gauge_set(CONTROL_SHED_ACTIVE, 0.0)
+
+    def read(self, step: int, metrics: Mapping[str, Any]) -> float | None:
+        from distrl_llm_tpu.serving_obs import (
+            FLEET_SERVING_QUEUE_WAIT_MAX_MS,
+            FLEET_SERVING_TTFT_MAX_MS,
+            SERVING_QUEUE_WAIT_MS,
+            SERVING_TTFT_MS,
+        )
+
+        ratios: list[float] = []
+        for slo, keys in (
+            (self.slo_ttft_ms,
+             (SERVING_TTFT_MS + "_max", FLEET_SERVING_TTFT_MAX_MS)),
+            (self.slo_queue_wait_ms,
+             (SERVING_QUEUE_WAIT_MS + "_max",
+              FLEET_SERVING_QUEUE_WAIT_MAX_MS)),
+        ):
+            if slo is None:
+                continue
+            vals = [float(metrics[k]) for k in keys
+                    if metrics.get(k) is not None]
+            if vals:
+                ratios.append(max(vals) / float(slo))
+        return max(ratios) if ratios else None
+
+    def _cooled(self, step: int, runtime: ControlRuntime) -> bool:
+        return cooldown_ok(self, step, runtime)
+
+    def _transition(self, step: int, runtime: ControlRuntime,
+                    engage: bool, reason: str,
+                    trigger: str | None = None) -> list[ControlAction]:
+        action = ControlAction(
+            step=step, controller=self.name, actuator="shed",
+            kind="engage" if engage else "release",
+            old=float(self.shed), new=float(engage), reason=reason,
+            trigger=trigger,
+        )
+
+        def push():
+            self.shed = engage
+            self.limits.set_shed(engage)
+            telemetry.gauge_set(CONTROL_SHED_ACTIVE, float(engage))
+
+        # a RELEASE restores the default state and is budget-FREE: an
+        # exhausted budget blocking it would leave shed engaged forever —
+        # the exact permanent-starvation mode shed_max_steps exists to
+        # prevent (the engage that created the state paid the budget)
+        if runtime.act(action, apply=push, free=not engage):
+            self._last_action_step = step
+            self._shed_since = step if engage else None
+            self._ok_run = 0
+            return [action]
+        return []
+
+    def step(self, step: int, metrics: Mapping[str, Any],
+             runtime: ControlRuntime) -> list[ControlAction]:
+        v = self.read(step, metrics)
+        self.last_signal = v
+        if not self.shed:
+            if v is not None and v > 1.0 and self._cooled(step, runtime):
+                return self._transition(
+                    step, runtime, True,
+                    f"latency at {v:.3g}x its SLO",
+                )
+            return []
+        # shed engaged: bounded duration first, then the recovery dwell
+        if (
+            self._shed_since is not None
+            and step - self._shed_since >= self.shed_max_steps
+        ):
+            return self._transition(
+                step, runtime, False,
+                f"shed_max_steps ({self.shed_max_steps}) reached",
+            )
+        if v is None or v < self.release_frac:
+            self._ok_run += 1
+            if self._ok_run >= self.dwell_steps and self._cooled(
+                step, runtime
+            ):
+                return self._transition(
+                    step, runtime, False,
+                    f"latency back under {self.release_frac:.2g}x SLO for "
+                    f"{self._ok_run} steps",
+                )
+        else:
+            self._ok_run = 0
+        return []
+
+    def on_trigger(self, trigger: str, step: int, runtime: ControlRuntime,
+                   extra: Mapping[str, Any]) -> bool:
+        """ttft_blowup / queue_wait_blowup escalation: immediate engage."""
+        if self.shed:
+            return False  # already shedding — the trigger adds nothing
+        if not self._cooled(step, runtime):
+            return False
+        return bool(self._transition(
+            step, runtime, True, f"sentinel trigger {trigger!r}",
+            trigger=trigger,
+        ))
+
+
+# ------------------------------------------------------ staleness governor
+
+
+class StalenessGovernor(Governor):
+    """Adapts the async regime's effective staleness bound and buffer
+    backpressure from the realized ``lineage/policy_lag_ms`` distribution
+    (async mode only; the drop/downweight admission semantics are
+    untouched — only the effective K and the high watermark move, both
+    clamped inside their configured values).
+
+    Signal: the step's ``lineage/policy_lag_ms_p90`` from the registry
+    snapshot riding the metrics record (None on steps where no lag closed
+    — the dwell holds). High lag shrinks K (fresher admissions) and the
+    buffer's high watermark (less queued backlog — the backlog IS most of
+    the lag); sustained low lag regrows both toward their configured
+    values."""
+
+    def __init__(self, policy, buffer, *, lag_target_ms: float,
+                 batch_size: int, cooldown_steps: int = 2,
+                 dwell_steps: int = 3):
+        if lag_target_ms <= 0:
+            raise ValueError(
+                f"lag_target_ms must be > 0, got {lag_target_ms}"
+            )
+        self.policy = policy
+        self.buffer = buffer
+        k_max = int(policy.max_staleness)
+        wm_max = int(buffer.high_watermark)
+        # the buffer floor keeps the documented async invariant: a
+        # get_batch(batch_size) must stay satisfiable below the
+        # backpressure gate, or learner and producer deadlock
+        wm_min = min(max(2 * int(batch_size), 1), wm_max)
+
+        def apply_k(v: float) -> None:
+            policy.max_staleness = int(v)
+
+        def apply_wm(v: float) -> None:
+            buffer.set_watermarks(int(v))
+
+        super().__init__(
+            "staleness",
+            actuators=[
+                BoundedActuator(
+                    name="max_staleness", value=float(k_max),
+                    min_value=1.0, max_value=float(max(k_max, 1)),
+                    apply=apply_k,
+                    shrink=lambda v: max(v // 2, 1.0),
+                    regrow=lambda v: v + 1.0,
+                    integer=True,
+                ),
+                BoundedActuator(
+                    name="buffer_high_watermark", value=float(wm_max),
+                    min_value=float(wm_min), max_value=float(wm_max),
+                    apply=apply_wm,
+                    shrink=lambda v: v // 2,
+                    regrow=lambda v: v + max(float(wm_max) / 4.0, 1.0),
+                    integer=True,
+                ),
+            ],
+            high=float(lag_target_ms), low=0.5 * float(lag_target_ms),
+            cooldown_steps=cooldown_steps, dwell_steps=dwell_steps,
+        )
+
+    def read(self, step: int, metrics: Mapping[str, Any]) -> float | None:
+        from distrl_llm_tpu.lineage import POLICY_LAG_MS
+
+        v = metrics.get(POLICY_LAG_MS + "_p90")
+        return float(v) if v is not None else None
+
+
+# ---------------------------------------------------- worker-health actor
+
+
+class WorkerHealthGovernor:
+    """Converts a per-worker tok/s regression into proactive quarantine:
+    the worker is demoted (``DriverClient.quarantine_worker``) so
+    dispatches avoid it, and the PR 5 rejoin loop PING-probes and
+    re-admits it (cold) once it answers again — recovery is automatic, the
+    actor only decides *when to stop trusting* a live-but-degraded worker
+    instead of waiting for a hard failure.
+
+    Signal: per-worker token rates derived from the fleet view's
+    cumulative ``gen_tokens`` marks (the FleetAggregator's own math),
+    each tracked against its own EMA — the same regression definition the
+    sentinel applies to the whole engine, per worker. Bounds: never below
+    ``min_healthy`` healthy workers (enforced here AND by the driver), a
+    per-worker re-quarantine cooldown, and the runtime's global budget."""
+
+    def __init__(self, driver, fleet_provider: Callable[[], Mapping | None],
+                 *, drop_frac: float = 0.5, warmup_obs: int = 3,
+                 ema_alpha: float = 0.3, cooldown_steps: int = 8,
+                 min_healthy: int = 1):
+        if not 0.0 < drop_frac < 1.0:
+            raise ValueError(f"drop_frac must be in (0, 1), got {drop_frac}")
+        self.name = "worker_health"
+        self.driver = driver
+        self.fleet_provider = fleet_provider
+        self.drop_frac = float(drop_frac)
+        self.warmup_obs = int(warmup_obs)
+        self.ema_alpha = float(ema_alpha)
+        self.cooldown_steps = int(cooldown_steps)
+        self.min_healthy = max(int(min_healthy), 1)
+        # per-worker (ts, cumulative tokens) marks + rate EMA + obs count
+        self._marks: dict[str, tuple[float, float]] = {}
+        self._ema: dict[str, float] = {}
+        self._obs: dict[str, int] = {}
+        self._pids: dict[str, Any] = {}
+        self._last_q_step: dict[str, int] = {}
+        self.last_rates: dict[str, float] = {}
+
+    def _reset_track(self, addr: str) -> None:
+        self._ema.pop(addr, None)
+        self._obs.pop(addr, None)
+        self._marks.pop(addr, None)
+
+    def _rates(self) -> dict[str, float]:
+        fleet = None
+        try:
+            fleet = self.fleet_provider()
+        except Exception:  # noqa: BLE001 — a failed refresh is a skipped obs
+            log.warning("fleet refresh failed in worker-health governor",
+                        exc_info=True)
+        rates: dict[str, float] = {}
+        if not fleet:
+            return rates
+        # only CURRENTLY healthy, warm workers are judged: a dead worker's
+        # counter stalls (the fleet cumulative never regresses by design),
+        # and judging the stall — or a freshly rejoined worker's
+        # recompile window — against the healthy EMA would quarantine the
+        # recovery itself. Unknown state (no workers list) judges all.
+        workers = fleet.get("workers")
+        healthy = (
+            {w.get("address") for w in workers
+             if w.get("healthy") and not w.get("cold")}
+            if workers is not None else None
+        )
+        for addr, rec in (fleet.get("worker_metrics") or {}).items():
+            pid = rec.get("pid")
+            last_pid = self._pids.get(addr)
+            self._pids[addr] = pid
+            if pid is not None and last_pid is not None and pid != last_pid:
+                # restart: the EXACT incarnation signal (the cumulative
+                # total deliberately never regresses, so a delta check
+                # cannot see this) — start the track over
+                self._reset_track(addr)
+            if healthy is not None and addr not in healthy:
+                self._reset_track(addr)
+                continue
+            tokens = float(rec.get("gen_tokens", 0.0))
+            ts = float(rec.get("ts", time.time()))
+            mark = self._marks.get(addr)
+            self._marks[addr] = (ts, tokens)
+            if mark is None or ts <= mark[0]:
+                continue
+            delta = tokens - mark[1]
+            if delta < 0:
+                # defensive: a raw regression means our mark predates
+                # some reset the pid check missed — start over
+                self._reset_track(addr)
+                continue
+            rates[addr] = delta / (ts - mark[0])
+        self.last_rates = rates
+        return rates
+
+    def _scan(self, step: int, runtime: ControlRuntime, *,
+              trigger: str | None, reason_prefix: str) -> list[ControlAction]:
+        applied: list[ControlAction] = []
+        for addr, rate in self._rates().items():
+            ema = self._ema.get(addr)
+            n = self._obs.get(addr, 0) + 1
+            self._obs[addr] = n
+            if ema is None:
+                self._ema[addr] = rate
+                continue
+            regressed = (
+                n > self.warmup_obs and rate < self.drop_frac * ema
+            )
+            # EMA updates regardless (the sentinel's ordering): a genuine
+            # slow fade tracks down with the EMA instead of re-triggering
+            self._ema[addr] = (
+                self.ema_alpha * rate + (1 - self.ema_alpha) * ema
+            )
+            if not regressed:
+                continue
+            last_q = self._last_q_step.get(addr)
+            if last_q is not None and step - last_q < self.cooldown_steps:
+                runtime.note_cooldown_skip()
+                continue
+            if runtime.budget_left() <= 0:
+                # checked BEFORE touching the driver: a quarantine the
+                # budget cannot account for must not happen at all
+                telemetry.counter_add(CONTROL_BUDGET_EXHAUSTED)
+                break
+            if not self.driver.quarantine_worker(
+                addr, min_healthy=self.min_healthy
+            ):
+                continue  # refused (min_healthy / already unhealthy)
+            action = ControlAction(
+                step=step, controller=self.name, actuator=f"worker:{addr}",
+                kind="quarantine", old=round(ema, 1), new=round(rate, 1),
+                reason=(
+                    f"{reason_prefix}: {rate:.1f} tok/s < "
+                    f"{self.drop_frac:.2g} x EMA {ema:.1f}"
+                ),
+                trigger=trigger,
+            )
+            if runtime.act(action):
+                self._last_q_step[addr] = step
+                # quarantine resets the track: the rejoined worker's
+                # post-recompile rate must not be judged against its
+                # pre-quarantine EMA
+                self._ema.pop(addr, None)
+                self._obs.pop(addr, None)
+                applied.append(action)
+        return applied
+
+    def step(self, step: int, metrics: Mapping[str, Any],
+             runtime: ControlRuntime) -> list[ControlAction]:
+        return self._scan(
+            step, runtime, trigger=None, reason_prefix="tok/s regression"
+        )
+
+    def on_trigger(self, trigger: str, step: int, runtime: ControlRuntime,
+                   extra: Mapping[str, Any]) -> bool:
+        """tok_s_regression escalation: an immediate per-worker scan —
+        the engine-wide EMA regressed, find the laggard now."""
+        return bool(self._scan(
+            step, runtime, trigger=trigger,
+            reason_prefix=f"sentinel {trigger!r} scan",
+        ))
+
+
+# ---------------------------------------------------- nan-loss rollback
+
+
+class NanRollbackController:
+    """Restores the last-good (adapter, optimizer state, version) snapshot
+    when an optimizer step produces a non-finite loss, so the run skips the
+    poisoned step instead of training on NaNs from there on.
+
+    The snapshot is the learner-side twin of the weight bus's versioned
+    state: it always holds a version every worker has already acked (the
+    trainer snapshots after each finite step's push), so a rollback needs
+    NO resync — dispatches keep naming a version the workers' AdapterCache
+    still holds, which the action record asserts when a bus is present.
+    Bounded by ``max_rollbacks`` and the runtime budget; an exhausted
+    controller leaves the step untouched (the pre-ISSUE-14 behavior)."""
+
+    def __init__(self, *, max_rollbacks: int = 3):
+        if max_rollbacks < 1:
+            raise ValueError(
+                f"max_rollbacks must be >= 1, got {max_rollbacks}"
+            )
+        self.name = "nan_rollback"
+        self.max_rollbacks = int(max_rollbacks)
+        self.rollbacks = 0
+        self._snap: tuple[int, Any, Any] | None = None
+
+    @staticmethod
+    def _copy_tree(tree):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.copy, tree)
+
+    def note_good(self, version: int, lora, opt_state) -> None:
+        """Snapshot the state a finite step produced (device copies — the
+        donating train step never aliases them)."""
+        self._snap = (
+            int(version), self._copy_tree(lora), self._copy_tree(opt_state)
+        )
+
+    @property
+    def snapshot_version(self) -> int | None:
+        return self._snap[0] if self._snap is not None else None
+
+    def rollback(self, step: int, runtime: ControlRuntime,
+                 bus=None) -> tuple[Any, Any, int] | None:
+        """Restore the last-good snapshot, or None when no snapshot exists
+        / the per-run rollback bound is spent / the budget is exhausted.
+        Returns fresh copies — a second consecutive rollback must find the
+        snapshot intact after the first restore's buffers were donated."""
+        if self._snap is None:
+            return None
+        if self.rollbacks >= self.max_rollbacks:
+            log.error(
+                "nan_loss at step %d but the rollback bound (%d) is spent "
+                "— leaving the step as-is", step, self.max_rollbacks,
+            )
+            return None
+        version, lora, opt_state = self._snap
+        extra = ""
+        if bus is not None:
+            # the restored version's broadcast already completed (or the
+            # bus is still resyncing it) — either way no NEW push is
+            # needed; record what the workers hold for the incident trail
+            extra = f"; bus last_acked=v{bus.last_acked_version}"
+        action = ControlAction(
+            step=step, controller=self.name, actuator="weight_version",
+            kind="rollback", old=None, new=float(version),
+            reason=f"non-finite loss; restored v{version}{extra}",
+            trigger="nan_loss",
+        )
+        if not runtime.act(action):
+            return None  # budget-suppressed: the caller leaves the step as-is
+        self.rollbacks += 1
+        telemetry.counter_add(CONTROL_NAN_ROLLBACKS)
+        return self._copy_tree(lora), self._copy_tree(opt_state), version
+
+
+# ------------------------------------------------------------- assembly
+
+
+def build_runtime(config, *, engine=None, recorder=None,
+                  driver=None, fleet_provider=None) -> ControlRuntime | None:
+    """Assemble the ControlRuntime for a trainer from its TrainConfig
+    (None when no controller is armed). The staleness governor attaches
+    later — its plant (policy + buffer) only exists once the async loop
+    builds them (:func:`attach_staleness`)."""
+    armed = set(config.armed_controllers())
+    if not armed:
+        return None
+    limits = None
+    if armed & {"hbm", "shed"}:
+        limits = ControlLimits()
+        if engine is not None and hasattr(engine, "control_limits"):
+            engine.control_limits = limits
+    runtime = ControlRuntime(
+        budget=config.control_budget, recorder=recorder, limits=limits,
+    )
+    if "hbm" in armed:
+        runtime.register(
+            HbmGovernor(
+                limits,
+                cooldown_steps=config.control_cooldown_steps,
+                dwell_steps=config.control_dwell_steps,
+            ),
+            triggers=("hbm_breach",),
+        )
+    if "shed" in armed:
+        runtime.register(
+            SloShedGovernor(
+                limits,
+                slo_ttft_ms=config.slo_ttft_ms,
+                slo_queue_wait_ms=config.slo_queue_wait_ms,
+                cooldown_steps=config.control_cooldown_steps,
+                dwell_steps=config.control_dwell_steps,
+            ),
+            triggers=("ttft_blowup", "queue_wait_blowup"),
+        )
+    if "worker_health" in armed and driver is not None:
+        if fleet_provider is None:
+            # no ObsPlane fleet aggregator: build a private one off the
+            # same driver (rates still need workers exporting obs blobs —
+            # worker_main --metrics-port / DISTRL_OBS=1; without them the
+            # governor sees no per-worker counters and stays inert)
+            from distrl_llm_tpu.obs import FleetAggregator
+
+            fleet_provider = FleetAggregator(driver).refresh
+        runtime.register(
+            WorkerHealthGovernor(
+                driver, fleet_provider,
+                cooldown_steps=max(4 * config.control_cooldown_steps, 4),
+            ),
+            triggers=("tok_s_regression",),
+        )
+    if "nan_rollback" in armed:
+        runtime.nan = NanRollbackController()
+    return runtime
+
+
+def attach_staleness(runtime: ControlRuntime, config, policy,
+                     buffer) -> None:
+    """Register the staleness governor once the async loop's policy and
+    buffer exist (no-op unless the controller is armed)."""
+    if "staleness" not in set(config.armed_controllers()):
+        return
+    runtime.register(
+        StalenessGovernor(
+            policy, buffer,
+            lag_target_ms=config.control_lag_ms,
+            batch_size=config.batch_size,
+            cooldown_steps=config.control_cooldown_steps,
+            dwell_steps=config.control_dwell_steps,
+        ),
+        triggers=("staleness_blowup",),
+    )

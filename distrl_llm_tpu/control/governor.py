@@ -1,0 +1,440 @@
+"""Governor/actuator framework: bounded, hysteretic, cooldown-guarded
+closed-loop control over the observability plane (ISSUE 14).
+
+Design contract — every actuation is:
+
+* **bounded** — actuators carry hard min/max clamps; a governor can never
+  push a knob past them, whatever its signal does;
+* **hysteretic** — a deadband ``[low, high]`` separates the shrink and
+  regrow regions, and regrow additionally requires a *sustained-headroom
+  dwell* (``dwell_steps`` consecutive below-band observations), so a signal
+  hovering at the threshold cannot ping-pong the knob;
+* **cooldown-guarded** — at most one action per governor per
+  ``cooldown_steps`` steps (suppressions are counted, never silent);
+* **budgeted** — a global per-run actuation budget on the runtime; an
+  exhausted budget freezes every knob at its current (clamped) value and
+  counts the suppression;
+* **observable** — every action bumps ``control/actions``, lands a
+  ``control_action`` record in the flight-recorder ring, sets the
+  ``control/value/<actuator>`` gauge, and emits a Perfetto instant, so
+  ``tools/trace_report.py`` renders a "control:" section.
+
+The :class:`ControlLimits` handle is the engine-facing half: the paged
+engine's continuous-admission loop consults it (chain cap scale + shed
+flag) behind a single ``is not None`` attribute check, so a run without
+controllers is byte-identical to one without this module.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping
+
+from distrl_llm_tpu import telemetry
+
+log = logging.getLogger(__name__)
+
+# ------------------------------------------------------------- series names
+# (pinned, with their types, in tests/test_telemetry.py; graftcheck GC2xx:
+# this module is the single owner of every control/* name — the engine's
+# shed counter and the trainer's rollback path reference these constants)
+
+CONTROL_ACTIONS = "control/actions"              # counter: applied actuations
+CONTROL_TRIGGER_ESCALATIONS = "control/trigger_escalations"  # counter:
+#                                       sentinel trigger → governor handoffs
+CONTROL_COOLDOWN_SKIPS = "control/cooldown_skips"  # counter: suppressed by
+#                                                    a governor cooldown
+CONTROL_BUDGET_EXHAUSTED = "control/budget_exhausted"  # counter: suppressed
+#                                                 by the global run budget
+CONTROL_SHED_GROUPS = "control/shed_groups"      # counter: groups whose
+#                        admission the SLO shedder deferred at least once
+#                        (emitted by the paged engine's admission loop)
+CONTROL_SHED_ACTIVE = "control/shed_active"      # gauge: 0/1 shed state
+CONTROL_NAN_ROLLBACKS = "control/nan_rollbacks"  # counter: restored steps
+# per-actuator current-value gauges, derived as f"{CONTROL_VALUE}/<name>"
+# (constant-prefix derivation, the serving/admission_stalls pattern)
+CONTROL_VALUE = "control/value"
+
+CONTROL_ACTION_INSTANT = "control/action"        # Perfetto instant name
+
+
+@dataclass
+class ControlAction:
+    """One applied (or suppressed) actuation — the flight-recorder record
+    and the unit the chaos gates count."""
+
+    step: int
+    controller: str
+    actuator: str
+    kind: str          # shrink | regrow | engage | release | quarantine | rollback
+    old: float | None
+    new: float | None
+    reason: str
+    trigger: str | None = None  # sentinel trigger that escalated, if any
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "step": self.step, "controller": self.controller,
+            "actuator": self.actuator, "kind": self.kind,
+            "old": self.old, "new": self.new, "reason": self.reason,
+            "trigger": self.trigger,
+        }
+
+
+class ControlLimits:
+    """Thread-safe admission limits shared between governors (writers) and
+    the paged engine's continuous-admission loop (reader).
+
+    At defaults (``admission_frac=1.0``, ``shed=False``) every read is the
+    identity — an engine holding a default handle makes byte-identical
+    admission decisions to one holding ``None`` (pinned in
+    tests/test_control.py)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._admission_frac = 1.0
+        self._shed = False
+
+    # ---- governor side -----------------------------------------------
+
+    @property
+    def admission_frac(self) -> float:
+        with self._mu:
+            return self._admission_frac
+
+    def set_admission_frac(self, frac: float) -> None:
+        with self._mu:
+            self._admission_frac = min(max(float(frac), 0.0), 1.0)
+
+    def set_shed(self, active: bool) -> None:
+        with self._mu:
+            self._shed = bool(active)
+
+    # ---- engine side -------------------------------------------------
+
+    def chain_cap(self, base: int) -> int:
+        """The continuous-admission live prefix-chain cap, scaled by the
+        HBM governor's admission fraction (never below 1 — the engine must
+        always be able to make progress)."""
+        with self._mu:
+            frac = self._admission_frac
+        return max(1, math.ceil(base * frac))
+
+    def shed_active(self) -> bool:
+        with self._mu:
+            return self._shed
+
+
+@dataclass
+class BoundedActuator:
+    """One clamped knob. ``apply(new_value)`` pushes the value into the
+    plant (a ControlLimits field, a StalenessPolicy attribute, a buffer
+    watermark); ``shrink``/``regrow`` compute the next candidate value —
+    the clamp is enforced here, not trusted to the governor."""
+
+    name: str
+    value: float
+    min_value: float
+    max_value: float
+    apply: Callable[[float], None]
+    shrink: Callable[[float], float]
+    regrow: Callable[[float], float]
+    integer: bool = False
+
+    def clamp(self, v: float) -> float:
+        v = min(max(v, self.min_value), self.max_value)
+        return float(int(v)) if self.integer else v
+
+
+class ControlRuntime:
+    """One per process: owns the registered governors, the global actuation
+    budget, the action log, and the sentinel trigger → governor map."""
+
+    def __init__(self, *, budget: int = 64, recorder=None,
+                 limits: ControlLimits | None = None):
+        if budget < 1:
+            raise ValueError(f"control budget must be >= 1, got {budget}")
+        self.budget = int(budget)
+        self.recorder = recorder  # obs.FlightRecorder | None
+        self.limits = limits
+        self.governors: list[Any] = []
+        self._trigger_map: dict[str, Any] = {}
+        self.actions: list[ControlAction] = []  # applied only, bounded
+        self.actions_taken = 0
+        self._budget_warned = False
+        self._mu = threading.Lock()
+        # the nan-loss rollback controller is step-inline (the trainer
+        # consults it between the train step and the weight push), not a
+        # per-step governor — it hangs here so one handle owns the budget
+        self.nan: Any = None
+
+    # ------------------------------------------------------------ registry
+
+    def register(self, governor, *, triggers: tuple[str, ...] = ()) -> None:
+        self.governors.append(governor)
+        for trig in triggers:
+            self._trigger_map[trig] = governor
+
+    def governor(self, name: str):
+        for g in self.governors:
+            if getattr(g, "name", None) == name:
+                return g
+        return None
+
+    # ------------------------------------------------------------- budget
+
+    def budget_left(self) -> int:
+        with self._mu:
+            return max(self.budget - self.actions_taken, 0)
+
+    def _consume_budget(self) -> bool:
+        with self._mu:
+            if self.actions_taken >= self.budget:
+                telemetry.counter_add(CONTROL_BUDGET_EXHAUSTED)
+                if not self._budget_warned:
+                    self._budget_warned = True
+                    log.warning(
+                        "control actuation budget (%d) exhausted — every "
+                        "knob frozen at its current value for the rest of "
+                        "the run", self.budget,
+                    )
+                return False
+            self.actions_taken += 1
+            return True
+
+    # ------------------------------------------------------------- acting
+
+    def act(self, action: ControlAction,
+            apply: Callable[[], None] | None = None,
+            free: bool = False) -> bool:
+        """Apply one actuation under the global budget. Returns True when
+        the action was applied (False = budget-suppressed; the plant is
+        untouched). Every applied action is counted, ring-recorded, traced
+        as an instant, and logged in one line.
+
+        ``free=True`` bypasses the budget WITHOUT consuming it — reserved
+        for actions that restore the safe/default state (the shed
+        RELEASE): an exhausted budget must freeze knobs where they are,
+        never pin the system in a degraded mode it can no longer leave.
+        Free actions are bounded by the budgeted actions that created the
+        state they undo (a release per engage), so they cannot run away."""
+        if not free and not self._consume_budget():
+            return False
+        if apply is not None:
+            apply()
+        with self._mu:
+            self.actions.append(action)
+            if len(self.actions) > 4096:  # bounded in-memory log
+                del self.actions[:2048]
+        telemetry.counter_add(CONTROL_ACTIONS)
+        if action.new is not None:
+            telemetry.gauge_set(
+                f"{CONTROL_VALUE}/{action.actuator}", float(action.new)
+            )
+        telemetry.emit_instant(CONTROL_ACTION_INSTANT, **action.to_dict())
+        if self.recorder is not None:
+            self.recorder.record("control_action", action.to_dict())
+        log.warning(
+            "control action [%s] %s.%s %s -> %s at step %d (%s)",
+            action.kind, action.controller, action.actuator,
+            action.old, action.new, action.step, action.reason,
+        )
+        return True
+
+    def note_cooldown_skip(self) -> None:
+        telemetry.counter_add(CONTROL_COOLDOWN_SKIPS)
+
+    # -------------------------------------------------------------- steps
+
+    def on_step(self, step: int, metrics: Mapping[str, Any]) -> list[ControlAction]:
+        """One control pass over the step's metrics record — the trainer
+        calls this right after ``obs.on_step`` (the worker pump calls it
+        between generation rounds)."""
+        applied: list[ControlAction] = []
+        for gov in self.governors:
+            try:
+                applied.extend(gov.step(step, metrics, self) or ())
+            except Exception:  # noqa: BLE001 — a governor bug must degrade
+                # to "knob stays put", never take the training loop down
+                log.warning(
+                    "governor %s failed on step %d",
+                    getattr(gov, "name", gov), step, exc_info=True,
+                )
+        return applied
+
+    def on_trigger(self, trigger: str, step: int,
+                   extra: Mapping[str, Any] | None = None) -> bool:
+        """Sentinel trigger escalation (exactly once per trigger per run —
+        the Sentinel's own fire-once contract). Returns True when a
+        registered governor acted on it; False leaves the trigger
+        dump-only (the PR 8 contract for un-armed controllers)."""
+        gov = self._trigger_map.get(trigger)
+        if gov is None:
+            return False
+        telemetry.counter_add(CONTROL_TRIGGER_ESCALATIONS)
+        try:
+            return bool(gov.on_trigger(trigger, step, self, extra or {}))
+        except Exception:  # noqa: BLE001 — same degrade-don't-crash rule
+            log.warning(
+                "trigger escalation %r -> %s failed", trigger,
+                getattr(gov, "name", gov), exc_info=True,
+            )
+            return False
+
+
+def cooldown_ok(gov, step: int, runtime: ControlRuntime) -> bool:
+    """THE cooldown check, shared by every governor shape (the deadband
+    base below and the stateful shed/worker-health controllers): one
+    owner of the suppress-and-count semantics, so the governors cannot
+    drift apart. ``gov`` needs ``_last_action_step`` and
+    ``cooldown_steps``."""
+    if (
+        gov._last_action_step is not None
+        and step - gov._last_action_step < gov.cooldown_steps
+    ):
+        runtime.note_cooldown_skip()
+        return False
+    return True
+
+
+class Governor:
+    """Deadband + hysteresis + cooldown base for scalar-signal governors.
+
+    Subclasses implement :meth:`read` (the signal, or None when there is no
+    observation this step). Semantics per step:
+
+    * signal **above** ``high`` → shrink every actuator one step (subject
+      to the cooldown and the runtime budget); the regrow dwell resets.
+    * signal **below** ``low`` for ``dwell_steps`` consecutive
+      observations → regrow one step (the sustained-headroom dwell); the
+      dwell restarts after every regrow action.
+    * signal **inside** the deadband → hold (hysteresis: neither shrink
+      nor dwell credit), so a breach recovers to *stable*, not to the edge
+      of the next breach.
+    """
+
+    # escalation semantics for sentinel triggers: one immediate shrink
+    ESCALATE_KIND = "shrink"
+
+    def __init__(self, name: str, *, actuators: list[BoundedActuator],
+                 high: float, low: float, cooldown_steps: int = 2,
+                 dwell_steps: int = 3):
+        if low > high:
+            raise ValueError(
+                f"deadband low ({low}) must be <= high ({high})"
+            )
+        if cooldown_steps < 0 or dwell_steps < 1:
+            raise ValueError(
+                "cooldown_steps must be >= 0 and dwell_steps >= 1"
+            )
+        self.name = name
+        self.actuators = actuators
+        self.high = float(high)
+        self.low = float(low)
+        self.cooldown_steps = int(cooldown_steps)
+        self.dwell_steps = int(dwell_steps)
+        self._last_action_step: int | None = None
+        self._ok_run = 0
+        self.last_signal: float | None = None
+
+    # ------------------------------------------------------------- signal
+
+    def read(self, step: int, metrics: Mapping[str, Any]) -> float | None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ helpers
+
+    def _cooled(self, step: int, runtime: ControlRuntime) -> bool:
+        return cooldown_ok(self, step, runtime)
+
+    def _move(self, step: int, runtime: ControlRuntime, kind: str,
+              reason: str, trigger: str | None = None) -> list[ControlAction]:
+        """One shrink/regrow pass over every actuator (they move in
+        lockstep — a governor's knobs express one decision, so the move
+        is all-or-nothing: a budget that cannot cover every pending knob
+        applies NONE of them, rather than leaving the knobs permanently
+        diverged when the exhausted budget then freezes everything)."""
+        moves: list[tuple[BoundedActuator, float]] = []
+        for act in self.actuators:
+            fn = act.shrink if kind == "shrink" else act.regrow
+            new = act.clamp(fn(act.value))
+            if new != act.value:  # at the clamp already = no move needed
+                moves.append((act, new))
+        if not moves:
+            return []
+        if runtime.budget_left() < len(moves):
+            telemetry.counter_add(CONTROL_BUDGET_EXHAUSTED)
+            return []
+        applied: list[ControlAction] = []
+        for act, new in moves:
+            action = ControlAction(
+                step=step, controller=self.name, actuator=act.name,
+                kind=kind, old=act.value, new=new, reason=reason,
+                trigger=trigger,
+            )
+            old = act.value
+
+            def push(act=act, new=new):
+                act.value = new
+                act.apply(new)
+
+            if runtime.act(action, apply=push):
+                applied.append(action)
+            else:
+                # cannot happen single-threaded (the reservation above);
+                # defensive against a concurrent budget consumer
+                act.value = old
+                break
+        if applied:
+            self._last_action_step = step
+            self._ok_run = 0
+        return applied
+
+    # --------------------------------------------------------------- step
+
+    def step(self, step: int, metrics: Mapping[str, Any],
+             runtime: ControlRuntime) -> list[ControlAction]:
+        v = self.read(step, metrics)
+        if v is None:
+            return []
+        self.last_signal = v
+        if v > self.high:
+            self._ok_run = 0
+            if not self._cooled(step, runtime):
+                return []
+            return self._move(
+                step, runtime, "shrink",
+                f"signal {v:.4g} > high {self.high:.4g}",
+            )
+        if v < self.low:
+            self._ok_run += 1
+            if self._ok_run < self.dwell_steps:
+                return []
+            if any(a.value < a.max_value for a in self.actuators):
+                if not self._cooled(step, runtime):
+                    return []
+                return self._move(
+                    step, runtime, "regrow",
+                    f"signal {v:.4g} < low {self.low:.4g} for "
+                    f"{self._ok_run} steps (dwell {self.dwell_steps})",
+                )
+            return []
+        # inside the deadband: hysteresis hold — no shrink, no dwell credit
+        self._ok_run = 0
+        return []
+
+    def on_trigger(self, trigger: str, step: int, runtime: ControlRuntime,
+                   extra: Mapping[str, Any]) -> bool:
+        """Sentinel escalation: one immediate bounded shrink, still subject
+        to the cooldown and the budget (an escalation is urgent, not
+        exempt)."""
+        self._ok_run = 0
+        if not self._cooled(step, runtime):
+            return False
+        return bool(self._move(
+            step, runtime, self.ESCALATE_KIND,
+            f"sentinel trigger {trigger!r}", trigger=trigger,
+        ))

@@ -538,6 +538,53 @@ class DriverClient:
 
     # ---------------------------------------------------------------- health
 
+    def quarantine_worker(self, address, *, min_healthy: int = 1) -> bool:
+        """Proactive demotion (ISSUE 14 worker-health controller): close a
+        live-but-regressing worker's connection and mark it unhealthy so
+        dispatches route around it; the rejoin loop then PING-probes the
+        address with the policy backoff and re-admits it cold — the same
+        recovery path a crashed worker takes, entered deliberately.
+
+        Refuses (returns False) when the worker is unknown or already
+        unhealthy, when demoting it would leave fewer than ``min_healthy``
+        healthy workers (a controller must degrade capacity, never zero
+        it), or when no rejoin loop is running (the quarantine would be
+        permanent — that is a kill, not a control action)."""
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        else:
+            address = (address[0], int(address[1]))
+        if self._rejoin_thread is None:
+            log.warning(
+                "refusing to quarantine %s: worker_rejoin is off, so the "
+                "worker could never be re-admitted", address,
+            )
+            return False
+        with self._workers_mu:
+            target = next(
+                (w for w in self._workers if w.address == address), None
+            )
+            if target is None or not target.healthy:
+                return False
+            healthy = sum(w.healthy for w in self._workers)
+            if healthy - 1 < max(int(min_healthy), 1):
+                log.warning(
+                    "refusing to quarantine %s: only %d healthy worker(s) "
+                    "remain (min_healthy=%d)", address, healthy, min_healthy,
+                )
+                return False
+            conn = target.conn
+        # demote OUTSIDE the mutex via the standard path (it re-takes the
+        # lock and applies the conn-identity guard against a racing rejoin)
+        self._mark_unhealthy(target, conn)
+        telemetry.counter_add(resilience.CP_QUARANTINES)
+        log.warning(
+            "worker %s:%d quarantined (proactive); rejoin loop will probe "
+            "and re-admit", *address,
+        )
+        return True
+
     def ping_all(self, timeout_ms: int = 5000) -> list[bool]:
         """Health check every worker — one thread per worker, so a single
         hung worker costs the sweep ONE ``timeout_ms``, not one per victim

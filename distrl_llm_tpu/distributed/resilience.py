@@ -53,6 +53,10 @@ CP_RETRIES = "cp/retries"
 CP_POISON_SHARDS = "cp/poison_shards"
 CP_DEGRADED_GROUPS = "cp/degraded_groups"
 CP_REJOIN_EPOCH = "cp/rejoin_epoch"  # gauge: bumps per re-admit
+# proactive health demotions (ISSUE 14 worker-health controller): the
+# worker was alive but regressing, so the controller quarantined it and
+# left the rejoin loop to probe + re-admit
+CP_QUARANTINES = "cp/quarantines"
 # ---- weight bus (weight_bus.py, ISSUE 9) ----
 CP_DISPATCH_BYTES = "cp/dispatch_bytes"        # counter: MSG_DISPATCH payload bytes
 CP_WEIGHT_BYTES = "cp/weight_bytes_sent"       # counter: MSG_WEIGHTS payload bytes
@@ -207,6 +211,12 @@ class _Rule:
     action: str              # "delay" | "drop" | "close" | "error"
     arg: float | None = None  # delay seconds
     prob: float | None = None
+    # channel selector (ISSUE 14 satellite): None matches every connection
+    # (the historical process-global schedule); a named channel matches
+    # only connections wrapped with that channel — "weights" targets the
+    # weight bus's out-of-band MSG_WEIGHTS connections independently of
+    # the "dispatch" control-plane connections, with its own call counter
+    channel: str | None = None
 
 
 def _parse_schedule(spec: str) -> tuple[int, list[_Rule]]:
@@ -215,10 +225,18 @@ def _parse_schedule(spec: str) -> tuple[int, list[_Rule]]:
         seed=SEED
         OP:N=ACTION            # the Nth OP call (1-based) takes ACTION
         OP:*=ACTION@P          # every OP call takes ACTION with prob P
+        CHANNEL.OP:N=ACTION    # the Nth OP call ON THAT CHANNEL only
+        CHANNEL.OP:*=ACTION@P  # per-channel probabilistic rule
 
-    where OP is ``send``/``recv`` and ACTION is ``drop`` | ``close`` |
-    ``error`` | ``delay:SECONDS``. Example:
-    ``"seed=7;recv:3=close;send:*=delay:0.05@0.2"``.
+    where OP is ``send``/``recv``, ACTION is ``drop`` | ``close`` |
+    ``error`` | ``delay:SECONDS``, and CHANNEL names a connection class —
+    ``dispatch`` (control-plane RPC, the default every unprefixed rule
+    also matches) or ``weights`` (the weight bus's out-of-band
+    MSG_WEIGHTS connections, ISSUE 9). Channel-scoped rules advance a
+    per-channel call counter, so a ``weights.send:2=close`` fires on the
+    second weight-bus send regardless of how many dispatch frames
+    interleave. Example:
+    ``"seed=7;recv:3=close;weights.send:2=close;send:*=delay:0.05@0.2"``.
     """
     seed = 0
     rules: list[_Rule] = []
@@ -233,6 +251,13 @@ def _parse_schedule(spec: str) -> tuple[int, list[_Rule]]:
             lhs, rhs = item.split("=", 1)
             op, idx = lhs.split(":", 1)
             op = op.strip()
+            channel = None
+            if "." in op:
+                channel, _, op = op.partition(".")
+                channel = channel.strip()
+                op = op.strip()
+                if not channel:
+                    raise ValueError("empty channel selector")
             if op not in ("send", "recv"):
                 raise ValueError(f"op must be send/recv, got {op!r}")
             prob = None
@@ -251,7 +276,7 @@ def _parse_schedule(spec: str) -> tuple[int, list[_Rule]]:
             index = None if idx.strip() == "*" else int(idx)
             if index is None and prob is None:
                 raise ValueError("wildcard rules need a probability (@P)")
-            rules.append(_Rule(op, index, action, arg, prob))
+            rules.append(_Rule(op, index, action, arg, prob, channel))
         except ValueError as e:
             raise ValueError(
                 f"bad fault-schedule item {item!r}: {e}"
@@ -264,9 +289,16 @@ class FaultInjector:
 
     One injector is installed process-wide (``install()`` or the
     ``DISTRL_FAULT_SCHEDULE`` env var) and every control-plane
-    :class:`Connection` is wrapped through it, so call counters are global:
-    the same schedule replayed against the same RPC sequence produces the
-    same event sequence (``events`` records it for assertions)."""
+    :class:`Connection` is wrapped through it. Unprefixed rules advance
+    process-global per-op counters (the historical contract: same schedule
+    + same RPC sequence → same event sequence); channel-scoped rules
+    (``weights.send:2=close``) advance per-channel counters, so a
+    weight-bus fault fires on the Nth WEIGHTS frame however many dispatch
+    frames interleave (ISSUE 14 satellite — PR 9's out-of-band connections
+    previously shared the global counters with no way to target them).
+    ``events`` records decisions for assertions: ``(op, n, action)`` for
+    global rules, ``("<channel>.<op>", n_channel, action)`` for scoped
+    ones."""
 
     def __init__(self, schedule: str = "", seed: int | None = None):
         sched_seed, self.rules = _parse_schedule(schedule)
@@ -274,9 +306,10 @@ class FaultInjector:
         self.seed = sched_seed if seed is None else seed
         self._rng = random.Random(self.seed)
         self._counts = {"send": 0, "recv": 0}
+        # per-(channel, op) counters for channel-scoped rules
+        self._chan_counts: dict[tuple[str, str], int] = {}
         self._mu = threading.Lock()
-        # (op, call_number, action) in decision order — the determinism
-        # contract: same schedule + same op sequence → identical list
+        # decision-order event log — the determinism contract above
         self.events: list[tuple[str, int, str]] = []
 
     @classmethod
@@ -284,26 +317,41 @@ class FaultInjector:
         spec = os.environ.get(FAULT_SCHEDULE_ENV, "")
         return cls(spec) if spec else None
 
-    def decide(self, op: str) -> tuple[str, float | None] | None:
-        """Advance the ``op`` counter and return (action, arg) when a rule
+    def decide(self, op: str,
+               channel: str = "dispatch") -> tuple[str, float | None] | None:
+        """Advance the counters and return (action, arg) when a rule
         fires, else None. Probabilistic rules draw from the seeded rng on
-        EVERY call (fired or not), keeping the stream deterministic."""
+        every MATCHING call (fired or not), keeping the stream
+        deterministic."""
         with self._mu:
             self._counts[op] += 1
             n = self._counts[op]
+            key = (channel, op)
+            n_chan = self._chan_counts.get(key, 0) + 1
+            self._chan_counts[key] = n_chan
             fired: tuple[str, float | None] | None = None
+            fired_scoped = False
             for r in self.rules:
                 if r.op != op:
                     continue
+                if r.channel is not None and r.channel != channel:
+                    continue
+                r_n = n if r.channel is None else n_chan
                 if r.index is not None:
-                    if r.index == n and fired is None:
+                    if r.index == r_n and fired is None:
                         fired = (r.action, r.arg)
+                        fired_scoped = r.channel is not None
                 else:
                     draw = self._rng.random()
                     if draw < r.prob and fired is None:
                         fired = (r.action, r.arg)
+                        fired_scoped = r.channel is not None
             if fired is not None:
-                self.events.append((op, n, fired[0]))
+                self.events.append((
+                    f"{channel}.{op}" if fired_scoped else op,
+                    n_chan if fired_scoped else n,
+                    fired[0],
+                ))
             return fired
 
 
@@ -332,11 +380,15 @@ class FaultyConnection:
     Fault semantics: ``delay`` sleeps then forwards; ``drop`` discards the
     frame (send: pretend-ok; recv: consume and report a timeout);
     ``close`` closes the underlying socket and raises WorkerDeadError;
-    ``error`` raises WorkerDeadError without closing."""
+    ``error`` raises WorkerDeadError without closing. ``channel`` names
+    the connection class for channel-scoped rules ("dispatch" by default;
+    the weight bus dials with "weights")."""
 
-    def __init__(self, inner, injector: FaultInjector):
+    def __init__(self, inner, injector: FaultInjector,
+                 channel: str = "dispatch"):
         self._inner = inner
         self._injector = injector
+        self.channel = channel
 
     @property
     def fd(self):
@@ -349,7 +401,7 @@ class FaultyConnection:
 
     def send(self, msg_type: int, req_id: int, payload: bytes = b"",
              timeout_ms: int = 30_000) -> None:
-        fault = self._injector.decide("send")
+        fault = self._injector.decide("send", self.channel)
         if fault is not None:
             action, arg = fault
             if action == "delay":
@@ -364,7 +416,7 @@ class FaultyConnection:
         self._inner.send(msg_type, req_id, payload, timeout_ms)
 
     def recv(self, timeout_ms: int):
-        fault = self._injector.decide("recv")
+        fault = self._injector.decide("recv", self.channel)
         if fault is not None:
             action, arg = fault
             if action == "delay":
@@ -385,11 +437,18 @@ class FaultyConnection:
         self._inner.close()
 
 
-def wrap_connection(conn):
+def wrap_connection(conn, channel: str = "dispatch"):
     """Wrap a Connection with the active injector, if any (no-op otherwise).
     Called at every control-plane connection creation point, driver and
-    worker side alike, so a schedule in the environment reaches both."""
+    worker side alike, so a schedule in the environment reaches both.
+    ``channel`` tags the connection class for channel-scoped rules: the
+    driver's weight bus dials its out-of-band connections with
+    ``channel="weights"`` so ``weights.*`` rules can fault MSG_WEIGHTS
+    traffic independently of dispatch traffic (worker-side ACCEPTED
+    connections serve both frame kinds on one socket and stay on the
+    default channel — the selector targets the driver side, where the
+    connections are distinct objects)."""
     injector = active_injector()
     if injector is None:
         return conn
-    return FaultyConnection(conn, injector)
+    return FaultyConnection(conn, injector, channel)

@@ -424,7 +424,10 @@ class WeightBus:
         )
         if fd < 0:
             raise OSError(f"cannot connect weight bus to {host}:{port}")
-        return resilience.wrap_connection(Connection(fd))
+        # channel-tagged for fault injection (ISSUE 14 satellite): a
+        # "weights.send:2=close" schedule faults the Nth WEIGHTS frame
+        # without perturbing the dispatch connections' counters
+        return resilience.wrap_connection(Connection(fd), channel="weights")
 
     def _next_id(self) -> int:
         with self._id_mu:

@@ -207,6 +207,50 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
     _ENGINE_STATE["adapter_cache"] = AdapterCache()
 
 
+def _init_control(args) -> None:
+    """Arm the worker-side control runtime (ISSUE 14): the engine-facing
+    governors — HBM admission governor and SLO load-shedder — act on THIS
+    worker's engine through its ControlLimits handle, pumped once per
+    generation round (the 'generate' handler). Driver-only controllers
+    (staleness, worker health, nan rollback) have no worker half.
+    The armed set was computed ONCE in main()'s validation pass
+    (args.control_hbm_armed / args.control_shed_armed) — one owner, so
+    validation and registration cannot drift apart."""
+    hbm = args.control_hbm_armed
+    shed = args.control_shed_armed
+    if not (hbm or shed):
+        return
+    from distrl_llm_tpu.control import (
+        ControlLimits, ControlRuntime, HbmGovernor, SloShedGovernor,
+    )
+
+    limits = ControlLimits()
+    _ENGINE_STATE["engine"].control_limits = limits
+    runtime = ControlRuntime(budget=args.control_budget, limits=limits)
+    if hbm:
+        runtime.register(
+            HbmGovernor(
+                limits,
+                cooldown_steps=args.control_cooldown_steps,
+                dwell_steps=args.control_dwell_steps,
+            ),
+            triggers=("hbm_breach",),
+        )
+    if shed:
+        runtime.register(
+            SloShedGovernor(
+                limits,
+                slo_ttft_ms=args.slo_ttft_ms,
+                slo_queue_wait_ms=args.slo_queue_wait_ms,
+                cooldown_steps=args.control_cooldown_steps,
+                dwell_steps=args.control_dwell_steps,
+            ),
+            triggers=("ttft_blowup", "queue_wait_blowup"),
+        )
+    _ENGINE_STATE["control"] = runtime
+    _ENGINE_STATE["control_step"] = 0
+
+
 def weights_handler(payload: bytes) -> bytes:
     """MSG_WEIGHTS frames (the driver's WeightBus): decode one versioned
     adapter update — delta against the cached base when the payload names
@@ -389,6 +433,18 @@ def handler(payload: bytes) -> bytes:
                 jax.random.PRNGKey(arg["rng_seed"]),
             )
             sp.set(tokens=int(result.lengths.sum()))
+        ctrl = _ENGINE_STATE.get("control")
+        if ctrl is not None:
+            # one control pass per generation round (ISSUE 14): read the
+            # round's windowed registry stats (serving latency maxes, …)
+            # and let the governors adjust the NEXT round's admission
+            # limits. metrics_snapshot is report-and-reset and nothing
+            # else consumes it worker-side (the obs blobs ride the
+            # non-destructive observe_snapshot)
+            _ENGINE_STATE["control_step"] += 1
+            ctrl.on_step(
+                _ENGINE_STATE["control_step"], telemetry.metrics_snapshot()
+            )
         return pickle.dumps({
             "tokens": result.tokens, "lengths": result.lengths,
             "logprobs": result.logprobs,
@@ -553,6 +609,48 @@ def main(argv: list[str] | None = None) -> None:
                              "piggyback the registry snapshot on RPC "
                              "results for the driver's fleet aggregator "
                              "(snapshot-only export also via DISTRL_OBS=1)")
+    parser.add_argument("--control", action="store_true",
+                        help="self-healing runtime (ISSUE 14): arm every "
+                             "engine-facing controller this worker's shape "
+                             "supports (HBM admission governor; SLO "
+                             "load-shedder when an --slo-* limit is set), "
+                             "pumped once per generation round")
+    parser.add_argument("--control-hbm", dest="control_hbm",
+                        action="store_true",
+                        help="HBM governor only: shrink this worker's "
+                             "continuous-admission chain cap under "
+                             "watermark pressure, regrow after a "
+                             "sustained-headroom dwell (requires "
+                             "--continuous-admission)")
+    parser.add_argument("--control-shed", dest="control_shed",
+                        action="store_true",
+                        help="SLO load-shedder only: throttle this "
+                             "worker's group admission (decline reason "
+                             "'shed') while its serving TTFT/queue-wait "
+                             "breach the --slo-* limits (requires "
+                             "--continuous-admission and an SLO)")
+    parser.add_argument("--control-budget", dest="control_budget",
+                        type=int, default=64,
+                        help="global actuation budget per run; once spent "
+                             "every controller knob freezes")
+    parser.add_argument("--control-cooldown-steps",
+                        dest="control_cooldown_steps", type=int, default=2,
+                        help="minimum rounds between two actions of one "
+                             "governor")
+    parser.add_argument("--control-dwell-steps",
+                        dest="control_dwell_steps", type=int, default=3,
+                        help="consecutive healthy rounds before a governor "
+                             "regrows a shrunk knob")
+    parser.add_argument("--slo-ttft-ms", dest="slo_ttft_ms", type=float,
+                        default=None,
+                        help="time-to-first-token SLO for this worker's "
+                             "SLO load-shedder (requires --control-shed "
+                             "or --control; driver-side the same flag "
+                             "additionally arms the sentinel trigger)")
+    parser.add_argument("--slo-queue-wait-ms", dest="slo_queue_wait_ms",
+                        type=float, default=None,
+                        help="queue-wait SLO for this worker's SLO "
+                             "load-shedder")
     parser.add_argument("--fault-schedule", type=str, default=None,
                         help="deterministic fault-injection schedule for "
                              "this worker's connections (resilience."
@@ -619,6 +717,58 @@ def main(argv: list[str] | None = None) -> None:
             "--serving-obs/--serving-dir require --scheduler refill "
             "(the refill scheduler hosts the instrumented admission loop)"
         )
+    # self-healing runtime (ISSUE 14): worker-side parity for the
+    # engine-facing controllers — same dead-flag policy as the driver
+    if args.control_hbm and not (
+        args.scheduler == "refill" and args.continuous_admission
+    ):
+        parser.error(
+            "--control-hbm requires --scheduler refill with "
+            "--continuous-admission (the chain cap it actuates)"
+        )
+    if args.control_shed:
+        if not (args.scheduler == "refill" and args.continuous_admission):
+            parser.error(
+                "--control-shed requires --scheduler refill with "
+                "--continuous-admission (the admission queue it throttles)"
+            )
+        if args.slo_ttft_ms is None and args.slo_queue_wait_ms is None:
+            parser.error(
+                "--control-shed needs an SLO to steer on "
+                "(--slo-ttft-ms / --slo-queue-wait-ms)"
+            )
+    if args.control_budget < 1:
+        # fail at the parser like the driver (TrainConfig validates the
+        # same bound) — not as a post-model-load ValueError traceback
+        parser.error("--control-budget must be >= 1")
+    if args.control_cooldown_steps < 0:
+        parser.error("--control-cooldown-steps must be >= 0")
+    if args.control_dwell_steps < 1:
+        parser.error("--control-dwell-steps must be >= 1")
+    # the armed set, computed ONCE (the single owner _init_control reads):
+    # validation below and governor registration can never drift apart
+    args.control_hbm_armed = args.control_hbm or (
+        args.control and args.continuous_admission
+    )
+    args.control_shed_armed = args.control_shed or (
+        args.control and args.continuous_admission
+        and (args.slo_ttft_ms is not None
+             or args.slo_queue_wait_ms is not None)
+    )
+    if (
+        args.slo_ttft_ms is not None or args.slo_queue_wait_ms is not None
+    ) and not args.control_shed_armed:
+        parser.error(
+            "--slo-ttft-ms/--slo-queue-wait-ms feed the worker-side SLO "
+            "load-shedder — arm it with --control-shed (or --control on "
+            "a --continuous-admission worker); they would be silently "
+            "ignored"
+        )
+    if args.control_shed_armed and not args.serving_obs:
+        # the shedder steers on serving/* latency the ledger produces —
+        # an SLO is an unambiguous ask, arm the measurement (the
+        # driver-side slo_* precedent)
+        args.serving_obs = True
 
     if args.serve_model:
         _init_engine(
@@ -639,6 +789,7 @@ def main(argv: list[str] | None = None) -> None:
             serving_obs=args.serving_obs, serving_dir=args.serving_dir,
             serving_ring=args.serving_ring,
         )
+        _init_control(args)
 
     import signal
 

@@ -42,6 +42,7 @@ import numpy as np
 
 from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.control.governor import CONTROL_SHED_GROUPS
 from distrl_llm_tpu.engine.engine import (
     GenerationResult,
     LoraMailbox,
@@ -1333,6 +1334,14 @@ class PagedGenerationEngine(LoraMailbox):
         # byte-identity pins are untouched (the ledger observes, never
         # schedules)
         self.serving_ledger: Any = None
+        # closed-loop admission limits (ISSUE 14): when an owner (trainer
+        # --control, worker --control, bench control A/B rows) attaches a
+        # control.ControlLimits here, the continuous-admission loop
+        # consults it — the HBM governor's chain-cap scale and the SLO
+        # shedder's shed gate. None = one attribute check per admission
+        # pass; a handle at its defaults makes byte-identical decisions
+        # (pinned in tests/test_control.py)
+        self.control_limits: Any = None
         # per-round speculative stats (refill spec rounds only): drafter,
         # realized accept rate, tokens/verify-step, emit histogram, verify
         # kernel choice + grid steps, draft/target version bookkeeping
@@ -1687,6 +1696,11 @@ class PagedGenerationEngine(LoraMailbox):
         # ledger observes, it never changes a scheduling decision
         sl = self.serving_ledger
         suid: dict[int, int] = {}  # group -> serving-record uid
+        # closed-loop admission limits (ISSUE 14): one attribute read per
+        # round when unarmed; armed, admit_groups consults the governors'
+        # chain-cap scale and shed gate at its existing decision points —
+        # a handle at its defaults decides identically to None (pinned)
+        limits = self.control_limits
         t_enqueue = time.time()
 
         real_len_h = np.asarray(prompt_mask).sum(axis=-1).astype(np.int64)
@@ -1995,6 +2009,7 @@ class PagedGenerationEngine(LoraMailbox):
         backfill_admits = 0
         boundary_admits = 0  # admissions (slots + prefills) this host pass
         fill_declined: str | None = None  # fill_idle's head-of-line decline
+        shed_groups_seen: set[int] = set()  # groups the shedder deferred
         dispatched = 0
         host_cand = np.full(r_slots, total, np.int64)  # device `cand` mirror
         epoch = np.zeros(r_slots, np.int64)
@@ -2061,11 +2076,30 @@ class PagedGenerationEngine(LoraMailbox):
             one prefetched chain beyond the slots' worst-case group spread
             (the worst_pool sizing above). Returns the head group's decline
             reason when the queue is left waiting (the admission audit's
-            attribution, ISSUE 13), None when the queue drained."""
+            attribution, ISSUE 13), None when the queue drained.
+
+            Control hooks (ISSUE 14): an armed SLO shedder declines new
+            GROUP admissions with the ``shed`` reason — but only while the
+            engine has live work to drain (shedding an otherwise-empty
+            engine would wedge it, not protect it); the HBM governor's
+            admission fraction scales the live-chain cap."""
             while group_queue:
+                if limits is not None and limits.shed_active() and (
+                    pending or bool((host_cand < total).any())
+                ):
+                    g = group_queue[0]
+                    if g not in shed_groups_seen:
+                        # counted once per deferred group, however many
+                        # passes decline it (the bench row's shed_groups)
+                        shed_groups_seen.add(g)
+                        telemetry.counter_add(CONTROL_SHED_GROUPS)
+                    return "shed"
                 if len(pending) >= r_slots:
                     return "no_slots"
-                if len(pool.chains) >= r_slots + 1:
+                cap = r_slots + 1
+                if limits is not None:
+                    cap = limits.chain_cap(cap)
+                if len(pool.chains) >= cap:
                     return "chain_cap"
                 g = group_queue[0]
                 n_chain = max(-(-int(real_len_h[g]) // ps), 1)
@@ -2590,6 +2624,12 @@ class PagedGenerationEngine(LoraMailbox):
             ),
             "backfill_admissions": backfill_admits,
             "groups_prefilled": groups_prefilled if continuous else None,
+            # closed-loop control self-description (ISSUE 14): how many
+            # groups the SLO shedder deferred at least once this round
+            # (None = no ControlLimits attached, the controllers-off row)
+            "shed_groups": (
+                len(shed_groups_seen) if limits is not None else None
+            ),
             "slot_idle_frac": (
                 round(1.0 - alive_h / (r_slots * dispatched), 4)
                 if dispatched else None

@@ -139,6 +139,8 @@ class LineageLedger:
         self.closed_groups = 0
         self.admitted = 0
         self.dropped = 0
+        # nan-loss rollbacks (ISSUE 14): [{step, restored_version, ts}]
+        self.rollbacks: list[dict[str, Any]] = []
 
     # ------------------------------------------------------------- plumbing
 
@@ -394,6 +396,24 @@ class LineageLedger:
                 telemetry.hist_observe(
                     POLICY_LAG_MS, lag_ms, trace_sample=True
                 )
+
+    def on_rollback(self, *, step: int, restored_version: int,
+                    ts: float | None = None) -> None:
+        """Record a nan-loss rollback (ISSUE 14): at optimizer step
+        ``step`` the learner discarded a poisoned update and restored
+        ``restored_version`` — the poisoned step never became a weight
+        version, so the version lineage stays gapless by construction and
+        this line is the durable record of why. Kept in ``rollbacks`` for
+        reports/smokes and streamed immediately (``kind: "rollback"``)."""
+        ts = time.time() if ts is None else ts
+        with self._mu:
+            entry = {
+                "step": int(step),
+                "restored_version": int(restored_version),
+                "ts": ts,
+            }
+            self.rollbacks.append(entry)
+            self._write({"kind": "rollback", **entry})
 
     def note_first_sample(self, version: int | None,
                           ts: float | None = None) -> None:

@@ -512,6 +512,12 @@ class FleetAggregator:
                 total_tokens += cumulative
                 per_worker[self._addr(track)] = {
                     "gen_tokens": cumulative, "ts": ts,
+                    # incarnation id (ISSUE 14): the cumulative total
+                    # NEVER regresses across restarts (by design, above),
+                    # so a consumer tracking rates — the worker-health
+                    # governor — needs the pid to reset its marks at the
+                    # exact restart instead of judging the stall window
+                    "pid": pid,
                 }
             # fleet-wide serving view (ISSUE 13): fold the workers'
             # serving/* histogram summaries and admission-stall counters
@@ -644,11 +650,20 @@ class Sentinel:
       or the fleet-folded worker max) above the configured SLO
       (``slo_ttft_ms`` / ``slo_queue_wait_ms``; None = trigger unarmed).
 
-    ``DISTRL_SENTINEL_INJECT="nan_loss:3"`` deterministically injects a
-    NaN loss at step 3 — the seeded fault the obs smoke/tests use to prove
-    exactly one incident bundle appears; ``ttft_blowup:<step>`` /
-    ``queue_wait_blowup:<step>`` inject an SLO breach the same way (legal
-    only with the matching SLO armed — injecting an unarmed trigger would
+    ``DISTRL_SENTINEL_INJECT="<trigger>:<step>"`` deterministically
+    injects any trigger's precondition at the named step — the seeded
+    faults the obs/control smokes and chaos gates build on (ISSUE 14
+    closed the parse-time asymmetry that rejected ``reward_collapse``,
+    ``staleness_blowup`` and ``hbm_breach``): ``nan_loss`` fakes a NaN
+    loss, ``tok_s_regression`` a zero-throughput step,
+    ``reward_collapse`` a sustained zero-reward run (from the named step
+    until the trigger fires, with the had-been-positive precondition
+    seeded), ``staleness_blowup`` a staleness reading past the armed
+    bound (async mode only), ``hbm_breach`` a one-step synthetic
+    watermark breach (the single-step twin of ``DISTRL_OBS_FAKE_HBM``,
+    which fakes *sustained* pressure for the HBM governor), and
+    ``ttft_blowup`` / ``queue_wait_blowup`` an SLO breach (legal only
+    with the matching SLO armed — injecting an unarmable trigger would
     make a CI gate built on it pass vacuously).
     """
 
@@ -672,6 +687,12 @@ class Sentinel:
         self.slo_queue_wait_ms = slo_queue_wait_ms
         self.capture_steps = capture_steps
         self.fired: set[str] = set()
+        # trigger escalation hook (ISSUE 14): the trainer points this at
+        # ControlRuntime.on_trigger so a fired trigger can ACT (shrink the
+        # admission cap, engage shedding, quarantine, …) instead of only
+        # dumping. None — or a runtime with no governor registered for the
+        # trigger — preserves the PR 8 dump-only contract exactly.
+        self.on_trigger: Callable[[str, int, Mapping[str, Any]], Any] | None = None
         self._tok_ema: float | None = None
         self._tok_obs = 0
         self._seen_reward = False
@@ -682,14 +703,20 @@ class Sentinel:
             try:
                 trig, _, at = spec.partition(":")
                 trig = trig.strip()
-                # only triggers with an implemented injection are legal —
-                # accepting (say) hbm_breach:3 here and never firing would
-                # make a CI gate built on it pass vacuously
+                # every Sentinel trigger is injectable (ISSUE 14 closed the
+                # parse-time asymmetry: reward_collapse / staleness_blowup /
+                # hbm_breach were valid triggers but rejected here, making
+                # chaos gates for them impossible); the guard now only
+                # rejects triggers whose ARMING precondition is absent —
+                # accepting those and never firing would make a CI gate
+                # built on them pass vacuously
                 if trig not in ("nan_loss", "tok_s_regression",
+                                "reward_collapse", "staleness_blowup",
+                                "hbm_breach",
                                 "ttft_blowup", "queue_wait_blowup"):
                     raise ValueError(trig)
-                # same vacuous-gate guard for the SLO triggers: without
-                # the matching SLO there is no threshold to breach
+                # vacuous-gate guards: without the matching limit there is
+                # no threshold to breach
                 if trig == "ttft_blowup" and slo_ttft_ms is None:
                     raise ValueError("ttft_blowup needs slo_ttft_ms")
                 if (trig == "queue_wait_blowup"
@@ -697,13 +724,21 @@ class Sentinel:
                     raise ValueError(
                         "queue_wait_blowup needs slo_queue_wait_ms"
                     )
+                if trig == "staleness_blowup" and staleness_limit is None:
+                    raise ValueError(
+                        "staleness_blowup needs a staleness limit "
+                        "(async mode)"
+                    )
                 self._inject = (trig, int(at))
             except ValueError:
                 log.warning(
                     "ignoring DISTRL_SENTINEL_INJECT=%r (expected "
-                    "'nan_loss:<step>', 'tok_s_regression:<step>', "
-                    "'ttft_blowup:<step>' or 'queue_wait_blowup:<step>', "
-                    "the SLO triggers only with their slo_* limit armed)",
+                    "'<trigger>:<step>' where <trigger> is one of "
+                    "nan_loss, tok_s_regression, reward_collapse, "
+                    "staleness_blowup, hbm_breach, ttft_blowup or "
+                    "queue_wait_blowup; staleness_blowup only in async "
+                    "mode, the SLO triggers only with their slo_* limit "
+                    "armed)",
                     spec,
                 )
 
@@ -729,18 +764,47 @@ class Sentinel:
             # window) makes this a counted no-op, never a second
             # start_trace mid-run
             self.profiler.request_capture(self.capture_steps)
+        hook = self.on_trigger
+        if hook is not None:
+            # trigger → action escalation (ISSUE 14): exactly once per
+            # trigger per run (this method's own fire-once contract); a
+            # runtime with no governor for the trigger returns without
+            # acting — the dump above already happened either way, so an
+            # un-armed controller leaves the trigger dump-only
+            try:
+                hook(trigger, step, dict(extra) if extra else {})
+            except Exception:  # noqa: BLE001 — an escalation bug must not
+                # suppress the incident path that just produced evidence
+                log.warning(
+                    "control escalation for trigger %r failed", trigger,
+                    exc_info=True,
+                )
         return True
 
     def check(self, step: int, metrics: Mapping[str, Any], *,
               config: Mapping[str, Any] | None = None,
               plan: Mapping[str, Any] | None = None) -> list[str]:
         m = dict(metrics)
+        forced_hbm: dict[str, float] | None = None
         if self._inject is not None and self._inject[1] == step:
             trig = self._inject[0]
             if trig == "nan_loss":
                 m["loss"] = float("nan")
             elif trig == "tok_s_regression":
                 m["engine/decode_tok_s"] = 0.0
+            elif trig == "staleness_blowup":
+                # parse-time guard ensures staleness_limit is armed
+                m["rollout/staleness_max"] = float(self.staleness_limit) + 1.0
+            elif trig == "hbm_breach":
+                # synthesize a one-step breach for the HBM check below —
+                # the single-step twin of the DISTRL_OBS_FAKE_HBM hook
+                # (which fakes SUSTAINED pressure for the governor gates;
+                # this injection proves the trigger itself fires)
+                forced_hbm = {
+                    "bytes_limit": 1.0,
+                    "peak_bytes_in_use": 1.0,
+                    "bytes_in_use": 1.0,
+                }
             elif trig == "ttft_blowup":
                 # parse-time guard ensures slo_ttft_ms is armed
                 m[SERVING_TTFT_MS + "_max"] = 1000.0 * self.slo_ttft_ms
@@ -748,6 +812,18 @@ class Sentinel:
                 m[SERVING_QUEUE_WAIT_MS + "_max"] = (
                     1000.0 * self.slo_queue_wait_ms
                 )
+        if (
+            self._inject is not None
+            and self._inject[0] == "reward_collapse"
+            and step >= self._inject[1]
+            and "reward_collapse" not in self.fired
+        ):
+            # reward collapse is a RUN of zero-reward steps after reward
+            # had been positive: inject the whole run (zero reward from
+            # the named step until the trigger fires), with the
+            # had-been-positive precondition seeded too
+            self._seen_reward = True
+            m["mean_accuracy_reward"] = 0.0
         fired: list[str] = []
 
         def fire(trigger: str, **extra) -> None:
@@ -824,7 +900,7 @@ class Sentinel:
                     observed_ms=round(max(observed), 3), slo_ms=slo,
                 )
         # --- HBM watermark breach
-        stats = hbm_stats()
+        stats = forced_hbm if forced_hbm is not None else hbm_stats()
         if stats and stats.get("bytes_limit"):
             peak = float(
                 stats.get("peak_bytes_in_use")

@@ -161,6 +161,33 @@ class TrajectoryBuffer:
             self._not_empty.notify_all()
             return True
 
+    def set_watermarks(self, high: int, low: int | None = None) -> None:
+        """Retune the backpressure watermarks at runtime (ISSUE 14: the
+        staleness governor shrinks the high watermark under policy-lag
+        pressure and regrows it on sustained headroom). Same validation as
+        construction; ``low`` defaults to ``high // 2``. The gate is
+        recomputed immediately: a shrink below the current occupancy gates
+        producers now, a regrow past it releases them."""
+        high = int(high)
+        low = max(high // 2, 1) if low is None else int(low)
+        if not 0 < high <= self.capacity:
+            raise ValueError(
+                f"high_watermark must be in (0, capacity={self.capacity}], "
+                f"got {high}"
+            )
+        if not 0 < low <= high:
+            raise ValueError(
+                f"low_watermark must be in (0, high_watermark={high}], "
+                f"got {low}"
+            )
+        with self._mu:
+            self.high_watermark = high
+            self.low_watermark = low
+            if len(self._q) >= high:
+                self._gated = True
+            else:
+                self._maybe_open_gate_locked()
+
     def close(self) -> None:
         """No more puts; blocked getters drain the remainder then get []."""
         with self._mu:

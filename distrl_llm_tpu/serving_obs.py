@@ -95,8 +95,11 @@ FLEET_SERVING_QUEUE_WAIT_MAX_MS = "fleet/serving_queue_wait_ms_max"
 FLEET_SERVING_STALLS = "fleet/serving_admission_stalls"
 
 # the complete decline-reason vocabulary (the admission audit's contract:
-# every declined pass carries exactly one of these)
-STALL_REASONS = ("no_slots", "no_pages", "chain_cap", "budget_wedge")
+# every declined pass carries exactly one of these). "shed" is the ISSUE 14
+# SLO load-shedder's reason: the controller, not the pool, deferred the
+# head group — the conservation sum(stalls) == declined_passes holds with
+# controllers on or off
+STALL_REASONS = ("no_slots", "no_pages", "chain_cap", "budget_wedge", "shed")
 
 # closed-value window per metric for percentile queries (bench rows, the
 # smoke): bounds host memory on a long-running server; counts/sums in the

@@ -176,6 +176,25 @@ def current_trace_context() -> dict | None:
     return getattr(_TLS, "ctx", None)
 
 
+def emit_instant(name: str, **args) -> None:
+    """Perfetto instant event ('i' phase, thread scope) — a point-in-time
+    marker with args. The control plane's governors stamp every actuation
+    with one (ISSUE 14) so ``tools/trace_report.py`` can render a
+    "control:" section from the trace file alone. No-op while tracing is
+    off (one attribute read)."""
+    st = _STATE
+    if not st.enabled:
+        return
+    st.events.append({
+        "ph": "i",
+        "s": "t",
+        "name": name,
+        "ts": time.time_ns() // 1000,
+        "tid": threading.get_ident(),
+        "args": args,
+    })
+
+
 def emit_flow_start(dispatch_id: int) -> None:
     """Driver-side flow-origin event: emitted INSIDE the ``cp/dispatch`` /
     ``cp/weight_push`` span so Perfetto anchors the arrow to that slice;

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import math
 import os
 import time
 from functools import partial
@@ -415,12 +416,60 @@ class Trainer:
                     type(engine).__name__,
                 )
 
+        # self-healing control runtime (distrl_llm_tpu/control/, ISSUE 14):
+        # bounded governors acting on the signals the obs plane measures.
+        # None unless a --control flag armed one; a run with controllers
+        # off is byte-identical to HEAD (the engine hook is a None check).
+        self.control: Any = None
+        if config.armed_controllers():
+            from distrl_llm_tpu.control import build_runtime, injected_nan_step
+
+            self.control = build_runtime(
+                config,
+                engine=engine,
+                recorder=(
+                    self.obs.recorder if self.obs is not None else None
+                ),
+                driver=(
+                    getattr(engine, "driver", None)
+                    if getattr(engine, "is_remote", False) else None
+                ),
+                fleet_provider=(
+                    self.obs.fleet.refresh
+                    if self.obs is not None and self.obs.fleet is not None
+                    else None
+                ),
+            )
+            if (
+                self.control is not None and self.obs is not None
+                and self.obs.sentinel is not None
+            ):
+                # trigger → action escalation: a fired sentinel trigger
+                # reaches its governor exactly once; triggers without a
+                # registered governor stay dump-only (the PR 8 contract)
+                self.obs.sentinel.on_trigger = self.control.on_trigger
+        # seeded chaos hook for the rollback gate (control_smoke): poison
+        # the REALIZED loss at the named step — honored only with the
+        # rollback controller armed, so the env can never corrupt a
+        # controller-less run
+        self._inject_nan_step = (
+            injected_nan_step()
+            if self.control is not None and self.control.nan is not None
+            else None
+        )
+
         self.ckpt: CheckpointManager | None = None
         if config.checkpoint_dir:
             self.ckpt = CheckpointManager(config.checkpoint_dir)
             if config.resume:
                 self._try_resume()
         self._push_weights()
+        if self.control is not None and self.control.nan is not None:
+            # the pre-step state is the first "last good" snapshot: a nan
+            # on the very first optimizer step rolls back to initialization
+            self.control.nan.note_good(
+                self.weight_version, self.lora, self.opt_state
+            )
 
     # ------------------------------------------------------------------ setup
 
@@ -1329,6 +1378,13 @@ class Trainer:
         self._rollout_buffer = buffer
         self._staleness_policy = policy
         self._rollout_dropped_stale = 0
+        if self.control is not None:
+            # staleness governor (ISSUE 14): its plant — the admission
+            # policy and the buffer watermarks — exists only now; no-op
+            # unless the controller is armed
+            from distrl_llm_tpu.control import attach_staleness
+
+            attach_staleness(self.control, cfg, policy, buffer)
 
         start_episode, start_batch = self.episode, self.batch_in_episode
         restored = getattr(self, "_resume_rollout_state", None)
@@ -1401,8 +1457,12 @@ class Trainer:
                 # refills with usable data while this update runs. NOT in
                 # downweight mode: there admission trains beyond-K groups
                 # at reduced weight, so evicting them here would silently
-                # turn downweight into drop
-                buffer.evict_stale(self.weight_version, cfg.max_staleness)
+                # turn downweight into drop. The EFFECTIVE bound is the
+                # policy's (the staleness governor may have shrunk it —
+                # identical to cfg.max_staleness with controllers off)
+                buffer.evict_stale(
+                    self.weight_version, policy.max_staleness
+                )
             with timer("generation"):
                 # honest accounting: the learner's BLOCKED time waiting on
                 # the buffer (decoupling hides the rest of generation)
@@ -1554,10 +1614,51 @@ class Trainer:
                 self._next_rng() if cfg.lora_dropout > 0.0 else None,
             )
             loss = float(loss)
-        self.weight_version += 1
-        t_sync0 = time.perf_counter()
-        self._push_weights()
-        if cfg.inflight_weight_updates:
+        if (
+            self._inject_nan_step is not None
+            and self.total_batch_steps + 1 == self._inject_nan_step
+        ):
+            # seeded chaos injection (ISSUE 14): the sentinel's env hook
+            # fakes the METRIC; this one poisons the realized loss so the
+            # rollback controller exercises its real path end-to-end
+            loss = float("nan")
+        # nan-loss rollback (ISSUE 14): a non-finite loss means the update
+        # that just donated self.lora is poisoned — restore the last-good
+        # (adapter, opt state, version) snapshot and skip the push, so the
+        # run trains on from the last finite step instead of spreading
+        # NaNs. The metrics record keeps the honest nan loss (the sentinel
+        # still dumps its once-per-run incident bundle from it).
+        rolled_back_to: int | None = None
+        if (
+            self.control is not None and self.control.nan is not None
+            and not math.isfinite(loss)
+        ):
+            restored = self.control.nan.rollback(
+                self.total_batch_steps + 1, self.control,
+                bus=getattr(self.engine, "bus", None),
+            )
+            if restored is not None:
+                self.lora, self.opt_state, rolled_back_to = restored
+                if self.lineage is not None:
+                    self.lineage.on_rollback(
+                        step=self.total_batch_steps + 1,
+                        restored_version=rolled_back_to,
+                    )
+        if rolled_back_to is not None:
+            # the poisoned update never becomes a version — no bump — but
+            # the restored tree must still be RE-PUSHED under the same
+            # version: the previously pushed rollout copy can alias
+            # buffers the poisoned train step just donated (sync mode
+            # pushes self.lora by reference), and the weight bus's
+            # idempotent per-(tree, version) push makes the re-broadcast
+            # a no-op for workers that already hold it
+            t_sync0 = time.perf_counter()
+            self._push_weights()
+        else:
+            self.weight_version += 1
+            t_sync0 = time.perf_counter()
+            self._push_weights()
+        if rolled_back_to is None and cfg.inflight_weight_updates:
             # PipelineRL-style: hand the fresh adapter to the generation
             # round still in flight on the rollout thread — engines swap at
             # their next decode dispatch (push_lora mailbox, or the remote
@@ -1584,6 +1685,16 @@ class Trainer:
             telemetry.gauge_set(
                 obs_mod.OBS_WEIGHT_SYNC_MS,
                 (time.perf_counter() - t_sync0) * 1e3,
+            )
+        if (
+            self.control is not None and self.control.nan is not None
+            and rolled_back_to is None and math.isfinite(loss)
+        ):
+            # this step's state is the new last-good snapshot (taken after
+            # the push, so the snapshot version is one every worker is
+            # already being broadcast — a rollback never needs a resync)
+            self.control.nan.note_good(
+                self.weight_version, self.lora, self.opt_state
             )
 
         if cfg.write_adapter_file:
@@ -1612,6 +1723,10 @@ class Trainer:
                 self, "_rollout_dropped_stale", 0
             ),
         }
+        if rolled_back_to is not None:
+            # which version the nan-loss rollback restored (the lineage
+            # ledger carries the durable record; this is the sink's copy)
+            metrics["control/rolled_back_to"] = rolled_back_to
         if cfg.learner_len_buckets:
             metrics["learner/answer_width"] = answer_width
         if cfg.learner_prompt_buckets:
@@ -1658,6 +1773,11 @@ class Trainer:
             # ring record + sentinel pass + fleet refresh — the per-step
             # entry point of the observability plane
             self.obs.on_step(self.total_batch_steps, metrics)
+        if self.control is not None:
+            # governors read the same metrics record the sentinel just
+            # checked (trigger escalations already ran inside on_step
+            # above); actions land before the next generation round
+            self.control.on_step(self.total_batch_steps, metrics)
         if cfg.trace_dir and telemetry.enabled():
             self._trace_steps_done += 1
             if cfg.trace_steps and self._trace_steps_done >= cfg.trace_steps:

@@ -62,7 +62,8 @@ class TestBenchContract:
                     "cb_mode", "prefill_shared_frac", "pages_shared_frac",
                     "slot_idle_frac",
                     "ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
-                    "admission_stall_frac"):
+                    "admission_stall_frac",
+                    "control_actions", "shed_groups"):
             assert key in rec, key
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
         # (honest null, never a fabricated number), a healthy single-config
@@ -89,6 +90,10 @@ class TestBenchContract:
         assert rec["ttft_p99_ms"] is None
         assert rec["queue_wait_p50_ms"] is None
         assert rec["admission_stall_frac"] is None
+        # self-healing-runtime fields (ISSUE 14): controllers off — both
+        # null, distinguishing "no controller ran" from "ran, acted 0×"
+        assert rec["control_actions"] is None
+        assert rec["shed_groups"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0
@@ -179,6 +184,26 @@ class TestBenchContract:
         assert rec["queue_wait_p50_ms"] is not None
         assert rec["queue_wait_p50_ms"] >= 0
         assert 0.0 <= rec["admission_stall_frac"] <= 1.0
+        # no ControlLimits attached: control provenance honestly null
+        assert rec["control_actions"] is None
+        assert rec["shed_groups"] is None
+
+    def test_cb_control_pinned_fields(self):
+        """BENCH_CONTROL_FRAC (ISSUE 14): the static governor-shrunk A/B
+        arm records its control provenance — 0 dynamic actions (the pin
+        IS the action) and 0 shed groups — while completing the same
+        volume under the shrunk chain cap."""
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_MAX_PROMPT": "256", "BENCH_MAX_NEW": "16",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+            "BENCH_CONT_ADMISSION": "1", "BENCH_CONTROL_FRAC": "0.4",
+        })
+        assert "error" not in rec
+        assert rec["cb_mode"] == "continuous"
+        assert rec["control_actions"] == 0
+        assert rec["shed_groups"] == 0
+        assert rec["value"] > 0
 
     def test_cb_fixed_control_fields(self):
         """The fixed-batch refill control reads cb_mode='refill' with the

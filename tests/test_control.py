@@ -1,0 +1,914 @@
+"""Self-healing runtime tests (ISSUE 14).
+
+Three layers, matching the acceptance criteria:
+
+* **Framework discipline** — deadband hold, hysteresis, cooldown
+  suppression (counted), hard clamps, the global actuation budget, and
+  the ControlLimits identity-at-defaults contract.
+* **Chaos gates** — one seeded closed-loop gate per controller: a
+  scripted plant breaches, the governor's bounded actions bring the
+  signal back inside the deadband, and NO further action fires across the
+  dwell window (the no-oscillation half of the contract). The plants are
+  deterministic functions of the actuator value, so the gates replay.
+* **Wiring** — sentinel trigger → governor escalation (exactly once per
+  trigger, cooldown enforced, dump-only when no governor is armed — the
+  PR 8 contract), the three previously-uninjectable sentinel triggers
+  (reward_collapse / staleness_blowup / hbm_breach), the paged engine's
+  ControlLimits hooks (byte-identity at defaults, bounded-cap and shed
+  runs complete with honest stall attribution), the FaultInjector channel
+  selector, and the config dead-flag policy.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.config import SamplingConfig, TrainConfig
+from distrl_llm_tpu.control import (
+    CONTROL_ACTIONS,
+    BoundedActuator,
+    ControlLimits,
+    ControlRuntime,
+    Governor,
+    HbmGovernor,
+    NanRollbackController,
+    SloShedGovernor,
+    StalenessGovernor,
+    WorkerHealthGovernor,
+)
+from distrl_llm_tpu.rollout.buffer import TrajectoryBuffer
+from distrl_llm_tpu.rollout.staleness import StalenessPolicy
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+
+
+def _runtime(budget=64, limits=None):
+    return ControlRuntime(budget=budget, limits=limits)
+
+
+class _PlantGovernor(Governor):
+    """Test governor over a scripted plant: signal = load × actuator."""
+
+    def __init__(self, plant, **kw):
+        self.plant = plant
+        act = BoundedActuator(
+            name="knob", value=1.0, min_value=0.1, max_value=1.0,
+            apply=lambda v: None,
+            shrink=lambda v: v * 0.5, regrow=lambda v: v + 0.25,
+        )
+        super().__init__("plant", actuators=[act], **kw)
+
+    def read(self, step, metrics):
+        return self.plant(self.actuators[0].value)
+
+
+# --------------------------------------------------------------- framework
+
+
+class TestFramework:
+    def test_deadband_holds(self):
+        rt = _runtime()
+        gov = _PlantGovernor(lambda v: 0.8, high=0.9, low=0.7)
+        rt.register(gov)
+        for s in range(10):
+            assert rt.on_step(s, {}) == []
+        assert rt.actions_taken == 0
+
+    def test_cooldown_suppresses_and_counts(self):
+        rt = _runtime()
+        gov = _PlantGovernor(lambda v: 2.0, high=0.9, low=0.7,
+                             cooldown_steps=3)
+        rt.register(gov)
+        acted = [bool(rt.on_step(s, {})) for s in range(7)]
+        # one shrink, two cooldown steps suppressed, then the next shrink
+        assert acted == [True, False, False, True, False, False, True]
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/actions"] == 3.0
+        assert snap["control/cooldown_skips"] == 4.0
+
+    def test_hard_clamps(self):
+        rt = _runtime()
+        gov = _PlantGovernor(lambda v: 2.0, high=0.9, low=0.7,
+                             cooldown_steps=0)
+        rt.register(gov)
+        for s in range(20):
+            rt.on_step(s, {})
+        # 1.0 → 0.5 → 0.25 → 0.125 → clamp 0.1, then NOTHING (already at
+        # the clamp: no-op moves are not actions)
+        assert gov.actuators[0].value == 0.1
+        assert rt.actions_taken == 4
+
+    def test_budget_freezes_every_knob(self):
+        rt = _runtime(budget=2)
+        gov = _PlantGovernor(lambda v: 2.0, high=0.9, low=0.7,
+                             cooldown_steps=0)
+        rt.register(gov)
+        for s in range(6):
+            rt.on_step(s, {})
+        assert rt.actions_taken == 2
+        assert gov.actuators[0].value == 0.25  # frozen mid-descent
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/budget_exhausted"] >= 1.0
+
+    def test_regrow_requires_sustained_dwell(self):
+        load = [2.0]
+        rt = _runtime()
+        gov = _PlantGovernor(lambda v: load[0] * v, high=0.9, low=0.7,
+                             cooldown_steps=0, dwell_steps=3)
+        rt.register(gov)
+        rt.on_step(0, {})  # breach → shrink to 0.5 (signal 1.0 → wait)
+        rt.on_step(1, {})  # 2.0*0.5 = 1.0 > 0.9 → shrink to 0.25
+        load[0] = 0.4      # recovery: 0.4*0.25 = 0.1 < 0.7
+        a2 = [bool(rt.on_step(s, {})) for s in range(2, 7)]
+        # two healthy observations hold, the third regrows; the dwell then
+        # restarts — next regrow only after three MORE healthy steps
+        assert a2 == [False, False, True, False, False]
+
+    def test_limits_identity_at_defaults(self):
+        lim = ControlLimits()
+        for base in (1, 3, 5, 9):
+            assert lim.chain_cap(base) == base
+        assert not lim.shed_active()
+        lim.set_admission_frac(0.5)
+        assert lim.chain_cap(5) == 3  # ceil(2.5), never below 1
+        lim.set_admission_frac(0.0)
+        assert lim.chain_cap(5) == 1
+
+
+# ------------------------------------------------------------- chaos gates
+
+
+class TestChaosGates:
+    """Seeded breach → bounded actuation (count asserted) → signal back
+    inside the deadband → no oscillation across the dwell window."""
+
+    def test_hbm_governor_converges_and_recovers(self):
+        lim = ControlLimits()
+        load = [1.0]  # plant: hbm fraction = load × admission_frac
+
+        def stats():
+            return {
+                "bytes_limit": 100.0,
+                "peak_bytes_in_use": 100.0 * load[0] * lim.admission_frac,
+            }
+
+        rt = _runtime()
+        gov = HbmGovernor(lim, cooldown_steps=1, dwell_steps=3,
+                          stats_fn=stats)
+        rt.register(gov, triggers=("hbm_breach",))
+        kinds = []
+        for s in range(12):
+            kinds += [a.kind for a in rt.on_step(s, {})]
+        # breach at frac=1 (1.0 > 0.85) → shrink ×0.5 → 0.5 below the low
+        # watermark → dwell → regrow to 0.75, which sits INSIDE the
+        # deadband [0.70, 0.85] → steady state, no further action
+        assert kinds == ["shrink", "regrow"]
+        assert lim.admission_frac == 0.75
+        assert 0.70 <= gov.last_signal <= 0.85
+        # recovery: pressure drops — the governor regrows to max and HOLDS
+        load[0] = 0.3
+        kinds2 = []
+        for s in range(12, 24):
+            kinds2 += [a.kind for a in rt.on_step(s, {})]
+        assert kinds2 == ["regrow"]
+        assert lim.admission_frac == 1.0
+        # no oscillation across the dwell window: nothing moves again
+        for s in range(24, 36):
+            assert rt.on_step(s, {}) == []
+
+    def test_shed_engages_bounded_and_releases(self):
+        lim = ControlLimits()
+        rt = _runtime()
+        gov = SloShedGovernor(lim, slo_ttft_ms=100.0, cooldown_steps=0,
+                              dwell_steps=2, shed_max_steps=50)
+        rt.register(gov)
+        # plant: latency breaches until shed engages; the shed drains the
+        # overload, so post-release traffic is healthy for good
+        drained = [False]
+
+        def shed_metrics():
+            if lim.shed_active():
+                drained[0] = True
+                return {}  # no new admissions → no new latency samples
+            return {
+                "serving/ttft_ms_max": 20.0 if drained[0] else 250.0
+            }
+
+        kinds = []
+        for s in range(10):
+            kinds += [a.kind for a in rt.on_step(s, shed_metrics())]
+        assert kinds == ["engage", "release"]
+        assert not lim.shed_active()
+        # healthy traffic after release: no flapping
+        for s in range(10, 20):
+            assert rt.on_step(s, {"serving/ttft_ms_max": 20.0}) == []
+
+    def test_shed_duration_is_bounded(self):
+        """A latency signal that NEVER recovers still cannot shed forever:
+        shed_max_steps releases every episode (bounded action, not
+        starvation) — each engage is matched by a release within the
+        bound, however long the breach persists."""
+        lim = ControlLimits()
+        rt = _runtime()
+        gov = SloShedGovernor(lim, slo_ttft_ms=100.0, cooldown_steps=0,
+                              dwell_steps=2, shed_max_steps=3)
+        rt.register(gov)
+        acts: list[tuple[str, int]] = []
+        for s in range(12):
+            acts += [
+                (a.kind, a.step) for a in rt.on_step(
+                    s, {"serving/ttft_ms_max": 500.0}
+                )
+            ]
+        engages = [s for k, s in acts if k == "engage"]
+        releases = [s for k, s in acts if k == "release"]
+        assert releases, "shed was never released under a permanent breach"
+        # strict alternation: at most one un-released engage in flight
+        assert 0 <= len(engages) - len(releases) <= 1
+        for e, r in zip(engages, releases):
+            assert 0 < r - e <= 3, (
+                f"shed episode {e}→{r} exceeded shed_max_steps"
+            )
+
+    def test_staleness_governor_shrinks_and_restores(self):
+        policy = StalenessPolicy(8, mode="drop")
+        buffer = TrajectoryBuffer(32, high_watermark=32)
+        rt = _runtime()
+        gov = StalenessGovernor(policy, buffer, lag_target_ms=1000.0,
+                                batch_size=4, cooldown_steps=0,
+                                dwell_steps=2)
+        rt.register(gov)
+        lag = {"lineage/policy_lag_ms_p90": 5000.0}
+        for s in range(8):
+            rt.on_step(s, lag)
+        # both knobs shrank in lockstep and respected their floors
+        assert policy.max_staleness == 1
+        assert buffer.high_watermark == 8  # floor: 2 × batch_size
+        assert policy.mode == "drop"  # semantics untouched
+        # recovery: sustained low lag regrows toward the configured values
+        low = {"lineage/policy_lag_ms_p90": 100.0}
+        for s in range(8, 60):
+            rt.on_step(s, low)
+        assert policy.max_staleness == 8
+        assert buffer.high_watermark == 32
+        # steady state: nothing moves again (no shrink-regrow ping-pong)
+        before = rt.actions_taken
+        for s in range(60, 70):
+            assert rt.on_step(s, low) == []
+        assert rt.actions_taken == before
+        # a None signal (no lag closed this step) holds everything
+        assert rt.on_step(70, {}) == []
+
+    def test_staleness_never_exceeds_configured_bound(self):
+        policy = StalenessPolicy(4, mode="downweight")
+        buffer = TrajectoryBuffer(16)
+        rt = _runtime()
+        gov = StalenessGovernor(policy, buffer, lag_target_ms=1000.0,
+                                batch_size=2, cooldown_steps=0,
+                                dwell_steps=1)
+        rt.register(gov)
+        for s in range(50):
+            rt.on_step(s, {"lineage/policy_lag_ms_p90": 10.0})
+        assert policy.max_staleness == 4  # regrow clamps at the config max
+        assert buffer.high_watermark == 16
+        assert policy.mode == "downweight"
+
+    def test_worker_health_quarantines_laggard_once(self):
+        class FakeDriver:
+            def __init__(self):
+                self.calls = []
+                self.healthy = 2
+
+            def quarantine_worker(self, addr, *, min_healthy=1):
+                if self.healthy - 1 < min_healthy:
+                    return False
+                self.calls.append(addr)
+                self.healthy -= 1
+                return True
+
+        driver = FakeDriver()
+        t = [0.0]
+        rate = {"w1": 100.0, "w2": 100.0}
+        tok = {"w1": 0.0, "w2": 0.0}
+
+        def fleet():
+            t[0] += 1.0
+            for w in tok:
+                tok[w] += rate[w]
+            return {"worker_metrics": {
+                w: {"gen_tokens": tok[w], "ts": t[0]} for w in tok
+            }}
+
+        rt = _runtime()
+        gov = WorkerHealthGovernor(driver, fleet, warmup_obs=2,
+                                   cooldown_steps=100, min_healthy=1)
+        rt.register(gov, triggers=("tok_s_regression",))
+        for s in range(5):
+            rt.on_step(s, {})
+        assert driver.calls == []  # both healthy: no action
+        rate["w2"] = 5.0  # w2 collapses
+        for s in range(5, 12):
+            rt.on_step(s, {})
+        # exactly one quarantine, of the laggard only; the per-worker
+        # cooldown + EMA reset keep it from re-firing
+        assert driver.calls == ["w2"]
+        assert rt.actions_taken == 1
+
+    def test_hbm_governor_steers_on_live_bytes_not_lifetime_peak(self):
+        """The governor's signal is bytes_in_use, NOT peak_bytes_in_use:
+        the peak is a lifetime high-watermark that never resets, so one
+        recovered spike would otherwise ratchet the cap down forever."""
+        lim = ControlLimits()
+        stats = {
+            "bytes_limit": 100.0,
+            "bytes_in_use": 50.0,
+            "peak_bytes_in_use": 99.0,  # an old spike, long recovered
+        }
+        rt = _runtime()
+        gov = HbmGovernor(lim, cooldown_steps=0, stats_fn=lambda: stats)
+        rt.register(gov)
+        for s in range(5):
+            rt.on_step(s, {})
+        assert rt.actions_taken == 0  # live 0.5 is healthy; peak ignored
+        assert lim.admission_frac == 1.0
+
+    def test_shed_release_survives_exhausted_budget(self):
+        """A release restores the default state and is budget-FREE: an
+        exhausted budget must freeze knobs, never pin the engine in shed
+        forever (the permanent-starvation mode shed_max_steps exists to
+        prevent)."""
+        lim = ControlLimits()
+        rt = _runtime(budget=1)  # the engage consumes the last unit
+        gov = SloShedGovernor(lim, slo_ttft_ms=100.0, cooldown_steps=0,
+                              dwell_steps=1, shed_max_steps=3)
+        rt.register(gov)
+        assert [a.kind for a in rt.on_step(0, {"serving/ttft_ms_max": 500.0})] == ["engage"]
+        assert lim.shed_active()
+        kinds = []
+        for s in range(1, 8):
+            kinds += [
+                a.kind for a in rt.on_step(s, {"serving/ttft_ms_max": 500.0})
+            ]
+        assert "release" in kinds
+        assert not lim.shed_active()
+
+    def test_worker_health_pid_change_resets_track(self):
+        """A worker restart is detected by pid change (the fleet
+        cumulative deliberately never regresses), and an unhealthy/cold
+        worker is never judged — the stall/recompile window must not
+        quarantine the recovery itself."""
+        class FakeDriver:
+            def __init__(self):
+                self.calls = []
+
+            def quarantine_worker(self, addr, *, min_healthy=1):
+                self.calls.append(addr)
+                return True
+
+        driver = FakeDriver()
+        state = {"t": 0.0, "tok": 0.0, "pid": 1, "rate": 100.0,
+                 "healthy": True}
+
+        def fleet():
+            state["t"] += 1.0
+            state["tok"] += state["rate"]
+            return {
+                "workers": [{"address": "w1",
+                             "healthy": state["healthy"], "cold": False}],
+                "worker_metrics": {"w1": {
+                    "gen_tokens": state["tok"], "ts": state["t"],
+                    "pid": state["pid"],
+                }},
+            }
+
+        rt = _runtime()
+        gov = WorkerHealthGovernor(driver, fleet, warmup_obs=2,
+                                   cooldown_steps=100)
+        rt.register(gov)
+        for s in range(5):
+            rt.on_step(s, {})
+        # death: counter stalls while unhealthy — no judgment, no call
+        state["rate"], state["healthy"] = 0.0, False
+        for s in range(5, 9):
+            rt.on_step(s, {})
+        assert driver.calls == []
+        # rejoin as a NEW incarnation, healthy again but slow at first
+        # (cold recompile): the pid change + track reset means the slow
+        # window builds a fresh EMA instead of failing the old one
+        state.update(pid=2, healthy=True, rate=5.0)
+        for s in range(9, 12):
+            rt.on_step(s, {})
+        assert driver.calls == []
+
+    def test_worker_health_respects_min_healthy(self):
+        class LastDriver:
+            def __init__(self):
+                self.calls = []
+
+            def quarantine_worker(self, addr, *, min_healthy=1):
+                return False  # only one healthy worker remains
+
+        driver = LastDriver()
+        t = [0.0]
+        tok = [0.0]
+        rates = iter([100.0] * 4 + [1.0] * 10)
+
+        def fleet():
+            t[0] += 1.0
+            tok[0] += next(rates)
+            return {"worker_metrics": {
+                "w1": {"gen_tokens": tok[0], "ts": t[0]},
+            }}
+
+        rt = _runtime()
+        gov = WorkerHealthGovernor(driver, fleet, warmup_obs=2,
+                                   min_healthy=1)
+        rt.register(gov)
+        for s in range(10):
+            rt.on_step(s, {})
+        # the refusal is not an action: capacity was never zeroed and the
+        # budget was not spent on it
+        assert rt.actions_taken == 0
+
+    def test_nan_rollback_restores_and_bounds(self):
+        rt = _runtime()
+        nan = NanRollbackController(max_rollbacks=2)
+        rt.nan = nan
+        lora = {"a": jnp.arange(4.0)}
+        opt = {"m": jnp.zeros(4)}
+        nan.note_good(3, lora, opt)
+        out = nan.rollback(7, rt)
+        assert out is not None
+        r_lora, r_opt, version = out
+        assert version == 3
+        np.testing.assert_array_equal(np.asarray(r_lora["a"]),
+                                      np.arange(4.0))
+        # restored copies are INDEPENDENT buffers: donating them must not
+        # corrupt the snapshot a second consecutive rollback needs
+        out2 = nan.rollback(8, rt)
+        assert out2 is not None and out2[2] == 3
+        # bound spent: third rollback refuses, the step proceeds as HEAD
+        assert nan.rollback(9, rt) is None
+        assert rt.actions_taken == 2
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/nan_rollbacks"] == 2.0
+
+    def test_nan_rollback_without_snapshot(self):
+        rt = _runtime()
+        nan = NanRollbackController()
+        assert nan.rollback(1, rt) is None
+
+
+# ---------------------------------------------------------- trigger wiring
+
+
+def _sentinel(tmp_path, runtime=None, **kw):
+    from distrl_llm_tpu.obs import FlightRecorder, Sentinel
+
+    rec = FlightRecorder(str(tmp_path), ring_size=8)
+    s = Sentinel(rec, None, **kw)
+    if runtime is not None:
+        s.on_trigger = runtime.on_trigger
+    return s, rec
+
+
+class TestTriggerWiring:
+    def test_escalation_exactly_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "hbm_breach:2")
+        lim = ControlLimits()
+        rt = _runtime()
+        gov = HbmGovernor(lim, stats_fn=lambda: None, cooldown_steps=0)
+        rt.register(gov, triggers=("hbm_breach",))
+        sent, rec = _sentinel(tmp_path, runtime=rt)
+        for step in range(5):
+            sent.check(step, {"loss": 1.0})
+        # the trigger fired exactly once (sentinel contract), escalated
+        # exactly once, and the governor shrank exactly once
+        assert len(rec.incidents) == 1
+        assert "hbm_breach" in rec.incidents[0]
+        assert rt.actions_taken == 1
+        assert rt.actions[0].trigger == "hbm_breach"
+        assert lim.admission_frac == 0.5
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/trigger_escalations"] == 1.0
+
+    def test_unarmed_trigger_stays_dump_only(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "hbm_breach:1")
+        rt = _runtime()  # NO governor registered for hbm_breach
+        sent, rec = _sentinel(tmp_path, runtime=rt)
+        for step in range(4):
+            sent.check(step, {"loss": 1.0})
+        assert len(rec.incidents) == 1  # the PR 8 dump still happens
+        assert rt.actions_taken == 0    # …and nothing acted
+        snap = telemetry.metrics_snapshot()
+        assert "control/actions" not in snap
+        assert "control/trigger_escalations" not in snap
+
+    def test_escalation_respects_cooldown(self):
+        lim = ControlLimits()
+        rt = _runtime()
+        gov = HbmGovernor(lim, stats_fn=lambda: None, cooldown_steps=5)
+        rt.register(gov, triggers=("hbm_breach",))
+        assert rt.on_trigger("hbm_breach", 3) is True
+        # a second escalation inside the cooldown is suppressed (counted)
+        assert rt.on_trigger("hbm_breach", 4) is False
+        assert rt.actions_taken == 1
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/cooldown_skips"] == 1.0
+
+    def test_reward_collapse_injection(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "reward_collapse:2")
+        sent, rec = _sentinel(tmp_path)
+        for step in range(10):
+            sent.check(step, {"loss": 1.0, "mean_accuracy_reward": 0.4})
+        assert len(rec.incidents) == 1
+        assert "reward_collapse" in rec.incidents[0]
+
+    def test_staleness_blowup_injection(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "staleness_blowup:3")
+        sent, rec = _sentinel(tmp_path, staleness_limit=4.0)
+        for step in range(6):
+            sent.check(step, {"loss": 1.0})
+        assert len(rec.incidents) == 1
+        assert "staleness_blowup" in rec.incidents[0]
+
+    def test_staleness_injection_rejected_without_limit(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "staleness_blowup:3")
+        sent, rec = _sentinel(tmp_path)  # no staleness limit armed
+        assert sent._inject is None  # parse-time rejection, not a dud gate
+        for step in range(6):
+            sent.check(step, {"loss": 1.0})
+        assert rec.incidents == []
+
+    def test_hbm_breach_injection_fires_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "hbm_breach:1")
+        sent, rec = _sentinel(tmp_path)
+        for step in range(4):
+            sent.check(step, {"loss": 1.0})
+        assert len(rec.incidents) == 1
+        assert "hbm_breach" in rec.incidents[0]
+
+
+# --------------------------------------------------------- engine coupling
+
+
+PAGE = 8
+
+
+def _engine(max_new=16, rows=4, **kw):
+    from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+    from distrl_llm_tpu.models import TINY
+
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=16, max_new_tokens=max_new,
+        eos_token_ids=[1], pad_token_id=0, page_size=PAGE,
+        max_concurrent_rows=rows, scheduler="refill",
+        prefix_sharing=True, continuous_admission=True,
+        decode_chunk=4, autotune=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    from distrl_llm_tpu.models import TINY, init_params
+
+    return init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+
+
+def _prompts(b=6, seed=0):
+    from distrl_llm_tpu.models import TINY
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, TINY.vocab_size, size=(b, 16)).astype(np.int32)
+    mask = np.ones((b, 16), np.int32)
+    for i in range(b):
+        pad = rng.integers(0, 9)
+        ids[i, :pad] = 0
+        mask[i, :pad] = 0
+    return ids, mask
+
+
+def _greedy(max_tokens=16, n=2):
+    return SamplingConfig(
+        max_tokens=max_tokens, temperature=0.0, top_p=1.0, n=n
+    )
+
+
+class TestEngineCoupling:
+    def test_default_limits_byte_identical(self, tiny_params):
+        ids, mask = _prompts()
+        rng = jax.random.PRNGKey(7)
+        base = _engine().generate(
+            tiny_params, None, ids, mask, _greedy(), rng
+        )
+        eng = _engine()
+        eng.control_limits = ControlLimits()  # attached, all defaults
+        out = eng.generate(tiny_params, None, ids, mask, _greedy(), rng)
+        np.testing.assert_array_equal(base.tokens, out.tokens)
+        np.testing.assert_array_equal(base.lengths, out.lengths)
+
+    def test_shrunk_chain_cap_completes_bit_identical(self, tiny_params):
+        """A governor-shrunk cap serializes group admission but greedy
+        outputs stay bit-identical — per-row prefill and per-slot decode
+        are order-independent."""
+        ids, mask = _prompts()
+        rng = jax.random.PRNGKey(7)
+        base = _engine().generate(
+            tiny_params, None, ids, mask, _greedy(), rng
+        )
+        eng = _engine()
+        lim = ControlLimits()
+        lim.set_admission_frac(0.2)  # cap 5 → 1 live chain
+        assert lim.chain_cap(5) == 1
+        eng.control_limits = lim
+        out = eng.generate(tiny_params, None, ids, mask, _greedy(), rng)
+        np.testing.assert_array_equal(base.tokens, out.tokens)
+        np.testing.assert_array_equal(base.lengths, out.lengths)
+        assert eng.last_pool_stats["shed_groups"] == 0
+
+    def test_shed_round_completes_with_attributed_stalls(self, tiny_params):
+        """Shed engaged for a whole round: the engine still completes
+        (admission proceeds whenever there is no live work to drain),
+        deferred groups are counted once each, and the serving audit
+        attributes the declined passes to 'shed' with conservation
+        intact."""
+        from distrl_llm_tpu.serving_obs import ServingLedger
+
+        ids, mask = _prompts()
+        rng = jax.random.PRNGKey(7)
+        base = _engine().generate(
+            tiny_params, None, ids, mask, _greedy(), rng
+        )
+        eng = _engine()
+        lim = ControlLimits()
+        lim.set_shed(True)
+        eng.control_limits = lim
+        eng.serving_ledger = sl = ServingLedger(ring_size=64)
+        out = eng.generate(tiny_params, None, ids, mask, _greedy(), rng)
+        np.testing.assert_array_equal(base.tokens, out.tokens)
+        assert eng.last_pool_stats["shed_groups"] > 0
+        assert sl.stalls["shed"] > 0
+        assert sum(sl.stalls.values()) == sl.declined_passes
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/shed_groups"] == (
+            eng.last_pool_stats["shed_groups"]
+        )
+
+
+# -------------------------------------------------- fault-injector channel
+
+
+class TestInjectorChannels:
+    def test_channel_scoped_rule_ignores_other_channels(self):
+        from distrl_llm_tpu.distributed.resilience import FaultInjector
+
+        fi = FaultInjector("weights.send:2=close")
+        # dispatch sends never match however many there are
+        for _ in range(5):
+            assert fi.decide("send", "dispatch") is None
+        assert fi.decide("send", "weights") is None   # weights send #1
+        assert fi.decide("send", "weights") == ("close", None)  # #2
+        assert fi.events == [("weights.send", 2, "close")]
+
+    def test_channel_counter_independent_of_interleaving(self):
+        from distrl_llm_tpu.distributed.resilience import FaultInjector
+
+        def run(interleave):
+            fi = FaultInjector("weights.send:2=close")
+            for _ in range(interleave):
+                fi.decide("send", "dispatch")
+            fi.decide("send", "weights")
+            for _ in range(interleave):
+                fi.decide("send", "dispatch")
+            return fi.decide("send", "weights")
+
+        # the weights-channel counter is immune to dispatch traffic
+        assert run(0) == run(3) == run(11) == ("close", None)
+
+    def test_unscoped_rules_keep_global_semantics(self):
+        from distrl_llm_tpu.distributed.resilience import FaultInjector
+
+        fi = FaultInjector("send:3=drop")
+        assert fi.decide("send", "dispatch") is None
+        assert fi.decide("send", "weights") is None
+        assert fi.decide("send", "dispatch") == ("drop", None)
+        assert fi.events == [("send", 3, "drop")]
+
+    def test_bad_channel_spec_rejected(self):
+        from distrl_llm_tpu.distributed.resilience import FaultInjector
+
+        with pytest.raises(ValueError):
+            FaultInjector(".send:1=drop")
+
+    def test_faulty_connection_passes_channel(self):
+        from distrl_llm_tpu.distributed.resilience import (
+            FaultInjector, FaultyConnection,
+        )
+
+        class Dummy:
+            fd = -1
+
+            def send(self, *a, **k):
+                pass
+
+            def recv(self, timeout_ms):
+                return (1, 1, b"")
+
+            def close(self):
+                pass
+
+        fi = FaultInjector("weights.recv:1=drop")
+        dispatch = FaultyConnection(Dummy(), fi, "dispatch")
+        weights = FaultyConnection(Dummy(), fi, "weights")
+        assert dispatch.recv(10) is not None
+        assert weights.recv(10) is None  # dropped: reported as timeout
+
+    def test_weight_bus_dials_weights_channel(self):
+        from distrl_llm_tpu.distributed import resilience
+        from distrl_llm_tpu.distributed.weight_bus import WeightBus
+
+        fi = resilience.FaultInjector("")
+        resilience.install(fi)
+        try:
+            class Chan:
+                def send(self, *a, **k):
+                    pass
+
+                def recv(self, timeout_ms):
+                    return None
+
+                def close(self):
+                    pass
+
+            bus = WeightBus([("127.0.0.1", 1)],
+                            connection_factory=lambda a: Chan())
+            bus.close()
+            # the REAL dial path tags channel="weights": exercise it
+            # against a dead port and confirm the wrapper class
+            with pytest.raises(OSError):
+                bus._dial(("127.0.0.1", 1))
+        finally:
+            resilience.install(None)
+
+
+# ----------------------------------------------------- trace_report section
+
+
+class TestTraceReportSection:
+    def test_control_section_renders_actions(self):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ))
+        from tools.trace_report import control_section
+
+        telemetry.configure(enabled=True)
+        lim = ControlLimits()
+        rt = _runtime()
+        gov = HbmGovernor(
+            lim, cooldown_steps=0,
+            stats_fn=lambda: {"bytes_limit": 1.0, "peak_bytes_in_use": 1.0},
+        )
+        rt.register(gov)
+        rt.on_step(3, {})
+        events = telemetry.recent_events()
+        lines = control_section(events)
+        text = "\n".join(lines)
+        assert lines[0] == "control:"
+        assert "hbm/shrink" in text
+        assert "admission_frac" in text
+
+    def test_control_section_absent_without_actions(self):
+        from tools.trace_report import control_section
+
+        assert control_section([]) == []
+        # unrelated instants don't render a control section
+        assert control_section([
+            {"ph": "i", "name": "something/else", "args": {}}
+        ]) == []
+
+
+# ------------------------------------------------------------- config gate
+
+
+class TestConfigPolicy:
+    def test_master_arms_applicable_subset(self):
+        cfg = TrainConfig(
+            control=True, engine_impl="paged", continuous_batching=True,
+            continuous_admission=True, max_concurrent_sequences=4,
+            sentinel=True, flight_recorder_dir="/tmp/fr",
+            slo_ttft_ms=100.0,
+        )
+        assert set(cfg.armed_controllers()) == {
+            "hbm", "shed", "nan_rollback"
+        }
+
+    def test_master_on_plain_run_arms_rollback_only(self):
+        assert TrainConfig(control=True).armed_controllers() == (
+            "nan_rollback",
+        )
+
+    def test_explicit_flags_reject_unsupported_shapes(self):
+        with pytest.raises(ValueError, match="control_hbm"):
+            TrainConfig(control_hbm=True)
+        with pytest.raises(ValueError, match="control_shed"):
+            TrainConfig(control_shed=True)
+        with pytest.raises(ValueError, match="control_staleness"):
+            TrainConfig(control_staleness=True)
+        with pytest.raises(ValueError, match="control_worker_health"):
+            TrainConfig(control_worker_health=True)
+
+    def test_staleness_flag_with_lineage(self):
+        cfg = TrainConfig(
+            control_staleness=True, lineage=True, rollout_mode="async",
+            clip_ratio=0.2, max_staleness=4,
+        )
+        assert cfg.armed_controllers() == ("staleness",)
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError, match="control_budget"):
+            TrainConfig(control_budget=0)
+        with pytest.raises(ValueError, match="control_dwell_steps"):
+            TrainConfig(control_dwell_steps=0)
+        with pytest.raises(ValueError, match="control_lag_ms"):
+            TrainConfig(control_lag_ms=0.0)
+
+
+# ------------------------------------------------------- nan rollback e2e
+
+
+class TestTrainerRollback:
+    def test_injected_nan_rolls_back_and_run_finishes(self, monkeypatch):
+        """End-to-end nan gate on the real trainer loop: the poisoned
+        step is skipped (its update never becomes a weight version), the
+        final loss is finite, and the rollback is recorded on the sink."""
+        monkeypatch.setenv("DISTRL_CONTROL_INJECT_NAN", "2")
+        from distrl_llm_tpu.engine import GenerationEngine
+        from distrl_llm_tpu.metrics import MemorySink
+        from distrl_llm_tpu.models import TINY, init_params
+        from distrl_llm_tpu.models.lora import lora_scale
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+        from distrl_llm_tpu.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="tiny", episodes=2, batch_size=4, num_candidates=4,
+            topk=4, train_batch_size=4, max_prompt_tokens=16,
+            max_new_tokens=24, number_of_actors=1, number_of_learners=1,
+            learner_chunk_size=1, eval_every=0, save_every=0,
+            metrics_backend="null", lr=1e-2, max_lora_rank=4, lora_alpha=8,
+            learner="grpo", control_nan_rollback=True,
+        )
+        tok = CharTokenizer()
+        problems = [f"q {c}" for c in "abcdefgh"]
+        train = {"problem": problems,
+                 "solution": [p.strip()[-1].upper() for p in problems]}
+        test = {k: v[:4] for k, v in train.items()}
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        engine = GenerationEngine(
+            TINY, max_prompt_tokens=cfg.max_prompt_tokens,
+            max_new_tokens=cfg.max_new_tokens,
+            eos_token_ids=[tok.eos_token_id],
+            pad_token_id=tok.pad_token_id, cache_dtype=jnp.float32,
+            lora_scale=lora_scale(cfg.max_lora_rank, cfg.lora_alpha),
+            decode_chunk=4,
+        )
+        sink = MemorySink()
+
+        def reward(completions, solutions):
+            return np.asarray(
+                [(0.0, 0.1 + (len(c) % 5) / 10.0) for c in completions],
+                np.float32,
+            )
+
+        trainer = Trainer(
+            train, test, reward, cfg, tokenizer=tok, engine=engine,
+            base_params=params, model_cfg=TINY, sink=sink,
+        )
+        trainer.train()
+        recs = [m for _, m in sink.records if "loss" in m]
+        losses = [m["loss"] for m in recs]
+        assert len(losses) == 4
+        assert math.isnan(losses[1])       # the poisoned step, honest
+        assert all(math.isfinite(x) for x in (losses[0], *losses[2:]))
+        rolled = [m for m in recs if "control/rolled_back_to" in m]
+        assert len(rolled) == 1
+        assert rolled[0]["control/rolled_back_to"] == 1
+        # the poisoned update never became a version: 4 steps, 3 versions
+        assert trainer.weight_version == 3
+        assert trainer.control.nan.rollbacks == 1

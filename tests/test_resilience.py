@@ -361,6 +361,53 @@ class TestRejoin:
             if restarted is not None:
                 kill(restarted)
 
+    def test_proactive_quarantine_probes_and_readmits(self):
+        """ISSUE 14 worker-health path: quarantine_worker demotes a LIVE
+        worker (counted), the rejoin loop PING-probes the same process and
+        re-admits it cold — no restart required — and the min_healthy
+        floor refuses a quarantine that would zero capacity."""
+        proc_a, port_a = spawn_worker()
+        proc_b, port_b = spawn_worker()
+        driver = DriverClient(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            retry_policy=RetryPolicy(base_s=0.05, max_backoff_s=0.2),
+            rejoin=True, rejoin_poll_s=0.05,
+        )
+        try:
+            assert driver.quarantine_worker(f"127.0.0.1:{port_a}")
+            assert driver.num_healthy == 1
+            # second quarantine would leave zero healthy: refused
+            assert not driver.quarantine_worker(f"127.0.0.1:{port_b}")
+            assert driver.num_healthy == 1
+            # already-unhealthy worker: refused (no double-demote)
+            assert not driver.quarantine_worker(f"127.0.0.1:{port_a}")
+            # the rejoin loop probes the still-running process and
+            # re-admits it — the "rejoin-probe" half of the controller
+            deadline = time.monotonic() + 30
+            while driver.num_healthy < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert driver.num_healthy == 2, "quarantined worker never rejoined"
+            assert driver.rejoin_epoch >= 1
+            assert driver.dispatch_objects([("echo", 5)], 10_000) == [5]
+            snap = telemetry.metrics_snapshot()
+            assert snap["cp/quarantines"] == 1.0
+            assert snap["cp/reconnects"] >= 1.0
+        finally:
+            driver.shutdown()
+            kill(proc_a)
+            kill(proc_b)
+
+    def test_quarantine_refused_without_rejoin_loop(self):
+        proc, port = spawn_worker()
+        driver = DriverClient([("127.0.0.1", port)], rejoin=False)
+        try:
+            # no rejoin loop = the quarantine would be permanent: refused
+            assert not driver.quarantine_worker(f"127.0.0.1:{port}")
+            assert driver.num_healthy == 1
+        finally:
+            driver.shutdown()
+            kill(proc)
+
     def test_remote_engine_rewarm_on_rejoin_epoch(self):
         """The re-warm allowance: a bumped rejoin_epoch clears the remote
         engine's warm keys, so the next round gets the cold (compile)

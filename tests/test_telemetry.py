@@ -328,6 +328,55 @@ class TestRegistry:
         snap2 = telemetry.metrics_snapshot()
         assert "cp/reconnects" not in snap2
 
+    def test_control_series_schema(self):
+        """Schema pin for the self-healing runtime's registry names
+        (ISSUE 14) and their TYPES: control/actions,
+        control/trigger_escalations, control/cooldown_skips,
+        control/budget_exhausted, control/shed_groups and
+        control/nan_rollbacks are COUNTERS; control/shed_active and the
+        per-actuator control/value/<name> derivations are GAUGES. The
+        quarantine counter (cp/quarantines) rides the cp family — the
+        DriverClient emits it."""
+        from distrl_llm_tpu import control as c
+        from distrl_llm_tpu.distributed import resilience as r
+
+        assert c.CONTROL_ACTIONS == "control/actions"
+        assert c.CONTROL_TRIGGER_ESCALATIONS == "control/trigger_escalations"
+        assert c.CONTROL_COOLDOWN_SKIPS == "control/cooldown_skips"
+        assert c.CONTROL_BUDGET_EXHAUSTED == "control/budget_exhausted"
+        assert c.CONTROL_SHED_GROUPS == "control/shed_groups"
+        assert c.CONTROL_SHED_ACTIVE == "control/shed_active"
+        assert c.CONTROL_NAN_ROLLBACKS == "control/nan_rollbacks"
+        assert c.CONTROL_VALUE == "control/value"
+        assert r.CP_QUARANTINES == "cp/quarantines"
+        telemetry.counter_add(c.CONTROL_ACTIONS)
+        telemetry.counter_add(c.CONTROL_TRIGGER_ESCALATIONS)
+        telemetry.counter_add(c.CONTROL_COOLDOWN_SKIPS, 2)
+        telemetry.counter_add(c.CONTROL_BUDGET_EXHAUSTED)
+        telemetry.counter_add(c.CONTROL_SHED_GROUPS, 3)
+        telemetry.counter_add(c.CONTROL_NAN_ROLLBACKS)
+        telemetry.counter_add(r.CP_QUARANTINES)
+        telemetry.gauge_set(c.CONTROL_SHED_ACTIVE, 1.0)
+        telemetry.gauge_set(f"{c.CONTROL_VALUE}/admission_frac", 0.5)
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/actions"] == 1.0
+        assert snap["control/trigger_escalations"] == 1.0
+        assert snap["control/cooldown_skips"] == 2.0
+        assert snap["control/budget_exhausted"] == 1.0
+        assert snap["control/shed_groups"] == 3.0
+        assert snap["control/nan_rollbacks"] == 1.0
+        assert snap["cp/quarantines"] == 1.0
+        assert snap["control/shed_active"] == 1.0
+        assert snap["control/value/admission_frac"] == 0.5
+        # shed admission stalls attribute through the serving audit's
+        # constant-prefix derivation with the new "shed" reason
+        from distrl_llm_tpu.serving_obs import SERVING_ADMISSION_STALLS
+
+        telemetry.counter_add(f"{SERVING_ADMISSION_STALLS}/shed")
+        assert telemetry.metrics_snapshot()[
+            "serving/admission_stalls/shed"
+        ] == 1.0
+
     def test_weight_bus_series_schema(self):
         """Schema pin for the weight-bus registry names (ISSUE 9): byte
         and push COUNTERS, plus the push→last-ack broadcast latency
@@ -513,7 +562,7 @@ class TestRegistry:
                 == "fleet/serving_queue_wait_ms_max")
         assert so.FLEET_SERVING_STALLS == "fleet/serving_admission_stalls"
         assert so.STALL_REASONS == (
-            "no_slots", "no_pages", "chain_cap", "budget_wedge"
+            "no_slots", "no_pages", "chain_cap", "budget_wedge", "shed"
         )
         for name in (so.SERVING_TTFT_MS, so.SERVING_TPOT_MS,
                      so.SERVING_QUEUE_WAIT_MS, so.SERVING_E2E_MS):

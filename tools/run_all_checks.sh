@@ -81,6 +81,14 @@ stage "serving_smoke" env JAX_PLATFORMS=cpu \
 # assert exactly one incident bundle with the expected manifest
 stage "obs_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/obs_smoke.py
+# self-healing-runtime gate (ISSUE 14): armed-but-quiescent controllers
+# byte-identical to controllers-off, seeded nan-loss rollback ends with a
+# finite loss + a lineage rollback record, sustained fake HBM pressure
+# walks the admission cap to its clamp in exactly the bounded shrink count
+# (no oscillation, run completes), and an injected ttft_blowup escalates
+# into one shed engage/release with conservation-intact "shed" attribution
+stage "control_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/control_smoke.py
 # weight-bus gate (ISSUE 9): broadcast-bus tiny train byte-identical to the
 # dispatch-transport golden (losses + adapter), per-dispatch payload shed
 # >= the serialized adapter, and a seeded mid-run worker kill/rejoin whose
@@ -137,7 +145,7 @@ stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
   tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
   tests/test_telemetry.py tests/test_obs.py tests/test_weight_bus.py \
-  tests/test_lineage.py
+  tests/test_lineage.py tests/test_control.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \

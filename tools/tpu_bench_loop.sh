@@ -245,6 +245,15 @@ bench cb_prefix /tmp/bench_tpu_cb_prefix.json 1200 \
 bench cb_continuous /tmp/bench_tpu_cb_continuous.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16
+# controller-cost A/B (ISSUE 14): the cb_continuous arm re-run with the
+# admission fraction pinned at 0.5 — the static twin of an HBM-governor
+# shrink — so the artifact quantifies what a governor-degraded engine
+# costs in tok/s (rows record control_actions/shed_groups; the unpinned
+# arm above reads null)
+bench cb_control /tmp/bench_tpu_cb_control.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_CONTROL_FRAC=0.5
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'

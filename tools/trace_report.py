@@ -298,6 +298,49 @@ def serving_section(events: list[dict]) -> list[str]:
     return lines
 
 
+def control_section(events: list[dict]) -> list[str]:
+    """Self-healing-runtime view (ISSUE 14): every governor actuation is
+    stamped as a ``control/action`` Perfetto instant with its controller,
+    actuator, kind, and old→new values. This section counts actions per
+    controller/kind and lists the first few in order — the audit trail of
+    what the runtime DID to itself. Empty when no controller ever acted
+    (or the run was untraced)."""
+    actions = [
+        ev.get("args", {}) for ev in events
+        if ev.get("ph") == "i" and ev.get("name") == "control/action"
+    ]
+    if not actions:
+        return []
+    lines = ["control:"]
+    per: dict[tuple[str, str], int] = {}
+    for a in actions:
+        key = (str(a.get("controller", "?")), str(a.get("kind", "?")))
+        per[key] = per.get(key, 0) + 1
+    lines.append(
+        f"  actions:            {len(actions)} total — " + ", ".join(
+            f"{ctrl}/{kind} ×{n}"
+            for (ctrl, kind), n in sorted(per.items())
+        )
+    )
+    escalated = sum(1 for a in actions if a.get("trigger"))
+    if escalated:
+        lines.append(
+            f"  trigger-escalated:  {escalated} "
+            f"({', '.join(sorted({str(a['trigger']) for a in actions if a.get('trigger')}))})"
+        )
+    for a in actions[:8]:
+        lines.append(
+            f"    step {a.get('step', '?'):>4}  "
+            f"[{a.get('kind', '?')}] {a.get('controller', '?')}."
+            f"{a.get('actuator', '?')} {a.get('old')} -> {a.get('new')}"
+            f" ({a.get('reason', '')})"
+        )
+    if len(actions) > 8:
+        lines.append(f"    … and {len(actions) - 8} more")
+    lines.append("")
+    return lines
+
+
 def lineage_section(events: list[dict],
                     spans: dict[tuple[int, str], list[dict]],
                     tracks: dict[int, str]) -> list[str]:
@@ -515,6 +558,7 @@ def build_report(events: list[dict], metadata: dict,
     lines.extend(rollout_section(events, spans))
     lines.extend(policy_lag_section(events))
     lines.extend(serving_section(events))
+    lines.extend(control_section(events))
     lines.extend(lineage_section(events, spans, tracks))
     lines.extend(spec_section(spans))
 

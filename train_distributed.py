@@ -317,6 +317,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo_queue_wait_ms", type=float, default=None,
                    help="queue-wait SLO: arms the sentinel's "
                         "queue_wait_blowup trigger; requires --sentinel")
+    p.add_argument("--control", action="store_true",
+                   help="self-healing runtime (ISSUE 14): arm every "
+                        "closed-loop controller this run's shape supports "
+                        "(HBM admission governor, SLO load-shedder, "
+                        "staleness governor, worker-health actor, nan-loss "
+                        "rollback) — bounded, hysteretic, cooldown-guarded "
+                        "actions on the observability plane, all counted "
+                        "under control/* and capped by --control_budget")
+    p.add_argument("--control_hbm", action="store_true",
+                   help="HBM governor only: shrink the continuous-"
+                        "admission chain cap under watermark pressure / "
+                        "hbm_breach, regrow after a sustained-headroom "
+                        "dwell (requires a local paged engine with "
+                        "--continuous_admission)")
+    p.add_argument("--control_shed", action="store_true",
+                   help="SLO load-shedder only: throttle group admission "
+                        "(decline reason 'shed') while TTFT/queue-wait "
+                        "breach the --slo_* limits (requires "
+                        "--continuous_admission and an SLO)")
+    p.add_argument("--control_staleness", action="store_true",
+                   help="staleness governor only: adapt the EFFECTIVE "
+                        "max_staleness and buffer watermark from the live "
+                        "lineage/policy_lag_ms distribution (requires "
+                        "--lineage; async mode)")
+    p.add_argument("--control_worker_health", action="store_true",
+                   help="worker-health actor only: quarantine a worker "
+                        "whose tok/s regresses against its own EMA and "
+                        "let the rejoin loop probe + re-admit it "
+                        "(requires --rollout_workers with rejoin on)")
+    p.add_argument("--control_nan_rollback", action="store_true",
+                   help="nan-loss rollback only: restore the last-good "
+                        "(adapter, opt state, version) snapshot and skip "
+                        "the poisoned step instead of training on NaNs")
+    p.add_argument("--control_budget", type=int, default=64,
+                   help="global actuation budget per run; once spent every "
+                        "controller knob freezes at its current value")
+    p.add_argument("--control_cooldown_steps", type=int, default=2,
+                   help="minimum steps between two actions of one governor")
+    p.add_argument("--control_dwell_steps", type=int, default=3,
+                   help="consecutive healthy observations before a governor "
+                        "regrows a shrunk knob")
+    p.add_argument("--control_lag_ms", type=float, default=5000.0,
+                   help="staleness-governor setpoint: policy-lag p90 above "
+                        "this shrinks the effective staleness bound")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
