@@ -131,7 +131,8 @@ def host_quantized_params(name: str, cfg, dtype, base_quant: str, host,
 
     from distrl_llm_tpu.models import init_params
     from distrl_llm_tpu.ops.quant import (
-        default_group_size, quant_bits_for, quantize_params,
+        default_group_size, pack_params_int4, quant_bits_for,
+        quantize_params, unpack_params_int4,
     )
 
     def build():
@@ -140,6 +141,11 @@ def host_quantized_params(name: str, cfg, dtype, base_quant: str, host,
         return quantize_params(
             params, bits=bits, group_size=default_group_size(bits)
         )
+
+    def build_packed():
+        # int4 payloads serialize nibble-packed (ops/quant.py transport
+        # form — half the cache bytes and disk I/O; int8/none pass through)
+        return pack_params_int4(build())
 
     cache_root = os.environ.get("BENCH_PARAMS_CACHE")
     with jax.default_device(host):
@@ -164,14 +170,25 @@ def host_quantized_params(name: str, cfg, dtype, base_quant: str, host,
                 lambda s: jax.ShapeDtypeStruct(
                     s.shape, s.dtype, sharding=SingleDeviceSharding(host)
                 ),
-                jax.eval_shape(build),
+                jax.eval_shape(build_packed),
             )
-            return ckpt.restore(path, abstract)
+            try:
+                return unpack_params_int4(ckpt.restore(path, abstract))
+            except Exception as e:  # noqa: BLE001 — stale/pre-packed cache
+                # rebuild WITHOUT re-saving (the stale directory is the
+                # prep stage's to clear) — an in-window bench must never
+                # die on a cache-schema migration
+                print(
+                    f"bench: params cache at {path} unreadable under the "
+                    f"packed-int4 schema ({type(e).__name__}) — rebuilding",
+                    file=sys.stderr,
+                )
+                return build()
         params = build()
         if save_on_miss:
             # population is the ungated prep stage's job; an in-window
             # cache miss must not additionally pay a multi-GB serialize
-            ckpt.save(path, params)
+            ckpt.save(path, pack_params_int4(params))
             ckpt.wait_until_finished()
         return params
 
@@ -709,11 +726,23 @@ def main() -> int:
                             str(min(n_prompts * n_cand, 128)),
                         )
                 plan_applied = True
+        if plan_applied:
+            # quantized-serving plan fields (ISSUE 15): a MEASURED base/KV
+            # format becomes the production default for this geometry;
+            # explicit BENCH_* pins still win (setdefault)
+            if resolved.plan.base_quant:
+                os.environ.setdefault(
+                    "BENCH_BASE_QUANT", resolved.plan.base_quant
+                )
+            if resolved.plan.kv_format:
+                os.environ.setdefault(
+                    "BENCH_KV_FORMAT", resolved.plan.kv_format
+                )
         if not plan_applied:
             os.environ.setdefault("BENCH_SCAN_CHUNK", "16")
             os.environ.setdefault("BENCH_TOP_P_IMPL", "bisect_mw")
-        # kv_quant is a capacity knob, not a plan-space choice — the int8
-        # production default stays regardless of the DB
+        # DB-less fallback: int8 KV stays the hard-coded production guess
+        # (a stored kv_format above outranks it via BENCH_KV_FORMAT)
         os.environ.setdefault("BENCH_KV_QUANT", "int8")
 
     # the CPU fallback's dot thunk has no bf16 support — use f32 off-TPU
@@ -735,7 +764,23 @@ def main() -> int:
         PagedGenerationEngine if os.environ.get("BENCH_ENGINE") == "paged"
         else GenerationEngine
     )
-    engine_kwargs = {"kv_quant": os.environ.get("BENCH_KV_QUANT", "none")}
+    # KV format (ISSUE 15): BENCH_KV_FORMAT (plan-field spelling) or the
+    # legacy BENCH_KV_QUANT; an explicit value — including "none" — pins the
+    # engine past any stored plan, unset leaves the plan DB in charge
+    # (ExecutionPlan.kv_format; empty DB = "none", the historical default)
+    kv_env = os.environ.get("BENCH_KV_FORMAT") or os.environ.get(
+        "BENCH_KV_QUANT"
+    )
+    if kv_env and kv_env not in ("none", "int8"):
+        _emit({
+            "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": f"invalid BENCH_KV_FORMAT/BENCH_KV_QUANT={kv_env!r} "
+                     "(expected none/int8)",
+            "backend": jax.devices()[0].platform,
+        })
+        return 1
+    engine_kwargs = {"kv_quant": kv_env}  # None = plan-DB-resolvable
     # Engine-level plan resolution tracks bench's own: production-default
     # runs let the engine consult the DB (the feature), while explicit A/B
     # invocations (BENCH_NO_FALLBACK=1 → prod_defaults off) pin the static
@@ -878,6 +923,17 @@ def main() -> int:
     import importlib
 
     importlib.import_module("distrl_llm_tpu.ops.paged").dispatch_choices.clear()
+    # same scoping for the ISSUE 15 trace-time dispatch records: which
+    # sampler implementation and which quant-matmul path THIS run ran
+    importlib.import_module(
+        "distrl_llm_tpu.ops.sampling"
+    ).sample_dispatch_choices.clear()
+    importlib.import_module(
+        "distrl_llm_tpu.ops.quant_matmul"
+    ).dispatch_choices.clear()
+    # measured bytes/token (ISSUE 15): have the engines file their decode
+    # step programs' XLA cost_analysis (resets with the tracker above)
+    os.environ.setdefault("DISTRL_MEASURE_COST", "1")
     # scope the obs compile/retrace tracker to this run the same way: the
     # recompile_count field must describe THIS config's programs only
     importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
@@ -1017,7 +1073,10 @@ def main() -> int:
     from distrl_llm_tpu.engine.budget import tree_bytes
 
     roofline = _decode_roofline_tok_s(
-        tree_bytes(params), cfg, engine_kwargs["kv_quant"], slot_rows,
+        tree_bytes(params), cfg,
+        # the ENGINE-resolved format (explicit pin or plan-DB) — the
+        # roofline must describe the bytes the run actually streamed
+        (getattr(engine, "kv_quant", None) or "none"), slot_rows,
         mean_kv, hbm_gbps,
         tokens_per_slot_step=(accept_rate or 1.0) if spec_ran else 1.0,
     )
@@ -1082,6 +1141,47 @@ def main() -> int:
         us_per_grid_step = round(
             dt * 1e6 / (grid_steps_estimate * steps_dispatched), 3
         )
+    # ---- quantized-serving self-description (ISSUE 15) -------------------
+    # effective KV format: what the engine RESOLVED (explicit env pin or
+    # plan-DB), not what the env requested; fleet rows (worker-side
+    # engines) honestly read null
+    kv_ran = getattr(engine, "kv_quant", None) if not fleet_n else None
+    # measured bytes/token from the decode step program's XLA cost_analysis
+    # (DISTRL_MEASURE_COST): one step streams `step_bytes_accessed`; over
+    # the timed window that is steps x bytes / tokens — for engines that
+    # don't count steps (dense waves), one token per slot row per step
+    # gives bytes/slot_rows (exact under BENCH_NO_EOS). Null when the
+    # backend reports no cost analysis — never a fabricated number.
+    _costs_now = importlib.import_module("distrl_llm_tpu.obs").costs()
+    _step_what = (
+        "decode_step/spec" if spec_ran
+        else ("decode_step/refill" if scheduler_ran == "refill"
+              else ("decode_step/paged"
+                    if os.environ.get("BENCH_ENGINE") == "paged"
+                    else "decode_step/dense"))
+    )
+    step_bytes_accessed = (
+        _costs_now.get(_step_what, {}).get("bytes_accessed")
+        if not fleet_n else None
+    )
+    bytes_per_token = None
+    if step_bytes_accessed:
+        if steps_dispatched and total_tokens:
+            bytes_per_token = round(
+                step_bytes_accessed * steps_dispatched / total_tokens, 1
+            )
+        elif total_tokens:
+            bytes_per_token = round(step_bytes_accessed / slot_rows, 1)
+    # which sampler implementation the engine's steps dispatched (the
+    # sample_with_logprob trace-time record; distinct choices joined "+")
+    _samp = importlib.import_module("distrl_llm_tpu.ops.sampling")
+    _samp_choices = sorted(set(_samp.sample_dispatch_choices.values()))
+    sample_kernel = "+".join(_samp_choices) if _samp_choices else None
+    # whether quantized base matmuls ran the fused kernel or the XLA
+    # container path (null when the base is unquantized — no dispatch)
+    _qmm = importlib.import_module("distrl_llm_tpu.ops.quant_matmul")
+    _qmm_choices = sorted(set(_qmm.dispatch_choices.values()))
+    quant_matmul_ran = "+".join(_qmm_choices) if _qmm_choices else None
     record = {
         "metric": "rollout_tokens_per_sec_per_chip",
         "engine": os.environ.get("BENCH_ENGINE", "dense"),
@@ -1124,7 +1224,22 @@ def main() -> int:
         "mfu": round(mfu, 6),
         "model": name,
         "base_quant": base_quant,
-        "kv_quant": engine_kwargs["kv_quant"],
+        # effective KV format the engine resolved (plan-field spelling;
+        # "kv_quant" kept as the legacy alias of the same value)
+        "kv_format": kv_ran,
+        "kv_quant": kv_ran,
+        # measured-bytes scoreboard (ISSUE 15, pinned in
+        # tests/test_bench_contract.py): XLA cost_analysis bytes of ONE
+        # decode step program and the derived HBM bytes per generated
+        # token — the metric every quantized-serving sub-item must move;
+        # bench_history scores bytes_per_token lower-is-better
+        "step_bytes_accessed": step_bytes_accessed,
+        "bytes_per_token": bytes_per_token,
+        # which sampler ran ("fused" one-pass kernel vs "xla" multi-pass)
+        # and which matmul path served the quantized base ("kernel" fused
+        # dequant-matmul vs "xla" container; null = unquantized base)
+        "sample_kernel": sample_kernel,
+        "quant_matmul": quant_matmul_ran,
         "top_p_impl": sampling.resolved_top_p_impl(
             getattr(engine, "plan_top_p_impl", None)
         ),

@@ -131,7 +131,11 @@ def build_engine_for_plan(
         eos_token_ids=list(eos_ids),
         pad_token_id=pad_id,
         cache_dtype=cache_dtype,
-        kv_quant=kv_quant,
+        # a kv_format candidate (ISSUE 15) IS the engine's KV format; a
+        # None-field candidate falls back to the sweep-level kv_quant
+        kv_quant=(
+            plan.kv_format if plan.kv_format is not None else kv_quant
+        ),
         scan_chunk=plan.scan_chunk,
         autotune=False,
     )
@@ -244,7 +248,26 @@ def tune_geometry(
     from distrl_llm_tpu.engine.budget import tree_bytes
 
     rows = n_prompts * n_candidates
-    param_bytes = tree_bytes(params)
+    # per-base-format param trees (ISSUE 15), quantized once per format the
+    # candidate space names: a base_quant candidate is measured over the
+    # int8/int4 containers it describes (the fused dequant-matmul kernel
+    # where enabled), and its memory guard sees the SHRUNK resident bytes
+    # — the capacity win is part of what makes a quantized plan feasible
+    _params_by_quant: dict[str, object] = {"none": params}
+
+    def _params_for(plan: ExecutionPlan):
+        bq = plan.base_quant or "none"
+        if bq not in _params_by_quant:
+            from distrl_llm_tpu.ops.quant import (
+                default_group_size, quant_bits_for, quantize_params,
+            )
+
+            bits = quant_bits_for(bq)
+            _params_by_quant[bq] = quantize_params(
+                params, bits=bits, group_size=default_group_size(bits)
+            )
+        return _params_by_quant[bq]
+
     rng = np.random.default_rng(0)
     prompts = rng.integers(
         1, min(model_cfg.vocab_size, 50_000),
@@ -254,10 +277,13 @@ def tune_geometry(
 
     results: list[CandidateResult] = []
     for plan in candidates:
+        cand_params = _params_for(plan)
+        cand_kv = plan.kv_format if plan.kv_format is not None else kv_quant
         reason = plan_memory_guard(
             model_cfg, plan, rows=rows, max_prompt_tokens=max_prompt_tokens,
-            max_new_tokens=max_new_tokens, param_bytes=param_bytes,
-            kv_quant=kv_quant, hbm_bytes=hbm_bytes,
+            max_new_tokens=max_new_tokens,
+            param_bytes=tree_bytes(cand_params),
+            kv_quant=cand_kv, hbm_bytes=hbm_bytes,
         )
         if reason is not None:
             log.warning("autotune: %s infeasible: %s", plan.to_dict(), reason)
@@ -293,7 +319,7 @@ def tune_geometry(
 
             def run(seed: int) -> int:
                 res = engine.generate(
-                    params, lora, prompts, pmask, sampling,
+                    cand_params, lora, prompts, pmask, sampling,
                     jax.random.PRNGKey(seed),
                 )
                 return int(res.lengths.sum())

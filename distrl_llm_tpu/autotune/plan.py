@@ -38,6 +38,21 @@ SPEC_VERIFIES = (None, "fused", "unrolled")
 #: prefill feeding freed slots; "batch" = the fixed-episode-batch pin;
 #: None = the engine default (fixed batches)
 CB_MODES = (None, "batch", "continuous")
+#: KV-cache storage formats (ISSUE 15): "int8" = per-token absmax int8 KV
+#: (compact-scales Pallas variants on the paged/blocked/verify kernels —
+#: ops/paged_int8.py); "none" = bf16/f32; None = the engine default
+#: ("none"), i.e. an empty DB keeps today's behavior byte-identically.
+#: Engines take ``kv_quant=None`` → consult this field; an explicit
+#: "none"/"int8" kwarg pins past any stored plan (the decode_scan_chunk
+#: convention: default ≠ pin).
+KV_FORMATS = (None, "none", "int8")
+#: frozen-base weight formats (ISSUE 15): int8/int4 weight-only containers
+#: (ops/quant.py) consumed by the fused dequant-matmul kernel
+#: (ops/quant_matmul.py). The ENGINE never loads weights, so this field is
+#: consumed by the callers that build the base tree (bench production
+#: defaults, tools/autotune.py measure, microbench) — stored so a tuned
+#: "int4 base + int8 KV" serving stack is one DB entry, not a flag recipe.
+BASE_QUANTS = (None, "none", "int8", "int4")
 #: draft lengths beyond this waste verify width faster than they amortize
 #: weight reads (and the engine rejects them) — plan validation mirrors it
 MAX_SPEC_DRAFT_LEN = 16
@@ -110,6 +125,14 @@ class ExecutionPlan:
     # that can't host it (wave scheduler, no row cap) drop a stored
     # "continuous" entry with a warning, same policy as the spec fields.
     cb_mode: str | None = None
+    # KV-cache storage format (ISSUE 15): "int8" per-token-absmax KV /
+    # "none" bf16-f32; None = engine default ("none"). Engines built with
+    # kv_quant=None adopt this; an explicit engine kwarg pins past it.
+    kv_format: str | None = None
+    # frozen-base weight format (ISSUE 15): "int8"/"int4" weight-only
+    # containers / "none" full-width; None = caller default. Consumed by
+    # the weight-loading callers (bench/autotune), not the engines.
+    base_quant: str | None = None
 
     def __post_init__(self):
         if self.decode_path not in DECODE_PATHS:
@@ -177,6 +200,16 @@ class ExecutionPlan:
         if self.cb_mode not in CB_MODES:
             raise ValueError(
                 f"cb_mode must be one of {CB_MODES}, got {self.cb_mode!r}"
+            )
+        if self.kv_format not in KV_FORMATS:
+            raise ValueError(
+                f"kv_format must be one of {KV_FORMATS}, got "
+                f"{self.kv_format!r}"
+            )
+        if self.base_quant not in BASE_QUANTS:
+            raise ValueError(
+                f"base_quant must be one of {BASE_QUANTS}, got "
+                f"{self.base_quant!r}"
             )
 
     def replace(self, **kw) -> "ExecutionPlan":
@@ -293,6 +326,8 @@ def candidate_plans(
     spec_drafters=(None,),
     spec_verifies=(None,),
     cb_modes=(None,),
+    kv_formats=(None,),
+    base_quants=(None,),
 ) -> list[ExecutionPlan]:
     """Enumerate a candidate space for the tuner (cartesian product, with
     the always-meaningless combos dropped: a formulation override without a
@@ -301,7 +336,11 @@ def candidate_plans(
     dense path, a pages_per_block without the blocked kernel, spec knobs
     anywhere but the speculative path, a cb_mode on the dense path — the
     admission scheduler is paged-refill machinery — and a speculative path
-    with no draft length, which is just the paged path wearing a costume)."""
+    with no draft length, which is just the paged path wearing a costume).
+    ``kv_formats``/``base_quants`` (ISSUE 15) apply on every path: the
+    dense engine hosts the int8 scale-carrying cache and the paged/
+    speculative kernels their compact-scales variants, and the quantized
+    base rides any decode path."""
     out = []
     for path in decode_paths:
         for chunk in scan_chunks:
@@ -328,17 +367,21 @@ def candidate_plans(
                                     for cb in cb_modes:
                                         if cb is not None and path == "dense":
                                             continue
-                                        for tp in top_p_impls:
-                                            out.append(ExecutionPlan(
-                                                decode_path=path,
-                                                scan_chunk=chunk,
-                                                cache_read_formulation=form,
-                                                top_p_impl=tp,
-                                                paged_kernel=pk,
-                                                pages_per_block=ppb,
-                                                spec_draft_len=sd,
-                                                spec_drafter=drafter,
-                                                spec_verify=sv,
-                                                cb_mode=cb,
-                                            ))
+                                        for kvf in kv_formats:
+                                            for bq in base_quants:
+                                                for tp in top_p_impls:
+                                                    out.append(ExecutionPlan(
+                                                        decode_path=path,
+                                                        scan_chunk=chunk,
+                                                        cache_read_formulation=form,
+                                                        top_p_impl=tp,
+                                                        paged_kernel=pk,
+                                                        pages_per_block=ppb,
+                                                        spec_draft_len=sd,
+                                                        spec_drafter=drafter,
+                                                        spec_verify=sv,
+                                                        cb_mode=cb,
+                                                        kv_format=kvf,
+                                                        base_quant=bq,
+                                                    ))
     return out

@@ -159,6 +159,11 @@ class TrainConfig:
     # weight-only quantization of the frozen base: {"none","int8","int4"}
     # (reference uses NF4 via bitsandbytes — LOAD_IN_4BIT, distributed_actor.py:17)
     base_quant: str = "none"
+    # quantization group size along the input dim for base_quant (ISSUE 15):
+    # None = per-format default (int8: per-column scales; int4: 64-wide
+    # blocks, bnb's blockwise NF4 knob). Must divide the model's projection
+    # input dims; requires base_quant != "none" (dead-flag policy).
+    quant_group_size: int | None = None
     # 8-bit blockwise optimizer state (reference: bnb.optim.Adam8bit, :209)
     optimizer_8bit: bool = True
     # Skip semantics for all-zero-reward microbatches. The reference intends
@@ -209,11 +214,16 @@ class TrainConfig:
     # over the rollout mesh's dp axis via shard_map — engine/sharded_paged.py;
     # wave scheduler, dp-only meshes)
     engine_impl: str = "dense"
-    # KV cache quantization for the paged engine: "none" or "int8" (per-token
-    # absmax). Halves the cache's RESIDENT memory (fit bigger batches); note
-    # the current jaxlib kernel materializes broadcast scales per step, so
-    # this is a capacity knob, not a decode-speed knob (ops/paged.py)
-    kv_cache_quant: str = "none"
+    # KV cache quantization: "none" or "int8" (per-token absmax; the
+    # compact-scales Pallas launches keep per-element traffic at
+    # ~1 byte — ops/paged_int8.py — so int8 KV is a bandwidth AND capacity
+    # knob). None (default) = let the autotune plan DB decide per
+    # (device, model, geometry) via ExecutionPlan.kv_format — the int8
+    # serving default is MEASURED in, not hard-coded; with an empty DB the
+    # engines fall back to "none", byte-identical to the historical
+    # default. An EXPLICIT value — including "none" — always wins over any
+    # stored plan (the decode_scan_chunk convention: default ≠ pin).
+    kv_cache_quant: str | None = None
     # K decode steps per dispatch in the dense engine (lax.scan inside one
     # jitted program). Over a network-tunneled PJRT client each dispatch can
     # cost a round trip that bounds decode throughput regardless of chip
@@ -608,9 +618,21 @@ class TrainConfig:
                 f"engine_impl must be dense/paged/paged_sharded, got "
                 f"{self.engine_impl!r}"
             )
-        if self.kv_cache_quant not in ("none", "int8"):
+        if self.kv_cache_quant not in (None, "none", "int8"):
             raise ValueError(
-                f"kv_cache_quant must be none/int8, got {self.kv_cache_quant!r}"
+                f"kv_cache_quant must be none/int8 (or unset = plan-DB-"
+                f"resolved), got {self.kv_cache_quant!r}"
+            )
+        if self.quant_group_size is not None and self.quant_group_size < 1:
+            raise ValueError(
+                f"quant_group_size must be >= 1, got {self.quant_group_size}"
+            )
+        if self.quant_group_size is not None and self.base_quant == "none":
+            # dead-flag policy: the group size shapes the base containers,
+            # which only exist under base_quant
+            raise ValueError(
+                "quant_group_size configures base_quant's groupwise scales "
+                "— set base_quant int8/int4 (it would be silently ignored)"
             )
         if self.engine_impl == "paged_sharded" and (
             self.continuous_batching or self.spec_draft
@@ -891,7 +913,8 @@ class TrainConfig:
                 f"producer_restarts must be >= 0, got {self.producer_restarts}"
             )
         if self.rollout_workers and (
-            self.kv_cache_quant != "none" or self.engine_impl != "dense"
+            self.kv_cache_quant not in (None, "none")
+            or self.engine_impl != "dense"
         ):
             # remote workers build their own engines (worker_main flags);
             # silently ignoring these knobs would misreport memory behavior

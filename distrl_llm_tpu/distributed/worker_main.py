@@ -53,7 +53,9 @@ _FLAKY_COUNTS: dict[str, int] = {}
 
 def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  seed: int, lora_rank: int = 32, lora_alpha: float = 16.0,
-                 engine_impl: str = "dense", kv_quant: str = "none",
+                 engine_impl: str = "dense", kv_quant: str | None = None,
+                 base_quant: str = "none",
+                 quant_group_size: int | None = None,
                  max_concurrent: int = 0, scheduler: str = "waves",
                  decode_chunk: int | None = None,
                  spec_draft: int | None = None, spec_ngram: int | None = None,
@@ -94,10 +96,26 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
         eos = [tok.eos_token_id]
         pad = tok.pad_token_id if tok.pad_token_id is not None else tok.eos_token_id
         cache_dtype = jnp.bfloat16
+    if base_quant != "none":
+        # quantized frozen base (ISSUE 15): the worker serves the SAME
+        # int8/int4 containers the driver's --base_quant run trains over,
+        # decoded through the fused dequant-matmul kernel where enabled
+        # (ops/quant_matmul.py; probe-gated, XLA container fallback)
+        from distrl_llm_tpu.ops.quant import (
+            default_group_size, quant_bits_for, quantize_params,
+        )
+
+        bits = quant_bits_for(base_quant)
+        params = quantize_params(
+            params, bits=bits,
+            group_size=quant_group_size or default_group_size(bits),
+        )
     from distrl_llm_tpu.models.lora import lora_scale as _scale
 
     _ENGINE_STATE["lora_scale"] = _scale(lora_rank, lora_alpha)
-    kwargs = {"kv_quant": kv_quant}  # both engines support int8 KV
+    # None = this host's plan DB decides (ExecutionPlan.kv_format); an
+    # explicit --kv-quant, including "none", pins — both engines support it
+    kwargs = {"kv_quant": kv_quant}
     if capture_logprobs:
         # behavior-logprob capture for driver-side off-policy corrections
         # (clip / async truncated-IS): the handler already ships
@@ -167,7 +185,10 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                 batch_prompts=budget_batch,
                 max_prompt_tokens=max_prompt_tokens,
                 max_new_tokens=max_new_tokens,
-                page_size=DEFAULT_PAGE_SIZE, kv_quant=kv_quant,
+                # pool sizing sees only the EXPLICIT format (the
+                # spec_draft convention): a plan-DB-resolved int8 KV
+                # leaves the pool sized for bf16 pages — slack, never OOM
+                page_size=DEFAULT_PAGE_SIZE, kv_quant=kv_quant or "none",
                 # pool sizing sees only the EXPLICIT draft length (trainer
                 # convention): a plan-DB entry that enables speculation
                 # (spec_draft None) isn't resolved until engine
@@ -490,8 +511,24 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--lora-alpha", type=float, default=16.0)
     parser.add_argument("--engine-impl", type=str, default="dense",
                         choices=["dense", "paged"])
-    parser.add_argument("--kv-quant", type=str, default="none",
-                        choices=["none", "int8"])
+    parser.add_argument("--kv-quant", type=str, default=None,
+                        choices=["none", "int8"],
+                        help="KV cache quantization; unset = this host's "
+                             "autotune plan DB decides (kv_format; empty "
+                             "DB = none). An explicit value, including "
+                             "none, always wins over any stored plan")
+    parser.add_argument("--base-quant", type=str, default="none",
+                        choices=["none", "int8", "int4"],
+                        help="weight-only quantization of this worker's "
+                             "frozen base (the driver's --base_quant "
+                             "counterpart on the serve path); decode runs "
+                             "the fused dequant-matmul kernel where "
+                             "enabled (DISTRL_QUANT_MATMUL)")
+    parser.add_argument("--quant-group-size", type=int, default=None,
+                        help="groupwise-scale width for --base-quant "
+                             "(must divide the projection input dims); "
+                             "unset = per-format default (int8: "
+                             "per-column, int4: 64)")
     parser.add_argument("--max-concurrent-sequences", type=int, default=0,
                         help="decode row cap (vLLM max_num_seqs); 0 = unlimited")
     # driver-side spelling is --continuous_batching (a bool that maps to
@@ -667,6 +704,16 @@ def main(argv: list[str] | None = None) -> None:
         telemetry.configure(enabled=True)
     if args.decode_chunk is not None and args.decode_chunk < 1:
         parser.error("--decode-chunk must be >= 1")
+    if args.quant_group_size is not None and args.quant_group_size < 1:
+        parser.error("--quant-group-size must be >= 1")
+    if args.quant_group_size is not None and args.base_quant == "none":
+        # dead-flag policy (driver parity: TrainConfig rejects the same
+        # combination) — the group size only shapes base containers
+        parser.error(
+            "--quant-group-size configures --base-quant's groupwise "
+            "scales — set --base-quant int8/int4 (it would be silently "
+            "ignored)"
+        )
     if args.scheduler == "refill" and args.engine_impl != "paged":
         parser.error("--scheduler refill requires --engine-impl paged")
     if args.scheduler != "refill" and (
@@ -775,6 +822,8 @@ def main(argv: list[str] | None = None) -> None:
             args.serve_model, args.max_prompt_tokens, args.max_new_tokens,
             args.seed, lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
             engine_impl=args.engine_impl, kv_quant=args.kv_quant,
+            base_quant=args.base_quant,
+            quant_group_size=args.quant_group_size,
             max_concurrent=args.max_concurrent_sequences,
             scheduler=args.scheduler, decode_chunk=args.decode_chunk,
             spec_draft=args.spec_draft,

@@ -44,7 +44,7 @@ from distrl_llm_tpu.models.configs import ModelConfig
 from distrl_llm_tpu.models.transformer import (
     forward, init_kv_cache, init_kv_cache_int8,
 )
-from distrl_llm_tpu.ops.sampling import sample, token_logprob
+from distrl_llm_tpu.ops.sampling import sample_with_logprob
 
 Params = dict[str, Any]
 
@@ -143,12 +143,19 @@ def _decode_step(params, lora, state: _DecodeState, rng,
     once every row has hit EOS the remaining steps are never dispatched (the
     fixed-shape analogue of continuous batching draining its tail)."""
     s = state
-    tok = sample(jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
-                 top_p_impl=top_p_impl)
+    # fused sample+logprob when the kernel is enabled (DISTRL_SAMPLE_KERNEL
+    # / probe — ops/sampling.py), multi-pass reference otherwise; greedy
+    # outputs bit-identical either way. Done rows' logprobs are zeroed
+    # below, so the pre-pad-substitution logprob is observably identical
+    # to the old post-substitution token_logprob.
+    tok, logp_s = sample_with_logprob(
+        jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
+        top_p_impl=top_p_impl, capture_logprob=capture_logprobs,
+    )
     tok = jnp.where(s.done, pad_id, tok)
     out = jax.lax.dynamic_update_slice(s.out, tok[:, None], (0, s.step))
     if capture_logprobs:  # per-step vocab logsumexp — only when requested
-        logp = jnp.where(s.done, 0.0, token_logprob(s.logits, tok))
+        logp = jnp.where(s.done, 0.0, logp_s)
         logps = jax.lax.dynamic_update_slice(
             s.logps, logp[:, None], (0, s.step)
         )
@@ -720,7 +727,11 @@ class GenerationEngine(LoraMailbox):
         pad_token_id: int,
         lora_scale: float = 1.0,
         cache_dtype=jnp.bfloat16,
-        kv_quant: str = "none",  # "int8": fused-dequant cache (paged parity)
+        # "int8": fused-dequant cache (paged parity). None = consult the
+        # autotune plan DB (ExecutionPlan.kv_format; empty DB = "none",
+        # byte-identical to the historical default); an explicit
+        # "none"/"int8" always wins (the decode_scan_chunk convention)
+        kv_quant: str | None = None,
         attn_impl: str = "reference",
         decode_chunk: int = 128,
         # None = consult the autotune plan DB (falls back to 0, the
@@ -742,6 +753,10 @@ class GenerationEngine(LoraMailbox):
         self.capture_logprobs = capture_logprobs
         if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        if kv_quant not in (None, "none", "int8"):
+            # validated BEFORE plan resolution so a typo'd kwarg fails with
+            # the engine's own contract, not a plan-field error
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         if cache_read_formulation not in (None, "dot", "mulred"):
             raise ValueError(
                 "cache_read_formulation must be None/'dot'/'mulred', got "
@@ -759,6 +774,10 @@ class GenerationEngine(LoraMailbox):
             requested["cache_read_formulation"] = cache_read_formulation
         if prompt_buckets is not None:
             requested["prompt_buckets"] = tuple(prompt_buckets)
+        if kv_quant is not None:
+            # explicit "none" is a real pin (the int8-default A/B control),
+            # not "unset" — the decode_scan_chunk convention
+            requested["kv_format"] = kv_quant
         self.resolved_plan = resolve_plan(
             model_cfg=cfg, max_prompt_tokens=max_prompt_tokens,
             max_new_tokens=max_new_tokens, rows=plan_rows,
@@ -811,6 +830,12 @@ class GenerationEngine(LoraMailbox):
         self.eos_ids = jnp.asarray(list(eos_token_ids), jnp.int32)
         self.pad_id = int(pad_token_id)
         self.lora_scale = lora_scale
+        # post-resolution KV format: an explicit kwarg already rode the
+        # requested dict (wins per-field); unset adopts the stored plan's
+        # kv_format, defaulting to the historical "none"
+        kv_quant = kv_quant if kv_quant is not None else (
+            plan.kv_format or "none"
+        )
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         # "int8" rides the cache_dtype static arg as a sentinel: _prefill
@@ -1019,6 +1044,13 @@ class GenerationEngine(LoraMailbox):
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
         top_p_impl = sampling.resolved_top_p_impl(self.plan_top_p_impl)
+        # measured bytes/token source (ISSUE 15; DISTRL_MEASURE_COST=1
+        # only): file the step program's XLA cost_analysis once
+        obs.maybe_record_step_cost(
+            "decode_step/dense", decode_step_fn, params, lora, state, rng,
+            eos_ids=self.eos_ids, temperature=temperature, top_p=top_p,
+            top_p_impl=top_p_impl,
+        )
         lora_cell = [lora]
         steps_seen = [0]
         # explicit enter/exit: the span must cover BOTH dispatch branches

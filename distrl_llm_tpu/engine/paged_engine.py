@@ -63,7 +63,7 @@ from distrl_llm_tpu.ops.paged import (
     make_page_table,
     pages_per_seq,
 )
-from distrl_llm_tpu.ops.sampling import sample, token_logprob
+from distrl_llm_tpu.ops.sampling import sample_with_logprob, token_logprob
 
 # telemetry series owned by the paged engine (one owner per name —
 # graftcheck GC2xx). ops/* attribute the Pallas grid-launch budget;
@@ -341,12 +341,17 @@ def _paged_decode_step(params, lora, state: _PagedDecodeState, rng, page_indices
     """One donated decode step over the paged cache (host-loop dispatched,
     zero cache-sized temps — same design as engine._decode_step)."""
     s = state
-    tok = sample(jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
-                 top_p_impl=top_p_impl)
+    # fused sample+logprob when enabled (ops/sampling.py); done rows'
+    # logprobs are zeroed below, so pre-substitution logprobs are
+    # observably identical to the old post-substitution token_logprob
+    tok, logp_s = sample_with_logprob(
+        jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
+        top_p_impl=top_p_impl, capture_logprob=capture_logprobs,
+    )
     tok = jnp.where(s.done, pad_id, tok)
     out = jax.lax.dynamic_update_slice(s.out, tok[:, None], (0, s.step))
     if capture_logprobs:
-        logp = jnp.where(s.done, 0.0, token_logprob(s.logits, tok))
+        logp = jnp.where(s.done, 0.0, logp_s)
         logps = jax.lax.dynamic_update_slice(s.logps, logp[:, None], (0, s.step))
     else:
         logps = s.logps
@@ -605,13 +610,17 @@ def _refill_decode_step(params, lora, state: _RefillState, rng,
     s = state
     total = s.out.shape[0]
     alive = ~s.done
-    tok = sample(jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
-                 top_p_impl=top_p_impl)
+    # fused sample+logprob when enabled (ops/sampling.py); dead slots'
+    # writes are dropped via the out-of-range sentinel either way, so the
+    # pre-substitution logprob is observably identical
+    tok, logp = sample_with_logprob(
+        jax.random.fold_in(rng, s.step), s.logits, temperature, top_p,
+        top_p_impl=top_p_impl, capture_logprob=capture_logprobs,
+    )
     tok = jnp.where(s.done, pad_id, tok)
     row = jnp.where(alive, s.cand, total)  # `total` is out of range → dropped
     out = s.out.at[row, s.gen_lengths].set(tok, mode="drop")
     if capture_logprobs:
-        logp = token_logprob(s.logits, tok)
         logps_buf = s.logps_buf.at[row, s.gen_lengths].set(logp, mode="drop")
     else:
         logps_buf = s.logps_buf
@@ -771,7 +780,7 @@ def _spec_admit(state, new_cand, admit_mask, last_logits, real_len,
     token, not logits), seed the n-gram sequence buffer with the packed
     prompt, and write that first token as generated output."""
     from distrl_llm_tpu.engine.speculative import SpecRefillState
-    from distrl_llm_tpu.ops.sampling import sample, token_logprob
+    from distrl_llm_tpu.ops.sampling import sample_with_logprob
 
     s = state
     total = b * n
@@ -782,8 +791,12 @@ def _spec_admit(state, new_cand, admit_mask, last_logits, real_len,
     )
 
     # first token per admitted slot, from the prompt's last-position logits
-    tok0 = sample(rng, last_logits[prompt_of], temperature, top_p,
-                  top_p_impl=top_p_impl)
+    # (fused sample+logprob when enabled — ops/sampling.py; the rejection-
+    # sampling accept path in _spec_step is untouched)
+    tok0, logp0 = sample_with_logprob(
+        rng, last_logits[prompt_of], temperature, top_p,
+        top_p_impl=top_p_impl, capture_logprob=capture_logprobs,
+    )
     hit_eos = jnp.isin(tok0, eos_ids)
     done = jnp.where(admit_mask, ~live_new | hit_eos, s.done)
 
@@ -801,7 +814,6 @@ def _spec_admit(state, new_cand, admit_mask, last_logits, real_len,
     row = jnp.where(admit_mask & live_new, cand, total)
     out = s.out.at[row, 0].set(tok0, mode="drop")
     if capture_logprobs:
-        logp0 = token_logprob(last_logits[prompt_of], tok0)
         logps_buf = s.logps_buf.at[row, 0].set(logp0, mode="drop")
     else:
         logps_buf = s.logps_buf
@@ -1019,7 +1031,12 @@ class PagedGenerationEngine(LoraMailbox):
         paged_impl: str = "auto",
         page_size: int = 128,
         decode_chunk: int = 128,
-        kv_quant: str = "none",  # "none" | "int8" (per-token absmax KV cache)
+        # "none" | "int8" (per-token absmax KV cache, compact-scales Pallas
+        # variants). None = consult the autotune plan DB
+        # (ExecutionPlan.kv_format; empty DB = "none", byte-identical to
+        # the historical default); an explicit value — including "none" —
+        # always wins (the decode_scan_chunk convention)
+        kv_quant: str | None = None,
         prompt_buckets: Sequence[int] | None = None,  # accepted for interface parity
         max_concurrent_rows: int = 0,  # 0 = unlimited (vLLM max_num_seqs)
         max_kv_pages: int = 0,  # refill decode-page pool size; 0 = worst-case
@@ -1067,6 +1084,10 @@ class PagedGenerationEngine(LoraMailbox):
         self.capture_logprobs = capture_logprobs
         if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        if kv_quant not in (None, "none", "int8"):
+            # validated BEFORE plan resolution so a typo'd kwarg fails with
+            # the engine's own contract, not a plan-field error
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         if pages_per_block is not None and pages_per_block < 0:
             raise ValueError(
                 f"pages_per_block must be >= 0, got {pages_per_block}"
@@ -1112,6 +1133,9 @@ class PagedGenerationEngine(LoraMailbox):
             requested["cb_mode"] = (
                 "continuous" if continuous_admission else "batch"
             )
+        if kv_quant is not None:
+            # explicit "none" is a real pin (the int8-default A/B control)
+            requested["kv_format"] = kv_quant
         # the paged_kernel plan field and the paged_impl kwarg name the same
         # choice: any explicit non-"auto" kwarg wins over the DB ("kernel"/
         # "reference" have no plan spelling, so they pin the field to None —
@@ -1285,6 +1309,12 @@ class PagedGenerationEngine(LoraMailbox):
             )
         )
         self.scheduler = scheduler
+        # post-resolution KV format (explicit kwarg already won per-field
+        # via the requested dict; unset adopts the stored plan, default
+        # "none" — the historical behavior, byte-identical on an empty DB)
+        kv_quant = kv_quant if kv_quant is not None else (
+            plan.kv_format or "none"
+        )
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         self.kv_quant = kv_quant
@@ -1919,6 +1949,25 @@ class PagedGenerationEngine(LoraMailbox):
                 # horizon, never past the sequence's hard ceiling
                 return min(rl + plen + lag_tokens, rl + max_steps)
 
+        # measured bytes/token source (ISSUE 15; DISTRL_MEASURE_COST=1
+        # only): file the slot-step program's XLA cost_analysis once
+        from distrl_llm_tpu import obs as _obs
+
+        if self.spec_draft:
+            _obs.maybe_record_step_cost(
+                "decode_step/spec", self._spec_step, params, lora_cell[0],
+                state, rng, drafter_cell[0], eos_ids=self.eos_ids,
+                temperature=temperature, top_p=top_p, max_steps=max_steps,
+                draft_len=d_cell[0], ngram_k=self.spec_ngram,
+                top_p_impl=top_p_impl,
+            )
+        else:
+            _obs.maybe_record_step_cost(
+                "decode_step/refill", self._refill_step, params,
+                lora_cell[0], state, rng, eos_ids=self.eos_ids,
+                temperature=temperature, top_p=top_p, max_steps=max_steps,
+                top_p_impl=top_p_impl,
+            )
         # K-steps-per-dispatch (tunnel dispatch-overhead lever). K must
         # DIVIDE `check`: the host acts when since_host >= check, so a
         # non-divisor K stretches the effective cadence to ceil(check/K)·K
@@ -2784,6 +2833,14 @@ class PagedGenerationEngine(LoraMailbox):
         temperature = jnp.asarray(sampling.temperature, jnp.float32)
         top_p = jnp.asarray(sampling.top_p, jnp.float32)
         top_p_impl = sampling.resolved_top_p_impl(self.plan_top_p_impl)
+        # measured bytes/token source (ISSUE 15; DISTRL_MEASURE_COST=1 only)
+        from distrl_llm_tpu import obs as _obs
+
+        _obs.maybe_record_step_cost(
+            "decode_step/paged", self._decode_step, params, lora, state,
+            rng, page_indices, eos_ids=self.eos_ids, temperature=temperature,
+            top_p=top_p, top_p_impl=top_p_impl,
+        )
         lora_cell = [lora]
         steps_seen = [0]
 

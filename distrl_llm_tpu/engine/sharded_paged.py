@@ -113,7 +113,9 @@ class ShardedPagedEngine(LoraMailbox):
         paged_impl: str = "auto",
         page_size: int = 128,
         decode_chunk: int = 128,
-        kv_quant: str = "none",
+        # None = consult the autotune plan DB (ExecutionPlan.kv_format;
+        # empty DB = "none"); an explicit value — including "none" — pins
+        kv_quant: str | None = None,
         prompt_buckets: Sequence[int] | None = None,  # interface parity
         # None = consult the autotune plan DB (falls back to 0, the
         # historical default); an explicit int — including 0 — always wins
@@ -139,6 +141,10 @@ class ShardedPagedEngine(LoraMailbox):
             )
         if scan_chunk is not None and scan_chunk < 0:
             raise ValueError(f"scan_chunk must be >= 0, got {scan_chunk}")
+        if kv_quant not in (None, "none", "int8"):
+            # validated BEFORE plan resolution so a typo'd kwarg fails with
+            # the engine's own contract, not a plan-field error
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         if pages_per_block is not None and pages_per_block < 0:
             raise ValueError(
                 f"pages_per_block must be >= 0, got {pages_per_block}"
@@ -154,6 +160,9 @@ class ShardedPagedEngine(LoraMailbox):
             requested["scan_chunk"] = scan_chunk
         if pages_per_block is not None:
             requested["pages_per_block"] = pages_per_block
+        if kv_quant is not None:
+            # explicit "none" is a real pin (the int8-default A/B control)
+            requested["kv_format"] = kv_quant
         if paged_impl != "auto":
             # same contract as PagedGenerationEngine: an explicit kwarg —
             # including the plan-unrepresentable "kernel"/"reference" —
@@ -195,6 +204,12 @@ class ShardedPagedEngine(LoraMailbox):
         self.decode_chunk = decode_chunk
         self.capture_logprobs = capture_logprobs
         self.prompt_buckets = [max_prompt_tokens]
+        # post-resolution KV format (explicit kwarg already won per-field)
+        kv_quant = kv_quant if kv_quant is not None else (
+            self.resolved_plan.plan.kv_format or "none"
+        )
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
         self._kv_quant = kv_quant
         self._prefill_kw = dict(
             cfg=cfg, prompt_pages=self.prompt_pages, page_size=page_size,

@@ -68,9 +68,39 @@ _TARGET_STREAM = {
 
 def _proj(h, p, lora, key, bias_key, lora_scale,
           lora_dropout: float = 0.0, dropout_rng=None):
-    """One projection with optional bias and optional LoRA delta."""
+    """One projection with optional bias and optional LoRA delta.
+
+    A quantized base weight with an active adapter (and no LoRA dropout —
+    dropout perturbs the adapter INPUT, which the epilogue can't express)
+    dispatches to the fused Pallas dequant-matmul with the LoRA delta
+    applied in the kernel epilogue (ops/quant_matmul.py): one program, one
+    output-tile round-trip, weight streamed at int width. Same math order
+    as the split path — (dot + bias) + delta — so greedy decode is
+    bit-identical whichever path ran."""
+    has_lora = lora is not None and key in lora
+    w = p[key]
+    if (
+        has_lora and isinstance(w, dict) and w["q"].ndim == 3
+        and (lora_dropout <= 0.0 or dropout_rng is None)
+    ):
+        from distrl_llm_tpu.ops.quant_matmul import (
+            dispatch_choices, quant_matmul, quant_matmul_dispatch,
+        )
+
+        a, b = lora[key]["a"], lora[key]["b"]
+        bits = 4 if w["q"].dtype == jnp.int4 else 8
+        use, interp = quant_matmul_dispatch(
+            w["q"].shape, bits, a.shape[-1], h.shape[-1], h.dtype
+        )
+        dispatch_choices[
+            (bits, h.shape[-1], w["q"].shape[-1], a.shape[-1])
+        ] = "kernel" if use else "xla"
+        if use:
+            return quant_matmul(
+                h, w, p.get(bias_key), a, b, lora_scale, interpret=interp
+            )
     y = linear(h, p[key], p.get(bias_key))
-    if lora is not None and key in lora:
+    if has_lora:
         rng = (
             jax.random.fold_in(dropout_rng, _TARGET_STREAM[key])
             if dropout_rng is not None else None

@@ -241,6 +241,36 @@ def costs() -> dict[str, dict[str, float]]:
         return {k: dict(v) for k, v in _costs.items()}
 
 
+def cost_measurement_enabled() -> bool:
+    """DISTRL_MEASURE_COST=1 (bench sets it): engines AOT-lower their
+    decode-step programs once and file the XLA cost_analysis — the
+    measured-bytes/token source for bench rows and the trace_report
+    roofline section (ISSUE 15). Off by default: the AOT compile is
+    measurement-only work (deduped by the persistent XLA compile cache,
+    but not free)."""
+    return os.environ.get("DISTRL_MEASURE_COST") == "1"
+
+
+def maybe_record_step_cost(what: str, fn_jit, *args, **kwargs) -> None:
+    """AOT-lower+compile ``fn_jit`` at these concrete args and record its
+    cost_analysis under ``what`` — once per name, only under
+    DISTRL_MEASURE_COST=1. Never raises: backends without AOT/cost
+    analysis leave the entry absent (bench reports null, not a fabricated
+    number). ``lower`` only traces — donated args are not consumed."""
+    if not cost_measurement_enabled():
+        return
+    with _compile_mu:
+        if what in _costs:
+            return
+    try:
+        record_cost(what, fn_jit.lower(*args, **kwargs).compile())
+    except Exception as e:  # noqa: BLE001 — measurement must not kill a run
+        logging.getLogger(__name__).warning(
+            "step-cost measurement for %s failed (%s: %s)",
+            what, type(e).__name__, e,
+        )
+
+
 # --------------------------------------------------------------- exposition
 
 

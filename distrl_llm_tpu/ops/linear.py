@@ -15,11 +15,30 @@ import jax.numpy as jnp
 
 def linear(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
     """y = x @ w (+ b). ``w`` is either a plain [in, out] array or a quantized
-    container dict (ops/quant.py): {"q": [G, g, out], "scale": [G, 1, out]}."""
+    container dict (ops/quant.py): {"q": [G, g, out], "scale": [G, 1, out]}.
+
+    Quantized containers dispatch to the fused Pallas dequant-matmul
+    (ops/quant_matmul.py) when it is enabled for this backend
+    (DISTRL_QUANT_MATMUL; probe-gated "auto" = TPU only), else to the XLA
+    container path below — same math, same order, greedy-bit-identical."""
     if isinstance(w, dict):
+        if w["q"].ndim == 3:
+            from distrl_llm_tpu.ops.quant_matmul import (
+                dispatch_choices, quant_matmul, quant_matmul_dispatch,
+            )
+
+            bits = 4 if w["q"].dtype == jnp.int4 else 8
+            use, interp = quant_matmul_dispatch(
+                w["q"].shape, bits, 0, x.shape[-1], x.dtype
+            )
+            dispatch_choices[(bits, x.shape[-1], w["q"].shape[-1], 0)] = (
+                "kernel" if use else "xla"
+            )
+            if use:
+                return quant_matmul(x, w, b, interpret=interp)
         # dequant folded into the matmul: XLA fuses the convert+scale into
         # the MXU operand read, so the weight moves through HBM at int8/int4
-        # width (the N4 dequant-matmul, no custom kernel needed)
+        # width (the N4 dequant-matmul — the fused kernel's exact-fallback)
         # q·scale in f32 (scale is stored f32 — bf16-rounding the scales
         # would stack ~0.4% error on the quantization error), cast once
         wq = (w["q"].astype(jnp.float32) * w["scale"]).astype(x.dtype)

@@ -128,15 +128,19 @@ def _launch(
         pltpu.VMEM((2, pages_per_compute_block, page_size, 1), v_s.dtype)
         if quantized
         else None,
-        pltpu.SemaphoreType.DMA((2,)),
-        pltpu.SemaphoreType.DMA((2,)),
+        # ONE shared DMA semaphore, matching the pinned jaxlib's kernel
+        # signature (17 positional args): the K and V copy descriptors both
+        # signal/wait it. Older jaxlibs took separate K/V semaphore arrays —
+        # the signature drift that had these launches env-skipped (ISSUE 15
+        # satellite; the drift PR 3 fixed for the other 16 tests).
+        pltpu.SemaphoreType.DMA,
     )
 
     operands = (
         lengths,
         page_indices.reshape(-1),
         jnp.zeros((1,), jnp.int32),  # buffer index
-        jnp.ones((1,), jnp.int32),  # init flag
+        jnp.zeros((1,), jnp.int32),  # step (0 prefetches the first block)
         q.astype(q_dtype_for_kernel_launch),
         k_w,
         k_s,  # None when unquantized — matches the None in_spec/scratch

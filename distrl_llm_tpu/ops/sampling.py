@@ -9,10 +9,16 @@ the sorted distribution whose mass reaches top_p).
 
 from __future__ import annotations
 
+import functools
+import logging
+import os
+
 import jax
 import jax.numpy as jnp
 
 from distrl_llm_tpu.ops.attention import NEG_INF
+
+logger = logging.getLogger(__name__)
 
 
 def top_p_filter(logits: jax.Array, top_p: jax.Array | float) -> jax.Array:
@@ -140,6 +146,236 @@ def sample(
     sampled = jax.random.categorical(rng, filtered, axis=-1)
     is_greedy = jnp.asarray(temperature, jnp.float32) == 0.0
     return jnp.where(is_greedy, greedy, sampled).astype(jnp.int32)
+
+
+# --------------------------------------------------------- fused sampler
+# One Pallas program per logits row: temperature scale, bisect top-p
+# filter, Gumbel-max categorical draw, and the chosen token's RAW-basis
+# logprob — replacing the multi-pass softmax/sort/cumsum pipeline that
+# re-reads the [B, V] logits from HBM per pass AND the separate
+# token_logprob logsumexp pass (at decode shapes, [480, 152k] f32, the
+# sampler pipeline alone is multiple GB/step of HBM traffic; ISSUE 15).
+#
+# Greedy (temperature == 0) is argmax over the raw row — bit-identical to
+# ``sample``'s greedy branch (pinned by tools/quant_smoke.py). The sampled
+# path draws via Gumbel-max over the SAME bisect-filtered tempered
+# distribution the multi-pass path uses, with uniforms from an in-kernel
+# counter-hash PRNG (murmur3 finalizer over (per-row seed, column)) — the
+# TPU-native prng primitives don't interpret on CPU, and a pure-jnp hash
+# runs identically compiled and interpreted. The draw stream differs from
+# jax.random.categorical by construction, so the sampled path is pinned
+# DISTRIBUTION-exact (seeded statistical parity, the spec_accept
+# precedent), not bit-exact.
+
+#: trace-time dispatch record (ops.paged.dispatch_choices idiom): keyed by
+#: (rows, vocab) → "fused" | "xla"; bench reads it for the sample_kernel row
+sample_dispatch_choices: dict = {}
+
+SAMPLE_IMPLS = ("auto", "fused", "interpret", "xla")
+
+_sampler_probe_state: dict = {}
+
+
+def sample_impl_mode() -> str:
+    """Resolved DISTRL_SAMPLE_KERNEL mode (validated; default "auto")."""
+    mode = os.environ.get("DISTRL_SAMPLE_KERNEL", "auto")
+    if mode not in SAMPLE_IMPLS:
+        raise ValueError(
+            f"DISTRL_SAMPLE_KERNEL must be one of {SAMPLE_IMPLS}, got "
+            f"{mode!r}"
+        )
+    return mode
+
+
+def _fused_sample_kernel(temp_ref, topp_ref, seed_ref, logits_ref,
+                         tok_ref, logp_ref, *, iters: int):
+    """One row: (token, raw-basis logprob) in a single pass over the
+    logits. Padded columns carry NEG_INF and can never win an argmax or
+    contribute mass."""
+    raw = logits_ref[...]  # [1, Vp] f32
+    t0 = temp_ref[0, 0]
+    top_p = topp_ref[0, 0]
+
+    greedy = jnp.argmax(raw, axis=-1)  # [1]
+
+    # tempered softmax (sample()'s exact order: scale, then filter)
+    t = jnp.maximum(t0, 1e-6)
+    scaled = raw / t
+    m = jnp.max(scaled, axis=-1, keepdims=True)
+    e = jnp.exp(scaled - m)
+    z = jnp.sum(e, axis=-1, keepdims=True)
+    probs = e / z
+
+    # bisect the keep threshold (top_p_filter_bisect's math: kept mass is
+    # always >= top_p; the LOW end of the interval is the threshold)
+    def body(_, interval):
+        lo, hi = interval
+        mid = 0.5 * (lo + hi)
+        mass = jnp.sum(jnp.where(probs >= mid, probs, 0.0), axis=-1,
+                       keepdims=True)
+        ok = mass >= top_p
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    lo = jnp.zeros_like(m)
+    hi = jnp.max(probs, axis=-1, keepdims=True)
+    lo, _ = jax.lax.fori_loop(0, iters, body, (lo, hi))
+    filtered = jnp.where(probs >= lo, scaled, NEG_INF)
+
+    # Gumbel-max draw with counter-hash uniforms: murmur3 fmix32 over
+    # (seed, column) — identical bits compiled and interpreted
+    vp = raw.shape[-1]
+    col = jax.lax.broadcasted_iota(jnp.uint32, (1, vp), 1)
+    h = col * jnp.uint32(0x9E3779B9) + seed_ref[0, 0].astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # 23 high bits → u ∈ [2^-24, 1 - 2^-24], every endpoint EXACTLY
+    # representable in f32: a 24-bit mapping can round to 1.0f (prob 2^-24
+    # per element), where -log(-log(1)) = +inf hands the argmax to an
+    # arbitrary — possibly padded — column
+    u = (h >> 9).astype(jnp.float32) * jnp.float32(2.0 ** -23) + jnp.float32(
+        2.0 ** -24
+    )
+    gumbel = -jnp.log(-jnp.log(u))
+    sampled = jnp.argmax(filtered + gumbel, axis=-1)
+
+    tok = jnp.where(t0 == 0.0, greedy, sampled).astype(jnp.int32)  # [1]
+
+    # raw-basis logprob of the chosen token (token_logprob's math)
+    m_raw = jnp.max(raw, axis=-1)
+    logz = jnp.log(jnp.sum(jnp.exp(raw - m_raw[..., None]), axis=-1)) + m_raw
+    picked = jnp.max(
+        jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, vp), 1) == tok[..., None],
+            raw, NEG_INF,
+        ),
+        axis=-1,
+    )
+    tok_ref[0, 0] = tok[0]
+    logp_ref[0, 0] = (picked - logz)[0]
+
+
+def fused_sample(
+    rng: jax.Array,
+    logits: jax.Array,  # [B, V]
+    temperature: jax.Array | float,
+    top_p: jax.Array | float = 1.0,
+    *,
+    iters: int = 16,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(tokens [B] i32, raw-basis logprobs [B] f32) in one fused kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, v = logits.shape
+    vp = -(-v // 128) * 128
+    lg = logits.astype(jnp.float32)
+    if vp != v:
+        lg = jnp.pad(lg, ((0, 0), (0, vp - v)), constant_values=NEG_INF)
+    # one independent 32-bit seed per row off the caller's key — the same
+    # key the multi-pass path would hand jax.random.categorical
+    seeds = jax.random.bits(rng, (b, 1), jnp.uint32).astype(jnp.int32)
+    t = jnp.full((1, 1), 0.0, jnp.float32) + jnp.asarray(
+        temperature, jnp.float32
+    )
+    p = jnp.full((1, 1), 0.0, jnp.float32) + jnp.asarray(top_p, jnp.float32)
+    tok, logp = pl.pallas_call(
+        functools.partial(_fused_sample_kernel, iters=iters),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, vp), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(t, p, seeds, lg)
+    return tok[:, 0], logp[:, 0]
+
+
+def _sampler_lowers(vocab: int) -> bool:
+    """Probe-compile the fused sampler at this vocab — Mosaic rejections
+    fire at COMPILE time, past any try/except around a traced call inside
+    the engines' jitted steps (the ops/attention._kernel_lowers
+    discipline)."""
+    key = ("fused_sample", vocab)
+    if key not in _sampler_probe_state:
+        try:
+            jax.block_until_ready(fused_sample(
+                jax.random.PRNGKey(0), jnp.zeros((2, vocab), jnp.float32),
+                1.0, 0.9,
+            ))
+            _sampler_probe_state[key] = True
+        except Exception as e:  # noqa: BLE001 — fall back, loudly, once
+            _sampler_probe_state[key] = False
+            logger.warning(
+                "fused sampler failed its lowering probe at vocab=%d (%s); "
+                "using the multi-pass sampler", vocab, e,
+            )
+    return _sampler_probe_state[key]
+
+
+def sample_dispatch(vocab: int, top_p_impl: str) -> tuple[bool, bool]:
+    """(use_fused, interpret) per DISTRL_SAMPLE_KERNEL.
+
+    "auto" engages the kernel on TPU when the probe compiles — except under
+    an EXPLICIT exact-nucleus pin (top_p_impl="exact" is a reproducibility
+    ask the bisect-filter kernel must not silently override). Off-TPU,
+    "auto" keeps the multi-pass path (the CPU tier-1 default,
+    byte-identical to before the kernel existed)."""
+    mode = sample_impl_mode()
+    if mode == "xla":
+        return False, False
+    if mode == "interpret":
+        return True, True
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "fused":
+        return True, not on_tpu
+    if top_p_impl == "exact":
+        return False, False
+    return (on_tpu and _sampler_lowers(vocab)), False
+
+
+def sample_with_logprob(
+    rng: jax.Array,
+    logits: jax.Array,  # [B, V]
+    temperature: jax.Array | float,
+    top_p: jax.Array | float = 1.0,
+    *,
+    top_p_impl: str = "bisect",
+    capture_logprob: bool = False,
+    impl: str | None = None,
+) -> tuple[jax.Array, jax.Array | None]:
+    """The engines' one sampling entry point: (tokens [B], behavior
+    logprobs [B] or None). Dispatches to the fused kernel when enabled
+    (DISTRL_SAMPLE_KERNEL / probe), else to the multi-pass ``sample`` +
+    ``token_logprob`` reference — greedy outputs bit-identical either way."""
+    use, interp = (
+        sample_dispatch(logits.shape[-1], top_p_impl)
+        if impl is None
+        else ({"fused": (True, False), "interpret": (True, True),
+               "xla": (False, False)}[impl])
+    )
+    sample_dispatch_choices[tuple(logits.shape)] = (
+        "fused" if use else "xla"
+    )
+    if use:
+        tok, logp = fused_sample(rng, logits, temperature, top_p,
+                                 interpret=interp)
+        return tok, (logp if capture_logprob else None)
+    tok = sample(rng, logits, temperature, top_p, top_p_impl=top_p_impl)
+    return tok, (token_logprob(logits, tok) if capture_logprob else None)
 
 
 def token_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:

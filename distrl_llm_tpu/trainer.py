@@ -510,7 +510,8 @@ class Trainer:
             # distributed_actor.py:17): quantize the frozen projections before
             # sharding so shards ship at int width
             params = quantize_params(
-                params, bits=bits, group_size=default_group_size(bits)
+                params, bits=bits,
+                group_size=config.quant_group_size or default_group_size(bits),
             )
         specs = param_specs(params)
         eos = [tokenizer.eos_token_id]
@@ -614,7 +615,11 @@ class Trainer:
                     max_prompt_tokens=config.max_prompt_tokens,
                     max_new_tokens=config.max_new_tokens,
                     page_size=DEFAULT_PAGE_SIZE,
-                    kv_quant=config.kv_cache_quant,
+                    # pool sizing sees only the EXPLICIT format (the
+                    # spec_draft convention): a plan-DB entry resolving
+                    # int8 KV at engine construction leaves the pool sized
+                    # for the larger bf16 pages — slack, never an OOM
+                    kv_quant=config.kv_cache_quant or "none",
                     # pool sizing sees only the EXPLICIT draft length; a
                     # plan-DB entry that enables speculation (spec_draft
                     # None) isn't resolved until engine construction, so

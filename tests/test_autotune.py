@@ -948,3 +948,111 @@ class TestKeys:
 
         assert model_config_hash(TINY) == model_config_hash(TINY)
         assert model_config_hash(TINY) != model_config_hash(QWEN2_0_5B)
+
+
+class TestQuantPlanFields:
+    """ISSUE 15: kv_format/base_quant plan fields — validation, candidate
+    space, engine resolution, and the explicit-pin convention."""
+
+    def test_field_validation(self):
+        ExecutionPlan(kv_format="int8", base_quant="int4")
+        ExecutionPlan(kv_format="none", base_quant="none")
+        with pytest.raises(ValueError, match="kv_format"):
+            ExecutionPlan(kv_format="fp8")
+        with pytest.raises(ValueError, match="base_quant"):
+            ExecutionPlan(base_quant="int2")
+
+    def test_defaults_stay_none(self):
+        # the empty-DB byte-identity contract: DEFAULT_PLAN's new fields
+        # are None (engine default), so resolution without a DB entry
+        # leaves every engine exactly as before ISSUE 15
+        assert DEFAULT_PLAN.kv_format is None
+        assert DEFAULT_PLAN.base_quant is None
+
+    def test_candidate_space_enumerates_formats(self):
+        from distrl_llm_tpu.autotune import candidate_plans
+
+        plans = candidate_plans(
+            scan_chunks=(0,), kv_formats=(None, "int8"),
+            base_quants=(None, "int4"),
+        )
+        combos = {(p.kv_format, p.base_quant) for p in plans}
+        assert combos == {
+            (None, None), (None, "int4"), ("int8", None), ("int8", "int4"),
+        }
+
+    def test_engine_adopts_stored_kv_format(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        _write_db(db, {
+            _key(): {
+                "plan": ExecutionPlan(
+                    decode_path="dense", kv_format="int8"
+                ).to_dict(),
+                "measurements": [], "note": "",
+            },
+        })
+        eng = GenerationEngine(TINY, plan_db=db, **ENGINE_KW)
+        assert eng.kv_quant == "int8"
+        assert eng.cache_dtype == "int8"  # the scale-carrying dense cache
+
+    def test_explicit_none_pins_past_stored_int8(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        _write_db(db, {
+            _key(): {
+                "plan": ExecutionPlan(
+                    decode_path="dense", kv_format="int8"
+                ).to_dict(),
+                "measurements": [], "note": "",
+            },
+        })
+        eng = GenerationEngine(TINY, plan_db=db, kv_quant="none", **ENGINE_KW)
+        assert eng.kv_quant == "none"
+
+    def test_paged_engine_adopts_and_pins(self, tmp_path):
+        db = str(tmp_path / "db.json")
+        _write_db(db, {
+            _key(): {
+                "plan": ExecutionPlan(
+                    decode_path="paged", kv_format="int8"
+                ).to_dict(),
+                "measurements": [], "note": "",
+            },
+        })
+        eng = PagedGenerationEngine(TINY, plan_db=db, page_size=8, **ENGINE_KW)
+        assert eng.kv_quant == "int8"
+        pinned = PagedGenerationEngine(
+            TINY, plan_db=db, page_size=8, kv_quant="none", **ENGINE_KW
+        )
+        assert pinned.kv_quant == "none"
+
+    def test_empty_db_keeps_historical_default(self, tmp_path):
+        eng = GenerationEngine(
+            TINY, plan_db=str(tmp_path / "nope.json"), **ENGINE_KW
+        )
+        assert eng.kv_quant == "none"
+        assert eng.cache_dtype == jnp.float32
+
+    def test_ingest_carries_quant_provenance(self):
+        from tools.autotune import plan_from_bench_row
+
+        plan = plan_from_bench_row({
+            "engine": "dense", "scan_chunk": 0, "scan_chunk_active": None,
+            "kv_format": "int8", "base_quant": "int4",
+        })
+        assert plan.kv_format == "int8"
+        assert plan.base_quant == "int4"
+        # pre-ISSUE-15 rows: fields absent → None (engine default)
+        legacy = plan_from_bench_row({
+            "engine": "dense", "scan_chunk": 0, "scan_chunk_active": None,
+        })
+        assert legacy.kv_format is None
+        assert legacy.base_quant is None
+
+    def test_microbench_builds_kv_format_candidate(self):
+        from distrl_llm_tpu.autotune.microbench import build_engine_for_plan
+
+        eng = build_engine_for_plan(
+            TINY, ExecutionPlan(decode_path="dense", kv_format="int8"),
+            max_prompt_tokens=16, max_new_tokens=8, rows=4,
+        )
+        assert eng.kv_quant == "int8"

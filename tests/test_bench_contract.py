@@ -63,8 +63,23 @@ class TestBenchContract:
                     "slot_idle_frac",
                     "ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
                     "admission_stall_frac",
-                    "control_actions", "shed_groups"):
+                    "control_actions", "shed_groups",
+                    "kv_format", "kv_quant", "base_quant",
+                    "bytes_per_token", "step_bytes_accessed",
+                    "sample_kernel", "quant_matmul"):
             assert key in rec, key
+        # quantized-serving fields (ISSUE 15): an unpinned run resolves
+        # the KV format from the (empty) plan DB — "none", the historical
+        # default; the unquantized base never dispatches a quant matmul
+        # (honest null), and the CPU sampler default is the multi-pass
+        # path. bytes_per_token is measured cost analysis — the CPU
+        # backend provides it, so the contract pins it populated.
+        assert rec["kv_format"] == "none"
+        assert rec["kv_quant"] == "none"
+        assert rec["quant_matmul"] is None
+        assert rec["sample_kernel"] == "xla"
+        assert rec["bytes_per_token"] and rec["bytes_per_token"] > 0
+        assert rec["step_bytes_accessed"] and rec["step_bytes_accessed"] > 0
         # measured-attribution fields (ISSUE 8): CPU has no memory stats
         # (honest null, never a fabricated number), a healthy single-config
         # run retraces nothing, and bench drives the engine directly — no
@@ -225,6 +240,25 @@ class TestBenchContract:
         assert rec["ttft_p99_ms"] is None
         assert rec["queue_wait_p50_ms"] is None
         assert rec["admission_stall_frac"] is None
+
+    def test_quantized_arm_reduces_measured_bytes(self):
+        """ISSUE 15 acceptance: the int8-base + int8-KV arm must stream
+        fewer MEASURED bytes per token (decode-step cost_analysis) than
+        the bf16/f32 control at identical volume — the quantized-serving
+        scoreboard the checked-in benchmarks/r15 artifact freezes."""
+        common = {**self.TINY, "BENCH_NO_EOS": "1"}
+        ctrl = run_bench(common)
+        arm = run_bench({
+            **common, "BENCH_BASE_QUANT": "int8",
+            "BENCH_KV_FORMAT": "int8", "BENCH_PARAMS_CACHE": "",
+        })
+        assert "error" not in ctrl and "error" not in arm
+        assert arm["base_quant"] == "int8"
+        assert arm["kv_format"] == "int8"
+        assert ctrl["bytes_per_token"] and arm["bytes_per_token"]
+        assert arm["bytes_per_token"] < ctrl["bytes_per_token"], (
+            arm["bytes_per_token"], ctrl["bytes_per_token"],
+        )
 
     def test_learner_record_shape(self):
         rec = run_bench({

@@ -150,3 +150,52 @@ class TestReadmeBaselineCommands:
         for cmd in self.CMDS:
             for token in cmd.split():
                 assert token in squashed, f"{token} not in README"
+
+
+def test_quantized_serving_flags():
+    """ISSUE 15: kv_cache_quant unset = plan-DB-resolvable (None), explicit
+    values (including none) pin; quant_group_size rides base_quant."""
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.kv_cache_quant is None  # unset → the plan DB decides
+    cfg = config_from_args(
+        build_parser().parse_args(["--kv_cache_quant", "none"])
+    )
+    assert cfg.kv_cache_quant == "none"  # an explicit pin, not "unset"
+    cfg = config_from_args(build_parser().parse_args(
+        ["--base_quant", "int4", "--quant_group_size", "32"]
+    ))
+    assert cfg.base_quant == "int4"
+    assert cfg.quant_group_size == 32
+
+
+def test_quant_group_size_requires_base_quant():
+    import pytest
+
+    with pytest.raises(ValueError, match="quant_group_size"):
+        config_from_args(
+            build_parser().parse_args(["--quant_group_size", "32"])
+        )
+
+
+def test_worker_quant_flag_parity():
+    """The ISSUE-15 satellite: worker_main must express the driver's base
+    quantization on the serve path (GC401) with agreeing defaults/types
+    (GC402)."""
+    import pytest
+
+    from distrl_llm_tpu.distributed.worker_main import main as worker_main
+
+    # parser-level dead-flag rejection, mirroring the driver's validation
+    with pytest.raises(SystemExit):
+        worker_main(["--quant-group-size", "32"])  # needs --base-quant
+    # a tiny worker engine over an int4 base builds and quantizes
+    import distrl_llm_tpu.distributed.worker_main as wm
+
+    wm._init_engine("tiny", 8, 8, 0, engine_impl="dense",
+                    base_quant="int4", quant_group_size=16)
+    try:
+        from distrl_llm_tpu.ops.quant import is_quantized_tree
+
+        assert is_quantized_tree(wm._ENGINE_STATE["params"])
+    finally:
+        wm._ENGINE_STATE.clear()

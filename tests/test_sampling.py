@@ -1,4 +1,8 @@
-"""Sampler unit tests: top-p nucleus semantics, greedy, temperature."""
+"""Sampler unit tests: top-p nucleus semantics, greedy, temperature, and
+the fused sample-from-logits Pallas kernel (ISSUE 15 — interpreter-mode
+pins; tools/tpu_kernel_check.py revalidates the Mosaic lowering)."""
+
+import os
 
 import numpy as np
 import pytest
@@ -183,3 +187,123 @@ class TestTopPBisectMultiway:
         logits = jnp.asarray([[0.0, 1.0, -2.0, 3.0]], jnp.float32)
         kept = np.asarray(top_p_filter_bisect_multiway(logits, 1.0)) > -1e29
         assert kept.all()
+
+
+class TestFusedSampler:
+    """One-pass Pallas sampler (ops/sampling.py::fused_sample): greedy
+    bit-identity, raw-basis logprob exactness, nucleus support, seeded
+    distribution parity, and the DISTRL_SAMPLE_KERNEL dispatch."""
+
+    def _logits(self, b=8, v=300, seed=0, scale=3.0):
+        # non-multiple-of-128 vocab exercises the NEG_INF padding
+        return jnp.asarray(
+            np.random.default_rng(seed).normal(size=(b, v)) * scale,
+            jnp.float32,
+        )
+
+    def test_greedy_bit_identity_and_logprob(self):
+        from distrl_llm_tpu.ops.sampling import fused_sample, token_logprob
+
+        lg = self._logits()
+        tok, logp = fused_sample(
+            jax.random.PRNGKey(0), lg, 0.0, 0.95, interpret=True
+        )
+        ref = sample(jax.random.PRNGKey(0), lg, 0.0, 0.95)
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(ref))
+        np.testing.assert_array_equal(
+            np.asarray(logp), np.asarray(token_logprob(lg, tok))
+        )
+
+    def test_sampled_tokens_within_nucleus(self):
+        from distrl_llm_tpu.ops.sampling import (
+            fused_sample, top_p_filter_bisect,
+        )
+
+        lg = self._logits(seed=1)
+        t, p = 1.0, 0.7
+        kept = np.asarray(top_p_filter_bisect(lg / t, p)) > -1e29
+        for i in range(16):
+            tok, _ = fused_sample(
+                jax.random.PRNGKey(i), lg, t, p, interpret=True
+            )
+            tk = np.asarray(tok)
+            assert kept[np.arange(lg.shape[0]), tk].all()
+
+    def test_sampled_logprob_is_raw_basis(self):
+        from distrl_llm_tpu.ops.sampling import fused_sample, token_logprob
+
+        lg = self._logits(seed=2)
+        tok, logp = fused_sample(
+            jax.random.PRNGKey(3), lg, 1.2, 0.9, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(logp), np.asarray(token_logprob(lg, tok)), atol=1e-6
+        )
+
+    @pytest.mark.slow
+    def test_distribution_parity_vs_multipass(self):
+        """Seeded statistical parity (the spec_accept discipline): fused
+        and multi-pass empirical distributions agree within a TV bound
+        scaled to sampling noise."""
+        from distrl_llm_tpu.ops.sampling import fused_sample
+
+        V, N = 64, 8192
+        row = jnp.asarray(
+            np.random.default_rng(5).normal(size=(V,)) * 2.0, jnp.float32
+        )
+        tiled = jnp.tile(row[None, :], (N, 1))
+        t, p = 1.2, 0.95
+        toks_f = np.asarray(
+            fused_sample(jax.random.PRNGKey(6), tiled, t, p,
+                         interpret=True)[0]
+        )
+        toks_m = np.asarray(sample(jax.random.PRNGKey(7), tiled, t, p))
+        emp_f = np.bincount(toks_f, minlength=V) / N
+        emp_m = np.bincount(toks_m, minlength=V) / N
+        tv = 0.5 * np.abs(emp_f - emp_m).sum()
+        assert tv < 3.0 * (V / N) ** 0.5, tv
+
+    def test_temperature_zero_rows_vs_sampled(self):
+        # traced scalar temperature selects greedy inside the kernel
+        from distrl_llm_tpu.ops.sampling import fused_sample
+
+        lg = self._logits(b=4, seed=8)
+        tok0, _ = fused_sample(
+            jax.random.PRNGKey(9), lg, 0.0, 1.0, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tok0), np.asarray(lg.argmax(-1))
+        )
+
+    def test_wrapper_dispatch_modes(self):
+        from distrl_llm_tpu.ops.sampling import (
+            sample_dispatch, sample_impl_mode, sample_with_logprob,
+        )
+
+        lg = self._logits(b=2, seed=10)
+        tok_x, lp_x = sample_with_logprob(
+            jax.random.PRNGKey(0), lg, 0.0, 0.95, capture_logprob=True,
+            impl="xla",
+        )
+        tok_i, lp_i = sample_with_logprob(
+            jax.random.PRNGKey(0), lg, 0.0, 0.95, capture_logprob=True,
+            impl="interpret",
+        )
+        np.testing.assert_array_equal(np.asarray(tok_x), np.asarray(tok_i))
+        np.testing.assert_allclose(
+            np.asarray(lp_x), np.asarray(lp_i), atol=1e-6
+        )
+        # capture off → no logprob pass at all
+        _, lp_none = sample_with_logprob(
+            jax.random.PRNGKey(0), lg, 0.0, 0.95, impl="xla"
+        )
+        assert lp_none is None
+        # env validation + the exact-nucleus reproducibility pin
+        os.environ["DISTRL_SAMPLE_KERNEL"] = "bogus"
+        try:
+            with pytest.raises(ValueError, match="DISTRL_SAMPLE_KERNEL"):
+                sample_impl_mode()
+        finally:
+            del os.environ["DISTRL_SAMPLE_KERNEL"]
+        use, _ = sample_dispatch(300, "exact")
+        assert use is False  # an explicit exact-nucleus ask never fuses

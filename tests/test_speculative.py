@@ -329,7 +329,9 @@ class TestSpecTrainerIntegration:
         )
         kw = engine_kwargs_from_config(cfg)
         assert kw == {
-            "kv_quant": "none", "scheduler": "refill",
+            # None = plan-DB-resolvable (ISSUE 15: the unset config leaves
+            # the engine's kv_format to the plan DB; empty DB = "none")
+            "kv_quant": None, "scheduler": "refill",
             "spec_draft": 4, "spec_ngram": 3, "max_concurrent_rows": 64,
         }
         # and the kwargs construct a real engine in the configured mode
@@ -340,7 +342,7 @@ class TestSpecTrainerIntegration:
         assert engine.scheduler == "refill" and engine.spec_draft == 4
         # default (dense) config maps to no scheduler/spec/row knobs; kv_quant
         # always rides along (the dense engine takes int8 KV too)
-        assert engine_kwargs_from_config(TrainConfig()) == {"kv_quant": "none"}
+        assert engine_kwargs_from_config(TrainConfig()) == {"kv_quant": None}
 
     def test_explicit_default_spellings_pin_past_plan_db(self):
         """An EXPLICITLY configured spec_drafter='ngram' / spec_verify=

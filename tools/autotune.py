@@ -108,6 +108,12 @@ def plan_from_bench_row(row: dict):
         # rows since this PR carry the formulation; older rows derive
         cache_read_formulation=row.get("cache_read_formulation"),
         top_p_impl=row.get("top_p_impl"),
+        # quantized-serving provenance (ISSUE 15): what the row MEASURED
+        # becomes the stored serving format ("none" included — it is a
+        # measured choice, not "unset"); pre-ISSUE-15 rows without the
+        # fields leave them None (engine default)
+        kv_format=row.get("kv_format") or row.get("kv_quant"),
+        base_quant=row.get("base_quant"),
         **spec_kw,
     )
 
@@ -265,6 +271,14 @@ def cmd_measure(args) -> int:
             (None if x in ("", "auto") else x)
             for x in args.cb_modes.split(",")
         ),
+        kv_formats=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.kv_formats.split(",")
+        ),
+        base_quants=tuple(
+            (None if x in ("", "auto") else x)
+            for x in args.base_quants.split(",")
+        ),
     )
     print(f"measuring {len(candidates)} candidate plan(s) for {args.model} "
           f"p{args.max_prompt}+n{args.max_new} × {args.prompts}·"
@@ -368,7 +382,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list from auto,fused,unrolled ('auto' = "
                         "engine default; speculative path only)")
     m.add_argument("--kv-quant", dest="kv_quant", default="none",
-                   choices=["none", "int8"])
+                   choices=["none", "int8"],
+                   help="sweep-level KV format for candidates whose "
+                        "kv_format field is unset ('auto' in --kv-formats)")
+    m.add_argument("--kv-formats", dest="kv_formats", default="auto",
+                   help="comma list of KV-format candidates from "
+                        "auto,none,int8 (ISSUE 15): 'auto' leaves the "
+                        "field unset (engine default / --kv-quant), "
+                        "none/int8 store a MEASURED serving format the "
+                        "engines resolve when built with kv_quant=None — "
+                        "e.g. --kv-formats none,int8 makes int8 KV the "
+                        "measured default wherever it wins")
+    m.add_argument("--base-quants", dest="base_quants", default="auto",
+                   help="comma list of frozen-base weight formats from "
+                        "auto,none,int8,int4 (ISSUE 15): each non-auto "
+                        "candidate is measured over a base tree quantized "
+                        "to that format (fused dequant-matmul kernel "
+                        "where enabled) and stored in the winning plan")
     m.add_argument("--warmup", type=int, default=1)
     m.add_argument("--repeats", type=int, default=2)
     m.set_defaults(fn=cmd_measure)

@@ -59,28 +59,52 @@ def round_index(path: str) -> int:
     return int(m.group(1)) if m else 0
 
 
+def quant_arm(rec: dict) -> tuple[str, str]:
+    """(base format, KV format) a row measured under. Pre-ISSUE-15 rows
+    spell the KV format ``kv_quant`` (or omit it entirely, when "none" WAS
+    the behavior) — normalizing here keeps the old-round → new-round
+    boundary pair scoreable instead of silently unscanned."""
+    return (
+        str(rec.get("base_quant") or "none"),
+        str(rec.get("kv_format") or rec.get("kv_quant") or "none"),
+    )
+
+
 def comparable(a: dict, b: dict) -> bool:
     """Two rounds are scoreable only when they measured the same thing on
-    the same backend with no degradation in either."""
+    the same backend with no degradation in either — and under the same
+    quantized-serving arm (ISSUE 15): an int8-base round against a bf16
+    round is an A/B, not a regression pair."""
     return (
         a.get("metric") == b.get("metric")
         and a.get("backend") == b.get("backend")
+        and quant_arm(a) == quant_arm(b)
         and "error" not in a and "error" not in b
     )
 
 
 # latency-typed names (*_ms, *_p99_ms, queue_wait_p50_ms, …): LOWER is
 # better — a 10% TTFT *improvement* must not read as a value drop, and a
-# 10% TTFT increase IS the regression (ISSUE 13 satellite)
+# 10% TTFT increase IS the regression (ISSUE 13 satellite). Byte-typed
+# names (bytes_per_token, *_bytes — ISSUE 15) score the same way: decode
+# is bandwidth-bound, so MORE bytes streamed per token IS the regression
+# and a quantization win must never read as a value drop.
 _LATENCY_RE = re.compile(r"(_ms$|_ms_|_p\d+_ms$|_p\d+$)")
+_BYTES_RE = re.compile(r"(_bytes$|bytes_per_token$)")
 
 # per-row latency fields scanned between comparable consecutive rounds
 # (bench rollout rows, ISSUE 13; null on non-cb rows — skipped then)
 LATENCY_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms")
+# per-row measured-bytes fields scanned the same way (ISSUE 15; null when
+# the backend reported no cost analysis — skipped then). comparable()
+# already pins both rounds to the same base_quant/kv_format arm, so a
+# flagged increase is a real fusion/layout regression, not an A/B diff.
+BYTES_FIELDS = ("bytes_per_token",)
 
 
 def lower_is_better(metric: str) -> bool:
-    return bool(_LATENCY_RE.search(str(metric)))
+    m = str(metric)
+    return bool(_LATENCY_RE.search(m) or _BYTES_RE.search(m))
 
 
 def regressed(metric: str, old: float, new: float, drop: float) -> bool:
@@ -158,16 +182,18 @@ def main(argv: list[str] | None = None) -> int:
                     f"({100 * (new / old - 1):+.1f}%, flag threshold "
                     f"{direction}{100 * args.drop:.0f}% for {metric})"
                 )
-            # serving-latency fields (cb rows): lower-is-better by type,
-            # scanned only when BOTH rounds produced them
-            for field in LATENCY_FIELDS:
+            # serving-latency + measured-bytes fields (cb/quant rows):
+            # lower-is-better by type, scanned only when BOTH rounds
+            # produced them
+            for field in LATENCY_FIELDS + BYTES_FIELDS:
                 ov, nv = prev[1].get(field), rec.get(field)
                 if ov is None or nv is None:
                     continue
                 if regressed(field, float(ov), float(nv), args.drop):
+                    unit = "B/tok" if field in BYTES_FIELDS else "ms"
                     flags.append(
                         f"r{prev[0]}→r{n}: {field} {float(ov):,.1f} → "
-                        f"{float(nv):,.1f} ms "
+                        f"{float(nv):,.1f} {unit} "
                         f"({100 * (float(nv) / float(ov) - 1):+.1f}%, "
                         f"flag threshold +{100 * args.drop:.0f}%)"
                     )

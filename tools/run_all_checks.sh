@@ -75,6 +75,14 @@ stage "cb_smoke" env JAX_PLATFORMS=cpu \
 # flight-recorder bundle
 stage "serving_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/serving_smoke.py
+# quantized-serving gate (ISSUE 15): quantized-base greedy decode through
+# the fused dequant-matmul kernel bit-identical to the XLA container path
+# (int8 + int4, LoRA epilogue), fused sampler greedy bit-identity + a
+# seeded sampled-path distribution check, and int8-KV plan resolution
+# (stored kv_format adopted, explicit "none" pins, empty DB = historical
+# default)
+stage "quant_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/quant_smoke.py
 # observability gate (ISSUE 8): 2-worker tiny run — scrape both worker
 # endpoints and the driver's fleet endpoint mid-run (fleet/* series
 # present, per-worker token counters flowing), inject a seeded NaN,

@@ -254,6 +254,36 @@ bench cb_control /tmp/bench_tpu_cb_control.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
   BENCH_CONTROL_FRAC=0.5
+# quantized-serving A/B matrix (ISSUE 15): one refill config swept over
+# (base format x KV format) plus the fused-sampler arm — every row
+# records base_quant / kv_format / bytes_per_token (measured XLA
+# cost_analysis of the decode step; DISTRL_MEASURE_COST is bench's
+# default) / sample_kernel / quant_matmul, so the artifact shows whether
+# the tok/s gain tracks the bytes/token drop (the roofline story) and
+# which kernel actually served each arm. quant_bf16_ctrl is the control
+# (identical env, formats pinned off past any stored plan).
+bench quant_bf16_ctrl /tmp/bench_tpu_quant_bf16_ctrl.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_BASE_QUANT=none BENCH_KV_FORMAT=none
+bench quant_int8_kv /tmp/bench_tpu_quant_int8_kv.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_BASE_QUANT=none BENCH_KV_FORMAT=int8
+bench quant_int8_base /tmp/bench_tpu_quant_int8_base.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_BASE_QUANT=int8 BENCH_KV_FORMAT=int8
+bench quant_int4_base /tmp/bench_tpu_quant_int4_base.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_BASE_QUANT=int4 BENCH_KV_FORMAT=int8
+# fused-sampler A/B on the int8 arm: DISTRL_SAMPLE_KERNEL=fused vs the
+# multi-pass control above (sample_kernel in the rows tells them apart)
+bench quant_sampler_fused /tmp/bench_tpu_quant_sampler_fused.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_BASE_QUANT=int8 BENCH_KV_FORMAT=int8 DISTRL_SAMPLE_KERNEL=fused
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
@@ -299,6 +329,8 @@ all_done() {
            dense dense_int8_mw waves_eos dense_eos \
            paged_blocked weight_bus_ab \
            cb_prefix cb_continuous \
+           quant_bf16_ctrl quant_int8_kv quant_int8_base quant_int4_base \
+           quant_sampler_fused \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

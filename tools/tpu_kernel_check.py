@@ -322,6 +322,80 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 — audit is best-effort on-chip
         print(f"SKIP mulred_fusion ({e})")
 
+    # ---- fused quantized-matmul kernel (ISSUE 15): int8/int4 weight x
+    # bf16 activation with in-kernel group-scale dequant and the LoRA
+    # delta in the epilogue, vs the exact XLA container path — the
+    # compiled-Mosaic datapoint behind the probe-gated "auto" dispatch
+    # (CPU tier-1 pins interpret-mode BIT-identity; bf16 MXU accumulation
+    # on silicon gets a tolerance)
+    try:
+        from distrl_llm_tpu.ops.linear import linear, lora_delta
+        from distrl_llm_tpu.ops.quant import quantize
+        from distrl_llm_tpu.ops.quant_matmul import quant_matmul
+
+        def check_qmm(label, bits, gs, K, N, M, r):
+            nonlocal failures
+            try:
+                wq = quantize(
+                    jnp.asarray(rng.normal(size=(K, N)) * 0.05, jnp.float32),
+                    bits=bits, group_size=gs,
+                )
+                x = jnp.asarray(rng.normal(size=(M, K)), jnp.bfloat16)
+                a = jnp.asarray(rng.normal(size=(K, r)) * 0.1, jnp.bfloat16)
+                bm = jnp.asarray(rng.normal(size=(r, N)) * 0.1, jnp.bfloat16)
+                want = (linear(x, wq) + lora_delta(x, a, bm, 0.5)).astype(
+                    jnp.float32
+                )
+                got = quant_matmul(x, wq, None, a, bm, 0.5).astype(
+                    jnp.float32
+                )
+                err = float(jnp.abs(got - want).max())
+                ok = err < 3e-2  # bf16 MXU vs XLA container
+                failures += not ok
+                print(f"{'PASS' if ok else 'FAIL'} {label} K={K} N={N} "
+                      f"M={M} r={r} max_err={err:.4f}")
+            except Exception as e:  # noqa: BLE001 — record, count, continue
+                failures += 1
+                print(f"FAIL {label} ({type(e).__name__}: {str(e)[:160]})")
+
+        # decode-row and prefill-row shapes, 0.5B-class and 7B-class dims
+        check_qmm("quant_matmul_int8_lora", 8, None, 896, 4864, 32, 32)
+        check_qmm("quant_matmul_int8_groups", 8, 128, 3584, 3584, 480, 32)
+        check_qmm("quant_matmul_int4_lora", 4, 64, 896, 4864, 32, 32)
+        check_qmm("quant_matmul_int4_7b", 4, 64, 3584, 18944, 96, 32)
+    except Exception as e:  # noqa: BLE001 — stanza group is best-effort
+        print(f"SKIP quant_matmul ({e})")
+
+    # ---- fused sample-from-logits kernel (ISSUE 15): greedy argmax must
+    # be BIT-identical to the multi-pass sampler on silicon, and a sampled
+    # batch must stay within the bisect-filtered nucleus — the compiled
+    # twin of tools/quant_smoke.py's interpret gates
+    try:
+        from distrl_llm_tpu.ops.sampling import (
+            fused_sample, sample, top_p_filter_bisect,
+        )
+
+        bs, vs = 64, 152_064  # production decode sampler shape
+        lgs = jnp.asarray(
+            rng.normal(size=(bs, vs)) * 3.0, jnp.float32
+        )
+        tok_f, logp_f = fused_sample(
+            jax.random.PRNGKey(0), lgs, 0.0, 0.95
+        )
+        tok_m = sample(jax.random.PRNGKey(0), lgs, 0.0, 0.95)
+        ok = bool((np.asarray(tok_f) == np.asarray(tok_m)).all())
+        failures += not ok
+        print(f"{'PASS' if ok else 'FAIL'} fused_sampler_greedy "
+              f"B={bs} V={vs} (bit-identical argmax)")
+        tok_s, _ = fused_sample(jax.random.PRNGKey(1), lgs, 1.2, 0.9)
+        kept = np.asarray(top_p_filter_bisect(lgs / 1.2, 0.9)) > -1e29
+        ok = bool(kept[np.arange(bs), np.asarray(tok_s)].all())
+        failures += not ok
+        print(f"{'PASS' if ok else 'FAIL'} fused_sampler_nucleus "
+              f"(sampled tokens within the bisect-kept set)")
+    except Exception as e:  # noqa: BLE001 — stanza group is best-effort
+        print(f"SKIP fused_sampler ({e})")
+
     # ---- donated decode-step HBM audit (TPU only — CPU memory_analysis
     # does not model donation aliasing, so this cannot run in CI): the
     # refill/spec step programs must NOT materialize page-pool-sized temps.

@@ -489,14 +489,38 @@ def roofline_section(spans: dict[tuple[int, str], list[dict]],
             f"({100 * achieved / peak_flops:.2f}% of peak)"
         )
     if costs:
+        # measured bytes/token (ISSUE 15): decode emits one token per
+        # alive slot per step, so a decode-step program's cost_analysis
+        # bytes x dispatched steps / generated tokens is the HBM traffic
+        # each token actually paid — the quantized-serving scoreboard.
+        # Steps and tokens come from the engine/decode span args.
+        # dense/wave engines span "engine/decode"; the refill scheduler
+        # (continuous batching + speculative — the serving path ISSUE 15
+        # targets) spans "engine/refill_decode"
+        dec_tokens = dec_steps = 0
+        for (_pid, name), evs in spans.items():
+            if name in ("engine/decode", "engine/refill_decode"):
+                for e in evs:
+                    a = e.get("args", {}) or {}
+                    dec_tokens += int(a.get("tokens") or 0)
+                    dec_steps += int(a.get("steps") or 0)
         lines.append("  compiled step programs (XLA cost_analysis):")
         for what, c in sorted(costs.items()):
             flops = c.get("flops", 0.0)
             byts = c.get("bytes_accessed", 0.0)
             ai = f"{flops / byts:.2f} FLOP/B" if byts else "n/a"
+            bpt = ""
+            if (
+                what.startswith("decode_step/") and byts
+                and dec_tokens and dec_steps
+            ):
+                bpt = (
+                    f", {byts * dec_steps / dec_tokens / 1e6:.3f} "
+                    "MB/token measured"
+                )
             lines.append(
                 f"    {what}: {flops / 1e9:.3f} GFLOP, "
-                f"{byts / 2**30:.3f} GiB accessed, intensity {ai}"
+                f"{byts / 2**30:.3f} GiB accessed, intensity {ai}{bpt}"
             )
     lines.append("")
     return lines

@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel chips (ring/ulysses attention)")
     p.add_argument("--fsdp", type=int, default=1, help="learner parameter sharding")
     p.add_argument("--base_quant", type=str, default="none", choices=["none", "int8", "int4"])
+    p.add_argument("--quant_group_size", type=int, default=None,
+                   help="groupwise-scale width along the input dim for "
+                        "--base_quant (must divide the projection input "
+                        "dims); unset = per-format default (int8: "
+                        "per-column, int4: 64 — bnb's blockwise knob)")
     p.add_argument("--attn_impl", type=str, default="reference",
                    choices=["reference", "flash", "splash", "ring", "ulysses"])
     p.add_argument("--engine_impl", type=str, default="dense",
@@ -66,10 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap on concurrent candidate rows (vLLM max_num_seqs"
                         "); rounds beyond the cap run as sequential waves. "
                         "0 = unlimited")
-    p.add_argument("--kv_cache_quant", type=str, default="none",
+    p.add_argument("--kv_cache_quant", type=str, default=None,
                    choices=["none", "int8"],
-                   help="paged-engine KV cache quantization (int8 halves "
-                        "cache memory + decode bandwidth)")
+                   help="KV cache quantization (int8 halves cache memory "
+                        "+ decode bandwidth via the compact-scales "
+                        "kernels). Unset = this host's autotune plan DB "
+                        "decides (ExecutionPlan.kv_format; empty DB = "
+                        "none). An explicit value, including none, always "
+                        "wins over any stored plan")
     p.add_argument("--decode_scan_chunk", type=int, default=None,
                    help="decode steps fused per dispatch via lax.scan "
                         "(all engines: dense, paged wave/refill, sharded, "
@@ -477,7 +486,9 @@ def run_smoke(config: TrainConfig) -> None:
         )
 
         bits = quant_bits_for(config.base_quant)
-        base = quantize_params(base, bits=bits, group_size=16)
+        base = quantize_params(
+            base, bits=bits, group_size=config.quant_group_size or 16
+        )
     engine = GenerationEngine(
         TINY,
         max_prompt_tokens=config.max_prompt_tokens,
