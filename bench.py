@@ -445,10 +445,18 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
     lora = init_lora_params(jax.random.PRNGKey(1), cfg, rank=lora_rank)
     optimizer = make_optimizer(2e-5, use_8bit=True)
     opt_state = optimizer.init(lora)
+    # BENCH_LEARN_OBS=1 (ISSUE 16): bench the ARMED step — the dynamics
+    # bundle rides the loss fetch, so its cost (if any) lands in
+    # step_seconds, and the record carries the policy-health fields. Off
+    # (default) emits the same fields as null, pinned by
+    # test_bench_contract so dashboards can rely on the keys.
+    learn_obs = os.environ.get("BENCH_LEARN_OBS", "0") == "1"
     step = make_train_step(
         cfg, learner_type="grpo", optimizer=optimizer,
         lora_scale=lora_scale(lora_rank, 16.0), micro_size=micro,
         donate=False, logit_chunk=logit_chunk, attn_impl=attn_impl,
+        clip_ratio=0.2 if learn_obs else 0.0,
+        emit_dynamics=learn_obs,
     )
     rng = np.random.default_rng(0)
     batch = UpdateBatch(
@@ -458,6 +466,14 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         answer_mask=jnp.ones((n_rows, t_len), jnp.int32),
         coeffs=jnp.asarray(rng.normal(size=n_rows), jnp.float32),
         sample_mask=jnp.ones((n_rows,), jnp.float32),
+        # synthetic behavior logprobs give the clip objective (and the
+        # KL/ratio telemetry) a realistic off-policy spread to chew on
+        behavior_logps=(
+            jnp.asarray(
+                rng.normal(-2.0, 0.25, size=(n_rows, t_len)), jnp.float32
+            )
+            if learn_obs else None
+        ),
     )
     # Time against a device-to-host FETCH, not block_until_ready: on the
     # tunneled PJRT client block_until_ready returned before chained steps
@@ -467,13 +483,27 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
     import importlib
 
     importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
+    kl_per_step: list[float] = []
+    dynamics = None
     t0 = time.perf_counter()
-    lora, opt_state, loss = step(lora, opt_state, params, batch)
+    if learn_obs:
+        lora, opt_state, loss, dynamics = step(lora, opt_state, params, batch)
+    else:
+        lora, opt_state, loss = step(lora, opt_state, params, batch)
     float(loss)
     compile_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(steps):
-        lora, opt_state, loss = step(lora, opt_state, params, batch)
+        if learn_obs:
+            lora, opt_state, loss, dynamics = step(
+                lora, opt_state, params, batch
+            )
+            if "kl" in dynamics:
+                # device reference only — converting here would force a
+                # per-step host sync the off path doesn't pay, skewing dt
+                kl_per_step.append(dynamics["kl"])
+        else:
+            lora, opt_state, loss = step(lora, opt_state, params, batch)
     loss_val = float(loss)
     dt = (time.perf_counter() - t0) / steps
 
@@ -514,6 +544,27 @@ def _learner_bench(cfg, name: str, fallback_err) -> int:
         # record: device HBM watermark and shape-keyed retrace count
         "hbm_peak_bytes": _hbm_peak_bytes(),
         "recompile_count": _recompile_count(),
+        # training-dynamics fields (ISSUE 16): null unless BENCH_LEARN_OBS
+        # armed the fused bundle; direction-neutral in bench_history.py (a
+        # curve shift is not a perf regression)
+        "entropy": (
+            round(float(dynamics["entropy"]), 6)
+            if dynamics is not None and "entropy" in dynamics else None
+        ),
+        "kl_p90": (
+            round(sorted(float(k) for k in kl_per_step)[
+                min(int(len(kl_per_step) * 0.9), len(kl_per_step) - 1)
+            ], 6)
+            if kl_per_step else None
+        ),
+        "clip_frac": (
+            round(float(dynamics["clip_frac"]), 6)
+            if dynamics is not None and "clip_frac" in dynamics else None
+        ),
+        "ratio_cap_frac": (
+            round(float(dynamics["cap_frac"]), 6)
+            if dynamics is not None and "cap_frac" in dynamics else None
+        ),
     }
     if mfu > 0.6:
         # >60% MFU on a fwd+bwd step means the timing is broken, not that

@@ -513,6 +513,38 @@ class TrainConfig:
     # the limit dumps a flight-recorder bundle. Require --sentinel.
     slo_ttft_ms: float | None = None
     slo_queue_wait_ms: float | None = None
+    # --- training-dynamics observability (learn_obs.py, ISSUE 16) ---------
+    # Device-computed training-dynamics bundle fused into the jitted train
+    # step (learner/train_step.py emit_dynamics): masked policy entropy,
+    # behavior↔policy KL, pre-binned IS-ratio histogram, clip/cap-saturation
+    # fractions, advantage moments, per-layer-group LoRA grad norms — all
+    # riding the ONE host transfer the loss already pays. The armed run is
+    # byte-identical to off in losses and adapter (pinned,
+    # tools/learn_smoke.py). Publishes learn/* registry series + a per-step
+    # JSONL (learn_dir/learn.jsonl; tools/learn_report.py reads it).
+    # learn_dir set alone implies learn_obs=True.
+    learn_obs: bool = False
+    learn_dir: str | None = None
+    # reward-distribution drift reference window (steps); drift is the
+    # z-score of the step's reward mean against the trailing window of
+    # older means
+    learn_drift_window: int = 32
+    # Training-dynamics sentinel triggers (ISSUE 16): each arms one
+    # deterministic trigger on the learn/* view; all require --sentinel
+    # (the evidence lands in the flight recorder) and auto-arm learn_obs
+    # (the signal's producer). Default None = off.
+    # entropy_collapse: masked answer-token entropy below this floor
+    learn_entropy_floor: float | None = None
+    # kl_blowup: behavior↔policy KL above this limit; also an escalation
+    # input to the staleness governor when control_staleness is armed
+    learn_kl_limit: float | None = None
+    # ratio_saturation: AIPO cap-saturation (or PPO clip) fraction above
+    # this threshold — fraction of answer tokens whose IS ratio the
+    # correction truncated
+    learn_ratio_sat_frac: float | None = None
+    # grad_spike: whole-adapter grad norm above this multiple of its
+    # running EMA (must be > 1)
+    learn_grad_spike: float | None = None
     # --- self-healing runtime (distrl_llm_tpu/control/, ISSUE 14) ---------
     # Closed-loop governors that ACT on the observability plane: bounded,
     # hysteretic, cooldown-guarded actuations with a global per-run budget.
@@ -741,6 +773,49 @@ class TrainConfig:
             # ask, arm the measurement; fleet runs instead read the
             # worker-fed fleet/serving_* gauges
             self.serving_obs = True
+        if self.learn_dir and not self.learn_obs:
+            # an output directory is an unambiguous ask — arm the ledger
+            self.learn_obs = True
+        if self.learn_drift_window < 2:
+            raise ValueError(
+                f"learn_drift_window must be >= 2 (a one-sample reference "
+                f"window has no variance), got {self.learn_drift_window}"
+            )
+        for learn_name in ("learn_entropy_floor", "learn_kl_limit",
+                           "learn_ratio_sat_frac", "learn_grad_spike"):
+            limit = getattr(self, learn_name)
+            if limit is not None and limit <= 0:
+                raise ValueError(f"{learn_name} must be > 0, got {limit}")
+        if (
+            self.learn_ratio_sat_frac is not None
+            and self.learn_ratio_sat_frac > 1.0
+        ):
+            raise ValueError(
+                f"learn_ratio_sat_frac is a token fraction in (0, 1], got "
+                f"{self.learn_ratio_sat_frac}"
+            )
+        if self.learn_grad_spike is not None and self.learn_grad_spike <= 1.0:
+            raise ValueError(
+                f"learn_grad_spike is a multiple of the grad-norm EMA and "
+                f"must be > 1, got {self.learn_grad_spike}"
+            )
+        _learn_triggers = (
+            self.learn_entropy_floor is not None
+            or self.learn_kl_limit is not None
+            or self.learn_ratio_sat_frac is not None
+            or self.learn_grad_spike is not None
+        )
+        if _learn_triggers and not self.sentinel:
+            raise ValueError(
+                "learn_entropy_floor/learn_kl_limit/learn_ratio_sat_frac/"
+                "learn_grad_spike arm sentinel triggers (entropy_collapse / "
+                "kl_blowup / ratio_saturation / grad_spike) — set "
+                "--sentinel (and --flight_recorder_dir) or drop them"
+            )
+        if _learn_triggers and not self.learn_obs:
+            # a trigger without the producer could never fire — a threshold
+            # is an unambiguous ask, arm the measurement (the SLO precedent)
+            self.learn_obs = True
         if self.serving_obs:
             # dead-flag policy (the prefix_sharing precedent): the ledger
             # instruments the refill/continuous loops only

@@ -671,5 +671,10 @@ def attach_staleness(runtime: ControlRuntime, config, policy,
             cooldown_steps=config.control_cooldown_steps,
             dwell_steps=config.control_dwell_steps,
         ),
-        triggers=("staleness_blowup",),
+        # kl_blowup (ISSUE 16): runaway behavior↔policy KL is the learning
+        # symptom of the same disease staleness_blowup is the systems
+        # symptom of — both escalate to the governor's bounded one-shot
+        # shrink of the effective staleness bound (cooldown/budget-guarded;
+        # unarmed unless learn_kl_limit set the trigger)
+        triggers=("staleness_blowup", "kl_blowup"),
     )

@@ -1,0 +1,233 @@
+"""Training-dynamics observability plane (ISSUE 16): the host half of the
+device-fused learning-telemetry bundle.
+
+The systems planes (spans PR 8, lineage PR 10, serving PR 13, control PR 14)
+say where the time and memory went; nothing said whether the policy was
+*learning healthily*. The train step already pays exactly one host transfer
+per optimizer step (the realized loss), and its ``has_aux=True`` pytree
+already threads per-microbatch scalars — so the whole dynamics bundle
+(masked policy entropy over answer tokens, behavior↔policy KL, a pre-binned
+device-side IS-ratio histogram, clip/cap-saturation fractions, advantage
+moments, per-layer-group LoRA grad norms) is computed ON DEVICE inside the
+jitted step (``learner/train_step.py``, ``emit_dynamics=True``) and rides
+that same fetch. Zero new host syncs; the armed run is byte-identical to
+off in losses and adapter (pinned by ``tools/learn_smoke.py``).
+
+This module is the single owner of the ``learn/*`` registry series (GC202)
+and hosts :class:`LearnLedger`, which each step:
+
+* publishes the bundle as registry gauges (→ the per-step MetricsSink
+  record, the Prometheus endpoint, and Perfetto counter tracks while
+  tracing);
+* replays the device-binned IS-ratio histogram into the registry via the
+  weighted ``hist_observe(..., count=)`` idiom — one entry per non-empty
+  bucket, valued at the bucket's own ``le`` bound so the registry's
+  bucketing reproduces the device counts exactly;
+* tracks reward-distribution drift against a running reference window
+  (trailing window of older reward means; drift = z-score of the current
+  mean against it);
+* streams one JSONL line per step to ``<learn_dir>/learn.jsonl``
+  (``kind: "step"``; ``close()`` appends ``kind: "summary"``) for
+  ``tools/learn_report.py``.
+
+Cost contract: the ledger only exists when ``--learn_obs`` armed it; the
+trainer's hook is one attribute check when off, and the off train step
+compiles to the exact pre-ISSUE-16 program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Mapping
+
+import numpy as np
+
+from distrl_llm_tpu import telemetry
+
+# ------------------------------------------------------------- series names
+# (single owner — GC202; pinned with their types in tests/test_telemetry.py)
+
+LEARN_ENTROPY = "learn/entropy"              # gauge: masked answer-token H
+LEARN_KL = "learn/kl_behavior"               # gauge: behavior↔policy KL (k3)
+LEARN_RATIO = "learn/is_ratio"               # hist: device-binned IS ratios
+LEARN_CLIP_FRAC = "learn/clip_frac"          # gauge: PPO-clip active frac
+LEARN_CAP_FRAC = "learn/ratio_cap_frac"      # gauge: AIPO cap-saturated frac
+LEARN_ADV_MEAN = "learn/adv_mean"            # gauge
+LEARN_ADV_STD = "learn/adv_std"              # gauge
+LEARN_ADV_POS_FRAC = "learn/adv_pos_frac"    # gauge
+LEARN_GRAD_NORM = "learn/grad_norm"          # gauge prefix: /a0../b3 groups
+LEARN_GRAD_NORM_TOTAL = "learn/grad_norm/total"  # gauge: whole-tree norm
+LEARN_REWARD_DRIFT = "learn/reward_drift"    # gauge: z vs reference window
+LEARN_STEPS = "learn/steps"                  # counter: bundles published
+
+
+def _scalar(v: Any) -> float:
+    return float(np.asarray(v))
+
+
+def lineage_dynamics(dynamics: Mapping[str, Any] | None) -> dict | None:
+    """The per-consumed-step columns the lineage ledger carries (ISSUE 16):
+    the subset of the bundle that lets ``lineage_report.py --step``
+    correlate policy lag with KL. None in, None out."""
+    if not dynamics:
+        return None
+    out: dict[str, float] = {}
+    if "entropy" in dynamics:
+        out["entropy"] = _scalar(dynamics["entropy"])
+    if "kl" in dynamics:
+        out["kl"] = _scalar(dynamics["kl"])
+    if "cap_frac" in dynamics:
+        out["ratio_cap_frac"] = _scalar(dynamics["cap_frac"])
+    elif "clip_frac" in dynamics:
+        out["ratio_cap_frac"] = _scalar(dynamics["clip_frac"])
+    return out or None
+
+
+class LearnLedger:
+    """Per-step publisher of the device-computed dynamics bundle.
+
+    Thread-safe like the other ledgers (one lock; the trainer calls from
+    the learner thread, reports may read concurrently). ``on_step`` takes
+    the bundle exactly as ``jax.device_get`` delivered it — numpy scalars
+    plus the ``ratio_counts`` vector — normalizes, publishes, and streams.
+    """
+
+    def __init__(self, out_dir: str | None = None, drift_window: int = 32):
+        if drift_window < 2:
+            raise ValueError(
+                f"drift_window must be >= 2, got {drift_window}"
+            )
+        self.out_dir = out_dir
+        self.drift_window = int(drift_window)
+        self._mu = threading.Lock()
+        self._file = None  # lazily opened <out_dir>/learn.jsonl
+        # reward drift: the recent window holds the last W reward means;
+        # means displaced from it accumulate into the (same-width) running
+        # reference window the drift z-score is computed against
+        self._recent: deque[float] = deque(maxlen=self.drift_window)
+        self._ref: deque[float] = deque(maxlen=self.drift_window)
+        self.steps = 0
+        self.last: dict[str, Any] = {}
+
+    # ------------------------------------------------------------- plumbing
+
+    def _write(self, doc: dict[str, Any]) -> None:
+        """Stream one JSONL line (lock held)."""
+        if self.out_dir is None:
+            return
+        if self._file is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._file = open(
+                os.path.join(self.out_dir, "learn.jsonl"), "a"
+            )
+        self._file.write(json.dumps(doc) + "\n")
+        self._file.flush()
+
+    def _drift_locked(self, reward_mean: float | None) -> float | None:
+        """Z-score of this step's reward mean against the running reference
+        window, then slide the windows. None until the reference window has
+        two observations (no honest variance before that)."""
+        drift = None
+        if reward_mean is not None:
+            if len(self._ref) >= 2:
+                ref = np.asarray(self._ref, np.float64)
+                drift = float(
+                    (reward_mean - ref.mean()) / (ref.std() + 1e-8)
+                )
+            if len(self._recent) == self.drift_window:
+                self._ref.append(self._recent.popleft())
+            self._recent.append(float(reward_mean))
+        return drift
+
+    @staticmethod
+    def _hist_value(bucket: int) -> float:
+        """A representative value landing EXACTLY in ``bucket`` under the
+        registry's inclusive-le ``bisect_left`` bucketing: the bucket's own
+        bound, or past-the-ladder for the overflow slot."""
+        bounds = telemetry.HIST_BUCKET_BOUNDS
+        if bucket < len(bounds):
+            return float(bounds[bucket])
+        return float(bounds[-1]) * 2.0
+
+    # --------------------------------------------------------------- publish
+
+    def on_step(self, step: int, dynamics: Mapping[str, Any], *,
+                reward_mean: float | None = None) -> dict[str, Any]:
+        """Publish one step's bundle; returns the normalized record (the
+        JSONL ``step`` document, minus ``kind``/``ts``)."""
+        doc: dict[str, Any] = {"step": int(step)}
+        gauges = (
+            ("entropy", LEARN_ENTROPY),
+            ("kl", LEARN_KL),
+            ("clip_frac", LEARN_CLIP_FRAC),
+            ("cap_frac", LEARN_CAP_FRAC),
+            ("adv_mean", LEARN_ADV_MEAN),
+            ("adv_std", LEARN_ADV_STD),
+            ("adv_pos_frac", LEARN_ADV_POS_FRAC),
+        )
+        for key, series in gauges:
+            if key in dynamics:
+                v = _scalar(dynamics[key])
+                doc[key] = v
+                telemetry.gauge_set(series, v)
+        if "tokens" in dynamics:
+            doc["tokens"] = _scalar(dynamics["tokens"])
+        # per-layer-group grad norms: total on its own constant, the A/B ×
+        # depth-bucket groups as a derived family off the constant prefix
+        for key in sorted(dynamics):
+            if not key.startswith("grad_norm"):
+                continue
+            v = _scalar(dynamics[key])
+            doc[key] = v
+            if key == "grad_norm_total":
+                telemetry.gauge_set(LEARN_GRAD_NORM_TOTAL, v)
+            else:
+                group = key[len("grad_norm_"):]
+                telemetry.gauge_set(f"{LEARN_GRAD_NORM}/{group}", v)
+        # device-binned IS-ratio histogram → registry, one weighted entry
+        # per non-empty bucket (the emit_hist idiom): the value is the
+        # bucket's le bound, so the registry's own bisect reproduces the
+        # device counts bit-for-bit
+        counts = dynamics.get("ratio_counts")
+        if counts is not None:
+            counts = np.asarray(counts, np.float64)
+            doc["ratio_counts"] = [int(c) for c in counts]
+            for bucket, c in enumerate(counts):
+                n = int(round(float(c)))
+                if n > 0:
+                    telemetry.hist_observe(
+                        LEARN_RATIO, self._hist_value(bucket),
+                        count=n, trace_sample=True,
+                    )
+        with self._mu:
+            drift = self._drift_locked(
+                float(reward_mean) if reward_mean is not None else None
+            )
+            if reward_mean is not None:
+                doc["reward_mean"] = float(reward_mean)
+            if drift is not None:
+                doc["reward_drift"] = drift
+                telemetry.gauge_set(LEARN_REWARD_DRIFT, drift)
+            telemetry.counter_add(LEARN_STEPS)
+            self.steps += 1
+            self.last = dict(doc)
+            self._write({"kind": "step", "ts": time.time(), **doc})
+        return doc
+
+    def close(self) -> None:
+        """Append the run summary line and close the stream."""
+        with self._mu:
+            self._write({
+                "kind": "summary",
+                "ts": time.time(),
+                "steps": self.steps,
+                "drift_window": self.drift_window,
+                "last": dict(self.last),
+            })
+            if self._file is not None:
+                self._file.close()
+                self._file = None

@@ -56,12 +56,21 @@ def answer_logprobs(
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,
     logit_chunk: int = 0,  # 0 = dense [B, T, V]; >0 = chunked CE (see module doc)
+    return_entropy: bool = False,  # also return per-position vocab entropy
 ) -> jax.Array:
     """Per-token logprobs of the answer under the current policy, [B, T] f32.
 
     Equivalent to the reference's compute_current_policy_probs
     (distributed_actor.py:215–260): token t's logprob comes from the logit at
     position P−1+t of the concatenated sequence.
+
+    ``return_entropy=True`` additionally returns the full-vocab policy
+    entropy per position, [B, T] f32 — ``H = lse − Σ softmax(logits)·logits``,
+    read off the same logits/logsumexp the logprob gather already
+    materializes (both the dense and the chunked-CE path), so the
+    training-dynamics bundle (ISSUE 16) costs no extra projection and no
+    extra host transfer. The flag is static: the default-off program is
+    unchanged.
     """
     full_ids = jnp.concatenate([prompt_ids, answer_ids], axis=1)
     full_mask = jnp.concatenate([prompt_mask, answer_mask], axis=1)
@@ -78,7 +87,11 @@ def answer_logprobs(
     if logit_chunk <= 0 or logit_chunk >= t:
         pred, _ = forward(params, cfg, full_ids, **fwd_kwargs)  # [B, T, V]
         gathered = jnp.take_along_axis(pred, answer_ids[..., None], axis=-1)[..., 0]
-        return gathered - jax.nn.logsumexp(pred, axis=-1)
+        lse = jax.nn.logsumexp(pred, axis=-1)
+        if not return_entropy:
+            return gathered - lse
+        entropy = lse - (jax.nn.softmax(pred, axis=-1) * pred).sum(-1)
+        return gathered - lse, entropy
 
     x, _ = forward(params, cfg, full_ids, skip_lm_head=True, **fwd_kwargs)
     b, _, d = x.shape
@@ -99,7 +112,11 @@ def answer_logprobs(
     def chunk_logprobs(x_c, ids_c):
         logits = linear(x_c, lm_head).astype(jnp.float32)  # [B, C, V]
         g = jnp.take_along_axis(logits, ids_c[..., None], axis=-1)[..., 0]
-        return g - jax.nn.logsumexp(logits, axis=-1)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        if not return_entropy:
+            return g - lse
+        ent = lse - (jax.nn.softmax(logits, axis=-1) * logits).sum(-1)
+        return g - lse, ent
 
     def body(carry, xc_ic):
         # checkpoint: the backward recomputes this chunk's logits from its
@@ -107,7 +124,13 @@ def answer_logprobs(
         return carry, jax.checkpoint(chunk_logprobs)(*xc_ic)
 
     _, out = jax.lax.scan(body, None, (xs, ids))  # [n, B, C]
-    return out.swapaxes(0, 1).reshape(b, n_chunks * chunk)[:, :t]
+
+    def unchunk(o):
+        return o.swapaxes(0, 1).reshape(b, n_chunks * chunk)[:, :t]
+
+    if not return_entropy:
+        return unchunk(out)
+    return unchunk(out[0]), unchunk(out[1])
 
 
 def _masked_mean_seq(logp_like: jax.Array, mask: jax.Array) -> jax.Array:

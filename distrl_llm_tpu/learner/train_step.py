@@ -31,11 +31,19 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.learner.losses import (
     answer_logprobs, grpo_aipo_loss, grpo_clip_loss, grpo_loss, kl_to_ref,
     pg_loss,
 )
 from distrl_llm_tpu.models.configs import ModelConfig
+
+# the device-side IS-ratio histogram (ISSUE 16) pre-bins over the SAME
+# bucket ladder the host registry uses, so LearnLedger can replay the
+# counts through hist_observe(count=) and the registry's own bisect
+# reproduces them exactly; one extra overflow slot past the last bound
+_RATIO_BOUNDS: tuple[float, ...] = telemetry.HIST_BUCKET_BOUNDS
+_GRAD_DEPTH_BUCKETS = 4  # LoRA grad-norm depth groups (a0..a3 / b0..b3)
 
 
 class UpdateBatch(NamedTuple):
@@ -56,6 +64,122 @@ class UpdateBatch(NamedTuple):
     version_lag: jax.Array | None = None
 
 
+def _microbatch_dynamics(
+    logps, entropy, mb: UpdateBatch, *,
+    clip_ratio: float, off_policy: str, is_cap: float,
+) -> dict:
+    """Per-microbatch training-dynamics SUMS (ISSUE 16), computed under
+    ``stop_gradient`` from intermediates the loss already materialized —
+    the ``lax.scan`` accumulates elementwise and ``_derive_dynamics``
+    normalizes after, so the whole bundle rides the step's existing single
+    host fetch. Keys are static per step build (the behavior-logprob
+    entries exist only when the batch carries them)."""
+    logps = jax.lax.stop_gradient(logps)
+    mask = mb.answer_mask.astype(jnp.float32) * mb.sample_mask[:, None]
+    real = mb.sample_mask
+    dyn = {
+        "tok_count": mask.sum(),
+        "entropy_sum": (jax.lax.stop_gradient(entropy) * mask).sum(),
+        # advantage moments over real rows (coeffs are the baseline-
+        # subtracted rewards / group-normalized advantages)
+        "adv_count": real.sum(),
+        "adv_sum": (mb.coeffs * real).sum(),
+        "adv_sq_sum": (jnp.square(mb.coeffs) * real).sum(),
+        "adv_pos": ((mb.coeffs > 0.0).astype(jnp.float32) * real).sum(),
+    }
+    if mb.behavior_logps is not None:
+        # behavior↔policy KL via the k3 estimator (kl_to_ref's idiom:
+        # zero the exponent at pads BEFORE exp — garbage pad logprobs
+        # would overflow exp and poison the sum through inf·0)
+        diff = (mb.behavior_logps - logps) * mask
+        dyn["kl_sum"] = ((jnp.exp(diff) - diff - 1.0) * mask).sum()
+        # device-binned IS-ratio histogram: bisect_left over the shared
+        # bucket ladder (searchsorted side="left" = the registry's
+        # inclusive-le semantics), masked tokens weighted out
+        log_ratio = (logps - mb.behavior_logps) * mask
+        ratio = jnp.exp(log_ratio)
+        bounds = jnp.asarray(_RATIO_BOUNDS, jnp.float32)
+        idx = jnp.searchsorted(bounds, ratio, side="left")
+        dyn["ratio_counts"] = (
+            jax.nn.one_hot(idx, len(_RATIO_BOUNDS) + 1, dtype=jnp.float32)
+            * mask[..., None]
+        ).sum((0, 1))
+        if clip_ratio > 0.0 and off_policy == "aipo":
+            # AIPO cap saturation: tokens whose raw ratio the truncation
+            # flattened — the silently-saturating regime the bundle exists
+            # to surface (answer-mask scope; the version-lag mask is an
+            # admission decision, not a saturation signal)
+            dyn["cap_count"] = (
+                (ratio >= is_cap).astype(jnp.float32) * mask
+            ).sum()
+        elif clip_ratio > 0.0:
+            dyn["clip_count"] = (
+                (jnp.abs(ratio - 1.0) > clip_ratio).astype(jnp.float32)
+                * mask
+            ).sum()
+    return dyn
+
+
+def _grad_norm_groups(grads, train_mode: str,
+                      n_buckets: int = _GRAD_DEPTH_BUCKETS) -> dict:
+    """Whole-tree grad norm, plus — for the LoRA pytree ``{"layers":
+    {target: {"a": [L, …], "b": [L, …]}}}`` — per-group norms split A vs B
+    and bucketed over the leading layer axis into ``n_buckets`` depth
+    groups (summed across targets). Full-finetune trees get the total
+    only."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    total_sq = sum(
+        jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves
+    )
+    out = {"grad_norm_total": jnp.sqrt(total_sq)}
+    layers = (
+        grads.get("layers")
+        if train_mode == "lora" and isinstance(grads, dict) else None
+    )
+    if not layers:
+        return out
+    for ab in ("a", "b"):
+        per_layer = None  # [L] sum of squares across targets
+        for target in layers.values():
+            if ab not in target:
+                continue
+            g = target[ab].astype(jnp.float32)
+            sq = jnp.sum(jnp.square(g), axis=tuple(range(1, g.ndim)))
+            per_layer = sq if per_layer is None else per_layer + sq
+        if per_layer is None:
+            continue
+        n = min(n_buckets, per_layer.shape[0])
+        for i, seg in enumerate(jnp.array_split(per_layer, n)):
+            out[f"grad_norm_{ab}{i}"] = jnp.sqrt(seg.sum())
+    return out
+
+
+def _derive_dynamics(sums, grads, *, train_mode: str) -> dict:
+    """Normalize the scan-accumulated sums into the published bundle."""
+    tok = jnp.maximum(sums["tok_count"], 1.0)
+    nadv = jnp.maximum(sums["adv_count"], 1.0)
+    adv_mean = sums["adv_sum"] / nadv
+    adv_var = jnp.maximum(
+        sums["adv_sq_sum"] / nadv - jnp.square(adv_mean), 0.0
+    )
+    dyn = {
+        "entropy": sums["entropy_sum"] / tok,
+        "tokens": sums["tok_count"],
+        "adv_mean": adv_mean,
+        "adv_std": jnp.sqrt(adv_var),
+        "adv_pos_frac": sums["adv_pos"] / nadv,
+    }
+    if "kl_sum" in sums:
+        dyn["kl"] = sums["kl_sum"] / tok
+        dyn["ratio_counts"] = sums["ratio_counts"]
+    if "cap_count" in sums:
+        dyn["cap_frac"] = sums["cap_count"] / tok
+    if "clip_count" in sums:
+        dyn["clip_frac"] = sums["clip_count"] / tok
+    dyn.update(_grad_norm_groups(grads, train_mode))
+    return dyn
+
+
 def _microbatch_loss(
     lora, base_params, cfg: ModelConfig, mb: UpdateBatch, *,
     learner_type: str, lora_scale: float, skip_semantics: str, remat: bool,
@@ -63,28 +187,35 @@ def _microbatch_loss(
     dropout_rng=None, logit_chunk: int = 0, train_mode: str = "lora",
     clip_ratio: float = 0.0, kl_coeff: float = 0.0,
     off_policy: str = "clip", is_cap: float = 2.0, max_staleness: int = 0,
+    emit_dynamics: bool = False,
 ):
     """Loss for one microbatch with the zero-reward skip folded in as a weight.
 
     ``train_mode="lora"``: ``lora`` is the trainable adapter over the frozen
     ``base_params``. ``train_mode="full"``: ``lora`` IS the full trainable
     param tree (bf16 full-rank — BASELINE config 3's no-LoRA mode) and
-    ``base_params`` is ignored."""
+    ``base_params`` is ignored.
+
+    ``emit_dynamics`` (static) appends the per-microbatch dynamics sums to
+    the aux pytree; off leaves the program and the aux shape exactly as
+    before."""
+    entropy = None
     if train_mode == "full":
-        logps = answer_logprobs(
+        out = answer_logprobs(
             lora, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
             mb.answer_mask, lora=None, remat=remat,
             attn_impl=attn_impl, attn_mesh=attn_mesh,
-            logit_chunk=logit_chunk,
+            logit_chunk=logit_chunk, return_entropy=emit_dynamics,
         )
     else:
-        logps = answer_logprobs(
+        out = answer_logprobs(
             base_params, cfg, mb.prompt_ids, mb.prompt_mask, mb.answer_ids,
             mb.answer_mask, lora=lora, lora_scale=lora_scale, remat=remat,
             attn_impl=attn_impl, attn_mesh=attn_mesh,
             lora_dropout=lora_dropout, dropout_rng=dropout_rng,
-            logit_chunk=logit_chunk,
+            logit_chunk=logit_chunk, return_entropy=emit_dynamics,
         )
+    logps, entropy = out if emit_dynamics else (out, None)
     if clip_ratio > 0.0 and off_policy == "aipo":
         # async regime: truncated-IS correction keyed on per-token version
         # lag (rollout/staleness.py) instead of the 1±ε clip — staleness up
@@ -131,6 +262,12 @@ def _microbatch_loss(
         skip = ~jnp.any(real & (mb.coeffs != 0.0))
     has_real = jnp.any(real)
     weight = jnp.where(skip | ~has_real, 0.0, 1.0)
+    if emit_dynamics:
+        dyn = _microbatch_dynamics(
+            logps, entropy, mb,
+            clip_ratio=clip_ratio, off_policy=off_policy, is_cap=is_cap,
+        )
+        return loss * weight, (weight, has_real.astype(jnp.float32), dyn)
     return loss * weight, (weight, has_real.astype(jnp.float32))
 
 
@@ -154,6 +291,7 @@ def make_train_step(
     off_policy: str = "clip",  # "clip" (1±ε) | "aipo" (truncated IS, async)
     is_cap: float = 2.0,  # AIPO ratio truncation C
     max_staleness: int = 0,  # AIPO: mask tokens with version lag beyond this
+    emit_dynamics: bool = False,  # ISSUE 16: fuse the dynamics bundle in
 ) -> Callable:
     """Build the jitted train step.
 
@@ -161,6 +299,17 @@ def make_train_step(
     loss_sum)`` where ``loss_sum`` matches the reference's returned metric: the
     sum of unscaled microbatch losses (its ``total_loss`` accumulation at
     distributed_actor.py:387–389 cancels the /num_batches scaling).
+
+    ``emit_dynamics=True`` (static) returns ``(lora, opt_state, loss_sum,
+    dynamics)`` instead, where ``dynamics`` is the device-computed
+    training-dynamics bundle (ISSUE 16): masked answer-token entropy,
+    behavior↔policy KL + the pre-binned IS-ratio histogram + clip/cap
+    saturation (only when the batch carries behavior logprobs), advantage
+    moments, and per-layer-group grad norms — all derived under
+    ``stop_gradient`` from intermediates the loss already materializes, so
+    the loss/update subgraph is unchanged and the bundle rides the caller's
+    existing single host fetch. Off compiles to the exact pre-ISSUE-16
+    program.
     """
 
     if train_mode == "full" and kl_coeff > 0.0:
@@ -188,6 +337,7 @@ def make_train_step(
         off_policy=off_policy,
         is_cap=is_cap,
         max_staleness=max_staleness,
+        emit_dynamics=emit_dynamics,
     )
 
     def step(lora, opt_state, base_params, batch: UpdateBatch,
@@ -212,12 +362,17 @@ def make_train_step(
         def accumulate(carry, xs):
             mb, key = xs
             grads_acc, loss_acc, nb_acc = carry
-            (loss, (weight, has_real)), grads = grad_fn(lora, mb, key)
+            (loss, aux), grads = grad_fn(lora, mb, key)
+            weight, has_real = aux[0], aux[1]
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-            return (grads_acc, loss_acc + loss, nb_acc + has_real), None
+            # dynamics sums ride the scan's ys output (stacked then summed
+            # below) so the carry shape is untouched; None when off — the
+            # exact pre-ISSUE-16 scan
+            ys = aux[2] if emit_dynamics else None
+            return (grads_acc, loss_acc + loss, nb_acc + has_real), ys
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, lora)
-        (grads, loss_sum, num_real_micro), _ = jax.lax.scan(
+        (grads, loss_sum, num_real_micro), dyn_stacked = jax.lax.scan(
             accumulate, (zero_grads, jnp.zeros([]), jnp.zeros([])),
             (micro, micro_keys),
         )
@@ -227,8 +382,18 @@ def make_train_step(
         denom = jnp.maximum(num_real_micro, 1.0)
         grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
 
+        dynamics = None
+        if emit_dynamics:
+            sums = jax.tree_util.tree_map(
+                lambda x: x.sum(axis=0), dyn_stacked
+            )
+            # grad norms read the averaged grads the optimizer consumes —
+            # the same tree, pure reads, no effect on the update
+            dynamics = _derive_dynamics(sums, grads, train_mode=train_mode)
         updates, opt_state = optimizer.update(grads, opt_state, lora)
         lora = optax.apply_updates(lora, updates)
+        if emit_dynamics:
+            return lora, opt_state, loss_sum, dynamics
         return lora, opt_state, loss_sum
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())

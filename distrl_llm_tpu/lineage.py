@@ -47,7 +47,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import asdict, dataclass, field
-from typing import Any, Sequence
+from typing import Any, Mapping, Sequence
 
 from distrl_llm_tpu import telemetry
 
@@ -101,6 +101,12 @@ class LineageRecord:
     # derived latencies (ms)
     sample_to_learn_ms: float | None = None
     policy_lag_ms: float | None = None
+    # training dynamics of the consuming step (ISSUE 16): the learn_obs
+    # bundle subset that lets lineage_report --step correlate policy lag
+    # with KL; None when learn_obs is off
+    kl: float | None = None
+    entropy: float | None = None
+    ratio_cap_frac: float | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = asdict(self)
@@ -281,13 +287,17 @@ class LineageLedger:
     def on_consumed(
         self, trajs_or_uids: Sequence, *, step: int, produced_version: int,
         ts: float | None = None,
+        dynamics: Mapping[str, Any] | None = None,
     ) -> None:
         """One optimizer step consumed these groups and produced
         ``produced_version``. Closes each record (sample→learn measured
         here); the policy-lag loop stays pending until that version reaches
         the workers (``on_push`` locally / ``on_broadcast_complete`` over
-        the bus)."""
+        the bus). ``dynamics`` is the consuming step's training-dynamics
+        subset (``learn_obs.lineage_dynamics``) — stamped on every record
+        the step consumed so reports can correlate policy lag with KL."""
         ts = time.time() if ts is None else ts
+        dynamics = dynamics or {}
         with self._mu:
             pend = self._await_act.setdefault(int(produced_version), [])
             for t in trajs_or_uids:
@@ -297,6 +307,12 @@ class LineageLedger:
                 rec.consumed_step = int(step)
                 rec.produced_version = int(produced_version)
                 rec.consumed_ts = ts
+                if "kl" in dynamics:
+                    rec.kl = float(dynamics["kl"])
+                if "entropy" in dynamics:
+                    rec.entropy = float(dynamics["entropy"])
+                if "ratio_cap_frac" in dynamics:
+                    rec.ratio_cap_frac = float(dynamics["ratio_cap_frac"])
                 if rec.sampled_ts is not None:
                     rec.sample_to_learn_ms = (ts - rec.sampled_ts) * 1e3
                     telemetry.hist_observe(

@@ -52,6 +52,13 @@ from collections import deque
 from typing import Any, Callable, Mapping
 
 from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.learn_obs import (
+    LEARN_CAP_FRAC,
+    LEARN_CLIP_FRAC,
+    LEARN_ENTROPY,
+    LEARN_GRAD_NORM_TOTAL,
+    LEARN_KL,
+)
 from distrl_llm_tpu.serving_obs import (
     FLEET_SERVING_QUEUE_WAIT_MAX_MS,
     FLEET_SERVING_TTFT_MAX_MS,
@@ -679,6 +686,15 @@ class Sentinel:
       ``serving/ttft_ms`` / ``serving/queue_wait_ms`` (local registry max,
       or the fleet-folded worker max) above the configured SLO
       (``slo_ttft_ms`` / ``slo_queue_wait_ms``; None = trigger unarmed).
+    * ``entropy_collapse`` / ``kl_blowup`` / ``ratio_saturation`` /
+      ``grad_spike`` — training-dynamics triggers (ISSUE 16) over the
+      device-fused ``learn/*`` bundle the trainer merges into the step
+      record: masked answer-token entropy below ``learn_entropy_floor``;
+      behavior↔policy KL above ``learn_kl_limit``; the AIPO
+      cap-saturation (or PPO clip) fraction above
+      ``learn_ratio_sat_frac``; the whole-adapter grad norm above
+      ``learn_grad_spike`` × its running EMA after ``warmup_steps``
+      observations. None = trigger unarmed.
 
     ``DISTRL_SENTINEL_INJECT="<trigger>:<step>"`` deterministically
     injects any trigger's precondition at the named step — the seeded
@@ -694,7 +710,11 @@ class Sentinel:
     which fakes *sustained* pressure for the HBM governor), and
     ``ttft_blowup`` / ``queue_wait_blowup`` an SLO breach (legal only
     with the matching SLO armed — injecting an unarmable trigger would
-    make a CI gate built on it pass vacuously).
+    make a CI gate built on it pass vacuously). The training-dynamics
+    triggers inject the same way: a reading past their armed threshold
+    at the named step (``grad_spike`` additionally seeds the EMA/warmup
+    preconditions so the spike is judgeable) — each legal only with its
+    ``learn_*`` threshold armed.
     """
 
     def __init__(self, recorder: FlightRecorder | None, profiler=None, *,
@@ -704,6 +724,10 @@ class Sentinel:
                  staleness_limit: float | None = None,
                  slo_ttft_ms: float | None = None,
                  slo_queue_wait_ms: float | None = None,
+                 learn_entropy_floor: float | None = None,
+                 learn_kl_limit: float | None = None,
+                 learn_ratio_sat_frac: float | None = None,
+                 learn_grad_spike: float | None = None,
                  capture_steps: int = 2):
         self.recorder = recorder
         self.profiler = profiler
@@ -715,6 +739,10 @@ class Sentinel:
         self.staleness_limit = staleness_limit
         self.slo_ttft_ms = slo_ttft_ms
         self.slo_queue_wait_ms = slo_queue_wait_ms
+        self.learn_entropy_floor = learn_entropy_floor
+        self.learn_kl_limit = learn_kl_limit
+        self.learn_ratio_sat_frac = learn_ratio_sat_frac
+        self.learn_grad_spike = learn_grad_spike
         self.capture_steps = capture_steps
         self.fired: set[str] = set()
         # trigger escalation hook (ISSUE 14): the trainer points this at
@@ -725,6 +753,8 @@ class Sentinel:
         self.on_trigger: Callable[[str, int, Mapping[str, Any]], Any] | None = None
         self._tok_ema: float | None = None
         self._tok_obs = 0
+        self._grad_ema: float | None = None
+        self._grad_obs = 0
         self._seen_reward = False
         self._collapse_run = 0
         self._inject: tuple[str, int] | None = None
@@ -743,7 +773,9 @@ class Sentinel:
                 if trig not in ("nan_loss", "tok_s_regression",
                                 "reward_collapse", "staleness_blowup",
                                 "hbm_breach",
-                                "ttft_blowup", "queue_wait_blowup"):
+                                "ttft_blowup", "queue_wait_blowup",
+                                "entropy_collapse", "kl_blowup",
+                                "ratio_saturation", "grad_spike"):
                     raise ValueError(trig)
                 # vacuous-gate guards: without the matching limit there is
                 # no threshold to breach
@@ -759,16 +791,32 @@ class Sentinel:
                         "staleness_blowup needs a staleness limit "
                         "(async mode)"
                     )
+                if (trig == "entropy_collapse"
+                        and learn_entropy_floor is None):
+                    raise ValueError(
+                        "entropy_collapse needs learn_entropy_floor"
+                    )
+                if trig == "kl_blowup" and learn_kl_limit is None:
+                    raise ValueError("kl_blowup needs learn_kl_limit")
+                if (trig == "ratio_saturation"
+                        and learn_ratio_sat_frac is None):
+                    raise ValueError(
+                        "ratio_saturation needs learn_ratio_sat_frac"
+                    )
+                if trig == "grad_spike" and learn_grad_spike is None:
+                    raise ValueError("grad_spike needs learn_grad_spike")
                 self._inject = (trig, int(at))
             except ValueError:
                 log.warning(
                     "ignoring DISTRL_SENTINEL_INJECT=%r (expected "
                     "'<trigger>:<step>' where <trigger> is one of "
                     "nan_loss, tok_s_regression, reward_collapse, "
-                    "staleness_blowup, hbm_breach, ttft_blowup or "
-                    "queue_wait_blowup; staleness_blowup only in async "
-                    "mode, the SLO triggers only with their slo_* limit "
-                    "armed)",
+                    "staleness_blowup, hbm_breach, ttft_blowup, "
+                    "queue_wait_blowup, entropy_collapse, kl_blowup, "
+                    "ratio_saturation or grad_spike; staleness_blowup "
+                    "only in async mode, the SLO triggers only with their "
+                    "slo_* limit armed, the training-dynamics triggers "
+                    "only with their learn_* threshold armed)",
                     spec,
                 )
 
@@ -841,6 +889,27 @@ class Sentinel:
             elif trig == "queue_wait_blowup":
                 m[SERVING_QUEUE_WAIT_MS + "_max"] = (
                     1000.0 * self.slo_queue_wait_ms
+                )
+            elif trig == "entropy_collapse":
+                # parse-time guards ensure the learn_* thresholds below
+                # are armed
+                m[LEARN_ENTROPY] = max(
+                    self.learn_entropy_floor - 1.0, 0.0
+                )
+            elif trig == "kl_blowup":
+                m[LEARN_KL] = 10.0 * self.learn_kl_limit + 1.0
+            elif trig == "ratio_saturation":
+                # synthetic reading; may exceed 1.0 when the threshold sits
+                # at the ceiling — the check only compares against it
+                m[LEARN_CAP_FRAC] = self.learn_ratio_sat_frac + 0.5
+            elif trig == "grad_spike":
+                # seed the EMA/warmup preconditions so the check below can
+                # judge the spike at exactly the named step
+                if self._grad_ema is None:
+                    self._grad_ema = 1.0
+                self._grad_obs = max(self._grad_obs, self.warmup_steps)
+                m[LEARN_GRAD_NORM_TOTAL] = (
+                    10.0 * self.learn_grad_spike * self._grad_ema
                 )
         if (
             self._inject is not None
@@ -929,6 +998,54 @@ class Sentinel:
                     trigger,
                     observed_ms=round(max(observed), 3), slo_ms=slo,
                 )
+        # --- training-dynamics triggers (ISSUE 16): the device-fused
+        # learn/* bundle the trainer merges into the step record
+        if self.learn_entropy_floor is not None:
+            ent = m.get(LEARN_ENTROPY)
+            if ent is not None and float(ent) < self.learn_entropy_floor:
+                fire(
+                    "entropy_collapse",
+                    entropy=float(ent), floor=self.learn_entropy_floor,
+                )
+        if self.learn_kl_limit is not None:
+            kl = m.get(LEARN_KL)
+            if kl is not None and float(kl) > self.learn_kl_limit:
+                fire(
+                    "kl_blowup",
+                    kl=float(kl), limit=self.learn_kl_limit,
+                )
+        if self.learn_ratio_sat_frac is not None:
+            # AIPO runs report the cap-saturation fraction, PPO-clip runs
+            # the clip fraction — one trigger covers whichever the loss
+            # computes
+            sat = m.get(LEARN_CAP_FRAC)
+            if sat is None:
+                sat = m.get(LEARN_CLIP_FRAC)
+            if sat is not None and float(sat) > self.learn_ratio_sat_frac:
+                fire(
+                    "ratio_saturation",
+                    saturated_frac=float(sat),
+                    limit=self.learn_ratio_sat_frac,
+                )
+        if self.learn_grad_spike is not None:
+            g = m.get(LEARN_GRAD_NORM_TOTAL)
+            if g is not None:
+                g = float(g)
+                self._grad_obs += 1
+                if self._grad_ema is None:
+                    self._grad_ema = g
+                else:
+                    if (
+                        self._grad_obs > self.warmup_steps
+                        and g > self.learn_grad_spike * self._grad_ema
+                    ):
+                        fire(
+                            "grad_spike",
+                            grad_norm=g, ema=round(self._grad_ema, 6),
+                            factor=self.learn_grad_spike,
+                        )
+                    a = self.tok_ema_alpha
+                    self._grad_ema = a * g + (1 - a) * self._grad_ema
         # --- HBM watermark breach
         stats = forced_hbm if forced_hbm is not None else hbm_stats()
         if stats and stats.get("bytes_limit"):
@@ -961,6 +1078,10 @@ class ObsPlane:
                  staleness_limit: float | None = None,
                  slo_ttft_ms: float | None = None,
                  slo_queue_wait_ms: float | None = None,
+                 learn_entropy_floor: float | None = None,
+                 learn_kl_limit: float | None = None,
+                 learn_ratio_sat_frac: float | None = None,
+                 learn_grad_spike: float | None = None,
                  config_snapshot: Mapping[str, Any] | None = None,
                  plan_provider: Callable[[], Mapping[str, Any] | None] | None = None):
         self.fleet = FleetAggregator(driver) if driver is not None else None
@@ -980,6 +1101,10 @@ class ObsPlane:
                 self.recorder, profiler, staleness_limit=staleness_limit,
                 slo_ttft_ms=slo_ttft_ms,
                 slo_queue_wait_ms=slo_queue_wait_ms,
+                learn_entropy_floor=learn_entropy_floor,
+                learn_kl_limit=learn_kl_limit,
+                learn_ratio_sat_frac=learn_ratio_sat_frac,
+                learn_grad_spike=learn_grad_spike,
             )
             if sentinel else None
         )

@@ -290,6 +290,10 @@ class Trainer:
                 config.max_staleness
                 if config.staleness_policy == "drop" else 0
             ),
+            # training-dynamics bundle (ISSUE 16): computed inside the
+            # jitted step and returned through the existing aux pytree —
+            # it rides the one host fetch the loss already pays
+            emit_dynamics=config.learn_obs,
         )
 
         self.total_batch_steps = 0
@@ -367,6 +371,13 @@ class Trainer:
                 # queue_wait_blowup sentinel triggers
                 slo_ttft_ms=config.slo_ttft_ms,
                 slo_queue_wait_ms=config.slo_queue_wait_ms,
+                # training-dynamics gates (ISSUE 16): arm the
+                # entropy_collapse / kl_blowup / ratio_saturation /
+                # grad_spike triggers over the learn/* bundle
+                learn_entropy_floor=config.learn_entropy_floor,
+                learn_kl_limit=config.learn_kl_limit,
+                learn_ratio_sat_frac=config.learn_ratio_sat_frac,
+                learn_grad_spike=config.learn_grad_spike,
                 config_snapshot=config.to_flat_dict(),
                 plan_provider=lambda: (
                     self.engine.resolved_plan.plan.to_dict()
@@ -392,6 +403,21 @@ class Trainer:
                 # produced version (PR 9's broadcast), not the local push
                 self.lineage.expect_acks = True
                 bus.on_broadcast = self.lineage.on_broadcast_complete
+
+        # training-dynamics ledger (distrl_llm_tpu/learn_obs.py, ISSUE 16):
+        # host half of the device-fused bundle the armed train step returns
+        # — publishes learn/* registry series, tracks reward drift, streams
+        # the per-step JSONL. None unless --learn_obs armed it; the step
+        # loop's hook is one attribute check when off.
+        self.learn: Any = None
+        self._last_dynamics: Any = None
+        if config.learn_obs:
+            from distrl_llm_tpu.learn_obs import LearnLedger
+
+            self.learn = LearnLedger(
+                out_dir=config.learn_dir,
+                drift_window=config.learn_drift_window,
+            )
 
         # request-level serving ledger (distrl_llm_tpu/serving_obs.py,
         # ISSUE 13): per-group lifecycle + admission audit recorded by the
@@ -1311,6 +1337,10 @@ class Trainer:
                 # stream any open serving records plus the stall/occupancy
                 # summary line, so serving.jsonl is report-complete
                 self.serving.close()
+            if self.learn is not None:
+                # append the run-summary line so learn.jsonl is
+                # report-complete for tools/learn_report.py
+                self.learn.close()
             # the obs plane deliberately OUTLIVES train(): a fleet
             # operator scrapes the endpoint while rejoins/drains settle
             # after the loop ends — close_obs() (or process exit; the
@@ -1498,9 +1528,14 @@ class Trainer:
                 # weight version it produced (both just advanced inside
                 # _update_on_candidates) — closes each record and opens
                 # the produced version's policy-lag window
+                from distrl_llm_tpu.learn_obs import lineage_dynamics
+
                 self.lineage.on_consumed(
                     kept, step=self.total_batch_steps,
                     produced_version=self.weight_version,
+                    # the consuming step's dynamics subset (ISSUE 16) —
+                    # None unless learn_obs armed the device bundle
+                    dynamics=lineage_dynamics(self._last_dynamics),
                 )
             if cfg.eval_every and self.total_batch_steps % cfg.eval_every == 0:
                 # evals need exclusive engine access (engines are not
@@ -1611,14 +1646,28 @@ class Trainer:
             # the max_* caps unless the learner buckets cut them)
             answer_width = int(update.answer_ids.shape[1])
             prompt_width = int(update.prompt_ids.shape[1])
-            self.lora, self.opt_state, loss = self.train_step(
+            step_args = (
                 self.lora, self.opt_state,
                 None if self._full else self.base_params_learner, update,
                 # adapter-input dropout (helper.py:40) needs a fresh key per
                 # update; disabled (None) when the rate is 0
                 self._next_rng() if cfg.lora_dropout > 0.0 else None,
             )
-            loss = float(loss)
+            if self.learn is not None:
+                # training-dynamics bundle (ISSUE 16): the armed step
+                # returns it through the aux pytree, and the loss fetch the
+                # off path already pays is widened to carry it — still
+                # exactly ONE host transfer per optimizer step
+                self.lora, self.opt_state, loss_dev, dyn_dev = (
+                    self.train_step(*step_args)
+                )
+                loss_host, self._last_dynamics = jax.device_get(
+                    (loss_dev, dyn_dev)
+                )
+                loss = float(loss_host)
+            else:
+                self.lora, self.opt_state, loss = self.train_step(*step_args)
+                loss = float(loss)
         if (
             self._inject_nan_step is not None
             and self.total_batch_steps + 1 == self._inject_nan_step
@@ -1770,6 +1819,15 @@ class Trainer:
                     obs_mod.OBS_LEARNER_IDLE,
                     timer.get("generation") / phase_total,
                 )
+        if self.learn is not None and self._last_dynamics is not None:
+            # training-dynamics bundle (ISSUE 16): publish this step's
+            # device-computed learn/* gauges + IS-ratio histogram BEFORE
+            # the snapshot merge below, so the dynamics ride the same sink
+            # record (wandb/jsonl curves) and the sentinel's metrics view
+            self.learn.on_step(
+                self.total_batch_steps, self._last_dynamics,
+                reward_mean=metrics.get("mean_accuracy_reward"),
+            )
         # registry series (pool/occupancy gauge, cp/rpc_* histograms, …)
         # ride the same sink record
         metrics.update(telemetry.metrics_snapshot())

@@ -273,6 +273,29 @@ class TestBenchContract:
                     "hbm_peak_bytes", "recompile_count"):
             assert key in rec, key
         assert "error" not in rec
+        # training-dynamics fields (ISSUE 16): keys always present,
+        # honestly null when BENCH_LEARN_OBS did not arm the fused bundle
+        for key in ("entropy", "kl_p90", "clip_frac", "ratio_cap_frac"):
+            assert key in rec, key
+            assert rec[key] is None
+
+    def test_learner_dynamics_fields(self):
+        """BENCH_LEARN_OBS=1 (ISSUE 16): the armed learner row carries the
+        measured policy-health fields — entropy/kl_p90/clip_frac real
+        numbers off the device bundle, ratio_cap_frac still null (the
+        bench step runs the PPO-clip objective, not AIPO)."""
+        rec = run_bench({
+            "BENCH_MODE": "learner", "BENCH_MODEL": "tiny",
+            "BENCH_ROWS": "2", "BENCH_MICRO": "1",
+            "BENCH_MAX_PROMPT": "16", "BENCH_MAX_NEW": "16",
+            "BENCH_STEPS": "1", "BENCH_LEARN_OBS": "1",
+        })
+        assert "error" not in rec
+        assert rec["entropy"] is not None and rec["entropy"] > 0
+        assert rec["kl_p90"] is not None and rec["kl_p90"] >= 0
+        assert rec["clip_frac"] is not None
+        assert 0.0 <= rec["clip_frac"] <= 1.0
+        assert rec["ratio_cap_frac"] is None
 
     def test_learner_quantized_base(self):
         rec = run_bench({

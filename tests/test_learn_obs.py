@@ -1,0 +1,643 @@
+"""Training-dynamics observability (ISSUE 16): the device-fused bundle's
+math and byte-identity contract, the four learn sentinel triggers (natural
+thresholds + seeded chaos gates, exactly-once), config validation, the
+LearnLedger's registry/drift/JSONL behavior, the kl_blowup → staleness
+governor escalation, and the report tools' empty-when-absent contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu import obs, telemetry
+from distrl_llm_tpu.config import TrainConfig
+from distrl_llm_tpu.learn_obs import (
+    LEARN_CAP_FRAC,
+    LEARN_CLIP_FRAC,
+    LEARN_ENTROPY,
+    LEARN_GRAD_NORM_TOTAL,
+    LEARN_KL,
+    LearnLedger,
+    lineage_dynamics,
+)
+
+# ----------------------------------------------------------- device bundle
+
+
+def _batch(rng, n=4, p=6, t=5, behavior=True):
+    from distrl_llm_tpu.learner.train_step import UpdateBatch
+    from distrl_llm_tpu.models import TINY
+
+    amask = np.ones((n, t), np.int32)
+    amask[1, 3:] = 0  # ragged answers: the masked positions must not count
+    return UpdateBatch(
+        prompt_ids=jnp.asarray(
+            rng.integers(1, TINY.vocab_size, (n, p)), jnp.int32
+        ),
+        prompt_mask=jnp.ones((n, p), jnp.int32),
+        answer_ids=jnp.asarray(
+            rng.integers(1, TINY.vocab_size, (n, t)), jnp.int32
+        ),
+        answer_mask=jnp.asarray(amask),
+        coeffs=jnp.asarray(rng.normal(size=n), jnp.float32),
+        sample_mask=jnp.ones((n,), jnp.float32),
+        behavior_logps=(
+            jnp.asarray(rng.normal(-2.0, 0.25, (n, t)), jnp.float32)
+            if behavior else None
+        ),
+    )
+
+
+class TestDeviceBundle:
+    """emit_dynamics=True must change the return arity and NOTHING else."""
+
+    def _run(self, *, emit, steps=3, off_policy="clip", seed=0):
+        import optax
+
+        from distrl_llm_tpu.learner.train_step import make_train_step
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        opt = optax.sgd(1e-3)
+        opt_state = opt.init(lora)
+        step = make_train_step(
+            TINY, learner_type="grpo", optimizer=opt, lora_scale=0.5,
+            micro_size=2, donate=False, clip_ratio=0.2,
+            off_policy=off_policy, is_cap=2.0, emit_dynamics=emit,
+        )
+        rng = np.random.default_rng(seed)
+        losses, dyn = [], None
+        for _ in range(steps):
+            out = step(lora, opt_state, params, _batch(rng))
+            if emit:
+                lora, opt_state, loss, dyn = out
+            else:
+                lora, opt_state, loss = out
+            losses.append(np.asarray(loss).tobytes())
+        return losses, lora, dyn
+
+    def test_armed_is_byte_identical_to_off(self):
+        """The acceptance bar: same losses (byte-for-byte) and same adapter
+        after N steps — the bundle is derived under stop_gradient from
+        intermediates the loss already materializes."""
+        off_losses, off_lora, _ = self._run(emit=False)
+        on_losses, on_lora, dyn = self._run(emit=True)
+        assert on_losses == off_losses  # raw bytes, not approx
+        flat_off = jax.tree_util.tree_leaves(off_lora)
+        flat_on = jax.tree_util.tree_leaves(on_lora)
+        for a, b in zip(flat_off, flat_on):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert dyn is not None
+
+    def test_bundle_contents_clip(self):
+        _, _, dyn = self._run(emit=True, steps=1)
+        dyn = jax.device_get(dyn)
+        assert float(dyn["entropy"]) > 0.0
+        assert float(dyn["kl"]) >= 0.0
+        assert 0.0 <= float(dyn["clip_frac"]) <= 1.0
+        assert "cap_frac" not in dyn  # clip mode reports clip, not cap
+        assert float(dyn["grad_norm_total"]) > 0.0
+        # real answer tokens: 3 full rows of 5 + one row of 3
+        assert float(dyn["tokens"]) == pytest.approx(18.0)
+        # the device histogram puts every real token in exactly one bucket
+        counts = np.asarray(dyn["ratio_counts"])
+        assert counts.sum() == 18
+        assert (counts >= 0).all()
+        # per-layer-group LoRA grad norms: A and B families present
+        groups = [k for k in dyn if k.startswith("grad_norm_")
+                  and k != "grad_norm_total"]
+        assert any(g.startswith("grad_norm_a") for g in groups)
+        assert any(g.startswith("grad_norm_b") for g in groups)
+
+    def test_bundle_contents_aipo(self):
+        _, _, dyn = self._run(emit=True, steps=1, off_policy="aipo")
+        dyn = jax.device_get(dyn)
+        assert "cap_frac" in dyn and "clip_frac" not in dyn
+        assert 0.0 <= float(dyn["cap_frac"]) <= 1.0
+
+    def test_device_histogram_matches_host_bucketing(self):
+        """searchsorted(side='left') on device must land each ratio in the
+        same bucket the registry's bisect_left would — replayed counts then
+        reproduce the device histogram exactly."""
+        import bisect
+
+        _, _, dyn = self._run(emit=True, steps=1)
+        dyn = jax.device_get(dyn)
+        bounds = list(telemetry.HIST_BUCKET_BOUNDS)
+        # replay via the ledger's representative values and re-bucket
+        ledger = LearnLedger()
+        for bucket, c in enumerate(np.asarray(dyn["ratio_counts"])):
+            if int(c) == 0:
+                continue
+            v = ledger._hist_value(bucket)
+            assert bisect.bisect_left(bounds, v) == bucket
+
+    def test_on_policy_batch_has_no_kl_keys(self):
+        import optax
+
+        from distrl_llm_tpu.learner.train_step import make_train_step
+        from distrl_llm_tpu.models import TINY, init_lora_params, init_params
+
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        opt = optax.sgd(1e-3)
+        step = make_train_step(
+            TINY, learner_type="pg", optimizer=opt, lora_scale=0.5,
+            micro_size=2, donate=False, emit_dynamics=True,
+        )
+        rng = np.random.default_rng(2)
+        _, _, _, dyn = step(
+            lora, opt.init(lora), params, _batch(rng, behavior=False)
+        )
+        dyn = jax.device_get(dyn)
+        assert "kl" not in dyn and "ratio_counts" not in dyn
+        assert float(dyn["entropy"]) > 0.0
+
+
+# ------------------------------------------------------- sentinel triggers
+
+
+def _sentinel(tmp_path, **kw):
+    rec = obs.FlightRecorder(str(tmp_path), ring_size=8)
+    return obs.Sentinel(rec, **kw), rec
+
+
+class TestLearnTriggers:
+    def test_entropy_collapse_fires_exactly_once(self, tmp_path):
+        s, rec = _sentinel(tmp_path, learn_entropy_floor=0.5)
+        assert s.check(1, {LEARN_ENTROPY: 1.2}) == []
+        assert s.check(2, {LEARN_ENTROPY: 0.1}) == ["entropy_collapse"]
+        assert s.check(3, {LEARN_ENTROPY: 0.0}) == []  # once per run
+        assert len(rec.incidents) == 1
+        man = json.load(
+            open(os.path.join(rec.incidents[0], "manifest.json"))
+        )
+        assert man["trigger"] == "entropy_collapse"
+        assert man["entropy"] == pytest.approx(0.1)
+        assert man["floor"] == pytest.approx(0.5)
+
+    def test_kl_blowup(self, tmp_path):
+        s, rec = _sentinel(tmp_path, learn_kl_limit=1.0)
+        assert s.check(1, {LEARN_KL: 0.8}) == []
+        assert s.check(2, {LEARN_KL: 3.0}) == ["kl_blowup"]
+        assert s.check(3, {LEARN_KL: 9.0}) == []
+        assert len(rec.incidents) == 1
+
+    def test_ratio_saturation_prefers_cap_falls_back_to_clip(self, tmp_path):
+        s, _ = _sentinel(tmp_path, learn_ratio_sat_frac=0.5)
+        # cap_frac present and healthy wins over a breaching clip_frac:
+        # AIPO runs judge the cap, not the (absent) clip
+        assert s.check(1, {LEARN_CAP_FRAC: 0.2, LEARN_CLIP_FRAC: 0.9}) == []
+        assert s.check(2, {LEARN_CAP_FRAC: 0.8}) == ["ratio_saturation"]
+        # clip-only runs judge the clip fraction with the same threshold
+        s2, _ = _sentinel(tmp_path / "b", learn_ratio_sat_frac=0.5)
+        assert s2.check(1, {LEARN_CLIP_FRAC: 0.7}) == ["ratio_saturation"]
+
+    def test_grad_spike_needs_warmup_and_ema(self, tmp_path):
+        s, rec = _sentinel(tmp_path, learn_grad_spike=3.0, warmup_steps=2)
+        # warmup: even a huge reading inside the first warmup_steps
+        # observations must not fire (the EMA is not judgeable yet)
+        assert s.check(1, {LEARN_GRAD_NORM_TOTAL: 1.0}) == []
+        assert s.check(2, {LEARN_GRAD_NORM_TOTAL: 100.0}) == []
+        # post-warmup spike vs the (now polluted) EMA
+        for step in range(3, 8):
+            s.check(step, {LEARN_GRAD_NORM_TOTAL: 1.0})
+        fired = s.check(8, {LEARN_GRAD_NORM_TOTAL: 1000.0})
+        assert fired == ["grad_spike"]
+        assert len(rec.incidents) == 1
+        man = json.load(
+            open(os.path.join(rec.incidents[0], "manifest.json"))
+        )
+        assert man["grad_norm"] == pytest.approx(1000.0)
+        assert man["factor"] == pytest.approx(3.0)
+
+    @pytest.mark.parametrize(
+        "trigger,kw",
+        [
+            ("entropy_collapse", {"learn_entropy_floor": 0.5}),
+            ("kl_blowup", {"learn_kl_limit": 1.0}),
+            ("ratio_saturation", {"learn_ratio_sat_frac": 0.5}),
+            ("grad_spike", {"learn_grad_spike": 2.0}),
+        ],
+    )
+    def test_seeded_injection_exactly_one_bundle(
+        self, tmp_path, monkeypatch, trigger, kw
+    ):
+        """The chaos gates (acceptance bar): each trigger injectable via
+        DISTRL_SENTINEL_INJECT at a named step, one incident bundle, never
+        a second."""
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", f"{trigger}:3")
+        s, rec = _sentinel(tmp_path, **kw)
+        for step in range(1, 7):
+            s.check(step, {"loss": 1.0})  # healthy metrics throughout
+        assert len(rec.incidents) == 1
+        man = json.load(
+            open(os.path.join(rec.incidents[0], "manifest.json"))
+        )
+        assert man["trigger"] == trigger and man["step"] == 3
+
+    def test_ratio_saturation_injection_at_ceiling_threshold(
+        self, tmp_path, monkeypatch
+    ):
+        """threshold == 1.0 (the allowed ceiling): the synthetic reading
+        must still strictly exceed it — a clamped-to-1.0 injection would
+        make this gate pass vacuously."""
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "ratio_saturation:2")
+        s, rec = _sentinel(tmp_path, learn_ratio_sat_frac=1.0)
+        for step in range(1, 5):
+            s.check(step, {"loss": 1.0})
+        assert len(rec.incidents) == 1
+
+    @pytest.mark.parametrize(
+        "trigger",
+        ["entropy_collapse", "kl_blowup", "ratio_saturation", "grad_spike"],
+    )
+    def test_injection_rejected_without_threshold(
+        self, tmp_path, monkeypatch, trigger
+    ):
+        """Vacuous-gate guard: injecting a learn trigger whose threshold is
+        unarmed is rejected at parse time (warning), not accepted-and-dud."""
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", f"{trigger}:2")
+        s, rec = _sentinel(tmp_path)  # no learn_* threshold armed
+        assert s._inject is None
+        for step in range(1, 5):
+            s.check(step, {"loss": 1.0})
+        assert rec.incidents == []
+
+    def test_kl_blowup_escalates_to_staleness_governor(
+        self, tmp_path, monkeypatch
+    ):
+        """ISSUE 16 control wiring: kl_blowup routes to the staleness
+        governor (same escalation as staleness_blowup) and shrinks the
+        effective staleness bound exactly once."""
+        from distrl_llm_tpu.control import ControlRuntime, StalenessGovernor
+        from distrl_llm_tpu.rollout.buffer import TrajectoryBuffer
+        from distrl_llm_tpu.rollout.staleness import StalenessPolicy
+
+        telemetry.reset()
+        monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "kl_blowup:2")
+        policy = StalenessPolicy(8, mode="drop")
+        buffer = TrajectoryBuffer(32, high_watermark=32)
+        rt = ControlRuntime(budget=8)
+        rt.register(
+            StalenessGovernor(
+                policy, buffer, lag_target_ms=1000.0, batch_size=4,
+                cooldown_steps=0, dwell_steps=1,
+            ),
+            triggers=("staleness_blowup", "kl_blowup"),
+        )
+        s, rec = _sentinel(tmp_path, learn_kl_limit=1.0)
+        s.on_trigger = rt.on_trigger
+        before = policy.max_staleness
+        for step in range(1, 5):
+            s.check(step, {"loss": 1.0})
+        assert len(rec.incidents) == 1 and "kl_blowup" in rec.incidents[0]
+        # the governor shrinks its knobs in lockstep (one escalation may
+        # move both the staleness bound and the buffer watermark) — every
+        # action must carry the escalating trigger, and exactly one
+        # escalation happened (the sentinel's fire-once contract)
+        assert rt.actions_taken >= 1
+        assert all(a.trigger == "kl_blowup" for a in rt.actions)
+        assert policy.max_staleness < before
+        snap = telemetry.metrics_snapshot()
+        assert snap["control/trigger_escalations"] == 1.0
+
+    def test_attach_staleness_registers_kl_blowup(self):
+        """The production wiring (controllers.attach_staleness) must map
+        kl_blowup, not just the test's hand-built runtime."""
+        from distrl_llm_tpu.control import ControlRuntime
+        from distrl_llm_tpu.control.controllers import attach_staleness
+        from distrl_llm_tpu.rollout.buffer import TrajectoryBuffer
+        from distrl_llm_tpu.rollout.staleness import StalenessPolicy
+
+        cfg = TrainConfig(
+            rollout_mode="async", clip_ratio=0.2, max_staleness=2,
+            lineage=True, control_staleness=True,
+        )
+        rt = ControlRuntime(budget=4)
+        attach_staleness(
+            rt, cfg, StalenessPolicy(4), TrajectoryBuffer(16)
+        )
+        assert "kl_blowup" in rt._trigger_map
+        assert rt._trigger_map["kl_blowup"] is rt._trigger_map[
+            "staleness_blowup"
+        ]
+
+
+# ------------------------------------------------------ config validation
+
+
+class TestConfigValidation:
+    def test_learn_dir_implies_learn_obs(self, tmp_path):
+        c = TrainConfig(learn_dir=str(tmp_path / "learn"))
+        assert c.learn_obs is True
+
+    def test_drift_window_lower_bound(self):
+        with pytest.raises(ValueError, match="learn_drift_window"):
+            TrainConfig(learn_obs=True, learn_drift_window=1)
+
+    @pytest.mark.parametrize(
+        "field", ["learn_entropy_floor", "learn_kl_limit",
+                  "learn_ratio_sat_frac", "learn_grad_spike"],
+    )
+    def test_thresholds_require_sentinel(self, field):
+        with pytest.raises(ValueError, match="sentinel"):
+            TrainConfig(**{field: 1.5 if field == "learn_grad_spike"
+                           else 0.5})
+
+    def test_thresholds_auto_arm_learn_obs(self, tmp_path):
+        c = TrainConfig(
+            sentinel=True, flight_recorder_dir=str(tmp_path),
+            learn_kl_limit=1.0,
+        )
+        assert c.learn_obs is True
+
+    @pytest.mark.parametrize(
+        "kw,match", [
+            ({"learn_entropy_floor": -0.1}, "learn_entropy_floor"),
+            ({"learn_kl_limit": 0.0}, "learn_kl_limit"),
+            # token fraction in (0, 1]
+            ({"learn_ratio_sat_frac": 1.5}, "learn_ratio_sat_frac"),
+            # EMA multiple, must be > 1
+            ({"learn_grad_spike": 0.9}, "learn_grad_spike"),
+        ],
+    )
+    def test_threshold_bounds(self, tmp_path, kw, match):
+        with pytest.raises(ValueError, match=match):
+            TrainConfig(
+                sentinel=True, flight_recorder_dir=str(tmp_path), **kw
+            )
+
+
+# ------------------------------------------------------------ LearnLedger
+
+
+class TestLearnLedger:
+    def test_publishes_gauges_and_replays_histogram(self):
+        telemetry.reset()
+        ledger = LearnLedger()
+        counts = [0] * (len(telemetry.HIST_BUCKET_BOUNDS) + 1)
+        counts[4], counts[7], counts[-1] = 5, 2, 1
+        doc = ledger.on_step(3, {
+            "entropy": 1.25, "kl": 0.02, "clip_frac": 0.1,
+            "adv_mean": 0.0, "adv_std": 1.0, "adv_pos_frac": 0.5,
+            "tokens": 8.0, "grad_norm_total": 0.75, "grad_norm_a0": 0.5,
+            "ratio_counts": counts,
+        })
+        assert doc["step"] == 3 and doc["entropy"] == 1.25
+        snap = telemetry.metrics_snapshot()
+        assert snap["learn/entropy"] == 1.25
+        assert snap["learn/kl_behavior"] == 0.02
+        assert snap["learn/grad_norm/total"] == 0.75
+        assert snap["learn/grad_norm/a0"] == 0.5
+        assert snap["learn/steps"] == 1.0
+        # the weighted replay reproduces the device total, overflow incl.
+        assert snap["learn/is_ratio_count"] == 8.0
+
+    def test_drift_zscore_against_reference_window(self):
+        telemetry.reset()
+        ledger = LearnLedger(drift_window=2)
+        dyn = {"entropy": 1.0}
+        # reference window needs 2 displaced means before a z is honest
+        for step, r in enumerate([0.0, 1.0, 0.0, 1.0], 1):
+            doc = ledger.on_step(step, dyn, reward_mean=r)
+            assert "reward_drift" not in doc
+        doc = ledger.on_step(5, dyn, reward_mean=5.0)
+        # ref window = [0.0, 1.0]: mean .5, std .5 → z = 9
+        assert doc["reward_drift"] == pytest.approx(9.0, rel=1e-4)
+        snap = telemetry.metrics_snapshot()
+        assert snap["learn/reward_drift"] == pytest.approx(9.0, rel=1e-4)
+
+    def test_jsonl_stream_and_summary(self, tmp_path):
+        telemetry.reset()
+        out = str(tmp_path / "learn")
+        ledger = LearnLedger(out_dir=out)
+        ledger.on_step(1, {"entropy": 1.0}, reward_mean=0.5)
+        ledger.on_step(2, {"entropy": 0.9}, reward_mean=0.4)
+        ledger.close()
+        rows = [json.loads(l) for l in
+                open(os.path.join(out, "learn.jsonl"))]
+        assert [r["kind"] for r in rows] == ["step", "step", "summary"]
+        assert rows[2]["steps"] == 2
+        assert rows[2]["last"]["entropy"] == 0.9
+
+    def test_no_out_dir_writes_nothing(self, tmp_path):
+        telemetry.reset()
+        ledger = LearnLedger()
+        ledger.on_step(1, {"entropy": 1.0})
+        ledger.close()
+        assert os.listdir(tmp_path) == []
+
+    def test_rejects_degenerate_window(self):
+        with pytest.raises(ValueError, match="drift_window"):
+            LearnLedger(drift_window=1)
+
+
+# ------------------------------------------------------- lineage coupling
+
+
+def _traj(version: int = 1):
+    from distrl_llm_tpu.rollout.trajectory import Trajectory
+
+    return Trajectory(
+        problem="what is 1+1?", solution="2", answers=["2", "3"],
+        token_lengths=[1, 1], produced_version=version,
+        episode=0, batch_index=0,
+    )
+
+
+class TestLineageDynamics:
+    def test_none_and_empty_in_none_out(self):
+        assert lineage_dynamics(None) is None
+        assert lineage_dynamics({}) is None
+        assert lineage_dynamics({"tokens": 8.0}) is None
+
+    def test_cap_frac_preferred_over_clip(self):
+        out = lineage_dynamics({
+            "entropy": np.float32(1.5), "kl": np.float32(0.1),
+            "cap_frac": np.float32(0.2), "clip_frac": np.float32(0.9),
+        })
+        assert out == {
+            "entropy": pytest.approx(1.5), "kl": pytest.approx(0.1),
+            "ratio_cap_frac": pytest.approx(0.2),
+        }
+
+    def test_clip_frac_fallback(self):
+        out = lineage_dynamics({"clip_frac": 0.3})
+        assert out == {"ratio_cap_frac": pytest.approx(0.3)}
+
+    def test_consumed_records_carry_columns(self, tmp_path):
+        from distrl_llm_tpu.lineage import LineageLedger
+
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        traj = _traj()
+        led.on_group_sampled(traj, worker="w0", ts=100.0)
+        led.on_consumed(
+            [traj], step=5, produced_version=2, ts=101.0,
+            dynamics={"kl": 0.25, "entropy": 1.1, "ratio_cap_frac": 0.05},
+        )
+        led.close()
+        rows = [json.loads(l) for l in
+                open(os.path.join(str(tmp_path), "lineage.jsonl"))]
+        consumed = [r for r in rows if r.get("consumed_step") == 5]
+        assert consumed and consumed[0]["kl"] == pytest.approx(0.25)
+        assert consumed[0]["entropy"] == pytest.approx(1.1)
+        assert consumed[0]["ratio_cap_frac"] == pytest.approx(0.05)
+
+    def test_consumed_without_dynamics_leaves_columns_null(self, tmp_path):
+        from distrl_llm_tpu.lineage import LineageLedger
+
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        traj = _traj()
+        led.on_group_sampled(traj, worker="w0", ts=100.0)
+        led.on_consumed([traj], step=5, produced_version=2, ts=101.0)
+        led.close()
+        rows = [json.loads(l) for l in
+                open(os.path.join(str(tmp_path), "lineage.jsonl"))]
+        consumed = [r for r in rows if r.get("consumed_step") == 5]
+        assert consumed and consumed[0]["kl"] is None
+        assert consumed[0]["entropy"] is None
+
+
+# ------------------------------------------------------------ report tools
+
+
+class TestLearnReport:
+    def _write_learn(self, tmp_path, n=3):
+        path = str(tmp_path / "learn.jsonl")
+        with open(path, "w") as f:
+            for step in range(1, n + 1):
+                f.write(json.dumps({
+                    "kind": "step", "ts": 0.0, "step": step,
+                    "entropy": 1.0 - 0.1 * step, "kl": 0.01 * step,
+                    "clip_frac": 0.05, "adv_mean": 0.0, "adv_std": 1.0,
+                    "adv_pos_frac": 0.5, "grad_norm_total": 0.8,
+                    "reward_mean": 0.4, "reward_drift": 0.2 * step,
+                }) + "\n")
+            f.write(json.dumps({
+                "kind": "summary", "ts": 0.0, "steps": n,
+                "drift_window": 32, "last": {},
+            }) + "\n")
+        return path
+
+    def test_happy_path_exits_zero(self, tmp_path, capsys):
+        from tools.learn_report import main
+
+        assert main([self._write_learn(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "entropy" in out and "drift" in out
+
+    def test_empty_file_exits_one_with_stderr(self, tmp_path, capsys):
+        from tools.learn_report import main
+
+        path = str(tmp_path / "learn.jsonl")
+        open(path, "w").close()
+        assert main([path]) == 1
+        assert capsys.readouterr().err.strip()
+
+    def test_missing_file_exits_one(self, tmp_path, capsys):
+        from tools.learn_report import main
+
+        assert main([str(tmp_path / "absent.jsonl")]) == 1
+        assert capsys.readouterr().err.strip()
+
+    def test_trigger_audit_lists_learn_incidents_only(
+        self, tmp_path, capsys
+    ):
+        from tools.learn_report import main
+
+        learn = self._write_learn(tmp_path)
+        fr = tmp_path / "fr"
+        for name, man in [
+            ("incident_step000004_kl_blowup",
+             {"trigger": "kl_blowup", "step": 4, "kl": 3.0, "limit": 1.0}),
+            ("incident_step000002_hbm_breach",  # systems trigger: excluded
+             {"trigger": "hbm_breach", "step": 2}),
+        ]:
+            d = fr / name
+            d.mkdir(parents=True)
+            (d / "manifest.json").write_text(json.dumps(man))
+        assert main([learn, "--incidents", str(fr)]) == 0
+        out = capsys.readouterr().out
+        assert "kl_blowup" in out
+        assert "hbm_breach" not in out
+
+    def test_missing_incidents_dir_is_empty_not_error(
+        self, tmp_path, capsys
+    ):
+        from tools.learn_report import main
+
+        learn = self._write_learn(tmp_path)
+        assert main([learn, "--incidents", str(tmp_path / "nope")]) == 0
+
+
+class TestTraceReportLearning:
+    def test_learning_section_renders_gauges_and_ratios(self):
+        from tools.trace_report import learning_section
+
+        telemetry.reset()
+        telemetry.configure(enabled=True)
+        try:
+            counts = [0] * (len(telemetry.HIST_BUCKET_BOUNDS) + 1)
+            counts[3] = 4
+            LearnLedger().on_step(1, {
+                "entropy": 1.25, "kl": 0.02, "clip_frac": 0.1,
+                "grad_norm_total": 0.75, "ratio_counts": counts,
+            })
+            lines = learning_section(telemetry.recent_events())
+        finally:
+            telemetry.reset()
+        text = "\n".join(lines)
+        assert lines[0] == "learning:"
+        assert "entropy" in text and "kl (behavior)" in text
+        assert "is ratio" in text and "(4 samples)" in text
+
+    def test_learning_section_absent_without_learn_series(self):
+        from tools.trace_report import learning_section
+
+        assert learning_section([]) == []
+        assert learning_section([
+            {"ph": "C", "name": "serving/live_slots",
+             "args": {"live_slots": 2}}
+        ]) == []
+
+
+class TestLineageReportDynamics:
+    def test_step_detail_shows_kl_columns(self, tmp_path, capsys):
+        from distrl_llm_tpu.lineage import LineageLedger
+        from tools.lineage_report import main
+
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        t1, t2 = _traj(), _traj()
+        led.on_group_sampled(t1, worker="w0", ts=100.0)
+        led.on_group_sampled(t2, worker="w0", ts=100.5)
+        led.on_consumed(
+            [t1, t2], step=7, produced_version=2, ts=101.0,
+            dynamics={"kl": 0.125, "entropy": 1.5},
+        )
+        led.close()
+        path = os.path.join(str(tmp_path), "lineage.jsonl")
+        assert main([path, "--step", "7"]) == 0
+        out = capsys.readouterr().out
+        assert "kl" in out and "0.125" in out
+
+    def test_step_detail_without_dynamics_keeps_old_shape(
+        self, tmp_path, capsys
+    ):
+        from distrl_llm_tpu.lineage import LineageLedger
+        from tools.lineage_report import main
+
+        led = LineageLedger(ring_size=8, out_dir=str(tmp_path))
+        traj = _traj()
+        led.on_group_sampled(traj, worker="w0", ts=100.0)
+        led.on_consumed([traj], step=3, produced_version=1, ts=101.0)
+        led.close()
+        path = os.path.join(str(tmp_path), "lineage.jsonl")
+        assert main([path, "--step", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "entropy" not in out  # columns only appear when carried

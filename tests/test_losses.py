@@ -91,6 +91,168 @@ class TestAnswerLogprobs:
         assert out.shape == (2, 5)
         assert out.dtype == jnp.float32
 
+    def test_return_entropy_matches_naive(self, setup):
+        """return_entropy=True (ISSUE 16) must hand back the softmax
+        entropy of the SAME shifted logits the logprob gather reads —
+        checked against −Σ p·log p of the naive full-softmax — without
+        changing the logprobs themselves."""
+        params, (pids, pmask, aids, amask) = setup
+        plain = answer_logprobs(
+            params, TINY, pids, pmask, aids, amask, remat=False
+        )
+        logps, entropy = answer_logprobs(
+            params, TINY, pids, pmask, aids, amask, remat=False,
+            return_entropy=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logps), np.asarray(plain), atol=1e-6
+        )
+        full_ids = jnp.concatenate([pids, aids], axis=1)
+        full_mask = jnp.concatenate([pmask, amask], axis=1)
+        logits, _ = forward(params, TINY, full_ids, attention_mask=full_mask)
+        logits = np.asarray(logits)[:, :-1]
+        P = pids.shape[1]
+        logits = logits[:, P - 1:]
+        log_probs = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        naive = -(np.exp(log_probs) * log_probs).sum(-1)
+        assert entropy.shape == plain.shape
+        np.testing.assert_allclose(
+            np.asarray(entropy), naive, atol=1e-4, rtol=1e-4
+        )
+        assert bool((np.asarray(entropy) > 0).all())
+
+    def test_return_entropy_chunked_matches_dense(self, setup):
+        """The chunked (fused-CE) path computes entropy inside each
+        checkpointed chunk off the already-materialized lse — values must
+        match the dense path bit-for-tolerance, non-divisor chunk incl."""
+        params, (pids, pmask, aids, amask) = setup
+        _, dense = answer_logprobs(
+            params, TINY, pids, pmask, aids, amask, remat=False,
+            return_entropy=True,
+        )
+        for chunk in (2, 3):
+            _, chunked = answer_logprobs(
+                params, TINY, pids, pmask, aids, amask, remat=False,
+                logit_chunk=chunk, return_entropy=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(chunked), np.asarray(dense),
+                atol=1e-5, rtol=1e-5,
+            )
+
+
+class TestEntropyBonus:
+    """entropy_bonus (ISSUE 16 satellite): pin the regularizer against the
+    closed-form entropy of known distributions, and pin the masked-entropy
+    metric's shared edge case — a fully-masked row must not poison the
+    masked mean (the bonus itself is unmasked; the train-step metric is)."""
+
+    def test_uniform_distribution_is_log_v(self):
+        from distrl_llm_tpu.learner.losses import entropy_bonus
+
+        B, T, V = 2, 3, 16
+        logprobs = jnp.full((B, T, V), -np.log(V), jnp.float32)
+        got = float(entropy_bonus(logprobs, alpha=1.0))
+        assert got == pytest.approx(np.log(V), rel=1e-6)
+
+    def test_hand_computed_two_token_distribution(self):
+        from distrl_llm_tpu.learner.losses import entropy_bonus
+
+        p = np.asarray([0.75, 0.25])
+        logprobs = jnp.asarray(np.log(p)[None, None, :], jnp.float32)
+        want = -(p * np.log(p)).sum()  # ≈ 0.5623 nats
+        got = float(entropy_bonus(logprobs, alpha=1.0))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_alpha_scales_linearly_and_grad_flows(self):
+        from distrl_llm_tpu.learner.losses import entropy_bonus
+
+        rng = np.random.default_rng(3)
+        raw = jnp.asarray(rng.normal(size=(2, 4, 8)), jnp.float32)
+        logprobs = jax.nn.log_softmax(raw, axis=-1)
+        one = float(entropy_bonus(logprobs, alpha=1.0))
+        assert float(entropy_bonus(logprobs, alpha=2.5)) == pytest.approx(
+            2.5 * one, rel=1e-5
+        )
+        g = jax.grad(
+            lambda lp: entropy_bonus(jax.nn.log_softmax(lp, -1), 0.1)
+        )(raw)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_near_deterministic_distribution_is_near_zero(self):
+        from distrl_llm_tpu.learner.losses import entropy_bonus
+
+        logits = jnp.asarray([[[30.0, 0.0, 0.0, 0.0]]], jnp.float32)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        assert float(entropy_bonus(logprobs, alpha=1.0)) == pytest.approx(
+            0.0, abs=1e-6
+        )
+
+    def test_masked_entropy_metric_ignores_all_masked_row(self):
+        """The dynamics bundle's masked entropy (train_step, ISSUE 16)
+        shares entropy_bonus's formula but weights by answer_mask ·
+        sample_mask — a row with no real tokens must contribute nothing,
+        and the mean must equal the real-token average exactly."""
+        from distrl_llm_tpu.learner.train_step import (
+            UpdateBatch, _derive_dynamics, _microbatch_dynamics,
+        )
+
+        rng = np.random.default_rng(11)
+        N, T = 3, 4
+        entropy = jnp.asarray(rng.uniform(0.5, 2.0, (N, T)), jnp.float32)
+        amask = np.ones((N, T), np.int32)
+        amask[1, :] = 0  # row with zero real answer tokens
+        mb = UpdateBatch(
+            prompt_ids=jnp.zeros((N, 2), jnp.int32),
+            prompt_mask=jnp.ones((N, 2), jnp.int32),
+            answer_ids=jnp.zeros((N, T), jnp.int32),
+            answer_mask=jnp.asarray(amask),
+            coeffs=jnp.asarray([1.0, -1.0, 0.5], jnp.float32),
+            sample_mask=jnp.asarray([1.0, 1.0, 0.0], jnp.float32),
+        )
+        ent = np.asarray(entropy)
+        logps = jnp.zeros((N, T), jnp.float32)
+        sums = _microbatch_dynamics(
+            logps, jnp.asarray(ent), mb,
+            clip_ratio=0.0, off_policy="none", is_cap=0.0,
+        )
+        grads = {"w": jnp.zeros((2, 2), jnp.float32)}
+        dyn = _derive_dynamics(sums, grads, train_mode="full")
+        # rows 1 (all-masked) and 2 (sample_mask 0) excluded: mean over row 0
+        want = ent[0].mean()
+        assert float(dyn["tokens"]) == pytest.approx(T)
+        assert float(dyn["entropy"]) == pytest.approx(want, rel=1e-6)
+        assert np.isfinite(float(dyn["entropy"]))
+
+    def test_all_rows_masked_stays_finite(self):
+        """tok_count == 0: the max(tok, 1) guard must yield 0.0, not NaN —
+        the same guard pg_loss's empty-answer row test pins."""
+        from distrl_llm_tpu.learner.train_step import (
+            UpdateBatch, _derive_dynamics, _microbatch_dynamics,
+        )
+
+        N, T = 2, 3
+        mb = UpdateBatch(
+            prompt_ids=jnp.zeros((N, 2), jnp.int32),
+            prompt_mask=jnp.ones((N, 2), jnp.int32),
+            answer_ids=jnp.zeros((N, T), jnp.int32),
+            answer_mask=jnp.zeros((N, T), jnp.int32),
+            coeffs=jnp.zeros((N,), jnp.float32),
+            sample_mask=jnp.zeros((N,), jnp.float32),
+        )
+        sums = _microbatch_dynamics(
+            jnp.zeros((N, T), jnp.float32),
+            jnp.ones((N, T), jnp.float32), mb,
+            clip_ratio=0.0, off_policy="none", is_cap=0.0,
+        )
+        dyn = _derive_dynamics(
+            sums, {"w": jnp.zeros((2,), jnp.float32)}, train_mode="full"
+        )
+        for key in ("entropy", "adv_mean", "adv_std", "adv_pos_frac"):
+            assert np.isfinite(float(dyn[key])), key
+        assert float(dyn["entropy"]) == 0.0
+        assert float(dyn["tokens"]) == 0.0
+
 
 class TestChunkedLogprobs:
     """logit_chunk runs lm_head + logsumexp per time-chunk (the fused-CE

@@ -589,6 +589,51 @@ class TestRegistry:
         assert snap["serving/ring_evictions"] == 1.0
         assert snap["serving/admission_stalls/no_pages"] == 1.0
 
+    def test_learn_series_schema(self):
+        """Schema pin for the training-dynamics registry names (ISSUE 16)
+        and their TYPES: learn/entropy, learn/kl_behavior,
+        learn/clip_frac, learn/ratio_cap_frac, learn/adv_mean,
+        learn/adv_std, learn/adv_pos_frac, learn/reward_drift and the
+        learn/grad_norm/<group> family (total + a0..b3 depth buckets) are
+        GAUGES; learn/is_ratio is a HISTOGRAM (device-binned, replayed
+        with the weighted count= idiom); learn/steps is a COUNTER."""
+        from distrl_llm_tpu import learn_obs as lo
+
+        assert lo.LEARN_ENTROPY == "learn/entropy"
+        assert lo.LEARN_KL == "learn/kl_behavior"
+        assert lo.LEARN_RATIO == "learn/is_ratio"
+        assert lo.LEARN_CLIP_FRAC == "learn/clip_frac"
+        assert lo.LEARN_CAP_FRAC == "learn/ratio_cap_frac"
+        assert lo.LEARN_ADV_MEAN == "learn/adv_mean"
+        assert lo.LEARN_ADV_STD == "learn/adv_std"
+        assert lo.LEARN_ADV_POS_FRAC == "learn/adv_pos_frac"
+        assert lo.LEARN_GRAD_NORM == "learn/grad_norm"
+        assert lo.LEARN_GRAD_NORM_TOTAL == "learn/grad_norm/total"
+        assert lo.LEARN_REWARD_DRIFT == "learn/reward_drift"
+        assert lo.LEARN_STEPS == "learn/steps"
+        for name in (lo.LEARN_ENTROPY, lo.LEARN_KL, lo.LEARN_CLIP_FRAC,
+                     lo.LEARN_CAP_FRAC, lo.LEARN_ADV_MEAN,
+                     lo.LEARN_ADV_STD, lo.LEARN_ADV_POS_FRAC,
+                     lo.LEARN_GRAD_NORM_TOTAL, lo.LEARN_REWARD_DRIFT):
+            telemetry.gauge_set(name, 0.5)
+        group = "a0"
+        telemetry.gauge_set(f"{lo.LEARN_GRAD_NORM}/{group}", 0.25)
+        telemetry.hist_observe(lo.LEARN_RATIO, 1.0, count=3)
+        telemetry.counter_add(lo.LEARN_STEPS)
+        snap = telemetry.metrics_snapshot()
+        assert snap["learn/entropy"] == 0.5
+        assert snap["learn/kl_behavior"] == 0.5
+        assert snap["learn/clip_frac"] == 0.5
+        assert snap["learn/ratio_cap_frac"] == 0.5
+        assert snap["learn/adv_mean"] == 0.5
+        assert snap["learn/adv_std"] == 0.5
+        assert snap["learn/adv_pos_frac"] == 0.5
+        assert snap["learn/grad_norm/total"] == 0.5
+        assert snap["learn/grad_norm/a0"] == 0.25
+        assert snap["learn/reward_drift"] == 0.5
+        assert snap["learn/is_ratio_count"] == 3.0
+        assert snap["learn/steps"] == 1.0
+
     def test_observe_snapshot_carries_hist_buckets(self):
         """Cumulative per-bucket counts ride observe_snapshot (the obs
         endpoint's and the worker blob's feed), aligned to

@@ -100,6 +100,11 @@ LATENCY_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms")
 # already pins both rounds to the same base_quant/kv_format arm, so a
 # flagged increase is a real fusion/layout regression, not an A/B diff.
 BYTES_FIELDS = ("bytes_per_token",)
+# The learner rows' training-dynamics fields (entropy / kl_p90 /
+# clip_frac / ratio_cap_frac, ISSUE 16) are deliberately in NEITHER scan
+# list: they describe the learning curve, not the machine — a shift in
+# either direction is an RL-behavior change, never a perf regression, so
+# the scan stays direction-neutral on them by exclusion.
 
 
 def lower_is_better(metric: str) -> bool:

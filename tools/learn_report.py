@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""One-command policy-health report of a learning-dynamics JSONL
+(ISSUE 16): is the policy learning healthily — entropy trajectory,
+behavior↔policy KL, IS-ratio saturation, advantage structure, gradient
+norms, and reward drift — from the ledger file alone, no live process.
+
+    python tools/learn_report.py run_myrun/learn.jsonl
+    python tools/learn_report.py run_myrun/learn.jsonl \
+        --incidents run_myrun/fr
+
+The file is what ``--learn_dir`` streams (``distrl_llm_tpu/learn_obs.py``):
+one JSON object per optimizer step (``kind: "step"``, carrying the
+device-computed bundle the jitted train step returned through its aux
+pytree) plus one ``kind: "summary"`` line written at close.
+
+Default output: a per-step table of the core signals, a distribution
+summary per signal, a reward-drift summary against the running reference
+window, and — when ``--incidents`` points at the flight-recorder directory
+— an audit of the training-dynamics sentinel triggers (entropy_collapse /
+kl_blowup / ratio_saturation / grad_spike) that actually fired. Sections
+render only when their data exists (the empty-when-absent pattern — an
+on-policy run has no KL column, an unarmed run no trigger audit).
+
+Exit status: 0 on a parseable file with at least one step record, 1
+otherwise — tools/run_all_checks.sh gates on it via learn_smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# (record key, column header, format width/precision)
+STEP_COLS = (
+    ("entropy", "entropy", "9.4f"),
+    ("kl", "kl", "9.5f"),
+    ("clip_frac", "clip", "6.3f"),
+    ("cap_frac", "cap", "6.3f"),
+    ("adv_mean", "adv_mean", "9.4f"),
+    ("adv_std", "adv_std", "8.4f"),
+    ("adv_pos_frac", "adv_pos", "7.3f"),
+    ("grad_norm_total", "grad", "9.4f"),
+    ("reward_mean", "reward", "7.3f"),
+    ("reward_drift", "drift", "7.2f"),
+)
+
+LEARN_TRIGGERS = (
+    "entropy_collapse", "kl_blowup", "ratio_saturation", "grad_spike",
+)
+
+MAX_TABLE_ROWS = 40
+
+
+def load(path: str) -> tuple[list[dict], dict | None]:
+    steps: list[dict] = []
+    summary: dict | None = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if doc.get("kind") == "step":
+                steps.append(doc)
+            elif doc.get("kind") == "summary":
+                summary = doc  # last one wins (close() writes exactly one)
+    return steps, summary
+
+
+def load_incidents(fr_dir: str | None) -> list[dict]:
+    """Manifests of the training-dynamics incident bundles under a
+    flight-recorder directory, oldest first. Missing dir / non-learn
+    triggers are simply absent — the audit is empty-when-absent."""
+    if not fr_dir or not os.path.isdir(fr_dir):
+        return []
+    out: list[dict] = []
+    for name in sorted(os.listdir(fr_dir)):
+        mpath = os.path.join(fr_dir, name, "manifest.json")
+        if not os.path.isfile(mpath):
+            continue
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if manifest.get("trigger") in LEARN_TRIGGERS:
+            manifest["bundle"] = name
+            out.append(manifest)
+    return out
+
+
+def _pct(vals: list[float], q: float) -> float:
+    s = sorted(vals)
+    return s[min(int(len(s) * q / 100.0), len(s) - 1)]
+
+
+def _table(steps: list[dict]) -> list[str]:
+    cols = [
+        (key, header, fmt) for key, header, fmt in STEP_COLS
+        if any(s.get(key) is not None for s in steps)
+    ]
+    if not cols:
+        return []
+    lines = ["per step:"]
+    width = {key: max(len(f"{0:{fmt}}"), len(header))
+             for key, header, fmt in cols}
+    lines.append(
+        "  " + f"{'step':>6} " + " ".join(
+            f"{header:>{width[key]}}" for key, header, _fmt in cols
+        )
+    )
+    shown = steps
+    elided = 0
+    if len(steps) > MAX_TABLE_ROWS:
+        # head + tail, never silent: long runs keep the first and the
+        # most recent steps visible, the distribution summary below
+        # covers everything
+        half = MAX_TABLE_ROWS // 2
+        shown = steps[:half] + steps[-half:]
+        elided = len(steps) - len(shown)
+    for i, s in enumerate(shown):
+        if elided and i == len(shown) // 2:
+            lines.append(f"  … {elided} steps elided …")
+        cells = []
+        for key, _header, fmt in cols:
+            v = s.get(key)
+            cells.append(
+                f"{v:{fmt}}" if v is not None else " " * width[key]
+            )
+        lines.append("  " + f"{s.get('step', '?'):>6} " + " ".join(cells))
+    lines.append("")
+    return lines
+
+
+def _distributions(steps: list[dict]) -> list[str]:
+    lines: list[str] = []
+    for key, label, _fmt in STEP_COLS:
+        vals = [float(s[key]) for s in steps if s.get(key) is not None]
+        if not vals:
+            continue
+        if not lines:
+            lines.append("distribution:")
+            lines.append(
+                f"  {'signal':<10} {'count':>6} {'mean':>11} {'p50':>11} "
+                f"{'p90':>11} {'max':>11}"
+            )
+        lines.append(
+            f"  {label:<10} {len(vals):>6} "
+            f"{sum(vals) / len(vals):>11.5f} {_pct(vals, 50):>11.5f} "
+            f"{_pct(vals, 90):>11.5f} {max(vals):>11.5f}"
+        )
+    if lines:
+        lines.append("")
+    return lines
+
+
+def _drift(steps: list[dict], summary: dict | None) -> list[str]:
+    drifts = [
+        (s.get("step"), float(s["reward_drift"]))
+        for s in steps if s.get("reward_drift") is not None
+    ]
+    if not drifts:
+        return []
+    vals = [d for _, d in drifts]
+    worst_step, worst = max(drifts, key=lambda sd: abs(sd[1]))
+    window = (summary or {}).get("drift_window")
+    lines = ["reward drift (z vs reference window"
+             + (f", W={window}" if window else "") + "):"]
+    lines.append(
+        f"  {len(vals)} scored steps, mean {sum(vals) / len(vals):+.3f}, "
+        f"worst {worst:+.3f} at step {worst_step}"
+    )
+    excursions = sum(1 for v in vals if abs(v) >= 3.0)
+    if excursions:
+        lines.append(
+            f"  {excursions} step(s) beyond ±3σ — the reward distribution "
+            "moved against its own recent history"
+        )
+    lines.append("")
+    return lines
+
+
+def _trigger_audit(incidents: list[dict]) -> list[str]:
+    if not incidents:
+        return []
+    lines = ["trigger audit (flight-recorder bundles):"]
+    for m in incidents:
+        detail = ", ".join(
+            f"{k}={m[k]}" for k in (
+                "entropy", "floor", "kl", "limit", "saturated_frac",
+                "grad_norm", "ema", "factor",
+            ) if k in m
+        )
+        lines.append(
+            f"  step {m.get('step', '?'):>5}  {m.get('trigger', '?'):<18} "
+            f"{m.get('bundle', '')}" + (f"  ({detail})" if detail else "")
+        )
+    lines.append("")
+    return lines
+
+
+def build_report(steps: list[dict], summary: dict | None,
+                 incidents: list[dict]) -> str:
+    if not steps:
+        raise ValueError("no step records in the learn file")
+    lines: list[str] = []
+    tokens = sum(int(s.get("tokens") or 0) for s in steps)
+    lines.append(
+        f"steps: {len(steps)} recorded"
+        + (f", {tokens} answer tokens scored" if tokens else "")
+    )
+    lines.append("")
+    lines.extend(_table(steps))
+    lines.extend(_distributions(steps))
+    lines.extend(_drift(steps, summary))
+    lines.extend(_trigger_audit(incidents))
+    return "\n".join(lines).rstrip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="policy-health report from a learning-dynamics JSONL"
+    )
+    p.add_argument("learn", help="path to a learn.jsonl (--learn_dir)")
+    p.add_argument("--incidents", type=str, default=None,
+                   help="flight-recorder directory (--flight_recorder_dir) "
+                        "to audit for training-dynamics trigger bundles")
+    args = p.parse_args(argv)
+    try:
+        steps, summary = load(args.learn)
+        report = build_report(
+            steps, summary, load_incidents(args.incidents)
+        )
+    except Exception as e:  # noqa: BLE001 — a truncated or still-being-
+        # written ledger must exit 1 with one line, never a raw traceback
+        print(
+            f"learn_report: cannot report on {args.learn}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

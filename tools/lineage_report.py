@@ -108,16 +108,35 @@ def step_detail(groups: list[dict], step: int,
         lines.append("  (no group record names this step)")
         return lines
     extra = f" {'ttft ms':>9} {'qwait ms':>9}" if serving is not None else ""
+    # training-dynamics columns (ISSUE 16): present only when the run
+    # armed learn_obs — the ledger stamps the consuming step's KL/entropy/
+    # cap fraction on every record, the correlate of the lag columns
+    dyn = any(
+        g.get(k) is not None for g in rows
+        for k in ("kl", "entropy", "ratio_cap_frac")
+    )
+    dyn_hdr = (
+        f" {'kl':>9} {'entropy':>8} {'cap':>6}" if dyn else ""
+    )
     lines.append(
         f"  {'uid':>5} {'ep/batch':>9} {'worker':<22} {'dispatch':>8} "
         f"{'versions':>9} {'lag':>4} {'s→learn ms':>11} {'verdict':<10}"
-        + extra
+        + dyn_hdr + extra
     )
     for g in sorted(rows, key=lambda g: g.get("uid", 0)):
         vmin, vmax = g.get("min_version", 0), g.get("max_version", 0)
         vspan = f"v{vmin}" if vmin == vmax else f"v{vmin}-{vmax}"
         stl = g.get("sample_to_learn_ms")
         stl_s = f"{stl:,.1f}" if stl is not None else "n/a"
+        dyn_cols = ""
+        if dyn:
+            kl, ent = g.get("kl"), g.get("entropy")
+            cap = g.get("ratio_cap_frac")
+            dyn_cols = (
+                f" {f'{kl:.5f}' if kl is not None else 'n/a':>9}"
+                f" {f'{ent:.4f}' if ent is not None else 'n/a':>8}"
+                f" {f'{cap:.3f}' if cap is not None else 'n/a':>6}"
+            )
         lines.append(
             f"  {g.get('uid', '?'):>5} "
             f"{g.get('episode', 0)}/{g.get('batch_index', 0):<7} "
@@ -125,7 +144,7 @@ def step_detail(groups: list[dict], step: int,
             f"{str(g.get('dispatch_id') or '-'):>8} {vspan:>9} "
             f"{str(g.get('staleness_lag', '?')):>4} "
             f"{stl_s:>11} {str(g.get('verdict') or '?'):<10}"
-            + _serving_cols(g, serving)
+            + dyn_cols + _serving_cols(g, serving)
         )
     produced = {g.get("produced_version") for g in rows}
     lines.append(f"  produced weight version(s): {sorted(produced)}")

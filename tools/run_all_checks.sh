@@ -111,6 +111,13 @@ stage "weight_bus_smoke" env JAX_PLATFORMS=cpu \
 # rollout/staleness + obs/weight_sync_ms series
 stage "lineage_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/lineage_smoke.py
+# training-dynamics gate (ISSUE 16): armed learn_obs run byte-identical to
+# off (losses + adapter checksum), learn/* gauges in the per-step sink
+# records + learn.jsonl step/summary stream, a seeded kl_blowup yields
+# exactly one incident bundle, and learn_report/lineage_report exit 0 on
+# the run's artifacts
+stage "learn_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/learn_smoke.py
 # bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
 # one table and flag >10% per-metric tok/s regressions — machine-readable
 # bench history, but cross-round rows come from different silicon windows,

@@ -298,6 +298,54 @@ def serving_section(events: list[dict]) -> list[str]:
     return lines
 
 
+def learning_section(events: list[dict]) -> list[str]:
+    """Training-dynamics view (ISSUE 16) from the learn ledger's traced
+    samples: per-step policy-health gauges published off the device-fused
+    bundle (``learn/entropy``, ``learn/kl_behavior``, the clip/cap
+    saturation fractions, ``learn/grad_norm/total``,
+    ``learn/reward_drift`` counter tracks) and the device-binned IS-ratio
+    histogram (``learn/is_ratio`` counter events, weight in count=). Empty
+    when the run never armed --learn_obs."""
+    gauges: dict[str, list[float]] = {}
+    ratios: list[float] = []
+    for ev in events:
+        name = ev.get("name", "")
+        if ev.get("ph") != "C" or not name.startswith("learn/"):
+            continue
+        args = ev.get("args", {})
+        key = name.rsplit("/", 1)[-1]
+        if name == "learn/is_ratio":
+            ratios.extend(
+                [float(args.get(key, 0))] * int(args.get("count", 1))
+            )
+        else:
+            gauges.setdefault(name, []).append(float(args.get(key, 0)))
+    if not gauges and not ratios:
+        return []
+    lines = ["learning:"]
+    for name, label in (
+        ("learn/entropy", "entropy:"),
+        ("learn/kl_behavior", "kl (behavior):"),
+        ("learn/clip_frac", "clip frac:"),
+        ("learn/ratio_cap_frac", "cap frac:"),
+        ("learn/adv_mean", "adv mean:"),
+        ("learn/adv_std", "adv std:"),
+        ("learn/grad_norm/total", "grad norm:"),
+        ("learn/reward_drift", "reward drift:"),
+    ):
+        vals = gauges.get(name)
+        if vals:
+            lines.append(
+                f"  {label:<19} mean {sum(vals) / len(vals):,.4f} / min "
+                f"{min(vals):,.4f} / max {max(vals):,.4f} "
+                f"({len(vals)} steps)"
+            )
+    if ratios:
+        lines.append(_dist_lines("is ratio:", ratios, unit=""))
+    lines.append("")
+    return lines
+
+
 def control_section(events: list[dict]) -> list[str]:
     """Self-healing-runtime view (ISSUE 14): every governor actuation is
     stamped as a ``control/action`` Perfetto instant with its controller,
@@ -582,6 +630,7 @@ def build_report(events: list[dict], metadata: dict,
     lines.extend(rollout_section(events, spans))
     lines.extend(policy_lag_section(events))
     lines.extend(serving_section(events))
+    lines.extend(learning_section(events))
     lines.extend(control_section(events))
     lines.extend(lineage_section(events, spans, tracks))
     lines.extend(spec_section(spans))

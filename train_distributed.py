@@ -326,6 +326,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo_queue_wait_ms", type=float, default=None,
                    help="queue-wait SLO: arms the sentinel's "
                         "queue_wait_blowup trigger; requires --sentinel")
+    p.add_argument("--learn_obs", action="store_true",
+                   help="training-dynamics observability (ISSUE 16): fuse "
+                        "the device-computed dynamics bundle (masked policy "
+                        "entropy, behavior-policy KL, pre-binned IS-ratio "
+                        "histogram, clip/cap-saturation fractions, "
+                        "advantage moments, per-layer-group LoRA grad "
+                        "norms) into the jitted train step — it rides the "
+                        "one host transfer the loss already pays — and "
+                        "publish it as learn/* registry series")
+    p.add_argument("--learn_dir", type=str, default=None,
+                   help="stream one learning-dynamics record per optimizer "
+                        "step to <dir>/learn.jsonl (implies --learn_obs); "
+                        "inspect with tools/learn_report.py")
+    p.add_argument("--learn_drift_window", type=int, default=32,
+                   help="reward-drift reference window in steps: "
+                        "learn/reward_drift is the z-score of the step's "
+                        "reward mean against the trailing window of older "
+                        "means")
+    p.add_argument("--learn_entropy_floor", type=float, default=None,
+                   help="arms the sentinel's entropy_collapse trigger: "
+                        "masked answer-token entropy below this floor "
+                        "dumps a flight-recorder bundle; requires "
+                        "--sentinel (implies --learn_obs)")
+    p.add_argument("--learn_kl_limit", type=float, default=None,
+                   help="arms the sentinel's kl_blowup trigger: behavior-"
+                        "policy KL above this limit dumps a bundle, and "
+                        "escalates to the staleness governor when "
+                        "--control_staleness is armed; requires --sentinel "
+                        "(implies --learn_obs)")
+    p.add_argument("--learn_ratio_sat_frac", type=float, default=None,
+                   help="arms the sentinel's ratio_saturation trigger: "
+                        "fraction of answer tokens whose IS ratio the "
+                        "AIPO cap (or PPO clip) truncated above this "
+                        "threshold dumps a bundle; requires --sentinel "
+                        "(implies --learn_obs)")
+    p.add_argument("--learn_grad_spike", type=float, default=None,
+                   help="arms the sentinel's grad_spike trigger: whole-"
+                        "adapter grad norm above this multiple (> 1) of "
+                        "its running EMA dumps a bundle; requires "
+                        "--sentinel (implies --learn_obs)")
     p.add_argument("--control", action="store_true",
                    help="self-healing runtime (ISSUE 14): arm every "
                         "closed-loop controller this run's shape supports "
