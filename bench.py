@@ -401,6 +401,43 @@ def _attn_fallback_fired(attn_impl: str) -> bool:
     return attn_mod._flash_fallback_warned
 
 
+class _BenchTurnHook:
+    """Synthetic raw-token engine turn hook for the multi-turn A/B
+    (BENCH_ENV/BENCH_MAX_TURNS, ISSUE 17): every candidate re-enters
+    ``max_turns - 1`` times with a fixed observation block appended to its
+    resident KV chain — the engine-side cost of multi-turn rollouts
+    (turn-resume fixups, admission contention, idle interception) without
+    any tokenizer or environment logic, so the row measures scheduling,
+    not env.step."""
+
+    def __init__(self, total: int, max_turns: int, obs_len: int, vocab: int):
+        rng = np.random.default_rng(7)
+        self.obs = rng.integers(1, vocab, size=obs_len).astype(np.int32)
+        self.max_turns = max(1, int(max_turns))
+        self.total = int(total)
+        self.turns = np.ones(self.total, np.int64)
+        self.step_ms: list[float] = []
+        self.finished_turns: list[int] = []
+
+    def reset(self) -> None:
+        self.turns[:] = 1
+        self.step_ms = []
+        self.finished_turns = []
+
+    def __call__(self, cand_id: int, gen_tokens) -> "np.ndarray | None":
+        t0 = time.perf_counter()
+        done = self.turns[cand_id] >= self.max_turns
+        self.step_ms.append((time.perf_counter() - t0) * 1e3)
+        if done:
+            self.finished_turns.append(int(self.turns[cand_id]))
+            return None
+        self.turns[cand_id] += 1
+        return self.obs
+
+    def declined(self, cand_id: int) -> None:
+        self.finished_turns.append(int(self.turns[cand_id]))
+
+
 def _learner_bench(cfg, name: str, fallback_err) -> int:
     """BENCH_MODE=learner: time the jitted train step at the reference
     learner shapes (micro 8 × [350 prompt + 1200 answer], distributed_
@@ -988,6 +1025,38 @@ def main() -> int:
     # scope the obs compile/retrace tracker to this run the same way: the
     # recompile_count field must describe THIS config's programs only
     importlib.import_module("distrl_llm_tpu.obs").reset_compile_tracker()
+    # multi-turn A/B arm (ISSUE 17): BENCH_ENV marks this row as a
+    # synthetic multi-turn env run — every candidate re-enters
+    # BENCH_MAX_TURNS - 1 times through the engine turn hook, with the
+    # observation appended to its resident KV chain (no re-prefill). The
+    # hook is armed BEFORE warmup so compilation covers the turn-resume
+    # fixup program; the single-turn control is the same invocation
+    # without BENCH_ENV.
+    turn_hook = None
+    bench_env = os.environ.get("BENCH_ENV")
+    if bench_env:
+        if (
+            fleet_n
+            or getattr(engine, "scheduler", None) != "refill"
+            or not getattr(engine, "max_concurrent_rows", 0)
+            or getattr(engine, "spec_draft", 0)
+        ):
+            _emit({
+                "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+                "unit": "tok/s/chip", "vs_baseline": 0.0,
+                "error": "BENCH_ENV needs a local paged refill engine with "
+                         "BENCH_MAX_CONCURRENT set and no BENCH_SPEC_DRAFT "
+                         "(the turn hook rides the refill scheduler)",
+                "backend": jax.devices()[0].platform,
+            })
+            return 1
+        turn_hook = _BenchTurnHook(
+            total=n_prompts * n_cand,
+            max_turns=int(os.environ.get("BENCH_MAX_TURNS", "2")),
+            obs_len=int(os.environ.get("BENCH_ENV_OBS_TOKENS", "16")),
+            vocab=cfg.vocab_size,
+        )
+        engine.turn_hook = turn_hook
     _, compile_dt = run(0)  # warmup: includes prefill+decode compilation
     # serving observability over the TIMED rounds only (ISSUE 13): arm a
     # ledger on continuous-admission engines AFTER warmup so the recorded
@@ -1035,9 +1104,16 @@ def main() -> int:
     # sums over all repeats, so the grid totals must be summed the same way
     # or the quotient is ~repeats× off
     sum_spec_grid = spec_grid_rounds = 0
+    env_counts: list[int] = []
+    env_step_ms: list[float] = []
     for i in range(repeats):
+        if turn_hook is not None:
+            turn_hook.reset()  # per-round turn cursors + timed-only stats
         result, dt_i = run(1 + i)
         timed.append(dt_i)
+        if turn_hook is not None:
+            env_counts.extend(int(x) for x in turn_hook.turns)
+            env_step_ms.extend(turn_hook.step_ms)
         # random weights rarely emit EOS, so rows typically decode max_new
         # tokens; count actual generated lengths to stay correct if not
         total_tokens += int(result.lengths.sum())
@@ -1090,6 +1166,9 @@ def main() -> int:
                 # prefix sharing (and continuous admission, which implies
                 # it) pins the refill path even for small batches
                 or engine.prefix_sharing
+                # an armed turn hook pins refill too (the turn-resume
+                # machinery lives on the refill scheduler's idle pass)
+                or getattr(engine, "turn_hook", None) is not None
             )
         )
         scheduler_ran = "refill" if engaged else "waves"
@@ -1366,6 +1445,22 @@ def main() -> int:
         # fraction of slot-steps spent idle (the drain-tail/backfill
         # number the continuous A/B moves; derived from the same
         # alive_slot_steps counter, all repeats)
+        # multi-turn env self-description (ISSUE 17, pinned in
+        # tests/test_bench_contract.py): which synthetic env arm ran
+        # (null = single-turn control), realized turns per candidate over
+        # the timed rounds, and the hook's own wall time per consulted
+        # turn — plus the engine's turn-resume accounting through
+        # pool_stats (turn_resumes / turn_prefill_saved_tokens). The A/B's
+        # claim is slot_idle_frac: re-admitting continuations onto
+        # resident chains must keep idle within noise of the control.
+        "env_name": bench_env or None,
+        "turns_mean": (
+            round(float(np.mean(env_counts)), 3) if env_counts else None
+        ),
+        "turns_max": int(np.max(env_counts)) if env_counts else None,
+        "env_step_ms_p50": (
+            round(float(np.median(env_step_ms)), 4) if env_step_ms else None
+        ),
         "cb_mode": getattr(engine, "last_cb_mode", None),
         "prefill_shared_frac": (
             (getattr(engine, "last_pool_stats", None) or {})

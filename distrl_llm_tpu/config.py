@@ -599,6 +599,24 @@ class TrainConfig:
     # with resume=True to continue from the last completed step.
     generation_timeout_s: float = 0.0
 
+    # --- Pluggable environments (ISSUE 17) ---------------------------------
+    # rollout environment: "math" (the legacy single-turn scorer — the exact
+    # pre-env generation/reward path, byte-identical), "code" (multi-turn
+    # sandboxed <tool> execution with outputs fed back), or "verifier"
+    # (multi-turn verifier-feedback, per-turn improvement reward). Multi-turn
+    # envs interleave engine generation with env.step on the local paged
+    # refill engine: continuing conversations are re-admitted onto their
+    # resident KV chains (no re-prefill) and env-injected observation tokens
+    # are loss-masked in the learner.
+    env: str = "math"
+    # max conversation turns per episode for multi-turn envs. env="math" is
+    # single-turn by construction, so >1 there is a dead flag (rejected).
+    max_turns: int = 1
+    # format-reward gate: "soft" (the reference's anchored single-line
+    # pattern — the parity default) or "strict" (the newline-delimited
+    # variant, previously dead parity code)
+    format_reward: str = "soft"
+
     def __post_init__(self):
         if self.learner not in ("pg", "grpo"):
             raise ValueError(f"learner must be 'pg' or 'grpo', got {self.learner!r}")
@@ -858,6 +876,55 @@ class TrainConfig:
                 "max_concurrent_sequences cap); they would be silently "
                 "ignored otherwise"
             )
+        # Pluggable environments (ISSUE 17). Import here, not at module
+        # top: config must stay importable without pulling the env package
+        # (worker processes construct configs before JAX spins up).
+        from distrl_llm_tpu.env import env_names
+        if self.env not in env_names():
+            raise ValueError(
+                f"env must be one of {', '.join(env_names())}, got "
+                f"{self.env!r}"
+            )
+        if self.max_turns < 1:
+            raise ValueError(f"max_turns must be >= 1, got {self.max_turns}")
+        if self.format_reward not in ("soft", "strict"):
+            raise ValueError(
+                f"format_reward must be 'soft' or 'strict', got "
+                f"{self.format_reward!r}"
+            )
+        if self.env == "math" and self.max_turns > 1:
+            # dead-flag policy: the math env is single-turn by construction
+            raise ValueError(
+                "max_turns > 1 is a dead flag with env='math' (single-turn "
+                "by construction) — pick env='code' or env='verifier'"
+            )
+        if self.env != "math":
+            # multi-turn envs need the refill scheduler's slot machinery:
+            # the engine turn hook re-admits continuing conversations onto
+            # their resident KV chains between turns
+            if not (self.continuous_batching and self.continuous_admission):
+                raise ValueError(
+                    f"env={self.env!r} (multi-turn) requires "
+                    "continuous_batching + continuous_admission: turn "
+                    "continuations re-enter through the refill scheduler's "
+                    "admission queue onto resident KV chains"
+                )
+            if self.engine_impl != "paged":
+                raise ValueError(
+                    f"env={self.env!r} requires engine_impl='paged' (the "
+                    "turn hook lives on the local paged refill engine)"
+                )
+            if self.spec_draft:
+                raise ValueError(
+                    f"env={self.env!r} is incompatible with spec_draft: "
+                    "the turn hook and the speculative resume path contend "
+                    "for the same slot state"
+                )
+            if self.rollout_workers:
+                raise ValueError(
+                    f"env={self.env!r} runs driver-local only this "
+                    "iteration — rollout_workers have no turn hook"
+                )
         if self.spec_draft is not None and not 0 <= self.spec_draft <= 16:
             raise ValueError(
                 f"spec_draft must be in [0, 16] (longer draft blocks waste "

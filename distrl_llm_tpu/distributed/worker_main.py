@@ -688,6 +688,18 @@ def main(argv: list[str] | None = None) -> None:
                         type=float, default=None,
                         help="queue-wait SLO for this worker's SLO "
                              "load-shedder")
+    parser.add_argument("--env", type=str, default="math",
+                        choices=["code", "math", "verifier"],
+                        help="rollout environment (driver parity, GC402). "
+                             "Multi-turn envs run driver-local this "
+                             "iteration — the remote worker engine has no "
+                             "turn hook, so any non-default value is "
+                             "rejected loudly instead of silently sampling "
+                             "single-turn")
+    parser.add_argument("--max-turns", type=int, default=1,
+                        help="conversation-turn budget (driver parity, "
+                             "GC402); >1 is rejected worker-side — see "
+                             "--env")
     parser.add_argument("--fault-schedule", type=str, default=None,
                         help="deterministic fault-injection schedule for "
                              "this worker's connections (resilience."
@@ -704,6 +716,17 @@ def main(argv: list[str] | None = None) -> None:
         telemetry.configure(enabled=True)
     if args.decode_chunk is not None and args.decode_chunk < 1:
         parser.error("--decode-chunk must be >= 1")
+    if args.env != "math" or args.max_turns != 1:
+        # multi-turn environments are driver-local this iteration: the
+        # engine turn hook lives on the driver's own paged engine, and a
+        # worker silently sampling single-turn would corrupt the round's
+        # per-turn rewards — fail loudly (driver config.py rejects
+        # env != 'math' over rollout_workers for the same reason)
+        parser.error(
+            "--env/--max-turns: multi-turn environments run driver-local "
+            "only (the turn hook lives on the driver's paged engine); "
+            "start the driver without --rollout_workers for env runs"
+        )
     if args.quant_group_size is not None and args.quant_group_size < 1:
         parser.error("--quant-group-size must be >= 1")
     if args.quant_group_size is not None and args.base_quant == "none":

@@ -81,6 +81,11 @@ ENGINE_SPEC_VERIFY_GRID_STEPS = "engine/spec_verify_grid_steps"  # counter
 # continuous-admission scheduler
 ENGINE_BACKFILL_ADMITS = "engine/backfill_admits"          # counter
 ENGINE_CONT_PREFILLS = "engine/cont_prefills"              # counter
+# multi-turn episode continuation (ISSUE 17): slots resumed in place after
+# the turn hook injected an observation, and the conversation-prefix tokens
+# whose re-prefill that in-place resume avoided (KV stayed resident)
+ENGINE_TURN_RESUMES = "engine/turn_resumes"                # counter
+ENGINE_TURN_PREFILL_SAVED = "engine/turn_prefill_saved_tokens"  # counter
 
 Params = dict[str, Any]
 
@@ -591,6 +596,71 @@ def _resume_fixup(params, lora, state: _RefillState, slot, prefix_tok,
         logits=s.logits.at[slot].set(logits[0, 0]),
         gen_lengths=s.gen_lengths.at[slot].set(prefix_len),
         seq_lengths=s.seq_lengths.at[slot].set(real_len_c + prefix_len),
+        k_pages=cache["k"], v_pages=cache["v"],
+    )
+
+
+def _turn_resume_fixup(params, lora, state: _RefillState, slot, obs_tok,
+                       obs_len, cand_c, gen_len_c, seq_len_c, real_len_c,
+                       *, cfg: ModelConfig, page_size: int, lora_scale: float,
+                       max_steps: int, pad_id: int,
+                       capture_logprobs: bool = False):
+    """Continue a FINISHED candidate in place with environment-injected
+    observation tokens (ISSUE 17 multi-turn episodes). Unlike ``_resume_fixup``
+    the slot was never released: the whole conversation's KV (prompt + every
+    prior turn, including the just-ended one) is still resident in the slot's
+    pages, so this appends exactly the observation — one chunked forward over
+    ``obs_len`` tokens instead of re-prefilling ``seq_len_c`` of context.
+
+    The observation tokens are recorded in the candidate's out buffer (they
+    are part of the answer the driver decodes) with behavior logprobs zeroed
+    — the trainer's loss mask excludes env-injected spans, so the zeros are
+    never consumed as behavior probabilities.
+
+    Positions are clamped to the slot's page-table coverage
+    (``real_len + max_steps`` tokens): masked padding lanes beyond ``obs_len``
+    would otherwise scatter KV garbage past the table. Valid observation
+    positions never reach the clamp — the host only resumes when
+    ``gen_len + obs_len < max_steps`` — so the clamp target is only ever
+    written by masked lanes whose KV is never attended to (attention is
+    bounded by the cache lengths entry)."""
+    s = state
+    t = obs_tok.shape[0]
+    steps = jnp.arange(t, dtype=jnp.int32)
+    valid_vec = steps < obs_len
+    valid = valid_vec.astype(jnp.int32)[None, :]
+    cache = {
+        "k": s.k_pages, "v": s.v_pages,
+        "lengths": seq_len_c[None],
+        "page_indices": s.page_indices[slot][None],
+    }
+    positions = jnp.minimum(seq_len_c + steps, real_len_c + max_steps - 1)[None, :]
+    obs_tok = jnp.where(valid_vec, obs_tok, pad_id)
+    logits, cache = forward(
+        params, cfg, obs_tok[None],
+        attention_mask=valid, positions=positions,
+        lora=lora, lora_scale=lora_scale,
+        kv_cache=cache, page_size=page_size, paged_chunked=True,
+        logits_positions=jnp.maximum(obs_len - 1, 0)[None],
+    )
+    total_cols = s.out.shape[1]
+    # out-of-range column sentinel drops the padding lanes, mirroring the
+    # decode step's dead-slot scatter discipline
+    col = jnp.where(valid_vec, gen_len_c + steps, total_cols)
+    out = s.out.at[cand_c, col].set(obs_tok, mode="drop")
+    if capture_logprobs:
+        logps_buf = s.logps_buf.at[cand_c, col].set(
+            jnp.zeros_like(col, dtype=s.logps_buf.dtype), mode="drop")
+    else:
+        logps_buf = s.logps_buf
+    new_gen = gen_len_c + obs_len
+    return s._replace(
+        out=out, logps_buf=logps_buf,
+        lengths_buf=s.lengths_buf.at[cand_c].set(new_gen),
+        done=s.done.at[slot].set(False),
+        logits=s.logits.at[slot].set(logits[0, 0]),
+        gen_lengths=s.gen_lengths.at[slot].set(new_gen),
+        seq_lengths=s.seq_lengths.at[slot].set(seq_len_c + obs_len),
         k_pages=cache["k"], v_pages=cache["v"],
     )
 
@@ -1372,6 +1442,15 @@ class PagedGenerationEngine(LoraMailbox):
         # pass; a handle at its defaults makes byte-identical decisions
         # (pinned in tests/test_control.py)
         self.control_limits: Any = None
+        # multi-turn episode continuation (ISSUE 17): when an owner (trainer
+        # env driver, bench env arm) attaches a turn hook here, the refill
+        # idle pass consults it before retiring a finished candidate —
+        # ``hook(cand_id, gen_tokens) -> np.ndarray | None`` returns
+        # observation tokens to append in place (KV chain stays resident) or
+        # None to finish; ``hook.declined(cand_id)`` unwinds an accepted
+        # observation the engine could not seat. None = one attribute check
+        # per idle pass — single-turn rounds and byte-identity pins untouched
+        self.turn_hook: Any = None
         # per-round speculative stats (refill spec rounds only): drafter,
         # realized accept rate, tokens/verify-step, emit histogram, verify
         # kernel choice + grid steps, draft/target version bookkeeping
@@ -1451,6 +1530,15 @@ class PagedGenerationEngine(LoraMailbox):
                 lora_scale=lora_scale,
             ),
             donate_argnames=("state",),
+        )
+        self._turn_resume = jax.jit(
+            partial(
+                _turn_resume_fixup, cfg=cfg, page_size=page_size,
+                lora_scale=lora_scale, pad_id=self.pad_id,
+                capture_logprobs=capture_logprobs,
+            ),
+            donate_argnames=("state",),
+            static_argnames=("max_steps",),
         )
         self._refill_step = jax.jit(
             partial(
@@ -1671,15 +1759,26 @@ class PagedGenerationEngine(LoraMailbox):
         self.last_pool_stats = None
         self.last_spec_stats = None
         self.last_round_stats = None  # waves/refill of THIS round accumulate
+        if self.turn_hook is not None and (
+            self.scheduler != "refill" or not self.max_concurrent_rows
+            or self.spec_draft
+        ):
+            raise ValueError(
+                "turn_hook (multi-turn episodes) requires the refill "
+                "scheduler with max_concurrent_rows set and no spec_draft — "
+                "turn continuation lives in the refill idle pass"
+            )
         if (
             self.scheduler == "refill"
             and self.max_concurrent_rows
             # spec decode and prefix sharing live on the refill path — a
             # configured speculative or prefix-sharing engine must not
             # silently fall back to plain waves on a small batch (review
-            # finding; continuous_admission implies prefix_sharing)
+            # finding; continuous_admission implies prefix_sharing). A turn
+            # hook forces refill too: turn continuation is an idle-pass
+            # feature
             and (total > self.max_concurrent_rows or self.spec_draft
-                 or self.prefix_sharing)
+                 or self.prefix_sharing or self.turn_hook is not None)
         ):
             self.last_cb_mode = self.cb_mode
             return self._generate_refill(
@@ -1726,6 +1825,10 @@ class PagedGenerationEngine(LoraMailbox):
         # ledger observes, it never changes a scheduling decision
         sl = self.serving_ledger
         suid: dict[int, int] = {}  # group -> serving-record uid
+        # multi-turn turn hook (ISSUE 17): one attribute read per round when
+        # unarmed; armed, the idle pass consults it before retiring a
+        # finished candidate (try_turn_resume below)
+        th = self.turn_hook
         # closed-loop admission limits (ISSUE 14): one attribute read per
         # round when unarmed; armed, admit_groups consults the governors'
         # chain-cap scale and shed gate at its existing decision points —
@@ -2060,6 +2163,8 @@ class PagedGenerationEngine(LoraMailbox):
         fill_declined: str | None = None  # fill_idle's head-of-line decline
         shed_groups_seen: set[int] = set()  # groups the shedder deferred
         dispatched = 0
+        turn_resumes = 0  # in-place episode continuations (turn hook)
+        turn_saved = 0  # resident-prefix tokens those resumes never re-prefilled
         host_cand = np.full(r_slots, total, np.int64)  # device `cand` mirror
         epoch = np.zeros(r_slots, np.int64)
 
@@ -2297,6 +2402,67 @@ class PagedGenerationEngine(LoraMailbox):
             state = admit(state, kill_cand, kill_mask, dstp)
             host_cand[s_i] = total
             epoch[s_i] += 1
+
+        def try_turn_resume(s_i: int, c: int) -> bool:
+            """Multi-turn continuation (ISSUE 17): before retiring a finished
+            candidate, offer its completion to the turn hook. If the hook
+            returns observation tokens and the slot has token room and pages,
+            append them in place — the slot keeps its occupant AND its pages,
+            so the whole conversation prefix (``seq_len`` tokens of resident
+            KV) is never re-prefilled. Returns True when the slot resumed
+            (the idle pass must then NOT release/finish it). Declines —
+            size, page pressure — unwind via ``hook.declined`` so the driver
+            can close the episode as truncated; declining instead of
+            preempting victims keeps turn continuation strictly lower
+            priority than first-turn progress."""
+            nonlocal state, budget, turn_resumes, turn_saved
+            # blocking read of the candidate's CURRENT truth: done is
+            # monotone per epoch, so the occupant has truly finished; turn
+            # boundaries are rare relative to decode steps, same cost
+            # argument as preempt()
+            gen_len = int(np.asarray(state.lengths_buf[c]))
+            if gen_len + 2 > max_steps:
+                # no room for even one observation + one decode token: the
+                # hook is never consulted, the driver scores the final turn
+                # from the result tensors
+                return False
+            tokens = np.asarray(state.out[c][:gen_len]).astype(np.int32)
+            obs = th(c, tokens)
+            if obs is None:
+                return False
+            obs = np.asarray(obs, np.int32).ravel()
+            t_obs = int(obs.size)
+            if t_obs == 0 or gen_len + t_obs + 1 > max_steps:
+                th.declined(c)
+                return False
+            rl = int(real_len_h[c // n])
+            seq_len = rl + gen_len
+            if pool.ensure(s_i, admit_last_pos(rl, gen_len + t_obs)):
+                th.declined(c)
+                return False
+            # any pages ensure granted must reach the device BEFORE the
+            # fixup's chunked forward scatters observation KV
+            state = state._replace(page_indices=jnp.asarray(pool.table))
+            obs_pad = np.full(max_steps, self.pad_id, np.int32)
+            obs_pad[:t_obs] = obs
+            state = self._turn_resume(
+                params, lora_cell[0], state, jnp.asarray(s_i, jnp.int32),
+                jnp.asarray(obs_pad), jnp.asarray(t_obs, jnp.int32),
+                jnp.asarray(c, jnp.int32), jnp.asarray(gen_len, jnp.int32),
+                jnp.asarray(seq_len, jnp.int32), jnp.asarray(rl, jnp.int32),
+                max_steps=max_steps,
+            )
+            # queued snapshots were taken while this slot's done flag was
+            # set — the epoch bump stops them retiring the resumed occupant
+            epoch[s_i] += 1
+            # each resume spends up to one more notice-latency window of
+            # idle slot-steps before the occupant's next EOS is seen
+            budget += 2 * check
+            turn_resumes += 1
+            turn_saved += seq_len
+            telemetry.counter_add(ENGINE_TURN_RESUMES)
+            telemetry.counter_add(ENGINE_TURN_PREFILL_SAVED, float(seq_len))
+            return True
 
         def serving_boundary(group_decline: str | None, had_idle: bool,
                              wedged: bool = False) -> None:
@@ -2550,6 +2716,13 @@ class PagedGenerationEngine(LoraMailbox):
             ]
             for s_i in idle:
                 c = snap_cand[s_i]
+                if (
+                    th is not None and c < total and not finished[c]
+                    and try_turn_resume(int(s_i), int(c))
+                ):
+                    # episode continues in place: occupant, pages and KV all
+                    # kept — do not release or retire the slot
+                    continue
                 if pool.owned[s_i] or pool.shared[s_i]:
                     pool.release(s_i)  # frees pages + redirects to scratch
                 if c < total:
@@ -2682,6 +2855,13 @@ class PagedGenerationEngine(LoraMailbox):
             "slot_idle_frac": (
                 round(1.0 - alive_h / (r_slots * dispatched), 4)
                 if dispatched else None
+            ),
+            # multi-turn episode continuation (ISSUE 17): in-place turn
+            # resumes and the conversation-prefix tokens they kept resident
+            # (None = no turn hook armed, the single-turn row)
+            "turn_resumes": turn_resumes if th is not None else None,
+            "turn_prefill_saved_tokens": (
+                turn_saved if th is not None else None
             ),
         }
         if not finished.all():

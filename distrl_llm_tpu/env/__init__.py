@@ -1,0 +1,31 @@
+"""Pluggable multi-turn environments (ISSUE 17).
+
+Public surface: the :class:`Environment` protocol and episode dataclasses
+(`base`), the three shipped environments, the name registry consumed by
+config validation and the CLIs, and :class:`EnvRolloutDriver` — the engine
+turn-hook implementation the trainer arms for ``env != "math"`` runs.
+"""
+
+from .base import Environment, EnvStep, EpisodeState, TurnRecord
+from .code_env import CodeToolEnv, run_sandboxed
+from .driver import EnvRolloutDriver, EnvRoundResult, EnvRoundStats
+from .math_env import MathSingleTurnEnv
+from .registry import ENV_REGISTRY, env_names, get_env_class
+from .verifier_env import VerifierFeedbackEnv
+
+__all__ = [
+    "ENV_REGISTRY",
+    "CodeToolEnv",
+    "Environment",
+    "EnvRolloutDriver",
+    "EnvRoundResult",
+    "EnvRoundStats",
+    "EnvStep",
+    "EpisodeState",
+    "MathSingleTurnEnv",
+    "TurnRecord",
+    "VerifierFeedbackEnv",
+    "env_names",
+    "get_env_class",
+    "run_sandboxed",
+]

@@ -1,0 +1,99 @@
+"""Environment protocol for multi-turn agentic rollouts (ISSUE 17).
+
+An :class:`Environment` owns the task-side half of an episode: it hands the
+rollout driver the first observation (the prompt), scores each policy turn,
+and decides whether to inject a new observation (tool output, verifier
+critique) or end the episode. The engine half — keeping the conversation's KV
+chain resident across turns — lives in ``engine/paged_engine.py`` behind the
+``turn_hook`` attribute; the glue is ``env/driver.py``.
+
+Contract:
+
+* ``reset(task) -> str`` — first observation. For the shipped envs this is
+  ``task["problem"]`` verbatim so prompt encoding stays on the trainer's
+  existing path (byte-identity for the single-turn math env).
+* ``step(completion) -> EnvStep`` — consume one policy turn. ``observation``
+  is the text to inject before the next turn, or ``None`` when the episode is
+  over. ``reward`` is the *per-turn shaped reward* (format / tool-use /
+  improvement — column 0 of the (n, 2) reward contract); terminal accuracy
+  rides in ``info["accuracy"]`` so column 1 keeps its meaning.
+
+Environments are cheap, single-use, and stateful: the driver builds one
+instance per candidate per round. They run on the host between engine turns,
+so they must never touch JAX.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Protocol, runtime_checkable
+
+
+@dataclass
+class EnvStep:
+    """Result of consuming one policy turn.
+
+    ``observation``: text injected as the next turn's context (loss-masked),
+    or ``None`` when the episode is done. ``reward``: per-turn shaped reward
+    (format/progress — never terminal accuracy, which belongs in
+    ``info["accuracy"]``). ``info`` carries provenance: ``tool_call_id`` for
+    tool envs, ``verdict`` for verifier envs, ``accuracy`` on terminal steps.
+    """
+
+    observation: str | None
+    reward: float
+    done: bool
+    info: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TurnRecord:
+    """One policy turn inside an episode, in answer-token coordinates.
+
+    ``policy_span`` is the half-open [start, end) of the tokens the policy
+    generated this turn; ``env_span`` covers the environment-injected
+    observation that followed (``None`` on the final turn). Spans index into
+    the engine's per-candidate answer buffer, so the loss mask, lineage
+    provenance, and per-turn version tags all share one coordinate system.
+    """
+
+    index: int
+    policy_span: tuple[int, int]
+    env_span: tuple[int, int] | None
+    reward: float
+    tool_call_id: str | None
+    info: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EpisodeState:
+    """Driver-side record of one candidate's episode across turns."""
+
+    task: dict[str, Any]
+    turns: list[TurnRecord] = field(default_factory=list)
+    done: bool = False
+    truncated: bool = False
+    accuracy: float = 0.0
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(t.reward for t in self.turns))
+
+    @property
+    def num_turns(self) -> int:
+        return len(self.turns)
+
+
+@runtime_checkable
+class Environment(Protocol):
+    """Minimal protocol every pluggable environment implements."""
+
+    name: str
+
+    def reset(self, task: dict[str, Any]) -> str:
+        """Begin an episode; return the first observation (the prompt)."""
+        ...
+
+    def step(self, completion: str) -> EnvStep:
+        """Consume one policy completion; return the next observation."""
+        ...

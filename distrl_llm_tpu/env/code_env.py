@@ -1,0 +1,135 @@
+"""Sandboxed code-execution tool environment.
+
+The model emits ``<tool>...</tool>`` blocks containing Python; the
+environment runs the last block in a restricted subprocess and feeds the
+captured output back as the next turn's observation (wrapped in
+``<output>`` tags, loss-masked by the driver). The episode ends when the
+model commits to an ``<answer>`` or the turn budget runs out, at which point
+accuracy is scored exactly like the math task.
+
+Sandbox restrictions (stdlib only — no new dependencies):
+
+* interpreter isolation: ``python -I`` (implies ``-E``/``-s``: no env vars,
+  no user site, no cwd on ``sys.path``), empty environment, tmpdir cwd;
+* resource rlimits via ``preexec_fn``: CPU seconds, address space, file
+  size, process count — plus a wall-clock timeout that kills the child;
+* output truncation to ``output_limit`` characters before it is tokenized,
+  so a print-loop cannot blow up the next turn's observation.
+
+This is defense against *accidents* (infinite loops, fork bombs, giant
+prints) during RL rollouts of a policy we are training, not a security
+boundary against an adversary with root.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+import tempfile
+from typing import Any
+
+from ..rewards import correctness_reward, make_format_scorer
+from .base import EnvStep
+
+_TOOL_RE = re.compile(r"<tool>(.*?)</tool>", re.DOTALL)
+
+_CPU_SECONDS = 2
+_ADDRESS_SPACE = 512 << 20  # 512 MiB
+_FILE_SIZE = 1 << 20  # 1 MiB
+_MAX_PROCS = 16
+
+
+def _sandbox_rlimits() -> None:  # pragma: no cover - runs in the child
+    import resource
+
+    resource.setrlimit(resource.RLIMIT_CPU, (_CPU_SECONDS, _CPU_SECONDS))
+    resource.setrlimit(resource.RLIMIT_FSIZE, (_FILE_SIZE, _FILE_SIZE))
+    for limit, value in (
+        (resource.RLIMIT_AS, _ADDRESS_SPACE),
+        (getattr(resource, "RLIMIT_NPROC", None), _MAX_PROCS),
+    ):
+        if limit is None:
+            continue
+        try:
+            resource.setrlimit(limit, (value, value))
+        except (ValueError, OSError):
+            pass  # some kernels/uids refuse; the wall timeout still holds
+
+
+def run_sandboxed(code: str, timeout_s: float = 5.0, output_limit: int = 256) -> str:
+    """Run ``code`` in the restricted subprocess; return its (truncated) output."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-I", "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env={},
+            cwd=tempfile.gettempdir(),
+            preexec_fn=_sandbox_rlimits,
+        )
+        out = proc.stdout if proc.returncode == 0 else proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired:
+        out = "<tool timeout>"
+    except Exception as exc:  # sandbox setup failure, not model output
+        out = f"<tool error: {type(exc).__name__}>"
+    out = out.strip()
+    if not out:
+        return "<no output>"
+    return out[:output_limit]
+
+
+class CodeToolEnv:
+    """Multi-turn tool env: run ``<tool>`` blocks, round-trip the output."""
+
+    name = "code"
+
+    def __init__(
+        self,
+        format_scorer: str = "soft",
+        max_turns: int = 4,
+        tool_timeout_s: float = 5.0,
+        output_limit: int = 256,
+    ):
+        self.max_turns = max(1, int(max_turns))
+        self.tool_timeout_s = float(tool_timeout_s)
+        self.output_limit = int(output_limit)
+        self._fmt = make_format_scorer(format_scorer)
+        self._task: dict[str, Any] | None = None
+        self._turn = 0
+        self._tool_seq = 0
+
+    def reset(self, task: dict[str, Any]) -> str:
+        self._task = dict(task)
+        self._turn = 0
+        self._tool_seq = 0
+        return str(task.get("problem", ""))
+
+    def _terminal(self, completion: str, fmt: float) -> EnvStep:
+        assert self._task is not None
+        acc = float(
+            correctness_reward([completion], [str(self._task.get("solution", ""))])[0]
+        )
+        return EnvStep(None, fmt, True, {"accuracy": acc})
+
+    def step(self, completion: str) -> EnvStep:
+        if self._task is None:
+            raise RuntimeError("step() before reset()")
+        self._turn += 1
+        fmt = float(self._fmt([completion])[0])
+        if "<answer>" in completion or self._turn >= self.max_turns:
+            return self._terminal(completion, fmt)
+        blocks = _TOOL_RE.findall(completion)
+        if blocks:
+            self._tool_seq += 1
+            tool_call_id = f"tool-{self._tool_seq}"
+            out = run_sandboxed(blocks[-1], self.tool_timeout_s, self.output_limit)
+            obs = f"\n<output>\n{out}\n</output>\n"
+            # small bonus for a well-formed tool call: shaped, not accuracy
+            return EnvStep(
+                obs, fmt + 0.05, False,
+                {"tool_call_id": tool_call_id, "tool_output": out},
+            )
+        obs = "\nNo <answer> given. Use <tool>...</tool> to compute, then reply in <answer>...</answer> tags.\n"
+        return EnvStep(obs, fmt, False, {})

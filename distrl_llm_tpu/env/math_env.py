@@ -1,0 +1,50 @@
+"""Single-turn math environment — the legacy scoring path behind the protocol.
+
+``env="math"`` is the default and routes the trainer down the *exact*
+pre-environment code path (the env driver is never armed, rewards come from
+``RewardComputer`` over whole groups), so training stays byte-identical to
+pre-ISSUE-17 HEAD — that pin lives in ``tests/test_rollout_modes.py``. This
+class exists so the math task is still expressible through the protocol
+(tests, ``env_smoke``) and so per-completion scoring matches the batch
+``reward_function`` contract exactly: one step, reward = format column,
+accuracy in ``info``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..rewards import make_reward_function
+from .base import EnvStep
+
+
+class MathSingleTurnEnv:
+    """One completion, one regex-scored step, done."""
+
+    name = "math"
+
+    def __init__(self, format_scorer: str = "soft", max_turns: int = 1):
+        del max_turns  # always single-turn
+        self._reward_fn = make_reward_function(format_scorer)
+        self._task: dict[str, Any] | None = None
+        self._stepped = False
+
+    def reset(self, task: dict[str, Any]) -> str:
+        self._task = dict(task)
+        self._stepped = False
+        return str(task.get("problem", ""))
+
+    def step(self, completion: str) -> EnvStep:
+        if self._task is None:
+            raise RuntimeError("step() before reset()")
+        if self._stepped:
+            raise RuntimeError("math env is single-turn; episode already done")
+        self._stepped = True
+        row = self._reward_fn([completion], [str(self._task.get("solution", ""))])
+        fmt, acc = float(row[0, 0]), float(row[0, 1])
+        return EnvStep(
+            observation=None,
+            reward=fmt,
+            done=True,
+            info={"accuracy": acc},
+        )

@@ -1,0 +1,26 @@
+"""Name -> environment class registry used by config validation and CLIs."""
+
+from __future__ import annotations
+
+from .code_env import CodeToolEnv
+from .math_env import MathSingleTurnEnv
+from .verifier_env import VerifierFeedbackEnv
+
+ENV_REGISTRY: dict[str, type] = {
+    MathSingleTurnEnv.name: MathSingleTurnEnv,
+    CodeToolEnv.name: CodeToolEnv,
+    VerifierFeedbackEnv.name: VerifierFeedbackEnv,
+}
+
+
+def env_names() -> tuple[str, ...]:
+    return tuple(sorted(ENV_REGISTRY))
+
+
+def get_env_class(name: str) -> type:
+    try:
+        return ENV_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown environment {name!r}; available: {', '.join(env_names())}"
+        ) from None

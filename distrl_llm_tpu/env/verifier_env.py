@@ -1,0 +1,65 @@
+"""Verifier-feedback environment: critique, re-prompt, reward improvement.
+
+Each turn the verifier extracts the model's answer, checks it against the
+solution, and — when wrong and turn budget remains — injects a critique and
+asks the model to try again. The per-turn reward is the *improvement* in the
+verifier's format score over the previous attempt (first turn: the score
+itself), so a policy that tightens its formatting across turns earns
+positive per-turn rewards while a degrading one pays for it. Terminal
+accuracy rides in ``info["accuracy"]`` as usual.
+
+With the turn hook in the paged engine, each retry continues the same KV
+chain — the critique is appended to the resident conversation, not
+re-prefilled.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..rewards import correctness_reward, extract_xml_answer, make_format_scorer
+from .base import EnvStep
+
+
+class VerifierFeedbackEnv:
+    """Multi-turn verifier loop: wrong answers get a critique and a retry."""
+
+    name = "verifier"
+
+    def __init__(self, format_scorer: str = "soft", max_turns: int = 4):
+        self.max_turns = max(1, int(max_turns))
+        self._fmt = make_format_scorer(format_scorer)
+        self._task: dict[str, Any] | None = None
+        self._turn = 0
+        self._prev_score: float | None = None
+
+    def reset(self, task: dict[str, Any]) -> str:
+        self._task = dict(task)
+        self._turn = 0
+        self._prev_score = None
+        return str(task.get("problem", ""))
+
+    def step(self, completion: str) -> EnvStep:
+        if self._task is None:
+            raise RuntimeError("step() before reset()")
+        self._turn += 1
+        score = float(self._fmt([completion])[0])
+        reward = score if self._prev_score is None else score - self._prev_score
+        self._prev_score = score
+        acc = float(
+            correctness_reward([completion], [str(self._task.get("solution", ""))])[0]
+        )
+        if acc == 1.0 or self._turn >= self.max_turns:
+            return EnvStep(
+                None, reward, True,
+                {"accuracy": acc, "verdict": "correct" if acc == 1.0 else "incorrect"},
+            )
+        answer = extract_xml_answer(completion) or "<missing>"
+        critique = (
+            f"\nVerifier: answer {answer!r} is incorrect. Re-check your reasoning "
+            "and reply again with <think>...</think> then <answer>...</answer>.\n"
+        )
+        return EnvStep(
+            critique, reward, False,
+            {"tool_call_id": f"verify-{self._turn}", "verdict": "incorrect"},
+        )

@@ -62,6 +62,13 @@ class UpdateBatch(NamedTuple):
     # version, from the rollout trajectory tags) — the AIPO objective masks
     # tokens beyond max_staleness; None outside the async regime
     version_lag: jax.Array | None = None
+    # multi-turn env rounds (ISSUE 17): [N, T] — answer_mask restricted to
+    # POLICY-generated spans. Environment-injected observation tokens stay
+    # in answer_mask (they are attention context for later turns — the
+    # behavior policy conditioned on them) but are excluded here, so every
+    # loss/metric term trains only policy spans; None = single-turn rounds,
+    # loss masks on answer_mask as always
+    loss_mask: jax.Array | None = None
 
 
 def _microbatch_dynamics(
@@ -75,7 +82,11 @@ def _microbatch_dynamics(
     host fetch. Keys are static per step build (the behavior-logprob
     entries exist only when the batch carries them)."""
     logps = jax.lax.stop_gradient(logps)
-    mask = mb.answer_mask.astype(jnp.float32) * mb.sample_mask[:, None]
+    # dynamics over TRAINABLE tokens: multi-turn rounds exclude env-injected
+    # spans (their behavior logprobs are zeroed placeholders — counting them
+    # would poison the KL/ratio stats with fake ratios)
+    train_mask = mb.answer_mask if mb.loss_mask is None else mb.loss_mask
+    mask = train_mask.astype(jnp.float32) * mb.sample_mask[:, None]
     real = mb.sample_mask
     dyn = {
         "tok_count": mask.sum(),
@@ -216,6 +227,10 @@ def _microbatch_loss(
             logit_chunk=logit_chunk, return_entropy=emit_dynamics,
         )
     logps, entropy = out if emit_dynamics else (out, None)
+    # loss terms mask on POLICY spans only (multi-turn env rounds);
+    # answer_mask above stays the attention mask — env-injected tokens are
+    # context the behavior policy conditioned on, they just don't train
+    loss_m = mb.answer_mask if mb.loss_mask is None else mb.loss_mask
     if clip_ratio > 0.0 and off_policy == "aipo":
         # async regime: truncated-IS correction keyed on per-token version
         # lag (rollout/staleness.py) instead of the 1±ε clip — staleness up
@@ -223,19 +238,19 @@ def _microbatch_loss(
         # clipped surrogate's gradient vanishes exactly on the samples that
         # need correcting
         loss = grpo_aipo_loss(
-            logps, mb.behavior_logps, mb.answer_mask.astype(jnp.float32),
+            logps, mb.behavior_logps, loss_m.astype(jnp.float32),
             mb.coeffs, mb.sample_mask, is_cap=is_cap,
             version_lag=mb.version_lag, max_staleness=max_staleness,
         )
     elif clip_ratio > 0.0:
         loss = grpo_clip_loss(
-            logps, mb.behavior_logps, mb.answer_mask.astype(jnp.float32),
+            logps, mb.behavior_logps, loss_m.astype(jnp.float32),
             mb.coeffs, mb.sample_mask, clip_ratio=clip_ratio,
         )
     else:
         loss_fn = grpo_loss if learner_type == "grpo" else pg_loss
         loss = loss_fn(
-            logps, mb.answer_mask.astype(jnp.float32), mb.coeffs, mb.sample_mask
+            logps, loss_m.astype(jnp.float32), mb.coeffs, mb.sample_mask
         )
     if kl_coeff > 0.0:
         # π_ref = the frozen base (no adapter): one extra stop-gradient
@@ -246,7 +261,7 @@ def _microbatch_loss(
             attn_impl=attn_impl, attn_mesh=attn_mesh, logit_chunk=logit_chunk,
         ))
         loss = loss + kl_coeff * kl_to_ref(
-            logps, ref_logps, mb.answer_mask.astype(jnp.float32),
+            logps, ref_logps, loss_m.astype(jnp.float32),
             mb.sample_mask,
         )
 
@@ -471,6 +486,7 @@ def prepare_update_batch(
             prompt_mask = np.asarray(prompt_mask)[:, -p_width:]
     behavior_logps = None
     version_lag = None
+    loss_mask = None
     if raw_rollout is not None:
         # PPO-clip path: train on the ENGINE'S token ids (retokenizing the
         # decoded text can shift token boundaries and desync the per-token
@@ -493,6 +509,15 @@ def prepare_update_batch(
         answer_mask = (
             np.arange(max_new_tokens)[None, :] < lengths[:, None]
         ).astype(np.int32)
+        if "loss_mask" in raw_rollout:
+            # multi-turn env rounds (ISSUE 17): environment-injected
+            # observation tokens stay in answer_mask (attention context —
+            # the behavior policy conditioned on them) but are excluded
+            # from the separate loss mask so they never train
+            lm = np.zeros((n_real, max_new_tokens), np.int32)
+            lm_src = np.asarray(raw_rollout["loss_mask"], np.int32)
+            lm[:, :width] = lm_src[:, :width]
+            loss_mask = answer_mask * lm
         behavior_logps = behavior
         if current_version is not None and "version_tags" in raw_rollout:
             # per-token optimizer-step lag from the rollout version tags
@@ -504,7 +529,7 @@ def prepare_update_batch(
             version_lag[:, :width] = np.maximum(
                 current_version - tags[:, :width], 0
             )
-            version_lag *= answer_mask
+            version_lag *= loss_mask if loss_mask is not None else answer_mask
     else:
         answer_ids, answer_mask = encode_fixed(
             tokenizer, answers, max_new_tokens, side="right"
@@ -521,6 +546,8 @@ def prepare_update_batch(
                 behavior_logps = behavior_logps[:, :width]
             if version_lag is not None:
                 version_lag = version_lag[:, :width]
+            if loss_mask is not None:
+                loss_mask = loss_mask[:, :width]
     n = -(-max(n_real, 1) // micro_size) * micro_size
     pad = n - n_real
 
@@ -543,6 +570,10 @@ def prepare_update_batch(
         version_lag=(
             jnp.asarray(pad_rows(version_lag))
             if version_lag is not None else None
+        ),
+        loss_mask=(
+            jnp.asarray(pad_rows(np.asarray(loss_mask)))
+            if loss_mask is not None else None
         ),
     )
     if mesh is not None:

@@ -107,6 +107,11 @@ class LineageRecord:
     kl: float | None = None
     entropy: float | None = None
     ratio_cap_frac: float | None = None
+    # per-turn provenance (ISSUE 17, env-routed rounds): one entry per
+    # turn per candidate — {"cand", "turn", "tool_call_id", "policy_span",
+    # "version"} where version is the policy version that sampled the
+    # turn's first token; None on the legacy single-turn path
+    turns: list | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = asdict(self)
@@ -203,6 +208,31 @@ class LineageLedger:
                 spec_target_version=spec_target_version,
                 sampled_ts=ts,
             )
+            turn_meta = getattr(traj, "meta", {}).get("turns")
+            if turn_meta:
+                # env-routed rounds (ISSUE 17): flatten per-candidate turn
+                # provenance, stamping each turn with the policy version
+                # that sampled its first token (read off the per-token
+                # version tags — in-flight swaps can split a group's turns
+                # across adapter versions)
+                tags = getattr(traj, "version_tags", None)
+                entries: list[dict[str, Any]] = []
+                for ci, cand_turns in enumerate(turn_meta):
+                    for t in cand_turns or ():
+                        span = t.get("policy_span") or [0, 0]
+                        version = None
+                        if tags is not None and len(tags) > ci:
+                            row = tags[ci]
+                            s = min(max(int(span[0]), 0), len(row) - 1)
+                            version = int(row[s])
+                        entries.append({
+                            "cand": ci,
+                            "turn": int(t.get("turn", 0)),
+                            "tool_call_id": t.get("tool_call_id"),
+                            "policy_span": [int(span[0]), int(span[1])],
+                            "version": version,
+                        })
+                rec.turns = entries
             self._ring[uid] = rec
             while len(self._ring) > self.ring_size:
                 # oldest open record falls off the ring — counted, and its

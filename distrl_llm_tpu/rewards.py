@@ -93,6 +93,54 @@ def reward_function(completions: Sequence[str], solutions: Sequence[str]) -> np.
     return np.column_stack((fmt, accuracy))
 
 
+def strict_reward_function(
+    completions: Sequence[str], solutions: Sequence[str]
+) -> np.ndarray:
+    """(N, 2) contract with the strict newline-delimited format gate in
+    column 0 — makes ``strict_format_reward`` a selectable scorer
+    (``format_reward="strict"``) instead of dead parity code. Module-level so
+    ``RewardComputer``'s process pool can pickle it."""
+    accuracy = correctness_reward(completions, solutions)
+    fmt = strict_format_reward(completions) + xmlcount_reward(completions)
+    return np.column_stack((fmt, accuracy))
+
+
+def soft_format_scorer(completions: Sequence[str]) -> np.ndarray:
+    """Format column of :func:`reward_function` alone (soft + xmlcount)."""
+    return soft_format_reward(completions) + xmlcount_reward(completions)
+
+
+def strict_format_scorer(completions: Sequence[str]) -> np.ndarray:
+    """Format column of :func:`strict_reward_function` alone."""
+    return strict_format_reward(completions) + xmlcount_reward(completions)
+
+
+_FORMAT_SCORERS = {"soft": soft_format_scorer, "strict": strict_format_scorer}
+_REWARD_FUNCTIONS = {"soft": reward_function, "strict": strict_reward_function}
+
+
+def make_format_scorer(name: str = "soft"):
+    """Per-completion format scorer used by env-routed scoring (column 0)."""
+    try:
+        return _FORMAT_SCORERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown format scorer {name!r}; available: soft, strict"
+        ) from None
+
+
+def make_reward_function(name: str = "soft"):
+    """Select the (N, 2) reward function by format gate. ``"soft"`` returns
+    :func:`reward_function` itself — the identical object, so the default
+    config keeps byte-identity with pre-env trainers."""
+    try:
+        return _REWARD_FUNCTIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown format reward {name!r}; available: soft, strict"
+        ) from None
+
+
 def _reward_task(fn, args: tuple[Sequence[str], Sequence[str]]) -> np.ndarray:
     return fn(*args)
 

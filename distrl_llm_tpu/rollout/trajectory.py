@@ -43,6 +43,16 @@ class Trajectory:
     lengths: np.ndarray | None = None  # [n]
     behavior_logps: np.ndarray | None = None  # [n, T] f32
     version_tags: np.ndarray | None = None  # [n, T] int32
+    # multi-turn env rounds (ISSUE 17): [n, T] 1 on policy-generated spans,
+    # 0 on environment-injected observation tokens — those never train and
+    # never vote in the staleness verdict (their "version" is the injection
+    # step, not a sampling event)
+    loss_mask: np.ndarray | None = None
+    # env-scored rounds carry their (n, 2) rewards with them (column 0 =
+    # summed per-turn shaped rewards, column 1 = terminal accuracy): the
+    # environment consumed each turn as it happened, so the consumer side
+    # must not re-score decoded text
+    rewards: np.ndarray | None = None
     produced_version: int = 0  # weight version at round entry
     episode: int = 0
     batch_index: int = 0
@@ -65,16 +75,21 @@ class Trajectory:
         else:
             tags = np.asarray(self.version_tags)
             if self.lengths is None:
-                bounds = (int(tags.min()), int(tags.max()))
+                mask = np.ones(tags.shape, bool)
             else:
                 mask = np.arange(tags.shape[1])[None, :] < np.asarray(
                     self.lengths
                 )[:, None]
-                bounds = (
-                    (int(tags[mask].min()), int(tags[mask].max()))
-                    if mask.any()
-                    else (self.produced_version, self.produced_version)
-                )
+            if self.loss_mask is not None:
+                # turn-aware verdicts (ISSUE 17): only POLICY tokens vote —
+                # env-injected observation spans are excluded, so a stale
+                # observation cannot age a group whose policy spans are fresh
+                mask = mask & (np.asarray(self.loss_mask) > 0)
+            bounds = (
+                (int(tags[mask].min()), int(tags[mask].max()))
+                if mask.any()
+                else (self.produced_version, self.produced_version)
+            )
         self.__dict__["_version_bounds_cache"] = bounds
         return bounds
 
@@ -144,6 +159,19 @@ def round_to_trajectories(
                 tags = version_tags_for_round(
                     tokens.shape[0], tokens.shape[1], base_version, swap_events
                 )
+        # env-routed rounds (ISSUE 17): per-group loss masks, pre-computed
+        # rewards and per-turn provenance ride the trajectory
+        loss_mask = (
+            np.asarray(cand["loss_mask"][j]) if "loss_mask" in cand else None
+        )
+        rewards = (
+            np.asarray(cand["rewards"][j]) if "rewards" in cand else None
+        )
+        meta: dict[str, Any] = {}
+        if "turns" in cand:
+            meta["turns"] = cand["turns"][j]
+        if "env_name" in cand:
+            meta["env_name"] = cand["env_name"]
         out.append(Trajectory(
             problem=cand["problem"][j][0],
             solution=cand["solution"][j][0],
@@ -153,9 +181,12 @@ def round_to_trajectories(
             lengths=lengths,
             behavior_logps=logps,
             version_tags=tags,
+            loss_mask=loss_mask,
+            rewards=rewards,
             produced_version=base_version,
             episode=episode,
             batch_index=batch_index,
+            meta=meta,
         ))
     return out
 
@@ -180,6 +211,21 @@ def trajectories_to_candidates(
         cand["behavior_logps"] = [t.behavior_logps for t in trajs]
         cand["gen_lengths"] = [t.lengths for t in trajs]
         cand["version_tags"] = [t.version_tags for t in trajs]
+    if all(t.loss_mask is not None for t in trajs) and trajs:
+        cand["loss_mask"] = [t.loss_mask for t in trajs]
+    if all(t.rewards is not None for t in trajs) and trajs:
+        # env-scored groups: the trainer's reward pass must not re-score
+        cand["rewards"] = [t.rewards for t in trajs]
+    if trajs and all("turns" in t.meta for t in trajs):
+        # per-turn provenance + env label resurface so consumed batches
+        # keep their env/* metrics and lineage columns in async mode
+        cand["turns"] = [t.meta["turns"] for t in trajs]
+        env_name = next(
+            (t.meta.get("env_name") for t in trajs if t.meta.get("env_name")),
+            None,
+        )
+        if env_name is not None:
+            cand["env_name"] = env_name
     if group_weights is not None:
         cand["group_weights"] = [float(w) for w in group_weights]
     return cand

@@ -74,8 +74,10 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
     for cand in candidates:
         kept_answers, kept_rewards, kept_problems = [], [], []
         kept_tokens, kept_logps, kept_lens, kept_tags = [], [], [], []
+        kept_masks = []
         has_raw = "answer_tokens" in cand
         has_tags = "version_tags" in cand
+        has_mask = "loss_mask" in cand
         for j, rewards in enumerate(cand["rewards"]):
             idx = np.argsort(rewards)[-topk:]
             kept_answers.append([cand["answers"][j][i] for i in idx])
@@ -87,6 +89,8 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
                 kept_lens.append(np.asarray(cand["gen_lengths"][j])[idx])
             if has_tags:  # policy-version tags stay row-aligned too
                 kept_tags.append(np.asarray(cand["version_tags"][j])[idx])
+            if has_mask:  # per-turn loss masks stay row-aligned too
+                kept_masks.append(np.asarray(cand["loss_mask"][j])[idx])
         cand["answers"] = kept_answers
         cand["rewards"] = kept_rewards
         cand["problem"] = kept_problems
@@ -96,6 +100,8 @@ def topk_filter(candidates: Sequence[MutableMapping[str, Any]], topk: int) -> No
             cand["gen_lengths"] = kept_lens
         if has_tags:
             cand["version_tags"] = kept_tags
+        if has_mask:
+            cand["loss_mask"] = kept_masks
 
 
 def flatten_for_update(
@@ -121,9 +127,11 @@ def flatten_for_update(
     tokens: list[np.ndarray] = []
     logps: list[np.ndarray] = []
     tags: list[np.ndarray] = []
+    masks: list[np.ndarray] = []
     lens: list[int] = []
     has_raw = all("answer_tokens" in c for c in candidates) and candidates
     has_tags = has_raw and all("version_tags" in c for c in candidates)
+    has_mask = has_raw and all("loss_mask" in c for c in candidates)
     for cand in candidates:
         gw = cand.get("group_weights")
         if learner_type == "grpo":
@@ -140,6 +148,8 @@ def flatten_for_update(
                     lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
                 if has_tags:
                     tags.extend(np.asarray(cand["version_tags"][j]))
+                if has_mask:
+                    masks.extend(np.asarray(cand["loss_mask"][j]))
         else:
             for j, (a, p, r, b) in enumerate(
                 zip(
@@ -157,6 +167,8 @@ def flatten_for_update(
                     lens.extend(np.asarray(cand["gen_lengths"][j]).tolist())
                 if has_tags:
                     tags.extend(np.asarray(cand["version_tags"][j]))
+                if has_mask:
+                    masks.extend(np.asarray(cand["loss_mask"][j]))
     raw = None
     if has_raw and tokens:
         raw = {
@@ -166,4 +178,9 @@ def flatten_for_update(
         }
         if has_tags and tags:
             raw["version_tags"] = np.asarray(tags, dtype=np.int32)
+        if has_mask and masks:
+            # multi-turn env rounds (ISSUE 17): 1 on policy spans, 0 on
+            # env-injected observation tokens — the learner multiplies this
+            # into its answer mask so injected tokens never train
+            raw["loss_mask"] = np.asarray(masks, dtype=np.int32)
     return problems, answers, np.asarray(coeffs, dtype=np.float32), raw

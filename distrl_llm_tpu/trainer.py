@@ -46,7 +46,11 @@ from distrl_llm_tpu.metrics import MetricsSink, make_sink
 from distrl_llm_tpu.models.lora import init_lora_params, lora_scale
 from distrl_llm_tpu.ops.quant import default_group_size, quant_bits_for, quantize_params
 from distrl_llm_tpu.parallel.mesh import RoleMeshes, build_role_meshes
-from distrl_llm_tpu.rewards import RewardComputer
+from distrl_llm_tpu.rewards import (
+    RewardComputer,
+    make_reward_function,
+    reward_function as parity_reward_function,
+)
 from distrl_llm_tpu.shaping import flatten_for_update, shape_rewards, topk_filter
 from distrl_llm_tpu.tokenizer import decode_batch, encode_fixed
 from distrl_llm_tpu.utils.chunking import chunk_sizes
@@ -112,6 +116,21 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
     return kwargs
 
 
+def _env_turn_counts(candidates: list[dict]) -> list[int]:
+    """Per-EPISODE turn counts from the provenance riding consumed batches.
+
+    ``cand["turns"]`` nests group-major: one entry per trajectory (group),
+    each a list over the group's candidate rows, each row the list of that
+    episode's turn records — the episode count is the innermost length, NOT
+    the row count (len(grp) is just ``num_candidates``)."""
+    return [
+        len(row or ())
+        for c in candidates if "turns" in c
+        for grp in c["turns"]
+        for row in (grp or ())
+    ]
+
+
 class StaleWeightsError(RuntimeError):
     """The rollout mesh holds an adapter older than the learner's — the race
     the reference structurally prevents with its synchronous barrier and we
@@ -163,6 +182,18 @@ class Trainer:
         self.model_cfg = model_cfg
         self.meshes = meshes
         self.sink = sink
+        # format-reward gate (ISSUE 17 satellite): "strict" swaps the
+        # previously-dead strict newline-delimited scorer into the (N, 2)
+        # contract. Only the parity default is substitutable — a custom fn
+        # plus a non-default gate is ambiguous (which one wins?), refuse.
+        if config.format_reward != "soft":
+            if reward_function is not parity_reward_function:
+                raise ValueError(
+                    "format_reward != 'soft' with a custom reward_function "
+                    "is ambiguous — encode the gate inside the custom fn, "
+                    "or drop one of the two"
+                )
+            reward_function = make_reward_function(config.format_reward)
         # the computer evaluates THIS trainer's reward_function (a custom fn
         # passed positionally — the reference contract — must actually run).
         # An explicit reward_computer carries parallelism config; the fn is
@@ -181,6 +212,28 @@ class Trainer:
             )
         self.rewards = reward_computer
         self._reward_fn = reward_function
+
+        # pluggable environments (ISSUE 17): a multi-turn env arms the
+        # engine's turn hook per round — finished turns step the env and
+        # continuing conversations resume on their resident KV chains.
+        # env="math" routes the exact legacy single-turn path (no driver,
+        # byte-identical losses and checksums).
+        self._env_driver: Any = None
+        if config.env != "math":
+            from distrl_llm_tpu.env import EnvRolloutDriver
+
+            if not hasattr(engine, "turn_hook"):
+                raise ValueError(
+                    f"env={config.env!r} needs an engine with a turn_hook "
+                    "(the local paged refill engine); "
+                    f"{type(engine).__name__} has none"
+                )
+            self._env_driver = EnvRolloutDriver(
+                config.env, tokenizer,
+                max_turns=config.max_turns,
+                max_new_tokens=config.max_new_tokens,
+                format_scorer=config.format_reward,
+            )
 
         # the silent-no-op fix (ISSUE 9): inflight_weight_updates with an
         # engine that cannot actually swap mid-round used to pretend to
@@ -907,6 +960,9 @@ class Trainer:
             # rollout mesh; the learner share's params live on a different
             # device set — the whole batch decodes on the sharded engine
             and getattr(self.engine, "mesh", None) is None
+            # a multi-turn env round must be ONE engine call: the turn
+            # hook's candidate ids index the whole round's rows
+            and self._env_driver is None
         )
         if hybrid:
             sizes = chunk_sizes(
@@ -1088,7 +1144,32 @@ class Trainer:
         # swaps (and the versions pushed with them) can be sliced out after
         swaps_before = len(getattr(self.engine, "last_swap_steps", ()))
         base_version = self._rollout_weight_version
-        result = self._dispatch_rollout(prompt_ids, prompt_mask, sampling, b_real)
+        env_round = None
+        if self._env_driver is not None:
+            # one env per candidate row (group-major, padding rows get
+            # synthetic done episodes); the driver IS the engine turn hook
+            # for the duration of this round
+            self._env_driver.begin_round(
+                problems + [""] * (b_pad - b_real),
+                solutions + [""] * (b_pad - b_real),
+                sampling.n,
+            )
+            self.engine.turn_hook = self._env_driver
+        try:
+            result = self._dispatch_rollout(
+                prompt_ids, prompt_mask, sampling, b_real
+            )
+        finally:
+            if self._env_driver is not None:
+                self.engine.turn_hook = None
+        if self._env_driver is not None:
+            # score stragglers the engine finished without consulting the
+            # hook (final blocking sweep) and assemble masks/rewards/turns
+            width = result.tokens.shape[-1]
+            env_round = self._env_driver.finish_round(
+                np.asarray(result.tokens).reshape(-1, width),
+                np.asarray(result.lengths).reshape(-1),
+            )
 
         # degraded remote rounds (poison-shard quarantine with
         # degrade_on_poison): the engine zero-filled the quarantined
@@ -1165,6 +1246,22 @@ class Trainer:
                 self.lineage.note_first_sample(base_version, now)
                 for _step, v in events:
                     self.lineage.note_first_sample(v, now)
+        if env_round is not None:
+            # env-routed rounds: per-group loss masks (1 on policy spans, 0
+            # on injected observations), the env's own (n, 2) rewards (the
+            # reward pass must NOT re-score — each turn was consumed live),
+            # and per-turn provenance for lineage
+            n_ = sampling.n
+            cand["loss_mask"] = [
+                env_round.loss_mask[i * n_:(i + 1) * n_] for i in kept_idx
+            ]
+            cand["rewards"] = [env_round.group_rewards[i] for i in kept_idx]
+            cand["turns"] = [
+                env_round.turn_provenance[i * n_:(i + 1) * n_]
+                for i in kept_idx
+            ]
+            cand["env_name"] = self._env_driver.env_name
+            cand["env_stats"] = env_round.stats
         # snapshot pool + round telemetry HERE, on the thread that ran the
         # round: with async_rollout the next round (or an eval) may
         # overwrite the engine's shared attributes before _train_batch
@@ -1198,6 +1295,11 @@ class Trainer:
         """Per-task-group (n, 2) rewards (distributed_trainer.py:205–219),
         host-parallel via RewardComputer."""
         for cand in candidates:
+            if "rewards" in cand:
+                # env-scored round (ISSUE 17): each turn was rewarded as it
+                # happened — re-scoring the decoded text would double-count
+                # and lose the per-turn shaping
+                continue
             groups = [
                 (cand["answers"][j], cand["solution"][j])
                 for j in range(len(cand["answers"]))
@@ -1802,6 +1904,25 @@ class Trainer:
             ):
                 if pool.get(key) is not None:
                     metrics[name] = pool[key]
+        # env-routed rounds (ISSUE 17): per-round turn/tool telemetry the
+        # driver assembled at finish_round; absent on the legacy path
+        env_stats = next(
+            (c["env_stats"] for c in candidates if "env_stats" in c), None
+        )
+        if env_stats is not None:
+            metrics["env/turns_mean"] = env_stats.turns_mean
+            metrics["env/turns_max"] = env_stats.turns_max
+            metrics["env/step_ms_p50"] = env_stats.env_step_ms_p50
+            metrics["env/round_tool_calls"] = env_stats.tool_calls
+            metrics["env/round_resume_declined"] = env_stats.resume_declined
+        elif any("turns" in c for c in candidates):
+            # async-consumed env batches: the round-level stats object
+            # stayed with the producer, but turn counts are derivable
+            # from the provenance that rode the trajectories
+            counts = _env_turn_counts(candidates)
+            if counts:
+                metrics["env/turns_mean"] = float(np.mean(counts))
+                metrics["env/turns_max"] = int(np.max(counts))
         metrics.update(self._engine_metrics(candidates))
         metrics.update(extra_metrics)
         metrics.update(timer.metrics())

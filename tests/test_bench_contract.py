@@ -66,7 +66,9 @@ class TestBenchContract:
                     "control_actions", "shed_groups",
                     "kv_format", "kv_quant", "base_quant",
                     "bytes_per_token", "step_bytes_accessed",
-                    "sample_kernel", "quant_matmul"):
+                    "sample_kernel", "quant_matmul",
+                    "env_name", "turns_mean", "turns_max",
+                    "env_step_ms_p50"):
             assert key in rec, key
         # quantized-serving fields (ISSUE 15): an unpinned run resolves
         # the KV format from the (empty) plan DB — "none", the historical
@@ -109,6 +111,13 @@ class TestBenchContract:
         # null, distinguishing "no controller ran" from "ran, acted 0×"
         assert rec["control_actions"] is None
         assert rec["shed_groups"] is None
+        # multi-turn env fields (ISSUE 17): the single-turn control row
+        # never arms a turn hook — all four honestly null, so the A/B
+        # artifact can tell "no env ran" from "env ran, 1 turn"
+        assert rec["env_name"] is None
+        assert rec["turns_mean"] is None
+        assert rec["turns_max"] is None
+        assert rec["env_step_ms_p50"] is None
         # spec off: the speculative self-description fields read null, so
         # a driver can distinguish "off" from "ran but never accepted"
         assert rec["spec_draft"] == 0
@@ -168,6 +177,32 @@ class TestBenchContract:
         # CPU resolves the probe-gated fused kernel to its exact
         # unrolled fallback; either spelling is a valid record, null is not
         assert rec["spec_verify_impl"] in ("fused", "unrolled")
+
+    def test_env_record_fields(self):
+        """A BENCH_ENV row must self-describe the multi-turn regime
+        (ISSUE 17): which env label ran, realized turn counts, and the
+        synthetic env-step latency — while the engaged refill mirror
+        still reports slot_idle_frac, the stat the multi-turn-vs-control
+        A/B in tpu_bench_loop.sh compares."""
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+            "BENCH_ENV": "code", "BENCH_MAX_TURNS": "2",
+        })
+        assert "error" not in rec
+        assert rec["env_name"] == "code"
+        # every candidate takes at least its first turn; the hook grants
+        # continuation up to BENCH_MAX_TURNS, so the realized mean sits
+        # in [1, 2] and the max never exceeds the cap
+        assert 1.0 <= rec["turns_mean"] <= 2.0
+        assert 1 <= rec["turns_max"] <= 2
+        assert rec["env_step_ms_p50"] is not None
+        assert rec["env_step_ms_p50"] >= 0
+        # turn continuations ride the refill scheduler's resident-KV
+        # path, so the engaged mirror (and its idle accounting) is live
+        assert rec["slot_idle_frac"] is not None
+        assert 0.0 <= rec["slot_idle_frac"] < 1.0
+        assert rec["value"] > 0
 
     def test_cb_record_fields(self):
         """A shared-prefix continuous-admission row must self-describe

@@ -79,6 +79,58 @@ class TestTrainConfig:
             assert key in flat, key
 
 
+class TestEnvConfig:
+    """--env/--max_turns/--format_reward validation (ISSUE 17)."""
+
+    def _multi(self, **kw):
+        base = dict(
+            env="code", max_turns=3, engine_impl="paged",
+            continuous_batching=True, continuous_admission=True,
+            max_concurrent_sequences=4,
+        )
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_defaults_are_legacy(self):
+        c = TrainConfig()
+        assert c.env == "math" and c.max_turns == 1
+        assert c.format_reward == "soft"
+
+    def test_valid_multi_turn_shape(self):
+        assert self._multi().env == "code"
+        assert self._multi(env="verifier", format_reward="strict").env == (
+            "verifier"
+        )
+
+    def test_unknown_env_raises(self):
+        with pytest.raises(ValueError, match="env"):
+            TrainConfig(env="chess")
+
+    def test_math_with_max_turns_is_dead_flag(self):
+        with pytest.raises(ValueError, match="max_turns"):
+            TrainConfig(env="math", max_turns=2)
+
+    def test_max_turns_must_be_positive(self):
+        with pytest.raises(ValueError, match="max_turns"):
+            self._multi(max_turns=0)
+
+    def test_format_reward_choices(self):
+        with pytest.raises(ValueError, match="format_reward"):
+            TrainConfig(format_reward="lenient")
+
+    def test_multi_turn_requires_continuous_refill(self):
+        with pytest.raises(ValueError, match="continuous"):
+            TrainConfig(env="code")
+        with pytest.raises(ValueError, match="continuous_admission"):
+            self._multi(continuous_admission=False)
+
+    def test_multi_turn_rejects_spec_and_workers(self):
+        with pytest.raises(ValueError, match="spec_draft"):
+            self._multi(spec_draft=2)
+        with pytest.raises(ValueError, match="rollout_workers"):
+            self._multi(rollout_workers=["grpc://w0:9000"])
+
+
 class TestSamplingConfig:
     def test_replace(self):
         s = SamplingConfig().replace(n=8, temperature=0.6)

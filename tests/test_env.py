@@ -1,0 +1,451 @@
+"""Pluggable environments (ISSUE 17): protocol envs, the multi-turn rollout
+driver, and the paged engine's turn-resume path.
+
+Three layers, matching the subsystem's seams:
+
+* **Environments** — math (single-turn legacy scoring behind the protocol),
+  code (sandboxed ``<tool>`` execution), verifier (critique + improvement
+  rewards): step semantics, terminal accuracy, sandbox containment.
+* **Driver** — ``EnvRolloutDriver`` as the engine turn hook: span
+  bookkeeping in answer-token coordinates, loss masks that exclude
+  env-injected tokens, (n, 2) group rewards, decline unwinding, straggler
+  scoring at ``finish_round``.
+* **Engine** — the refill scheduler's in-place turn resume: an armed but
+  never-granting hook is byte-invisible; a granted observation appends to
+  the RESIDENT chain and the continuation decodes exactly what a dense
+  engine decodes from the full conversation re-fed as a prompt (the
+  no-re-prefill path is math-invariant); declines finish the candidate
+  exactly like the unarmed engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.engine import GenerationEngine
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.env import (
+    EnvRolloutDriver,
+    EnvStep,
+    Environment,
+    env_names,
+    get_env_class,
+)
+from distrl_llm_tpu.env.code_env import CodeToolEnv, run_sandboxed
+from distrl_llm_tpu.env.math_env import MathSingleTurnEnv
+from distrl_llm_tpu.env.verifier_env import VerifierFeedbackEnv
+from distrl_llm_tpu.models import TINY, init_params
+from distrl_llm_tpu.rewards import reward_function
+from distrl_llm_tpu.tokenizer import CharTokenizer
+
+WELL_FORMED = "<think>plan</think>\n<answer>42</answer>"
+
+
+# ------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_names_sorted_and_complete(self):
+        assert env_names() == ("code", "math", "verifier")
+
+    def test_lookup_and_protocol(self):
+        for name in env_names():
+            cls = get_env_class(name)
+            assert isinstance(cls(), Environment)
+            assert cls.name == name
+
+    def test_unknown_name_lists_available(self):
+        with pytest.raises(ValueError, match="code, math, verifier"):
+            get_env_class("chess")
+
+
+# ------------------------------------------------------------- math env
+
+
+class TestMathEnv:
+    def test_single_step_matches_reward_function(self):
+        env = MathSingleTurnEnv()
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step(WELL_FORMED)
+        ref = reward_function([WELL_FORMED], ["42"])
+        assert step.done and step.observation is None
+        assert step.reward == pytest.approx(float(ref[0, 0]))
+        assert step.info["accuracy"] == float(ref[0, 1]) == 1.0
+
+    def test_second_step_raises(self):
+        env = MathSingleTurnEnv()
+        env.reset({"problem": "p", "solution": "1"})
+        env.step("x")
+        with pytest.raises(RuntimeError, match="single-turn"):
+            env.step("y")
+
+    def test_step_before_reset_raises(self):
+        with pytest.raises(RuntimeError, match="reset"):
+            MathSingleTurnEnv().step("x")
+
+
+# ------------------------------------------------------------- code env
+
+
+class TestCodeEnv:
+    def test_tool_block_executes_and_round_trips(self):
+        env = CodeToolEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step("<tool>print(6*7)</tool>")
+        assert not step.done
+        assert "<output>" in step.observation and "42" in step.observation
+        assert step.info["tool_call_id"] == "tool-1"
+        assert step.info["tool_output"] == "42"
+
+    def test_answer_terminates_with_accuracy(self):
+        env = CodeToolEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step("<answer>42</answer>")
+        assert step.done and step.info["accuracy"] == 1.0
+
+    def test_no_tool_no_answer_gets_hint(self):
+        env = CodeToolEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step("hmm")
+        assert not step.done and "<tool>" in step.observation
+        assert "tool_call_id" not in step.info
+
+    def test_turn_budget_forces_terminal(self):
+        env = CodeToolEnv(max_turns=2)
+        env.reset({"problem": "p", "solution": "42"})
+        assert not env.step("<tool>print(1)</tool>").done
+        final = env.step("<tool>print(2)</tool>")  # budget spent: scored
+        assert final.done and final.info["accuracy"] == 0.0
+
+    def test_last_tool_block_wins(self):
+        env = CodeToolEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": ""})
+        step = env.step("<tool>print(1)</tool> then <tool>print(2)</tool>")
+        assert step.info["tool_output"] == "2"
+
+    def test_sandbox_timeout_is_contained(self):
+        out = run_sandboxed("while True: pass", timeout_s=0.5)
+        assert out == "<tool timeout>"
+
+    def test_sandbox_truncates_output(self):
+        out = run_sandboxed("print('x' * 10000)", output_limit=32)
+        assert len(out) == 32
+
+    def test_sandbox_captures_errors_without_raising(self):
+        out = run_sandboxed("raise ValueError('boom')")
+        assert "ValueError" in out
+
+
+# -------------------------------------------------------- verifier env
+
+
+class TestVerifierEnv:
+    def test_wrong_answer_gets_critique(self):
+        env = VerifierFeedbackEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step("<think>a</think>\n<answer>41</answer>")
+        assert not step.done
+        assert "'41'" in step.observation
+        assert step.info["tool_call_id"] == "verify-1"
+
+    def test_correct_answer_terminates(self):
+        env = VerifierFeedbackEnv(max_turns=3)
+        env.reset({"problem": "p", "solution": "42"})
+        step = env.step(WELL_FORMED)
+        assert step.done and step.info["accuracy"] == 1.0
+        assert step.info["verdict"] == "correct"
+
+    def test_reward_is_improvement_over_previous_turn(self):
+        env = VerifierFeedbackEnv(max_turns=4)
+        env.reset({"problem": "p", "solution": "nope"})
+        bad, good = "no tags here", "<think>a</think>\n<answer>x</answer>"
+        from distrl_llm_tpu.rewards import soft_format_scorer
+
+        r1 = env.step(bad).reward
+        r2 = env.step(good).reward
+        r3 = env.step(bad).reward
+        s_bad = float(soft_format_scorer([bad])[0])
+        s_good = float(soft_format_scorer([good])[0])
+        assert r1 == pytest.approx(s_bad)  # first turn: the score itself
+        assert r2 == pytest.approx(s_good - s_bad)  # improvement: positive
+        assert r3 == pytest.approx(s_bad - s_good)  # regression: pays
+
+    def test_budget_exhaustion_terminates_incorrect(self):
+        env = VerifierFeedbackEnv(max_turns=2)
+        env.reset({"problem": "p", "solution": "42"})
+        assert not env.step("<answer>1</answer>").done
+        final = env.step("<answer>2</answer>")
+        assert final.done and final.info["verdict"] == "incorrect"
+
+
+# ------------------------------------------------------------ driver
+
+
+def _driver(env="code", max_turns=3, width=96, **kw):
+    tok = CharTokenizer(TINY.vocab_size)
+    return tok, EnvRolloutDriver(
+        env, tok, max_turns=max_turns, max_new_tokens=width, **kw
+    )
+
+
+class TestDriver:
+    def test_tool_round_trip_masks_and_provenance(self):
+        tok, drv = _driver()
+        drv.begin_round(["compute 6*7"], ["42"], 1)
+        turn1 = np.asarray(tok.encode("<tool>print(6*7)</tool>"), np.int32)
+        obs = drv(0, turn1)
+        assert obs is not None and "42" in tok.decode(obs)
+        turn2 = np.asarray(tok.encode("<answer>42</answer>"), np.int32)
+        full = np.concatenate([turn1, obs, turn2])
+        assert drv(0, full) is None  # terminal <answer>
+
+        tokens = np.zeros((1, 96), np.int32)
+        tokens[0, :full.size] = full
+        res = drv.finish_round(tokens, np.asarray([full.size]))
+        g1, e1 = turn1.size, turn1.size + obs.size
+        mask = res.loss_mask[0]
+        assert mask[:g1].all() and mask[e1:full.size].all()
+        assert not mask[g1:e1].any()  # observation never trains
+        assert res.group_rewards[0].shape == (1, 2)
+        assert res.group_rewards[0][0, 1] == 1.0
+        prov = res.turn_provenance[0]
+        assert [t["turn"] for t in prov] == [0, 1]
+        assert prov[0]["tool_call_id"] == "tool-1"
+        assert prov[0]["env_span"] == [int(g1), int(e1)]
+        assert res.stats.tool_calls == 1 and res.stats.turns_max == 2
+
+    def test_synthetic_padding_rows_never_step(self):
+        tok, drv = _driver(env="verifier")
+        drv.begin_round(["q", ""], ["42", ""], 2)
+        # padding rows (group 1) are born done: the hook ends them at
+        # first contact and they contribute zero reward rows
+        for c in (2, 3):
+            assert drv(c, np.asarray([5], np.int32)) is None
+        tokens = np.zeros((4, 96), np.int32)
+        res = drv.finish_round(tokens, np.asarray([1, 1, 1, 1]))
+        np.testing.assert_array_equal(res.group_rewards[1], np.zeros((2, 2)))
+        assert res.turns[2] == 0 and res.turns[3] == 0
+        # synthetic rows are excluded from the round stats
+        assert res.stats.turns_max <= drv.max_turns
+
+    def test_turn_budget_ends_episode(self):
+        tok, drv = _driver(env="verifier", max_turns=2)
+        drv.begin_round(["q"], ["42"], 1)
+        t1 = np.asarray(tok.encode("<answer>1</answer>"), np.int32)
+        obs = drv(0, t1)
+        assert obs is not None  # wrong answer, budget remains
+        full = np.concatenate(
+            [t1, obs, np.asarray(tok.encode("<answer>2</answer>"), np.int32)]
+        )
+        assert drv(0, full) is None  # budget spent
+        res = drv.finish_round(
+            np.zeros((1, 96), np.int32), np.asarray([full.size])
+        )
+        assert res.turns[0] == 2
+
+    def test_declined_unwinds_phantom_env_span(self):
+        tok, drv = _driver(env="verifier")
+        drv.begin_round(["q"], ["42"], 1)
+        t1 = np.asarray(tok.encode("<answer>1</answer>"), np.int32)
+        assert drv(0, t1) is not None
+        drv.declined(0)  # engine had no room to seat the critique
+        ep = drv._episodes[0].state
+        assert ep.done and ep.truncated
+        assert ep.turns[-1].env_span is None  # the span never materialized
+        res = drv.finish_round(
+            np.zeros((1, 96), np.int32), np.asarray([t1.size])
+        )
+        assert res.stats.resume_declined == 1
+        # the policy turn still trains
+        assert res.loss_mask[0, :t1.size].all()
+
+    def test_finish_round_scores_unconsulted_stragglers(self):
+        """A candidate the engine finished without consulting the hook
+        (final blocking sweep) still owes its turn to the environment."""
+        tok, drv = _driver(env="math", max_turns=1)
+        drv.begin_round(["q"], ["42"], 2)
+        rows = [tok.encode(WELL_FORMED), tok.encode("wrong")]
+        width = max(len(r) for r in rows)
+        tokens = np.zeros((2, 96), np.int32)
+        for i, r in enumerate(rows):
+            tokens[i, :len(r)] = r
+        res = drv.finish_round(
+            tokens, np.asarray([len(r) for r in rows])
+        )
+        ref = reward_function([WELL_FORMED, "wrong"], ["42", "42"])
+        np.testing.assert_allclose(res.group_rewards[0], ref)
+        assert list(res.turns) == [1, 1]
+
+
+class TestTurnCountFallback:
+    """Async-consumed batches derive env/turns_* from provenance; the
+    nesting is group-major (groups → rows → turn records) and the episode
+    turn count is the INNERMOST length — counting rows per group instead
+    silently reported num_candidates as the turn count."""
+
+    def test_counts_are_per_episode_not_per_row(self):
+        from distrl_llm_tpu.trainer import _env_turn_counts
+
+        t = {"turn": 0}
+        candidates = [
+            # 2 groups × 2 rows: episodes of 1, 2, 2 and 0 turns
+            {"turns": [[[t], [t, t]], [[t, t], []]]},
+            {"no_turns_key": True},
+        ]
+        assert sorted(_env_turn_counts(candidates)) == [0, 1, 2, 2]
+
+    def test_no_provenance_yields_empty(self):
+        from distrl_llm_tpu.trainer import _env_turn_counts
+
+        assert _env_turn_counts([{"x": 1}]) == []
+        assert _env_turn_counts([{"turns": []}]) == []
+
+
+# ----------------------------------------------- engine turn-resume path
+
+
+P_LEN = 16
+
+
+class ScriptHook:
+    """Deterministic turn hook: grants each candidate's scripted
+    observations in order, then lets it finish."""
+
+    def __init__(self, grants=None):
+        self.grants = {c: list(seq) for c, seq in (grants or {}).items()}
+        self.calls: list[tuple[int, int]] = []
+        self.declines: list[int] = []
+
+    def __call__(self, cand_id, gen_tokens):
+        self.calls.append((int(cand_id), int(len(gen_tokens))))
+        seq = self.grants.get(int(cand_id))
+        return np.asarray(seq.pop(0), np.int32) if seq else None
+
+    def declined(self, cand_id):
+        self.declines.append(int(cand_id))
+
+
+def _paged(max_new=48, rows=4, **kw):
+    # half-vocab EOS so greedy streams finish turns early enough to leave
+    # token room for observations + continuations
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=P_LEN, max_new_tokens=max_new,
+        eos_token_ids=list(range(2, TINY.vocab_size, 2)), pad_token_id=0,
+        cache_dtype=jnp.float32, page_size=8, max_concurrent_rows=rows,
+        scheduler="refill", decode_chunk=4, autotune=False, **kw,
+    )
+
+
+def _dense(max_prompt=P_LEN, max_new=48):
+    return GenerationEngine(
+        TINY, max_prompt_tokens=max_prompt, max_new_tokens=max_new,
+        eos_token_ids=list(range(2, TINY.vocab_size, 2)), pad_token_id=0,
+        cache_dtype=jnp.float32,
+    )
+
+
+@pytest.fixture(scope="module")
+def turn_setup():
+    params = init_params(jax.random.PRNGKey(7), TINY)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, TINY.vocab_size, size=(2, P_LEN)).astype(np.int32)
+    mask = np.ones((2, P_LEN), np.int32)
+    return params, ids, mask
+
+
+def _greedy(n=1, max_tokens=48):
+    return SamplingConfig(max_tokens=max_tokens, temperature=0.0, n=n)
+
+
+class TestEngineTurnResume:
+    def test_armed_but_never_granting_hook_is_byte_invisible(self, turn_setup):
+        params, ids, mask = turn_setup
+        golden = _paged().generate(
+            params, None, ids, mask, _greedy(n=2), jax.random.PRNGKey(0))
+        eng = _paged()
+        hook = ScriptHook()
+        eng.turn_hook = hook
+        res = eng.generate(
+            params, None, ids, mask, _greedy(n=2), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(res.tokens, golden.tokens)
+        np.testing.assert_array_equal(res.lengths, golden.lengths)
+        # the hook WAS consulted (once per finishing candidate with room)
+        assert hook.calls and not hook.declines
+        st = eng.last_pool_stats
+        assert st["turn_resumes"] == 0
+        assert st["turn_prefill_saved_tokens"] == 0
+
+    def test_resume_continuation_matches_dense_full_context(self, turn_setup):
+        """The KV-exactness pin: after an in-place resume, the engine
+        decodes exactly what a dense engine decodes when handed the whole
+        conversation (prompt + turn 1 + observation) as a prompt — the
+        resident chain IS the re-prefilled context, byte for byte."""
+        params, ids, mask = turn_setup
+        one_id, one_mask = ids[:1], mask[:1]
+        # phase 1 (control): where does the first turn end?
+        base = _paged().generate(
+            params, None, one_id, one_mask, _greedy(), jax.random.PRNGKey(0))
+        g1 = int(base.lengths[0, 0])
+        gen1 = np.asarray(base.tokens[0, 0, :g1])
+        assert g1 < 40  # room must remain for the obs + continuation
+
+        obs = np.arange(5, 5 + 2 * 8, 2, dtype=np.int32) % 251 | 1  # odd ids
+        eng = _paged()
+        hook = ScriptHook(grants={0: [obs]})
+        eng.turn_hook = hook
+        res = eng.generate(
+            params, None, one_id, one_mask, _greedy(), jax.random.PRNGKey(0))
+        total = int(res.lengths[0, 0])
+        row = np.asarray(res.tokens[0, 0])
+        st = eng.last_pool_stats
+        assert st["turn_resumes"] == 1
+        # every resident token (prompt + turn 1) skipped re-prefill
+        assert st["turn_prefill_saved_tokens"] == P_LEN + g1
+        # turn 1 and the injected observation sit verbatim in the row
+        np.testing.assert_array_equal(row[:g1], gen1)
+        np.testing.assert_array_equal(row[g1:g1 + obs.size], obs)
+        assert total > g1 + obs.size  # a continuation was decoded
+
+        # dense control: full conversation re-fed as a prompt
+        conv = np.concatenate([one_id[0], gen1, obs])[None, :]
+        dense = _dense(max_prompt=conv.shape[1]).generate(
+            params, None, conv.astype(np.int32),
+            np.ones_like(conv, np.int32), _greedy(), jax.random.PRNGKey(0))
+        g2 = int(dense.lengths[0, 0])
+        np.testing.assert_array_equal(
+            row[g1 + obs.size:total],
+            np.asarray(dense.tokens[0, 0, :g2]),
+        )
+        assert total == g1 + obs.size + g2
+
+    def test_oversize_observation_declines_and_finishes(self, turn_setup):
+        params, ids, mask = turn_setup
+        golden = _paged().generate(
+            params, None, ids, mask, _greedy(n=2), jax.random.PRNGKey(0))
+        eng = _paged()
+        hook = ScriptHook(
+            grants={c: [np.full(64, 5, np.int32)] for c in range(4)})
+        eng.turn_hook = hook
+        res = eng.generate(
+            params, None, ids, mask, _greedy(n=2), jax.random.PRNGKey(0))
+        # nothing fits (64 obs tokens > the 48-token window): every grant
+        # is declined and the round is byte-identical to the unarmed one
+        np.testing.assert_array_equal(res.tokens, golden.tokens)
+        assert hook.declines
+        assert eng.last_pool_stats["turn_resumes"] == 0
+
+    def test_hook_requires_refill_scheduler(self):
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=P_LEN, max_new_tokens=8,
+            eos_token_ids=[1], pad_token_id=0, page_size=8,
+            autotune=False,
+        )
+        eng.turn_hook = ScriptHook()
+        params = init_params(jax.random.PRNGKey(0), TINY)
+        ids = np.ones((1, P_LEN), np.int32)
+        with pytest.raises(ValueError, match="refill"):
+            eng.generate(params, None, ids, np.ones_like(ids),
+                         _greedy(max_tokens=8), jax.random.PRNGKey(0))

@@ -112,6 +112,71 @@ class TestRewardFunction:
         assert out.shape == (0, 2)
 
 
+class TestSelectableScorers:
+    """``--format_reward`` (ISSUE 17 satellite): ``strict_format_reward``
+    becomes a selectable gate instead of dead parity code."""
+
+    def test_soft_returns_the_parity_function_itself(self):
+        from distrl_llm_tpu.rewards import make_reward_function
+
+        # identity, not equivalence: the default config's byte-identity
+        # pin depends on the exact object (and on picklability for the
+        # RewardComputer process pool)
+        assert make_reward_function("soft") is reward_function
+
+    def test_strict_gates_column_0_only(self):
+        from distrl_llm_tpu.rewards import make_reward_function
+
+        fn = make_reward_function("strict")
+        out = fn([GOOD, ONELINE], ["42", "42"])
+        ref = reward_function([GOOD, ONELINE], ["42", "42"])
+        # accuracy column is untouched
+        np.testing.assert_array_equal(out[:, 1], ref[:, 1])
+        # GOOD satisfies the strict newline format: 0.1 + xmlcount
+        assert out[0, 0] == pytest.approx(0.1 + 0.2)
+        # ONELINE passes soft but fails strict: xmlcount only (0 here)
+        assert out[1, 0] == pytest.approx(0.0)
+        assert ref[1, 0] == pytest.approx(0.1)
+
+    def test_format_scorers_match_reward_columns(self):
+        from distrl_llm_tpu.rewards import (
+            make_format_scorer,
+            strict_reward_function,
+        )
+
+        batch = [GOOD, ONELINE, ""]
+        np.testing.assert_array_equal(
+            make_format_scorer("soft")(batch),
+            reward_function(batch, [""] * 3)[:, 0],
+        )
+        np.testing.assert_array_equal(
+            make_format_scorer("strict")(batch),
+            strict_reward_function(batch, [""] * 3)[:, 0],
+        )
+
+    def test_unknown_names_raise(self):
+        from distrl_llm_tpu.rewards import (
+            make_format_scorer,
+            make_reward_function,
+        )
+
+        with pytest.raises(ValueError, match="soft, strict"):
+            make_reward_function("lenient")
+        with pytest.raises(ValueError, match="soft, strict"):
+            make_format_scorer("lenient")
+
+    def test_strict_function_is_picklable(self):
+        import pickle
+
+        from distrl_llm_tpu.rewards import make_reward_function
+
+        fn = pickle.loads(pickle.dumps(make_reward_function("strict")))
+        np.testing.assert_array_equal(
+            fn([GOOD], ["42"]),
+            make_reward_function("strict")([GOOD], ["42"]),
+        )
+
+
 class TestRewardComputer:
     def test_serial_matches_reference_function(self):
         rc = RewardComputer(num_workers=0)

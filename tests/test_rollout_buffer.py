@@ -311,6 +311,97 @@ class TestVersionTags:
         )
 
 
+class TestTurnAwareVersionBounds:
+    """Multi-turn env rounds (ISSUE 17): only POLICY tokens vote in the
+    staleness verdict — env-injected observation spans carry the injection
+    step's version, not a sampling event, and must not age (or refresh)
+    a group."""
+
+    @staticmethod
+    def _turny(tags, loss_mask, lengths, version=0):
+        t = traj(0, version=version)
+        t.version_tags = np.asarray(tags, np.int32)
+        t.loss_mask = np.asarray(loss_mask, np.int32)
+        t.lengths = np.asarray(lengths, np.int32)
+        return t
+
+    def test_env_tokens_excluded_from_bounds(self):
+        tags = [[5, 5, 1, 1]]
+        # without a loss mask the stale tail votes...
+        t = traj(0)
+        t.version_tags = np.asarray(tags, np.int32)
+        t.lengths = np.asarray([4], np.int32)
+        assert t.min_version == 1
+        # ...with it, the env span (positions 2-3) is silent
+        t2 = self._turny(tags, [[1, 1, 0, 0]], [4])
+        assert (t2.min_version, t2.max_version) == (5, 5)
+
+    def test_all_env_masked_falls_back_to_produced_version(self):
+        t = self._turny([[5, 5, 5, 5]], [[0, 0, 0, 0]], [4], version=7)
+        assert (t.min_version, t.max_version) == (7, 7)
+
+    def test_drop_mode_ignores_fresh_env_tokens(self):
+        """A group whose only in-bound tokens are env-injected must DROP:
+        the policy spans are uniformly stale, and observations are not
+        evidence of freshness."""
+        pol = StalenessPolicy(2, mode="drop")
+        fake_fresh = self._turny(
+            [[0, 0, 9, 9], [0, 0, 9, 9]],
+            [[1, 1, 0, 0], [1, 1, 0, 0]], [4, 4],
+        )
+        kept, _ = pol.admit([fake_fresh], learner_version=9)
+        assert kept == [] and pol.dropped == 1
+
+    def test_drop_mode_ignores_stale_env_tokens(self):
+        """The dual: stale observations inside fresh policy spans must
+        not drop (or down-weight) the group."""
+        stale_obs = self._turny(
+            [[0, 0, 9, 9], [0, 0, 9, 9]],
+            [[0, 0, 1, 1], [0, 0, 1, 1]], [4, 4], version=9,
+        )
+        kept, weights = StalenessPolicy(2, mode="drop").admit(
+            [stale_obs], learner_version=9)
+        assert kept == [stale_obs]
+        down = self._turny(
+            [[0, 0, 9, 9]], [[0, 0, 1, 1]], [4], version=9)
+        kept, weights = StalenessPolicy(
+            1, mode="downweight", downweight=0.5
+        ).admit([down], learner_version=9)
+        assert weights == [1.0]  # min policy version is 9: lag 0
+
+    def test_round_trip_carries_env_fields(self):
+        cand = {
+            "answers": [["x", "y"]],
+            "problem": [["p0", "p0"]],
+            "solution": [["s0", "s0"]],
+            "token_lengths": [[3, 2]],
+            "answer_tokens": [np.ones((2, 4), np.int32)],
+            "behavior_logps": [np.zeros((2, 4), np.float32)],
+            "gen_lengths": [np.asarray([3, 2])],
+            "loss_mask": [np.asarray([[1, 0, 1, 0], [1, 1, 0, 0]])],
+            "rewards": [np.asarray([[0.1, 1.0], [0.0, 0.0]])],
+            "turns": [[[{"turn": 0}], [{"turn": 0}]]],
+            "env_name": "verifier",
+        }
+        trajs = round_to_trajectories(cand, base_version=3)
+        assert trajs[0].meta["env_name"] == "verifier"
+        np.testing.assert_array_equal(
+            trajs[0].loss_mask, cand["loss_mask"][0])
+        back = trajectories_to_candidates(trajs)
+        np.testing.assert_array_equal(
+            back["loss_mask"][0], cand["loss_mask"][0])
+        np.testing.assert_array_equal(
+            back["rewards"][0], cand["rewards"][0])
+        assert back["turns"] == cand["turns"]
+        assert back["env_name"] == "verifier"
+
+    def test_legacy_rounds_carry_no_env_fields(self):
+        trajs = [traj(0), traj(1)]
+        back = trajectories_to_candidates(trajs)
+        for key in ("loss_mask", "rewards", "turns", "env_name"):
+            assert key not in back
+
+
 class TestStalenessPolicy:
     def test_drop_mode(self):
         pol = StalenessPolicy(2, mode="drop")

@@ -116,6 +116,34 @@ class TestSyncByteIdentity:
         assert all(m["rollout_dropped_stale"] == 0 for m in recs)
 
 
+class TestEnvRouting:
+    """``env="math"`` (the default) routes the EXACT legacy path (ISSUE
+    17): no env driver is constructed, the engine's turn hook is never
+    armed, and the golden byte-identity pins above therefore cover the
+    default env. An explicit ``env="math"`` must change nothing."""
+
+    @pytest.mark.parametrize("clip", [0.0, 0.2])
+    def test_explicit_math_env_is_byte_identical(self, clip):
+        trainer, sink, engine = _run_tiny(clip_ratio=clip, env="math")
+        losses = [m["loss"] for _, m in sink.records if "loss" in m]
+        assert losses == GOLDEN_LOSSES[clip], (
+            "env='math' diverged from the legacy rollout path"
+        )
+        assert _checksum(trainer.lora) == GOLDEN_CHECKSUM[clip]
+
+    def test_math_env_never_arms_driver_or_hook(self):
+        trainer, _, engine = _run_tiny(env="math")
+        assert trainer._env_driver is None
+        assert getattr(engine, "turn_hook", None) is None
+
+    def test_math_records_carry_no_env_metrics(self):
+        _, sink, _ = _run_tiny(env="math")
+        recs = [m for _, m in sink.records if "loss" in m]
+        assert recs and not any(
+            k.startswith("env/") for m in recs for k in m
+        )
+
+
 class TestModeAliasing:
     def test_async_rollout_flag_selects_pipelined(self):
         cfg = TrainConfig(model="t", async_rollout=True)

@@ -118,10 +118,15 @@ def step_detail(groups: list[dict], step: int,
     dyn_hdr = (
         f" {'kl':>9} {'entropy':>8} {'cap':>6}" if dyn else ""
     )
+    # per-turn provenance column (ISSUE 17): present only for multi-turn
+    # env rounds — the ledger stamps each policy turn's span, tool-call
+    # id, and the weight version that sampled it
+    turny = any(g.get("turns") for g in rows)
+    turn_hdr = f" {'turns':>5}" if turny else ""
     lines.append(
         f"  {'uid':>5} {'ep/batch':>9} {'worker':<22} {'dispatch':>8} "
         f"{'versions':>9} {'lag':>4} {'s→learn ms':>11} {'verdict':<10}"
-        + dyn_hdr + extra
+        + dyn_hdr + turn_hdr + extra
     )
     for g in sorted(rows, key=lambda g: g.get("uid", 0)):
         vmin, vmax = g.get("min_version", 0), g.get("max_version", 0)
@@ -137,6 +142,8 @@ def step_detail(groups: list[dict], step: int,
                 f" {f'{ent:.4f}' if ent is not None else 'n/a':>8}"
                 f" {f'{cap:.3f}' if cap is not None else 'n/a':>6}"
             )
+        turns = g.get("turns") or []
+        turn_cols = f" {len(turns):>5}" if turny else ""
         lines.append(
             f"  {g.get('uid', '?'):>5} "
             f"{g.get('episode', 0)}/{g.get('batch_index', 0):<7} "
@@ -144,8 +151,21 @@ def step_detail(groups: list[dict], step: int,
             f"{str(g.get('dispatch_id') or '-'):>8} {vspan:>9} "
             f"{str(g.get('staleness_lag', '?')):>4} "
             f"{stl_s:>11} {str(g.get('verdict') or '?'):<10}"
-            + dyn_cols + _serving_cols(g, serving)
+            + dyn_cols + turn_cols + _serving_cols(g, serving)
         )
+        # one indented line per policy turn: which candidate, which turn
+        # index, the tool call that ended it, the token span that trains,
+        # and the weight version live when it sampled
+        for t in turns:
+            span = t.get("policy_span") or [0, 0]
+            ver = t.get("version")
+            lines.append(
+                f"        turn cand={t.get('cand', '?')} "
+                f"idx={t.get('turn', '?')} "
+                f"tool={t.get('tool_call_id') or '-'} "
+                f"span=[{span[0]},{span[1]}) "
+                f"version={f'v{ver}' if ver is not None else 'n/a'}"
+            )
     produced = {g.get("produced_version") for g in rows}
     lines.append(f"  produced weight version(s): {sorted(produced)}")
     return lines

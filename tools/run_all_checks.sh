@@ -118,6 +118,13 @@ stage "lineage_smoke" env JAX_PLATFORMS=cpu \
 # the run's artifacts
 stage "learn_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/learn_smoke.py
+# pluggable-environment gate (ISSUE 17): the code env's <tool> block runs
+# in the sandbox and round-trips loss-masked, both multi-turn envs train
+# end-to-end sync+async through the paged refill engine with turn
+# continuations resuming resident KV chains (no prefix re-prefill), and
+# lineage stamps per-turn provenance the report tool renders
+stage "env_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/env_smoke.py
 # bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
 # one table and flag >10% per-metric tok/s regressions — machine-readable
 # bench history, but cross-round rows come from different silicon windows,
@@ -142,7 +149,7 @@ fi
 stage "suite_trainer" timeout 600 python -m pytest -q \
   tests/test_trainer.py tests/test_async_rollout.py tests/test_clip_objective.py \
   tests/test_failure_and_resume.py tests/test_role_separation.py \
-  tests/test_rollout_buffer.py tests/test_rollout_modes.py
+  tests/test_rollout_buffer.py tests/test_rollout_modes.py tests/test_env.py
 stage "suite_engines_1" timeout 600 python -m pytest -q \
   tests/test_engine.py tests/test_paged.py
 stage "suite_engines_2" timeout 600 python -m pytest -q \

@@ -284,6 +284,20 @@ bench quant_sampler_fused /tmp/bench_tpu_quant_sampler_fused.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
   BENCH_BASE_QUANT=int8 BENCH_KV_FORMAT=int8 DISTRL_SAMPLE_KERNEL=fused
+# multi-turn env A/B (ISSUE 17): identical refill config with and
+# without the synthetic turn hook (2 policy turns, 16-token observation
+# per continuation). The env arm's rows carry env_name/turns_mean/
+# turns_max/env_step_ms_p50 (control reads null), and the comparison the
+# artifact answers is slot_idle_frac: turn continuations resume resident
+# KV chains in place, so multi-turn scheduling should idle no more slots
+# than the single-turn control
+bench env_singleturn_ctrl /tmp/bench_tpu_env_singleturn_ctrl.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16
+bench env_multiturn /tmp/bench_tpu_env_multiturn.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16 \
+  BENCH_ENV=code BENCH_MAX_TURNS=2 BENCH_ENV_OBS_TOKENS=16
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
@@ -331,6 +345,7 @@ all_done() {
            cb_prefix cb_continuous \
            quant_bf16_ctrl quant_int8_kv quant_int8_base quant_int4_base \
            quant_sampler_fused \
+           env_singleturn_ctrl env_multiturn \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

@@ -180,6 +180,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rollout_buffer_groups", type=int, default=0,
                    help="trajectory-buffer capacity in task groups for "
                         "--rollout_mode async (0 = auto: 4x batch_size)")
+    p.add_argument("--env", type=str, default="math",
+                   choices=["code", "math", "verifier"],
+                   help="rollout environment: 'math' = the legacy "
+                        "single-turn scorer (byte-identical pre-env path); "
+                        "'code' = multi-turn sandboxed <tool> execution "
+                        "with outputs fed back; 'verifier' = multi-turn "
+                        "verifier feedback with per-turn improvement "
+                        "rewards. Multi-turn envs need --continuous_batching "
+                        "+ --continuous_admission (turn continuations "
+                        "resume on resident KV chains, no re-prefill)")
+    p.add_argument("--max_turns", type=int, default=1,
+                   help="conversation-turn budget per episode for "
+                        "multi-turn --env values (env='math' is single-turn "
+                        "by construction; >1 there is rejected)")
+    p.add_argument("--format_reward", type=str, default="soft",
+                   choices=["soft", "strict"],
+                   help="format-reward gate: 'soft' = the reference's "
+                        "anchored single-line pattern (parity default); "
+                        "'strict' = the newline-delimited variant")
     p.add_argument("--async_rollout", action="store_true",
                    help="DEPRECATED alias for --rollout_mode pipelined "
                         "(one-step-off-policy LlamaRL/PipelineRL-style "
@@ -502,7 +521,11 @@ def run_smoke(config: TrainConfig) -> None:
     config = dataclasses.replace(
         config,
         model="tiny", episodes=1, batch_size=4, num_candidates=4, topk=4,
-        train_batch_size=4, max_prompt_tokens=64, max_new_tokens=32,
+        train_batch_size=4, max_prompt_tokens=64,
+        # multi-turn envs need the answer window to seat a policy turn PLUS
+        # the injected observation (CharTokenizer: 1 char ≈ 1 token) or every
+        # turn resume is declined for lack of room
+        max_new_tokens=32 if config.env == "math" else 96,
         number_of_actors=1, number_of_learners=1, learner_chunk_size=1,
         eval_every=0, save_every=0, metrics_backend="null",
         max_lora_rank=4, lora_alpha=8, lr=1e-3,
@@ -529,21 +552,47 @@ def run_smoke(config: TrainConfig) -> None:
         base = quantize_params(
             base, bits=bits, group_size=config.quant_group_size or 16
         )
-    engine = GenerationEngine(
-        TINY,
-        max_prompt_tokens=config.max_prompt_tokens,
-        max_new_tokens=config.max_new_tokens,
-        eos_token_ids=[tokenizer.eos_token_id],
-        pad_token_id=tokenizer.pad_token_id,
-        # behavior-logprob capture whenever the objective needs it, so
-        # --smoke composes with --clip_ratio / --rollout_mode async
-        capture_logprobs=config.clip_ratio > 0.0,
-        # honor --autotune/--plan-db in the smoke path too: "--autotune off
-        # skips the DB read entirely" must hold for every engine the CLI
-        # builds
-        autotune=config.autotune,
-        plan_db=config.plan_db,
-    )
+    if config.engine_impl == "paged":
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+        from distrl_llm_tpu.models.lora import lora_scale
+
+        engine = PagedGenerationEngine(
+            TINY,
+            max_prompt_tokens=config.max_prompt_tokens,
+            max_new_tokens=config.max_new_tokens,
+            # multi-turn smoke: half-vocab EOS so the random tiny policy
+            # actually ends turns inside the window and the env gets to
+            # inject observations; math keeps the real EOS contract
+            eos_token_ids=(
+                [tokenizer.eos_token_id] if config.env == "math"
+                else list(range(2, TINY.vocab_size, 2))
+            ),
+            pad_token_id=tokenizer.pad_token_id,
+            page_size=8, max_concurrent_rows=4,
+            scheduler="refill" if config.continuous_batching else "static",
+            continuous_admission=config.continuous_admission,
+            decode_chunk=4,
+            lora_scale=lora_scale(config.max_lora_rank, config.lora_alpha),
+            capture_logprobs=config.clip_ratio > 0.0,
+            autotune=config.autotune,
+            plan_db=config.plan_db,
+        )
+    else:
+        engine = GenerationEngine(
+            TINY,
+            max_prompt_tokens=config.max_prompt_tokens,
+            max_new_tokens=config.max_new_tokens,
+            eos_token_ids=[tokenizer.eos_token_id],
+            pad_token_id=tokenizer.pad_token_id,
+            # behavior-logprob capture whenever the objective needs it, so
+            # --smoke composes with --clip_ratio / --rollout_mode async
+            capture_logprobs=config.clip_ratio > 0.0,
+            # honor --autotune/--plan-db in the smoke path too: "--autotune
+            # off skips the DB read entirely" must hold for every engine the
+            # CLI builds
+            autotune=config.autotune,
+            plan_db=config.plan_db,
+        )
     sink = MemorySink()
     from distrl_llm_tpu.parallel.mesh import build_role_meshes
 
