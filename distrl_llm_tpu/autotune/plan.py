@@ -53,6 +53,13 @@ KV_FORMATS = (None, "none", "int8")
 #: defaults, tools/autotune.py measure, microbench) — stored so a tuned
 #: "int4 base + int8 KV" serving stack is one DB entry, not a flag recipe.
 BASE_QUANTS = (None, "none", "int8", "int4")
+#: tiered KV prefix cache (ISSUE 18): "on" = cross-request radix prefix
+#: index + host-RAM spill store on the refill pool (paged_engine's
+#: prefix_cache kwarg); "off" pins it off; None = the engine default
+#: (off), so an empty DB keeps today's behavior byte-identically. Engines
+#: take ``prefix_cache=None`` → consult this field; an explicit True/False
+#: kwarg pins past any stored plan (the decode_scan_chunk convention).
+PREFIX_CACHES = (None, "off", "on")
 #: draft lengths beyond this waste verify width faster than they amortize
 #: weight reads (and the engine rejects them) — plan validation mirrors it
 MAX_SPEC_DRAFT_LEN = 16
@@ -133,6 +140,11 @@ class ExecutionPlan:
     # containers / "none" full-width; None = caller default. Consumed by
     # the weight-loading callers (bench/autotune), not the engines.
     base_quant: str | None = None
+    # tiered KV prefix cache (ISSUE 18): "on" arms the cross-request radix
+    # prefix index + host spill store on the refill pool (requires
+    # continuous admission — engines that can't host it drop a stored "on"
+    # with a warning); "off" pins it off; None = engine default (off).
+    prefix_cache: str | None = None
 
     def __post_init__(self):
         if self.decode_path not in DECODE_PATHS:
@@ -210,6 +222,11 @@ class ExecutionPlan:
             raise ValueError(
                 f"base_quant must be one of {BASE_QUANTS}, got "
                 f"{self.base_quant!r}"
+            )
+        if self.prefix_cache not in PREFIX_CACHES:
+            raise ValueError(
+                f"prefix_cache must be one of {PREFIX_CACHES}, got "
+                f"{self.prefix_cache!r}"
             )
 
     def replace(self, **kw) -> "ExecutionPlan":
@@ -328,6 +345,7 @@ def candidate_plans(
     cb_modes=(None,),
     kv_formats=(None,),
     base_quants=(None,),
+    prefix_caches=(None,),
 ) -> list[ExecutionPlan]:
     """Enumerate a candidate space for the tuner (cartesian product, with
     the always-meaningless combos dropped: a formulation override without a
@@ -367,21 +385,30 @@ def candidate_plans(
                                     for cb in cb_modes:
                                         if cb is not None and path == "dense":
                                             continue
-                                        for kvf in kv_formats:
-                                            for bq in base_quants:
-                                                for tp in top_p_impls:
-                                                    out.append(ExecutionPlan(
-                                                        decode_path=path,
-                                                        scan_chunk=chunk,
-                                                        cache_read_formulation=form,
-                                                        top_p_impl=tp,
-                                                        paged_kernel=pk,
-                                                        pages_per_block=ppb,
-                                                        spec_draft_len=sd,
-                                                        spec_drafter=drafter,
-                                                        spec_verify=sv,
-                                                        cb_mode=cb,
-                                                        kv_format=kvf,
-                                                        base_quant=bq,
-                                                    ))
+                                        for pc in prefix_caches:
+                                            # the radix cache rides the
+                                            # continuous-admission chain
+                                            # machinery (ISSUE 18)
+                                            if pc == "on" and cb != "continuous":
+                                                continue
+                                            if pc is not None and path == "dense":
+                                                continue
+                                            for kvf in kv_formats:
+                                                for bq in base_quants:
+                                                    for tp in top_p_impls:
+                                                        out.append(ExecutionPlan(
+                                                            decode_path=path,
+                                                            scan_chunk=chunk,
+                                                            cache_read_formulation=form,
+                                                            top_p_impl=tp,
+                                                            paged_kernel=pk,
+                                                            pages_per_block=ppb,
+                                                            spec_draft_len=sd,
+                                                            spec_drafter=drafter,
+                                                            spec_verify=sv,
+                                                            cb_mode=cb,
+                                                            kv_format=kvf,
+                                                            base_quant=bq,
+                                                            prefix_cache=pc,
+                                                        ))
     return out

@@ -323,6 +323,29 @@ class TrainConfig:
     # plan-DB-resolvable (a stored cb_mode="continuous" entry may enable
     # it; empty DB = historical fixed batches, byte-identical).
     continuous_admission: bool = False
+    # tiered KV cache, tier 1 (ISSUE 18): cross-request radix prefix index
+    # over the continuous-admission pool — any prompt sharing a cached
+    # prefix (multi-turn history, shared task preambles) aliases those
+    # pages and prefills ONLY its un-cached suffix, with unpinned cache
+    # nodes LRU-evicted under page pressure. Greedy outputs stay
+    # bit-identical to the cache-off engine (the warm suffix prefill runs
+    # the same packed attention numerics over the cached pages —
+    # tests/test_prefix_sharing.py pins it). None = plan-DB-resolvable
+    # (stored prefix_cache="on" enables; empty DB = off, byte-identical);
+    # an explicit bool — including False — pins past any stored plan.
+    # Requires continuous_admission and an unquantized KV pool.
+    prefix_cache: bool | None = None
+    # tiered KV cache, tier 2 (ISSUE 18): preempted chains spill their
+    # written KV pages to a host-RAM page store on a background thread and
+    # restore bit-exactly on resume (no recompute); idle cache nodes spill
+    # on eviction and page back in on the next radix hit. Explicit-only
+    # (never plan-resolved); requires prefix_cache; incompatible with
+    # spec_draft (speculative chains resume by recompute).
+    kv_spill: bool = False
+    # host page-store byte cap in MiB for kv_spill (0 = unbounded); the
+    # store LRU-drops whole payloads past the cap, and a dropped preempt
+    # payload falls back to the recompute resume path
+    kv_spill_host_mb: int = 0
     # speculative decoding for the paged refill engine: draft spec_draft
     # tokens per step and verify them in one forward (the verify attention
     # runs as ONE fused blocked kernel sweep — spec_verify); rejection
@@ -875,6 +898,38 @@ class TrainConfig:
                 "scheduler — set continuous_batching (and a "
                 "max_concurrent_sequences cap); they would be silently "
                 "ignored otherwise"
+            )
+        # dead-flag policy for the tiered KV cache (ISSUE 18): tier 1
+        # aliases cached chains out of the continuous-admission pool, tier 2
+        # spills through tier 1's host store — surface dead wiring here
+        # rather than letting the engine raise mid-run
+        if self.prefix_cache and not self.continuous_admission:
+            raise ValueError(
+                "prefix_cache (the radix KV cache) aliases cached prompt "
+                "chains out of the continuous-admission pool — set "
+                "continuous_admission (it would be a dead flag otherwise)"
+            )
+        if self.prefix_cache and self.kv_cache_quant == "int8":
+            raise ValueError(
+                "prefix_cache requires a lossless KV pool: int8 pages "
+                "cannot reproduce the cold prefill's attention inputs "
+                "bit-exactly — drop kv_cache_quant or prefix_cache"
+            )
+        if self.kv_spill and not self.prefix_cache:
+            raise ValueError(
+                "kv_spill parks KV pages through the tiered cache's host "
+                "store — it requires prefix_cache"
+            )
+        if self.kv_spill and self.spec_draft:
+            raise ValueError(
+                "kv_spill restores raw decode cursors the speculative "
+                "scheduler does not expose — preempted speculative chains "
+                "already resume by recompute; drop kv_spill or spec_draft"
+            )
+        if self.kv_spill_host_mb and not self.kv_spill:
+            raise ValueError(
+                "kv_spill_host_mb caps the kv_spill host store — set "
+                "kv_spill (it would be a dead knob otherwise)"
             )
         # Pluggable environments (ISSUE 17). Import here, not at module
         # top: config must stay importable without pulling the env package

@@ -63,6 +63,9 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                  spec_verify: str | None = None, spec_adapt: bool = False,
                  prefix_sharing: bool = False,
                  continuous_admission: bool = False,
+                 prefix_cache: bool | None = None,
+                 kv_spill: bool = False,
+                 kv_spill_host_mb: int = 0,
                  gpu_usage: float = 0.0,
                  budget_batch: int = 0, scan_chunk: int | None = None,
                  autotune: bool = True, plan_db: str | None = None,
@@ -166,6 +169,15 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
             kwargs["prefix_sharing"] = True
         if continuous_admission:
             kwargs["continuous_admission"] = True
+        # tiered KV cache (ISSUE 18), trainer convention: None stays
+        # plan-DB-resolvable; an explicit bool — including --prefix-cache
+        # off — pins past any stored plan. kv_spill is explicit-only.
+        if prefix_cache is not None:
+            kwargs["prefix_cache"] = prefix_cache
+        if kv_spill:
+            kwargs["kv_spill"] = True
+            if kv_spill_host_mb:
+                kwargs["kv_spill_host_mb"] = kv_spill_host_mb
         if gpu_usage > 0:
             # --actor-gpu-usage → KV page budget, same contract as the
             # trainer's local engine (engine/budget.py)
@@ -199,6 +211,9 @@ def _init_engine(model: str, max_prompt_tokens: int, max_new_tokens: int,
                 # pool math (chains move into the pool); a plan-DB-enabled
                 # continuous run surfaces as the engine's pool-floor error
                 continuous=continuous_admission,
+                # only an explicit --prefix-cache on bumps the floor; a
+                # plan-resolved cache rides the refill slack instead
+                prefix_cache=bool(prefix_cache),
             )
     else:
         engine_cls = GenerationEngine
@@ -572,6 +587,28 @@ def main(argv: list[str] | None = None) -> None:
                              "episode batch; implies --prefix-sharing "
                              "(requires --scheduler refill). Unset leaves "
                              "this host's autotune plan DB in charge")
+    parser.add_argument("--prefix-cache", choices=("on", "off"),
+                        default=None,
+                        help="tiered KV cache tier 1 (ISSUE 18): "
+                             "cross-request radix prefix index — warm "
+                             "prompts alias cached pages and prefill only "
+                             "their un-cached suffix, bit-identically to "
+                             "cache-off (requires --continuous-admission "
+                             "and an unquantized pool). Explicit on/off "
+                             "pins past this host's plan DB; unset leaves "
+                             "the DB in charge")
+    parser.add_argument("--kv-spill", action="store_true",
+                        help="tiered KV cache tier 2 (ISSUE 18): "
+                             "preempted chains spill written KV pages to "
+                             "a host-RAM store and restore bit-exactly on "
+                             "resume instead of recomputing (requires "
+                             "--prefix-cache on; incompatible with "
+                             "--spec-draft)")
+    parser.add_argument("--kv-spill-host-mb", type=int, default=0,
+                        help="host page-store byte cap in MiB for "
+                             "--kv-spill (0 = unbounded); payloads LRU-"
+                             "drop past the cap and fall back to the "
+                             "recompute resume")
     parser.add_argument("--serving-obs", dest="serving_obs",
                         action="store_true",
                         help="request-level serving ledger (ISSUE 13): "
@@ -778,6 +815,33 @@ def main(argv: list[str] | None = None) -> None:
             "--scheduler refill requires --max-concurrent-sequences "
             "(the decode slot count)"
         )
+    # tiered KV cache (ISSUE 18), driver-parity dead-flag policy
+    if args.prefix_cache == "on" and not args.continuous_admission:
+        parser.error(
+            "--prefix-cache on aliases cached prompt chains out of the "
+            "continuous-admission pool — add --continuous-admission"
+        )
+    if args.prefix_cache == "on" and args.kv_quant == "int8":
+        parser.error(
+            "--prefix-cache on requires a lossless KV pool: int8 pages "
+            "cannot reproduce the cold prefill's attention inputs "
+            "bit-exactly — drop --kv-quant int8 or the cache"
+        )
+    if args.kv_spill and args.prefix_cache != "on":
+        parser.error(
+            "--kv-spill parks KV pages through the tiered cache's host "
+            "store — it requires --prefix-cache on"
+        )
+    if args.kv_spill and args.spec_draft:
+        parser.error(
+            "--kv-spill restores raw decode cursors the speculative "
+            "scheduler does not expose — drop --kv-spill or --spec-draft"
+        )
+    if args.kv_spill_host_mb and not args.kv_spill:
+        parser.error(
+            "--kv-spill-host-mb caps the --kv-spill host store — it "
+            "would be a dead knob without it"
+        )
     if args.serving_dir and not args.serving_obs:
         args.serving_obs = True  # an output directory is an unambiguous ask
     if args.serving_obs and args.scheduler != "refill":
@@ -854,6 +918,12 @@ def main(argv: list[str] | None = None) -> None:
             spec_verify=args.spec_verify, spec_adapt=args.spec_adapt,
             prefix_sharing=args.prefix_sharing,
             continuous_admission=args.continuous_admission,
+            prefix_cache=(
+                None if args.prefix_cache is None
+                else args.prefix_cache == "on"
+            ),
+            kv_spill=args.kv_spill,
+            kv_spill_host_mb=args.kv_spill_host_mb,
             gpu_usage=args.actor_gpu_usage, budget_batch=args.budget_batch,
             scan_chunk=args.decode_scan_chunk,
             autotune=args.autotune == "on", plan_db=args.plan_db,

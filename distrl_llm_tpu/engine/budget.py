@@ -73,6 +73,7 @@ def kv_pool_pages(
     spec_draft: int = 0,
     hbm_bytes: int | None = None,
     continuous: bool = False,
+    prefix_cache: bool = False,
 ) -> int:
     """Pages available to the refill decode pool under ``gpu_usage``.
 
@@ -98,6 +99,12 @@ def kv_pool_pages(
     private_pages = 1 + pages_per_seq(max_new_tokens + max(spec_draft, 0),
                                       page_size)
     floor = 1 + private_pages + (prompt_pages if continuous else 0)
+    if prefix_cache:
+        # tiered KV cache (ISSUE 18): warm radix-cache pages are resident
+        # in the SAME pool, so the floor carries one extra prompt chain —
+        # a clamped budget still leaves the cache able to keep at least one
+        # cached prefix resident next to the serial-decode minimum
+        floor += prompt_pages
     if pool < floor:
         log.warning(
             "actor_gpu_usage=%.2f leaves %d KV pages (< single-sequence "

@@ -665,6 +665,118 @@ def _turn_resume_fixup(params, lora, state: _RefillState, slot, obs_tok,
     )
 
 
+def _gather_page_tiles(k_pages, v_pages, src):
+    """One physical page's KV tiles across all layers — [K, ps, hd] (or
+    int8 weight+scales) per layer — as INDEPENDENT device buffers: jit
+    outputs, never views into the state pools, so a background spill
+    thread may hold them across the decode loop's donated-state dispatches
+    (ISSUE 18 tier-2 transport; the PR 15 quant idiom — quantized pools
+    gather weight + scales alike, so the round-trip is a pure memcpy and
+    bit-exact by construction)."""
+    from distrl_llm_tpu.ops.paged import is_quantized_pages
+
+    def take(pages):
+        if is_quantized_pages(pages):
+            return type(pages)(
+                weight=pages.weight[:, src], scales=pages.scales[:, src]
+            )
+        return pages[:, src]
+
+    return (
+        tuple(take(p) for p in k_pages),
+        tuple(take(p) for p in v_pages),
+    )
+
+
+def _restore_page_tiles(state, k_tiles, v_tiles, dst):
+    """Scatter one parked page's tiles back into the live pools at page
+    ``dst`` (the `_cont_adopt` placement idiom, single-page edition)."""
+    from distrl_llm_tpu.ops.paged import is_quantized_pages
+
+    def put(pages, tile):
+        if is_quantized_pages(pages):
+            return type(pages)(
+                weight=pages.weight.at[:, dst].set(tile.weight),
+                scales=pages.scales.at[:, dst].set(tile.scales),
+            )
+        return pages.at[:, dst].set(tile)
+
+    return state._replace(
+        k_pages=tuple(put(p, t) for p, t in zip(state.k_pages, k_tiles)),
+        v_pages=tuple(put(p, t) for p, t in zip(state.v_pages, v_tiles)),
+    )
+
+
+def _warm_prefill(params, lora, state: _RefillState, row_ext, suffix_tok,
+                  suffix_len, start, logits_buf, g, *, cfg: ModelConfig,
+                  page_size: int, lora_scale: float, pad_id: int):
+    """Suffix-only group prefill through a radix-cache hit (ISSUE 18): the
+    prompt's first ``start`` tokens are already resident in cached chain
+    pages, so this forwards only the un-cached suffix — KV writes land in
+    the chain's FRESH pages (the hit is capped below the last token, so no
+    suffix position ever writes into a cached page) and the group's
+    sampling logits come off the suffix's last real token.
+
+    Runs the ``paged_prefix`` forward mode: suffix KV is written to pages,
+    then attention goes through the SAME packed ``attention`` front door
+    the cold `_paged_prefill` uses, over the row's dense-gathered packed
+    window in compute dtype — so a warm group's logits and suffix KV are
+    bit-identical to the cold prefill's (cached pages hold exact ``astype``
+    round-trips of the in-flight k/v the cold path attended over).
+
+    ``row_ext`` is the chain's table row padded with the scratch page plus
+    ONE extra trailing scratch column: masked padding lanes clamp their
+    positions to ``prompt_pages * page_size``, whose block index is exactly
+    that extra column — their garbage KV lands on scratch, never in a page
+    another admission could alias (the `_turn_resume_fixup` clamp
+    discipline, aimed at scratch instead of the write ceiling because
+    cached pages are immutable cross-group state). The ``paged_prefix``
+    gather drops that trailing column, so the attention key window is
+    exactly the cold packed width."""
+    s = state
+    t = suffix_tok.shape[0]
+    prompt_pages = row_ext.shape[0] - 1
+    steps = jnp.arange(t, dtype=jnp.int32)
+    valid_vec = steps < suffix_len
+    valid = valid_vec.astype(jnp.int32)[None, :]
+    cache = {
+        "k": s.k_pages, "v": s.v_pages,
+        "lengths": start[None],
+        "page_indices": row_ext[None],
+    }
+    positions = jnp.where(
+        valid_vec, start + steps, prompt_pages * page_size
+    )[None, :]
+    suffix_tok = jnp.where(valid_vec, suffix_tok, pad_id)
+    logits, cache = forward(
+        params, cfg, suffix_tok[None],
+        attention_mask=valid, positions=positions,
+        lora=lora, lora_scale=lora_scale,
+        kv_cache=cache, page_size=page_size, paged_prefix=True,
+        logits_positions=jnp.maximum(suffix_len - 1, 0)[None],
+    )
+    s = s._replace(k_pages=cache["k"], v_pages=cache["v"])
+    return s, logits_buf.at[g].set(logits[0, 0])
+
+
+def _spill_resume_fixup(state: _RefillState, slot, logits_row, prefix_len,
+                        real_len_c):
+    """Cursor-only resume for a candidate whose KV pages were restored from
+    the host spill store (ISSUE 18 tier 2): the preceding `_refill_admit`
+    seated the slot with prompt logits and zeroed cursors, and the page
+    restores already re-materialized the generated prefix's KV bit-exactly
+    — so unlike `_resume_fixup` there is nothing to recompute, only the
+    slot's logits row and cursors to fast-forward. The out/logps/lengths
+    buffers are candidate-indexed and were never erased by preemption."""
+    s = state
+    return s._replace(
+        done=s.done.at[slot].set(False),
+        logits=s.logits.at[slot].set(logits_row),
+        gen_lengths=s.gen_lengths.at[slot].set(prefix_len),
+        seq_lengths=s.seq_lengths.at[slot].set(real_len_c + prefix_len),
+    )
+
+
 def _refill_decode_step(params, lora, state: _RefillState, rng,
                         *, cfg: ModelConfig, page_size: int, eos_ids,
                         pad_id: int, temperature, top_p, lora_scale: float,
@@ -1125,6 +1237,25 @@ class PagedGenerationEngine(LoraMailbox):
         # plan DB (cb_mode field; empty DB = off); an explicit bool —
         # including False — always wins (the decode_scan_chunk convention).
         continuous_admission: bool | None = None,
+        # tiered KV cache (ISSUE 18). Tier 1: a cross-request radix prefix
+        # index over the continuous-admission pool — admissions
+        # longest-prefix-match their token ids against previously finished
+        # chains, alias every matched full page refcounted, and prefill
+        # only the un-cached suffix (SGLang RadixAttention-style; multi-turn
+        # re-admission of a conversation's history costs zero prefill).
+        # None = consult the autotune plan DB (prefix_cache field; empty
+        # DB = off); an explicit bool — including False — always wins (the
+        # decode_scan_chunk convention). Requires continuous admission.
+        prefix_cache: bool | None = None,
+        # Tier 2: evicted cache nodes and preempted chains park their KV
+        # pages (int8 payload + scales travel as-is — the PR 15 quant
+        # transport idiom) in a host-RAM page store on a background thread
+        # and page back in on re-match/resume, bit-exact. Explicit-only:
+        # host-memory geometry is a deployment fact, not a measured plan
+        # field. Requires prefix_cache; speculative chains resume by
+        # recompute instead (their draft state is not spillable).
+        kv_spill: bool = False,
+        kv_spill_host_mb: int = 0,  # host store byte cap; 0 = unbounded
         # speculative decoding (engine/speculative.py). None = consult the
         # autotune plan DB (spec_draft_len/spec_ngram_k/spec_drafter/
         # spec_verify plan fields; empty DB falls back to the historical
@@ -1206,6 +1337,14 @@ class PagedGenerationEngine(LoraMailbox):
         if kv_quant is not None:
             # explicit "none" is a real pin (the int8-default A/B control)
             requested["kv_format"] = kv_quant
+        if prefix_cache is not None:
+            # explicit False pins "off" past any stored plan (the cache-off
+            # A/B control must never be silently re-armed by the DB)
+            requested["prefix_cache"] = "on" if prefix_cache else "off"
+        if kv_spill_host_mb < 0:
+            raise ValueError(
+                f"kv_spill_host_mb must be >= 0, got {kv_spill_host_mb}"
+            )
         # the paged_kernel plan field and the paged_impl kwarg name the same
         # choice: any explicit non-"auto" kwarg wins over the DB ("kernel"/
         # "reference" have no plan spelling, so they pin the field to None —
@@ -1359,6 +1498,73 @@ class PagedGenerationEngine(LoraMailbox):
             )
         )
         self.last_cb_mode: str | None = None
+        # post-resolution KV format (explicit kwarg already won per-field
+        # via the requested dict; unset adopts the stored plan, default
+        # "none" — the historical behavior, byte-identical on an empty DB)
+        kv_quant = kv_quant if kv_quant is not None else (
+            plan.kv_format or "none"
+        )
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
+        self.kv_quant = kv_quant
+        # ---- tiered KV cache (ISSUE 18) resolution: tier 1 aliases cached
+        # chains out of the continuous-admission pool, so it inherits the
+        # cb_mode policy verbatim — explicit wins (including False), a
+        # stored plan the engine can't host degrades with a warning, the
+        # same value passed explicitly raises
+        pc_explicit = prefix_cache is not None
+        pcache = (
+            prefix_cache if pc_explicit else plan.prefix_cache == "on"
+        )
+        if pcache and kv_quant == "int8":
+            # int8 pages are QUANTIZED rewrites of the in-flight k/v, so a
+            # warm suffix prefill over cached pages could never be
+            # bit-identical to the packed cold prefill (which attends the
+            # un-quantized in-flight values) — the cache's core contract
+            if pc_explicit:
+                raise ValueError(
+                    "prefix_cache requires a lossless KV pool "
+                    "(kv_quant='none'): int8 pages cannot reproduce the "
+                    "cold prefill's attention inputs bit-exactly"
+                )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "autotune: stored plan wants the radix prefix cache "
+                "(prefix_cache='on') but the KV pool is int8-quantized — "
+                "ignoring the plan's prefix_cache"
+            )
+            pcache = False
+        if pcache and not cont:
+            if pc_explicit:
+                raise ValueError(
+                    "prefix_cache aliases cached prompt chains out of the "
+                    "continuous-admission pool — set "
+                    "continuous_admission=True (refill scheduler with "
+                    "max_concurrent_rows)"
+                )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "autotune: stored plan wants the radix prefix cache "
+                "(prefix_cache='on') but this engine does not run "
+                "continuous admission — ignoring the plan's prefix_cache"
+            )
+            pcache = False
+        self.prefix_cache = bool(pcache)
+        if kv_spill and not self.prefix_cache:
+            raise ValueError(
+                "kv_spill parks KV pages through the tiered cache's host "
+                "store — it requires prefix_cache=True"
+            )
+        if kv_spill and spec_draft:
+            raise ValueError(
+                "kv_spill restores raw decode cursors the speculative "
+                "scheduler does not expose (draft history, acceptance "
+                "state) — preempted speculative chains already resume by "
+                "recompute; drop kv_spill or spec_draft"
+            )
+        self.kv_spill = bool(kv_spill)
         # honesty: the record in resolved_plan must describe what this
         # engine actually is (generate() routes on spec_draft/scheduler,
         # not on the plan record), including when the decode_path came
@@ -1376,18 +1582,13 @@ class PagedGenerationEngine(LoraMailbox):
                     "continuous" if cont
                     else ("batch" if plan.cb_mode is not None else None)
                 ),
+                prefix_cache=(
+                    "on" if pcache
+                    else ("off" if plan.prefix_cache is not None else None)
+                ),
             )
         )
         self.scheduler = scheduler
-        # post-resolution KV format (explicit kwarg already won per-field
-        # via the requested dict; unset adopts the stored plan, default
-        # "none" — the historical behavior, byte-identical on an empty DB)
-        kv_quant = kv_quant if kv_quant is not None else (
-            plan.kv_format or "none"
-        )
-        if kv_quant not in ("none", "int8"):
-            raise ValueError(f"kv_quant must be none/int8, got {kv_quant!r}")
-        self.kv_quant = kv_quant
         self.cfg = cfg
         self.max_prompt_tokens = max_prompt_tokens
         self.max_new_tokens = max_new_tokens
@@ -1411,17 +1612,23 @@ class PagedGenerationEngine(LoraMailbox):
         # pages and preempt-by-recompute under pressure
         # continuous admission allocates prompt chains FROM the pool, so the
         # single-sequence floor additionally carries one prompt chain
+        # the tiered cache keeps warm chains resident in the SAME pool, so
+        # its floor carries one extra prompt chain (mirrors budget.py's
+        # kv_pool_pages(prefix_cache=True) clamp)
         pool_floor = 1 + self.private_pages + (
             self.prompt_pages if self.continuous_admission else 0
-        )
+        ) + (self.prompt_pages if self.prefix_cache else 0)
         if max_kv_pages and max_kv_pages < pool_floor:
             raise ValueError(
                 f"max_kv_pages={max_kv_pages} cannot fit one sequence "
                 f"(need >= {pool_floor}: scratch + "
                 f"{self.private_pages} private pages"
                 + (f" + {self.prompt_pages} prompt-chain pages for "
-                   f"continuous admission)" if self.continuous_admission
-                   else ")")
+                   f"continuous admission" if self.continuous_admission
+                   else "")
+                + (f" + {self.prompt_pages} resident-cache pages for "
+                   f"prefix_cache" if self.prefix_cache else "")
+                + ")"
             )
         self.max_kv_pages = max_kv_pages
         self.last_pool_stats: dict | None = None
@@ -1470,6 +1677,28 @@ class PagedGenerationEngine(LoraMailbox):
         # continuous admission builds per-layer 0-page tiles in this dtype
         # and reuses the jitted prefill at [1, P]
         self.cache_dtype = cache_dtype
+        # tiered-KV engine state (ISSUE 18): the radix index and host page
+        # store are ENGINE-owned — they outlive the per-round PagePool, so
+        # warm prefixes survive into the next round's admissions; each
+        # round's pool attaches to them and round end flushes residency to
+        # the store (page ids are round-scoped, payloads are not). The
+        # adapter identity guard invalidates the WHOLE cache whenever the
+        # LoRA the KV was computed under changes (cached KV is only exact
+        # for the adapter that wrote it — a strong reference keeps the
+        # identity test sound against id() reuse).
+        if self.prefix_cache:
+            from distrl_llm_tpu.engine.page_pool import (
+                HostPageStore, RadixCache,
+            )
+
+            self._radix = RadixCache(page_size)
+            self._kv_store = HostPageStore(
+                max_bytes=kv_spill_host_mb * 2**20
+            )
+        else:
+            self._radix = None
+            self._kv_store = None
+        self._cache_lora_ref: Any = None
 
         self._prefill = jax.jit(
             partial(
@@ -1539,6 +1768,24 @@ class PagedGenerationEngine(LoraMailbox):
             ),
             donate_argnames=("state",),
             static_argnames=("max_steps",),
+        )
+        # tiered-KV programs (ISSUE 18): warm suffix prefill through a
+        # radix hit, page spill/restore transport, spill-resume cursor
+        # fast-forward. _gather_page deliberately does NOT donate — its
+        # outputs must be independent buffers a host thread can park.
+        self._warm_prefill = jax.jit(
+            partial(
+                _warm_prefill, cfg=cfg, page_size=page_size,
+                lora_scale=lora_scale, pad_id=self.pad_id,
+            ),
+            donate_argnames=("state", "logits_buf"),
+        )
+        self._gather_page = jax.jit(_gather_page_tiles)
+        self._restore_page = jax.jit(
+            _restore_page_tiles, donate_argnames=("state",),
+        )
+        self._spill_fixup = jax.jit(
+            _spill_resume_fixup, donate_argnames=("state",),
         )
         self._refill_step = jax.jit(
             partial(
@@ -1903,11 +2150,46 @@ class PagedGenerationEngine(LoraMailbox):
             else worst_pool
         )
         budgeted = pool_pages < worst_pool
+        # tiered KV cache (ISSUE 18): only a continuous-admission round can
+        # host it (cached chains are pool pages) — __init__ enforces the
+        # pairing, this flag just names the round-local arming
+        cache_on = self.prefix_cache and continuous
         pool = PagePool(
             first_page=shared_static, n_pages=pool_pages, r_slots=r_slots,
             width=width, page_size=self.page_size,
             prompt_pages=self.prompt_pages, prefix_sharing=sharing,
+            radix=self._radix if cache_on else None,
+            store=self._kv_store if cache_on else None,
         )
+        # round-local cache bookkeeping (all inert when the cache is off):
+        # un-padded prompt token rows (radix keys + cache_chain retirement),
+        # per-group hit sizes (serving-ledger provenance), and the round's
+        # restore-latency samples (spill_restore_ms_p50)
+        group_hit_tok: dict[int, int] = {}
+        restore_ms: list[float] = []
+        # live ("preempt", cand) host-store keys: candidate ids are round-
+        # scoped, so any payload not consumed by a resume is dropped at
+        # round end rather than leaking into the engine-lifetime store
+        spilled_keys: set = set()
+        if cache_on:
+            # adapter identity guard: cached KV is only exact under the
+            # adapter that wrote it — any change (each training round hands
+            # the engine a new LoRA object) drops the whole cache. The
+            # strong reference held in __init__ keeps `is` sound.
+            if self._cache_lora_ref is not lora:
+                pool.invalidate_cache()
+                self._cache_lora_ref = lora
+            real_toks = [
+                np.asarray(prompt_ids[g])[
+                    np.asarray(prompt_mask[g]) > 0
+                ].astype(np.int32)
+                for g in range(b)
+            ]
+            radix_snap0 = self._radix.snapshot()
+        # cache writes stay legal until a mid-round weight swap is consumed
+        # (chains prefilled before the swap must not enter the cache under
+        # the post-swap adapter identity)
+        cache_write = [cache_on]
         if sharing and not continuous:
             # adopt the monolithic prefill's static region as refcounted
             # prefix chains: ceil(rl/ps) live pages per prompt (full pages
@@ -2052,6 +2334,20 @@ class PagedGenerationEngine(LoraMailbox):
                 # horizon, never past the sequence's hard ceiling
                 return min(rl + plen + lag_tokens, rl + max_steps)
 
+        if cache_on:
+            # spill transport: MAIN-thread device gather into independent
+            # buffers (jit outputs, never views into the donated state
+            # pools) — the host store's worker thread only converts them.
+            # The closure reads the loop's CURRENT `state` binding: every
+            # pool path that can evict (alloc/admit/ensure/note_write) runs
+            # between dispatches, while the binding holds live buffers.
+            def _spill_payload(page):
+                return self._gather_page(
+                    state.k_pages, state.v_pages,
+                    jnp.asarray(page, jnp.int32),
+                )
+
+            pool.spill_fn = _spill_payload
         # measured bytes/token source (ISSUE 15; DISTRL_MEASURE_COST=1
         # only): file the slot-step program's XLA cost_analysis once
         from distrl_llm_tpu import obs as _obs
@@ -2178,9 +2474,18 @@ class PagedGenerationEngine(LoraMailbox):
                 g = c // n
                 group_left[g] -= 1
                 if group_left[g] == 0 and g in pool.chains:
-                    # refcount hold drops; the chain pages free as the last
-                    # slot references release (CoW release discipline)
-                    pool.drop_prefix(g)
+                    if cache_write[0]:
+                        # tiered cache (ISSUE 18): the finished chain's full
+                        # pages become radix inventory instead of freeing —
+                        # the next admission sharing this prefix aliases
+                        # them with zero prefill. The mutable partial tail
+                        # derefs as before; chain holds transfer in place.
+                        pool.cache_chain(g, real_toks[g])
+                    else:
+                        # refcount hold drops; the chain pages free as the
+                        # last slot references release (CoW release
+                        # discipline)
+                        pool.drop_prefix(g)
 
         # graftcheck: hot-region cont-admission
         def admit_group(g: int) -> bool:
@@ -2191,9 +2496,32 @@ class PagedGenerationEngine(LoraMailbox):
             nonlocal state, groups_prefilled, t_prefill, boundary_admits
             rl = int(real_len_h[g])
             n_chain = max(-(-rl // ps), 1)
+            resident: list = []
+            if cache_on:
+                # tier-1 longest-prefix match (ISSUE 18), restoring any
+                # spilled matched pages from the host store first
+                nodes, _hit = pool.radix_match(real_toks[g])
+                if nodes:
+                    resident, uploads = pool.restore_nodes(nodes)
+                    if uploads:
+                        t0r = time.perf_counter()
+                        for _node, page, payload in uploads:
+                            k_t, v_t = payload
+                            state = self._restore_page(
+                                state, k_t, v_t,
+                                jnp.asarray(page, jnp.int32),
+                            )
+                        jax.block_until_ready(state.k_pages[0])
+                        ms = (time.perf_counter() - t0r) * 1e3
+                        pool.note_restore_ms(ms)
+                        restore_ms.append(ms)
+            if resident:
+                return admit_group_warm(g, resident, rl, n_chain)
             chain = pool.alloc_prefix(g, n_chain, rl // ps)
             if chain is None:
                 return False
+            if cache_on:
+                group_hit_tok[g] = 0
             t0 = time.perf_counter()
             with telemetry.span("engine/prefill", rows=1, tokens=rl):
                 k_t, v_t, logits_g, _rl = self._prefill(
@@ -2214,6 +2542,49 @@ class PagedGenerationEngine(LoraMailbox):
             # already queued — but decode absorbing prefill would bias the
             # fixed-vs-continuous A/B in the new mode's favor
             jax.block_until_ready(logits_cell[0])
+            t_prefill += time.perf_counter() - t0
+            groups_prefilled += 1
+            boundary_admits += 1
+            telemetry.counter_add(ENGINE_CONT_PREFILLS)
+            if sl is not None:
+                sl.on_prefill_done(suid.get(g))
+            pending.extend(range(g * n, (g + 1) * n))
+            return True
+
+        def admit_group_warm(g: int, resident, rl: int,
+                             n_chain: int) -> bool:
+            """Radix-hit admission (ISSUE 18): alias the matched resident
+            pages refcounted into group ``g``'s chain and forward ONLY the
+            un-cached suffix — its KV writes land in the chain's fresh
+            pages (the match is capped below the last token, so no write
+            ever touches a cached page) and the group's sampling logits
+            come off the suffix's last real token through the chunked
+            paged forward, exactly the `_resume_fixup` shape."""
+            nonlocal state, groups_prefilled, t_prefill, boundary_admits
+            chain = pool.admit_cached(g, resident, n_chain, rl // ps)
+            if chain is None:
+                return False
+            hit = len(resident) * ps
+            group_hit_tok[g] = hit
+            suffix = real_toks[g][hit:rl]
+            t0 = time.perf_counter()
+            with telemetry.span("engine/prefill", rows=1,
+                                tokens=rl - hit):
+                suf = np.full(self.prompt_pages * ps, self.pad_id,
+                              np.int32)
+                suf[:suffix.size] = suffix
+                row_ext = np.full(self.prompt_pages + 1, pool.scratch,
+                                  np.int32)
+                row_ext[:n_chain] = chain
+                state, logits_cell[0] = self._warm_prefill(
+                    params, lora_cell[0], state, jnp.asarray(row_ext),
+                    jnp.asarray(suf), jnp.asarray(suffix.size, jnp.int32),
+                    jnp.asarray(hit, jnp.int32), logits_cell[0],
+                    jnp.asarray(g, jnp.int32),
+                )
+                # same timer discipline as the cold adopt above: block so
+                # the suffix forward is attributed to prefill, not decode
+                jax.block_until_ready(logits_cell[0])
             t_prefill += time.perf_counter() - t0
             groups_prefilled += 1
             boundary_admits += 1
@@ -2318,6 +2689,7 @@ class PagedGenerationEngine(LoraMailbox):
                         shared_pages=int(alias["shared_pages"]),
                         cow=bool(alias["cow_queued"]),
                         backfill=dispatched > 0, resumed=bool(plen),
+                        prefix_hit_tokens=group_hit_tok.get(pr, 0),
                     )
                 if sharing:
                     src = pool.take_copy(int(s_i))
@@ -2341,6 +2713,48 @@ class PagedGenerationEngine(LoraMailbox):
                 # their first decode step (and before any resume fixup)
                 s = s._replace(page_indices=jnp.asarray(pool.table))
                 for s_i, prefix, plen, rl, pr, logp0 in resumes:
+                    if self.kv_spill:
+                        payload = self._kv_store.get(
+                            ("preempt", int(new_cand[s_i]))
+                        )
+                        if payload is not None:
+                            # tier-2 resume (ISSUE 18): the preempt spill
+                            # parked the slot's written pages + logits row
+                            # host-side — reload them bit-exactly into the
+                            # freshly granted pages (same block order) and
+                            # fast-forward the cursors; nothing recomputes.
+                            # Payload aged out of the store's byte cap →
+                            # fall through to the recompute fixup below.
+                            t0r = time.perf_counter()
+                            owned = pool.owned[int(s_i)]
+                            nv = int(payload["n_valid"])
+                            for pg, (k_t, v_t) in zip(
+                                owned[:nv], payload["tiles"]
+                            ):
+                                s = self._restore_page(
+                                    s, k_t, v_t,
+                                    jnp.asarray(pg, jnp.int32),
+                                )
+                            s = self._spill_fixup(
+                                s, jnp.asarray(s_i, jnp.int32),
+                                jnp.asarray(payload["logits"]),
+                                jnp.asarray(plen, jnp.int32),
+                                jnp.asarray(rl, jnp.int32),
+                            )
+                            jax.block_until_ready(s.logits)
+                            ms = (time.perf_counter() - t0r) * 1e3
+                            pool.note_restore_ms(ms)
+                            pool.note_restored(nv)
+                            restore_ms.append(ms)
+                            # the restored content goes stale the moment
+                            # decode continues — drop the host copy
+                            self._kv_store.drop(
+                                ("preempt", int(new_cand[s_i]))
+                            )
+                            spilled_keys.discard(
+                                ("preempt", int(new_cand[s_i]))
+                            )
+                            continue
                     if self.spec_draft:
                         # host-rebuilt n-gram buffer: packed prompt + prefix
                         buf_w = s.seq_buf.shape[1]
@@ -2388,6 +2802,36 @@ class PagedGenerationEngine(LoraMailbox):
                         float(np.asarray(state.logps_buf[c, 0]))
                         if self.capture_logprobs else 0.0
                     )
+                    if self.kv_spill:
+                        # tier-2 spill (ISSUE 18): park the slot's WRITTEN
+                        # pages (its CoW tail + decode pages, block order)
+                        # and its logits row host-side BEFORE releasing the
+                        # pages — resume becomes a bit-exact page reload
+                        # instead of a recompute forward. Gathers dispatch
+                        # here on the main thread; the store thread only
+                        # converts the finished buffers.
+                        rl_p = int(real_len_h[c // n])
+                        owned = pool.owned[s_i]
+                        n_valid = min(
+                            (rl_p + plen - 1) // ps - rl_p // ps + 1,
+                            len(owned),
+                        )
+                        self._kv_store.put(
+                            ("preempt", c),
+                            {
+                                "tiles": [
+                                    self._gather_page(
+                                        state.k_pages, state.v_pages,
+                                        jnp.asarray(pg, jnp.int32),
+                                    )
+                                    for pg in owned[:n_valid]
+                                ],
+                                "logits": state.logits[s_i],
+                                "n_valid": np.int64(n_valid),
+                            },
+                        )
+                        pool.note_spilled(n_valid)
+                        spilled_keys.add(("preempt", c))
                     pending.appendleft((c, prefix, plen, logp0))
                 else:
                     pending.appendleft(c)
@@ -2546,6 +2990,16 @@ class PagedGenerationEngine(LoraMailbox):
                     # superseded-adapter bookkeeping (value + version)
                     drafter_cell[0] = self._prev_lora
                     drafter_version = self._prev_lora_version
+                if cache_on:
+                    # consumed in-flight weight swap: KV cached under the
+                    # superseded adapter is no longer exact — drop the whole
+                    # cache and stop caching for the rest of the round
+                    # (chains prefilled pre-swap must not be retired into
+                    # the cache under the new identity). Already-admitted
+                    # chains keep decoding on their pre-swap KV, exactly
+                    # as the cache-off engine does.
+                    pool.invalidate_cache()
+                    cache_write[0] = False
                 if k_conf:
                     sig = _chunk_round_sig()
                     if sig != chunk_sig:
@@ -2822,6 +3276,19 @@ class PagedGenerationEngine(LoraMailbox):
             if c < total:
                 mark_finished(int(c))
         alive_h = int(np.asarray(state.alive_steps))
+        if cache_on:
+            # park every resident cached page host-side: device page ids
+            # are round-scoped, so the tree survives between rounds as a
+            # host-resident index and the next round restores matched
+            # prefixes from the store. Unconsumed preempt payloads drop
+            # (candidate ids are round-scoped too).
+            pool.flush_cache()
+            for key in spilled_keys:
+                self._kv_store.drop(key)
+            radix_snap1 = self._radix.snapshot()
+            radix_delta = {
+                k: radix_snap1[k] - radix_snap0[k] for k in radix_snap1
+            }
         self.last_pool_stats = {
             "pool_pages": pool_pages,
             "worst_case_pages": worst_pool,
@@ -2862,6 +3329,32 @@ class PagedGenerationEngine(LoraMailbox):
             "turn_resumes": turn_resumes if th is not None else None,
             "turn_prefill_saved_tokens": (
                 turn_saved if th is not None else None
+            ),
+            # tiered KV cache (ISSUE 18): per-round radix-counter deltas +
+            # this round's spill/restore latency (None on the cache-off
+            # control — the bench contract's honest-null discipline)
+            "prefix_cache": bool(cache_on),
+            "radix_hit_rate": (
+                round(
+                    radix_delta["hit_tok"]
+                    / max(radix_delta["lookup_tok"], 1), 4,
+                ) if cache_on else None
+            ),
+            "prefill_tok_saved": (
+                radix_delta["prefill_tok_saved"] if cache_on else None
+            ),
+            "radix_evictions": (
+                radix_delta["evictions"] if cache_on else None
+            ),
+            "spilled_pages": (
+                radix_delta["spilled_pages"] if cache_on else None
+            ),
+            "restored_pages": (
+                radix_delta["restored_pages"] if cache_on else None
+            ),
+            "spill_restore_ms_p50": (
+                round(float(np.percentile(restore_ms, 50)), 3)
+                if cache_on and restore_ms else None
             ),
         }
         if not finished.all():

@@ -75,6 +75,14 @@ class EnvRoundResult:
     turn_provenance: list[list[dict[str, Any]]]  # per candidate row
     stats: EnvRoundStats
     episodes: list[EpisodeState] = field(default_factory=list)
+    # per candidate row: the full conversation transcript in answer-token
+    # coordinates (policy spans + injected observations, up to the final
+    # cursor). A caller continuing the conversation in a LATER round
+    # composes its next prompt as ``prompt_ids + history[c]``; with the
+    # tiered KV cache on (ISSUE 18) the engine's admit-time radix match
+    # aliases every full page the retired conversation left behind, so
+    # re-admitting the history costs zero prefill for the cached prefix.
+    history: list[np.ndarray] = field(default_factory=list)
 
 
 class EnvRolloutDriver:
@@ -245,6 +253,18 @@ class EnvRolloutDriver:
 
         loss_mask = np.zeros((rows, width), dtype=np.int32)
         turns = np.zeros(rows, dtype=np.int32)
+        history: list[np.ndarray] = []
+        for c, ep in enumerate(self._episodes):
+            # transcript = everything up to the last turn's end (the final
+            # cursor is max(policy/env span ends) — lengths[c] can run past
+            # it when the engine decoded beyond the last consulted turn)
+            end = int(lengths[c])
+            for turn in ep.state.turns:
+                end = max(end, turn.policy_span[1])
+                if turn.env_span is not None:
+                    end = max(end, turn.env_span[1])
+            row = np.asarray(tokens[c])
+            history.append(row[: min(end, row.shape[0])].astype(np.int32))
         provenance: list[list[dict[str, Any]]] = []
         group_rewards: list[np.ndarray] = []
         for c, ep in enumerate(self._episodes):
@@ -292,4 +312,5 @@ class EnvRolloutDriver:
             turn_provenance=provenance,
             stats=stats,
             episodes=[ep.state for ep in self._episodes],
+            history=history,
         )

@@ -138,6 +138,7 @@ def _layer(
     paged_verify: bool = False,  # S>1 per-row draft-block decode (spec decode)
     paged_verify_impl: str = "fused",  # "fused" | "unrolled" verify sweep
     paged_chunked: bool = False,  # S>1 continuation (chunked) prefill
+    paged_prefix: bool = False,  # S>1 warm (radix-hit) suffix prefill
     lora_dropout: float = 0.0,
     dropout_rng: jax.Array | None = None,  # per-layer key (training only)
     cache_read_formulation: str = "dot",  # "mulred" inside scan-chunk bodies
@@ -193,6 +194,43 @@ def _layer(
                 gather_pages_dense(cache_v, page_indices),
                 paged_lengths, q_valid,
             )
+        elif paged_prefix:
+            # warm (radix-hit) suffix prefill: the row's first
+            # ``paged_lengths`` positions are already resident in cached
+            # pages; only the suffix re-forwards. Bit-identity with the
+            # packed cold prefill demands the SAME attention numerics
+            # (``attention_reference`` rounds probs to the value dtype
+            # before the PV product; ``chunked_context_attention`` keeps
+            # them f32 to match the decode op), so this branch writes the
+            # suffix KV to pages and then attends over the row's
+            # dense-gathered packed window in COMPUTE dtype through the
+            # same ``attention`` front door the cold path uses. Contract:
+            # ``page_indices`` carries ONE trailing scratch column (the
+            # engine's warm-admission row extension) — the gather drops it
+            # so the key window width equals the cold packed width.
+            from distrl_llm_tpu.ops.paged import gather_pages_dense
+
+            q_valid = key_valid[:, :s] if key_valid is not None else (
+                jnp.ones((b, s), jnp.int32)
+            )
+            cache_k = write_tokens_to_pages(
+                cache_k, k, paged_lengths, page_indices, page_size,
+                valid=q_valid > 0)
+            cache_v = write_tokens_to_pages(
+                cache_v, v, paged_lengths, page_indices, page_size,
+                valid=q_valid > 0)
+            ctx_k = gather_pages_dense(
+                cache_k, page_indices[:, :-1], dtype=q.dtype)
+            ctx_v = gather_pages_dense(
+                cache_v, page_indices[:, :-1], dtype=q.dtype)
+            # query i sits at global position lengths+i; causality over the
+            # packed window reproduces the cold mask rows for real lanes
+            # (padding lanes attend garbage, but their outputs land on the
+            # scratch page and the logits gather never reads them)
+            jpos = jnp.arange(ctx_k.shape[1])[None, None, None, :]
+            qpos = (paged_lengths[:, None]
+                    + jnp.arange(s, dtype=jnp.int32)[None, :])[:, None, :, None]
+            att = attention(q, ctx_k, ctx_v, jpos <= qpos, impl=attn_impl)
         elif paged_verify:
             # speculative-decode verify: S draft tokens extend each row's
             # sequence at its own per-row offset. QKV/MLP batch over the
@@ -308,6 +346,7 @@ def forward(
     paged_verify: bool = False,  # speculative-decode draft-block verify
     paged_verify_impl: str = "fused",  # verify sweep: "fused" | "unrolled"
     paged_chunked: bool = False,  # continuation (chunked) prefill over pages
+    paged_prefix: bool = False,  # warm (radix-hit) suffix prefill over pages
     lora_dropout: float = 0.0,  # peft-style adapter-input dropout (training)
     dropout_rng: jax.Array | None = None,
     skip_lm_head: bool = False,  # return final-norm hidden states, not logits
@@ -360,7 +399,7 @@ def forward(
     # DCE'd under jit, but eager/non-jit callers would pay it)
     needs_dense_mask = (
         (kv_cache is not None and not paged)
-        or (paged and s > 1 and not paged_chunked
+        or (paged and s > 1 and not paged_chunked and not paged_prefix
             and attn_impl not in ("ring", "ulysses", "flash", "splash"))
         or (kv_cache is None and attn_impl not in ("ring", "ulysses", "flash", "splash"))
     )
@@ -390,6 +429,7 @@ def forward(
         paged_verify=paged_verify,
         paged_verify_impl=paged_verify_impl,
         paged_chunked=paged_chunked,
+        paged_prefix=paged_prefix,
         lora_dropout=lora_dropout if dropout_rng is not None else 0.0,
         cache_read_formulation=cache_read_formulation,
     )

@@ -201,17 +201,24 @@ def write_tokens_to_pages(
     return pages
 
 
-def gather_pages_dense(pages, page_indices: jax.Array) -> jax.Array:
+def gather_pages_dense(pages, page_indices: jax.Array,
+                       dtype=jnp.float32) -> jax.Array:
     """Gather each row's pages into a dense position-ordered context
-    [B, width·ps, K, hd] f32 (page-table column t covers positions
+    [B, width·ps, K, hd] (page-table column t covers positions
     [t·ps, (t+1)·ps), so the concatenation is position order). Quantized
-    pools dequantize AFTER the gather — only the rows' own pages."""
+    pools dequantize AFTER the gather — only the rows' own pages.
+
+    ``dtype`` defaults to f32 (the chunked-attention accumulator contract);
+    the warm radix-prefill path passes the COMPUTE dtype so the gathered
+    context is bit-identical to the in-flight k/v the packed cold prefill
+    attended over (page writes are exact ``astype`` round-trips when the
+    pool dtype holds the compute dtype losslessly)."""
     if is_quantized_pages(pages):
         w = pages.weight[:, page_indices]
         s_ = pages.scales[:, page_indices]
-        dense = _quant_utils().from_int8(w, s_, dtype=jnp.float32)
+        dense = _quant_utils().from_int8(w, s_, dtype=dtype)
     else:
-        dense = pages[:, page_indices].astype(jnp.float32)
+        dense = pages[:, page_indices].astype(dtype)
     # [K, B, width, ps, hd] → [B, width·ps, K, hd]
     kh, b, width, ps, hd = dense.shape
     return dense.transpose(1, 2, 3, 0, 4).reshape(b, width * ps, kh, hd)

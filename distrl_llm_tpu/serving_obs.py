@@ -247,11 +247,15 @@ class ServingLedger:
 
     def on_admit(self, uid, *, cand: int, slot: int, shared_pages: int = 0,
                  cow: bool = False, backfill: bool = False,
-                 resumed: bool = False, ts: float | None = None) -> None:
+                 resumed: bool = False, prefix_hit_tokens: int = 0,
+                 ts: float | None = None) -> None:
         """A candidate of this group was admitted into a decode slot
         (``shared_pages``/``cow`` are the page pool's chain-alias facts for
         the slot: how many prefix pages it aliases and whether the
-        copy-on-write tail split rode this admission)."""
+        copy-on-write tail split rode this admission;
+        ``prefix_hit_tokens`` is the radix-cache hit the group's admission
+        rode in on — tokens of prompt that skipped prefill entirely, 0 on
+        cold admissions and cache-off engines)."""
         ts = time.time() if ts is None else ts
         with self._mu:
             rec = self._rec(uid)
@@ -261,6 +265,7 @@ class ServingLedger:
                 "cand": int(cand), "slot": int(slot),
                 "shared_pages": int(shared_pages), "cow": bool(cow),
                 "backfill": bool(backfill), "resumed": bool(resumed),
+                "prefix_hit_tokens": int(prefix_hit_tokens),
                 "ts": ts,
             })
             if resumed:

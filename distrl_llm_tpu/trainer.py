@@ -98,6 +98,16 @@ def engine_kwargs_from_config(config: TrainConfig) -> dict[str, Any]:
                 kwargs["spec_verify"] = config.spec_verify
             if config.spec_adapt:
                 kwargs["spec_adapt"] = True
+            # tiered KV cache (ISSUE 18): None stays plan-DB-resolvable at
+            # the engine; an explicit bool — INCLUDING False — pins past
+            # any stored plan (the spec_draft convention). kv_spill is
+            # explicit-only, never plan-resolved.
+            if config.prefix_cache is not None:
+                kwargs["prefix_cache"] = config.prefix_cache
+            if config.kv_spill:
+                kwargs["kv_spill"] = True
+                if config.kv_spill_host_mb:
+                    kwargs["kv_spill_host_mb"] = config.kv_spill_host_mb
     if config.max_concurrent_sequences and config.engine_impl != "paged_sharded":
         # the sharded engine admits whole dp-sharded waves; a row cap is the
         # per-replica engines' admission knob
@@ -715,6 +725,9 @@ class Trainer:
                     # surfaces as the engine's pool-floor error, naming
                     # the pin to set
                     continuous=config.continuous_admission,
+                    # only the EXPLICIT flag bumps the floor (same rule):
+                    # a plan-resolved cache rides the refill slack instead
+                    prefix_cache=bool(config.prefix_cache),
                 )
             engine = engine_cls(
                 model_cfg,

@@ -68,7 +68,9 @@ class TestBenchContract:
                     "bytes_per_token", "step_bytes_accessed",
                     "sample_kernel", "quant_matmul",
                     "env_name", "turns_mean", "turns_max",
-                    "env_step_ms_p50"):
+                    "env_step_ms_p50",
+                    "prefix_cache", "radix_hit_rate", "prefill_tok_saved",
+                    "spill_restore_ms_p50"):
             assert key in rec, key
         # quantized-serving fields (ISSUE 15): an unpinned run resolves
         # the KV format from the (empty) plan DB — "none", the historical
@@ -111,6 +113,13 @@ class TestBenchContract:
         # null, distinguishing "no controller ran" from "ran, acted 0×"
         assert rec["control_actions"] is None
         assert rec["shed_groups"] is None
+        # tiered-KV-cache fields (ISSUE 18): the dense engine has no
+        # pool at all — all four honestly null (a cache-off PAGED row
+        # reads prefix_cache=False instead; see test_cb_record_fields)
+        assert rec["prefix_cache"] is None
+        assert rec["radix_hit_rate"] is None
+        assert rec["prefill_tok_saved"] is None
+        assert rec["spill_restore_ms_p50"] is None
         # multi-turn env fields (ISSUE 17): the single-turn control row
         # never arms a turn hook — all four honestly null, so the A/B
         # artifact can tell "no env ran" from "env ran, 1 turn"
@@ -237,6 +246,38 @@ class TestBenchContract:
         # no ControlLimits attached: control provenance honestly null
         assert rec["control_actions"] is None
         assert rec["shed_groups"] is None
+        # tiered cache off (the A/B control row): prefix_cache reads
+        # False — "pool ran, cache off" — and the cache measurements null
+        assert rec["prefix_cache"] is False
+        assert rec["radix_hit_rate"] is None
+        assert rec["prefill_tok_saved"] is None
+        assert rec["spill_restore_ms_p50"] is None
+
+    def test_radix_cache_record_fields(self):
+        """BENCH_PREFIX_CACHE=1 (ISSUE 18): the warm arm's timed round
+        re-admits the warmup round's prompts, so the row carries a real
+        radix hit rate and saved-prefill count — the fields the
+        radix_warm-vs-cb_continuous A/B in tpu_bench_loop.sh compares.
+        Device page ids are round-scoped, so the cross-round warm hit
+        necessarily restored its pages from the host-side park — the
+        restore p50 is a real measured latency here, not null."""
+        # prompts must span >= 1 FULL page (the 128-token default page
+        # size) or nothing is cacheable — only the mutable partial tail
+        rec = run_bench({
+            **self.TINY, "BENCH_ENGINE": "paged",
+            "BENCH_MAX_PROMPT": "256", "BENCH_MAX_NEW": "16",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+            "BENCH_CONT_ADMISSION": "1", "BENCH_PREFIX_CACHE": "1",
+        })
+        assert "error" not in rec
+        assert rec["prefix_cache"] is True
+        assert rec["radix_hit_rate"] is not None
+        assert 0.0 < rec["radix_hit_rate"] <= 1.0
+        assert rec["prefill_tok_saved"] is not None
+        assert rec["prefill_tok_saved"] > 0
+        assert rec["spill_restore_ms_p50"] is not None
+        assert rec["spill_restore_ms_p50"] >= 0
+        assert rec["value"] > 0
 
     def test_cb_control_pinned_fields(self):
         """BENCH_CONTROL_FRAC (ISSUE 14): the static governor-shrunk A/B

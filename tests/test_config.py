@@ -131,6 +131,48 @@ class TestEnvConfig:
             self._multi(rollout_workers=["grpc://w0:9000"])
 
 
+class TestTieredCacheConfig:
+    """--prefix_cache/--kv_spill dead-flag validation (ISSUE 18)."""
+
+    def _cb(self, **kw):
+        base = dict(
+            engine_impl="paged", continuous_batching=True,
+            continuous_admission=True, max_concurrent_sequences=4,
+        )
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_defaults_off_and_plan_resolvable(self):
+        c = TrainConfig()
+        assert c.prefix_cache is None  # plan-DB-resolvable, not pinned
+        assert c.kv_spill is False
+        assert c.kv_spill_host_mb == 0
+
+    def test_valid_tiered_shape(self):
+        c = self._cb(prefix_cache=True, kv_spill=True, kv_spill_host_mb=64)
+        assert c.prefix_cache is True and c.kv_spill is True
+
+    def test_prefix_cache_requires_continuous_admission(self):
+        with pytest.raises(ValueError, match="continuous_admission"):
+            TrainConfig(prefix_cache=True)
+
+    def test_prefix_cache_rejects_int8_kv(self):
+        with pytest.raises(ValueError, match="lossless"):
+            self._cb(prefix_cache=True, kv_cache_quant="int8")
+
+    def test_kv_spill_requires_prefix_cache(self):
+        with pytest.raises(ValueError, match="prefix_cache"):
+            self._cb(kv_spill=True)
+
+    def test_kv_spill_rejects_spec_draft(self):
+        with pytest.raises(ValueError, match="spec_draft"):
+            self._cb(prefix_cache=True, kv_spill=True, spec_draft=2)
+
+    def test_host_mb_requires_kv_spill(self):
+        with pytest.raises(ValueError, match="kv_spill"):
+            self._cb(prefix_cache=True, kv_spill_host_mb=64)
+
+
 class TestSamplingConfig:
     def test_replace(self):
         s = SamplingConfig().replace(n=8, temperature=0.6)

@@ -262,6 +262,26 @@ class TestDriver:
         # the policy turn still trains
         assert res.loss_mask[0, :t1.size].all()
 
+    def test_history_carries_the_full_transcript(self):
+        """ISSUE 18: finish_round exports each candidate's conversation
+        transcript (policy spans + injected observations) so a later
+        round can re-admit ``prompt_ids + history[c]`` through the radix
+        cache — the array must cover through the last env span even when
+        the engine's length cursor stopped earlier."""
+        tok, drv = _driver()
+        drv.begin_round(["compute 6*7"], ["42"], 1)
+        turn1 = np.asarray(tok.encode("<tool>print(6*7)</tool>"), np.int32)
+        obs = drv(0, turn1)
+        turn2 = np.asarray(tok.encode("<answer>42</answer>"), np.int32)
+        full = np.concatenate([turn1, obs, turn2])
+        assert drv(0, full) is None
+        tokens = np.zeros((1, 96), np.int32)
+        tokens[0, :full.size] = full
+        # a stale length cursor must not truncate the env span
+        res = drv.finish_round(tokens, np.asarray([turn1.size]))
+        np.testing.assert_array_equal(res.history[0], full)
+        assert res.history[0].dtype == np.int32
+
     def test_finish_round_scores_unconsulted_stragglers(self):
         """A candidate the engine finished without consulting the hook
         (final blocking sweep) still owes its turn to the environment."""

@@ -1,0 +1,416 @@
+"""Tiered KV cache (ISSUE 18): radix prefix index + host-RAM spill.
+
+The two contracts this PR exists for, both pinned here:
+
+* **Exactness** — greedy decode with the radix cache on (warm cross-group
+  admissions, cross-round flush→restore re-admission, and tier-2
+  spill→restore under forced page pressure) is bit-identical to the
+  cache-off engine. The packed cold prefill and the paged warm-suffix
+  prefill run the SAME attention front door over bit-identical inputs, so
+  this is an equality pin, not a tolerance.
+* **Conservation** — match/evict/spill/restore transitions never leak or
+  double-track a page under any interleaving with the PR 12 CoW
+  machinery (property-style fuzz with ``check_invariants`` recomputing
+  every refcount and asserting the tree's page set disjoint from the
+  free list).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrl_llm_tpu.config import SamplingConfig
+from distrl_llm_tpu.engine.page_pool import (
+    HostPageStore,
+    PagePool,
+    RadixCache,
+)
+from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+from distrl_llm_tpu.models import TINY, init_params
+
+PAGE = 8
+
+
+def _pool(n_pages=24, r_slots=4, store=None, spill=False):
+    pool = PagePool(
+        first_page=0, n_pages=n_pages, r_slots=r_slots, width=8,
+        page_size=PAGE, prompt_pages=3, prefix_sharing=True,
+        radix=RadixCache(PAGE), store=store,
+    )
+    if spill:
+        # host-side fuzz double for the engine's device gather: the
+        # payload is keyed on the page id, so a restore's payload
+        # identity proves which physical page round-tripped
+        pool.spill_fn = lambda page: {"page": np.int64(page)}
+    return pool
+
+
+def _toks(n, seed=0, prefix=None):
+    rng = np.random.default_rng(seed)
+    t = list(rng.integers(2, 999, size=n))
+    if prefix is not None:
+        t[: len(prefix)] = list(prefix)
+    return [int(x) for x in t]
+
+
+class TestRadixMatch:
+    def test_retire_then_warm_alias(self):
+        """cache_chain retires a finished chain's full pages into the
+        tree; a later admission with the same prompt aliases the SAME
+        physical pages and books the saved prefill."""
+        pool = _pool()
+        toks = _toks(20, seed=1)
+        chain = pool.alloc_prefix(0, 3, 2)  # rl=20: 2 full pages + tail
+        assert chain is not None
+        pool.cache_chain(0, toks)
+        pool.check_invariants()
+        nodes, hit = pool.radix_match(toks)
+        assert hit == 2 * PAGE
+        assert [n.page for n in nodes] == chain[:2]
+        resident, uploads = pool.restore_nodes(nodes)
+        assert resident == nodes and uploads == []  # never left the device
+        pages = pool.admit_cached(1, resident, 3, 2)
+        assert pages is not None and pages[:2] == chain[:2]
+        assert pool.radix.prefill_tok_saved == 2 * PAGE
+        pool.check_invariants()
+        pool.drop_prefix(1)
+        pool.check_invariants()
+
+    def test_match_never_covers_the_last_token(self):
+        """The hit is capped below real_len so at least one suffix token
+        prefills — its forward pass produces the admission's sampling
+        logits, and no suffix write ever lands in a cached page."""
+        pool = _pool()
+        toks = _toks(2 * PAGE, seed=2)  # page-aligned length
+        pool.alloc_prefix(0, 2, 2)
+        pool.cache_chain(0, toks)
+        _nodes, hit = pool.radix_match(toks)
+        assert hit == PAGE  # (16-1)//8 = 1 full page, not 2
+
+    def test_cache_chain_dedup_keeps_one_copy(self):
+        """A second identical chain retiring derefs its duplicate pages —
+        the tree keeps one physical copy per distinct prefix."""
+        pool = _pool()
+        toks = _toks(20, seed=3)
+        pool.alloc_prefix(0, 3, 2)
+        pool.cache_chain(0, toks)
+        free0 = pool.free_pages
+        chain1 = pool.alloc_prefix(1, 3, 2)
+        assert chain1 is not None
+        pool.cache_chain(1, toks)
+        pool.check_invariants()
+        # all 3 of chain1's pages freed: 2 duplicates + the mutable tail
+        assert pool.free_pages == free0
+        assert pool.radix.node_count() == 2
+
+    def test_lru_eviction_spills_then_restores_bit_exact(self):
+        """Page pressure evicts the LRU unpinned node through the host
+        store; a later match restores it and the upload payload is the
+        one the evicted page spilled."""
+        store = HostPageStore()
+        try:
+            # 8 usable pages: after A and B retire (2 cached pages each,
+            # tails freed) 4 are free — the 6-page demand forces the two
+            # LRU pages (chain A's, untouched since retiring) out
+            pool = _pool(n_pages=9, r_slots=2, store=store, spill=True)
+            ta, tb = _toks(20, seed=4), _toks(20, seed=5)
+            chain_a = pool.alloc_prefix(0, 3, 2)
+            pool.cache_chain(0, ta)
+            pool.alloc_prefix(1, 3, 2)
+            pool.cache_chain(1, tb)
+            pool.radix_match(tb)  # touch B: A becomes the LRU victim
+            assert pool.alloc_prefix(2, 6, 5) is not None
+            assert pool.radix.evictions >= 2
+            assert pool.radix.spilled_pages >= 2
+            pool.check_invariants()
+            pool.drop_prefix(2)
+            nodes, hit = pool.radix_match(ta)
+            assert hit == 2 * PAGE
+            resident, uploads = pool.restore_nodes(nodes)
+            assert len(resident) == 2 and len(uploads) == 2
+            assert [int(p["page"]) for _n, _pg, p in uploads] == chain_a[:2]
+            assert pool.radix.restored_pages == 2
+            pool.check_invariants()
+        finally:
+            store.close()
+
+    def test_eviction_without_spill_path_prunes(self):
+        """No store/spill_fn: pressure prunes the subtree instead of
+        leaking it (or pretending it stayed restorable)."""
+        pool = _pool(n_pages=9, r_slots=2)  # store=None
+        pool.alloc_prefix(0, 3, 2)
+        pool.cache_chain(0, _toks(20, seed=6))
+        assert pool.alloc_prefix(1, 7, 6) is not None
+        assert pool.radix.node_count() == 0
+        _nodes, hit = pool.radix_match(_toks(20, seed=6))
+        assert hit == 0
+        pool.drop_prefix(1)
+        pool.check_invariants()
+        assert pool.free_pages == pool.universe_pages
+
+    def test_flush_parks_and_invalidate_forgets(self):
+        store = HostPageStore()
+        try:
+            pool = _pool(store=store, spill=True)
+            toks = _toks(20, seed=7)
+            pool.alloc_prefix(0, 3, 2)
+            pool.cache_chain(0, toks)
+            pool.flush_cache()
+            assert pool.free_pages == pool.universe_pages
+            assert pool.radix.resident_pages == 0
+            # the tree survives as a host-resident index
+            nodes, hit = pool.radix_match(toks)
+            assert hit == 2 * PAGE
+            resident, uploads = pool.restore_nodes(nodes)
+            assert len(uploads) == 2
+            pool.check_invariants()
+            pool.invalidate_cache()
+            assert pool.radix.node_count() == 0
+            assert pool.free_pages == pool.universe_pages
+            pool.check_invariants()
+        finally:
+            store.close()
+
+
+class TestHostPageStore:
+    def test_roundtrip_bit_exact(self):
+        store = HostPageStore()
+        try:
+            payload = (
+                np.arange(32, dtype=np.int8).reshape(4, 8),
+                {"scales": np.linspace(0.1, 1.7, 7, dtype=np.float32)},
+            )
+            store.put(("radix", 0), payload)
+            out = store.get(("radix", 0))
+            np.testing.assert_array_equal(out[0], payload[0])
+            np.testing.assert_array_equal(
+                out[1]["scales"], payload[1]["scales"]
+            )
+            assert out[0].dtype == np.int8
+        finally:
+            store.close()
+
+    def test_byte_cap_lru_drops_oldest(self):
+        store = HostPageStore(max_bytes=3000)
+        try:
+            for i in range(4):  # 4 × 1 KiB > cap
+                store.put(i, np.zeros(1024, np.int8))
+            store.get(3)  # drain the queue deterministically
+            assert store.dropped_payloads >= 1
+            assert store.used_bytes <= 3000
+            assert store.get(0) is None  # the oldest aged out
+            assert store.get(3) is not None
+        finally:
+            store.close()
+
+    def test_drop_while_pending_discards(self):
+        store = HostPageStore()
+        try:
+            store.put("k", np.ones(8))
+            store.drop("k")
+            assert store.get("k") is None
+            assert store.used_bytes == 0
+        finally:
+            store.close()
+
+
+class TestRadixSpillFuzz:
+    @pytest.mark.slow
+    def test_match_evict_spill_restore_conserve_pages(self):
+        """The PR 12 conservation fuzz extended with the tiered-cache
+        transitions: random interleavings of chain alloc (warm, through
+        match→restore→admit_cached), slot admits/writes/releases, chain
+        retirement INTO the tree vs plain drops, pressure-driven
+        evictions, round-boundary flushes, and full invalidations — after
+        every op the recomputed refcounts must match and the tree's page
+        set stays disjoint from the free list; the finale releases
+        everything and every page must come back (zero leak)."""
+        rng = np.random.default_rng(5678)
+        # a small shared prompt alphabet makes cross-chain prefix hits
+        # (and hence aliased cached pages) common instead of accidental
+        bases = [_toks(2 * PAGE, seed=s) for s in range(3)]
+        for trial in range(10):
+            store = HostPageStore()
+            pool = _pool(
+                n_pages=int(rng.integers(14, 30)),
+                r_slots=int(rng.integers(2, 5)),
+                store=store, spill=True,
+            )
+            try:
+                occupants: dict[int, tuple[int, int]] = {}
+                live: dict[int, tuple[int, list[int]]] = {}  # g -> (rl, toks)
+                next_prompt = 0
+                for _ in range(80):
+                    op = int(rng.integers(0, 8))
+                    if op == 0 and len(live) < 5:
+                        rl = int(rng.integers(PAGE + 1, 3 * PAGE + 1))
+                        toks = _toks(
+                            rl, seed=int(rng.integers(1 << 30)),
+                            prefix=bases[int(rng.integers(3))][:2 * PAGE],
+                        )
+                        n_chain, full = -(-rl // PAGE), rl // PAGE
+                        nodes, _hit = pool.radix_match(toks)
+                        resident, _ups = pool.restore_nodes(nodes)
+                        if pool.admit_cached(
+                            next_prompt, resident, n_chain, full
+                        ) is not None:
+                            live[next_prompt] = (rl, toks)
+                            next_prompt += 1
+                    elif op == 1 and live and occupants is not None:
+                        free_slots = [
+                            s for s in range(len(pool.owned))
+                            if s not in occupants
+                        ]
+                        if free_slots:
+                            s = free_slots[0]
+                            g = int(rng.choice(list(live)))
+                            rl = live[g][0]
+                            last = int(rng.integers(rl, rl + 2 * PAGE))
+                            if pool.admit(s, g, rl, last,
+                                          first_write=rl):
+                                pool.take_copy(s)
+                                occupants[s] = (g, rl)
+                    elif op == 2 and occupants:
+                        s = int(rng.choice(list(occupants)))
+                        _g, rl = occupants[s]
+                        try:
+                            pool.note_write(
+                                s, int(rng.integers(rl, rl + PAGE))
+                            )
+                        except RuntimeError:
+                            pass  # dry pool may refuse a split — legal
+                    elif op == 3 and occupants:
+                        s = int(rng.choice(list(occupants)))
+                        pool.release(s)
+                        del occupants[s]
+                    elif op == 4 and live:
+                        g = int(rng.choice(list(live)))
+                        if g not in {pg for pg, _ in occupants.values()}:
+                            rl, toks = live.pop(g)
+                            if rng.integers(2):
+                                pool.cache_chain(g, toks)
+                            else:
+                                pool.drop_prefix(g)
+                    elif op == 5:
+                        # a pure lookup (hit accounting + LRU touches)
+                        pool.radix_match(
+                            bases[int(rng.integers(3))]
+                        )
+                    elif op == 6 and rng.integers(4) == 0:
+                        pool.flush_cache()
+                    elif op == 7 and rng.integers(8) == 0:
+                        pool.invalidate_cache()
+                    pool.check_invariants()
+                for s in list(occupants):
+                    pool.release(s)
+                    pool.check_invariants()
+                for g in list(live):
+                    pool.drop_prefix(g)
+                    pool.check_invariants()
+                pool.invalidate_cache()
+                pool.check_invariants()
+                assert pool.free_pages == pool.universe_pages, (
+                    f"trial {trial}: leaked "
+                    f"{pool.universe_pages - pool.free_pages} page(s)"
+                )
+                assert not pool.ref, (
+                    f"trial {trial}: refcount residue {pool.ref}"
+                )
+            finally:
+                store.close()
+
+
+def _make_engine(cache=False, pool=0, **kw):
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=16, max_new_tokens=24,
+        eos_token_ids=[1], pad_token_id=0, page_size=PAGE,
+        max_concurrent_rows=4, scheduler="refill",
+        max_kv_pages=pool, spec_draft=0, decode_chunk=4,
+        autotune=False, continuous_admission=True, prefix_cache=cache,
+        **kw,
+    )
+
+
+def _prompts(b=6, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(2, TINY.vocab_size, size=(b, 16)).astype(np.int32)
+    ids[:, :PAGE] = ids[0, :PAGE]  # one page-aligned cross-group prefix
+    return ids, np.ones((b, 16), np.int32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+
+
+class TestTieredGreedyIdentity:
+    def test_warm_admission_bit_identical_across_rounds(
+        self, tiny_params, monkeypatch
+    ):
+        """The acceptance pin: greedy decode with the radix cache on is
+        bit-identical to the cache-off engine — on the FIRST round (warm
+        cross-group aliasing of the shared prefix) and on a SECOND round
+        of the same prompts (flush→restore re-admission of the whole
+        conversation history), with real measured savings both times."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts()
+        samp = SamplingConfig(max_tokens=24, temperature=0.0, top_p=1.0, n=2)
+        rng = jax.random.PRNGKey(7)
+        ref = _make_engine(cache=False).generate(
+            tiny_params, None, ids, mask, samp, rng)
+        eng = _make_engine(cache=True)
+        r1 = eng.generate(tiny_params, None, ids, mask, samp, rng)
+        s1 = eng.last_pool_stats
+        np.testing.assert_array_equal(r1.tokens, ref.tokens)
+        np.testing.assert_array_equal(r1.lengths, ref.lengths)
+        assert s1["prefix_cache"] is True
+        assert s1["prefill_tok_saved"] > 0  # groups 2..6 rode group 1
+        assert s1["radix_hit_rate"] > 0
+        r2 = eng.generate(tiny_params, None, ids, mask, samp, rng)
+        s2 = eng.last_pool_stats
+        np.testing.assert_array_equal(r2.tokens, ref.tokens)
+        np.testing.assert_array_equal(r2.lengths, ref.lengths)
+        assert s2["restored_pages"] > 0  # round-2 hits restored from host
+        assert s2["prefill_tok_saved"] > 0
+
+    def test_spill_restore_bit_identical_under_pressure(
+        self, tiny_params, monkeypatch
+    ):
+        """Tier-2 pin: a page budget tight enough to preempt forces
+        chains to spill to the host store and restore on resume — the
+        restored continuation must stay bit-identical to the unbudgeted
+        cache-off run, and the round must actually have spilled."""
+        monkeypatch.setenv("DISTRL_POOL_CHECK", "1")
+        ids, mask = _prompts(seed=11)
+        samp = SamplingConfig(max_tokens=24, temperature=0.0, top_p=1.0, n=2)
+        rng = jax.random.PRNGKey(9)
+        ref = _make_engine(cache=False).generate(
+            tiny_params, None, ids, mask, samp, rng)
+        eng = _make_engine(cache=True, pool=12, kv_spill=True)
+        res = eng.generate(tiny_params, None, ids, mask, samp, rng)
+        stats = eng.last_pool_stats
+        np.testing.assert_array_equal(res.tokens, ref.tokens)
+        np.testing.assert_array_equal(res.lengths, ref.lengths)
+        assert stats["preemptions"] > 0, "budget never bit — weak test"
+        assert stats["spilled_pages"] > 0
+        assert stats["restored_pages"] > 0
+        assert stats["spill_restore_ms_p50"] is not None
+
+    def test_prefix_cache_requires_continuous_admission(self):
+        with pytest.raises(ValueError, match="continuous"):
+            PagedGenerationEngine(
+                TINY, max_prompt_tokens=16, max_new_tokens=8,
+                eos_token_ids=[1], pad_token_id=0, page_size=PAGE,
+                max_concurrent_rows=4, scheduler="refill",
+                decode_chunk=4, autotune=False, prefix_cache=True,
+            )
+
+    def test_prefix_cache_rejects_int8_kv(self):
+        with pytest.raises(ValueError, match="lossless"):
+            _make_engine(cache=True, kv_quant="int8")
+
+    def test_kv_spill_requires_prefix_cache(self):
+        with pytest.raises(ValueError, match="prefix_cache"):
+            _make_engine(cache=False, kv_spill=True)

@@ -68,6 +68,22 @@ class TestServingLedger:
         assert rec.first_token_ts == rec.finish_ts == 10.4
         assert rec.ttft_ms == pytest.approx(400.0)
 
+    def test_admit_records_carry_prefix_hit_tokens(self, tmp_path):
+        """ISSUE 18: the admit record pins the radix-cache hit the group
+        rode in on — prompt tokens that skipped prefill — and defaults to
+        0 on cold admissions so cache-off ledgers stay shape-identical."""
+        led = ServingLedger(out_dir=str(tmp_path))
+        uid = led.on_enqueue(0, n=2, prompt_tokens=24, ts=1.0)
+        led.on_admit(uid, cand=0, slot=0, prefix_hit_tokens=16, ts=1.1)
+        led.on_admit(uid, cand=1, slot=1, ts=1.2)  # cold twin
+        led.on_finish(uid, 0, ts=2.0)
+        led.on_finish(uid, 1, ts=2.0)
+        led.close()
+        docs = [json.loads(l) for l in open(tmp_path / "serving.jsonl")]
+        (g,) = [d for d in docs if d["kind"] == "group"]
+        assert g["admits"][0]["prefix_hit_tokens"] == 16
+        assert g["admits"][1]["prefix_hit_tokens"] == 0
+
     def test_resumed_admit_keeps_original_queue_wait(self):
         led = ServingLedger()
         uid = led.on_enqueue(0, n=1, prompt_tokens=4, ts=10.0)
@@ -378,6 +394,36 @@ class TestServingReportTool:
         assert "no_pages" in out
         assert "occupancy:" in out
 
+    def test_report_warm_vs_cold_ttft(self, tmp_path, capsys):
+        """ISSUE 18: one warm group (an admit with prefix_hit_tokens)
+        makes the report render the radix-cache section with warm and
+        cold TTFT rows; a hit-free ledger must not grow the section."""
+        from tools import serving_report
+
+        path = self._write(tmp_path, [
+            {"kind": "group", "group_index": 0, "n": 1, "finish_ts": 1.0,
+             "ttft_ms": 3.0,
+             "admits": [{"cand": 0, "slot": 0, "prefix_hit_tokens": 16}]},
+            {"kind": "group", "group_index": 1, "n": 1, "finish_ts": 1.0,
+             "ttft_ms": 9.0,
+             "admits": [{"cand": 0, "slot": 1, "prefix_hit_tokens": 0}]},
+        ])
+        assert serving_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "radix cache: 1 warm group(s) of 2" in out
+        assert "16 prompt tokens admitted straight from cache" in out
+        assert "warm ttft" in out and "cold ttft" in out
+
+    def test_report_no_radix_section_when_cold(self, tmp_path, capsys):
+        from tools import serving_report
+
+        path = self._write(tmp_path, [
+            {"kind": "group", "group_index": 0, "n": 1, "finish_ts": 1.0,
+             "ttft_ms": 3.0, "admits": [{"cand": 0, "slot": 0}]},
+        ])
+        assert serving_report.main([path]) == 0
+        assert "radix cache" not in capsys.readouterr().out
+
     def test_no_groups_exits_1(self, tmp_path, capsys):
         from tools import serving_report
 
@@ -430,6 +476,35 @@ class TestBenchHistoryLatency:
         out = capsys.readouterr().out
         assert rc == 1
         assert "ttft_p50_ms 50.0 → 80.0" in out.replace(",", "")
+
+    def test_rate_fields_scanned_higher_is_better(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """ISSUE 18: radix_hit_rate is scanned HIGHER-is-better — a hit-
+        rate collapse between comparable cache-on rounds flags (warm
+        admissions stopped landing) while an improvement never does; the
+        restore latency scans with the *_ms fields (lower-is-better)."""
+        from tools import bench_history as bh
+
+        assert "radix_hit_rate" in bh.RATE_FIELDS
+        assert "spill_restore_ms_p50" in bh.LATENCY_FIELDS
+        assert bh.lower_is_better("spill_restore_ms_p50")
+        assert not bh.lower_is_better("radix_hit_rate")
+
+        def art(n, hit):
+            rec = {"metric": "rollout_tokens_per_sec_per_chip",
+                   "value": 100.0, "backend": "cpu",
+                   "radix_hit_rate": hit}
+            return {"n": n, "rc": 0, "tail": json.dumps(rec)}
+
+        for n, hit in ((1, 0.8), (2, 0.4)):
+            with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as f:
+                json.dump(art(n, hit), f)
+        monkeypatch.setattr(bh, "REPO", str(tmp_path))
+        rc = bh.main(["--glob", "BENCH_r*.json"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "radix_hit_rate 0.800 → 0.400" in out.replace(",", "")
 
 
 class TestLineageServingJoin:

@@ -634,6 +634,32 @@ class TestRegistry:
         assert snap["learn/is_ratio_count"] == 3.0
         assert snap["learn/steps"] == 1.0
 
+    def test_pool_series_schema(self):
+        """Schema pin for the tiered-KV-cache registry names (ISSUE 18)
+        and their TYPES, all single-owned by engine/page_pool.py:
+        pool/radix_hit_rate is a GAUGE (cumulative hit/lookup token
+        ratio); pool/prefill_tok_saved, pool/evictions and
+        pool/spilled_pages are COUNTERS; pool/restore_ms is a HISTOGRAM
+        (host->device restore batches)."""
+        from distrl_llm_tpu.engine import page_pool as pp
+
+        assert pp.POOL_RADIX_HIT_RATE == "pool/radix_hit_rate"
+        assert pp.POOL_PREFILL_TOK_SAVED == "pool/prefill_tok_saved"
+        assert pp.POOL_EVICTIONS == "pool/evictions"
+        assert pp.POOL_SPILLED_PAGES == "pool/spilled_pages"
+        assert pp.POOL_RESTORE_MS == "pool/restore_ms"
+        telemetry.gauge_set(pp.POOL_RADIX_HIT_RATE, 0.5)
+        telemetry.counter_add(pp.POOL_PREFILL_TOK_SAVED, 16.0)
+        telemetry.counter_add(pp.POOL_EVICTIONS)
+        telemetry.counter_add(pp.POOL_SPILLED_PAGES, 2.0)
+        telemetry.hist_observe(pp.POOL_RESTORE_MS, 1.5)
+        snap = telemetry.metrics_snapshot()
+        assert snap["pool/radix_hit_rate"] == 0.5
+        assert snap["pool/prefill_tok_saved"] == 16.0
+        assert snap["pool/evictions"] == 1.0
+        assert snap["pool/spilled_pages"] == 2.0
+        assert snap["pool/restore_ms_count"] == 1.0
+
     def test_observe_snapshot_carries_hist_buckets(self):
         """Cumulative per-bucket counts ride observe_snapshot (the obs
         endpoint's and the worker blob's feed), aligned to

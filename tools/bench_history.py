@@ -93,8 +93,18 @@ _LATENCY_RE = re.compile(r"(_ms$|_ms_|_p\d+_ms$|_p\d+$)")
 _BYTES_RE = re.compile(r"(_bytes$|bytes_per_token$)")
 
 # per-row latency fields scanned between comparable consecutive rounds
-# (bench rollout rows, ISSUE 13; null on non-cb rows — skipped then)
-LATENCY_FIELDS = ("ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms")
+# (bench rollout rows, ISSUE 13; null on non-cb rows — skipped then).
+# spill_restore_ms_p50 (ISSUE 18): the tiered cache's host-restore p50 —
+# latency-typed by name, null on cache-off rows
+LATENCY_FIELDS = (
+    "ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
+    "spill_restore_ms_p50",
+)
+# per-row rate fields scanned the same way but HIGHER-is-better (ISSUE 18:
+# a radix hit-rate drop between comparable cache-on rounds means warm
+# admissions stopped landing — a cache regression even when tok/s is
+# noisy); null on cache-off rows — skipped then
+RATE_FIELDS = ("radix_hit_rate",)
 # per-row measured-bytes fields scanned the same way (ISSUE 15; null when
 # the backend reported no cost analysis — skipped then). comparable()
 # already pins both rounds to the same base_quant/kv_format arm, so a
@@ -190,17 +200,24 @@ def main(argv: list[str] | None = None) -> int:
             # serving-latency + measured-bytes fields (cb/quant rows):
             # lower-is-better by type, scanned only when BOTH rounds
             # produced them
-            for field in LATENCY_FIELDS + BYTES_FIELDS:
+            for field in LATENCY_FIELDS + BYTES_FIELDS + RATE_FIELDS:
                 ov, nv = prev[1].get(field), rec.get(field)
                 if ov is None or nv is None:
                     continue
                 if regressed(field, float(ov), float(nv), args.drop):
-                    unit = "B/tok" if field in BYTES_FIELDS else "ms"
+                    # rates are unitless fractions — 3 decimals; latency
+                    # and byte fields keep the historical 1-decimal pin
+                    unit, prec = ("ms", 1)
+                    if field in BYTES_FIELDS:
+                        unit = "B/tok"
+                    elif field in RATE_FIELDS:
+                        unit, prec = ("", 3)
+                    sign = "-" if field in RATE_FIELDS else "+"
                     flags.append(
-                        f"r{prev[0]}→r{n}: {field} {float(ov):,.1f} → "
-                        f"{float(nv):,.1f} {unit} "
+                        f"r{prev[0]}→r{n}: {field} {float(ov):,.{prec}f} → "
+                        f"{float(nv):,.{prec}f} {unit} "
                         f"({100 * (float(nv) / float(ov) - 1):+.1f}%, "
-                        f"flag threshold +{100 * args.drop:.0f}%)"
+                        f"flag threshold {sign}{100 * args.drop:.0f}%)"
                     )
         prev = (n, rec)
 

@@ -125,6 +125,14 @@ stage "learn_smoke" env JAX_PLATFORMS=cpu \
 # lineage stamps per-turn provenance the report tool renders
 stage "env_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/env_smoke.py
+# tiered-KV gate (ISSUE 18): warm-prefix rounds book measured
+# prefill_tok_saved, cross-round re-admission restores through the host-
+# parked tree, a tight page budget spills tier-2 and restores bit-exact,
+# and a multi-turn round's transcript re-admits as the next round's
+# prompt with every full history page served from cache — all arms
+# byte-identical to the cache-off golden run under greedy decode
+stage "radix_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/radix_smoke.py
 # bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
 # one table and flag >10% per-metric tok/s regressions — machine-readable
 # bench history, but cross-round rows come from different silicon windows,

@@ -105,6 +105,37 @@ def build_report(groups: list[dict], summary: dict | None) -> str:
             )
         lines.append("")
 
+    # ---- warm vs cold TTFT (ISSUE 18): a group is WARM when any of its
+    # admits rode a radix-cache hit (prefix_hit_tokens > 0) — the table
+    # quantifies what the tiered cache buys at the request level; renders
+    # only when a warm group exists (cache-off ledgers show nothing new)
+    warm = [
+        g for g in groups
+        if any(a.get("prefix_hit_tokens", 0) > 0 for a in g.get("admits", ()))
+    ]
+    if warm:
+        cold = [g for g in groups if g not in warm]
+        hit_tok = sum(
+            a.get("prefix_hit_tokens", 0)
+            for g in warm for a in g.get("admits", ())
+        )
+        lines.append(
+            f"radix cache: {len(warm)} warm group(s) of {len(groups)}, "
+            f"{hit_tok} prompt tokens admitted straight from cache"
+        )
+        for label, pop in (("warm ttft", warm), ("cold ttft", cold)):
+            vals = [
+                float(g["ttft_ms"]) for g in pop
+                if g.get("ttft_ms") is not None
+            ]
+            if vals:
+                lines.append(
+                    f"  {label:<12} {len(vals):>6} {_pct(vals, 50):>10,.2f} "
+                    f"{_pct(vals, 90):>10,.2f} {_pct(vals, 99):>10,.2f} "
+                    f"{max(vals):>10,.2f}"
+                )
+        lines.append("")
+
     # ---- admission audit
     if summary is not None:
         declined = int(summary.get("declined_passes", 0))

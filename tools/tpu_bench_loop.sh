@@ -245,6 +245,23 @@ bench cb_prefix /tmp/bench_tpu_cb_prefix.json 1200 \
 bench cb_continuous /tmp/bench_tpu_cb_continuous.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16
+# tiered-KV A/B (ISSUE 18): the cb_continuous arm re-run with the radix
+# prefix cache on (warm admissions skip cached prefill — rows record
+# prefix_cache / radix_hit_rate / prefill_tok_saved; cb_continuous above
+# reads null on all three, so it is the cache-off control), then again
+# with host-RAM spill enabled under a deliberately small page budget so
+# preemptions actually spill and restore (spill_restore_ms_p50 in the
+# rows prices the tier-2 round-trip; bench_history scores
+# radix_hit_rate higher-is-better and the restore p50 lower-is-better
+# across rounds)
+bench radix_warm /tmp/bench_tpu_radix_warm.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_PREFIX_CACHE=1
+bench kv_spill /tmp/bench_tpu_kv_spill.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_PREFIX_CACHE=1 BENCH_KV_SPILL=1 BENCH_KV_PAGES=192
 # controller-cost A/B (ISSUE 14): the cb_continuous arm re-run with the
 # admission fraction pinned at 0.5 — the static twin of an HBM-governor
 # shrink — so the artifact quantifies what a governor-degraded engine

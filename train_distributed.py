@@ -117,6 +117,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "pages as freed slots and page budget allow, so "
                         "short completions backfill immediately. Implies "
                         "--prefix_sharing; requires --continuous_batching")
+    p.add_argument("--prefix_cache", choices=["on", "off"], default=None,
+                   help="tiered KV cache tier 1: cross-request radix prefix "
+                        "index over the continuous-admission pool — warm "
+                        "prompts (multi-turn history, shared preambles) "
+                        "alias cached pages and prefill ONLY their "
+                        "un-cached suffix, bit-identically to cache-off. "
+                        "Requires --continuous_admission and an "
+                        "unquantized KV pool. Passing the flag — INCLUDING "
+                        "'off' — pins the choice past any stored autotune "
+                        "plan; omitting it leaves the plan DB in charge")
+    p.add_argument("--kv_spill", action="store_true",
+                   help="tiered KV cache tier 2: preempted chains spill "
+                        "written KV pages to a host-RAM store and restore "
+                        "bit-exactly on resume instead of recomputing. "
+                        "Requires --prefix_cache on; incompatible with "
+                        "--spec_draft")
+    p.add_argument("--kv_spill_host_mb", type=int, default=0,
+                   help="host page-store cap in MiB for --kv_spill (0 = "
+                        "unbounded); payloads LRU-drop past the cap and "
+                        "fall back to the recompute resume")
     p.add_argument("--spec_draft", type=int, default=None,
                    help="speculative decoding: draft this many tokens per "
                         "step and verify in one forward; distribution-"
@@ -500,6 +520,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
     fields["autotune"] = args.autotune == "on"
     fields["worker_rejoin"] = args.worker_rejoin == "on"
+    # tri-state pin (the spec_draft convention): omitted = None = plan-DB-
+    # resolvable; an explicit spelling — including "off" — pins the engine
+    fields["prefix_cache"] = (
+        None if args.prefix_cache is None else args.prefix_cache == "on"
+    )
     return TrainConfig(mesh=mesh, **fields)
 
 
