@@ -303,12 +303,13 @@ def _recompile_count() -> int:
     return obs.retrace_total()
 
 
-def _serving_pct(ledger, metric: str, q: float):
+def _serving_pct(ledger, metric: str, q: float, cls: str | None = None):
     """Rounded serving-latency percentile for a bench row, or None without
-    a ledger / without samples (dense/fixed/fleet rows)."""
+    a ledger / without samples (dense/fixed/fleet rows). ``cls`` narrows to
+    one priority class's samples (gateway rows, ISSUE 19)."""
     if ledger is None:
         return None
-    v = ledger.percentile(metric, q)
+    v = ledger.percentile(metric, q, cls=cls)
     return round(v, 3) if v is not None else None
 
 
@@ -317,6 +318,23 @@ def _serving_stall_frac(ledger):
         return None
     v = ledger.stall_frac()
     return round(v, 4) if v is not None else None
+
+
+def _gateway_shed_frac(service):
+    """Per-class share of shed+preempt deferral events over a gateway
+    replay (sums to 1.0), from GatewayService's run-cumulative tallies.
+    None off-gateway or when nothing was deferred — the r19 contract
+    checks >= 90% of the mass lands on batch/scavenger."""
+    if service is None:
+        return None
+    counts: dict[str, int] = {}
+    for action in ("shed", "preempt"):
+        for cls, n in service.class_actions.get(action, {}).items():
+            counts[cls] = counts.get(cls, 0) + int(n)
+    total = sum(counts.values())
+    if not total:
+        return None
+    return {cls: round(n / total, 4) for cls, n in sorted(counts.items())}
 
 
 def _fleet_tok_s():
@@ -1110,6 +1128,34 @@ def main() -> int:
     control_actions0 = _tlm.observe_snapshot()["counters"].get(
         "control/actions", 0.0
     )
+    # BENCH_GATEWAY=1 (ISSUE 19): drive the timed window through the
+    # serving gateway instead of fixed batched rounds — a seeded open-loop
+    # arrival trace (BENCH_ARRIVAL_PROCESS, default burst, at
+    # BENCH_ARRIVAL_RPS) replayed over the streaming HTTP front-end, with
+    # tenant/priority classes mixed in. BENCH_SHED_FLOOR pins a class-aware
+    # shed floor on the timed window (2 = scavenger only, 1 = batch too) —
+    # the static twin of the class-aware SLO governor, same convention as
+    # BENCH_CONTROL_FRAC. Gateway rows are only comparable to gateway rows
+    # at the same arrival rate (bench_history comparable()).
+    gateway_on = os.environ.get("BENCH_GATEWAY") == "1"
+    gateway_rate = None
+    gateway_service = None
+    gateway_summary = None
+    if gateway_on and (
+        fleet_n
+        or turn_hook is not None
+        or not getattr(engine, "continuous_admission", False)
+        or getattr(engine, "spec_draft", 0)
+    ):
+        _emit({
+            "metric": "rollout_tokens_per_sec_per_chip", "value": 0.0,
+            "unit": "tok/s/chip", "vs_baseline": 0.0,
+            "error": "BENCH_GATEWAY needs a local continuous-admission "
+                     "refill engine without BENCH_ENV/BENCH_SPEC_DRAFT "
+                     "(the gateway schedules the plain refill boundaries)",
+            "backend": jax.devices()[0].platform,
+        })
+        return 1
     if fleet_agg is not None:
         # first refresh sets the per-worker (ts, gen_tokens) marks off the
         # warmup round's piggybacked snapshots; the post-timing refresh
@@ -1129,31 +1175,92 @@ def main() -> int:
     sum_spec_grid = spec_grid_rounds = 0
     env_counts: list[int] = []
     env_step_ms: list[float] = []
-    for i in range(repeats):
-        if turn_hook is not None:
-            turn_hook.reset()  # per-round turn cursors + timed-only stats
-        result, dt_i = run(1 + i)
-        timed.append(dt_i)
-        if turn_hook is not None:
-            env_counts.extend(int(x) for x in turn_hook.turns)
-            env_step_ms.extend(turn_hook.step_ms)
-        # random weights rarely emit EOS, so rows typically decode max_new
-        # tokens; count actual generated lengths to stay correct if not
-        total_tokens += int(result.lengths.sum())
-        if result.steps_dispatched is None:
-            have_steps = False
-        else:
-            sum_steps += result.steps_dispatched
-        if getattr(result, "alive_slot_steps", None) is None:
-            have_alive = False
-        else:
-            sum_alive += result.alive_slot_steps
-        st = getattr(engine, "last_spec_stats", None)
-        if st and st.get("verify_grid_steps"):
-            sum_spec_grid += (
-                st["verify_grid_steps"] + st.get("draft_grid_steps", 0)
+    if gateway_on:
+        # the timed window IS the open-loop replay: wall clock covers the
+        # whole drain (queueing included), so tok/s here is goodput under
+        # the arrival process, not a closed-loop batch ceiling. Clients
+        # fire on the trace's schedule whether or not earlier requests
+        # completed — under 2× overload the queue grows, which is the
+        # point of the r19 artifact.
+        from distrl_llm_tpu.gateway import traffic as _traffic
+        from distrl_llm_tpu.gateway.scheduler import parse_tenant_quota
+        from distrl_llm_tpu.gateway.server import GatewayServer
+        from distrl_llm_tpu.gateway.service import GatewayService
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+
+        gateway_rate = float(os.environ.get("BENCH_ARRIVAL_RPS", "8"))
+        gw_floor = os.environ.get("BENCH_SHED_FLOOR")
+        if gw_floor:
+            # static class-aware shed floor (2 = scavenger only, 1 = batch
+            # too): the overload arm's stand-in for the SLO governor, so
+            # A/B rows don't depend on the governor's dwell timing. Reuses
+            # the BENCH_CONTROL_FRAC ControlLimits when both are set.
+            if control_limits is None:
+                from distrl_llm_tpu.control import ControlLimits
+
+                control_limits = ControlLimits()
+                engine.control_limits = control_limits
+            control_limits.set_shed(True, floor=int(gw_floor))
+        gateway_service = GatewayService(
+            engine, params, CharTokenizer(cfg.vocab_size), lora=lora,
+            quota=parse_tenant_quota(
+                os.environ.get("BENCH_TENANT_QUOTA") or None
+            ),
+            max_groups_per_round=int(
+                os.environ.get("BENCH_MAX_CONCURRENT", "0")
+                or getattr(engine, "max_concurrent_rows", 0) or 8
+            ),
+            seed=7,
+        ).start()
+        gateway_server = GatewayServer(gateway_service, port=0)
+        try:
+            arrivals = _traffic.synthesize(
+                seed=7, n_requests=n_prompts, rate_rps=gateway_rate,
+                process=os.environ.get("BENCH_ARRIVAL_PROCESS", "burst"),
+                max_prompt_tokens=max_prompt, max_new_tokens=max_new,
             )
-            spec_grid_rounds += 1
+            t0_gw = time.perf_counter()
+            gateway_summary = _traffic.replay(gateway_server.url, arrivals)
+            timed.append(time.perf_counter() - t0_gw)
+        finally:
+            gateway_server.close()
+            gateway_service.close()
+        total_tokens = sum(
+            int(c["gen_tokens"])
+            for c in gateway_summary["by_class"].values()
+        )
+        # per-step occupancy counters describe ONE generate() round; the
+        # gateway runs many small rounds whose drain tails overlap client
+        # arrivals, so those quotients would not mean what they mean on
+        # batch rows — honest null
+        have_steps = have_alive = False
+    else:
+        for i in range(repeats):
+            if turn_hook is not None:
+                turn_hook.reset()  # per-round turn cursors + timed stats
+            result, dt_i = run(1 + i)
+            timed.append(dt_i)
+            if turn_hook is not None:
+                env_counts.extend(int(x) for x in turn_hook.turns)
+                env_step_ms.extend(turn_hook.step_ms)
+            # random weights rarely emit EOS, so rows typically decode
+            # max_new tokens; count actual generated lengths to stay
+            # correct if not
+            total_tokens += int(result.lengths.sum())
+            if result.steps_dispatched is None:
+                have_steps = False
+            else:
+                sum_steps += result.steps_dispatched
+            if getattr(result, "alive_slot_steps", None) is None:
+                have_alive = False
+            else:
+                sum_alive += result.alive_slot_steps
+            st = getattr(engine, "last_spec_stats", None)
+            if st and st.get("verify_grid_steps"):
+                sum_spec_grid += (
+                    st["verify_grid_steps"] + st.get("draft_grid_steps", 0)
+                )
+                spec_grid_rounds += 1
     steps_dispatched = sum_steps if have_steps else None
     alive_slot_steps = sum_alive if have_alive else None
     if fleet_agg is not None:
@@ -1169,7 +1276,11 @@ def main() -> int:
     # mean over ALL repeats' candidates (the last run alone can be a
     # length outlier under EOS sampling, skewing mfu/roofline vs the
     # all-repeats tps numerator)
-    mean_new = total_tokens / (n_prompts * n_cand * repeats)
+    # gateway rows run one request-group per prompt (n=1, single replay);
+    # batch rows run n_cand candidates per prompt across every repeat
+    mean_new = total_tokens / (
+        n_prompts if gateway_on else n_prompts * n_cand * repeats
+    )
     mean_kv = mean_prompt_len + mean_new / 2.0  # KV grows linearly over decode
     flops_per_token = _decode_flops_per_token(cfg, mean_kv)
     mfu = tps_chip * flops_per_token / (peak_tflops * 1e12)
@@ -1551,6 +1662,28 @@ def main() -> int:
             (getattr(engine, "last_pool_stats", None) or {})
             .get("shed_groups")
         ),
+        # serving-gateway provenance (ISSUE 19, pinned in
+        # tests/test_bench_contract.py): BENCH_GATEWAY rows drive an
+        # open-loop arrival trace through the streaming front-end, so
+        # tok/s is goodput under load, only comparable to other gateway
+        # rows at the same arrival rate (bench_history comparable()).
+        # Per-class p99 TTFT comes from the server-side ledger — the
+        # overload A/B's contract is bounded interactive p99 while the
+        # shed floor pushes deferrals onto batch/scavenger.
+        # shed_frac_by_class: each class's share of shed+preempt
+        # deferral events over the whole replay (sums to 1.0; null when
+        # nothing was deferred or off-gateway).
+        "gateway_mode": gateway_on,
+        "arrival_rate": gateway_rate,
+        "ttft_p99_interactive_ms": (
+            _serving_pct(serving_ledger, "ttft_ms", 99, cls="interactive")
+            if gateway_on else None
+        ),
+        "ttft_p99_batch_ms": (
+            _serving_pct(serving_ledger, "ttft_ms", 99, cls="batch")
+            if gateway_on else None
+        ),
+        "shed_frac_by_class": _gateway_shed_frac(gateway_service),
         # measured-attribution fields (ISSUE 8, pinned in
         # tests/test_bench_contract.py): device HBM watermark (null on
         # backends without memory stats), shape-keyed retrace count since

@@ -536,6 +536,25 @@ class TrainConfig:
     # the limit dumps a flight-recorder bundle. Require --sentinel.
     slo_ttft_ms: float | None = None
     slo_queue_wait_ms: float | None = None
+    # --- multi-tenant serving gateway (distrl_llm_tpu/gateway/, ISSUE 19) -
+    # Streaming HTTP front-end + priority-class scheduling over the
+    # continuous-admission engine: POST /v1/generate streams tokens as the
+    # refill loop emits them, requests carry tenant + priority class
+    # (interactive > batch > scavenger) from headers, and the gateway's
+    # round former drains its open queue class-then-FIFO-with-aging.
+    # gateway_port None = gateway off (the default; off is byte-identical
+    # to a build without the subsystem). 0 = auto-assign (the bound port
+    # is printed as "GATEWAY <n>").
+    gateway_port: int | None = None
+    # comma-separated subset of priority classes this gateway serves
+    # (empty = all three). Requests naming an unserved class are rejected
+    # with HTTP 400, never silently reclassified.
+    gateway_classes: str | None = None
+    # per-tenant reserved-token quotas, "tenant=tokens,..."; the pseudo-
+    # tenant "default" caps tenants not named. Admission declines on quota
+    # are the ``quota`` stall reason in the serving ledger's conservation
+    # sum. Requires the gateway (dead otherwise).
+    tenant_quota: str | None = None
     # --- training-dynamics observability (learn_obs.py, ISSUE 16) ---------
     # Device-computed training-dynamics bundle fused into the jitted train
     # step (learner/train_step.py emit_dynamics): masked policy entropy,
@@ -873,6 +892,45 @@ class TrainConfig:
                     "continuous loops — requires engine_impl='paged' and "
                     "continuous_batching"
                 )
+        # --- serving gateway validation (ISSUE 19) ------------------------
+        if self.gateway_port is not None:
+            if not (0 <= self.gateway_port <= 65535):
+                raise ValueError(
+                    f"gateway_port must be in [0, 65535] (0 = auto-assign), "
+                    f"got {self.gateway_port}"
+                )
+            if (
+                self.engine_impl != "paged"
+                or not self.continuous_batching
+                or not self.continuous_admission
+            ):
+                raise ValueError(
+                    "the serving gateway schedules the continuous-admission "
+                    "refill engine — requires engine_impl='paged', "
+                    "continuous_batching, and continuous_admission"
+                )
+            if self.rollout_workers:
+                raise ValueError(
+                    "the serving gateway fronts a LOCAL engine; over "
+                    "rollout_workers arm it worker-side "
+                    "(worker_main --gateway-port)"
+                )
+            # validate eagerly so a bad spec fails at config time, not when
+            # the first request arrives
+            from distrl_llm_tpu.gateway.scheduler import (
+                parse_gateway_classes,
+                parse_tenant_quota,
+            )
+            parse_gateway_classes(self.gateway_classes)
+            parse_tenant_quota(self.tenant_quota)
+        elif self.gateway_classes or self.tenant_quota:
+            # dead-flag policy: class/quota knobs shape the gateway's
+            # admission plane only
+            raise ValueError(
+                "gateway_classes/tenant_quota configure the serving "
+                "gateway — set gateway_port (they would be silently "
+                "ignored otherwise)"
+            )
         # decode_scan_chunk covers every engine_impl and scheduler (dense,
         # paged wave + refill + speculative, paged_sharded)
         if self.continuous_batching and (

@@ -157,7 +157,8 @@ class SloShedGovernor:
                  slo_ttft_ms: float | None = None,
                  slo_queue_wait_ms: float | None = None,
                  release_frac: float = 0.7, cooldown_steps: int = 2,
-                 dwell_steps: int = 2, shed_max_steps: int = 8):
+                 dwell_steps: int = 2, shed_max_steps: int = 8,
+                 class_aware: bool = False):
         if slo_ttft_ms is None and slo_queue_wait_ms is None:
             raise ValueError(
                 "SloShedGovernor needs at least one SLO "
@@ -179,6 +180,14 @@ class SloShedGovernor:
         self.cooldown_steps = int(cooldown_steps)
         self.dwell_steps = int(dwell_steps)
         self.shed_max_steps = int(shed_max_steps)
+        # class-aware shedding (ISSUE 19, gateway rounds): engage at floor
+        # 2 (scavenger only) and escalate to floor 1 (batch too) if the
+        # breach persists one dwell past the engage — NEVER floor 0, so
+        # interactive admissions are untouchable by this governor. Without
+        # class_aware the floor is pinned 0 (the pre-gateway semantics,
+        # and what non-gateway rounds read regardless).
+        self.class_aware = bool(class_aware)
+        self.shed_floor = 0
         self.shed = False
         self._shed_since: int | None = None
         self._ok_run = 0
@@ -225,7 +234,10 @@ class SloShedGovernor:
 
         def push():
             self.shed = engage
-            self.limits.set_shed(engage)
+            self.shed_floor = (
+                2 if (engage and self.class_aware) else 0
+            )
+            self.limits.set_shed(engage, floor=self.shed_floor)
             telemetry.gauge_set(CONTROL_SHED_ACTIVE, float(engage))
 
         # a RELEASE restores the default state and is budget-FREE: an
@@ -271,6 +283,30 @@ class SloShedGovernor:
                 )
         else:
             self._ok_run = 0
+            if (
+                self.class_aware and self.shed_floor == 2
+                and v is not None and v > 1.0
+                and self._shed_since is not None
+                and step - self._shed_since >= self.dwell_steps
+                and self._cooled(step, runtime)
+            ):
+                # persistent breach with scavenger already shed: widen to
+                # batch (floor 1). Interactive stays admitted — floor 0 is
+                # unreachable for a class-aware shedder.
+                action = ControlAction(
+                    step=step, controller=self.name, actuator="shed",
+                    kind="engage", old=2.0, new=1.0,
+                    reason=f"latency still {v:.3g}x SLO with scavenger "
+                           f"shed: widening shed to batch",
+                )
+
+                def widen():
+                    self.shed_floor = 1
+                    self.limits.set_shed(True, floor=1)
+
+                if runtime.act(action, apply=widen):
+                    self._last_action_step = step
+                    return [action]
         return []
 
     def on_trigger(self, trigger: str, step: int, runtime: ControlRuntime,

@@ -97,6 +97,13 @@ class ControlLimits:
         self._mu = threading.Lock()
         self._admission_frac = 1.0
         self._shed = False
+        # class-aware shed floor (ISSUE 19): the lowest priority RANK the
+        # shed gate still blocks — 0 blocks every class (the pre-gateway
+        # behavior, and the only value non-gateway rounds ever read), 2
+        # blocks only scavenger. Consulted by the engine ONLY on rounds
+        # carrying gateway identity, so the default handle stays the
+        # identity for everything else.
+        self._shed_floor = 0
 
     # ---- governor side -----------------------------------------------
 
@@ -109,9 +116,10 @@ class ControlLimits:
         with self._mu:
             self._admission_frac = min(max(float(frac), 0.0), 1.0)
 
-    def set_shed(self, active: bool) -> None:
+    def set_shed(self, active: bool, floor: int = 0) -> None:
         with self._mu:
             self._shed = bool(active)
+            self._shed_floor = max(0, int(floor)) if active else 0
 
     # ---- engine side -------------------------------------------------
 
@@ -126,6 +134,12 @@ class ControlLimits:
     def shed_active(self) -> bool:
         with self._mu:
             return self._shed
+
+    def shed_floor(self) -> int:
+        """Lowest priority rank the active shed gate blocks (0 = all
+        classes; meaningful only while ``shed_active()``)."""
+        with self._mu:
+            return self._shed_floor
 
 
 @dataclass

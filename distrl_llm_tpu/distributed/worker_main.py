@@ -458,16 +458,23 @@ def handler(payload: bytes) -> bytes:
         # (weight-bus pushes landing mid-generation) ship back with the
         # result — the driver merges them into its trajectory version tags
         swaps_before = len(getattr(engine, "last_swap_steps", ()))
+        # when the serving gateway is armed (ISSUE 19) its round former
+        # shares this engine — the mutex serializes trainer dispatches
+        # against gateway rounds (absent a gateway there is no mutex and
+        # nothing changes)
+        from contextlib import nullcontext
+
         with telemetry.span(
             "worker/generate", rows=int(arg["prompt_ids"].shape[0]),
             n=int(arg["sampling"].get("n", 1)),
         ) as sp:
-            result = engine.generate(
-                _ENGINE_STATE["params"], lora,
-                arg["prompt_ids"], arg["prompt_mask"],
-                SamplingConfig(**arg["sampling"]),
-                jax.random.PRNGKey(arg["rng_seed"]),
-            )
+            with _ENGINE_STATE.get("engine_mutex") or nullcontext():
+                result = engine.generate(
+                    _ENGINE_STATE["params"], lora,
+                    arg["prompt_ids"], arg["prompt_mask"],
+                    SamplingConfig(**arg["sampling"]),
+                    jax.random.PRNGKey(arg["rng_seed"]),
+                )
             sp.set(tokens=int(result.lengths.sum()))
         ctrl = _ENGINE_STATE.get("control")
         if ctrl is not None:
@@ -626,6 +633,29 @@ def main(argv: list[str] | None = None) -> None:
                         default=1024,
                         help="bounded ring of OPEN serving records; "
                              "overflow counted in serving/ring_evictions")
+    parser.add_argument("--gateway-port", dest="gateway_port", type=int,
+                        default=None,
+                        help="multi-tenant serving gateway (ISSUE 19): "
+                             "serve POST /v1/generate on 127.0.0.1:<port> "
+                             "(0 = auto; the bound port prints as "
+                             "'GATEWAY <n>'), streaming tokens per request "
+                             "with tenant + priority class from X-Tenant / "
+                             "X-Priority headers; requires --serve-model, "
+                             "--scheduler refill and "
+                             "--continuous-admission")
+    parser.add_argument("--gateway-classes", dest="gateway_classes",
+                        type=str, default=None,
+                        help="comma-separated subset of priority classes "
+                             "this gateway serves (default: interactive,"
+                             "batch,scavenger); unserved classes get "
+                             "HTTP 400")
+    parser.add_argument("--tenant-quota", dest="tenant_quota", type=str,
+                        default=None,
+                        help="per-tenant reserved-token quotas "
+                             "'tenant=tokens,...' ('default' caps unnamed "
+                             "tenants); quota declines are the 'quota' "
+                             "admission-stall reason (requires "
+                             "--gateway-port)")
     # default 0.0 (worst-case page pool) vs the driver's reference-parity
     # 0.91: an unconfigured worker must size for the worst case rather
     # than assume it owns 91% of an unknown chip's HBM
@@ -842,6 +872,36 @@ def main(argv: list[str] | None = None) -> None:
             "--kv-spill-host-mb caps the --kv-spill host store — it "
             "would be a dead knob without it"
         )
+    # serving gateway (ISSUE 19): driver-parity validation — the gateway
+    # schedules the continuous-admission refill engine
+    if args.gateway_port is not None:
+        if not (0 <= args.gateway_port <= 65535):
+            parser.error("--gateway-port must be in [0, 65535] (0 = auto)")
+        if not args.serve_model:
+            parser.error("--gateway-port requires --serve-model (the "
+                         "gateway fronts this worker's engine)")
+        if not (args.scheduler == "refill" and args.continuous_admission):
+            parser.error(
+                "--gateway-port requires --scheduler refill with "
+                "--continuous-admission (the request-queue scheduler is "
+                "the gateway's admission plane)"
+            )
+        from distrl_llm_tpu.gateway.scheduler import (
+            parse_gateway_classes, parse_tenant_quota,
+        )
+
+        try:
+            parse_gateway_classes(args.gateway_classes)
+            parse_tenant_quota(args.tenant_quota)
+        except ValueError as e:
+            parser.error(str(e))
+    elif args.gateway_classes or args.tenant_quota:
+        # dead-flag policy (driver parity): class/quota knobs shape the
+        # gateway's admission plane only
+        parser.error(
+            "--gateway-classes/--tenant-quota configure the serving "
+            "gateway — set --gateway-port (they would be silently ignored)"
+        )
     if args.serving_dir and not args.serving_obs:
         args.serving_obs = True  # an output directory is an unambiguous ask
     if args.serving_obs and args.scheduler != "refill":
@@ -945,6 +1005,48 @@ def main(argv: list[str] | None = None) -> None:
         # possible over the control plane
         server.weights_handler = weights_handler
 
+    gateway_server = None
+    gateway_service = None
+    if args.gateway_port is not None:
+        # multi-tenant serving gateway (ISSUE 19): the service forms
+        # class-ordered rounds on THIS worker's engine, serialized against
+        # the control plane's generate op through the shared engine mutex
+        # (the op acquires it below); the worker's serving ledger and
+        # control limits stay attached — gateway rounds record into the
+        # same ledger with tenant/priority stamped on each group
+        import threading as _threading
+
+        from distrl_llm_tpu.gateway.scheduler import (
+            parse_gateway_classes, parse_tenant_quota,
+        )
+        from distrl_llm_tpu.gateway.server import GatewayServer
+        from distrl_llm_tpu.gateway.service import GatewayService
+
+        if args.serve_model == "tiny":
+            from distrl_llm_tpu.models import TINY
+            from distrl_llm_tpu.tokenizer import CharTokenizer
+
+            gw_tok = CharTokenizer(TINY.vocab_size)
+        else:
+            from distrl_llm_tpu.tokenizer import load_tokenizer
+
+            gw_tok = load_tokenizer(args.serve_model)
+        engine_mutex = _threading.Lock()
+        _ENGINE_STATE["engine_mutex"] = engine_mutex
+        gateway_service = GatewayService(
+            _ENGINE_STATE["engine"], _ENGINE_STATE["params"], gw_tok,
+            classes=parse_gateway_classes(args.gateway_classes),
+            quota=parse_tenant_quota(args.tenant_quota),
+            max_groups_per_round=max(
+                1, args.max_concurrent_sequences or 8
+            ),
+            seed=args.seed,
+            engine_lock=engine_mutex,
+        ).start()
+        gateway_server = GatewayServer(
+            gateway_service, port=args.gateway_port
+        )
+
     metrics_server = None
     if args.metrics_port is not None:
         from distrl_llm_tpu import telemetry
@@ -966,7 +1068,13 @@ def main(argv: list[str] | None = None) -> None:
     print(f"PORT {server.port}", flush=True)
     if metrics_server is not None:
         print(f"METRICS {metrics_server.port}", flush=True)
+    if gateway_server is not None:
+        print(f"GATEWAY {gateway_server.port}", flush=True)
     server.serve_forever(handler)
+    if gateway_server is not None:
+        gateway_server.close()
+    if gateway_service is not None:
+        gateway_service.close()
     if metrics_server is not None:
         metrics_server.close()
     serving_ledger = _ENGINE_STATE.get("serving_ledger")

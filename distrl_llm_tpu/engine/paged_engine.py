@@ -1630,6 +1630,27 @@ class PagedGenerationEngine(LoraMailbox):
                    f"prefix_cache" if self.prefix_cache else "")
                 + ")"
             )
+        if (
+            max_kv_pages and self.continuous_admission
+            and max_kv_pages < pool_floor + self.private_pages
+        ):
+            # above the hard floor but wedge-prone (ISSUE 19 satellite, the
+            # BENCH_KV_PAGES<=16 gotcha): a budget that cannot hold the
+            # head group's chain plus TWO private regions serializes every
+            # admission behind a full drain, and a mid-round decline with
+            # no live slot trips the wedge detector. Warn at build time
+            # with the number, don't wait for the round to stall.
+            import warnings
+
+            warnings.warn(
+                f"max_kv_pages={max_kv_pages} is wedge-prone under "
+                f"continuous admission: the pool fits one sequence (floor "
+                f"{pool_floor}) but cannot overlap the next admission's "
+                f"private region — minimum comfortable budget is "
+                f"{pool_floor + self.private_pages} pages "
+                f"({pool_floor} floor + {self.private_pages} private)",
+                RuntimeWarning, stacklevel=2,
+            )
         self.max_kv_pages = max_kv_pages
         self.last_pool_stats: dict | None = None
         # request-level serving observability (ISSUE 13): when an owner
@@ -1658,6 +1679,20 @@ class PagedGenerationEngine(LoraMailbox):
         # observation the engine could not seat. None = one attribute check
         # per idle pass — single-turn rounds and byte-identity pins untouched
         self.turn_hook: Any = None
+        # multi-tenant gateway identity (ISSUE 19): when a gateway owner
+        # attaches per-round tenancy here, the continuous-admission loop
+        # schedules by priority class. ``round_meta`` maps group index ->
+        # {"tenant", "cls", "rank", "seq", "arrival_ts", "trace_ctx"};
+        # ``quota_book`` is a gateway.TenantQuotaBook consulted (charge at
+        # admission, credit at group finish) with the ``quota`` stall
+        # reason on decline; ``stream_hook`` is ``fn(cand, token_list)``
+        # called with newly visible tokens at host boundaries plus a
+        # byte-complete final flush at round end. All three default None =
+        # one attribute check per site — non-gateway rounds and the
+        # byte-identity pins are untouched (pinned in tests/test_gateway.py)
+        self.round_meta: Any = None
+        self.quota_book: Any = None
+        self.stream_hook: Any = None
         # per-round speculative stats (refill spec rounds only): drafter,
         # realized accept rate, tokens/verify-step, emit histogram, verify
         # kernel choice + grid steps, draft/target version bookkeeping
@@ -2081,6 +2116,13 @@ class PagedGenerationEngine(LoraMailbox):
         # chain-cap scale and shed gate at its existing decision points —
         # a handle at its defaults decides identically to None (pinned)
         limits = self.control_limits
+        # gateway tenancy (ISSUE 19): one attribute read per round when
+        # unarmed; armed, admit_groups orders by class-then-FIFO-with-aging,
+        # the quota book gates admissions, preemption prefers low classes,
+        # and the stream hook flushes tokens at host boundaries
+        meta = self.round_meta
+        qb = self.quota_book
+        stream = self.stream_hook
         t_enqueue = time.time()
 
         real_len_h = np.asarray(prompt_mask).sum(axis=-1).astype(np.int64)
@@ -2446,9 +2488,18 @@ class PagedGenerationEngine(LoraMailbox):
             # any admission, so prefill-done lands here too
             for g in range(b):
                 if row_alive[g]:
+                    mg = meta.get(g) if meta is not None else None
                     suid[g] = sl.on_enqueue(
                         g, n=n, prompt_tokens=int(real_len_h[g]),
-                        ts=t_enqueue,
+                        tenant=mg.get("tenant") if mg else None,
+                        priority=mg.get("cls") if mg else None,
+                        trace_ctx=mg.get("trace_ctx") if mg else None,
+                        # gateway rounds stamp the request's true ARRIVAL
+                        # time so queue_wait/TTFT include the open-queue
+                        # wait, not just the in-round wait
+                        ts=(
+                            mg.get("arrival_ts") or t_enqueue
+                        ) if mg else t_enqueue,
                     )
             if not continuous:
                 for uid_g in suid.values():
@@ -2458,6 +2509,38 @@ class PagedGenerationEngine(LoraMailbox):
         boundary_admits = 0  # admissions (slots + prefills) this host pass
         fill_declined: str | None = None  # fill_idle's head-of-line decline
         shed_groups_seen: set[int] = set()  # groups the shedder deferred
+        # gateway round-local bookkeeping (ISSUE 19; all dead when meta is
+        # None): per-group quota reservations, deterministic aging counters,
+        # per-candidate streamed-token cursors, the declined head group's
+        # class for the per-class stall attribution, and the per-class
+        # shed/preempt action tally the bench artifact scores
+        quota_charged: dict[int, int] = {}
+        group_waited: dict[int, int] = {}
+        stream_sent: dict[int, int] = {}
+        decline_cls: str | None = None
+        class_actions: dict[str, dict[str, int]] = {
+            "shed": {}, "preempt": {},
+        }
+        # turn-resume declines for lack of max_new_tokens window (the
+        # PR 17 CharTokenizer gotcha): (obs_tokens, needed_window) pairs,
+        # warned once per round when EVERY resume was window-declined
+        window_declines: list[tuple[int, int]] = []
+        window_short = 0  # resumes never offered: no room for obs+1 at all
+
+        def cls_of(g: int) -> str | None:
+            mg = meta.get(g) if meta is not None else None
+            return mg.get("cls") if mg else None
+
+        def rank_of(g: int) -> int:
+            mg = meta.get(g) if meta is not None else None
+            return int(mg.get("rank", 0)) if mg else 0
+
+        def eff_rank(g: int) -> int:
+            # FIFO-with-aging (no starvation): every 16 passed-over
+            # admission passes promote the group one class step toward
+            # rank 0 — pass counters, never wall clock, so the schedule
+            # is deterministic and replayable
+            return max(0, rank_of(g) - group_waited.get(g, 0) // 16)
         dispatched = 0
         turn_resumes = 0  # in-place episode continuations (turn hook)
         turn_saved = 0  # resident-prefix tokens those resumes never re-prefilled
@@ -2470,6 +2553,17 @@ class PagedGenerationEngine(LoraMailbox):
             finished[c] = True
             if sl is not None:
                 sl.on_finish(suid.get(c // n), c)
+            if qb is not None and quota_charged.get(c // n):
+                g_q = c // n
+                if bool(finished[g_q * n:(g_q + 1) * n].all()):
+                    # the group's last candidate finished: release its
+                    # tenant's token reservation (charge at admission,
+                    # credit at close — the quota bounds in-flight
+                    # footprint, not lifetime usage)
+                    qb.credit(
+                        (meta.get(g_q) or {}).get("tenant", ""),
+                        quota_charged.pop(g_q),
+                    )
             if sharing:
                 g = c // n
                 group_left[g] -= 1
@@ -2594,6 +2688,65 @@ class PagedGenerationEngine(LoraMailbox):
             pending.extend(range(g * n, (g + 1) * n))
             return True
 
+        def try_admit_group(g: int) -> str | None:
+            """One group's admission decision: the decline reason, or None
+            when the group was admitted. Shared by the FIFO path and the
+            gateway's class-ordered path — the checks and their order are
+            identical, so non-gateway rounds decide exactly as before."""
+            if limits is not None and limits.shed_active() and (
+                pending or bool((host_cand < total).any())
+            ) and rank_of(g) >= (
+                limits.shed_floor() if meta is not None else 0
+            ):
+                # class-aware shed (ISSUE 19): the governor's shed floor
+                # names the lowest rank still admitted — scavenger sheds
+                # before batch, interactive never sheds at floor >= 1.
+                # Without gateway identity every group is rank 0 and the
+                # floor is pinned 0: the ISSUE 14 behavior, bit for bit
+                if g not in shed_groups_seen:
+                    # counted once per deferred group, however many
+                    # passes decline it (the bench row's shed_groups)
+                    shed_groups_seen.add(g)
+                    telemetry.counter_add(CONTROL_SHED_GROUPS)
+                    c_g = cls_of(g)
+                    if c_g is not None:
+                        class_actions["shed"][c_g] = (
+                            class_actions["shed"].get(c_g, 0) + 1
+                        )
+                return "shed"
+            if len(pending) >= r_slots:
+                return "no_slots"
+            cap = r_slots + 1
+            if limits is not None:
+                cap = limits.chain_cap(cap)
+            if len(pool.chains) >= cap:
+                return "chain_cap"
+            if qb is not None and meta is not None and g not in quota_charged:
+                # per-tenant token quota (ISSUE 19): reserve the group's
+                # WORST-CASE footprint (prompt + full output window) before
+                # touching pool state; a decline is the ``quota`` stall
+                # reason. The charge sticks across declined passes (the
+                # group stays queued) and credits back at group finish.
+                mg = meta.get(g)
+                if mg is not None:
+                    # the window is the REQUEST's own budget when the meta
+                    # carries one (the gateway caps each request below the
+                    # round max) — this keeps the charge equal to what the
+                    # gateway's submit-time quota check priced, so a request
+                    # that entered the queue can always eventually admit
+                    charge = int(real_len_h[g]) + n * min(
+                        max_steps, int(mg.get("max_new", max_steps))
+                    )
+                    if not qb.try_charge(mg.get("tenant", ""), charge):
+                        return "quota"
+                    quota_charged[g] = charge
+            n_chain = max(-(-int(real_len_h[g]) // ps), 1)
+            if pool.free_pages < n_chain + self.private_pages:
+                return "no_pages"
+            if not admit_group(g):
+                return "no_pages"
+            return None
+
         def admit_groups() -> str | None:
             """Admission-ahead: keep the candidate queue stocked while the
             pool can afford the head group's chain AND a full private
@@ -2607,32 +2760,52 @@ class PagedGenerationEngine(LoraMailbox):
             GROUP admissions with the ``shed`` reason — but only while the
             engine has live work to drain (shedding an otherwise-empty
             engine would wedge it, not protect it); the HBM governor's
-            admission fraction scales the live-chain cap."""
+            admission fraction scales the live-chain cap.
+
+            Gateway rounds (ISSUE 19, ``meta`` armed): groups are visited
+            in class-then-FIFO-with-aging order, and a POLICY decline
+            (shed/quota) on one group skips ahead to the next — an
+            interactive group never waits behind a shed scavenger. A
+            RESOURCE decline (slots/pages/chain cap) still ends the pass
+            head-of-line, exactly like the FIFO path, so pool pressure
+            keeps its auditable ordering."""
+            nonlocal decline_cls
+            decline_cls = None
+            if meta is None:
+                while group_queue:
+                    reason = try_admit_group(group_queue[0])
+                    if reason is not None:
+                        return reason
+                    group_queue.popleft()
+                return None
             while group_queue:
-                if limits is not None and limits.shed_active() and (
-                    pending or bool((host_cand < total).any())
-                ):
-                    g = group_queue[0]
-                    if g not in shed_groups_seen:
-                        # counted once per deferred group, however many
-                        # passes decline it (the bench row's shed_groups)
-                        shed_groups_seen.add(g)
-                        telemetry.counter_add(CONTROL_SHED_GROUPS)
-                    return "shed"
-                if len(pending) >= r_slots:
-                    return "no_slots"
-                cap = r_slots + 1
-                if limits is not None:
-                    cap = limits.chain_cap(cap)
-                if len(pool.chains) >= cap:
-                    return "chain_cap"
-                g = group_queue[0]
-                n_chain = max(-(-int(real_len_h[g]) // ps), 1)
-                if pool.free_pages < n_chain + self.private_pages:
-                    return "no_pages"
-                if not admit_group(g):
-                    return "no_pages"
-                group_queue.popleft()
+                order = sorted(
+                    group_queue,
+                    key=lambda g: (
+                        eff_rank(g), (meta.get(g) or {}).get("seq", g),
+                    ),
+                )
+                head_reason: str | None = None
+                admitted_g: int | None = None
+                for g in order:
+                    reason = try_admit_group(g)
+                    if reason is None:
+                        admitted_g = g
+                        break
+                    if head_reason is None:
+                        head_reason = reason
+                        decline_cls = cls_of(g)
+                    if reason not in ("shed", "quota"):
+                        break  # resource decline: no skip-ahead past it
+                if admitted_g is None:
+                    for g in group_queue:
+                        group_waited[g] = group_waited.get(g, 0) + 1
+                    return head_reason
+                group_queue.remove(admitted_g)
+                for g in group_queue:
+                    # passed-over groups age one pass per admission ahead
+                    # of them (the deterministic starvation valve)
+                    group_waited[g] = group_waited.get(g, 0) + 1
             return None
         # graftcheck: end-hot-region
 
@@ -2838,6 +3011,11 @@ class PagedGenerationEngine(LoraMailbox):
                 pool.preemptions += 1
                 if sl is not None:
                     sl.on_preempt(suid.get(c // n), c)
+                c_g = cls_of(c // n)
+                if c_g is not None:
+                    class_actions["preempt"][c_g] = (
+                        class_actions["preempt"].get(c_g, 0) + 1
+                    )
             pool.release(s_i)
             kill_cand = np.full(r_slots, total, np.int32)
             kill_mask = np.zeros(r_slots, bool)
@@ -2859,7 +3037,7 @@ class PagedGenerationEngine(LoraMailbox):
             can close the episode as truncated; declining instead of
             preempting victims keeps turn continuation strictly lower
             priority than first-turn progress."""
-            nonlocal state, budget, turn_resumes, turn_saved
+            nonlocal state, budget, turn_resumes, turn_saved, window_short
             # blocking read of the candidate's CURRENT truth: done is
             # monotone per epoch, so the occupant has truly finished; turn
             # boundaries are rare relative to decode steps, same cost
@@ -2869,6 +3047,7 @@ class PagedGenerationEngine(LoraMailbox):
                 # no room for even one observation + one decode token: the
                 # hook is never consulted, the driver scores the final turn
                 # from the result tensors
+                window_short += 1
                 return False
             tokens = np.asarray(state.out[c][:gen_len]).astype(np.int32)
             obs = th(c, tokens)
@@ -2877,6 +3056,10 @@ class PagedGenerationEngine(LoraMailbox):
             obs = np.asarray(obs, np.int32).ravel()
             t_obs = int(obs.size)
             if t_obs == 0 or gen_len + t_obs + 1 > max_steps:
+                if t_obs > 0:
+                    # window decline, not an empty observation: remember
+                    # what WOULD have fit for the round-end diagnostic
+                    window_declines.append((t_obs, gen_len + t_obs + 1))
                 th.declined(c)
                 return False
             rl = int(real_len_h[c // n])
@@ -2930,12 +3113,26 @@ class PagedGenerationEngine(LoraMailbox):
                     # every slot is busy (or the pass offered no idle
                     # slot): the queue waits on decode progress
                     reason = "no_slots"
+            cls = None
+            if reason is not None and meta is not None:
+                # class attribution (ISSUE 19): the declined head's class —
+                # the group admit_groups declined, else the pending head's
+                # group, else the queue head
+                if reason == group_decline and decline_cls is not None:
+                    cls = decline_cls
+                elif pending:
+                    e0 = pending[0]
+                    c0 = e0[0] if isinstance(e0, tuple) else e0
+                    cls = cls_of(c0 // n)
+                elif group_queue:
+                    cls = cls_of(group_queue[0])
             sl.on_boundary(
                 live_slots=int((host_cand < total).sum()),
                 queue_depth=waiting,
                 free_pages=pool.free_pages,
                 admitted=boundary_admits,
                 reason=reason,
+                cls=cls,
             )
             boundary_admits = 0
             fill_declined = None
@@ -3162,6 +3359,27 @@ class PagedGenerationEngine(LoraMailbox):
                         and int(seq_h[s_i]) > int(real_len_h[c_s // n])
                     ):
                         sl.on_first_token(suid.get(c_s // n))
+            if stream is not None:
+                # gateway streaming (ISSUE 19): flush each live slot's
+                # newly visible tokens off the boundary snapshot. The
+                # snapshot's seq count is one boundary old, so the first
+                # ``gen`` output positions are already written and
+                # immutable — reading them from the CURRENT out buffer is
+                # exact. One small blocking gather per streaming slot per
+                # boundary, gateway-armed rounds only (opt-in cost); the
+                # round-end flush below guarantees byte-complete streams
+                # regardless of boundary cadence
+                for s_i in range(r_slots):
+                    c_s = int(snap_cand[s_i])
+                    if c_s >= total or snap_epoch[s_i] != epoch[s_i]:
+                        continue
+                    gen = int(seq_h[s_i]) - int(real_len_h[c_s // n])
+                    sent = stream_sent.get(c_s, 0)
+                    if gen > sent:
+                        # graftcheck: disable=GC301 -- gateway-armed rounds only: streamed positions are immutable once written
+                        toks = np.asarray(state.out[c_s][sent:gen])
+                        stream_sent[c_s] = gen
+                        stream(c_s, [int(t) for t in toks])
             # a done flag is only believed if the slot hasn't been refilled
             # since the snapshot was dispatched (done is monotone per epoch)
             idle = [
@@ -3207,7 +3425,21 @@ class PagedGenerationEngine(LoraMailbox):
                             if host_cand[v] < total and v != s_i
                             and snap_epoch[v] == epoch[v]
                         ]
-                        if occupied:
+                        if occupied and meta is not None:
+                            # class-aware preemption (ISSUE 19): evict the
+                            # highest-rank (lowest-priority) occupant first
+                            # — scavenger before batch before interactive —
+                            # least progress within a class. Non-gateway
+                            # rounds keep the pure least-progress victim
+                            victim = min(
+                                occupied,
+                                key=lambda v: (
+                                    -rank_of(int(host_cand[v]) // n),
+                                    int(seq_h[v])
+                                    - int(real_len_h[int(host_cand[v]) // n]),
+                                ),
+                            )
+                        elif occupied:
                             victim = min(
                                 occupied,
                                 key=lambda v: int(seq_h[v])
@@ -3249,6 +3481,21 @@ class PagedGenerationEngine(LoraMailbox):
                     stalled_boundaries += 1
                     wedged = True
                     if stalled_boundaries > 4:
+                        # name the MINIMUM VIABLE page budget (ISSUE 19
+                        # satellite): what the head of the queue needs to
+                        # admit — chain pages for its prompt plus the full
+                        # private region the admission gate reserves — so
+                        # the fix is a number, not a bisection
+                        if group_queue:
+                            g_h = group_queue[0]
+                            rl_h = int(real_len_h[g_h])
+                        else:
+                            e0 = pending[0]
+                            c0 = e0[0] if isinstance(e0, tuple) else e0
+                            rl_h = int(real_len_h[c0 // n])
+                        need = (
+                            max(-(-rl_h // ps), 1) + self.private_pages
+                        )
                         raise RuntimeError(
                             f"continuous admission wedged: "
                             f"{int(finished.sum())}/{total} finished, "
@@ -3256,7 +3503,12 @@ class PagedGenerationEngine(LoraMailbox):
                             f"{len(group_queue)} queued groups, no live "
                             f"slot, and the pool ({pool.free_pages} free / "
                             f"{pool.universe_pages}) cannot admit the head "
-                            f"— the page budget cannot make progress"
+                            f"— the page budget cannot make progress. "
+                            f"Minimum viable budget for the head request: "
+                            f"{need} pages (ceil(prompt {rl_h} / page_size "
+                            f"{ps}) chain + {self.private_pages} private) — "
+                            f"raise max_kv_pages / BENCH_KV_PAGES to at "
+                            f"least {need}"
                         )
                 else:
                     stalled_boundaries = 0
@@ -3319,6 +3571,13 @@ class PagedGenerationEngine(LoraMailbox):
             "shed_groups": (
                 len(shed_groups_seen) if limits is not None else None
             ),
+            # multi-tenant gateway rounds (ISSUE 19): per-class shed/preempt
+            # action tally — the bench artifact's "actions land on low
+            # classes" contract reads this (None = no gateway identity)
+            "class_actions": (
+                {k: dict(v) for k, v in class_actions.items()}
+                if meta is not None else None
+            ),
             "slot_idle_frac": (
                 round(1.0 - alive_h / (r_slots * dispatched), 4)
                 if dispatched else None
@@ -3363,8 +3622,52 @@ class PagedGenerationEngine(LoraMailbox):
                 f"refill scheduler exhausted its step budget ({budget}) with "
                 f"{missing}/{total} candidates unfinished — this is a bug"
             )
+        if th is not None and turn_resumes == 0 and (
+            window_declines or window_short
+        ):
+            # multi-turn window exhaustion (ISSUE 19 satellite, the PR 17
+            # gotcha): every turn resume this round was declined for lack
+            # of max_new_tokens window — the run silently degraded to
+            # single-turn. One warning naming the observed observation
+            # length and the minimum viable window.
+            import warnings
+
+            if window_declines:
+                obs_max = max(t for t, _ in window_declines)
+                need_w = max(w for _, w in window_declines)
+                detail = (
+                    f"observed observation length up to {obs_max} tokens; "
+                    f"minimum viable max_new_tokens window: {need_w}"
+                )
+            else:
+                detail = (
+                    f"every finished candidate was within 2 tokens of the "
+                    f"window, so no observation could seat at all "
+                    f"(max_new_tokens={max_steps})"
+                )
+            warnings.warn(
+                f"multi-turn window exhausted: all "
+                f"{len(window_declines) + window_short} turn continuations "
+                f"this round were declined for max_new_tokens room — the "
+                f"round degraded to single-turn. {detail} (need "
+                f"gen_len + obs_tokens + 1 <= max_new_tokens)",
+                RuntimeWarning, stacklevel=2,
+            )
         out = np.asarray(state.out).reshape(b, n, max_steps)
         lengths = np.asarray(state.lengths_buf).reshape(b, n)
+        if stream is not None:
+            # byte-complete final flush: whatever the boundary cadence
+            # missed (fast finishes, the last chunk) streams here from the
+            # already-host result tensors before the round returns
+            for c in range(total):
+                ln = int(lengths[c // n, c % n])
+                sent = stream_sent.get(c, 0)
+                if ln > sent:
+                    stream_sent[c] = ln
+                    stream(
+                        c,
+                        [int(t) for t in out[c // n, c % n, sent:ln]],
+                    )
         if sl is not None:
             # realized token counts close each serving record (TPOT needs
             # them); the closed records stream to the JSONL here

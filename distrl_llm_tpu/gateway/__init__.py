@@ -1,0 +1,32 @@
+"""Multi-tenant serving gateway (ISSUE 19): streaming HTTP front-end,
+priority-class scheduling, per-tenant token quotas, and an open-loop
+traffic harness over the continuous-batching engine.
+
+The package is pure attach-pattern glue: nothing here imports JAX at
+module scope, and an engine with no gateway attached is byte-identical to
+pre-gateway HEAD (the hooks are ``is not None`` checks, pinned in
+tests/test_gateway.py).
+
+* :mod:`.scheduler` — priority classes, the class-then-FIFO-with-aging
+  request queue, :class:`TenantQuotaBook`, and the single-owner
+  ``gateway/*`` telemetry series.
+* :mod:`.service` — :class:`GatewayService`: the engine-facing loop that
+  forms rounds from the open queue, attaches ``round_meta`` /
+  ``quota_book`` / ``stream_hook``, and demuxes streamed tokens back to
+  per-request subscribers.
+* :mod:`.server` — :class:`GatewayServer`: ``POST /v1/generate`` chunked
+  streaming on the obs.MetricsServer ThreadingHTTPServer pattern.
+* :mod:`.traffic` — seeded open-loop arrival processes (Poisson/burst)
+  with long-tail length distributions, JSONL-replayable.
+"""
+
+from distrl_llm_tpu.gateway.scheduler import (  # noqa: F401
+    CLASS_RANK,
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    GatewayRequest,
+    RequestQueue,
+    TenantQuotaBook,
+    parse_gateway_classes,
+    parse_tenant_quota,
+)

@@ -1,0 +1,274 @@
+"""Priority classes, the gateway request queue, and per-tenant token
+quotas (ISSUE 19).
+
+Three fixed priority classes order all gateway work:
+
+    interactive (rank 0)  >  batch (rank 1)  >  scavenger (rank 2)
+
+Rank is the scheduling currency everywhere class-awareness appears —
+admission ordering prefers LOW rank, preemption victims and load-shed
+prefer HIGH rank. Ordering within a class is FIFO **with aging**: every
+scheduling pass a request is passed over bumps a deterministic wait
+counter, and each ``AGE_PASSES`` passes promote its *effective* rank one
+step toward 0 — a scavenger request cannot starve behind an endless
+interactive stream (no wall clock in the policy: counters only, so the
+decision is replayable).
+
+:class:`TenantQuotaBook` is the admission-side token budget: each tenant
+holds at most ``quota`` reserved tokens across its in-flight groups
+(reservation = prompt + the worst-case output window, credited back at
+group close). A quota-declined admission is a first-class stall reason
+(``quota`` in serving_obs.STALL_REASONS) so the conservation contract
+``sum(stalls) == declined`` extends rather than breaks.
+
+This module is the single owner of every ``gateway/*`` telemetry series
+(graftcheck GC202); per-class and per-tenant breakdowns derive with the
+constant-prefix pattern (``f"{GATEWAY_REQUESTS}/{cls}"``)."""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from distrl_llm_tpu import telemetry
+
+# ------------------------------------------------------------- class model
+
+PRIORITY_CLASSES = ("interactive", "batch", "scavenger")
+CLASS_RANK = {c: i for i, c in enumerate(PRIORITY_CLASSES)}
+DEFAULT_CLASS = "batch"
+
+# scheduling passes a queued request must be passed over before its
+# effective rank promotes one step (deterministic aging — pass counts,
+# never wall clock)
+AGE_PASSES = 16
+
+# ------------------------------------------------------------ series names
+# (single-owner gateway/* constants, GC202; schema pinned in
+# tests/test_telemetry.py. Per-class / per-tenant breakdowns derive as
+# f"{CONST}/<suffix>" — constant-prefix derivation, GC201-legal)
+
+GATEWAY_REQUESTS = "gateway/requests"              # counter (+ /<class>)
+GATEWAY_REJECTED = "gateway/rejected"              # counter: HTTP 4xx/5xx
+GATEWAY_QUEUE_DEPTH = "gateway/queue_depth"        # gauge
+GATEWAY_ROUNDS = "gateway/rounds"                  # counter: engine rounds
+GATEWAY_STREAMED_TOKENS = "gateway/streamed_tokens"  # counter
+GATEWAY_QUOTA_DENIALS = "gateway/quota_denials"    # counter (+ /<tenant>)
+GATEWAY_QUOTA_RESERVED = "gateway/quota_reserved"  # gauge  (+ /<tenant>)
+GATEWAY_AGED_PROMOTIONS = "gateway/aged_promotions"  # counter
+
+# tenant names become telemetry-series suffixes and JSONL fields: clamp to
+# the series alphabet so a hostile header can't mint malformed series
+_TENANT_RE = re.compile(r"[^a-z0-9_]+")
+
+
+def sanitize_tenant(name: str) -> str:
+    s = _TENANT_RE.sub("_", str(name or "anon").lower()).strip("_")
+    if not s or not s[0].isalpha():
+        s = "t_" + s if s else "anon"
+    return s[:48]
+
+
+# --------------------------------------------------------------- CLI parse
+
+
+def parse_gateway_classes(spec: str | None) -> tuple[str, ...]:
+    """``--gateway_classes`` value → ordered class subset. Empty/None means
+    all three; unknown names are config errors, not silent drops."""
+    if not spec:
+        return PRIORITY_CLASSES
+    out = []
+    for tok in str(spec).split(","):
+        tok = tok.strip().lower()
+        if not tok:
+            continue
+        if tok not in CLASS_RANK:
+            raise ValueError(
+                f"unknown gateway class {tok!r} "
+                f"(expected a subset of {PRIORITY_CLASSES})"
+            )
+        if tok not in out:
+            out.append(tok)
+    if not out:
+        return PRIORITY_CLASSES
+    # preserve priority order regardless of spelling order
+    return tuple(sorted(out, key=CLASS_RANK.__getitem__))
+
+
+def parse_tenant_quota(spec: str | None) -> dict[str, int]:
+    """``--tenant_quota`` value → {tenant: max reserved tokens}. Grammar:
+    ``tenant=tokens[,tenant=tokens...]``; the pseudo-tenant ``default``
+    caps every tenant not named explicitly. Empty/None = unlimited."""
+    if not spec:
+        return {}
+    book: dict[str, int] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad --tenant_quota entry {part!r} "
+                "(expected tenant=tokens)"
+            )
+        name, val = part.split("=", 1)
+        tokens = int(val)
+        if tokens < 1:
+            raise ValueError(
+                f"--tenant_quota for {name.strip()!r} must be >= 1, "
+                f"got {tokens}"
+            )
+        book[sanitize_tenant(name)] = tokens
+    return book
+
+
+# ----------------------------------------------------------------- request
+
+
+@dataclass
+class GatewayRequest:
+    """One client request as the gateway sees it. ``seq`` is the FIFO
+    arrival stamp; ``waited_passes`` is the aging counter the queue owns."""
+
+    rid: int
+    tenant: str
+    cls: str
+    prompt_ids: Any            # np.ndarray [P] (already tokenized, padded)
+    prompt_len: int
+    max_new_tokens: int
+    temperature: float = 0.0
+    seq: int = 0
+    arrival_ts: float = 0.0
+    trace_ctx: dict | None = None   # (trace_id, dispatch_id) lineage stamp
+    waited_passes: int = 0
+    # per-request event stream the HTTP handler drains: ("tokens", text),
+    # ("done", payload) or ("error", message)
+    events: Any = field(default=None, repr=False)
+
+    @property
+    def rank(self) -> int:
+        return CLASS_RANK[self.cls]
+
+    def effective_rank(self) -> int:
+        """Aged rank: every AGE_PASSES passed-over passes promote one step
+        toward interactive; never below 0."""
+        return max(0, self.rank - self.waited_passes // AGE_PASSES)
+
+
+class RequestQueue:
+    """Class-then-FIFO-with-aging open queue. Thread-safe; ``pop_batch``
+    is the single scheduling decision point so ordering stays auditable:
+    sort key ``(effective_rank, seq)`` — class first, arrival order
+    within class, with deterministic aging as the starvation valve."""
+
+    def __init__(self, classes: tuple[str, ...] = PRIORITY_CLASSES):
+        self.classes = tuple(classes)
+        self._mu = threading.Lock()
+        self._items: list[GatewayRequest] = []
+        self._seq = 0
+
+    def push(self, req: GatewayRequest) -> None:
+        with self._mu:
+            self._seq += 1
+            req.seq = self._seq
+            self._items.append(req)
+            telemetry.counter_add(GATEWAY_REQUESTS)
+            telemetry.counter_add(f"{GATEWAY_REQUESTS}/{req.cls}")
+            telemetry.gauge_set(GATEWAY_QUEUE_DEPTH, float(len(self._items)))
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)
+
+    def pop_batch(self, max_groups: int) -> list[GatewayRequest]:
+        """Take up to ``max_groups`` requests in scheduling order; every
+        request left behind ages one pass (only when a pass actually
+        passed it over — an empty pop ages nobody)."""
+        with self._mu:
+            if not self._items or max_groups < 1:
+                return []
+            order = sorted(
+                self._items, key=lambda r: (r.effective_rank(), r.seq)
+            )
+            take = order[:max_groups]
+            taken = set(id(r) for r in take)
+            for r in self._items:
+                if id(r) not in taken:
+                    before = r.effective_rank()
+                    r.waited_passes += 1
+                    if r.effective_rank() < before:
+                        telemetry.counter_add(GATEWAY_AGED_PROMOTIONS)
+            self._items = [r for r in self._items if id(r) not in taken]
+            telemetry.gauge_set(GATEWAY_QUEUE_DEPTH, float(len(self._items)))
+            return take
+
+
+# ------------------------------------------------------------------- quota
+
+
+class TenantQuotaBook:
+    """Per-tenant reserved-token budget, charged at admission and credited
+    at group close. The reservation is the WORST-CASE footprint (prompt +
+    full output window) — the quota bounds what a tenant can pin, not what
+    it happened to emit. Thread-safe: the engine's admission loop and the
+    gateway's submit path both touch it.
+
+    ``try_charge`` is the only decision point; a False return is exactly
+    one ``quota`` admission stall when the engine declines on it."""
+
+    def __init__(self, quotas: dict[str, int] | None = None):
+        self.quotas = dict(quotas or {})
+        self.default = self.quotas.get("default")
+        self._mu = threading.Lock()
+        self.reserved: dict[str, int] = {}
+        self.denials: dict[str, int] = {}
+
+    def limit_for(self, tenant: str) -> int | None:
+        lim = self.quotas.get(tenant, self.default)
+        return None if lim is None else int(lim)
+
+    def try_charge(self, tenant: str, tokens: int) -> bool:
+        tenant = sanitize_tenant(tenant)
+        tokens = int(tokens)
+        with self._mu:
+            lim = self.limit_for(tenant)
+            held = self.reserved.get(tenant, 0)
+            if lim is not None and held + tokens > lim:
+                self.denials[tenant] = self.denials.get(tenant, 0) + 1
+                telemetry.counter_add(GATEWAY_QUOTA_DENIALS)
+                telemetry.counter_add(f"{GATEWAY_QUOTA_DENIALS}/{tenant}")
+                return False
+            self.reserved[tenant] = held + tokens
+            telemetry.gauge_set(GATEWAY_QUOTA_RESERVED,
+                                float(sum(self.reserved.values())))
+            telemetry.gauge_set(f"{GATEWAY_QUOTA_RESERVED}/{tenant}",
+                                float(self.reserved[tenant]))
+            return True
+
+    def credit(self, tenant: str, tokens: int) -> None:
+        tenant = sanitize_tenant(tenant)
+        with self._mu:
+            held = self.reserved.get(tenant, 0)
+            self.reserved[tenant] = max(0, held - int(tokens))
+            telemetry.gauge_set(GATEWAY_QUOTA_RESERVED,
+                                float(sum(self.reserved.values())))
+            telemetry.gauge_set(f"{GATEWAY_QUOTA_RESERVED}/{tenant}",
+                                float(self.reserved[tenant]))
+
+    def reset(self) -> None:
+        """Drop every reservation (a failed engine round can never reach
+        its group-finish credits — the service resets so the book cannot
+        wedge future rounds; denial counters survive)."""
+        with self._mu:
+            self.reserved.clear()
+            telemetry.gauge_set(GATEWAY_QUOTA_RESERVED, 0.0)
+
+    def stats(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                "reserved": dict(self.reserved),
+                "denials": dict(self.denials),
+                "quotas": dict(self.quotas),
+            }

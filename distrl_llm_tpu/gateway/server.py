@@ -1,0 +1,168 @@
+"""GatewayServer: the streaming HTTP front-end (ISSUE 19).
+
+Same pattern as :class:`obs.MetricsServer` — a ThreadingHTTPServer on
+127.0.0.1 (``port=0`` auto-assigns, read ``.port``) with one daemon serve
+thread — plus chunked response streaming, which the metrics endpoint
+never needed: ``POST /v1/generate`` answers HTTP/1.1 with
+``Transfer-Encoding: chunked`` and writes one JSON line per token batch
+as the engine streams it, ending with a ``done`` line carrying the full
+result. An operator fronts it; nothing here needs to be internet-facing.
+
+Request contract::
+
+    POST /v1/generate
+    X-Tenant: acme            (optional; default "anon")
+    X-Priority: interactive   (optional; interactive|batch|scavenger,
+                               default "batch")
+    {"prompt": "...", "max_new_tokens": 32, "temperature": 0.0}
+
+Response: ``application/x-ndjson`` chunks —
+``{"tokens": [...], "text": "..."}`` per streamed batch, then
+``{"done": true, "rid": ..., "gen_tokens": ..., "trace_id": ...,
+"dispatch_id": ...}``. Errors before streaming starts are plain JSON
+with an HTTP error code; errors mid-stream land as a final
+``{"error": ...}`` line (the status line is already on the wire).
+
+``GET /v1/stats`` → gateway/service/quota counters; ``GET /healthz`` →
+``ok``."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.gateway.scheduler import (
+    DEFAULT_CLASS,
+    GATEWAY_REJECTED,
+)
+
+log = logging.getLogger("distrl.gateway")
+
+
+class GatewayServer:
+    """HTTP front-end over one :class:`GatewayService`."""
+
+    def __init__(self, service, port: int = 0, host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # chunked responses need HTTP/1.1 framing; http.server
+            # defaults to 1.0 where chunked is illegal
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: ARG002 — quiet
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _chunk(self, doc: dict) -> None:
+                payload = (json.dumps(doc) + "\n").encode()
+                self.wfile.write(
+                    f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
+                )
+                self.wfile.flush()
+
+            def _end_chunks(self) -> None:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send(200, "text/plain", b"ok\n")
+                    elif path == "/v1/stats":
+                        self._send(
+                            200, "application/json",
+                            json.dumps(server.service.stats()).encode(),
+                        )
+                    else:
+                        self._send(404, "text/plain", b"not found\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_POST(self):  # noqa: N802 — http.server contract
+                path = self.path.split("?", 1)[0]
+                if path != "/v1/generate":
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n_body = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n_body) or b"{}")
+                    req = server.service.submit(
+                        doc.get("prompt"),
+                        prompt_ids=doc.get("prompt_ids"),
+                        tenant=self.headers.get("X-Tenant", "anon"),
+                        cls=(
+                            self.headers.get("X-Priority", DEFAULT_CLASS)
+                            .strip().lower()
+                        ),
+                        max_new_tokens=doc.get("max_new_tokens"),
+                        temperature=doc.get("temperature"),
+                    )
+                except (ValueError, KeyError, TypeError) as e:
+                    # submit() already counted GATEWAY_REJECTED for policy
+                    # rejections; malformed JSON lands here too
+                    if not isinstance(e, ValueError):
+                        telemetry.counter_add(GATEWAY_REJECTED)
+                    self._send(
+                        400, "application/json",
+                        json.dumps({"error": str(e)}).encode(),
+                    )
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        kind, payload = req.events.get()
+                        if kind == "tokens":
+                            self._chunk({
+                                "tokens": payload,
+                                "text": server.service._decode(payload),
+                            })
+                        elif kind == "done":
+                            self._chunk(dict(payload, done=True))
+                            break
+                        else:  # "error"
+                            self._chunk({"error": payload})
+                            break
+                    self._end_chunks()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream; the round finishes
+
+        self.service = service
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gateway-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 — idempotent teardown
+            pass
+
+    def stats(self) -> dict[str, Any]:
+        return self.service.stats()

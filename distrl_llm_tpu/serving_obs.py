@@ -84,6 +84,12 @@ SERVING_QUEUE_DEPTH = "serving/queue_depth"      # gauge (Perfetto track)
 SERVING_FREE_PAGES = "serving/free_pages"        # gauge (Perfetto track)
 SERVING_RECORDS_CLOSED = "serving/records_closed"      # counter
 SERVING_RING_EVICTIONS = "serving/ring_evictions"      # counter
+# per-class decline attribution (ISSUE 19): gateway rounds carry a
+# priority class on the head group; the flat stalls counters above stay
+# the conservation ledger while f"{SERVING_CLASS_STALLS}/<class>/<reason>"
+# explains WHICH class ate the decline (separate prefix so the fleet fold
+# of the flat reasons never double-counts)
+SERVING_CLASS_STALLS = "serving/class_stalls"
 
 # fleet-folded serving view (FleetAggregator publishes these from the
 # per-worker obs blobs — cumulative hist summaries, so the mean is the
@@ -97,9 +103,12 @@ FLEET_SERVING_STALLS = "fleet/serving_admission_stalls"
 # the complete decline-reason vocabulary (the admission audit's contract:
 # every declined pass carries exactly one of these). "shed" is the ISSUE 14
 # SLO load-shedder's reason: the controller, not the pool, deferred the
-# head group — the conservation sum(stalls) == declined_passes holds with
-# controllers on or off
-STALL_REASONS = ("no_slots", "no_pages", "chain_cap", "budget_wedge", "shed")
+# head group; "quota" (ISSUE 19) is the gateway's per-tenant token budget
+# declining the head group — the conservation sum(stalls) ==
+# declined_passes holds with controllers and gateway on or off
+STALL_REASONS = (
+    "no_slots", "no_pages", "chain_cap", "budget_wedge", "shed", "quota",
+)
 
 # closed-value window per metric for percentile queries (bench rows, the
 # smoke): bounds host memory on a long-running server; counts/sums in the
@@ -117,6 +126,10 @@ class ServingRecord:
     group_index: int           # position within the round's prompt batch
     n: int                     # candidates in the group
     prompt_tokens: int
+    # multi-tenant identity (ISSUE 19): None on non-gateway rounds — the
+    # single-tenant JSONL shape is pinned unchanged in tests
+    tenant: str | None = None
+    priority: str | None = None
     # causal ids shared with the lineage ledger (telemetry trace context —
     # one allocation path, no second counter)
     trace_id: str | None = None
@@ -172,6 +185,11 @@ class ServingLedger:
         # admission audit totals (the smoke's conservation contract:
         # sum(stalls.values()) == declined_passes)
         self.stalls: dict[str, int] = {r: 0 for r in STALL_REASONS}
+        # per-class breakdown (ISSUE 19): {class: {reason: count}} for the
+        # declines whose head group carried a priority class. Invariant:
+        # sum over classes of stalls_by_class[cls][r] <= stalls[r], equal
+        # when every decline is class-attributed (all-gateway traffic)
+        self.stalls_by_class: dict[str, dict[str, int]] = {}
         self.declined_passes = 0
         self.boundary_passes = 0
         # bounded occupancy timeline: (ts, live_slots, queue_depth,
@@ -184,6 +202,9 @@ class ServingLedger:
             "tpot_ms": deque(maxlen=_SAMPLE_WINDOW),
             "e2e_ms": deque(maxlen=_SAMPLE_WINDOW),
         }
+        # per-class samples keyed (class, metric), populated only for
+        # records that carried a priority class (gateway traffic)
+        self._class_samples: dict[tuple[str, str], deque] = {}
         self.closed_groups = 0
 
     # ------------------------------------------------------------- plumbing
@@ -214,24 +235,37 @@ class ServingLedger:
             v = getattr(rec, key)
             if v is not None:
                 self._samples[key].append(float(v))
+                if rec.priority is not None:
+                    self._class_samples.setdefault(
+                        (rec.priority, key), deque(maxlen=_SAMPLE_WINDOW)
+                    ).append(float(v))
         self._write(rec.to_dict())
 
     # ------------------------------------------------------------ lifecycle
 
     def on_enqueue(self, group_index: int, *, n: int, prompt_tokens: int,
+                   tenant: str | None = None, priority: str | None = None,
+                   trace_ctx: Mapping[str, Any] | None = None,
                    ts: float | None = None) -> int:
         """Open one record as the group enters the engine's request queue.
         Stamps the ambient trace context (the worker handler binds the
         driver dispatch's ids for the frame's duration) so serving records
-        join onto lineage/policy-lag rows by dispatch_id."""
+        join onto lineage/policy-lag rows by dispatch_id. Gateway rounds
+        pass ``trace_ctx`` explicitly — each HTTP request carries its OWN
+        dispatch ids allocated at arrival, not the round's ambient frame —
+        plus the tenant/priority identity."""
         ts = time.time() if ts is None else ts
-        ctx = telemetry.current_trace_context()
+        ctx = (
+            trace_ctx if trace_ctx is not None
+            else telemetry.current_trace_context()
+        )
         with self._mu:
             self._uid += 1
             uid = self._uid
             rec = ServingRecord(
                 uid=uid, group_index=int(group_index), n=int(n),
                 prompt_tokens=int(prompt_tokens),
+                tenant=tenant, priority=priority,
                 trace_id=ctx.get("trace_id") if ctx else None,
                 dispatch_id=ctx.get("dispatch_id") if ctx else None,
                 enqueue_ts=ts,
@@ -280,6 +314,11 @@ class ServingLedger:
                         SERVING_QUEUE_WAIT_MS, rec.queue_wait_ms,
                         trace_sample=True,
                     )
+                    if rec.priority is not None:
+                        telemetry.hist_observe(
+                            f"{SERVING_QUEUE_WAIT_MS}/{rec.priority}",
+                            rec.queue_wait_ms,
+                        )
 
     def on_prefill_done(self, uid, ts: float | None = None) -> None:
         with self._mu:
@@ -301,6 +340,10 @@ class ServingLedger:
                 telemetry.hist_observe(
                     SERVING_TTFT_MS, rec.ttft_ms, trace_sample=True
                 )
+                if rec.priority is not None:
+                    telemetry.hist_observe(
+                        f"{SERVING_TTFT_MS}/{rec.priority}", rec.ttft_ms
+                    )
 
     def on_preempt(self, uid, cand: int) -> None:  # noqa: ARG002 — the
         # candidate id documents intent at call sites; the record
@@ -332,6 +375,10 @@ class ServingLedger:
                     telemetry.hist_observe(
                         SERVING_TTFT_MS, rec.ttft_ms, trace_sample=True
                     )
+                    if rec.priority is not None:
+                        telemetry.hist_observe(
+                            f"{SERVING_TTFT_MS}/{rec.priority}", rec.ttft_ms
+                        )
             if rec.enqueue_ts is not None:
                 rec.e2e_ms = (ts - rec.enqueue_ts) * 1e3
                 telemetry.hist_observe(
@@ -371,12 +418,15 @@ class ServingLedger:
 
     def on_boundary(self, *, live_slots: int, queue_depth: int,
                     free_pages: int, admitted: int,
-                    reason: str | None = None,
+                    reason: str | None = None, cls: str | None = None,
                     ts: float | None = None) -> None:
         """One admission pass at a host chunk boundary. ``admitted`` counts
         slot admissions + group prefills this pass; a pass that admitted
         nothing while work waited is a DECLINED pass, attributed to
-        ``reason`` (one of :data:`STALL_REASONS`)."""
+        ``reason`` (one of :data:`STALL_REASONS`). ``cls`` is the priority
+        class of the declined head group when the round carries gateway
+        identity — the per-class breakdown rides NEXT to the flat reason
+        counters, never instead of them (conservation stays class-blind)."""
         if reason is not None and reason not in STALL_REASONS:
             raise ValueError(
                 f"unknown admission-stall reason {reason!r} "
@@ -397,21 +447,33 @@ class ServingLedger:
                 self.declined_passes += 1
             if declined and reason is not None:
                 self.stalls[reason] += 1
+                if cls is not None:
+                    by = self.stalls_by_class.setdefault(cls, {})
+                    by[reason] = by.get(reason, 0) + 1
         if declined:
             telemetry.counter_add(SERVING_DECLINED_PASSES)
             if reason is not None:
                 telemetry.counter_add(f"{SERVING_ADMISSION_STALLS}/{reason}")
+                if cls is not None:
+                    telemetry.counter_add(
+                        f"{SERVING_CLASS_STALLS}/{cls}/{reason}"
+                    )
 
     # --------------------------------------------------------------- export
 
-    def percentile(self, metric: str, q: float) -> float | None:
+    def percentile(self, metric: str, q: float,
+                   cls: str | None = None) -> float | None:
         """q-th percentile (0..100) of a closed-record latency metric
         ("ttft_ms" | "queue_wait_ms" | "tpot_ms" | "e2e_ms"), or None when
-        no record produced it."""
+        no record produced it. ``cls`` narrows to one priority class
+        (gateway rounds only; None when that class closed no record)."""
         with self._mu:
             # snapshot under the lock: a closing record appends to this
             # deque concurrently (the thread-safety contract above)
-            vals = sorted(self._samples[metric])
+            if cls is not None:
+                vals = sorted(self._class_samples.get((cls, metric), ()))
+            else:
+                vals = sorted(self._samples[metric])
         if not vals:
             return None
         idx = min(int(len(vals) * q / 100.0), len(vals) - 1)
@@ -429,12 +491,14 @@ class ServingLedger:
         with self._mu:
             occ = list(self.occupancy)
             stalls = dict(self.stalls)
+            by_class = {c: dict(r) for c, r in self.stalls_by_class.items()}
             declined = self.declined_passes
             passes = self.boundary_passes
             closed = self.closed_groups
         return {
             "closed_groups": closed,
             "stalls": stalls,
+            "stalls_by_class": by_class,
             "declined_passes": declined,
             "admission_passes": passes,
             "stall_frac": declined / passes if passes else None,
@@ -450,6 +514,10 @@ class ServingLedger:
             "declined_passes": self.declined_passes,
             "admission_passes": self.boundary_passes,
         }
+        if self.stalls_by_class:
+            doc["stalls_by_class"] = {
+                c: dict(r) for c, r in self.stalls_by_class.items()
+            }
         if occ:
             lives = [o[1] for o in occ]
             queues = [o[2] for o in occ]

@@ -245,6 +245,21 @@ class Trainer:
                 format_scorer=config.format_reward,
             )
 
+        # multi-tenant serving gateway (ISSUE 19): built lazily at the top
+        # of train() — it serves WHILE training runs, and its rounds share
+        # the engine with rollout generation through _engine_mutex
+        self._gateway_service: Any = None
+        self._gateway_server: Any = None
+        self._engine_mutex: Any = None
+        if config.gateway_port is not None and not getattr(
+            engine, "continuous_admission", False
+        ):
+            raise ValueError(
+                "gateway_port needs a local continuous-admission paged "
+                f"engine; {type(engine).__name__} has no request-queue "
+                "admission plane"
+            )
+
         # the silent-no-op fix (ISSUE 9): inflight_weight_updates with an
         # engine that cannot actually swap mid-round used to pretend to
         # work (the push was a getattr that quietly found nothing). Any
@@ -929,12 +944,66 @@ class Trainer:
         else:
             self._lora_rollout = pushed
         self._rollout_weight_version = self.weight_version
+        if self._gateway_service is not None:
+            # the gateway serves the freshest pushed policy: attribute
+            # swap only — a round already being formed finishes on the
+            # previous tree (one-round staleness, same as rollout)
+            gw_params, gw_lora = self._engine_params("rollout")
+            self._gateway_service.params = gw_params
+            self._gateway_service.lora = gw_lora
         if self.lineage is not None:
             # weight-version lineage: push time opens the learn-to-act
             # window; with a broadcast bus the policy-lag loop stays open
             # until on_broadcast_complete (the bus hook), locally it closes
             # here — the pushed tree IS resident when this returns
             self.lineage.on_push(self.weight_version)
+
+    # ---------------------------------------------------------------- gateway
+
+    def _start_gateway(self) -> None:
+        """Serve the rollout engine over HTTP while training runs
+        (ISSUE 19). The service forms class-ordered rounds between the
+        trainer's own generation rounds — _engine_mutex serializes the
+        two owners — and records into the already-attached serving
+        ledger/control limits (it only overrides what it was given)."""
+        cfg = self.config
+        if cfg.gateway_port is None or self._gateway_service is not None:
+            return
+        import threading as _threading
+
+        from distrl_llm_tpu.gateway.scheduler import (
+            parse_gateway_classes,
+            parse_tenant_quota,
+        )
+        from distrl_llm_tpu.gateway.server import GatewayServer
+        from distrl_llm_tpu.gateway.service import GatewayService
+
+        self._engine_mutex = _threading.Lock()
+        params, lora = self._engine_params("rollout")
+        self._gateway_service = GatewayService(
+            self.engine, params, self.tokenizer, lora=lora,
+            classes=parse_gateway_classes(cfg.gateway_classes),
+            quota=parse_tenant_quota(cfg.tenant_quota),
+            max_groups_per_round=max(1, cfg.max_concurrent_sequences or 8),
+            seed=cfg.seed,
+            engine_lock=self._engine_mutex,
+        ).start()
+        self._gateway_server = GatewayServer(
+            self._gateway_service, port=cfg.gateway_port
+        )
+        log.info(
+            "serving gateway listening on 127.0.0.1:%d (classes %s)",
+            self._gateway_server.port, self._gateway_service.classes,
+        )
+
+    def _close_gateway(self) -> None:
+        if self._gateway_server is not None:
+            self._gateway_server.close()
+            self._gateway_server = None
+        if self._gateway_service is not None:
+            self._gateway_service.close()
+            self._gateway_service = None
+        self._engine_mutex = None
 
     # ---------------------------------------------------------------- rollout
 
@@ -1081,8 +1150,15 @@ class Trainer:
             warm_key = (role, bucket, ids.shape[0], sampling.n)
             if warm_key not in self._warm_engine_keys:
                 timeout = 0.0
+        # an armed serving gateway shares this engine — the mutex
+        # serializes trainer rounds against gateway rounds (absent a
+        # gateway there is no mutex and nothing changes)
+        from contextlib import nullcontext
+
+        mutex = self._engine_mutex or nullcontext()
         if timeout <= 0:
-            result = self.engine.generate(*args)
+            with mutex:
+                result = self.engine.generate(*args)
             if warm_key is not None:
                 self._warm_engine_keys.add(warm_key)
             return result
@@ -1093,7 +1169,8 @@ class Trainer:
 
         def run() -> None:
             try:
-                result["value"] = self.engine.generate(*args)
+                with mutex:
+                    result["value"] = self.engine.generate(*args)
             except BaseException as e:  # noqa: BLE001 — re-raised on the caller
                 result["error"] = e
 
@@ -1343,6 +1420,9 @@ class Trainer:
             os.makedirs(cfg.run_directory, exist_ok=True)
 
         try:
+            # serving gateway up BEFORE the first eval: "serve while
+            # training" covers the whole loop, evals included
+            self._start_gateway()
             # initial eval (distributed_trainer.py:241–242)
             self.evaluate()
 
@@ -1423,6 +1503,9 @@ class Trainer:
             self.save_checkpoint()
             raise
         finally:
+            # gateway down first: its rounds must not race the teardown
+            # of the ledger/lineage streams below
+            self._close_gateway()
             service = getattr(self, "_rollout_service", None)
             if service is not None:
                 # closes the buffer and stops after the round in flight;

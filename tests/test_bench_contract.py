@@ -70,7 +70,10 @@ class TestBenchContract:
                     "env_name", "turns_mean", "turns_max",
                     "env_step_ms_p50",
                     "prefix_cache", "radix_hit_rate", "prefill_tok_saved",
-                    "spill_restore_ms_p50"):
+                    "spill_restore_ms_p50",
+                    "gateway_mode", "arrival_rate",
+                    "ttft_p99_interactive_ms", "ttft_p99_batch_ms",
+                    "shed_frac_by_class"):
             assert key in rec, key
         # quantized-serving fields (ISSUE 15): an unpinned run resolves
         # the KV format from the (empty) plan DB — "none", the historical
@@ -120,6 +123,14 @@ class TestBenchContract:
         assert rec["radix_hit_rate"] is None
         assert rec["prefill_tok_saved"] is None
         assert rec["spill_restore_ms_p50"] is None
+        # serving-gateway fields (ISSUE 19): no gateway drove this row —
+        # mode False, arrival/per-class-latency/shed-mix provenance null,
+        # so the overload A/B can tell "no gateway" from "gateway, 0 shed"
+        assert rec["gateway_mode"] is False
+        assert rec["arrival_rate"] is None
+        assert rec["ttft_p99_interactive_ms"] is None
+        assert rec["ttft_p99_batch_ms"] is None
+        assert rec["shed_frac_by_class"] is None
         # multi-turn env fields (ISSUE 17): the single-turn control row
         # never arms a turn hook — all four honestly null, so the A/B
         # artifact can tell "no env ran" from "env ran, 1 turn"
@@ -295,6 +306,42 @@ class TestBenchContract:
         assert rec["control_actions"] == 0
         assert rec["shed_groups"] == 0
         assert rec["value"] > 0
+
+    def test_gateway_record_fields(self):
+        """A BENCH_GATEWAY row must self-describe the serving-gateway
+        regime (ISSUE 19): open-loop mode on, the offered arrival rate,
+        per-class TTFT p99s off the ledger's class-tagged samples —
+        the fields the 1x-vs-2x overload A/B in tpu_bench_loop.sh and
+        tools/bench_history.py compare."""
+        # 8 requests: the seeded mix needs >= 5 before an interactive
+        # arrival shows up (the weights skew toward batch)
+        rec = run_bench({
+            **self.TINY, "BENCH_PROMPTS": "8", "BENCH_ENGINE": "paged",
+            "BENCH_SCHEDULER": "refill", "BENCH_MAX_CONCURRENT": "4",
+            "BENCH_CONT_ADMISSION": "1", "BENCH_GATEWAY": "1",
+            "BENCH_ARRIVAL_RPS": "16", "BENCH_ARRIVAL_PROCESS": "poisson",
+        })
+        assert "error" not in rec
+        assert rec["gateway_mode"] is True
+        assert rec["arrival_rate"] == 16.0
+        # the synthesized mix always includes interactive and batch, and
+        # every closed request feeds a class-tagged TTFT sample
+        assert rec["ttft_p99_interactive_ms"] is not None
+        assert rec["ttft_p99_interactive_ms"] > 0
+        assert rec["ttft_p99_batch_ms"] is not None
+        assert rec["ttft_p99_batch_ms"] > 0
+        # the open-loop replay measures wall-clock, not engine steps —
+        # step/alive accounting honestly absent, volume still real
+        assert rec["value"] > 0
+        assert rec["total_tokens"] > 0
+
+    def test_gateway_needs_refill_engine(self):
+        """BENCH_GATEWAY on the dense engine is a config error: still
+        exactly one JSON line, with the error naming the constraint."""
+        rec = run_bench({**self.TINY, "BENCH_GATEWAY": "1"})
+        assert "error" in rec
+        assert "continuous-admission" in rec["error"]
+        assert rec["vs_baseline"] == 0.0
 
     def test_cb_fixed_control_fields(self):
         """The fixed-batch refill control reads cb_mode='refill' with the

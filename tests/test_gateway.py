@@ -1,0 +1,552 @@
+"""Serving-gateway tests (ISSUE 19): priority classes + the aging queue,
+per-tenant quota accounting, CLI parsing, traffic synthesis determinism,
+the GatewayService round loop on the tiny engine (streaming order,
+quota-impossible rejection, attach/detach residue), the HTTP front-end,
+and config/CLI validation."""
+
+import json
+import queue as queue_mod
+
+import numpy as np
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.gateway import traffic
+from distrl_llm_tpu.gateway.scheduler import (
+    AGE_PASSES,
+    GATEWAY_QUOTA_DENIALS,
+    PRIORITY_CLASSES,
+    GatewayRequest,
+    RequestQueue,
+    TenantQuotaBook,
+    parse_gateway_classes,
+    parse_tenant_quota,
+    sanitize_tenant,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=False)
+
+
+class TestSanitizeTenant:
+    def test_clamps_to_series_alphabet(self):
+        assert sanitize_tenant("Acme Corp!") == "acme_corp"
+        assert sanitize_tenant("9lives") == "t_9lives"
+        assert sanitize_tenant("") == "anon"
+        assert sanitize_tenant("a" * 99) == "a" * 48
+
+    def test_idempotent(self):
+        for raw in ("Acme Corp!", "anon", "x", "9lives"):
+            once = sanitize_tenant(raw)
+            assert sanitize_tenant(once) == once
+
+
+class TestParseGatewayClasses:
+    def test_default_is_all_three(self):
+        assert parse_gateway_classes(None) == PRIORITY_CLASSES
+        assert parse_gateway_classes("") == PRIORITY_CLASSES
+
+    def test_subset_normalizes_to_priority_order(self):
+        assert parse_gateway_classes("batch,interactive") == (
+            "interactive", "batch",
+        )
+        assert parse_gateway_classes(" Scavenger , BATCH ") == (
+            "batch", "scavenger",
+        )
+
+    def test_unknown_class_is_a_config_error(self):
+        with pytest.raises(ValueError, match="unknown gateway class"):
+            parse_gateway_classes("interactive,premium")
+
+
+class TestParseTenantQuota:
+    def test_grammar(self):
+        assert parse_tenant_quota("acme=1000, globex=500") == {
+            "acme": 1000, "globex": 500,
+        }
+        assert parse_tenant_quota(None) == {}
+        assert parse_tenant_quota("") == {}
+
+    def test_default_pseudo_tenant(self):
+        book = TenantQuotaBook(parse_tenant_quota("default=64,acme=128"))
+        assert book.limit_for("acme") == 128
+        assert book.limit_for("someone_else") == 64
+
+    def test_bad_entries_raise(self):
+        with pytest.raises(ValueError, match="tenant=tokens"):
+            parse_tenant_quota("acme")
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_tenant_quota("acme=0")
+
+
+def _req(cls: str, rid: int = 0) -> GatewayRequest:
+    return GatewayRequest(
+        rid=rid, tenant="acme", cls=cls,
+        prompt_ids=np.array([2, 3], np.int32), prompt_len=2,
+        max_new_tokens=4, events=queue_mod.Queue(),
+    )
+
+
+class TestRequestQueue:
+    def test_class_then_fifo_order(self):
+        q = RequestQueue()
+        for i, cls in enumerate(
+            ("scavenger", "batch", "interactive", "batch")
+        ):
+            q.push(_req(cls, rid=i))
+        batch = q.pop_batch(4)
+        assert [r.rid for r in batch] == [2, 1, 3, 0]
+
+    def test_aging_promotes_a_starved_request(self):
+        """A scavenger request passed over AGE_PASSES * rank times reaches
+        effective rank 0 and beats a LATER interactive arrival (FIFO
+        within the promoted rank) — the starvation valve, deterministic
+        in pass counts."""
+        q = RequestQueue()
+        q.push(_req("scavenger", rid=0))
+        for i in range(2 * AGE_PASSES + 2):
+            q.push(_req("interactive", rid=100 + i))
+            got = q.pop_batch(1)
+            if got[0].rid == 0:
+                break
+        else:
+            pytest.fail("scavenger request starved past the aging bound")
+        # it cannot have run before rank drops below interactive's
+        assert i >= AGE_PASSES
+
+    def test_empty_pop_ages_nobody(self):
+        q = RequestQueue()
+        r = _req("scavenger")
+        q.push(r)
+        q.pop_batch(0)
+        assert r.waited_passes == 0
+        assert q.pop_batch(1) == [r]
+
+
+class TestTenantQuotaBook:
+    def test_charge_deny_credit(self):
+        book = TenantQuotaBook({"acme": 10})
+        assert book.try_charge("acme", 6)
+        assert not book.try_charge("acme", 5)   # 6 + 5 > 10
+        assert book.try_charge("acme", 4)       # exactly at the cap
+        book.credit("acme", 6)
+        assert book.try_charge("acme", 6)
+        stats = book.stats()
+        assert stats["denials"] == {"acme": 1}
+        snap = telemetry.observe_snapshot()["counters"]
+        assert snap[GATEWAY_QUOTA_DENIALS] == 1.0
+        assert snap[f"{GATEWAY_QUOTA_DENIALS}/acme"] == 1.0
+
+    def test_unlimited_without_quota(self):
+        book = TenantQuotaBook({})
+        assert book.limit_for("anyone") is None
+        assert book.try_charge("anyone", 10**9)
+
+    def test_reset_drops_reservations_keeps_denials(self):
+        book = TenantQuotaBook({"acme": 4})
+        assert book.try_charge("acme", 4)
+        assert not book.try_charge("acme", 1)
+        book.reset()
+        assert book.try_charge("acme", 4)
+        assert book.stats()["denials"] == {"acme": 1}
+
+
+class TestTrafficSynthesis:
+    def test_deterministic_per_seed(self):
+        a = traffic.synthesize(seed=11, n_requests=40, rate_rps=20)
+        b = traffic.synthesize(seed=11, n_requests=40, rate_rps=20)
+        c = traffic.synthesize(seed=12, n_requests=40, rate_rps=20)
+        assert a == b
+        assert a != c
+
+    def test_caps_and_shape(self):
+        arr = traffic.synthesize(
+            seed=3, n_requests=64, rate_rps=50, process="burst",
+            max_prompt_tokens=12, max_new_tokens=6,
+        )
+        assert len(arr) == 64
+        ts = [a["t"] for a in arr]
+        assert ts == sorted(ts)
+        assert all(1 <= a["prompt_len"] <= 12 for a in arr)
+        assert all(1 <= a["max_new_tokens"] <= 6 for a in arr)
+        assert {a["cls"] for a in arr} <= set(PRIORITY_CLASSES)
+
+    def test_trace_roundtrip(self, tmp_path):
+        arr = traffic.synthesize(seed=5, n_requests=8, rate_rps=10)
+        path = str(tmp_path / "trace.jsonl")
+        traffic.save_trace(path, arr)
+        assert traffic.load_trace(path) == json.loads(
+            json.dumps(arr)
+        )
+
+    def test_unknown_process_raises(self):
+        with pytest.raises(ValueError, match="unknown arrival process"):
+            traffic.synthesize(seed=1, n_requests=1, rate_rps=1,
+                               process="thundering_herd")
+
+
+# ------------------------------------------------------------ engine rounds
+
+
+def _tiny_engine(**kw):
+    import jax.numpy as jnp  # noqa: F401 — backend init
+    from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+    from distrl_llm_tpu.models import TINY
+
+    return PagedGenerationEngine(
+        TINY, max_prompt_tokens=16, max_new_tokens=8, eos_token_ids=[1],
+        pad_token_id=0, page_size=8, max_concurrent_rows=2,
+        scheduler="refill", decode_chunk=2, autotune=False,
+        continuous_admission=True, **kw,
+    )
+
+
+def _service(engine, **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from distrl_llm_tpu.gateway.service import GatewayService
+    from distrl_llm_tpu.models import TINY, init_params
+    from distrl_llm_tpu.tokenizer import CharTokenizer
+
+    params = init_params(jax.random.PRNGKey(0), TINY, dtype=jnp.bfloat16)
+    return GatewayService(
+        engine, params, CharTokenizer(TINY.vocab_size),
+        max_groups_per_round=4, seed=3, **kw,
+    )
+
+
+def _drain_events(req, timeout_s: float = 60.0):
+    """Consume one request's event stream; returns (chunks, done)."""
+    chunks, done = [], None
+    while True:
+        kind, payload = req.events.get(timeout=timeout_s)
+        if kind == "tokens":
+            chunks.extend(payload)
+        elif kind == "done":
+            done = payload
+            break
+        else:
+            raise AssertionError(f"request errored: {payload}")
+    return chunks, done
+
+
+class TestGatewayService:
+    def test_round_streams_byte_complete(self):
+        svc = _service(_tiny_engine()).start()
+        try:
+            reqs = [
+                svc.submit("hello", tenant="acme", cls="interactive"),
+                svc.submit("worldly", tenant="globex", cls="batch"),
+                svc.submit("bye", tenant="acme", cls="scavenger",
+                           max_new_tokens=4),
+            ]
+            assert svc.drain(timeout_s=120.0)
+            for req in reqs:
+                chunks, done = _drain_events(req)
+                # byte-complete streaming: concatenated chunks ARE the
+                # final token list
+                assert chunks == done["tokens"]
+                assert done["gen_tokens"] == len(done["tokens"]) > 0
+                assert done["tenant"] == req.tenant
+                assert done["cls"] == req.cls
+            # each request capped at its OWN window while the round ran
+            # at the batch max
+            assert len(reqs[2].events.queue) == 0
+            stats = svc.stats()
+            assert stats["completed"] == 3 and stats["failed"] == 0
+            assert stats["completed_by_class"] == {
+                "interactive": 1, "batch": 1, "scavenger": 1,
+            }
+        finally:
+            svc.close()
+
+    def test_requests_carry_distinct_dispatch_lineage(self):
+        svc = _service(_tiny_engine())
+        try:
+            a = svc.submit("one", cls="batch")
+            b = svc.submit("two", cls="batch")
+            assert a.trace_ctx["dispatch_id"] != b.trace_ctx["dispatch_id"]
+        finally:
+            svc.close()
+
+    def test_submit_rejections(self):
+        svc = _service(_tiny_engine(), quota={"tiny_tenant": 10})
+        try:
+            with pytest.raises(ValueError, match="unknown priority class"):
+                svc.submit("x", cls="premium")
+            with pytest.raises(ValueError, match="empty prompt"):
+                svc.submit("")
+            # footprint 12 + 8 > 10: rejected at the door, never queued
+            with pytest.raises(ValueError, match="could never admit"):
+                svc.submit("a" * 12, tenant="tiny_tenant")
+            assert len(svc.queue) == 0
+        finally:
+            svc.close()
+
+    def test_class_subset_gateway_rejects_unserved(self):
+        svc = _service(_tiny_engine(), classes=("interactive", "batch"))
+        try:
+            with pytest.raises(ValueError, match="not served"):
+                svc.submit("x", cls="scavenger")
+        finally:
+            svc.close()
+
+    def test_long_prompt_keeps_tail(self):
+        svc = _service(_tiny_engine())
+        try:
+            req = svc.submit("a" * 40)
+            assert req.prompt_len == 16  # engine window
+        finally:
+            svc.close()
+
+    def test_spec_engine_rejected(self):
+        eng = _tiny_engine()
+        eng.spec_draft = 4
+        with pytest.raises(ValueError, match="speculative"):
+            _service(eng)
+
+    def test_non_continuous_engine_rejected(self):
+        import jax.numpy as jnp  # noqa: F401
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+        from distrl_llm_tpu.models import TINY
+
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8,
+            eos_token_ids=[1], pad_token_id=0, page_size=8,
+            max_concurrent_rows=2, scheduler="refill", decode_chunk=2,
+            autotune=False,
+        )
+        with pytest.raises(ValueError, match="continuous_admission"):
+            _service(eng)
+
+    def test_hooks_detached_between_rounds(self):
+        eng = _tiny_engine()
+        svc = _service(eng).start()
+        try:
+            svc.submit("hello")
+            assert svc.drain(timeout_s=120.0)
+            assert eng.round_meta is None
+            assert eng.quota_book is None
+            assert eng.stream_hook is None
+        finally:
+            svc.close()
+
+
+class TestGatewayServer:
+    def test_http_stream_and_stats(self):
+        import http.client
+
+        from distrl_llm_tpu.gateway.server import GatewayServer
+
+        svc = _service(_tiny_engine()).start()
+        server = GatewayServer(svc, port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=120)
+            conn.request(
+                "POST", "/v1/generate",
+                body=json.dumps({"prompt": "hi", "max_new_tokens": 4}),
+                headers={"X-Tenant": "acme", "X-Priority": "interactive"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            streamed, final = [], None
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if doc.get("done"):
+                    final = doc
+                    break
+                streamed.extend(doc.get("tokens", []))
+            assert final is not None and streamed == final["tokens"]
+            assert final["cls"] == "interactive"
+            conn.close()
+
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request("GET", "/v1/stats")
+            stats = json.loads(conn.getresponse().read())
+            assert stats["completed"] == 1
+            conn.close()
+        finally:
+            server.close()
+            svc.close()
+
+    def test_bad_class_is_http_400(self):
+        import http.client
+
+        from distrl_llm_tpu.gateway.server import GatewayServer
+
+        svc = _service(_tiny_engine()).start()
+        server = GatewayServer(svc, port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request(
+                "POST", "/v1/generate",
+                body=json.dumps({"prompt": "hi"}),
+                headers={"X-Priority": "premium"},
+            )
+            assert conn.getresponse().status == 400
+            conn.close()
+        finally:
+            server.close()
+            svc.close()
+
+
+# ------------------------------------------------- trainer-side wiring
+
+
+def _gateway_trainer(engine=None):
+    import jax
+
+    from distrl_llm_tpu.metrics import MemorySink
+    from distrl_llm_tpu.models import TINY, init_params
+    from distrl_llm_tpu.rewards import reward_function
+    from distrl_llm_tpu.tokenizer import CharTokenizer
+    from distrl_llm_tpu.trainer import Trainer
+    from tests.test_trainer import make_config, make_datasets
+
+    cfg = make_config(
+        max_prompt_tokens=16, max_new_tokens=8, engine_impl="paged",
+        continuous_batching=True, continuous_admission=True,
+        max_concurrent_sequences=2, gateway_port=0,
+    )
+    train, test = make_datasets()
+    return Trainer(
+        train, test, reward_function, cfg,
+        tokenizer=CharTokenizer(), engine=engine or _tiny_engine(),
+        base_params=init_params(jax.random.PRNGKey(0), TINY),
+        model_cfg=TINY, sink=MemorySink(),
+    )
+
+
+class TestTrainerGateway:
+    """gateway_port on the local trainer: the service/server lifecycle is
+    owned by train() (up before the first eval, down in finally), with the
+    engine shared between gateway rounds and rollout via _engine_mutex."""
+
+    def test_init_rejects_engine_without_admission_plane(self):
+        import jax.numpy as jnp  # noqa: F401
+        from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine
+        from distrl_llm_tpu.models import TINY
+
+        eng = PagedGenerationEngine(
+            TINY, max_prompt_tokens=16, max_new_tokens=8, eos_token_ids=[1],
+            pad_token_id=0, page_size=8, max_concurrent_rows=2,
+            scheduler="refill", decode_chunk=2, autotune=False,
+        )
+        with pytest.raises(ValueError, match="admission plane"):
+            _gateway_trainer(engine=eng)
+
+    def test_start_serves_http_and_close_detaches(self):
+        import http.client
+
+        tr = _gateway_trainer()
+        tr._start_gateway()
+        try:
+            assert tr._gateway_server is not None
+            assert tr._gateway_server.port > 0  # port 0 = auto-assign
+            assert tr._engine_mutex is not None
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", tr._gateway_server.port, timeout=120)
+            conn.request(
+                "POST", "/v1/generate",
+                body=json.dumps({"prompt": "hi", "max_new_tokens": 4}),
+                headers={"X-Tenant": "acme", "X-Priority": "interactive"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            streamed, final = [], None
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if doc.get("done"):
+                    final = doc
+                    break
+                streamed.extend(doc.get("tokens", []))
+            conn.close()
+            assert final is not None and streamed == final["tokens"]
+            assert final["dispatch_id"] is not None
+            # a weight push refreshes the live service's snapshot in place
+            # (attribute swap, no restart)
+            svc = tr._gateway_service
+            tr._push_weights()
+            assert tr._gateway_service is svc
+        finally:
+            tr._close_gateway()
+        assert tr._gateway_service is None
+        assert tr._gateway_server is None
+        assert tr._engine_mutex is None
+        # idempotent: a second close (train()'s finally) is a no-op
+        tr._close_gateway()
+
+
+# ---------------------------------------------------------- config parity
+
+
+class TestGatewayConfig:
+    def _cfg(self, **kw):
+        from distrl_llm_tpu.config import TrainConfig
+
+        base = dict(
+            model="tiny", engine_impl="paged", continuous_batching=True,
+            continuous_admission=True, max_concurrent_sequences=4,
+        )
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_gateway_fields_accepted(self):
+        cfg = self._cfg(gateway_port=0, gateway_classes="interactive,batch",
+                        tenant_quota="acme=1000")
+        assert cfg.gateway_port == 0
+
+    def test_port_range_validated(self):
+        with pytest.raises(ValueError, match="gateway_port"):
+            self._cfg(gateway_port=70000)
+
+    def test_needs_continuous_admission(self):
+        with pytest.raises(ValueError, match="continuous_admission"):
+            self._cfg(gateway_port=0, continuous_admission=False)
+
+    def test_dead_flags_rejected(self):
+        with pytest.raises(ValueError, match="gateway_port"):
+            self._cfg(tenant_quota="acme=10")
+
+    def test_bad_specs_surface_at_config_time(self):
+        with pytest.raises(ValueError, match="unknown gateway class"):
+            self._cfg(gateway_port=0, gateway_classes="premium")
+        with pytest.raises(ValueError, match="tenant=tokens"):
+            self._cfg(gateway_port=0, tenant_quota="acme")
+
+    def test_rejected_with_rollout_workers(self):
+        with pytest.raises(ValueError, match="worker-side"):
+            self._cfg(gateway_port=0, rollout_workers=2)
+
+
+class TestControlFloorDefault:
+    def test_shed_floor_defaults_identity(self):
+        """ISSUE 14 behavior is the floor-0 special case: a plain
+        set_shed(True) keeps floor 0 (every class sheds), and clearing
+        the shed resets it."""
+        from distrl_llm_tpu.control import ControlLimits
+
+        limits = ControlLimits()
+        assert limits.shed_floor() == 0
+        limits.set_shed(True)
+        assert limits.shed_active() and limits.shed_floor() == 0
+        limits.set_shed(True, floor=2)
+        assert limits.shed_floor() == 2
+        limits.set_shed(False)
+        assert not limits.shed_active() and limits.shed_floor() == 0

@@ -164,6 +164,136 @@ class TestServingLedger:
         assert summ["closed_groups"] == 10
 
 
+class TestClassServing:
+    """ISSUE 19: the multi-tenant additions ride NEXT to the single-tenant
+    audit — per-class breakdowns never replace the flat counters, and the
+    class-less paths keep their exact pre-gateway shape."""
+
+    def test_class_stall_conservation(self):
+        led = ServingLedger()
+        led.on_boundary(live_slots=2, queue_depth=3, free_pages=0,
+                        admitted=0, reason="shed", cls="scavenger")
+        led.on_boundary(live_slots=2, queue_depth=3, free_pages=0,
+                        admitted=0, reason="shed", cls="scavenger")
+        led.on_boundary(live_slots=4, queue_depth=2, free_pages=0,
+                        admitted=0, reason="quota", cls="batch")
+        # a class-less decline (non-gateway round interleaved): counts in
+        # the flat reason, absent from the breakdown
+        led.on_boundary(live_slots=4, queue_depth=2, free_pages=0,
+                        admitted=0, reason="no_pages")
+        stats = led.stats()
+        assert sum(stats["stalls"].values()) == stats["declined_passes"]
+        assert stats["stalls_by_class"] == {
+            "scavenger": {"shed": 2}, "batch": {"quota": 1},
+        }
+        for cls, reasons in stats["stalls_by_class"].items():
+            for reason, count in reasons.items():
+                assert count <= stats["stalls"][reason]
+        snap = telemetry.observe_snapshot()["counters"]
+        assert snap[f"{so.SERVING_CLASS_STALLS}/scavenger/shed"] == 2.0
+        assert snap[f"{so.SERVING_CLASS_STALLS}/batch/quota"] == 1.0
+        assert snap[f"{so.SERVING_ADMISSION_STALLS}/no_pages"] == 1.0
+        assert not any(
+            k.startswith(so.SERVING_CLASS_STALLS) and "no_pages" in k
+            for k in snap
+        )
+
+    def test_records_carry_tenant_and_priority(self, tmp_path):
+        led = ServingLedger(out_dir=str(tmp_path))
+        uid = led.on_enqueue(0, n=1, prompt_tokens=4, tenant="acme",
+                             priority="interactive", ts=1.0)
+        led.on_admit(uid, cand=0, slot=0, ts=1.2)
+        led.on_finish(uid, 0, ts=2.0)
+        led.note_tokens(uid, 3, ts=2.0)  # closes the record
+        led.close()
+        docs = [json.loads(l) for l in open(tmp_path / "serving.jsonl")]
+        (g,) = [d for d in docs if d["kind"] == "group"]
+        assert g["tenant"] == "acme" and g["priority"] == "interactive"
+        # per-class percentile narrows to this record's class
+        assert led.percentile("ttft_ms", 50, cls="interactive") == \
+            pytest.approx(1000.0)
+        assert led.percentile("ttft_ms", 50, cls="batch") is None
+        # the per-class histograms ride NEXT to the flat ones
+        snap = telemetry.observe_snapshot()["hists"]
+        assert snap[so.SERVING_TTFT_MS]["count"] == 1.0
+        assert snap[f"{so.SERVING_TTFT_MS}/interactive"]["count"] == 1.0
+
+    def test_single_tenant_shape_pinned(self, tmp_path):
+        """Class-less lifecycles (every pre-gateway caller) write records
+        with tenant/priority null, mint NO per-class series, and answer
+        class-narrowed percentiles with None — byte-for-byte the ISSUE 13
+        shape plus two null fields."""
+        led = ServingLedger(out_dir=str(tmp_path))
+        uid = led.on_enqueue(0, n=1, prompt_tokens=4, ts=1.0)
+        led.on_admit(uid, cand=0, slot=0, ts=1.1)
+        led.on_finish(uid, 0, ts=1.5)
+        led.note_tokens(uid, 3, ts=1.5)  # closes the record
+        led.on_boundary(live_slots=1, queue_depth=1, free_pages=0,
+                        admitted=0, reason="no_slots")
+        led.close()
+        docs = [json.loads(l) for l in open(tmp_path / "serving.jsonl")]
+        (g,) = [d for d in docs if d["kind"] == "group"]
+        assert g["tenant"] is None and g["priority"] is None
+        assert led.percentile("ttft_ms", 50) is not None
+        assert led.percentile("ttft_ms", 50, cls="interactive") is None
+        assert led.stats()["stalls_by_class"] == {}
+        snap = telemetry.observe_snapshot()
+        assert not any(
+            k.startswith(so.SERVING_CLASS_STALLS)
+            for k in snap["counters"]
+        )
+        assert not any("/" in k[len("serving/"):]
+                       for k in snap["hists"] if k.startswith("serving/"))
+
+    def test_gateway_round_attributes_classes_end_to_end(self, tmp_path):
+        """A REAL gateway round on the tiny engine: records carry the
+        tenant/priority identity from round_meta and the per-class stall
+        breakdown stays conservation-consistent."""
+        import jax
+        import jax.numpy as jnp
+
+        from distrl_llm_tpu.gateway.service import GatewayService
+        from distrl_llm_tpu.models import TINY, init_params
+        from distrl_llm_tpu.tokenizer import CharTokenizer
+
+        eng = _tiny_engine(continuous_admission=True)
+        led = ServingLedger(out_dir=str(tmp_path))
+        params = init_params(jax.random.PRNGKey(0), TINY,
+                             dtype=jnp.bfloat16)
+        svc = GatewayService(
+            eng, params, CharTokenizer(TINY.vocab_size),
+            serving_ledger=led, max_groups_per_round=4, seed=3,
+        ).start()
+        try:
+            reqs = [
+                svc.submit("hello", tenant="acme", cls="interactive"),
+                svc.submit("worldly", tenant="globex", cls="batch"),
+                svc.submit("byebye", tenant="acme", cls="scavenger"),
+            ]
+            assert svc.drain(timeout_s=120.0)
+        finally:
+            svc.close()
+        for req in reqs:
+            while True:
+                kind, payload = req.events.get(timeout=5)
+                if kind == "done":
+                    break
+                assert kind == "tokens", payload
+        stats = led.stats()
+        assert stats["closed_groups"] == 3
+        assert sum(stats["stalls"].values()) == stats["declined_passes"]
+        led.close()
+        docs = [json.loads(l) for l in open(tmp_path / "serving.jsonl")]
+        by_identity = {
+            (d["tenant"], d["priority"])
+            for d in docs if d["kind"] == "group"
+        }
+        assert by_identity == {
+            ("acme", "interactive"), ("globex", "batch"),
+            ("acme", "scavenger"),
+        }
+
+
 def _tiny_engine(**kw):
     import jax.numpy as jnp  # noqa: F401 — backend init
     from distrl_llm_tpu.engine.paged_engine import PagedGenerationEngine

@@ -561,9 +561,15 @@ class TestRegistry:
         assert (so.FLEET_SERVING_QUEUE_WAIT_MAX_MS
                 == "fleet/serving_queue_wait_ms_max")
         assert so.FLEET_SERVING_STALLS == "fleet/serving_admission_stalls"
+        # "quota" (ISSUE 19): the gateway's per-tenant token budget joined
+        # the decline vocabulary — conservation extends, never breaks
         assert so.STALL_REASONS == (
-            "no_slots", "no_pages", "chain_cap", "budget_wedge", "shed"
+            "no_slots", "no_pages", "chain_cap", "budget_wedge", "shed",
+            "quota",
         )
+        # per-class breakdown prefix rides NEXT to the flat counters
+        # (separate root so the fleet fold's rsplit can't double-count)
+        assert so.SERVING_CLASS_STALLS == "serving/class_stalls"
         for name in (so.SERVING_TTFT_MS, so.SERVING_TPOT_MS,
                      so.SERVING_QUEUE_WAIT_MS, so.SERVING_E2E_MS):
             telemetry.hist_observe(name, 5.0)
@@ -588,6 +594,48 @@ class TestRegistry:
         assert snap["serving/records_closed"] == 1.0
         assert snap["serving/ring_evictions"] == 1.0
         assert snap["serving/admission_stalls/no_pages"] == 1.0
+
+    def test_gateway_series_schema(self):
+        """Schema pin for the serving-gateway registry names (ISSUE 19)
+        and their TYPES: gateway/requests, gateway/rejected,
+        gateway/rounds, gateway/streamed_tokens, gateway/quota_denials and
+        gateway/aged_promotions are COUNTERS (per-class / per-tenant
+        breakdowns derive with the constant-prefix pattern);
+        gateway/queue_depth and gateway/quota_reserved are GAUGES."""
+        from distrl_llm_tpu.gateway import scheduler as gw
+
+        assert gw.GATEWAY_REQUESTS == "gateway/requests"
+        assert gw.GATEWAY_REJECTED == "gateway/rejected"
+        assert gw.GATEWAY_QUEUE_DEPTH == "gateway/queue_depth"
+        assert gw.GATEWAY_ROUNDS == "gateway/rounds"
+        assert gw.GATEWAY_STREAMED_TOKENS == "gateway/streamed_tokens"
+        assert gw.GATEWAY_QUOTA_DENIALS == "gateway/quota_denials"
+        assert gw.GATEWAY_QUOTA_RESERVED == "gateway/quota_reserved"
+        assert gw.GATEWAY_AGED_PROMOTIONS == "gateway/aged_promotions"
+        assert gw.PRIORITY_CLASSES == ("interactive", "batch", "scavenger")
+        telemetry.counter_add(gw.GATEWAY_REQUESTS)
+        telemetry.counter_add(f"{gw.GATEWAY_REQUESTS}/interactive")
+        telemetry.counter_add(gw.GATEWAY_REJECTED)
+        telemetry.counter_add(gw.GATEWAY_ROUNDS)
+        telemetry.counter_add(gw.GATEWAY_STREAMED_TOKENS, 12.0)
+        telemetry.counter_add(gw.GATEWAY_QUOTA_DENIALS)
+        telemetry.counter_add(f"{gw.GATEWAY_QUOTA_DENIALS}/acme")
+        telemetry.counter_add(gw.GATEWAY_AGED_PROMOTIONS)
+        telemetry.gauge_set(gw.GATEWAY_QUEUE_DEPTH, 4.0)
+        telemetry.gauge_set(gw.GATEWAY_QUOTA_RESERVED, 96.0)
+        telemetry.gauge_set(f"{gw.GATEWAY_QUOTA_RESERVED}/acme", 96.0)
+        snap = telemetry.metrics_snapshot()
+        assert snap["gateway/requests"] == 1.0
+        assert snap["gateway/requests/interactive"] == 1.0
+        assert snap["gateway/rejected"] == 1.0
+        assert snap["gateway/rounds"] == 1.0
+        assert snap["gateway/streamed_tokens"] == 12.0
+        assert snap["gateway/quota_denials"] == 1.0
+        assert snap["gateway/quota_denials/acme"] == 1.0
+        assert snap["gateway/aged_promotions"] == 1.0
+        assert snap["gateway/queue_depth"] == 4.0
+        assert snap["gateway/quota_reserved"] == 96.0
+        assert snap["gateway/quota_reserved/acme"] == 96.0
 
     def test_learn_series_schema(self):
         """Schema pin for the training-dynamics registry names (ISSUE 16)

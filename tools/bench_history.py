@@ -79,6 +79,13 @@ def comparable(a: dict, b: dict) -> bool:
         a.get("metric") == b.get("metric")
         and a.get("backend") == b.get("backend")
         and quant_arm(a) == quant_arm(b)
+        # gateway rows (ISSUE 19) measure goodput under an open-loop
+        # arrival process: a 1x-rate round against a 2x-overload round is
+        # the A/B itself, and a gateway round against a closed-loop batch
+        # round measures different things entirely — scoreable pairs must
+        # share both the mode and the arrival rate
+        and (a.get("gateway_mode"), a.get("arrival_rate"))
+        == (b.get("gateway_mode"), b.get("arrival_rate"))
         and "error" not in a and "error" not in b
     )
 
@@ -99,6 +106,11 @@ _BYTES_RE = re.compile(r"(_bytes$|bytes_per_token$)")
 LATENCY_FIELDS = (
     "ttft_p50_ms", "ttft_p99_ms", "queue_wait_p50_ms",
     "spill_restore_ms_p50",
+    # per-class gateway TTFT (ISSUE 19; null off-gateway — skipped then):
+    # comparable() already pins the pair to one gateway mode + arrival
+    # rate, so an interactive-p99 increase between rounds is a scheduling
+    # regression, not a load difference
+    "ttft_p99_interactive_ms", "ttft_p99_batch_ms",
 )
 # per-row rate fields scanned the same way but HIGHER-is-better (ISSUE 18:
 # a radix hit-rate drop between comparable cache-on rounds means warm

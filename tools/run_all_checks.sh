@@ -133,6 +133,14 @@ stage "env_smoke" env JAX_PLATFORMS=cpu \
 # byte-identical to the cache-off golden run under greedy decode
 stage "radix_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/radix_smoke.py
+# serving-gateway gate (ISSUE 19): a multi-tenant three-class replay over
+# the streaming HTTP front-end — chunk streams byte-complete, scavenger
+# sheds under a pinned floor while interactive never does, the per-class
+# admission audit conserves on the ledger AND the registry, a
+# quota-impossible request 400s at the door, and greedy outputs are
+# byte-identical before the gateway ever attaches and after it closes
+stage "gateway_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/gateway_smoke.py
 # bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
 # one table and flag >10% per-metric tok/s regressions — machine-readable
 # bench history, but cross-round rows come from different silicon windows,
@@ -175,7 +183,8 @@ stage "suite_misc" timeout 600 python -m pytest -q \
   tests/test_control_plane.py tests/test_data.py tests/test_rewards.py \
   tests/test_shaping.py tests/test_long_context.py tests/test_full_finetune.py \
   tests/test_telemetry.py tests/test_obs.py tests/test_weight_bus.py \
-  tests/test_lineage.py tests/test_control.py
+  tests/test_lineage.py tests/test_control.py tests/test_serving_obs.py \
+  tests/test_gateway.py
 stage "suite_io" timeout 600 python -m pytest -q \
   tests/test_from_pretrained.py tests/test_remote_engine.py \
   tests/test_native_tokenizer.py tests/test_native_spm.py \

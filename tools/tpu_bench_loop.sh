@@ -315,6 +315,26 @@ bench env_multiturn /tmp/bench_tpu_env_multiturn.json 1200 \
   BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
   BENCH_SCHEDULER=refill BENCH_SCAN_CHUNK=16 \
   BENCH_ENV=code BENCH_MAX_TURNS=2 BENCH_ENV_OBS_TOKENS=16
+# serving-gateway overload A/B (ISSUE 19): the cb_continuous engine
+# driven through the streaming HTTP front-end by a seeded burst arrival
+# trace at 1x vs 2x rate, class-aware shed floor pinned at 2 (scavenger
+# sheds first, interactive never) — rows record gateway_mode /
+# arrival_rate / ttft_p99_interactive_ms / ttft_p99_batch_ms /
+# shed_frac_by_class, and the r19 contract is bounded interactive p99
+# TTFT at 2x while >=90% of shed/preempt mass stays off interactive.
+# cb_continuous above is the gateway-off control (identical engine env
+# minus BENCH_GATEWAY*); tok/s on these rows is goodput under the
+# arrival process, so bench_history only compares them at equal rate.
+bench gateway_1x /tmp/bench_tpu_gateway_1x.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_GATEWAY=1 BENCH_ARRIVAL_RPS=8 BENCH_ARRIVAL_PROCESS=burst \
+  BENCH_SHED_FLOOR=2
+bench gateway_2x /tmp/bench_tpu_gateway_2x.json 1200 \
+  BENCH_ENGINE=paged BENCH_EOS_RATE=0.002 BENCH_MAX_CONCURRENT=128 \
+  BENCH_SCHEDULER=refill BENCH_CONT_ADMISSION=1 BENCH_SCAN_CHUNK=16 \
+  BENCH_GATEWAY=1 BENCH_ARRIVAL_RPS=16 BENCH_ARRIVAL_PROCESS=burst \
+  BENCH_SHED_FLOOR=2
 run_stage mem_envelope 1200 bash -c \
   'GRAFT_MEMORY_COMPILE=1 python tools/memory_envelope.py \
      > /tmp/memory_envelope_tpu.log 2>&1; rc=$?; tail -5 /tmp/memory_envelope_tpu.log; exit $rc'
@@ -363,6 +383,7 @@ all_done() {
            quant_bf16_ctrl quant_int8_kv quant_int8_base quant_int4_base \
            quant_sampler_fused \
            env_singleturn_ctrl env_multiturn \
+           gateway_1x gateway_2x \
            dispatch_probe sampler_probe; do
     [ -f "/tmp/graft_stage_${n}.done" ] || return 1
   done

@@ -1,0 +1,128 @@
+"""Replay (or synthesize) an open-loop traffic trace against a running
+serving gateway (ISSUE 19).
+
+Two modes:
+
+* ``--synthesize out.jsonl`` — generate a seeded arrival trace (Poisson
+  or burst process, lognormal long-tail prompt/output lengths) and write
+  it as JSONL. No gateway needed.
+* ``--url http://127.0.0.1:PORT`` (with ``--trace in.jsonl`` or inline
+  synthesis) — fire each request at its scheduled offset, open-loop,
+  and print the per-class client-side TTFT/e2e percentile summary as
+  JSON on stdout.
+
+Examples::
+
+    # write a reusable overload trace
+    python -m tools.traffic_replay --synthesize /tmp/burst.jsonl \
+        --n 200 --rate 20 --process burst --seed 7
+
+    # drive it at a live gateway
+    python -m tools.traffic_replay --url http://127.0.0.1:8700 \
+        --trace /tmp/burst.jsonl --speedup 2.0
+
+The trace format is one JSON object per line:
+``{"t": offset_s, "tenant": ..., "cls": ..., "prompt_len": ...,
+"max_new_tokens": ...}`` — small enough to hand-edit, stable enough to
+bisect against."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="traffic_replay",
+        description="Synthesize and/or replay open-loop gateway traffic.",
+    )
+    p.add_argument("--url", default=None,
+                   help="gateway base URL (http://host:port); omit to "
+                        "only synthesize")
+    p.add_argument("--trace", default=None,
+                   help="JSONL arrival trace to replay (else synthesize "
+                        "inline from the knobs below)")
+    p.add_argument("--synthesize", default=None, metavar="OUT",
+                   help="write the synthesized trace to this JSONL path")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n", type=int, default=100,
+                   help="number of requests to synthesize")
+    p.add_argument("--rate", type=float, default=10.0,
+                   help="mean arrival rate (requests/s)")
+    p.add_argument("--process", choices=("poisson", "burst"),
+                   default="poisson")
+    p.add_argument("--burst-every", type=float, default=2.0,
+                   help="seconds between bursts (burst process)")
+    p.add_argument("--burst-size", type=int, default=8,
+                   help="extra back-to-back arrivals per burst")
+    p.add_argument("--class-mix", default=None,
+                   help="cls=weight,... (default "
+                        "interactive=0.4,batch=0.4,scavenger=0.2)")
+    p.add_argument("--tenants", default="acme,globex",
+                   help="comma-separated tenant names to draw from")
+    p.add_argument("--max-prompt-tokens", type=int, default=64)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--speedup", type=float, default=1.0,
+                   help="replay the trace this many times faster than "
+                        "real time")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-request client timeout (s)")
+    return p
+
+
+def _parse_mix(spec: str | None) -> dict[str, float] | None:
+    if not spec:
+        return None
+    mix: dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(f"bad --class-mix entry {part!r} "
+                             "(expected cls=weight)")
+        k, v = part.split("=", 1)
+        mix[k.strip().lower()] = float(v)
+    return mix or None
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from distrl_llm_tpu.gateway import traffic
+
+    if args.trace:
+        arrivals = traffic.load_trace(args.trace)
+    else:
+        arrivals = traffic.synthesize(
+            seed=args.seed, n_requests=args.n, rate_rps=args.rate,
+            process=args.process, burst_every_s=args.burst_every,
+            burst_size=args.burst_size, class_mix=_parse_mix(args.class_mix),
+            tenants=tuple(
+                t.strip() for t in args.tenants.split(",") if t.strip()
+            ),
+            max_prompt_tokens=args.max_prompt_tokens,
+            max_new_tokens=args.max_new_tokens,
+        )
+    if args.synthesize:
+        traffic.save_trace(args.synthesize, arrivals)
+        print(f"wrote {len(arrivals)} arrivals -> {args.synthesize}",
+              file=sys.stderr)
+    if args.url is None:
+        if not args.synthesize:
+            print("nothing to do: pass --url to replay or --synthesize "
+                  "to write a trace", file=sys.stderr)
+            return 2
+        return 0
+    summary = traffic.replay(
+        args.url, arrivals, timeout_s=args.timeout, speedup=args.speedup,
+    )
+    json.dump(summary, sys.stdout, indent=2)
+    print()
+    errors = sum(c["errors"] for c in summary["by_class"].values())
+    return 0 if errors == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

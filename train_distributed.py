@@ -365,6 +365,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo_queue_wait_ms", type=float, default=None,
                    help="queue-wait SLO: arms the sentinel's "
                         "queue_wait_blowup trigger; requires --sentinel")
+    p.add_argument("--gateway_port", type=int, default=None,
+                   help="multi-tenant serving gateway (ISSUE 19): serve "
+                        "POST /v1/generate on 127.0.0.1:<port> (0 = auto-"
+                        "assign; the bound port prints as 'GATEWAY <n>'), "
+                        "streaming tokens per request with tenant + "
+                        "priority class (interactive > batch > scavenger) "
+                        "from X-Tenant / X-Priority headers; requires "
+                        "engine_impl=paged + --continuous_batching + "
+                        "--continuous_admission")
+    p.add_argument("--gateway_classes", type=str, default=None,
+                   help="comma-separated subset of priority classes the "
+                        "gateway serves (default: all three); requests "
+                        "naming an unserved class get HTTP 400")
+    p.add_argument("--tenant_quota", type=str, default=None,
+                   help="per-tenant reserved-token quotas "
+                        "'tenant=tokens,...' (pseudo-tenant 'default' caps "
+                        "unnamed tenants); admission declines on quota are "
+                        "the 'quota' stall reason; requires --gateway_port")
     p.add_argument("--learn_obs", action="store_true",
                    help="training-dynamics observability (ISSUE 16): fuse "
                         "the device-computed dynamics bundle (masked policy "
