@@ -620,6 +620,19 @@ class TrainConfig:
     # version) snapshot and skip the poisoned step instead of training on
     # NaNs from there on. Applicable to every run shape.
     control_nan_rollback: bool = False
+    # --- elastic fleet (distrl_llm_tpu/distributed/fleet.py, ISSUE 20) ----
+    # autoscaling governor: steers a FleetSupervisor-owned worker pool's
+    # target size over [fleet_min, fleet_max] — scale-up admits a cold
+    # worker through add_worker (full weight-bus resync), scale-down
+    # retires the least-productive worker through the graceful-drain path.
+    # Requires rollout_workers + worker_rejoin + fleet bounds. NOT armed by
+    # the --control master (resizing the pool is a capacity decision, not
+    # a self-healing default) — always explicit.
+    control_autoscale: bool = False
+    # target-pool bounds for the autoscaler / FleetSupervisor; 0 = unset
+    # (the fleet stays static at the connect-time worker set)
+    fleet_min: int = 0
+    fleet_max: int = 0
     # global actuation budget per run: once spent, every knob freezes at
     # its current (clamped) value — a runaway controller is bounded by
     # construction
@@ -1268,6 +1281,21 @@ class TrainConfig:
                 "relies on the rejoin loop to re-admit them — requires "
                 "rollout_workers with worker_rejoin"
             )
+        # --- elastic fleet (ISSUE 20) ---------------------------------
+        if (self.fleet_min or self.fleet_max) and not (
+            1 <= self.fleet_min <= self.fleet_max
+        ):
+            raise ValueError(
+                f"fleet bounds need 1 <= fleet_min <= fleet_max, got "
+                f"[{self.fleet_min}, {self.fleet_max}]"
+            )
+        if self.control_autoscale and not self._autoscale_applicable():
+            raise ValueError(
+                "control_autoscale resizes a dynamic rollout pool — "
+                "requires rollout_workers with worker_rejoin (cold joins "
+                "ride the rejoin/resync path) and fleet_min/fleet_max "
+                "bounds for the target-size actuator"
+            )
 
     def _hbm_controller_applicable(self) -> bool:
         return bool(
@@ -1281,6 +1309,12 @@ class TrainConfig:
             self._hbm_controller_applicable()
             and (self.slo_ttft_ms is not None
                  or self.slo_queue_wait_ms is not None)
+        )
+
+    def _autoscale_applicable(self) -> bool:
+        return bool(
+            self.rollout_workers and self.worker_rejoin
+            and self.fleet_max > 0
         )
 
     def armed_controllers(self) -> tuple[str, ...]:
@@ -1305,6 +1339,11 @@ class TrainConfig:
             armed.append("worker_health")
         if self.control_nan_rollback or self.control:
             armed.append("nan_rollback")
+        # explicit-only (never under the --control master): resizing the
+        # pool is a capacity decision — __post_init__ already rejected the
+        # flag on shapes that cannot host it
+        if self.control_autoscale:
+            armed.append("autoscale")
         return tuple(armed)
 
     @property

@@ -11,9 +11,11 @@ bundle and keep going. This package closes the loops:
   per-governor cooldowns and a sustained-headroom regrow dwell, a global
   per-run actuation budget, and the :class:`ControlLimits` handle the paged
   engine's admission loop consults (one attribute check when absent).
-* :mod:`~distrl_llm_tpu.control.controllers` — the five concrete
+* :mod:`~distrl_llm_tpu.control.controllers` — the six concrete
   controllers: HBM admission governor, SLO load-shedder, staleness
-  governor, worker-health actor, and the nan-loss rollback.
+  governor, worker-health actor, the nan-loss rollback, and the
+  autoscaling governor (ISSUE 20) steering the elastic fleet's target
+  pool size.
 
 Everything defaults OFF behind ``--control`` / per-controller flags; a run
 with controllers off is byte-identical to one without this package (the
@@ -39,6 +41,7 @@ from distrl_llm_tpu.control.governor import (
     Governor,
 )
 from distrl_llm_tpu.control.controllers import (
+    AutoscaleGovernor,
     HbmGovernor,
     NanRollbackController,
     SloShedGovernor,
@@ -63,6 +66,7 @@ __all__ = [
     "ControlLimits",
     "ControlRuntime",
     "Governor",
+    "AutoscaleGovernor",
     "HbmGovernor",
     "NanRollbackController",
     "SloShedGovernor",

@@ -1,4 +1,5 @@
-"""The five concrete controllers of the self-healing runtime (ISSUE 14).
+"""The six concrete controllers of the self-healing runtime (ISSUE 14,
+ISSUE 20).
 
 Each one closes a loop the observability plane already measures:
 
@@ -21,6 +22,10 @@ own EMA                   worker (PR 5 rejoin loop       min_healthy; per-
                           probes + re-admits)            worker cooldown
 non-finite loss           restore last-good (adapter,    max_rollbacks per
                           opt state, version) snapshot   run
+serving queue-wait /      FleetSupervisor.scale_to       target in
+learner idle (up), per-   (add_worker cold joins /       [fleet_min,
+worker tok/s (down)       retire_worker drains)          fleet_max]; +-1 per
+                                                         action, dwell down
 ========================  =============================  ====================
 
 Every controller rides the governor framework's cooldown/budget/clamp
@@ -554,6 +559,288 @@ class WorkerHealthGovernor:
         ))
 
 
+# ---------------------------------------------------- autoscale governor
+
+
+class AutoscaleGovernor:
+    """Elastic pool sizing (ISSUE 20): steers the FleetSupervisor's
+    ``scale_to`` actuator over target worker count [fleet_min, fleet_max].
+
+    Signals, each normalized by its threshold so the deadband math is
+    unitless (the SloShedGovernor convention):
+
+    * **up-pressure** — the step's worst observed serving queue wait
+      (``serving/queue_wait_ms_max`` or the fleet-folded worker max) over
+      ``queue_wait_high_ms``, and ``obs/learner_idle_frac`` over
+      ``idle_high``. Load ratio > 1.0 scales up one worker (cooldown- and
+      budget-guarded): spawn + cold admission through
+      ``engine.add_worker`` with a full-tensor weight-bus resync.
+    * **down-pressure** — per-worker tok/s (rate EMAs derived from the
+      fleet view's cumulative ``gen_tokens`` marks, the WorkerHealth
+      math) below ``tok_s_low`` while the up-signal sits under
+      ``release_frac`` (hysteresis: the band between ``release_frac`` and
+      1.0 holds), sustained for ``dwell_steps`` consecutive observations.
+      Scale-down retires the *least-productive* worker (lowest rate EMA)
+      through the graceful-drain path. ``tok_s_low=None`` disables
+      scale-down entirely — absence of load is never, by itself, a reason
+      to shrink (and the armed-but-quiescent run stays byte-identical to
+      controllers-off, the PR 14 pin).
+
+    Every step also pumps ``supervisor.poll()`` — death observation and
+    bounded respawn ride the control pass, so a preemption during a scale
+    event converges back to the target without a separate watchdog."""
+
+    ESCALATE_KIND = "scale_up"
+
+    def __init__(self, supervisor, fleet_provider: Callable[[], Mapping | None] | None,
+                 *, min_workers: int, max_workers: int,
+                 queue_wait_high_ms: float | None = None,
+                 idle_high: float | None = None,
+                 tok_s_low: float | None = None,
+                 release_frac: float = 0.7, ema_alpha: float = 0.3,
+                 cooldown_steps: int = 4, dwell_steps: int = 3):
+        if not (1 <= int(min_workers) <= int(max_workers)):
+            raise ValueError(
+                f"need 1 <= min_workers <= max_workers, got "
+                f"[{min_workers}, {max_workers}]"
+            )
+        if not 0.0 < release_frac <= 1.0:
+            raise ValueError(
+                f"release_frac must be in (0, 1], got {release_frac}"
+            )
+        if dwell_steps < 1:
+            raise ValueError(f"dwell_steps must be >= 1, got {dwell_steps}")
+        self.name = "autoscale"
+        self.supervisor = supervisor
+        self.fleet_provider = fleet_provider
+        self.queue_wait_high_ms = queue_wait_high_ms
+        self.idle_high = idle_high
+        self.tok_s_low = tok_s_low
+        self.release_frac = float(release_frac)
+        self.ema_alpha = float(ema_alpha)
+        self.cooldown_steps = int(cooldown_steps)
+        self.dwell_steps = int(dwell_steps)
+        self._last_action_step: int | None = None
+        self._ok_run = 0
+        self.last_signal: float | None = None
+        self._victims: tuple = ()
+        # per-worker (ts, cumulative tokens) marks + rate EMAs — the
+        # least-productive ranking scale-down retires by
+        self._marks: dict[str, tuple[float, float]] = {}
+        self._ema: dict[str, float] = {}
+        self._pids: dict[str, Any] = {}
+        initial = float(
+            getattr(supervisor, "target_workers", 0)
+            or getattr(supervisor, "pool_size", 0) or min_workers
+        )
+        self.actuator = BoundedActuator(
+            name="target_workers",
+            value=min(max(initial, float(min_workers)), float(max_workers)),
+            min_value=float(min_workers), max_value=float(max_workers),
+            apply=self._apply_target,
+            # directionality note: for a pool, the HIGH-signal response is
+            # MORE capacity — the custom step() below maps breach→regrow
+            # (+1) and sustained-calm→shrink (−1), inverse of the scalar
+            # Governor base (which is why this is a custom shape)
+            shrink=lambda v: v - 1.0,
+            regrow=lambda v: v + 1.0,
+            integer=True,
+        )
+
+    def _apply_target(self, v: float) -> None:
+        victims, self._victims = self._victims, ()
+        self.supervisor.scale_to(int(v), victims=victims)
+
+    # ------------------------------------------------------------- signals
+
+    def _load(self, metrics: Mapping[str, Any]) -> float | None:
+        """Worst up-pressure ratio across the armed signals, or None when
+        no armed signal has an observation this step."""
+        from distrl_llm_tpu.serving_obs import (
+            FLEET_SERVING_QUEUE_WAIT_MAX_MS,
+            SERVING_QUEUE_WAIT_MS,
+        )
+
+        ratios: list[float] = []
+        if self.queue_wait_high_ms is not None:
+            vals = [
+                float(metrics[k])
+                for k in (SERVING_QUEUE_WAIT_MS + "_max",
+                          FLEET_SERVING_QUEUE_WAIT_MAX_MS)
+                if metrics.get(k) is not None
+            ]
+            if vals:
+                ratios.append(max(vals) / float(self.queue_wait_high_ms))
+        if self.idle_high is not None:
+            from distrl_llm_tpu.obs import OBS_LEARNER_IDLE
+
+            idle = metrics.get(OBS_LEARNER_IDLE)
+            if idle is not None:
+                ratios.append(float(idle) / float(self.idle_high))
+        return max(ratios) if ratios else None
+
+    def _rates(self) -> dict[str, float]:
+        """Per-worker tok/s this pass, updating the rate EMAs (the
+        WorkerHealthGovernor mark math; a pid change resets the track)."""
+        fleet = None
+        if self.fleet_provider is not None:
+            try:
+                fleet = self.fleet_provider()
+            except Exception:  # noqa: BLE001 — a failed refresh skips the obs
+                log.warning("fleet refresh failed in autoscale governor",
+                            exc_info=True)
+        rates: dict[str, float] = {}
+        if not fleet:
+            return rates
+        workers = fleet.get("workers")
+
+        def norm(a):
+            # worker_states addresses are (host, port) tuples; the
+            # worker_metrics table keys are "host:port" track labels
+            if isinstance(a, (tuple, list)):
+                return f"{a[0]}:{a[1]}"
+            return str(a)
+
+        live = (
+            {norm(w.get("address")) for w in workers
+             if w.get("healthy") and not w.get("cold")
+             and not w.get("retired")}
+            if workers is not None else None
+        )
+        for addr, rec in (fleet.get("worker_metrics") or {}).items():
+            pid = rec.get("pid")
+            last_pid = self._pids.get(addr)
+            self._pids[addr] = pid
+            if pid is not None and last_pid is not None and pid != last_pid:
+                self._ema.pop(addr, None)
+                self._marks.pop(addr, None)
+            if live is not None and addr not in live:
+                self._ema.pop(addr, None)
+                self._marks.pop(addr, None)
+                continue
+            tokens = float(rec.get("gen_tokens", 0.0))
+            ts = float(rec.get("ts", time.time()))
+            mark = self._marks.get(addr)
+            self._marks[addr] = (ts, tokens)
+            if mark is None or ts <= mark[0] or tokens < mark[1]:
+                continue
+            rate = (tokens - mark[1]) / (ts - mark[0])
+            rates[addr] = rate
+            ema = self._ema.get(addr)
+            self._ema[addr] = (
+                rate if ema is None
+                else self.ema_alpha * rate + (1 - self.ema_alpha) * ema
+            )
+        # forget tracks the fleet view no longer reports (scaled-in)
+        known = set((fleet.get("worker_metrics") or {}))
+        for addr in list(self._ema):
+            if addr not in known:
+                self._ema.pop(addr, None)
+                self._marks.pop(addr, None)
+        return rates
+
+    def _least_productive(self) -> tuple:
+        """Victims for a scale-down, least-productive first: the lowest
+        rate EMAs among the supervisor's live pool (workers without an
+        EMA yet — cold joins — are never first pick)."""
+        pool = {
+            f"{h}:{p}" for h, p in getattr(
+                self.supervisor, "addresses", lambda: []
+            )()
+        }
+        ranked = sorted(
+            (a for a in self._ema if a in pool), key=lambda a: self._ema[a]
+        )
+        return tuple(ranked)
+
+    # ---------------------------------------------------------------- step
+
+    def _resize(self, step: int, runtime: ControlRuntime, kind: str,
+                new_value: float, reason: str, victims: tuple = (),
+                trigger: str | None = None) -> list[ControlAction]:
+        new = self.actuator.clamp(new_value)
+        if new == self.actuator.value:
+            return []  # at the bound already
+        action = ControlAction(
+            step=step, controller=self.name, actuator=self.actuator.name,
+            kind=kind, old=self.actuator.value, new=new, reason=reason,
+            trigger=trigger,
+        )
+
+        def push():
+            self.actuator.value = new
+            self._victims = victims
+            self.actuator.apply(new)
+
+        if runtime.act(action, apply=push):
+            self._last_action_step = step
+            self._ok_run = 0
+            return [action]
+        return []
+
+    def step(self, step: int, metrics: Mapping[str, Any],
+             runtime: ControlRuntime) -> list[ControlAction]:
+        # observe the pool first: deaths noticed here respawn within the
+        # supervisor's restart budget, so a preemption mid-scale-event
+        # converges without a separate watchdog thread
+        poll = getattr(self.supervisor, "poll", None)
+        if poll is not None:
+            poll()
+        rates = self._rates()
+        load = self._load(metrics)
+        self.last_signal = load
+        if load is not None and load > 1.0:
+            self._ok_run = 0
+            if (self.actuator.value < self.actuator.max_value
+                    and cooldown_ok(self, step, runtime)):
+                return self._resize(
+                    step, runtime, "scale_up", self.actuator.value + 1,
+                    f"load at {load:.3g}x its scale-up threshold",
+                )
+            return []
+        if load is not None and load >= self.release_frac:
+            # inside the deadband: hysteresis hold, no dwell credit
+            self._ok_run = 0
+            return []
+        # below the band (or no up-signal): down-pressure needs an actual
+        # low-throughput observation — calm alone never shrinks the pool
+        if self.tok_s_low is None:
+            self._ok_run = 0
+            return []
+        per_worker = None
+        if rates:
+            per_worker = sum(rates.values()) / len(rates)
+        if per_worker is None or per_worker >= self.tok_s_low:
+            self._ok_run = 0
+            return []
+        self._ok_run += 1
+        if self._ok_run < self.dwell_steps:
+            return []
+        if (self.actuator.value > self.actuator.min_value
+                and cooldown_ok(self, step, runtime)):
+            return self._resize(
+                step, runtime, "scale_down", self.actuator.value - 1,
+                f"per-worker rate {per_worker:.1f} tok/s < "
+                f"{self.tok_s_low:.1f} for {self._ok_run} steps "
+                f"(dwell {self.dwell_steps})",
+                victims=self._least_productive(),
+            )
+        return []
+
+    def on_trigger(self, trigger: str, step: int, runtime: ControlRuntime,
+                   extra: Mapping[str, Any]) -> bool:
+        """queue_wait_blowup escalation (registered only when the SLO
+        shedder is not armed — a trigger maps to one governor): one
+        immediate scale-up, cooldown- and budget-guarded."""
+        self._ok_run = 0
+        if not cooldown_ok(self, step, runtime):
+            return False
+        return bool(self._resize(
+            step, runtime, "scale_up", self.actuator.value + 1,
+            f"sentinel trigger {trigger!r}", trigger=trigger,
+        ))
+
+
 # ---------------------------------------------------- nan-loss rollback
 
 
@@ -636,7 +923,8 @@ class NanRollbackController:
 
 
 def build_runtime(config, *, engine=None, recorder=None,
-                  driver=None, fleet_provider=None) -> ControlRuntime | None:
+                  driver=None, fleet_provider=None,
+                  fleet_supervisor=None) -> ControlRuntime | None:
     """Assemble the ControlRuntime for a trainer from its TrainConfig
     (None when no controller is armed). The staleness governor attaches
     later — its plant (policy + buffer) only exists once the async loop
@@ -688,6 +976,45 @@ def build_runtime(config, *, engine=None, recorder=None,
             ),
             triggers=("tok_s_regression",),
         )
+    if "autoscale" in armed:
+        supervisor = fleet_supervisor or getattr(
+            engine, "fleet_supervisor", None
+        )
+        if supervisor is None:
+            # armed without a supervised pool (e.g. workers started by
+            # hand): there is no process actuator to resize — stay inert
+            # rather than register a governor that can only half-act
+            log.warning(
+                "autoscale armed but no FleetSupervisor owns the worker "
+                "pool — the governor is not registered"
+            )
+        else:
+            runtime.register(
+                AutoscaleGovernor(
+                    supervisor, fleet_provider,
+                    min_workers=config.fleet_min,
+                    max_workers=config.fleet_max,
+                    queue_wait_high_ms=config.slo_queue_wait_ms,
+                    # scale up when the learner is starved for data most
+                    # of the step — but only where idle is a *signal*: in
+                    # sync mode the learner structurally waits out every
+                    # rollout, so a calm run would read as breached and the
+                    # armed-but-quiescent byte-identity pin would break.
+                    # Scale-down stays opt-in (tok_s_low) — calm alone
+                    # must never shrink the pool.
+                    idle_high=(
+                        0.9 if config.rollout_mode != "sync" else None
+                    ),
+                    cooldown_steps=max(2 * config.control_cooldown_steps, 2),
+                    dwell_steps=config.control_dwell_steps,
+                ),
+                # a sentinel trigger maps to ONE governor: the shedder
+                # owns queue_wait_blowup when armed; otherwise the blowup
+                # escalates here as an immediate scale-up
+                triggers=(
+                    () if "shed" in armed else ("queue_wait_blowup",)
+                ),
+            )
     if "nan_rollback" in armed:
         runtime.nan = NanRollbackController()
     return runtime

@@ -341,6 +341,10 @@ class _Worker:
     conn: Connection | None
     healthy: bool = True
     cold: bool = False  # just rejoined: its engine process recompiles
+    # TERMINAL membership state (ISSUE 20 elastic fleet): an intentionally
+    # scaled-in worker. Distinct from death — the rejoin loop must never
+    # re-dial it, quarantine refuses it, and dispatch never routes to it.
+    retired: bool = False
 
 
 class DriverClient:
@@ -369,6 +373,11 @@ class DriverClient:
         # bumps on every successful re-admit; RemoteEngine clears its warm
         # keys when it changes (the rejoined worker compiles from scratch)
         self.rejoin_epoch = 0
+        # bumps on every MEMBERSHIP change (add_worker / retire_worker),
+        # distinct from rejoin_epoch: a dispatch round spanning a scale
+        # event re-snapshots the worker set per iteration, so shards on a
+        # retiring worker requeue to survivors and every group is conserved
+        self.membership_epoch = 0
         # weight-bus hooks (weight_bus.py, ISSUE 9). rejoin_hook(address)
         # runs after a PING-verified reconnect and BEFORE re-admission —
         # the bus resyncs the cold worker with a full-tensor push; False
@@ -418,13 +427,15 @@ class DriverClient:
     def worker_states(self) -> list[dict]:
         """Point-in-time health view for the observability plane
         (obs.FleetAggregator): one dict per configured worker, under the
-        same mutex health transitions take."""
+        same mutex health transitions take. A retired worker reports
+        distinctly (terminal; not merely unhealthy)."""
         with self._workers_mu:
             return [
                 {
                     "address": f"{w.address[0]}:{w.address[1]}",
                     "healthy": bool(w.healthy),
                     "cold": bool(w.cold),
+                    "retired": bool(w.retired),
                 }
                 for w in self._workers
             ]
@@ -452,76 +463,95 @@ class DriverClient:
     def _rejoin_loop(self) -> None:
         """Background re-dial of unhealthy workers with the policy's seeded
         backoff; a PING-verified connection re-admits the worker (cold: its
-        engine process likely restarted and recompiles everything)."""
-        backoff: dict[int, tuple[int, float]] = {}  # idx -> (attempt, next_t)
+        engine process likely restarted and recompiles everything).
+
+        Backoff state is keyed by ADDRESS, not list index: the worker list
+        grows under add_worker, and an index key would alias one worker's
+        backoff clock onto another after a scale event. A RETIRED worker is
+        terminal — it is never probed, never re-dialed (the ISSUE 20
+        rejoin/retire aliasing fix)."""
+        backoff: dict[tuple, tuple[int, float]] = {}  # addr -> (attempt, next_t)
         while not self._stop_rejoin.wait(self._rejoin_poll_s):
-            for k, w in enumerate(self._workers):
+            with self._workers_mu:
+                snapshot = list(self._workers)
+            for w in snapshot:
                 if self._stop_rejoin.is_set():
                     break
-                if w.healthy:
-                    backoff.pop(k, None)
+                if w.retired:
+                    backoff.pop(w.address, None)
                     continue
-                attempt, next_t = backoff.get(k, (0, 0.0))
+                if w.healthy:
+                    backoff.pop(w.address, None)
+                    continue
+                attempt, next_t = backoff.get(w.address, (0, 0.0))
                 if time.monotonic() < next_t:
                     continue
                 if self._try_rejoin(w):
-                    backoff.pop(k, None)
+                    backoff.pop(w.address, None)
                 else:
-                    backoff[k] = (
+                    backoff[w.address] = (
                         attempt + 1,
                         time.monotonic() + self.retry.backoff(attempt),
                     )
 
+    def _dial_verified(self, address: tuple[str, int]) -> Connection | None:
+        """The admission preamble shared by rejoin AND first joins
+        (``add_worker``): cp_connect → PING/PONG → weight-bus full resync
+        through ``rejoin_hook``. Returns the verified connection, or None
+        — the caller owns the admit-under-mutex step."""
+        host, port = address
+        fd = self._lib.cp_connect(
+            host.encode(), port, self._connect_timeout_ms
+        )
+        if fd < 0:
+            return None
+        conn = resilience.wrap_connection(Connection(fd))
+        rid = self._next_id()
+        ok = False
+        try:
+            conn.send(MSG_PING, rid)
+            frame = conn.recv(timeout_ms=5000)
+            ok = (
+                frame is not None
+                and frame[0] == MSG_PONG
+                and frame[1] == rid
+            )
+        except WorkerDeadError:
+            ok = False
+        if not ok:
+            conn.close()
+            return None
+        hook = self.rejoin_hook
+        if hook is not None:
+            # weight-bus resync (ISSUE 9): the joining worker's engine
+            # process has no adapter cache — push the current version
+            # full-tensor BEFORE admission, so the first post-join
+            # dispatch never names a version it lacks
+            try:
+                synced = bool(hook(tuple(address)))
+            except Exception:  # noqa: BLE001 — a failed resync fails
+                # this attempt; the caller's backoff/retry owns the rest
+                log.warning(
+                    "join/rejoin hook failed for %s", address, exc_info=True
+                )
+                synced = False
+            if not synced:
+                conn.close()
+                return None
+        return conn
+
     def _try_rejoin(self, w: _Worker) -> bool:
         host, port = w.address
         with telemetry.span("cp/reconnect", worker=f"{host}:{port}") as sp:
-            fd = self._lib.cp_connect(
-                host.encode(), port, self._connect_timeout_ms
-            )
-            if fd < 0:
+            conn = self._dial_verified(w.address)
+            if conn is None:
                 sp.set(ok=False)
                 return False
-            conn = resilience.wrap_connection(Connection(fd))
-            rid = self._next_id()
-            ok = False
-            try:
-                conn.send(MSG_PING, rid)
-                frame = conn.recv(timeout_ms=5000)
-                ok = (
-                    frame is not None
-                    and frame[0] == MSG_PONG
-                    and frame[1] == rid
-                )
-            except WorkerDeadError:
-                ok = False
-            if not ok:
-                conn.close()
-                sp.set(ok=False)
-                return False
-            hook = self.rejoin_hook
-            if hook is not None:
-                # weight-bus resync (ISSUE 9): the restarted worker's
-                # engine process lost its adapter cache — push the current
-                # version full-tensor BEFORE re-admission, so the first
-                # post-rejoin dispatch never names a version it lacks
-                try:
-                    synced = bool(hook(w.address))
-                except Exception:  # noqa: BLE001 — a failed resync fails
-                    # this attempt; the backoff loop retries
-                    log.warning(
-                        "rejoin hook failed for %s", w.address, exc_info=True
-                    )
-                    synced = False
-                if not synced:
-                    conn.close()
-                    sp.set(ok=False)
-                    return False
             with self._workers_mu:
-                if self._stop_rejoin.is_set():
-                    # shutdown() won the race (it may have given up joining
-                    # this thread while we were blocked in connect/PING):
-                    # admitting now would leak the fd and leave a worker
-                    # process that never receives MSG_SHUTDOWN
+                if self._stop_rejoin.is_set() or w.retired:
+                    # shutdown() (or a racing retire) won: admitting now
+                    # would leak the fd and leave a worker process that
+                    # never receives MSG_SHUTDOWN
                     conn.close()
                     sp.set(ok=False)
                     return False
@@ -534,6 +564,118 @@ class DriverClient:
         telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
         telemetry.gauge_set(resilience.CP_REJOIN_EPOCH, self.rejoin_epoch)
         log.info("worker %s:%d rejoined (cold)", host, port)
+        return True
+
+    # ----------------------------------------------------------- membership
+
+    @staticmethod
+    def _parse_address(address) -> tuple[str, int]:
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            return (host or "127.0.0.1", int(port))
+        return (address[0], int(address[1]))
+
+    def add_worker(self, address) -> bool:
+        """Admit a NEW worker mid-run (ISSUE 20 elastic fleet): the PR 5
+        rejoin path generalized to first joins — dial, PING-verify, full
+        weight-bus resync through ``rejoin_hook``, admit COLD (its engine
+        compiles from scratch, so the next round gets the cold deadline).
+        Re-adding a previously retired address re-activates its slot.
+
+        Returns False when the worker cannot be verified (unreachable,
+        no PONG, resync failed) or the address is already an active
+        member; the membership set is unchanged on failure."""
+        address = self._parse_address(address)
+        with self._workers_mu:
+            existing = next(
+                (w for w in self._workers if w.address == address), None
+            )
+            if existing is not None and not existing.retired:
+                log.warning(
+                    "add_worker(%s): already a member (healthy=%s)",
+                    address, existing.healthy,
+                )
+                return False
+        conn = self._dial_verified(address)
+        if conn is None:
+            return False
+        with self._workers_mu:
+            if self._stop_rejoin.is_set():
+                # shutdown in progress: do not admit into a closing plane
+                conn.close()
+                return False
+            target = next(
+                (w for w in self._workers if w.address == address), None
+            )
+            if target is None:
+                target = _Worker(address, None, healthy=False)
+                self._workers.append(target)
+            elif not target.retired:
+                conn.close()  # lost an add/add race: already active
+                return False
+            target.retired = False
+            target.conn = conn
+            target.cold = True
+            target.healthy = True
+            self.rejoin_epoch += 1
+            self.membership_epoch += 1
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+        telemetry.gauge_set(resilience.CP_REJOIN_EPOCH, self.rejoin_epoch)
+        log.info("worker %s:%d added (cold)", *address)
+        return True
+
+    def retire_worker(self, address, drain: bool = True,
+                      timeout_ms: int = 5000) -> bool:
+        """Intentional scale-in (ISSUE 20): transition a worker to the
+        TERMINAL ``retired`` state — distinct from death. The rejoin loop
+        never re-dials it, dispatch never routes to it, and a shard in
+        flight on it requeues to survivors through the standard
+        resubmission path (group conservation holds across the event).
+
+        ``drain=True`` sends MSG_SHUTDOWN over a dedicated connection so
+        the worker exits its serve loop cleanly (the SIGTERM contract:
+        in-flight frames deliver their results before the process moves
+        on). Supervised local workers are drained by their FleetSupervisor
+        via SIGTERM instead (drain=False here).
+
+        Returns False for an unknown or already-retired address. Bumps
+        ``cp/retires`` — never the quarantine/reconnect counters."""
+        address = self._parse_address(address)
+        with self._workers_mu:
+            target = next(
+                (w for w in self._workers if w.address == address), None
+            )
+            if target is None or target.retired:
+                return False
+            target.retired = True
+            target.healthy = False
+            conn, target.conn = target.conn, None
+            self.membership_epoch += 1
+        if conn is not None:
+            conn.close()
+        if drain:
+            # dedicated drain connection: the dispatch conn above may have
+            # a drain thread blocked in recv on it — sending SHUTDOWN
+            # there would corrupt the request/response pairing
+            host, port = address
+            fd = self._lib.cp_connect(
+                host.encode(), port, self._connect_timeout_ms
+            )
+            if fd >= 0:
+                dconn = resilience.wrap_connection(Connection(fd))
+                try:
+                    dconn.send(MSG_SHUTDOWN, self._next_id())
+                    dconn.recv(timeout_ms)
+                except WorkerDeadError:
+                    pass  # already gone: retired either way
+                finally:
+                    dconn.close()
+        telemetry.counter_add(resilience.CP_RETIRES)
+        telemetry.gauge_set(resilience.CP_HEALTHY_GAUGE, self.num_healthy)
+        log.info(
+            "worker %s:%d retired (%s)", *address,
+            "drained" if drain else "no drain",
+        )
         return True
 
     # ---------------------------------------------------------------- health
@@ -550,11 +692,7 @@ class DriverClient:
         healthy workers (a controller must degrade capacity, never zero
         it), or when no rejoin loop is running (the quarantine would be
         permanent — that is a kill, not a control action)."""
-        if isinstance(address, str):
-            host, _, port = address.rpartition(":")
-            address = (host or "127.0.0.1", int(port))
-        else:
-            address = (address[0], int(address[1]))
+        address = self._parse_address(address)
         if self._rejoin_thread is None:
             log.warning(
                 "refusing to quarantine %s: worker_rejoin is off, so the "
@@ -565,7 +703,9 @@ class DriverClient:
             target = next(
                 (w for w in self._workers if w.address == address), None
             )
-            if target is None or not target.healthy:
+            if target is None or not target.healthy or target.retired:
+                # retired is TERMINAL: quarantining it would re-enter the
+                # rejoin loop's probe set and re-dial an intentional exit
                 return False
             healthy = sum(w.healthy for w in self._workers)
             if healthy - 1 < max(int(min_healthy), 1):
@@ -645,6 +785,16 @@ class DriverClient:
         ledger records per sampled group (ISSUE 10)."""
         rid = self._next_id()
         host, port = w.address
+        # ONE snapshot of the connection: retire_worker / _mark_unhealthy
+        # null w.conn concurrently, and a torn read here would surface as
+        # AttributeError instead of the WorkerDeadError the resubmission
+        # path handles (ISSUE 20 mid-round scale events)
+        conn = w.conn
+        if conn is None:
+            raise WorkerDeadError(
+                f"worker {w.address} connection closed mid-round "
+                "(retired or demoted)"
+            )
         # dispatch id: always allocated (a counter bump) so lineage works
         # with tracing off; the ctx ENVELOPE only ships while tracing is on
         ctx = telemetry.next_dispatch_context()
@@ -661,12 +811,12 @@ class DriverClient:
             telemetry.counter_add(resilience.CP_DISPATCH_BYTES, len(payload))
             if telemetry.enabled():
                 telemetry.emit_flow_start(ctx["dispatch_id"])
-                w.conn.send(
+                conn.send(
                     MSG_DISPATCH_CTX, rid, pickle.dumps((ctx, payload))
                 )
             else:
-                w.conn.send(MSG_DISPATCH, rid, payload)
-            frame = w.conn.recv(timeout_ms)
+                conn.send(MSG_DISPATCH, rid, payload)
+            frame = conn.recv(timeout_ms)
         if frame is None:
             raise WorkerDeadError(
                 f"worker {w.address} missed the {timeout_ms}ms deadline"
@@ -791,7 +941,13 @@ class DriverClient:
                     f"{len(pending)} shard(s) still pending"
                 )
             with self._workers_mu:
-                avail = [w for w in self._workers if w.healthy and w.conn]
+                # membership snapshot per iteration: a worker retired (or
+                # added) MID-round is respected at the next redistribution,
+                # so a round spanning a scale event conserves every group
+                avail = [
+                    w for w in self._workers
+                    if w.healthy and w.conn and not w.retired
+                ]
                 warm = [w for w in avail if not w.cold]
             # fall back to cold workers only when they are ALL that's left
             # (better a possible compile-time miss than failing the round)

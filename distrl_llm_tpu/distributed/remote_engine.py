@@ -139,6 +139,37 @@ class RemoteEngine:
             driver.rejoin_hook = self._rejoin_resync
             driver.transient_hook = self._transient_resync
             driver.shutdown_hooks.append(self.bus.close)
+        # elastic-fleet process owner (ISSUE 20): a launcher that spawns
+        # local worker processes attaches its FleetSupervisor here; the
+        # autoscaling governor resizes the pool through it
+        self.fleet_supervisor = None
+
+    # ------------------------------------------------------------ membership
+
+    def add_worker(self, address) -> bool:
+        """Admit one worker mid-run (ISSUE 20): the bus learns the address
+        FIRST (the driver's admission hook full-syncs through it), then the
+        control plane dials, PING-verifies, resyncs, and admits cold."""
+        address = self.driver._parse_address(address)
+        if self.bus is not None:
+            self.bus.add_worker(tuple(address))
+        if self.driver.add_worker(address):
+            return True
+        # failed admission must not leave a phantom bus target blocking
+        # future flushes
+        if self.bus is not None:
+            self.bus.retire_worker(tuple(address))
+        return False
+
+    def retire_worker(self, address, drain: bool = True) -> bool:
+        """Retire one worker (ISSUE 20 scale-in): membership leaves the
+        control plane first (no new shards route to it), then the bus drops
+        it so an in-flight broadcast skips it instead of hanging flush()."""
+        address = self.driver._parse_address(address)
+        ok = self.driver.retire_worker(address, drain=drain)
+        if self.bus is not None:
+            self.bus.retire_worker(tuple(address))
+        return ok
 
     # ------------------------------------------------------------ weight bus
 

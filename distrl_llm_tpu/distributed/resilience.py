@@ -24,7 +24,8 @@ remaining failure half (LlamaRL/Laminar-style fault isolation, PAPERS.md):
 
 Telemetry series contract (names pinned by tests/test_telemetry.py):
 ``cp/healthy_workers`` (gauge), ``cp/reconnects``, ``cp/resubmits``,
-``cp/retries``, ``cp/poison_shards``, ``cp/degraded_groups`` (counters),
+``cp/retries``, ``cp/poison_shards``, ``cp/degraded_groups``,
+``cp/retires`` (counters),
 plus ``cp/reconnect`` / ``cp/retry`` / ``cp/resubmit`` spans while tracing.
 The weight bus (weight_bus.py, ISSUE 9) adds ``cp/dispatch_bytes``,
 ``cp/weight_bytes_sent``, ``cp/weight_pushes``, ``cp/weight_full_syncs``,
@@ -57,6 +58,10 @@ CP_REJOIN_EPOCH = "cp/rejoin_epoch"  # gauge: bumps per re-admit
 # worker was alive but regressing, so the controller quarantined it and
 # left the rejoin loop to probe + re-admit
 CP_QUARANTINES = "cp/quarantines"
+# intentional scale-in retirements (ISSUE 20 elastic fleet): a retired
+# worker is TERMINAL membership state — drained, never re-dialed, and
+# never counted against the quarantine/reconnect series
+CP_RETIRES = "cp/retires"
 # ---- weight bus (weight_bus.py, ISSUE 9) ----
 CP_DISPATCH_BYTES = "cp/dispatch_bytes"        # counter: MSG_DISPATCH payload bytes
 CP_WEIGHT_BYTES = "cp/weight_bytes_sent"       # counter: MSG_WEIGHTS payload bytes

@@ -365,6 +365,11 @@ class WeightBus:
         connection_factory: Callable | None = None,
     ):
         self._addresses = [tuple(a) for a in addresses]
+        # guards MEMBERSHIP mutations (ISSUE 20 add_worker/retire_worker):
+        # the sender thread snapshots the target set per broadcast, and a
+        # retire mid-broadcast must make the victim's push a skip, never a
+        # flush()-wedging straggler
+        self._members_mu = threading.Lock()
         self.retry = retry_policy or RetryPolicy()
         self._connect_timeout_ms = connect_timeout_ms
         self._ack_timeout_ms = ack_timeout_ms
@@ -449,6 +454,51 @@ class WeightBus:
             except Exception:  # noqa: BLE001 — already tearing down
                 pass
 
+    # ---------------------------------------------------------- membership
+
+    def member_addresses(self) -> list[tuple]:
+        with self._members_mu:
+            return list(self._addresses)
+
+    def _is_member(self, address: tuple) -> bool:
+        with self._members_mu:
+            return tuple(address) in self._addresses
+
+    def add_worker(self, address: tuple) -> bool:
+        """Admit a new broadcast target (ISSUE 20 scale-up). Must run
+        BEFORE the control plane's admission hook fires — the hook's
+        ``sync_worker`` call needs the address to be a member. The new
+        worker has no acked base, so its first push is automatically a
+        full-tensor sync. Returns False if already a member."""
+        address = tuple(address)
+        with self._members_mu:
+            if address in self._addresses:
+                return False
+            self._addresses.append(address)
+        with self._chan_mu_guard:
+            self._chan_mu.setdefault(address, threading.Lock())
+        return True
+
+    def retire_worker(self, address: tuple) -> bool:
+        """Remove a broadcast target (ISSUE 20 scale-in): drop its channel
+        and acked state, and wake any ``flush()`` blocked on its ack — a
+        retired worker must complete the drain, never hang it. Returns
+        False if not a member."""
+        address = tuple(address)
+        with self._members_mu:
+            if address not in self._addresses:
+                return False
+            self._addresses.remove(address)
+        self._drop_channel(address)
+        with self._acked_mu:
+            self._acked.pop(address, None)
+        # the survivors may ALL have acked already: recompute the
+        # watermark and re-evaluate any blocked flush()
+        self._refresh_acked()
+        with self._done:
+            self._done.notify_all()
+        return True
+
     # --------------------------------------------------------------- pushes
 
     def push(self, tree_np, version: int) -> None:
@@ -464,10 +514,11 @@ class WeightBus:
             return False
         if self.last_pushed_version is None:
             return True
+        targets = self.member_addresses()  # retired workers never block a drain
         with self._acked_mu:
             return all(
                 self._acked.get(a, (None, None))[0] == self.last_pushed_version
-                for a in self._addresses
+                for a in targets
             )
 
     def flush(self, timeout_s: float = 60.0) -> bool:
@@ -515,11 +566,15 @@ class WeightBus:
             ok, nbytes = self._push_worker(a, tree_np, version)
             return a, ok, nbytes, (time.perf_counter() - tw) * 1e3
 
+        # membership snapshot: a worker added mid-broadcast gets its full
+        # sync through the admission hook; one retired mid-broadcast turns
+        # its in-flight push into a skip (checked per attempt below)
+        targets = self.member_addresses()
         with ThreadPoolExecutor(
-            max_workers=max(len(self._addresses), 1),
+            max_workers=max(len(targets), 1),
             thread_name_prefix="cp-weight-push",
         ) as pool:
-            futs = [pool.submit(timed_push, a) for a in self._addresses]
+            futs = [pool.submit(timed_push, a) for a in targets]
             for f in futs:
                 a, ok, nbytes, ack_ms = f.result()
                 oks.append(ok)
@@ -571,6 +626,10 @@ class WeightBus:
             mu = self._chan_mu.setdefault(tuple(address), threading.Lock())
         with mu:
             for attempt in range(self.retry.max_call_retries + 1):
+                if not self._is_member(tuple(address)):
+                    # retired mid-broadcast (ISSUE 20): skip, don't retry —
+                    # the drain completes on the survivors' acks
+                    return False, sent_total
                 with self._acked_mu:
                     base = None if full else self._acked.get(tuple(address))
                 payload = encode_update(
@@ -736,10 +795,11 @@ class WeightBus:
         (a rejoin resync can complete a broadcast a death interrupted)."""
         if self.last_pushed_version is None:
             return
+        targets = self.member_addresses()
         with self._acked_mu:
             if all(
                 self._acked.get(a, (None, None))[0] == self.last_pushed_version
-                for a in self._addresses
+                for a in targets
             ):
                 self.last_acked_version = self.last_pushed_version
 

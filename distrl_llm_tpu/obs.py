@@ -87,6 +87,11 @@ FLEET_GEN_TOKENS = "fleet/gen_tokens_total"  # gauge: cumulative worker tokens
 FLEET_WORKERS_HEALTHY = "fleet/workers_healthy"  # gauge
 FLEET_WORKERS_TOTAL = "fleet/workers_total"      # gauge
 FLEET_REJOIN_EPOCH = "fleet/rejoin_epoch"        # gauge
+# elastic fleet (ISSUE 20): the supervisor/autoscaler publish these — the
+# constants live here with the rest of the fleet/* series (single-owner
+# registry discipline, GC2xx), imported by distributed/fleet.py
+FLEET_TARGET_WORKERS = "fleet/target_workers"    # gauge: autoscaler setpoint
+FLEET_SCALE_EVENTS = "fleet/scale_events"        # counter: grow/shrink events
 
 # engine-side LoraMailbox push→swap latency (engine/engine.py observes it)
 SWAP_LATENCY_MS = "engine/swap_latency_ms"   # histogram
@@ -494,6 +499,11 @@ class FleetAggregator:
         # change detects a restart EXACTLY, where counter regression alone
         # misses an incarnation that already out-generated its predecessor
         self._pids: dict[str, Any] = {}
+        # tokens finalized by workers the fleet SCALED IN (ISSUE 20): a
+        # retired worker's whole cumulative count folds here and its track
+        # is dropped from the live table — fleet/gen_tokens_total stays
+        # monotone and /metrics.json stops carrying the dead track
+        self._scaled_in_tokens = 0.0
 
     @staticmethod
     def _addr(track: str) -> str:
@@ -514,7 +524,34 @@ class FleetAggregator:
             )
             epoch = int(getattr(self.driver, "rejoin_epoch", 0))
             remote = telemetry.remote_metrics()
-            total_tokens = 0.0
+            # elastic scale-in (ISSUE 20): a retired worker is TERMINAL
+            # membership state — fold its cumulative count into the fleet
+            # base (the restart-retirement logic generalized to a whole
+            # track) and drop the track so it never leaks into the live
+            # table or /metrics.json again
+            retired_addrs = {
+                (
+                    f"{a[0]}:{a[1]}" if isinstance(a, (tuple, list))
+                    else str(a)
+                )
+                for a in (
+                    w.get("address") for w in workers if w.get("retired")
+                )
+            }
+            for track in list(remote):
+                if self._addr(track) not in retired_addrs:
+                    continue
+                snap = remote.pop(track)
+                tokens = float(
+                    snap.get("counters", {}).get(OBS_GEN_TOKENS, 0.0)
+                )
+                self._scaled_in_tokens += (
+                    self._retired.pop(track, 0.0) + tokens
+                )
+                self._marks.pop(track, None)
+                self._pids.pop(track, None)
+                telemetry.drop_remote_track(track)
+            total_tokens = self._scaled_in_tokens
             rate = 0.0
             per_worker: dict[str, dict[str, float]] = {}
             for track, snap in remote.items():
@@ -568,7 +605,12 @@ class FleetAggregator:
                 "workers_healthy": sum(
                     1 for w in workers if w.get("healthy")
                 ),
-                "workers_total": len(workers),
+                # retired workers left the membership — they are reported
+                # in "workers" (terminal state, distinctly) but no longer
+                # counted in the pool size
+                "workers_total": sum(
+                    1 for w in workers if not w.get("retired")
+                ),
                 "tok_s": round(rate, 3),
                 "gen_tokens_total": total_tokens,
                 "worker_metrics": per_worker,

@@ -500,6 +500,19 @@ def remote_metrics() -> dict[str, dict]:
         return {k: dict(v) for k, v in st.remote_metrics.items()}
 
 
+def drop_remote_track(track: str) -> bool:
+    """Forget one worker track from the fleet table (elastic scale-in,
+    ISSUE 20): the FleetAggregator folds a retired worker's counter base
+    into the fleet totals first, then drops the track here so a
+    scaled-in worker doesn't leak into ``/metrics.json`` forever. Also
+    clears the trace-track incarnation key — a future worker reusing the
+    address starts a fresh track. Returns True when the track existed."""
+    st = _STATE
+    with st.lock:
+        st.remote_incarnations.pop(track, None)
+        return st.remote_metrics.pop(track, None) is not None
+
+
 def recent_events(n: int = 512) -> list[dict]:
     """Copy of the newest ``n`` recorded trace events (the span tail a
     flight-recorder incident bundles). Empty while tracing is off."""

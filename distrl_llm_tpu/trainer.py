@@ -543,6 +543,10 @@ class Trainer:
                     if self.obs is not None and self.obs.fleet is not None
                     else None
                 ),
+                # elastic fleet (ISSUE 20): the launcher attaches the
+                # FleetSupervisor to the remote engine; the autoscaling
+                # governor actuates the pool through it
+                fleet_supervisor=getattr(engine, "fleet_supervisor", None),
             )
             if (
                 self.control is not None and self.obs is not None
@@ -670,6 +674,23 @@ class Trainer:
                 # version reference; dispatch = legacy weights-in-request
                 weight_bus=config.weight_bus,
             )
+            if "autoscale" in config.armed_controllers():
+                # elastic fleet (ISSUE 20): the supervisor adopts the
+                # connected workers (it can drain-retire them but not
+                # respawn them) and spawns OWNED workers for any scale-up
+                # past this set; the autoscaling governor finds it through
+                # engine.fleet_supervisor at build_runtime time
+                from distrl_llm_tpu.distributed.fleet import (
+                    FleetSupervisor, spec_from_config,
+                )
+
+                supervisor = FleetSupervisor(
+                    spec_from_config(config),
+                    min_workers=config.fleet_min,
+                    max_workers=config.fleet_max,
+                )
+                supervisor.adopt(addresses)
+                supervisor.attach(engine)
         else:
             if config.full_finetune and not meshes.timeshared:
                 # full mode never reads a frozen base on the rollout mesh —

@@ -32,6 +32,7 @@ from distrl_llm_tpu import telemetry
 from distrl_llm_tpu.config import SamplingConfig, TrainConfig
 from distrl_llm_tpu.control import (
     CONTROL_ACTIONS,
+    AutoscaleGovernor,
     BoundedActuator,
     ControlLimits,
     ControlRuntime,
@@ -485,6 +486,185 @@ def _sentinel(tmp_path, runtime=None, **kw):
     return s, rec
 
 
+class _FakeSupervisor:
+    """Scripted FleetSupervisor stand-in: scale_to mutates a fake pool
+    (victims honored first), addresses()/poll() match the real surface."""
+
+    def __init__(self, n=2, base_port=9000):
+        self._next = base_port + n
+        self._addrs = [("127.0.0.1", base_port + i) for i in range(n)]
+        self.scale_calls: list[tuple[int, tuple]] = []
+        self.polls = 0
+
+    @property
+    def pool_size(self):
+        return len(self._addrs)
+
+    def addresses(self):
+        return list(self._addrs)
+
+    def poll(self):
+        self.polls += 1
+        return []
+
+    def scale_to(self, n, victims=()):
+        self.scale_calls.append((int(n), tuple(victims)))
+        pending = list(victims)
+        while len(self._addrs) > int(n):
+            if pending:
+                host, _, port = pending.pop(0).rpartition(":")
+                addr = (host, int(port))
+                if addr in self._addrs:
+                    self._addrs.remove(addr)
+                    continue
+            self._addrs.pop()
+        while len(self._addrs) < int(n):
+            self._addrs.append(("127.0.0.1", self._next))
+            self._next += 1
+
+
+class _FakeFleet:
+    """Deterministic fleet-view provider: each snapshot() tick advances a
+    scripted per-worker token counter at ``rates[addr]`` tok/s."""
+
+    def __init__(self, sup):
+        self.sup = sup
+        self.ts = 100.0
+        self.tokens: dict[str, float] = {}
+        self.rates: dict[str, float] = {}
+
+    def snapshot(self):
+        self.ts += 1.0
+        workers, metrics = [], {}
+        for host, port in self.sup.addresses():
+            a = f"{host}:{port}"
+            self.tokens[a] = self.tokens.get(a, 0.0) + self.rates.get(a, 0.0)
+            workers.append({
+                "address": a, "healthy": True, "cold": False,
+                "retired": False,
+            })
+            metrics[a] = {"gen_tokens": self.tokens[a], "ts": self.ts,
+                          "pid": 1}
+        return {"workers": workers, "worker_metrics": metrics}
+
+
+QW_MAX = "serving/queue_wait_ms_max"
+
+
+class TestAutoscaleGovernor:
+    def _gov(self, sup, fleet, **kw):
+        kw.setdefault("min_workers", 2)
+        kw.setdefault("max_workers", 4)
+        kw.setdefault("queue_wait_high_ms", 100.0)
+        return AutoscaleGovernor(sup, fleet.snapshot, **kw)
+
+    def test_breach_scales_up_under_cooldown_until_max(self):
+        sup = _FakeSupervisor(2)
+        rt = _runtime()
+        gov = self._gov(sup, _FakeFleet(sup), cooldown_steps=2,
+                        dwell_steps=2)
+        rt.register(gov)
+        acted = [bool(rt.on_step(s, {QW_MAX: 500.0})) for s in range(6)]
+        # up at 0, two steps of cooldown, up at 2 → max; then nothing (the
+        # bound is a hard clamp, not an action)
+        assert acted == [True, False, True, False, False, False]
+        assert sup.scale_calls == [(3, ()), (4, ())]
+        assert sup.pool_size == 4
+        assert gov.actuator.value == 4.0
+        # every pass pumped the supervisor (death-respawn rides control)
+        assert sup.polls == 6
+
+    def test_deadband_holds_and_calm_never_shrinks(self):
+        sup = _FakeSupervisor(3)
+        rt = _runtime()
+        gov = self._gov(sup, _FakeFleet(sup), tok_s_low=None,
+                        release_frac=0.7, cooldown_steps=0, dwell_steps=1)
+        rt.register(gov)
+        for s in range(5):
+            assert rt.on_step(s, {QW_MAX: 80.0}) == []   # 0.8x: in band
+        for s in range(5, 10):
+            assert rt.on_step(s, {QW_MAX: 10.0}) == []   # calm, no tok_s_low
+        for s in range(10, 15):
+            assert rt.on_step(s, {}) == []               # no signal at all
+        assert rt.actions_taken == 0
+        assert sup.pool_size == 3
+
+    def test_scale_down_needs_dwell_and_retires_least_productive(self):
+        sup = _FakeSupervisor(3)
+        fleet = _FakeFleet(sup)
+        # distinct per-worker throughput: 9001 is the straggler
+        fleet.rates = {"127.0.0.1:9000": 9.0, "127.0.0.1:9001": 1.0,
+                       "127.0.0.1:9002": 3.0}  # avg 4.33 < tok_s_low
+        rt = _runtime()
+        gov = self._gov(sup, fleet, tok_s_low=5.0, cooldown_steps=0,
+                        dwell_steps=3, min_workers=1)
+        rt.register(gov)
+        assert rt.on_step(0, {}) == []          # marks only, no rates yet
+        assert rt.on_step(1, {}) == []          # dwell 1/3
+        assert rt.on_step(2, {QW_MAX: 80.0}) == []  # in-band: dwell resets
+        assert rt.on_step(3, {}) == []          # dwell 1/3 again
+        assert rt.on_step(4, {}) == []          # dwell 2/3
+        actions = rt.on_step(5, {})             # dwell 3/3 → shrink
+        assert [a.kind for a in actions] == ["scale_down"]
+        # victims ranked ascending rate EMA: straggler first
+        assert sup.scale_calls == [(2, (
+            "127.0.0.1:9001", "127.0.0.1:9002", "127.0.0.1:9000",
+        ))]
+        assert ("127.0.0.1", 9001) not in sup.addresses()
+        # survivors average (9+3)/2 = 6 ≥ 5: the pool holds from here
+        for s in range(6, 12):
+            assert rt.on_step(s, {}) == []
+        assert sup.pool_size == 2
+
+    def test_min_bound_holds_under_sustained_low_rate(self):
+        sup = _FakeSupervisor(2)
+        fleet = _FakeFleet(sup)
+        fleet.rates = {"127.0.0.1:9000": 0.5, "127.0.0.1:9001": 0.5}
+        rt = _runtime()
+        gov = self._gov(sup, fleet, tok_s_low=5.0, cooldown_steps=0,
+                        dwell_steps=2, min_workers=2)
+        rt.register(gov)
+        for s in range(8):
+            rt.on_step(s, {})
+        assert rt.actions_taken == 0
+        assert sup.pool_size == 2
+
+    def test_budget_freezes_the_pool(self):
+        sup = _FakeSupervisor(2)
+        rt = _runtime(budget=1)
+        gov = self._gov(sup, _FakeFleet(sup), cooldown_steps=0)
+        rt.register(gov)
+        for s in range(5):
+            rt.on_step(s, {QW_MAX: 500.0})
+        assert rt.actions_taken == 1
+        assert sup.pool_size == 3  # one admission, then frozen
+
+    def test_trigger_escalates_once_then_cooldown(self):
+        sup = _FakeSupervisor(2)
+        rt = _runtime()
+        gov = self._gov(sup, _FakeFleet(sup), cooldown_steps=5)
+        rt.register(gov, triggers=("queue_wait_blowup",))
+        assert rt.on_trigger("queue_wait_blowup", 3) is True
+        assert rt.on_trigger("queue_wait_blowup", 4) is False
+        assert rt.actions_taken == 1
+        assert rt.actions[0].kind == "scale_up"
+        assert rt.actions[0].trigger == "queue_wait_blowup"
+        assert sup.scale_calls == [(3, ())]
+
+    def test_bounds_validated(self):
+        sup = _FakeSupervisor(2)
+        with pytest.raises(ValueError, match="min_workers"):
+            AutoscaleGovernor(sup, None, min_workers=0, max_workers=2)
+        with pytest.raises(ValueError, match="min_workers"):
+            AutoscaleGovernor(sup, None, min_workers=3, max_workers=2)
+        with pytest.raises(ValueError, match="release_frac"):
+            AutoscaleGovernor(sup, None, min_workers=1, max_workers=2,
+                              release_frac=1.5)
+        with pytest.raises(ValueError, match="dwell_steps"):
+            AutoscaleGovernor(sup, None, min_workers=1, max_workers=2,
+                              dwell_steps=0)
+
+
 class TestTriggerWiring:
     def test_escalation_exactly_once(self, tmp_path, monkeypatch):
         monkeypatch.setenv("DISTRL_SENTINEL_INJECT", "hbm_breach:2")
@@ -848,6 +1028,35 @@ class TestConfigPolicy:
             TrainConfig(control_dwell_steps=0)
         with pytest.raises(ValueError, match="control_lag_ms"):
             TrainConfig(control_lag_ms=0.0)
+
+    def test_autoscale_requires_elastic_shape(self):
+        # dead flag: no rollout pool to resize
+        with pytest.raises(ValueError, match="control_autoscale"):
+            TrainConfig(control_autoscale=True)
+        # an elastic pool with rejoin off cannot admit cold workers
+        with pytest.raises(ValueError, match="control_autoscale"):
+            TrainConfig(
+                control_autoscale=True, rollout_workers=("127.0.0.1:1",),
+                worker_rejoin=False, fleet_min=1, fleet_max=4,
+            )
+        # bounds must be a sane interval once either is set
+        with pytest.raises(ValueError, match="fleet_min"):
+            TrainConfig(fleet_min=3, fleet_max=2)
+        with pytest.raises(ValueError, match="fleet_min"):
+            TrainConfig(fleet_max=2)  # fleet_min left 0
+
+    def test_autoscale_explicit_only_never_under_master(self):
+        base = dict(
+            rollout_workers=("127.0.0.1:1",), worker_rejoin=True,
+            fleet_min=1, fleet_max=4,
+        )
+        # --control on a shape that COULD host it still does not arm it:
+        # resizing the pool is a capacity decision, always explicit
+        assert "autoscale" not in TrainConfig(
+            control=True, **base
+        ).armed_controllers()
+        cfg = TrainConfig(control_autoscale=True, **base)
+        assert "autoscale" in cfg.armed_controllers()
 
 
 # ------------------------------------------------------- nan rollback e2e

@@ -128,6 +128,121 @@ class TestDispatchCollect:
             driver.dispatch_objects([("echo", 1)], timeout_ms=2000)
 
 
+@needs_native
+class TestDynamicMembership:
+    """Elastic fleet (ISSUE 20): add_worker / retire_worker on a live
+    plane, and the retire-vs-rejoin aliasing regression."""
+
+    def test_add_worker_admits_third(self, two_workers):
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        p3, port3 = spawn_worker()
+        try:
+            assert driver.add_worker(("127.0.0.1", port3))
+            assert driver.num_healthy == 3
+            assert driver.membership_epoch >= 1
+            # the new member takes real dispatch work immediately
+            got = driver.dispatch_objects(
+                [("echo", i) for i in range(6)], timeout_ms=30_000
+            )
+            assert got == list(range(6))
+            # a second add of an active member is refused, not duplicated
+            assert not driver.add_worker(("127.0.0.1", port3))
+            assert driver.num_healthy == 3
+            driver.shutdown()
+            assert p3.wait(timeout=10) == 0
+        finally:
+            if p3.poll() is None:
+                p3.send_signal(signal.SIGKILL)
+                p3.wait(timeout=10)
+
+    def test_retire_worker_drains_gracefully(self, two_workers):
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        assert driver.retire_worker(addrs[0], drain=True)
+        # the drained worker exits 0 — the graceful-shutdown contract, not
+        # a kill
+        assert procs[0].wait(timeout=15) == 0
+        states = {s["address"]: s for s in driver.worker_states()}
+        key = f"{addrs[0][0]}:{addrs[0][1]}"
+        assert states[key]["retired"] and not states[key]["healthy"]
+        # the survivor still serves a full round (conservation)
+        got = driver.dispatch_objects(
+            [("echo", i) for i in range(4)], timeout_ms=10_000
+        )
+        assert got == list(range(4))
+        assert driver.num_healthy == 1
+        driver.shutdown()
+
+    def test_retired_worker_is_never_redialed(self, two_workers):
+        """Regression (ISSUE 20 satellite): retire is TERMINAL. The rejoin
+        loop must not re-dial a retired address even when a fresh process
+        answers on the same port — retired != dead-awaiting-rejoin."""
+        import socket
+        import time
+
+        procs, addrs = two_workers
+        driver = DriverClient(addrs, rejoin=True, rejoin_poll_s=0.05)
+        epoch_before = driver.rejoin_epoch
+        assert driver.retire_worker(addrs[0], drain=True)
+        assert procs[0].wait(timeout=15) == 0
+        # resurrect a listener on the SAME port: a rejoin loop that still
+        # tracks the address would dial and re-admit it
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(addrs[0])
+            s.listen(1)
+            s.settimeout(1.5)
+            try:
+                conn, _ = s.accept()
+                conn.close()
+                raise AssertionError(
+                    "rejoin loop dialed a retired worker's address"
+                )
+            except socket.timeout:
+                pass  # nobody dialed — retired stayed terminal
+        assert driver.rejoin_epoch == epoch_before
+        assert driver.num_healthy == 1
+        # retire never books quarantine/reconnect counters — it has its
+        # own series
+        from distrl_llm_tpu import telemetry
+        from distrl_llm_tpu.distributed import resilience
+
+        snap = telemetry.metrics_snapshot()
+        assert snap.get(resilience.CP_RETIRES, 0.0) >= 1.0
+        assert snap.get(resilience.CP_QUARANTINES, 0.0) == 0.0
+        time.sleep(0.1)
+        driver.shutdown()
+
+    def test_scale_event_mid_round_conserves_groups(self, two_workers):
+        """A dispatch round racing a retire loses nothing: the retired
+        worker's in-flight shard resubmits to the survivors."""
+        import threading
+
+        procs, addrs = two_workers
+        driver = DriverClient(addrs)
+        results: list = []
+
+        def rounds():
+            for _ in range(10):
+                results.append(
+                    driver.dispatch_objects(
+                        [("echo", i) for i in range(6)], timeout_ms=30_000
+                    )
+                )
+
+        th = threading.Thread(target=rounds)
+        th.start()
+        driver.retire_worker(addrs[1], drain=True)
+        th.join(timeout=60)
+        assert not th.is_alive()
+        assert len(results) == 10
+        for got in results:
+            assert got == list(range(6))
+        assert procs[1].wait(timeout=15) == 0
+        driver.shutdown()
+
+
 class TestJaxDistributed:
     def test_two_process_initialize(self, tmp_path):
         """jax.distributed.initialize across 2 CPU processes: both see the

@@ -1,0 +1,177 @@
+"""FleetSupervisor unit tier (ISSUE 20): the argv recipe's CLI parity,
+pool lifecycle (start → scale_to → retire) with the death-vs-drain
+exit-status ledger, and poll()'s bounded death→respawn convergence.
+
+Echo workers (no --serve-model) keep this tier fast; the full
+model-serving elastic loop — governor, weight-bus resync, aggregator
+folds — is gated end-to-end by tools/fleet_smoke.py.
+"""
+
+import signal
+
+import pytest
+
+from distrl_llm_tpu import telemetry
+from distrl_llm_tpu.config import TrainConfig
+from distrl_llm_tpu.distributed.fleet import (
+    FleetSupervisor,
+    WorkerSpec,
+    spec_from_config,
+)
+from distrl_llm_tpu.native.build import native_available
+
+pytestmark = [pytest.mark.distributed]
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ not available"
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _echo_spec():
+    return WorkerSpec(env={"JAX_PLATFORMS": "cpu"})
+
+
+class TestWorkerSpec:
+    def test_argv_uses_worker_main_own_flags(self):
+        spec = WorkerSpec(
+            serve_model="tiny", max_prompt_tokens=8, max_new_tokens=6,
+            seed=7, lora_rank=4, lora_alpha=8.0, engine_impl="paged",
+            extra_args=("--capture-logprobs",),
+        )
+        argv = spec.argv()
+        assert argv[1:4] == ["-m", "distrl_llm_tpu.distributed.worker_main",
+                             "--port"]
+        for flag, value in (
+            ("--serve-model", "tiny"), ("--max-prompt-tokens", "8"),
+            ("--max-new-tokens", "6"), ("--seed", "7"),
+            ("--lora-rank", "4"), ("--lora-alpha", "8.0"),
+            ("--engine-impl", "paged"),
+        ):
+            assert argv[argv.index(flag) + 1] == value
+        assert argv[-1] == "--capture-logprobs"
+
+    def test_echo_spec_omits_engine_flags(self):
+        argv = WorkerSpec().argv()
+        assert "--serve-model" not in argv
+
+    def test_spec_from_config_maps_aliased_fields(self):
+        cfg = TrainConfig(
+            model="tiny", max_prompt_tokens=16, max_new_tokens=24,
+            max_lora_rank=8, lora_alpha=16.0,
+            workers_capture_logprobs=True, clip_ratio=0.2,
+            async_rollout=True, rollout_workers=("127.0.0.1:1",),
+            number_of_actors=1, number_of_learners=1,
+            learner_chunk_size=0, metrics_backend="null",
+        )
+        spec = spec_from_config(cfg)
+        assert spec.serve_model == "tiny"
+        assert spec.max_prompt_tokens == 16 and spec.max_new_tokens == 24
+        assert spec.lora_rank == 8 and spec.lora_alpha == 16.0
+        assert spec.engine_impl == "dense"
+        assert "--capture-logprobs" in spec.extra_args
+        # piggybacked registry snapshots feed the autoscaler's victim marks
+        assert spec.env.get("DISTRL_OBS") == "1"
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="min_workers"):
+            FleetSupervisor(WorkerSpec(), min_workers=0, max_workers=2)
+        with pytest.raises(ValueError, match="min_workers"):
+            FleetSupervisor(WorkerSpec(), min_workers=3, max_workers=2)
+
+
+@needs_native
+class TestSupervisorLifecycle:
+    def test_start_scale_retire_and_drain_ledger(self):
+        sup = FleetSupervisor(
+            _echo_spec(), min_workers=1, max_workers=3, restart_budget=1
+        )
+        try:
+            addrs = sup.start(2)
+            assert len(addrs) == 2 and sup.pool_size == 2
+            assert sup.target_workers == 2
+
+            assert sup.scale_to(3) == 3
+            assert sup.pool_size == 3 and sup.scale_events == 1
+
+            # shrink to 1, naming the FIRST worker as the victim: it goes
+            # before the newest-first remainder
+            survivor_pool_before = sup.addresses()
+            victim = f"{addrs[0][0]}:{addrs[0][1]}"
+            assert sup.scale_to(1, victims=(victim,)) == 1
+            assert sup.pool_size == 1 and sup.scale_events == 2
+            assert tuple(addrs[0]) not in sup.addresses()
+            # newest-first remainder: the scale-up worker (coldest) went,
+            # the second seed worker survived
+            assert sup.addresses() == [survivor_pool_before[1]]
+            # SIGTERM contract: both retires drained (exit 0), no deaths
+            assert sup.drains == 2 and sup.deaths == 0
+
+            # clamp: target beyond max_workers truncates, and a resize
+            # that changes nothing is not a scale event
+            assert sup.scale_to(99) == 3
+            events_after = sup.scale_events
+            assert sup.scale_to(3) == 3
+            assert sup.scale_events == events_after
+        finally:
+            sup.close()
+
+    def test_poll_respawns_deaths_within_budget(self):
+        sup = FleetSupervisor(
+            _echo_spec(), min_workers=1, max_workers=3, restart_budget=1
+        )
+        try:
+            sup.start(2)
+            first = sorted(sup.addresses())
+            rec = next(iter(sup._procs.values()))
+            rec.proc.send_signal(signal.SIGKILL)
+            rec.proc.wait(timeout=10)
+
+            out = sup.poll()
+            assert out["dead"] == 1 and out["respawned"] == 1
+            assert out["restarts_left"] == 0
+            assert sup.pool_size == 2 and sup.deaths == 1
+            # the replacement is a fresh port, never the dead address
+            assert rec.address not in sup.addresses()
+            assert sorted(sup.addresses()) != first
+
+            # budget exhausted: the next death shrinks the pool for good
+            rec2 = next(iter(sup._procs.values()))
+            rec2.proc.send_signal(signal.SIGKILL)
+            rec2.proc.wait(timeout=10)
+            out = sup.poll()
+            assert out["dead"] == 1 and out["respawned"] == 0
+            assert sup.pool_size == 1 and sup.deaths == 2
+            # a quiet pool polls clean
+            assert sup.poll()["dead"] == 0
+        finally:
+            sup.close()
+
+    def test_adopted_workers_join_pool_without_ownership(self):
+        sup = FleetSupervisor(
+            _echo_spec(), min_workers=1, max_workers=4, restart_budget=0
+        )
+        sup.adopt(["127.0.0.1:7001", ("127.0.0.1", 7002)])
+        assert sup.pool_size == 2 and sup.target_workers == 2
+        assert ("127.0.0.1", 7001) in sup.addresses()
+        # no Popen handle: poll never books an adopted worker as dead
+        assert sup.poll()["dead"] == 0
+        sup.close()  # nothing owned to reap
+
+    def test_telemetry_gauges_track_target(self):
+        sup = FleetSupervisor(
+            _echo_spec(), min_workers=1, max_workers=2, restart_budget=0
+        )
+        try:
+            sup.start(1)
+            sup.scale_to(2)
+            snap = telemetry.metrics_snapshot()
+            assert snap["fleet/target_workers"] == 2.0
+            assert snap["fleet/scale_events"] == 1.0
+        finally:
+            sup.close()

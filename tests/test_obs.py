@@ -263,6 +263,59 @@ class TestFleetAggregation:
         assert agg.refresh() is first  # cached within the window
         assert agg.refresh(force=True) is not first
 
+    def test_scale_in_folds_retired_worker_and_drops_track(self):
+        """Elastic scale-in (ISSUE 20): a retired worker's cumulative count
+        folds into the fleet base (gen_tokens_total stays monotone across
+        the event), its track leaves the live table AND the telemetry
+        fleet table, and the membership accounting excludes the terminal
+        slot while still listing it in the worker states."""
+        driver = _FakeDriver()
+        agg = obs.FleetAggregator(driver, min_refresh_s=0.0)
+        _worker_snapshot("worker h1:1", 300.0, ts=10.0)
+        _worker_snapshot("worker h2:2", 200.0, ts=10.0)
+        fleet = agg.refresh(force=True)
+        assert fleet["gen_tokens_total"] == 500.0
+        assert fleet["workers_total"] == 2
+
+        # h2 retires (graceful drain): terminal membership state
+        driver._states[1]["healthy"] = False
+        driver._states[1]["retired"] = True
+        fleet = agg.refresh(force=True)
+        assert fleet["gen_tokens_total"] == 500.0  # monotone across fold
+        assert "h2:2" not in fleet["worker_metrics"]
+        assert "worker h2:2" not in telemetry.remote_metrics()  # no leak
+        assert fleet["workers_total"] == 1
+        assert fleet["workers_healthy"] == 1
+        # the terminal state is still VISIBLE (ledger), just not counted
+        assert any(w.get("retired") for w in fleet["workers"])
+        snap = telemetry.metrics_snapshot()
+        assert snap["fleet/workers_total"] == 1.0
+        assert snap["fleet/gen_tokens_total"] == 500.0
+
+        # the survivor keeps rating against the folded base
+        _worker_snapshot("worker h1:1", 400.0, ts=12.0)
+        fleet = agg.refresh(force=True)
+        assert fleet["gen_tokens_total"] == 600.0
+        assert fleet["tok_s"] == pytest.approx(50.0)
+        assert list(fleet["worker_metrics"]) == ["h1:1"]
+
+    def test_scale_in_fold_includes_restart_retired_base(self):
+        """A worker that restarted once (per-track retired base) and THEN
+        scaled in must fold base + final count — dropping either would
+        regress the published fleet total."""
+        driver = _FakeDriver()
+        agg = obs.FleetAggregator(driver, min_refresh_s=0.0)
+        _worker_snapshot("worker h2:2", 1000.0, ts=10.0, pid=1)
+        agg.refresh(force=True)
+        _worker_snapshot("worker h2:2", 50.0, ts=11.0, pid=2)  # restarted
+        fleet = agg.refresh(force=True)
+        assert fleet["gen_tokens_total"] == 1050.0
+        driver._states[1]["retired"] = True
+        driver._states[1]["healthy"] = False
+        fleet = agg.refresh(force=True)
+        assert fleet["gen_tokens_total"] == 1050.0  # 1000 base + 50 final
+        assert "h2:2" not in fleet["worker_metrics"]
+
 
 class TestFlightRecorder:
     def test_ring_bound_and_eviction(self):

@@ -308,6 +308,9 @@ class TestRegistry:
         assert r.CP_RETRIES == "cp/retries"
         assert r.CP_POISON_SHARDS == "cp/poison_shards"
         assert r.CP_DEGRADED_GROUPS == "cp/degraded_groups"
+        # intentional scale-in (ISSUE 20): a COUNTER, distinct from the
+        # quarantine/reconnect vocabulary — retire is terminal, not a fault
+        assert r.CP_RETIRES == "cp/retires"
         telemetry.gauge_set(r.CP_HEALTHY_GAUGE, 4)
         telemetry.gauge_set(r.CP_HEALTHY_GAUGE, 3)  # gauge: last value
         telemetry.counter_add(r.CP_RECONNECTS)
@@ -497,6 +500,10 @@ class TestRegistry:
         assert obs.FLEET_WORKERS_HEALTHY == "fleet/workers_healthy"
         assert obs.FLEET_WORKERS_TOTAL == "fleet/workers_total"
         assert obs.FLEET_REJOIN_EPOCH == "fleet/rejoin_epoch"
+        # elastic-fleet pins (ISSUE 20): the autoscaler's target-size gauge
+        # and the scale-event counter-as-gauge the supervisor republishes
+        assert obs.FLEET_TARGET_WORKERS == "fleet/target_workers"
+        assert obs.FLEET_SCALE_EVENTS == "fleet/scale_events"
         assert r.CP_REJOIN_EPOCH == "cp/rejoin_epoch"
         telemetry.gauge_set(obs.FLEET_TOK_S, 1200.0)
         telemetry.gauge_set(obs.FLEET_GEN_TOKENS, 4000.0)

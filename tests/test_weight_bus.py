@@ -381,6 +381,91 @@ class TestBusPushPlane:
 
 
 @needs_native
+class TestBusScaleEvents:
+    """ISSUE 20: the bus under membership churn — a retired worker must
+    never wedge flush(), and a just-added worker's first push must be a
+    full-tensor sync (it has no acked base to delta against)."""
+
+    def test_retired_worker_never_wedges_flush(self, workers):
+        """A dead member blocks the drain (its ack never comes); retiring
+        it removes it from the target set and wakes the blocked flush —
+        the drain completes on the survivor's ack alone."""
+        from distrl_llm_tpu.distributed.resilience import RetryPolicy
+
+        procs, addrs = workers
+        eng = _connect(
+            addrs,
+            retry_policy=RetryPolicy(max_call_retries=1, base_s=0.05, seed=0),
+        )
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        eng.push_lora(lora, version=0)
+        assert eng.bus.flush(timeout_s=60)
+
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        eng.push_lora(
+            jax.tree_util.tree_map(lambda x: x + 0.5, lora), version=1
+        )
+        # the dead worker is still a member: the drain cannot complete
+        assert not eng.bus.flush(timeout_s=3.0)
+        assert eng.bus.last_acked_version == 0
+
+        # retire (death path: no drain RPC) → membership shrinks, the
+        # watermark recomputes over survivors, flush returns promptly
+        assert eng.retire_worker(addrs[0], drain=False)
+        assert eng.bus.flush(timeout_s=30)
+        assert eng.bus.last_acked_version == 1
+        assert eng.bus.member_addresses() == [tuple(addrs[1])]
+        # the survivor actually holds v1
+        dbg = eng.driver.dispatch_objects([("weights_debug", {})], 60_000)[0]
+        assert dbg["current"] == 1
+        eng.driver.shutdown()
+
+    def test_added_worker_first_push_is_full_sync(self, workers):
+        """add_worker on a bus-backed engine admits the address, and the
+        admission hook lands the CURRENT version full-tensor before the
+        worker takes traffic; the next version then deltas against it."""
+        _, addrs = workers
+        eng = _connect(addrs[:1])
+        lora = init_lora_params(jax.random.PRNGKey(1), TINY, rank=4)
+        lora1 = jax.tree_util.tree_map(lambda x: x + 0.5, lora)
+        eng.push_lora(lora, version=0)
+        eng.push_lora(lora1, version=1)
+        assert eng.bus.flush(timeout_s=60)
+
+        telemetry.metrics_snapshot()  # reset counter deltas
+        assert eng.add_worker(addrs[1])
+        assert eng.driver.num_healthy == 2
+        assert tuple(addrs[1]) in eng.bus.member_addresses()
+        # the admission resync already landed v1 (full): flush is a no-op
+        # wait, and the counter shows the full-tensor push
+        assert eng.bus.flush(timeout_s=60)
+        assert eng.bus.acked_version(tuple(addrs[1])) == 1
+        snap = telemetry.metrics_snapshot()
+        assert snap.get("cp/weight_full_syncs", 0) >= 1
+        dbg = eng.driver.dispatch_objects(
+            [("weights_debug", {}), ("weights_debug", {})], 60_000
+        )
+        for d in dbg:
+            assert d["current"] == 1
+            assert d["checksums"][1] == wb.checksum_tree(
+                jax.tree_util.tree_map(np.asarray, lora1)
+            )
+        # with an acked base in place, the NEXT push deltas everywhere —
+        # no full-tensor frame in a steady-state broadcast
+        eng.push_lora(
+            jax.tree_util.tree_map(lambda x: x + 0.25, lora1), version=2
+        )
+        assert eng.bus.flush(timeout_s=60)
+        snap = telemetry.metrics_snapshot()
+        assert snap.get("cp/weight_full_syncs", 0) == 0
+        assert [
+            eng.bus.acked_version(tuple(a)) for a in addrs
+        ] == [2, 2]
+        eng.driver.shutdown()
+
+
+@needs_native
 class TestBroadcastGeneration:
     @pytest.mark.slow
     def test_broadcast_matches_dispatch_and_sheds_payload_bytes(

@@ -86,6 +86,10 @@ def comparable(a: dict, b: dict) -> bool:
         # share both the mode and the arrival rate
         and (a.get("gateway_mode"), a.get("arrival_rate"))
         == (b.get("gateway_mode"), b.get("arrival_rate"))
+        # elastic-fleet rows (ISSUE 20): fleet-wide tok/s scales with the
+        # pool, so a 4-worker round against a 2-worker round is a capacity
+        # A/B, not a regression pair — scoreable pairs must share the arm
+        and a.get("fleet_workers") == b.get("fleet_workers")
         and "error" not in a and "error" not in b
     )
 
@@ -111,12 +115,22 @@ LATENCY_FIELDS = (
     # rate, so an interactive-p99 increase between rounds is a scheduling
     # regression, not a load difference
     "ttft_p99_interactive_ms", "ttft_p99_batch_ms",
+    # weight-bus broadcast p50 (ISSUE 20; null on local-rollout rows —
+    # skipped then): a slower adapter push between comparable same-fleet
+    # rounds means resyncs started eating the rollout budget
+    "weight_sync_ms",
 )
 # per-row rate fields scanned the same way but HIGHER-is-better (ISSUE 18:
 # a radix hit-rate drop between comparable cache-on rounds means warm
 # admissions stopped landing — a cache regression even when tok/s is
 # noisy); null on cache-off rows — skipped then
-RATE_FIELDS = ("radix_hit_rate",)
+RATE_FIELDS = (
+    "radix_hit_rate",
+    # fleet-wide generated tok/s (ISSUE 20; null off-fleet — skipped
+    # then): comparable() pins both rounds to the same fleet_workers arm,
+    # so a drop here is lost per-worker throughput, not a smaller pool
+    "fleet_tok_s",
+)
 # per-row measured-bytes fields scanned the same way (ISSUE 15; null when
 # the backend reported no cost analysis — skipped then). comparable()
 # already pins both rounds to the same base_quant/kv_format arm, so a
@@ -222,6 +236,8 @@ def main(argv: list[str] | None = None) -> int:
                     unit, prec = ("ms", 1)
                     if field in BYTES_FIELDS:
                         unit = "B/tok"
+                    elif field == "fleet_tok_s":
+                        unit = "tok/s"
                     elif field in RATE_FIELDS:
                         unit, prec = ("", 3)
                     sign = "-" if field in RATE_FIELDS else "+"

@@ -141,6 +141,14 @@ stage "radix_smoke" env JAX_PLATFORMS=cpu \
 # byte-identical before the gateway ever attaches and after it closes
 stage "gateway_smoke" env JAX_PLATFORMS=cpu \
   timeout 600 python tools/gateway_smoke.py
+# elastic-fleet gate (ISSUE 20): a supervised pool scales 2→4→2 under fake
+# load signals — cooldown-spaced scale-ups admit cold workers that answer
+# dispatches, a seeded SIGKILL mid-scale-event converges via the restart
+# budget, scale-downs drain gracefully (exactly one drain per retire),
+# fleet totals stay monotone across scale-in, and the armed-but-quiescent
+# autoscaler is byte-identical to controllers-off
+stage "fleet_smoke" env JAX_PLATFORMS=cpu \
+  timeout 600 python tools/fleet_smoke.py
 # bench-trajectory stage (WARN-ONLY): fold the BENCH_r*.json artifacts into
 # one table and flag >10% per-metric tok/s regressions — machine-readable
 # bench history, but cross-round rows come from different silicon windows,

@@ -389,6 +389,58 @@ def control_section(events: list[dict]) -> list[str]:
     return lines
 
 
+def fleet_section(events: list[dict]) -> list[str]:
+    """Elastic-fleet view (ISSUE 20): the autoscaler's setpoint trajectory
+    (``fleet/target_workers`` gauge), scale events (``fleet/scale_events``
+    counter), graceful retirements (``cp/retires``), and every
+    ``control/action`` instant stamped by the ``autoscale`` governor with
+    its old→new pool target. Empty when the run never scaled and never
+    armed --control_autoscale — a static fleet leaves no trace here."""
+    targets: list[float] = []
+    scale_events = retires = 0.0
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        name = ev.get("name", "")
+        args = ev.get("args", {})
+        key = name.rsplit("/", 1)[-1]
+        if name == "fleet/target_workers":
+            targets.append(float(args.get(key, 0)))
+        elif name == "fleet/scale_events":
+            scale_events += float(args.get(key, 0))
+        elif name == "cp/retires":
+            retires += float(args.get(key, 0))
+    actions = [
+        ev.get("args", {}) for ev in events
+        if ev.get("ph") == "i" and ev.get("name") == "control/action"
+        and ev.get("args", {}).get("controller") == "autoscale"
+    ]
+    if not actions and not scale_events and not retires:
+        return []
+    lines = ["fleet:"]
+    if targets:
+        lines.append(
+            f"  target pool:        {targets[0]:.0f} -> {targets[-1]:.0f} "
+            f"(min {min(targets):.0f} / max {max(targets):.0f} across "
+            f"{len(targets)} samples)"
+        )
+    ups = sum(1 for a in actions if a.get("kind") == "scale_up")
+    downs = sum(1 for a in actions if a.get("kind") == "scale_down")
+    lines.append(
+        f"  scale events:       {scale_events:.0f} applied — "
+        f"{ups} up / {downs} down actuations, {retires:.0f} retire(s)"
+    )
+    for a in actions[:8]:
+        lines.append(
+            f"    step {a.get('step', '?'):>4}  [{a.get('kind', '?')}] "
+            f"pool {a.get('old')} -> {a.get('new')} ({a.get('reason', '')})"
+        )
+    if len(actions) > 8:
+        lines.append(f"    … and {len(actions) - 8} more")
+    lines.append("")
+    return lines
+
+
 def lineage_section(events: list[dict],
                     spans: dict[tuple[int, str], list[dict]],
                     tracks: dict[int, str]) -> list[str]:
@@ -632,6 +684,7 @@ def build_report(events: list[dict], metadata: dict,
     lines.extend(serving_section(events))
     lines.extend(learning_section(events))
     lines.extend(control_section(events))
+    lines.extend(fleet_section(events))
     lines.extend(lineage_section(events, spans, tracks))
     lines.extend(spec_section(spans))
 

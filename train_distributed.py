@@ -467,6 +467,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--control_lag_ms", type=float, default=5000.0,
                    help="staleness-governor setpoint: policy-lag p90 above "
                         "this shrinks the effective staleness bound")
+    p.add_argument("--control_autoscale", action="store_true",
+                   help="autoscaling governor (ISSUE 20): steer the "
+                        "supervised worker pool's target size over "
+                        "[--fleet_min, --fleet_max] from serving queue "
+                        "wait and learner idle (scale-up admits a cold "
+                        "worker through the weight-bus resync; scale-down "
+                        "drains the least-productive one). Requires "
+                        "--rollout_workers with rejoin on and the fleet "
+                        "bounds; never armed by the --control master")
+    p.add_argument("--fleet_min", type=int, default=0,
+                   help="lower bound on the autoscaler's target worker "
+                        "count (0 = no elastic fleet)")
+    p.add_argument("--fleet_max", type=int, default=0,
+                   help="upper bound on the autoscaler's target worker "
+                        "count (0 = no elastic fleet)")
     p.add_argument("--prompt_buckets", type=str, default="",
                    help="comma-separated prompt length buckets for the "
                         "rollout engine, e.g. 128,256 (max_prompt_tokens is "
